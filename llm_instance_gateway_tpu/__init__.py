@@ -1,0 +1,28 @@
+"""TPU-native inference gateway + serving framework.
+
+A brand-new, TPU-first framework with the capabilities of
+kubernetes-sigs/llm-instance-gateway (the Gateway API Inference Extension):
+
+- ``gateway``  — the Endpoint Picker: filter-tree scheduler over a live metrics
+  plane (KV-cache headroom, prefill/decode queue depths, criticality, LoRA
+  affinity), ext-proc-style transport and a standalone reverse proxy.
+- ``server``   — the TPU model server the reference delegates to vLLM:
+  continuous batching with a prefill/decode split, paged KV cache, OpenAI-style
+  API, Prometheus metrics matching the gateway contract, LoRA hot-swap via
+  Orbax restore into pre-allocated adapter slots (no XLA recompilation).
+- ``models``   — JAX/Flax-free pytree model definitions (Llama-3-style GQA
+  decoder, Gemma, Mixtral-MoE) with multi-LoRA slot application.
+- ``ops``      — Pallas TPU kernels (flash attention, paged decode attention,
+  multi-LoRA bgmv) with XLA fallbacks for CPU tests.
+- ``parallel`` — device-mesh shardings (dp/fsdp/tp/sp), ring attention for
+  long context, collective helpers.
+- ``api``      — InferencePool / InferenceModel declarative config
+  (CRD-equivalent) + reconcilers in ``gateway.controllers``.
+- ``sim``      — discrete-event simulator of continuous batching + routing,
+  recalibrated to TPU latency models.
+
+Reference parity map: see SURVEY.md at the repo root; docstrings throughout
+cite /root/reference file:line for the behavior they match.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Declarative configuration API (CRD-equivalent types)."""
+
+from llm_instance_gateway_tpu.api import v1alpha1
+
+__all__ = ["v1alpha1"]

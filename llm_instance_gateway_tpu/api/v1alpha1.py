@@ -1,0 +1,164 @@
+"""v1alpha1 declarative API: InferencePool and InferenceModel.
+
+Parity: reference ``api/v1alpha1/inferencepool_types.go:26-46`` (Spec with
+``Selector`` and ``TargetPortNumber``) and ``inferencemodel_types.go:40-68``
+(``ModelName``, ``Criticality``, ``TargetModels`` weighted split, ``PoolRef``).
+
+These are plain dataclasses loadable from YAML/JSON documents of the same
+shape as the reference CRDs (group ``inference.tpu.x-k8s.io``), so that the
+reconcilers in ``gateway.controllers`` can consume either Kubernetes watch
+payloads or local config files.  TPU additions: ``slice_topology`` on the pool
+(e.g. ``v5e-8``) and per-model ``adapter_artifact`` (Orbax checkpoint path) so
+the LoRA sidecar can hot-swap adapters without a separate registry.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+GROUP = "inference.tpu.x-k8s.io"
+VERSION = "v1alpha1"
+
+
+class Criticality(str, enum.Enum):
+    """Request criticality tiers (inferencemodel_types.go:86-98)."""
+
+    CRITICAL = "Critical"
+    DEFAULT = "Default"
+    SHEDDABLE = "Sheddable"
+
+
+@dataclass(frozen=True)
+class TargetModel:
+    """Weighted rollout target (inferencemodel_types.go:99-135).
+
+    ``weight`` semantics match the reference's RandomWeightedDraw inputs:
+    relative integer weights, not percentages.
+    """
+
+    name: str
+    weight: int = 1
+    # TPU addition: where the adapter's Orbax checkpoint lives (None for the
+    # base model itself).
+    adapter_artifact: str | None = None
+
+
+@dataclass(frozen=True)
+class PoolRef:
+    name: str
+    kind: str = "InferencePool"
+    group: str = GROUP
+
+
+@dataclass
+class InferenceModelSpec:
+    model_name: str
+    criticality: Criticality = Criticality.DEFAULT
+    target_models: list[TargetModel] = field(default_factory=list)
+    pool_ref: PoolRef | None = None
+
+
+@dataclass
+class InferenceModel:
+    """A logical model (base or LoRA'd) exposed through a pool."""
+
+    name: str
+    namespace: str = "default"
+    spec: InferenceModelSpec = field(default_factory=lambda: InferenceModelSpec(""))
+    resource_version: str = "0"
+
+    @property
+    def model_name(self) -> str:
+        return self.spec.model_name
+
+
+@dataclass
+class InferencePoolSpec:
+    """inferencepool_types.go:26-46: selector + target port; TPU topology added."""
+
+    selector: dict[str, str] = field(default_factory=dict)
+    target_port_number: int = 8000
+    slice_topology: str = "v5e-1"
+
+
+@dataclass
+class InferencePool:
+    name: str
+    namespace: str = "default"
+    spec: InferencePoolSpec = field(default_factory=InferencePoolSpec)
+    resource_version: str = "0"
+
+
+# ---------------------------------------------------------------------------
+# YAML/JSON (de)serialization in CRD document shape.
+# ---------------------------------------------------------------------------
+
+
+def _meta(doc: Mapping[str, Any]) -> tuple[str, str, str]:
+    meta = doc.get("metadata", {})
+    return (
+        meta.get("name", ""),
+        meta.get("namespace", "default"),
+        str(meta.get("resourceVersion", "0")),
+    )
+
+
+def inference_model_from_doc(doc: Mapping[str, Any]) -> InferenceModel:
+    """Parse an InferenceModel document (same shape as the reference CRD)."""
+    name, namespace, rv = _meta(doc)
+    spec = doc.get("spec", {})
+    targets = [
+        TargetModel(
+            name=t["name"],
+            weight=int(t.get("weight", 1)),
+            adapter_artifact=t.get("adapterArtifact"),
+        )
+        for t in spec.get("targetModels", [])
+    ]
+    pool_ref = None
+    if "poolRef" in spec:
+        pr = spec["poolRef"]
+        pool_ref = PoolRef(name=pr["name"], kind=pr.get("kind", "InferencePool"))
+    return InferenceModel(
+        name=name,
+        namespace=namespace,
+        resource_version=rv,
+        spec=InferenceModelSpec(
+            model_name=spec.get("modelName", name),
+            criticality=Criticality(spec.get("criticality", "Default")),
+            target_models=targets,
+            pool_ref=pool_ref,
+        ),
+    )
+
+
+def inference_pool_from_doc(doc: Mapping[str, Any]) -> InferencePool:
+    name, namespace, rv = _meta(doc)
+    spec = doc.get("spec", {})
+    return InferencePool(
+        name=name,
+        namespace=namespace,
+        resource_version=rv,
+        spec=InferencePoolSpec(
+            selector=dict(spec.get("selector", {})),
+            target_port_number=int(spec.get("targetPortNumber", 8000)),
+            slice_topology=spec.get("sliceTopology", "v5e-1"),
+        ),
+    )
+
+
+def from_documents(docs: list[Mapping[str, Any]]):
+    """Split a multi-doc config into (pools, models), dispatching on ``kind``."""
+    pools: list[InferencePool] = []
+    models: list[InferenceModel] = []
+    for doc in docs:
+        if not doc:
+            continue
+        kind = doc.get("kind", "")
+        if kind == "InferencePool":
+            pools.append(inference_pool_from_doc(doc))
+        elif kind == "InferenceModel":
+            models.append(inference_model_from_doc(doc))
+    return pools, models

@@ -1,0 +1,1 @@
+"""Endpoint-picker gateway: metrics plane, scheduler, handlers, transports."""

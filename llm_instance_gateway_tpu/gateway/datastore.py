@@ -1,0 +1,126 @@
+"""In-memory gateway state: pool singleton, model routing table, pod membership.
+
+Parity: reference ``pkg/ext-proc/backend/datastore.go:13-105`` —
+``K8sDatastore`` with an RWMutex'd pool, a sync.Map of InferenceModels keyed by
+ModelName, a sync.Map of Pods, ``RandomWeightedDraw`` for traffic splitting and
+``IsCritical``.  Python port uses a single lock (the GIL makes per-field
+locks unnecessary for our access pattern) and ``random.Random`` seeded per-draw
+like the reference's nanosecond-seeded draw (datastore.go:81-84).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Iterable
+
+from llm_instance_gateway_tpu.api.v1alpha1 import (
+    Criticality,
+    InferenceModel,
+    InferencePool,
+)
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+
+class Datastore:
+    """Thread-safe cache of pool/models/pods consumed by scheduler + handlers."""
+
+    def __init__(self, pods: Iterable[Pod] = ()):  # WithPods test option (:37-44)
+        self._lock = threading.RLock()
+        self._pool: InferencePool | None = None
+        self._models: dict[str, InferenceModel] = {}
+        self._pods: dict[str, Pod] = {p.name: p for p in pods}
+
+    # -- pool (datastore.go:46-68) -----------------------------------------
+    def set_pool(self, pool: InferencePool) -> None:
+        with self._lock:
+            self._pool = pool
+
+    def get_pool(self) -> InferencePool:
+        with self._lock:
+            if self._pool is None:
+                raise LookupError(
+                    "InferencePool not initialized yet"
+                )  # parity: getInferencePool error
+            return self._pool
+
+    def has_synced_pool(self) -> bool:
+        with self._lock:
+            return self._pool is not None
+
+    # -- models (datastore.go:70-76) ---------------------------------------
+    def store_model(self, model: InferenceModel) -> None:
+        with self._lock:
+            self._models[model.spec.model_name] = model
+
+    def delete_model(self, model_name: str) -> None:
+        with self._lock:
+            self._models.pop(model_name, None)
+
+    def fetch_model(self, model_name: str) -> InferenceModel | None:
+        with self._lock:
+            return self._models.get(model_name)
+
+    def all_models(self) -> list[InferenceModel]:
+        with self._lock:
+            return list(self._models.values())
+
+    # -- pods --------------------------------------------------------------
+    def store_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._pods[pod.name] = pod
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            self._pods.pop(name, None)
+
+    def get_pod(self, name: str) -> Pod | None:
+        with self._lock:
+            return self._pods.get(name)
+
+    def all_pods(self) -> list[Pod]:
+        with self._lock:
+            return list(self._pods.values())
+
+    def pod_names(self) -> set[str]:
+        with self._lock:
+            return set(self._pods)
+
+
+def random_weighted_draw(
+    model: InferenceModel, seed: int | None = None
+) -> str:
+    """Pick a target model by relative weight (datastore.go:78-98).
+
+    Returns the chosen target model name, or the logical model name itself when
+    no targets are configured (reference request.go:47-50 falls back to the
+    request model when TargetModels is empty).
+    """
+    targets = model.spec.target_models
+    if not targets:
+        return model.spec.model_name
+    rng = random.Random(seed if seed is not None else time.time_ns())
+    total = sum(t.weight for t in targets)
+    if total <= 0:
+        return targets[0].name  # all-zero weights: deterministic, don't crash
+    point = rng.randint(1, total)
+    acc = 0
+    for t in targets:
+        acc += t.weight
+        if point <= acc:
+            return t.name
+    return targets[-1].name  # unreachable; defensive
+
+
+def is_critical(model: InferenceModel | None) -> bool:
+    """datastore.go:100-105: nil-safe criticality check."""
+    return model is not None and model.spec.criticality is Criticality.CRITICAL
+
+
+def resolve_adapter_artifact(model: InferenceModel, target_name: str) -> str | None:
+    """TPU addition: artifact for the drawn target, for sidecar-free hot-swap."""
+    for t in model.spec.target_models:
+        if t.name == target_name:
+            return t.adapter_artifact
+    return None

@@ -1,0 +1,202 @@
+"""Model-server metrics adapter: scrape + map TPU serving metrics.
+
+Parity: reference ``pkg/ext-proc/backend/vllm/metrics.go`` — scrape
+``http://<pod>/metrics``, parse Prometheus text, map the server's counters
+into ``gateway.types.Metrics``, and derive the active-LoRA set from a labeled
+info gauge, selecting the *latest* series when multiple are exposed
+(metrics.go:135-150).
+
+Where vLLM exports CUDA-side counters (``vllm:gpu_cache_usage_perc``,
+``vllm:num_requests_waiting``), our TPU server (``server/metrics.py``) exports
+the contract below.  The names are the seam between the gateway and any
+TPU model server (JetStream-style) that wants to join a pool:
+
+=====================================  =======================================
+``tpu:prefill_queue_size``             requests awaiting prefill (gauge)
+``tpu:decode_queue_size``              requests awaiting a decode slot (gauge)
+``tpu:num_requests_running``           in-flight requests (gauge)
+``tpu:num_requests_waiting``           total queued (prefill+decode) (gauge)
+``tpu:kv_cache_usage_perc``            paged-KV utilization 0..1 (gauge)
+``tpu:kv_tokens_capacity``             total KV token capacity (gauge)
+``tpu:kv_tokens_free``                 free KV token headroom (gauge)
+``tpu:decode_tokens_per_sec``          recent decode throughput (gauge)
+``tpu:lora_requests_info``             labels ``running_lora_adapters`` (CSV),
+                                       ``max_lora``; gauge value = unix ts of
+                                       the snapshot (latest series wins)
+=====================================  =======================================
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+import urllib.error
+import urllib.request
+
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+from llm_instance_gateway_tpu.utils import prom_parse
+
+# Metric-name contract (metrics.go:19-32 equivalent).
+LORA_INFO_METRIC = "tpu:lora_requests_info"
+LORA_ADAPTERS_LABEL = "running_lora_adapters"
+LORA_MAX_LABEL = "max_lora"
+PREFILL_QUEUE_METRIC = "tpu:prefill_queue_size"
+DECODE_QUEUE_METRIC = "tpu:decode_queue_size"
+RUNNING_METRIC = "tpu:num_requests_running"
+WAITING_METRIC = "tpu:num_requests_waiting"
+KV_USAGE_METRIC = "tpu:kv_cache_usage_perc"
+KV_CAPACITY_METRIC = "tpu:kv_tokens_capacity"
+KV_FREE_METRIC = "tpu:kv_tokens_free"
+DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
+
+
+class FetchError(Exception):
+    pass
+
+
+def families_to_metrics(
+    families: dict[str, list[prom_parse.Sample]], existing: Metrics
+) -> tuple[Metrics, list[str]]:
+    """Map parsed families onto a cloned Metrics (promToPodMetrics, :73-129).
+
+    Missing families leave the existing (stale) values in place and are
+    reported in the returned error list — the reference aggregates per-metric
+    errors with multierr and keeps going (metrics.go:78-128).
+    """
+    updated = existing.clone()
+    errs: list[str] = []
+
+    def latest_value(name: str) -> float | None:
+        s = prom_parse.latest_sample(families.get(name, []))
+        if s is None:
+            errs.append(f"metric family {name!r} not found")
+            return None
+        return s.value
+
+    v = latest_value(RUNNING_METRIC)
+    if v is not None:
+        updated.running_queue_size = int(v)
+    v = latest_value(WAITING_METRIC)
+    if v is not None:
+        updated.waiting_queue_size = int(v)
+    v = latest_value(KV_USAGE_METRIC)
+    if v is not None:
+        updated.kv_cache_usage_percent = float(v)
+
+    # TPU-specific signals are optional for foreign servers: absence is not an
+    # error if the total-queue contract is satisfied.
+    for name, setter in (
+        (PREFILL_QUEUE_METRIC, lambda m, x: setattr(m, "prefill_queue_size", int(x))),
+        (DECODE_QUEUE_METRIC, lambda m, x: setattr(m, "decode_queue_size", int(x))),
+        (KV_CAPACITY_METRIC, lambda m, x: setattr(m, "kv_tokens_capacity", int(x))),
+        (KV_FREE_METRIC, lambda m, x: setattr(m, "kv_tokens_free", int(x))),
+        (DECODE_TPS_METRIC, lambda m, x: setattr(m, "decode_tokens_per_sec", float(x))),
+    ):
+        s = prom_parse.latest_sample(families.get(name, []))
+        if s is not None:
+            setter(updated, s.value)
+
+    # LoRA info: latest series by gauge-value timestamp (metrics.go:135-150 —
+    # the reference compares the *gauge value*, which vLLM sets to a unix ts).
+    lora_samples = families.get(LORA_INFO_METRIC, [])
+    if lora_samples:
+        best = max(lora_samples, key=lambda s: s.value)
+        adapters: dict[str, int] = {}
+        csv = best.labels.get(LORA_ADAPTERS_LABEL, "")
+        for name in csv.split(","):
+            name = name.strip()
+            if name:
+                adapters[name] = 0
+        updated.active_adapters = adapters
+        try:
+            updated.max_active_adapters = int(float(best.labels.get(LORA_MAX_LABEL, "0")))
+        except ValueError:
+            errs.append(f"invalid {LORA_MAX_LABEL} label: {best.labels}")
+    return updated, errs
+
+
+class PodMetricsClient:
+    """HTTP scraper (FetchMetrics, metrics.go:38-68)."""
+
+    def __init__(self, timeout_s: float = 5.0, scheme: str = "http"):
+        self.timeout_s = timeout_s
+        self.scheme = scheme
+
+    def fetch_metrics(self, pod: Pod, existing: Metrics) -> Metrics:
+        url = f"{self.scheme}://{pod.address}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                if resp.status != 200:
+                    raise FetchError(
+                        f"unexpected status code from {pod}: {resp.status}"
+                    )
+                body = resp.read().decode("utf-8", errors="replace")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise FetchError(f"failed to fetch metrics from {pod}: {e}") from e
+        families = prom_parse.parse_text(body)
+        updated, _errs = families_to_metrics(families, existing)
+        return updated
+
+
+class FakePodMetricsClient:
+    """Test fake (backend/fake.go:10-21): per-pod canned results or errors."""
+
+    def __init__(
+        self,
+        res: dict[str, Metrics] | None = None,
+        err: dict[str, Exception] | None = None,
+    ):
+        self.res = res or {}
+        self.err = err or {}
+
+    def fetch_metrics(self, pod: Pod, existing: Metrics) -> Metrics:
+        if pod.name in self.err:
+            raise self.err[pod.name]
+        if pod.name in self.res:
+            return self.res[pod.name].clone()
+        return existing.clone()
+
+
+def fetch_all(
+    client,
+    pods: list[PodMetrics],
+    timeout_s: float = 5.0,
+    executor: futures.ThreadPoolExecutor | None = None,
+) -> tuple[dict[str, Metrics], list[str]]:
+    """Parallel per-pod fetch fan-out (provider.go:145-162).
+
+    Pass a persistent ``executor`` (the Provider owns one) — creating and
+    context-managing a pool per call would both churn threads at the 50 ms
+    refresh cadence and, worse, block past ``timeout_s`` in
+    ``shutdown(wait=True)`` while a slow endpoint drips bytes.  With a shared
+    pool, stragglers keep a worker busy past the deadline but never block the
+    refresh loop; the bounded pool size caps the damage from a wedged pod.
+    """
+    results: dict[str, Metrics] = {}
+    errs: list[str] = []
+    if not pods:
+        return results, errs
+    ex = executor or _default_executor()
+    futs = {ex.submit(client.fetch_metrics, pm.pod, pm.metrics): pm.pod for pm in pods}
+    done, not_done = futures.wait(futs, timeout=timeout_s)
+    for fut in done:
+        pod = futs[fut]
+        try:
+            results[pod.name] = fut.result()
+        except Exception as e:  # non-fatal: stale metrics persist
+            errs.append(str(e))
+    for fut in not_done:
+        fut.cancel()  # cancels queued fetches; running ones finish in background
+        errs.append(f"timeout fetching metrics from {futs[fut]}")
+    return results, errs
+
+
+_SHARED_EXECUTOR: futures.ThreadPoolExecutor | None = None
+
+
+def _default_executor() -> futures.ThreadPoolExecutor:
+    global _SHARED_EXECUTOR
+    if _SHARED_EXECUTOR is None:
+        _SHARED_EXECUTOR = futures.ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="metrics-fetch"
+        )
+    return _SHARED_EXECUTOR

@@ -1,0 +1,126 @@
+"""Metrics provider: the gateway's live state plane.
+
+Parity: reference ``pkg/ext-proc/backend/provider.go`` — a concurrent map of
+``PodMetrics`` refreshed by two loops: pod membership from the datastore
+(default every 10 s) and metrics scrapes (default every 50 ms, 5 s fetch
+timeout, parallel per-pod fan-out, errors aggregated and non-fatal so stale
+metrics persist; provider.go:60-179).  A debug dump loop logs all metrics at
+debug verbosity every 5 s (provider.go:91-98).
+
+The scheduler reads ``all_pod_metrics()`` — an O(pods) snapshot with no I/O on
+the request path (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.metrics_client import fetch_all
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+logger = logging.getLogger(__name__)
+
+FETCH_METRICS_TIMEOUT_S = 5.0  # provider.go:14
+
+
+class Provider:
+    def __init__(self, metrics_client, datastore: Datastore):
+        self._client = metrics_client
+        self._datastore = datastore
+        self._metrics: dict[str, PodMetrics] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- snapshot accessors (provider.go:34-58) ----------------------------
+    def all_pod_metrics(self) -> list[PodMetrics]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def get_pod_metrics(self, pod_name: str) -> PodMetrics | None:
+        with self._lock:
+            return self._metrics.get(pod_name)
+
+    def update_pod_metrics(self, pod: Pod, metrics: Metrics) -> None:
+        with self._lock:
+            self._metrics[pod.name] = PodMetrics(pod=pod, metrics=metrics)
+
+    # -- lifecycle (provider.go:60-101) ------------------------------------
+    def init(
+        self,
+        refresh_pods_interval_s: float = 10.0,
+        refresh_metrics_interval_s: float = 0.05,
+        debug_dump_interval_s: float = 5.0,
+    ) -> None:
+        """Synchronous first refresh, then background refresh loops."""
+        self.refresh_pods_once()
+        self.refresh_metrics_once()
+
+        def loop(interval: float, fn) -> None:
+            while not self._stop.wait(interval):
+                try:
+                    fn()
+                except Exception:
+                    logger.exception("refresh loop error")
+
+        for interval, fn in (
+            (refresh_pods_interval_s, self.refresh_pods_once),
+            (refresh_metrics_interval_s, self.refresh_metrics_once),
+            (debug_dump_interval_s, self._debug_dump),
+        ):
+            t = threading.Thread(target=loop, args=(interval, fn), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- refresh bodies ----------------------------------------------------
+    def refresh_pods_once(self) -> None:
+        """Merge datastore pod membership into the metrics map (provider.go:105-132).
+
+        New pods get zeroed metrics (scraped next tick); removed pods drop out.
+        """
+        want = {p.name: p for p in self._datastore.all_pods()}
+        with self._lock:
+            for name, pod in want.items():
+                if name not in self._metrics:
+                    self._metrics[name] = PodMetrics(pod=pod, metrics=Metrics())
+                elif self._metrics[name].pod != pod:
+                    self._metrics[name] = PodMetrics(
+                        pod=pod, metrics=self._metrics[name].metrics
+                    )
+            for name in list(self._metrics):
+                if name not in want:
+                    del self._metrics[name]
+
+    def refresh_metrics_once(self) -> list[str]:
+        """Parallel scrape of every pod (provider.go:134-179); returns errors."""
+        snapshot = self.all_pod_metrics()
+        results, errs = fetch_all(
+            self._client, snapshot, timeout_s=FETCH_METRICS_TIMEOUT_S
+        )
+        with self._lock:
+            for pm in snapshot:
+                updated = results.get(pm.pod.name)
+                if updated is not None and pm.pod.name in self._metrics:
+                    self._metrics[pm.pod.name] = PodMetrics(pod=pm.pod, metrics=updated)
+        if errs:
+            logger.debug("metrics refresh errors: %s", "; ".join(errs))
+        return errs
+
+    def _debug_dump(self) -> None:
+        logger.debug("===DEBUG: current pods and metrics: %s", self.all_pod_metrics())
+
+
+class StaticProvider:
+    """Provider over a fixed metrics list — for tests and the simulator."""
+
+    def __init__(self, pod_metrics: list[PodMetrics]):
+        self._pm = pod_metrics
+
+    def all_pod_metrics(self) -> list[PodMetrics]:
+        return list(self._pm)

@@ -1,0 +1,9 @@
+"""Request scheduling: filter decision tree + scheduler policies."""
+
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    SchedulingError,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+
+__all__ = ["Scheduler", "SchedulingError", "LLMRequest"]

@@ -1,0 +1,36 @@
+"""Scheduler thresholds as runtime configuration.
+
+The reference hard-codes these with an explicit TODO to move them into the
+InferencePool config (``pkg/ext-proc/scheduling/scheduler.go:16-24``):
+``kvCacheThreshold=0.8``, ``queueThresholdCritical=5``,
+``queueingThresholdLoRA=50``.  We resolve that TODO: thresholds live in a
+dataclass, defaulted to the reference's experimentally-derived values, and can
+be overridden per-pool (see ``gateway.controllers.pool``) or retuned with the
+simulator (``sim/``) before burning TPU hours.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    # Max KV-cache utilization for a pod to accept a sheddable request.
+    kv_cache_threshold: float = 0.8
+    # Max total queue depth for a pod to accept a sheddable request.
+    queue_threshold_critical: int = 5
+    # Queue depth above which LoRA affinity stops being worth the wait and the
+    # scheduler falls through to least-queuing (scheduler.go:40-57).
+    queueing_threshold_lora: int = 50
+    # TPU additions -------------------------------------------------------
+    # Prefer pods whose free KV tokens cover the prompt (token-aware routing
+    # for long context); only applied when the request carries a token hint.
+    token_headroom_factor: float = 1.0
+    # Prefill queue depth above which a replica is considered prefill-saturated
+    # (prefill/decode disaggregation: scheduler must not send long prompts to a
+    # replica with a deep prefill backlog even if decode is idle).
+    prefill_queue_threshold: int = 8
+
+
+DEFAULT_CONFIG = SchedulerConfig()

@@ -1,0 +1,200 @@
+"""Filter decision-tree framework and the filter/predicate library.
+
+Parity: reference ``pkg/ext-proc/scheduling/filter.go``:
+
+- ``Filter`` node with ``next_on_success`` / ``next_on_failure`` /
+  ``next_on_success_or_failure`` routing (filter.go:44-73): on success the
+  *filtered* set flows down; on failure the *original* set flows to the
+  failure branch (so a failed refinement falls back rather than dead-ends).
+- ``to_filter_func`` lifts a per-pod predicate into a set filter that fails on
+  an empty result (filter.go:79-93).
+- The filter functions: least-queuing with first-range bucketing
+  (filter.go:102-122), least-KV-cache (:134-154), low-queueing predicate
+  (:124-126), low-LoRA-cost (:163-166), LoRA-affinity (:169-172),
+  can-accept-new-LoRA (:175-177), critical-request (:179-181), and the
+  sheddable-admission predicate (:183-187).
+
+TPU-native additions: prefill-queue filters for the disaggregated
+prefill/decode pipeline and a KV-token-headroom predicate for long-context
+token-aware routing.  All pure functions over ``PodMetrics`` snapshots — the
+hot path never touches I/O (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from llm_instance_gateway_tpu.gateway.scheduling.config import (
+    DEFAULT_CONFIG,
+    SchedulerConfig,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+FilterFunc = Callable[[LLMRequest, Sequence[PodMetrics]], list[PodMetrics]]
+Predicate = Callable[[LLMRequest, PodMetrics], bool]
+
+
+class FilterError(Exception):
+    """Raised when a filter yields no pods and there is no failure branch.
+
+    ``shed=True`` marks the deliberate load-shedding drop (the tree's "drop
+    request" leaf) as opposed to an unexpected empty result.
+    """
+
+    def __init__(self, msg: str, shed: bool = False):
+        super().__init__(msg)
+        self.shed = shed
+
+
+@dataclass
+class Filter:
+    """A node in the scheduling decision tree (filter.go:30-73)."""
+
+    name: str
+    func: FilterFunc
+    next_on_success: Optional["Filter"] = None
+    next_on_failure: Optional["Filter"] = None
+    next_on_success_or_failure: Optional["Filter"] = None
+
+    def filter(self, req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
+        try:
+            filtered = self.func(req, pods)
+            err = None
+        except FilterError as e:
+            filtered, err = [], e
+
+        success = err is None and len(filtered) > 0
+        if success:
+            nxt = self.next_on_success or self.next_on_success_or_failure
+            if nxt is None:
+                return filtered
+            return nxt.filter(req, filtered)  # pass refined set down
+        nxt = self.next_on_failure or self.next_on_success_or_failure
+        if nxt is None:
+            if err is not None:
+                raise err  # leaf failure: propagate the causing error
+            raise FilterError(f"no pods available for filter {self.name}")
+        return nxt.filter(req, list(pods))  # pass ORIGINAL set to fallback
+
+
+def to_filter_func(predicate: Predicate, name: str = "") -> FilterFunc:
+    """Lift a per-pod predicate into a set filter (filter.go:79-93)."""
+
+    def f(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
+        kept = [pm for pm in pods if predicate(req, pm)]
+        if not kept:
+            raise FilterError(f"no pods passed predicate {name or predicate}")
+        return kept
+
+    f.__name__ = name or getattr(predicate, "__name__", "predicate")
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Range-bucketing filters (min..min+(max-min)/divisor], reference style.
+# ---------------------------------------------------------------------------
+
+
+def least_queuing_filter(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
+    """Keep pods in the first 1/len(pods) range of queue depth (filter.go:102-122).
+
+    The reference deliberately buckets instead of strict-min picking: pods with
+    "relatively low" queueing all survive so the next filter can discriminate,
+    and the final random pick spreads load among near-ties.  Queue depths are
+    ints, so the range division is integer division, exactly as in Go
+    (``min+(max-min)/len(pods)``, filter.go:117).
+    """
+    if not pods:
+        raise FilterError("no pods to filter")
+    depths = [pm.metrics.total_queue_size for pm in pods]
+    lo, hi = min(depths), max(depths)
+    cut = lo + (hi - lo) // len(pods)
+    return [pm for pm, d in zip(pods, depths) if d <= cut]
+
+
+def least_kv_cache_filter(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
+    """First 1/len(pods) range of KV-cache utilization (filter.go:134-154)."""
+    if not pods:
+        raise FilterError("no pods to filter")
+    usage = [pm.metrics.kv_cache_usage_percent for pm in pods]
+    lo, hi = min(usage), max(usage)
+    cut = lo + (hi - lo) / len(pods)
+    return [pm for pm, u in zip(pods, usage) if u <= cut]
+
+
+def least_prefill_queue_filter(
+    req: LLMRequest, pods: Sequence[PodMetrics]
+) -> list[PodMetrics]:
+    """TPU addition: first half-range of prefill queue depth.
+
+    With prefill/decode disaggregation a new request's TTFT is gated by the
+    prefill queue specifically; decode backlog matters only for TPOT.
+    """
+    if not pods:
+        raise FilterError("no pods to filter")
+    depths = [pm.metrics.prefill_queue_size for pm in pods]
+    lo, hi = min(depths), max(depths)
+    cut = lo + (hi - lo) // len(pods)
+    return [pm for pm, d in zip(pods, depths) if d <= cut]
+
+
+# ---------------------------------------------------------------------------
+# Predicates (config-parameterized where the reference hard-coded).
+# ---------------------------------------------------------------------------
+
+
+def make_predicates(cfg: SchedulerConfig = DEFAULT_CONFIG) -> dict[str, Predicate]:
+    def low_queueing(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:124-126 — queue below the LoRA-affinity-worthwhile bound.
+        return pm.metrics.total_queue_size < cfg.queueing_threshold_lora
+
+    def lora_affinity(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:169-172 — adapter already resident on the replica.
+        return req.resolved_target_model in pm.metrics.active_adapters
+
+    def can_accept_new_lora(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:175-177 — replica has a free adapter slot.
+        return len(pm.metrics.active_adapters) < pm.metrics.max_active_adapters
+
+    def low_lora_cost(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:163-166 — affinity OR free slot: loading is cheap either way.
+        return (
+            req.resolved_target_model in pm.metrics.active_adapters
+            or len(pm.metrics.active_adapters) < pm.metrics.max_active_adapters
+        )
+
+    def critical_request(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:179-181 — pod-independent branch switch.
+        return req.critical
+
+    def sheddable_admission(req: LLMRequest, pm: PodMetrics) -> bool:
+        # filter.go:183-187 — noQueueAndLessThanKVCacheThresholdPredicate.
+        return (
+            pm.metrics.total_queue_size <= cfg.queue_threshold_critical
+            and pm.metrics.kv_cache_usage_percent <= cfg.kv_cache_threshold
+        )
+
+    def token_headroom(req: LLMRequest, pm: PodMetrics) -> bool:
+        # TPU addition: free KV tokens cover the (hinted) prompt.  Requests
+        # without a hint pass trivially so the filter is a no-op for them.
+        if req.prompt_tokens <= 0 or pm.metrics.kv_tokens_capacity <= 0:
+            return True
+        need = int(req.prompt_tokens * cfg.token_headroom_factor)
+        return pm.metrics.kv_tokens_free >= need
+
+    def prefill_not_saturated(req: LLMRequest, pm: PodMetrics) -> bool:
+        # TPU addition: avoid replicas with a deep prefill backlog.
+        return pm.metrics.prefill_queue_size < cfg.prefill_queue_threshold
+
+    return {
+        "low_queueing": low_queueing,
+        "lora_affinity": lora_affinity,
+        "can_accept_new_lora": can_accept_new_lora,
+        "low_lora_cost": low_lora_cost,
+        "critical_request": critical_request,
+        "sheddable_admission": sheddable_admission,
+        "token_headroom": token_headroom,
+        "prefill_not_saturated": prefill_not_saturated,
+    }

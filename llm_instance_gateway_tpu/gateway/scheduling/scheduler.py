@@ -1,0 +1,194 @@
+"""Scheduler: builds the decision trees and picks a target replica.
+
+Parity: reference ``pkg/ext-proc/scheduling/scheduler.go:26-122``:
+
+- ``default`` tree: critical? -> low-latency path, else sheddable path which
+  drops with RESOURCE_EXHAUSTED when no replica has capacity
+  (scheduler.go:74-90 -> 429 at the transport layer).
+- low-latency path: queue < threshold -> LoRA affinity -> can-accept-new-LoRA,
+  falling back to least-queuing -> low-LoRA-cost -> least-KV-cache
+  (scheduler.go:34-72).
+- Final choice: uniform random among survivors (scheduler.go:120) to spread
+  near-ties.
+
+TPU-native extensions (both ON by default — this framework routes TPU
+disaggregated-continuous-batching replicas; pass ``False`` for strict
+reference parity, as the parity tests do):
+
+- ``token_aware=True`` inserts the KV-token-headroom predicate ahead of the
+  queue filters so long-context requests only land where the prompt fits.
+- ``prefill_aware=True`` routes on the prefill queue (TTFT-gating signal under
+  prefill/decode disaggregation) before total queue depth.
+
+When an optional native library is present (``native/libligsched.so``), the
+flat hot loop (bucketing filters over large pools) runs in C++; the decision
+tree and semantics stay identical (see ``native.py``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Protocol, Sequence
+
+from llm_instance_gateway_tpu.gateway.scheduling.config import (
+    DEFAULT_CONFIG,
+    SchedulerConfig,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.filter import (
+    Filter,
+    FilterError,
+    least_kv_cache_filter,
+    least_prefill_queue_filter,
+    least_queuing_filter,
+    make_predicates,
+    to_filter_func,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+
+
+class SchedulingError(Exception):
+    """Raised when no pod can serve the request.
+
+    ``shed`` marks the load-shedding drop (reference maps it to gRPC
+    RESOURCE_EXHAUSTED -> HTTP 429, server.go:95-113).
+    """
+
+    def __init__(self, msg: str, shed: bool = False):
+        super().__init__(msg)
+        self.shed = shed
+
+
+class PodMetricsProvider(Protocol):
+    """scheduler.go:108-110."""
+
+    def all_pod_metrics(self) -> list[PodMetrics]: ...
+
+
+def _drop_filter() -> Filter:
+    def drop(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
+        raise FilterError(
+            "dropping request due to limited backend resources", shed=True
+        )
+
+    return Filter(name="drop request", func=drop)
+
+
+def build_default_tree(
+    cfg: SchedulerConfig = DEFAULT_CONFIG,
+    token_aware: bool = False,
+    prefill_aware: bool = False,
+) -> Filter:
+    """Construct the reference decision tree (scheduler.go:26-91)."""
+    preds = make_predicates(cfg)
+
+    def queue_filter(tail: Filter | None) -> Filter:
+        """Queue-depth stage ending in ``tail``.
+
+        With ``prefill_aware`` the stage is prefill-queue bucketing followed by
+        total-queue bucketing; the tail is attached to the *last* node so later
+        wiring can't clobber the inner chain.
+        """
+        least_queue = Filter(
+            name="least queuing",
+            func=least_queuing_filter,
+            next_on_success_or_failure=tail,
+        )
+        if prefill_aware:
+            return Filter(
+                name="least prefill queuing",
+                func=least_prefill_queue_filter,
+                next_on_success_or_failure=least_queue,
+            )
+        return least_queue
+
+    def with_token_headroom(inner: Filter) -> Filter:
+        if not token_aware:
+            return inner
+        return Filter(
+            name="token headroom",
+            func=to_filter_func(preds["token_headroom"], "token_headroom"),
+            next_on_success=inner,
+            next_on_failure=inner,  # headroom is advisory: fall back, don't fail
+        )
+
+    # queueLoRAAndKVCacheFilter (scheduler.go:35-46)
+    queue_lora_kv = queue_filter(
+        Filter(
+            name="low cost LoRA",
+            func=to_filter_func(preds["low_lora_cost"], "low_lora_cost"),
+            next_on_success_or_failure=Filter(
+                name="least KV cache percent", func=least_kv_cache_filter
+            ),
+        )
+    )
+
+    # queueAndKVCacheFilter (scheduler.go:49-56)
+    queue_kv = queue_filter(
+        Filter(name="least KV cache percent", func=least_kv_cache_filter)
+    )
+
+    # lowLatencyFilter (scheduler.go:58-72)
+    low_latency = Filter(
+        name="low queueing filter",
+        func=to_filter_func(preds["low_queueing"], "low_queueing"),
+        next_on_success=Filter(
+            name="affinity LoRA",
+            func=to_filter_func(preds["lora_affinity"], "lora_affinity"),
+            next_on_success=queue_kv,
+            next_on_failure=Filter(
+                name="can accept LoRA Adapter",
+                func=to_filter_func(preds["can_accept_new_lora"], "can_accept_new_lora"),
+                next_on_success_or_failure=queue_kv,
+            ),
+        ),
+        next_on_failure=queue_lora_kv,
+    )
+
+    # sheddableRequestFilter (scheduler.go:74-90)
+    sheddable = Filter(
+        name="has capacity for sheddable requests",
+        func=to_filter_func(preds["sheddable_admission"], "sheddable_admission"),
+        next_on_success=queue_lora_kv,
+        next_on_failure=_drop_filter(),
+    )
+
+    # defaultFilter (scheduler.go:27-32)
+    return Filter(
+        name="critical request",
+        func=to_filter_func(preds["critical_request"], "critical_request"),
+        next_on_success=with_token_headroom(low_latency),
+        next_on_failure=with_token_headroom(sheddable),
+    )
+
+
+class Scheduler:
+    """scheduler.go:93-122, with configurable thresholds and TPU options."""
+
+    def __init__(
+        self,
+        pod_metrics_provider: PodMetricsProvider,
+        cfg: SchedulerConfig = DEFAULT_CONFIG,
+        token_aware: bool = True,
+        prefill_aware: bool = True,
+        rng: random.Random | None = None,
+        tree: Filter | None = None,
+    ):
+        self._provider = pod_metrics_provider
+        self.cfg = cfg
+        self._tree = tree or build_default_tree(
+            cfg, token_aware=token_aware, prefill_aware=prefill_aware
+        )
+        self._rng = rng or random.Random()
+
+    def schedule(self, req: LLMRequest) -> Pod:
+        pods = self._provider.all_pod_metrics()
+        try:
+            survivors = self._tree.filter(req, pods)
+        except FilterError as e:
+            raise SchedulingError(
+                f"failed to apply filter, resulted 0 pods: {e}", shed=e.shed
+            ) from e
+        if not survivors:
+            raise SchedulingError("failed to apply filter, resulted 0 pods")
+        return survivors[self._rng.randrange(len(survivors))].pod

@@ -1,0 +1,23 @@
+"""Scheduling request type.
+
+Parity: reference ``pkg/ext-proc/scheduling/types.go:4-11`` (``LLMRequest``)
+plus a token-count hint used by TPU-side token-aware routing (long-context
+requests must land on replicas with enough KV-token headroom, SURVEY.md §5
+"long-context").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LLMRequest:
+    model: str
+    target_models: dict[str, int] = field(default_factory=dict)
+    resolved_target_model: str = ""
+    critical: bool = False
+    # TPU addition: estimated prompt tokens (0 = unknown).  Enables the
+    # kv-token-headroom predicate; requests without the hint fall back to the
+    # reference's percent-based signal.
+    prompt_tokens: int = 0

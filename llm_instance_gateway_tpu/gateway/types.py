@@ -1,0 +1,89 @@
+"""Core gateway data types: pods (TPU slice replicas) and their live metrics.
+
+Parity: reference ``pkg/ext-proc/backend/types.go:8-53`` defines
+``Pod{Name,Address}`` and ``Metrics{ActiveModels, RunningQueueSize,
+WaitingQueueSize, KVCacheUsagePercent, ...}``.  The TPU-native schema differs
+deliberately:
+
+- The unit of placement is a **slice-backed replica** (a JetStream-style server
+  owning one TPU slice), not a single-GPU pod (SURVEY.md §2.5).
+- Queue depth is split into **prefill** and **decode** queues because TPU
+  continuous batching disaggregates the two phases; the scheduler must route on
+  the right one (SURVEY.md §7 "hard parts").
+- KV headroom is token-denominated (``kv_tokens_free`` /
+  ``kv_tokens_capacity``) in addition to the percent signal, enabling
+  token-aware long-context routing (reference stubs this at
+  ``backend/types.go:25`` but never uses it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Pod:
+    """A routable model-server replica (one TPU-slice-backed server process).
+
+    ``address`` is ``host:port`` of the replica's serving endpoint.  For a
+    multi-host slice this is the slice leader (SURVEY.md §7: "the pod is
+    actually the slice's leader host").
+    """
+
+    name: str
+    address: str
+
+    def __str__(self) -> str:  # parity: types.go Pod.String()
+        return f"{self.name}({self.address})"
+
+
+@dataclass
+class Metrics:
+    """Live scheduling signals scraped from one replica.
+
+    Parity with ``backend/types.go:17-31`` plus the TPU prefill/decode split.
+    ``active_adapters`` maps adapter id -> number of in-flight requests using
+    it (reference: ``ActiveModels map[string]int``).
+    """
+
+    active_adapters: dict[str, int] = field(default_factory=dict)
+    max_active_adapters: int = 0
+    # Queue depths.  ``waiting_queue_size`` mirrors the reference's vLLM
+    # num_requests_waiting; on TPU it is prefill_queue + decode_waiting.
+    running_queue_size: int = 0
+    waiting_queue_size: int = 0
+    prefill_queue_size: int = 0
+    decode_queue_size: int = 0
+    # KV / HBM headroom.
+    kv_cache_usage_percent: float = 0.0
+    kv_tokens_capacity: int = 0
+    kv_tokens_free: int = 0
+    # Serving rates (optional, for latency-aware policies and the simulator).
+    decode_tokens_per_sec: float = 0.0
+
+    def clone(self) -> "Metrics":
+        m = dataclasses.replace(self)
+        m.active_adapters = dict(self.active_adapters)
+        return m
+
+    @property
+    def total_queue_size(self) -> int:
+        """Combined pending work; used where the reference used WaitingQueueSize."""
+        if self.waiting_queue_size:
+            return self.waiting_queue_size
+        return self.prefill_queue_size + self.decode_queue_size
+
+
+@dataclass
+class PodMetrics:
+    """A pod together with its latest metrics snapshot (types.go:33-53)."""
+
+    pod: Pod
+    metrics: Metrics
+
+    def clone(self) -> "PodMetrics":
+        return PodMetrics(pod=self.pod, metrics=self.metrics.clone())
+
+    def __str__(self) -> str:
+        return f"Pod: {self.pod}; Metrics: {self.metrics}"

@@ -1,0 +1,1 @@
+"""Shared utilities: Prometheus text parsing, logging helpers."""

@@ -1,0 +1,112 @@
+"""Minimal Prometheus text-exposition-format parser.
+
+The reference uses ``expfmt.TextParser.TextToMetricFamilies``
+(``pkg/ext-proc/backend/vllm/metrics.go:62-67``) to parse model-server
+/metrics scrapes.  This is the Python equivalent: a small, dependency-free
+parser producing ``{family_name: [Sample]}`` where each sample carries labels,
+value, and optional timestamp (timestamps are how the reference selects the
+*latest* LoRA info series, metrics.go:135-150).
+
+Only the subset of the format the gateway consumes is supported: counters and
+gauges with optional labels and timestamps; HELP/TYPE comments are skipped;
+histogram/summary series parse as plain samples of their component families.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Sample:
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    value: float = 0.0
+    timestamp_ms: int | None = None
+
+
+def _parse_labels(s: str) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(s)
+    while i < n:
+        # key
+        j = s.find("=", i)
+        if j < 0:
+            break
+        key = s[i:j].strip().strip(",").strip()
+        # value: quoted string with escapes
+        k = s.find('"', j)
+        if k < 0:
+            break
+        out = []
+        k += 1
+        while k < n:
+            c = s[k]
+            if c == "\\" and k + 1 < n:
+                nxt = s[k + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                k += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            k += 1
+        labels[key] = "".join(out)
+        i = k + 1
+    return labels
+
+
+def parse_text(text: str) -> dict[str, list[Sample]]:
+    """Parse exposition text into families keyed by metric name."""
+    families: dict[str, list[Sample]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        # name{labels} value [timestamp]
+        labels: dict[str, str] = {}
+        if "{" in line:
+            brace = line.index("{")
+            end = line.rfind("}")
+            if end < brace:
+                continue  # malformed line: unbalanced braces — skip, don't raise
+            name = line[:brace].strip()
+            labels = _parse_labels(line[brace + 1 : end])
+            rest = line[end + 1 :].split()
+        else:
+            parts = line.split()
+            name, rest = parts[0], parts[1:]
+        if not rest:
+            continue
+        try:
+            value = float(rest[0])
+        except ValueError:
+            continue
+        ts = None
+        if len(rest) > 1:
+            try:
+                ts = int(float(rest[1]))
+            except ValueError:
+                ts = None
+        families.setdefault(name, []).append(
+            Sample(name=name, labels=labels, value=value, timestamp_ms=ts)
+        )
+    return families
+
+
+def latest_sample(samples: list[Sample]) -> Sample | None:
+    """Latest-by-timestamp selection (metrics.go:135-150 getLatestLoraMetric).
+
+    Samples without timestamps compare as oldest; with no timestamps at all the
+    last sample in exposition order wins.
+    """
+    if not samples:
+        return None
+    best = samples[0]
+    for s in samples[1:]:
+        bt = best.timestamp_ms if best.timestamp_ms is not None else -1
+        st = s.timestamp_ms if s.timestamp_ms is not None else -1
+        if st >= bt:
+            best = s
+    return best

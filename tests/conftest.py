@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Multi-chip sharding tests run on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``), per the reference's
+"multi-node-without-a-cluster" test strategy (SURVEY.md §4): fake the fleet,
+test the real algorithms.  Must run before the first ``import jax``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

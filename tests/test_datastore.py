@@ -1,0 +1,122 @@
+"""Datastore tests (reference ``backend/datastore_test.go:9-90``)."""
+
+import pytest
+
+from llm_instance_gateway_tpu.api.v1alpha1 import (
+    Criticality,
+    InferenceModel,
+    InferenceModelSpec,
+    InferencePool,
+    TargetModel,
+    from_documents,
+)
+from llm_instance_gateway_tpu.gateway.datastore import (
+    Datastore,
+    is_critical,
+    random_weighted_draw,
+)
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+
+def model(name, criticality=Criticality.DEFAULT, targets=()):
+    return InferenceModel(
+        name=name,
+        spec=InferenceModelSpec(
+            model_name=name,
+            criticality=criticality,
+            target_models=list(targets),
+        ),
+    )
+
+
+class TestRandomWeightedDraw:
+    # datastore_test.go: fixed-seed draws over weight distributions.
+    def test_draw_distribution(self):
+        m = model(
+            "m",
+            targets=[
+                TargetModel("canary", weight=10),
+                TargetModel("stable", weight=90),
+            ],
+        )
+        counts = {"canary": 0, "stable": 0}
+        for seed in range(2000):
+            counts[random_weighted_draw(m, seed=seed)] += 1
+        frac = counts["canary"] / 2000
+        assert 0.05 < frac < 0.16  # ~10% ± noise
+
+    def test_draw_single_target(self):
+        m = model("m", targets=[TargetModel("only", weight=1)])
+        assert random_weighted_draw(m, seed=42) == "only"
+
+    def test_draw_no_targets_falls_back_to_model_name(self):
+        # request.go:47-50 behavior.
+        assert random_weighted_draw(model("base"), seed=1) == "base"
+
+    def test_draw_deterministic_with_seed(self):
+        m = model("m", targets=[TargetModel("a", 1), TargetModel("b", 1)])
+        assert random_weighted_draw(m, seed=7) == random_weighted_draw(m, seed=7)
+
+
+class TestCriticality:
+    def test_is_critical(self):
+        assert is_critical(model("m", Criticality.CRITICAL))
+        assert not is_critical(model("m", Criticality.DEFAULT))
+        assert not is_critical(model("m", Criticality.SHEDDABLE))
+        assert not is_critical(None)  # nil-safe (datastore.go:100-105)
+
+
+class TestDatastore:
+    def test_pool_unset_raises(self):
+        with pytest.raises(LookupError):
+            Datastore().get_pool()
+
+    def test_pool_roundtrip(self):
+        ds = Datastore()
+        ds.set_pool(InferencePool(name="pool-a"))
+        assert ds.get_pool().name == "pool-a"
+        assert ds.has_synced_pool()
+
+    def test_model_store_fetch_delete(self):
+        ds = Datastore()
+        ds.store_model(model("sql-lora"))
+        assert ds.fetch_model("sql-lora").name == "sql-lora"
+        ds.delete_model("sql-lora")
+        assert ds.fetch_model("sql-lora") is None
+
+    def test_pods_with_init_option(self):
+        # WithPods test option (datastore.go:37-44).
+        ds = Datastore(pods=[Pod("p1", "1.2.3.4:8000")])
+        assert ds.pod_names() == {"p1"}
+        ds.store_pod(Pod("p2", "1.2.3.5:8000"))
+        ds.delete_pod("p1")
+        assert ds.pod_names() == {"p2"}
+
+
+class TestAPIDocs:
+    def test_from_documents_dispatch(self):
+        docs = [
+            {
+                "kind": "InferencePool",
+                "metadata": {"name": "pool"},
+                "spec": {"selector": {"app": "srv"}, "targetPortNumber": 9000},
+            },
+            {
+                "kind": "InferenceModel",
+                "metadata": {"name": "sql-lora"},
+                "spec": {
+                    "modelName": "sql-lora",
+                    "criticality": "Critical",
+                    "poolRef": {"name": "pool"},
+                    "targetModels": [
+                        {"name": "sql-lora-v1", "weight": 100, "adapterArtifact": "/ckpt/sql"}
+                    ],
+                },
+            },
+        ]
+        pools, models = from_documents(docs)
+        assert pools[0].spec.target_port_number == 9000
+        m = models[0]
+        assert m.spec.criticality is Criticality.CRITICAL
+        assert m.spec.pool_ref.name == "pool"
+        assert m.spec.target_models[0].adapter_artifact == "/ckpt/sql"

@@ -1,0 +1,199 @@
+"""Scheduler filter-tree tests.
+
+Table-driven port of the reference spec
+(``pkg/ext-proc/scheduling/filter_test.go:12-409``): the default tree on
+critical/sheddable requests, the bucketing filters, the admission predicate,
+and low-LoRA-cost — plus tests for the TPU extensions (token headroom,
+prefill-aware routing).
+"""
+
+import pytest
+
+from llm_instance_gateway_tpu.gateway.scheduling.config import SchedulerConfig
+from llm_instance_gateway_tpu.gateway.scheduling.filter import (
+    Filter,
+    FilterError,
+    least_kv_cache_filter,
+    least_queuing_filter,
+    make_predicates,
+    to_filter_func,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    SchedulingError,
+    build_default_tree,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+
+
+def pm(name, queue=0, kv=0.0, max_adapters=0, adapters=(), prefill=0, kv_free=0, kv_cap=0):
+    return PodMetrics(
+        pod=Pod(name=name, address=f"{name}:8000"),
+        metrics=Metrics(
+            waiting_queue_size=queue,
+            kv_cache_usage_percent=kv,
+            max_active_adapters=max_adapters,
+            active_adapters={a: 1 for a in adapters},
+            prefill_queue_size=prefill,
+            kv_tokens_free=kv_free,
+            kv_tokens_capacity=kv_cap,
+        ),
+    )
+
+
+def names(pods):
+    return [p.pod.name for p in pods]
+
+
+# Shared fixture mirroring filter_test.go:38-74.
+def three_pods():
+    return [
+        pm("pod1", queue=0, kv=0.2, max_adapters=2, adapters=("foo", "bar")),
+        pm("pod2", queue=3, kv=0.1, max_adapters=2, adapters=("foo", "critical")),
+        pm("pod3", queue=10, kv=0.2, max_adapters=2, adapters=("foo",)),
+    ]
+
+
+def parity_tree():
+    return build_default_tree(token_aware=False, prefill_aware=False)
+
+
+class TestDefaultTree:
+    def test_critical_request_picks_affine_low_kv_pod(self):
+        # filter_test.go:29-89 — pod2: relatively low queue, model active, low KV.
+        req = LLMRequest(model="critical", resolved_target_model="critical", critical=True)
+        got = parity_tree().filter(req, three_pods())
+        assert names(got) == ["pod2"]
+
+    def test_sheddable_accepted(self):
+        # filter_test.go:91-150 — pod1 has capacity (queue 0 <= 5, kv 0.2 <= 0.8).
+        req = LLMRequest(model="sheddable", resolved_target_model="sheddable")
+        got = parity_tree().filter(req, three_pods())
+        assert names(got) == ["pod1"]
+
+    def test_sheddable_dropped_when_saturated(self):
+        # filter_test.go:152-200 — all pods above KV threshold -> drop.
+        pods = [
+            pm("pod1", queue=10, kv=0.9, max_adapters=2, adapters=("foo", "bar")),
+            pm("pod2", queue=3, kv=0.85, max_adapters=2, adapters=("foo", "critical")),
+            pm("pod3", queue=10, kv=0.85, max_adapters=2, adapters=("foo",)),
+        ]
+        req = LLMRequest(model="sheddable", resolved_target_model="sheddable")
+        with pytest.raises(FilterError, match="dropping request"):
+            parity_tree().filter(req, pods)
+
+    def test_simple_filter_without_successor_fails(self):
+        # filter_test.go:22-27.
+        def boom(req, pods):
+            raise FilterError("filter error")
+
+        with pytest.raises(FilterError):
+            Filter(name="boom", func=boom).filter(LLMRequest(model="m"), [])
+
+
+class TestFilterFuncs:
+    def test_least_queuing_buckets_first_range(self):
+        # filter_test.go:233-264: queues 0,3,10 -> cut at 0+10//3=3 -> keep 0,3.
+        pods = [pm("a", queue=0), pm("b", queue=3), pm("c", queue=10)]
+        got = least_queuing_filter(LLMRequest(model="m"), pods)
+        assert names(got) == ["a", "b"]
+
+    def test_least_queuing_empty_input_fails(self):
+        with pytest.raises(FilterError):
+            least_queuing_filter(LLMRequest(model="m"), [])
+
+    def test_least_kv_cache_buckets_first_range(self):
+        # filter_test.go:272-303: kv 0,0.3,1.0 -> cut at 1/3 -> keep 0,0.3.
+        pods = [pm("a", kv=0.0), pm("b", kv=0.3), pm("c", kv=1.0)]
+        got = least_kv_cache_filter(LLMRequest(model="m"), pods)
+        assert names(got) == ["a", "b"]
+
+    def test_sheddable_admission_predicate(self):
+        # filter_test.go:305-338 with queueThreshold=0, kvThreshold=0.8.
+        preds = make_predicates(SchedulerConfig(queue_threshold_critical=0, kv_cache_threshold=0.8))
+        f = to_filter_func(preds["sheddable_admission"])
+        pods = [pm("ok", queue=0, kv=0.0), pm("queued", queue=1, kv=0.3), pm("hot", queue=0, kv=1.0)]
+        got = f(LLMRequest(model="m"), pods)
+        assert names(got) == ["ok"]
+
+    def test_low_lora_cost(self):
+        # filter_test.go:340-394: active adapter or free slot passes.
+        preds = make_predicates()
+        f = to_filter_func(preds["low_lora_cost"])
+        req = LLMRequest(model="model", resolved_target_model="model")
+        pods = [
+            pm("active", max_adapters=2, adapters=("model",)),
+            pm("has-room", max_adapters=2, adapters=("another-model",)),
+            pm("full", max_adapters=2, adapters=("foo", "bar")),
+        ]
+        got = f(req, pods)
+        assert names(got) == ["active", "has-room"]
+
+    def test_lora_affinity_and_can_accept(self):
+        preds = make_predicates()
+        req = LLMRequest(model="m", resolved_target_model="m")
+        affine = pm("affine", max_adapters=1, adapters=("m",))
+        room = pm("room", max_adapters=2, adapters=("x",))
+        full = pm("full", max_adapters=1, adapters=("x",))
+        assert preds["lora_affinity"](req, affine)
+        assert not preds["lora_affinity"](req, room)
+        assert preds["can_accept_new_lora"](req, room)
+        assert not preds["can_accept_new_lora"](req, full)
+
+
+class TestTPUExtensions:
+    def test_token_headroom_prefers_fitting_pods(self):
+        tree = build_default_tree(token_aware=True, prefill_aware=False)
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True, prompt_tokens=5000)
+        pods = [
+            pm("small", queue=0, kv=0.1, max_adapters=2, adapters=("m",), kv_free=1000, kv_cap=8000),
+            pm("roomy", queue=0, kv=0.1, max_adapters=2, adapters=("m",), kv_free=7000, kv_cap=8000),
+        ]
+        got = tree.filter(req, pods)
+        assert names(got) == ["roomy"]
+
+    def test_token_headroom_advisory_fallback(self):
+        # No pod fits -> headroom must NOT dead-end; falls back to all pods.
+        tree = build_default_tree(token_aware=True, prefill_aware=False)
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True, prompt_tokens=50_000)
+        pods = [
+            pm("a", queue=0, kv=0.1, max_adapters=2, adapters=("m",), kv_free=1000, kv_cap=8000),
+            pm("b", queue=0, kv=0.2, max_adapters=2, adapters=("m",), kv_free=2000, kv_cap=8000),
+        ]
+        got = tree.filter(req, pods)
+        assert names(got) == ["a"]  # falls through to least-KV
+
+    def test_prefill_aware_routes_on_prefill_queue(self):
+        tree = build_default_tree(token_aware=False, prefill_aware=True)
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True)
+        pods = [
+            pm("deep-prefill", queue=2, kv=0.1, max_adapters=2, adapters=("m",), prefill=9),
+            pm("idle-prefill", queue=2, kv=0.1, max_adapters=2, adapters=("m",), prefill=0),
+        ]
+        got = tree.filter(req, pods)
+        assert names(got) == ["idle-prefill"]
+
+
+class TestScheduler:
+    def test_schedule_returns_pod(self):
+        sched = Scheduler(StaticProvider(three_pods()), token_aware=False, prefill_aware=False)
+        req = LLMRequest(model="critical", resolved_target_model="critical", critical=True)
+        assert sched.schedule(req).name == "pod2"
+
+    def test_schedule_shed_maps_to_429(self):
+        pods = [pm("pod1", queue=10, kv=0.9, max_adapters=1, adapters=("foo",))]
+        sched = Scheduler(StaticProvider(pods), token_aware=False, prefill_aware=False)
+        with pytest.raises(SchedulingError) as exc_info:
+            sched.schedule(LLMRequest(model="shed", resolved_target_model="shed"))
+        assert exc_info.value.shed
+
+    def test_schedule_no_pods_sheds(self):
+        # With zero pods even a critical request falls through the tree's
+        # failure branches into the drop filter -> RESOURCE_EXHAUSTED, exactly
+        # as the reference tree behaves (scheduler.go:27-32 -> :83-90).
+        sched = Scheduler(StaticProvider([]))
+        with pytest.raises(SchedulingError) as exc_info:
+            sched.schedule(LLMRequest(model="m", critical=True))
+        assert exc_info.value.shed
