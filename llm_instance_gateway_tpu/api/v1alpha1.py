@@ -96,6 +96,24 @@ class InferencePool:
 # ---------------------------------------------------------------------------
 
 
+def _parse_criticality(raw: Any) -> Criticality:
+    """Case-tolerant criticality parsing.
+
+    CRD validation would reject unknown tiers server-side; file-based configs
+    have no admission webhook, so be lenient on case but loud on junk.
+    """
+    if raw is None:
+        return Criticality.DEFAULT
+    text = str(raw).strip().capitalize()
+    try:
+        return Criticality(text)
+    except ValueError as e:
+        raise ValueError(
+            f"invalid criticality {raw!r} (want one of "
+            f"{[c.value for c in Criticality]})"
+        ) from e
+
+
 def _meta(doc: Mapping[str, Any]) -> tuple[str, str, str]:
     meta = doc.get("metadata", {})
     return (
@@ -120,14 +138,18 @@ def inference_model_from_doc(doc: Mapping[str, Any]) -> InferenceModel:
     pool_ref = None
     if "poolRef" in spec:
         pr = spec["poolRef"]
-        pool_ref = PoolRef(name=pr["name"], kind=pr.get("kind", "InferencePool"))
+        pool_ref = PoolRef(
+            name=pr["name"],
+            kind=pr.get("kind", "InferencePool"),
+            group=pr.get("group", GROUP),
+        )
     return InferenceModel(
         name=name,
         namespace=namespace,
         resource_version=rv,
         spec=InferenceModelSpec(
             model_name=spec.get("modelName", name),
-            criticality=Criticality(spec.get("criticality", "Default")),
+            criticality=_parse_criticality(spec.get("criticality")),
             target_models=targets,
             pool_ref=pool_ref,
         ),
@@ -150,15 +172,23 @@ def inference_pool_from_doc(doc: Mapping[str, Any]) -> InferencePool:
 
 
 def from_documents(docs: list[Mapping[str, Any]]):
-    """Split a multi-doc config into (pools, models), dispatching on ``kind``."""
+    """Split a multi-doc config into (pools, models), dispatching on ``kind``.
+
+    A malformed document names itself in the raised error instead of failing
+    anonymously for the whole file.
+    """
     pools: list[InferencePool] = []
     models: list[InferenceModel] = []
     for doc in docs:
         if not doc:
             continue
         kind = doc.get("kind", "")
-        if kind == "InferencePool":
-            pools.append(inference_pool_from_doc(doc))
-        elif kind == "InferenceModel":
-            models.append(inference_model_from_doc(doc))
+        try:
+            if kind == "InferencePool":
+                pools.append(inference_pool_from_doc(doc))
+            elif kind == "InferenceModel":
+                models.append(inference_model_from_doc(doc))
+        except (ValueError, KeyError, TypeError) as e:
+            name = doc.get("metadata", {}).get("name", "<unnamed>")
+            raise ValueError(f"invalid {kind or 'document'} {name!r}: {e}") from e
     return pools, models

@@ -1,0 +1,1 @@
+"""gRPC ext-proc transport: wire proto + streaming service."""

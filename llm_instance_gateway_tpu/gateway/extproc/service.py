@@ -1,0 +1,175 @@
+"""gRPC ExternalProcessor service over the tpu.extproc.v1 wire protocol.
+
+Parity: reference ``pkg/ext-proc/main.go:131-158`` (gRPC server wiring +
+health service) and ``handlers/server.go:51-121`` (the Process stream loop).
+
+grpc-python stub codegen (grpc_tools) is not available in this image, so the
+service is registered through grpc's generic-handler API with protobuf
+(de)serializers from the protoc-generated ``extproc_pb2`` — functionally
+identical to generated ``_pb2_grpc`` code.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures as _futures
+
+import grpc
+
+from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    ProcessingResult,
+    RequestBody,
+    RequestHeaders,
+    ResponseBody,
+    ResponseHeaders,
+)
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    ProcessingError,
+    RequestContext,
+    Server,
+)
+
+logger = logging.getLogger(__name__)
+
+SERVICE_NAME = "tpu.extproc.v1.ExternalProcessor"
+HEALTH_SERVICE_NAME = "tpu.extproc.v1.Health"
+
+
+def _to_message(req: pb.ProcessingRequest):
+    which = req.WhichOneof("request")
+    if which == "request_headers":
+        return RequestHeaders(
+            headers={h.key: h.raw_value.decode("utf-8", "replace")
+                     for h in req.request_headers.headers.headers}
+        )
+    if which == "request_body":
+        return RequestBody(body=req.request_body.body)
+    if which == "response_headers":
+        return ResponseHeaders(
+            headers={h.key: h.raw_value.decode("utf-8", "replace")
+                     for h in req.response_headers.headers.headers}
+        )
+    if which == "response_body":
+        return ResponseBody(
+            body=req.response_body.body,
+            end_of_stream=req.response_body.end_of_stream,
+        )
+    return None
+
+
+def _to_proto(result: ProcessingResult) -> pb.ProcessingResponse:
+    if result.immediate_status is not None:
+        return pb.ProcessingResponse(
+            immediate_response=pb.ImmediateResponse(
+                status_code=result.immediate_status,
+                details="dropping request due to limited backend resources",
+            )
+        )
+    common = pb.CommonResponse(clear_route_cache=result.clear_route_cache)
+    for key, value in result.set_headers.items():
+        common.header_mutation.set_headers.append(
+            pb.HeaderValue(key=key, raw_value=value.encode())
+        )
+    if result.body is not None:
+        common.body_mutation.body = result.body
+    if result.phase == "request_headers":
+        return pb.ProcessingResponse(
+            request_headers=pb.HeadersResponse(response=common)
+        )
+    if result.phase == "request_body":
+        return pb.ProcessingResponse(request_body=pb.BodyResponse(response=common))
+    if result.phase == "response_headers":
+        return pb.ProcessingResponse(
+            response_headers=pb.HeadersResponse(response=common)
+        )
+    return pb.ProcessingResponse(response_body=pb.BodyResponse(response=common))
+
+
+class ExtProcService:
+    """Bidirectional Process stream: one RequestContext per stream."""
+
+    def __init__(self, server: Server):
+        self._server = server
+
+    def process(self, request_iterator, context: grpc.ServicerContext):
+        req_ctx = RequestContext()
+        for req in request_iterator:
+            msg = _to_message(req)
+            if msg is None:
+                context.abort(grpc.StatusCode.UNKNOWN, "unknown request type")
+            try:
+                result = self._server.process(req_ctx, msg)
+            except ProcessingError as e:
+                # server.go:110-112: non-shed errors terminate the stream.
+                context.abort(grpc.StatusCode.UNKNOWN, f"failed to handle request: {e}")
+            yield _to_proto(result)
+
+
+class HealthService:
+    """main.go:43-52: SERVING once the InferencePool has synced."""
+
+    def __init__(self, datastore):
+        self._datastore = datastore
+
+    def check(self, request: pb.HealthCheckRequest, context) -> pb.HealthCheckResponse:
+        if self._datastore.has_synced_pool():
+            status = pb.HealthCheckResponse.SERVING
+        else:
+            status = pb.HealthCheckResponse.NOT_SERVING
+        return pb.HealthCheckResponse(status=status)
+
+
+def build_grpc_server(
+    handler_server: Server,
+    datastore,
+    port: int = 9002,
+    max_workers: int = 16,
+) -> grpc.Server:
+    """Assemble the gRPC server (main.go:131-158); caller starts/stops it."""
+    ext = ExtProcService(handler_server)
+    health = HealthService(datastore)
+    server = grpc.server(_futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                SERVICE_NAME,
+                {
+                    "Process": grpc.stream_stream_rpc_method_handler(
+                        ext.process,
+                        request_deserializer=pb.ProcessingRequest.FromString,
+                        response_serializer=pb.ProcessingResponse.SerializeToString,
+                    )
+                },
+            ),
+            grpc.method_handlers_generic_handler(
+                HEALTH_SERVICE_NAME,
+                {
+                    "Check": grpc.unary_unary_rpc_method_handler(
+                        health.check,
+                        request_deserializer=pb.HealthCheckRequest.FromString,
+                        response_serializer=pb.HealthCheckResponse.SerializeToString,
+                    )
+                },
+            ),
+        )
+    )
+    server.add_insecure_port(f"[::]:{port}")
+    return server
+
+
+def make_process_stub(channel: grpc.Channel):
+    """Client-side Process stream callable (for tests and the load rig)."""
+    return channel.stream_stream(
+        f"/{SERVICE_NAME}/Process",
+        request_serializer=pb.ProcessingRequest.SerializeToString,
+        response_deserializer=pb.ProcessingResponse.FromString,
+    )
+
+
+def make_health_stub(channel: grpc.Channel):
+    return channel.unary_unary(
+        f"/{HEALTH_SERVICE_NAME}/Check",
+        request_serializer=pb.HealthCheckRequest.SerializeToString,
+        response_deserializer=pb.HealthCheckResponse.FromString,
+    )

@@ -1,0 +1,8 @@
+"""Request/response handlers: the ext-proc processing core, transport-agnostic."""
+
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    RequestContext,
+    Server,
+)
+
+__all__ = ["Server", "RequestContext"]

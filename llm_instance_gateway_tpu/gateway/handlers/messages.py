@@ -1,0 +1,57 @@
+"""Transport-agnostic processing messages.
+
+The reference's handler layer consumes Envoy ``ProcessingRequest`` protos and
+emits ``ProcessingResponse`` protos (``pkg/ext-proc/handlers/server.go:51-121``).
+We keep the same four-phase shape (request headers/body, response
+headers/body) but as plain dataclasses, so the same handler core backs:
+
+- the gRPC ext-proc transport (``gateway/extproc``), which (de)serializes
+  these to the wire proto, and
+- the standalone reverse-proxy transport (``gateway/proxy``), which maps HTTP
+  requests directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RequestHeaders:
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class RequestBody:
+    body: bytes = b""
+
+
+@dataclass
+class ResponseHeaders:
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResponseBody:
+    body: bytes = b""
+    end_of_stream: bool = True
+
+
+ProcessingMessage = RequestHeaders | RequestBody | ResponseHeaders | ResponseBody
+
+
+@dataclass
+class ProcessingResult:
+    """What the transport must do with the in-flight HTTP message.
+
+    Mirrors the subset of Envoy's CommonResponse/ImmediateResponse the
+    reference uses: header mutations (request.go:82-97), body mutation
+    (request.go:110-114), ClearRouteCache (request.go:128-139), and an
+    immediate status for shedding (server.go:100-109 -> 429).
+    """
+
+    phase: str = ""
+    set_headers: dict[str, str] = field(default_factory=dict)
+    body: bytes | None = None  # None = leave body unmodified
+    clear_route_cache: bool = False
+    immediate_status: int | None = None  # e.g. 429; short-circuits the request

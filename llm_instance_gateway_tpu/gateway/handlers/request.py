@@ -1,0 +1,113 @@
+"""Request-phase handlers: model resolution, traffic split, scheduling, mutation.
+
+Parity: reference ``pkg/ext-proc/handlers/request.go``:
+
+- ``HandleRequestHeaders`` (:122-142): respond with ClearRouteCache=true so
+  the proxy recomputes the target cluster from the injected header.
+- ``HandleRequestBody`` (:19-120): JSON body must carry ``model``; the model
+  must be registered as an InferenceModel (no passthrough, :42-45); weighted
+  draw over TargetModels resolves the served model (:46-51); the body's
+  ``model`` field is rewritten only when resolution changed it (:62-70);
+  the scheduler picks a pod and the transport gets the target-pod header +
+  Content-Length (:82-97).
+
+TPU addition: a prompt-token estimate is attached to the LLMRequest so the
+token-headroom filter can do long-context-aware placement.
+"""
+
+from __future__ import annotations
+
+import json
+
+from llm_instance_gateway_tpu.gateway.datastore import (
+    is_critical,
+    random_weighted_draw,
+)
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    ProcessingResult,
+    RequestBody,
+    RequestHeaders,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+
+
+class RequestError(Exception):
+    """Malformed or unroutable request (transport maps to 4xx/5xx)."""
+
+
+def estimate_prompt_tokens(body: dict) -> int:
+    """Cheap prompt-size hint for token-aware routing.
+
+    ~4 chars/token is the standard rough estimate; precision doesn't matter —
+    the headroom filter is advisory and only needs order-of-magnitude.
+    """
+    text = ""
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        text = prompt
+    elif isinstance(prompt, list):
+        text = " ".join(p for p in prompt if isinstance(p, str))
+    elif isinstance(body.get("messages"), list):
+        text = " ".join(
+            str(m.get("content", "")) for m in body["messages"] if isinstance(m, dict)
+        )
+    return len(text) // 4
+
+
+def handle_request_headers(req_ctx, msg: RequestHeaders) -> ProcessingResult:
+    """request.go:122-142."""
+    return ProcessingResult(phase="request_headers", clear_route_cache=True)
+
+
+def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
+    """request.go:19-120.  ``server`` provides datastore/scheduler/header name."""
+    try:
+        body = json.loads(msg.body)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise RequestError(f"error unmarshaling request body: {e}") from e
+    model = body.get("model")
+    if not isinstance(model, str):
+        raise RequestError("model not found in request")
+
+    model_obj = server.datastore.fetch_model(model)
+    if model_obj is None:
+        # No passthrough of unregistered models (request.go:39-45).
+        raise RequestError(
+            f"error finding a model object in InferenceModel for input {model}"
+        )
+    model_name = model
+    if model_obj.spec.target_models:
+        model_name = random_weighted_draw(model_obj)
+        if not model_name:
+            raise RequestError(
+                f"error getting target model name for model {model_obj.name}"
+            )
+
+    llm_req = LLMRequest(
+        model=model,
+        resolved_target_model=model_name,
+        critical=is_critical(model_obj),
+        prompt_tokens=estimate_prompt_tokens(body),
+    )
+
+    request_body = msg.body
+    if llm_req.model != llm_req.resolved_target_model:
+        body["model"] = llm_req.resolved_target_model
+        request_body = json.dumps(body).encode()
+
+    target_pod = server.scheduler.schedule(llm_req)  # raises SchedulingError
+
+    req_ctx.model = llm_req.model
+    req_ctx.resolved_target_model = llm_req.resolved_target_model
+    req_ctx.target_pod = target_pod
+
+    return ProcessingResult(
+        phase="request_body",
+        set_headers={
+            server.target_pod_header: target_pod.address,
+            # Body was (possibly) mutated: Content-Length must follow
+            # (request.go:89-96).
+            "Content-Length": str(len(request_body)),
+        },
+        body=request_body,
+    )

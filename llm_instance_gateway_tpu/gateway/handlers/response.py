@@ -1,0 +1,52 @@
+"""Response-phase handlers: debug header + OpenAI usage accounting.
+
+Parity: reference ``pkg/ext-proc/handlers/response.go:13-94``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    ProcessingResult,
+    ResponseBody,
+    ResponseHeaders,
+)
+
+
+class ResponseError(Exception):
+    pass
+
+
+@dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+def handle_response_headers(req_ctx, msg: ResponseHeaders) -> ProcessingResult:
+    """response.go:13-38: debug marker header only."""
+    return ProcessingResult(
+        phase="response_headers",
+        set_headers={"x-went-into-resp-headers": "true"},
+    )
+
+
+def handle_response_body(req_ctx, msg: ResponseBody) -> ProcessingResult:
+    """response.go:64-83: parse OpenAI ``usage`` into the request context.
+
+    Groundwork for per-model token accounting (SURVEY.md §5 observability).
+    """
+    try:
+        body = json.loads(msg.body)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ResponseError(f"unmarshaling response body: {e}") from e
+    usage = body.get("usage") or {}
+    req_ctx.usage = Usage(
+        prompt_tokens=int(usage.get("prompt_tokens", 0) or 0),
+        completion_tokens=int(usage.get("completion_tokens", 0) or 0),
+        total_tokens=int(usage.get("total_tokens", 0) or 0),
+    )
+    return ProcessingResult(phase="response_body")

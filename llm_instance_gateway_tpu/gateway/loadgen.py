@@ -1,0 +1,123 @@
+"""Gateway load benchmark: the ghz-style ext-proc stress rig.
+
+Parity: reference ``pkg/ext-proc/test/benchmark/benchmark.go:20-110`` — spin a
+local ext-proc server with ``numFakePods`` fake pods × ``numModelsPerPod``
+adapters (default 200×5 = 1000 models), fire N gRPC Process requests
+round-robining model names, and report throughput + latency summary.
+
+Run:  python -m llm_instance_gateway_tpu.gateway.loadgen --requests 10000
+Also imported by bench.py for the scheduler-throughput component.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import grpc
+
+from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
+from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub
+from llm_instance_gateway_tpu.gateway.testing import (
+    fake_metrics,
+    fake_pod,
+    generate_request,
+    make_model,
+    start_ext_proc,
+)
+
+
+def model_name(i: int) -> str:  # benchmark.go:71-73
+    return f"adapter-{i}"
+
+
+def build_fixture(num_fake_pods: int, num_models_per_pod: int):
+    """benchmark.go:75-106: pod i serves adapters i*M..i*M+M-1."""
+    pods = {}
+    models = []
+    total = num_fake_pods * num_models_per_pod
+    for i in range(num_fake_pods):
+        adapters = {
+            model_name(i * num_models_per_pod + j): 0
+            for j in range(num_models_per_pod)
+        }
+        pods[fake_pod(i)] = fake_metrics(
+            queue=i % 5, kv=(i % 10) / 10.0, adapters=adapters,
+            max_adapters=num_models_per_pod + 1,
+        )
+    for i in range(total):
+        models.append(make_model(model_name(i), Criticality.CRITICAL))
+    return pods, models
+
+
+def run_load(
+    requests: int = 10000,
+    num_fake_pods: int = 200,
+    num_models_per_pod: int = 5,
+    port: int = 19102,
+    streams: int = 8,
+) -> dict:
+    """Fire ``requests`` Process calls; return a ghz-style summary dict."""
+    pods, models = build_fixture(num_fake_pods, num_models_per_pod)
+    server = start_ext_proc(pods, models, port=port)
+    total_models = num_fake_pods * num_models_per_pod
+    latencies: list[float] = []
+    try:
+        channel = grpc.insecure_channel(f"localhost:{port}")
+        stub = make_process_stub(channel)
+        t_start = time.perf_counter()
+        # Round-robin model names (benchmark.go:64-69), batched into streams.
+        sent = 0
+        while sent < requests:
+            batch = min(requests - sent, max(1, requests // streams))
+            msgs = [
+                pb.ProcessingRequest(
+                    request_body=pb.HttpBody(
+                        body=generate_request(model_name((sent + k) % total_models))
+                    )
+                )
+                for k in range(batch)
+            ]
+            t0 = time.perf_counter()
+            # One stream per batch: measures per-message processing inline.
+            for resp in stub(iter(msgs)):
+                t1 = time.perf_counter()
+                latencies.append(t1 - t0)
+                t0 = t1
+                assert resp.WhichOneof("response") == "request_body"
+            sent += batch
+        wall = time.perf_counter() - t_start
+        channel.close()
+    finally:
+        server.stop(None)
+
+    latencies.sort()
+
+    def pct(p: float) -> float:
+        return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
+
+    return {
+        "requests": requests,
+        "num_fake_pods": num_fake_pods,
+        "num_models": total_models,
+        "wall_s": round(wall, 3),
+        "rps": round(requests / wall, 1),
+        "p50_us": round(pct(0.5) * 1e6, 1),
+        "p99_us": round(pct(0.99) * 1e6, 1),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=10000)
+    parser.add_argument("--fake-pods", type=int, default=200)
+    parser.add_argument("--models-per-pod", type=int, default=5)
+    args = parser.parse_args(argv)
+    summary = run_load(args.requests, args.fake_pods, args.models_per_pod)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

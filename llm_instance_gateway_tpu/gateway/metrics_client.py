@@ -29,6 +29,7 @@ TPU model server (JetStream-style) that wants to join a pool:
 from __future__ import annotations
 
 import concurrent.futures as futures
+import threading
 import urllib.error
 import urllib.request
 
@@ -107,10 +108,17 @@ def families_to_metrics(
             if name:
                 adapters[name] = 0
         updated.active_adapters = adapters
-        try:
-            updated.max_active_adapters = int(float(best.labels.get(LORA_MAX_LABEL, "0")))
-        except ValueError:
-            errs.append(f"invalid {LORA_MAX_LABEL} label: {best.labels}")
+        raw_max = best.labels.get(LORA_MAX_LABEL)
+        if raw_max is None:
+            # Without max_lora the slot-room predicates are permanently false
+            # for this pod — surface the misconfiguration instead of silently
+            # degrading LoRA placement.
+            errs.append(f"{LORA_INFO_METRIC} missing {LORA_MAX_LABEL} label")
+        else:
+            try:
+                updated.max_active_adapters = int(float(raw_max))
+            except ValueError:
+                errs.append(f"invalid {LORA_MAX_LABEL} label: {best.labels}")
     return updated, errs
 
 
@@ -164,12 +172,14 @@ def fetch_all(
 ) -> tuple[dict[str, Metrics], list[str]]:
     """Parallel per-pod fetch fan-out (provider.go:145-162).
 
-    Pass a persistent ``executor`` (the Provider owns one) — creating and
-    context-managing a pool per call would both churn threads at the 50 ms
-    refresh cadence and, worse, block past ``timeout_s`` in
+    Pass a persistent ``executor`` (Provider owns and passes its own) —
+    creating and context-managing a pool per call would both churn threads at
+    the 50 ms refresh cadence and, worse, block past ``timeout_s`` in
     ``shutdown(wait=True)`` while a slow endpoint drips bytes.  With a shared
     pool, stragglers keep a worker busy past the deadline but never block the
     refresh loop; the bounded pool size caps the damage from a wedged pod.
+    The module-level fallback pool exists only for executor-less callers
+    (tests, one-shot scripts).
     """
     results: dict[str, Metrics] = {}
     errs: list[str] = []
@@ -191,12 +201,14 @@ def fetch_all(
 
 
 _SHARED_EXECUTOR: futures.ThreadPoolExecutor | None = None
+_SHARED_EXECUTOR_LOCK = threading.Lock()
 
 
 def _default_executor() -> futures.ThreadPoolExecutor:
     global _SHARED_EXECUTOR
-    if _SHARED_EXECUTOR is None:
-        _SHARED_EXECUTOR = futures.ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="metrics-fetch"
-        )
-    return _SHARED_EXECUTOR
+    with _SHARED_EXECUTOR_LOCK:
+        if _SHARED_EXECUTOR is None:
+            _SHARED_EXECUTOR = futures.ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="metrics-fetch"
+            )
+        return _SHARED_EXECUTOR

@@ -13,9 +13,9 @@ the request path (SURVEY.md §3.2).
 
 from __future__ import annotations
 
+import concurrent.futures as futures
 import logging
 import threading
-import time
 
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.metrics_client import fetch_all
@@ -27,13 +27,16 @@ FETCH_METRICS_TIMEOUT_S = 5.0  # provider.go:14
 
 
 class Provider:
-    def __init__(self, metrics_client, datastore: Datastore):
+    def __init__(self, metrics_client, datastore: Datastore, max_fetch_workers: int = 32):
         self._client = metrics_client
         self._datastore = datastore
         self._metrics: dict[str, PodMetrics] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._executor = futures.ThreadPoolExecutor(
+            max_workers=max_fetch_workers, thread_name_prefix="metrics-fetch"
+        )
 
     # -- snapshot accessors (provider.go:34-58) ----------------------------
     def all_pod_metrics(self) -> list[PodMetrics]:
@@ -77,6 +80,7 @@ class Provider:
 
     def stop(self) -> None:
         self._stop.set()
+        self._executor.shutdown(wait=False, cancel_futures=True)
 
     # -- refresh bodies ----------------------------------------------------
     def refresh_pods_once(self) -> None:
@@ -101,7 +105,10 @@ class Provider:
         """Parallel scrape of every pod (provider.go:134-179); returns errors."""
         snapshot = self.all_pod_metrics()
         results, errs = fetch_all(
-            self._client, snapshot, timeout_s=FETCH_METRICS_TIMEOUT_S
+            self._client,
+            snapshot,
+            timeout_s=FETCH_METRICS_TIMEOUT_S,
+            executor=self._executor,
         )
         with self._lock:
             for pm in snapshot:

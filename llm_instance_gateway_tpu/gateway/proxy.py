@@ -1,0 +1,237 @@
+"""Standalone gateway: an L7 reverse proxy embedding the ext-proc handler core.
+
+The reference runs as an Envoy ext-proc sidecar: Envoy terminates HTTP, calls
+the EPP over gRPC, then routes to the ORIGINAL_DST cluster using the
+``target-pod`` header (``pkg/manifests/patch_policy.yaml:14-42``).  On GKE
+that wiring is reproduced by the manifests under ``deploy/``; for
+environments without Envoy (and for the TPU pools' leaner data path) this
+module IS the proxy: it terminates OpenAI-style HTTP, runs the identical
+four-phase handler core inline (request headers -> body -> schedule ->
+forward -> response phases), and streams the model server's reply back.
+
+Endpoints:
+- ``POST /v1/completions`` and ``/v1/chat/completions`` — routed inference.
+- ``GET  /metrics``  — gateway self-telemetry (scheduler decisions, shed rate,
+  pick latency; resolves reference TODO provider.go:140).
+- ``GET  /healthz``  — 200 once the InferencePool is synced (main.go:43-52).
+- ``GET  /v1/models`` — logical models from the datastore.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+
+import aiohttp
+from aiohttp import web
+
+from llm_instance_gateway_tpu.api import v1alpha1
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    RequestBody,
+    RequestHeaders,
+    ResponseBody,
+    ResponseHeaders,
+)
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    ProcessingError,
+    RequestContext,
+    Server,
+)
+from llm_instance_gateway_tpu.gateway.metrics_client import PodMetricsClient
+from llm_instance_gateway_tpu.gateway.provider import Provider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics, Timer
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+logger = logging.getLogger(__name__)
+
+
+class GatewayProxy:
+    def __init__(
+        self,
+        handler_server: Server,
+        provider,
+        datastore: Datastore,
+        request_timeout_s: float = 3600.0,
+    ):
+        self.server = handler_server
+        self.provider = provider
+        self.datastore = datastore
+        self.metrics = GatewayMetrics()
+        self.request_timeout_s = request_timeout_s
+        self._session: aiohttp.ClientSession | None = None
+
+    # -- app wiring --------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.handle_completion)
+        app.router.add_post("/v1/chat/completions", self.handle_completion)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/healthz", self.handle_health)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.request_timeout_s)
+        )
+
+    async def _on_cleanup(self, app) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    # -- request path ------------------------------------------------------
+    async def handle_completion(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        req_ctx = RequestContext()
+        loop = asyncio.get_running_loop()
+
+        # Phase 1+2: headers then body, through the same core the gRPC
+        # transport uses.  Scheduling is CPU-only (no I/O) but can walk a
+        # large pool; run in executor to keep the event loop responsive.
+        self.server.process(req_ctx, RequestHeaders(headers=dict(request.headers)))
+        try:
+            with Timer() as t:
+                result = await loop.run_in_executor(
+                    None, self.server.process, req_ctx, RequestBody(body=body)
+                )
+        except ProcessingError as e:
+            self.metrics.record_error()
+            kind = "invalid_request_error" if e.status == 400 else "api_error"
+            return web.json_response(
+                {"error": {"message": str(e), "type": kind}}, status=e.status
+            )
+        self.metrics.record_request(req_ctx.model or "?")
+        if result.immediate_status is not None:
+            self.metrics.record_shed()
+            return web.json_response(
+                {"error": {"message": "dropping request due to limited backend resources",
+                            "type": "rate_limit_exceeded"}},
+                status=result.immediate_status,
+            )
+
+        pod = req_ctx.target_pod
+        affinity_hit = False
+        pm = self.provider.get_pod_metrics(pod.name) if hasattr(self.provider, "get_pod_metrics") else None
+        if pm is not None:
+            affinity_hit = req_ctx.resolved_target_model in pm.metrics.active_adapters
+        self.metrics.record_pick(pod.name, t.seconds, affinity_hit)
+
+        # Forward to the picked replica (Envoy's ORIGINAL_DST role).
+        out_body = result.body if result.body is not None else body
+        url = f"http://{pod.address}{request.path}"
+        try:
+            async with self._session.post(
+                url,
+                data=out_body,
+                headers={
+                    "Content-Type": "application/json",
+                    self.server.target_pod_header: pod.address,
+                },
+            ) as upstream:
+                resp_body = await upstream.read()
+                status = upstream.status
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            self.metrics.record_error()
+            logger.warning("upstream %s failed: %s", pod.address, e)
+            return web.json_response(
+                {"error": {"message": f"upstream error: {e}", "type": "api_error"}},
+                status=502,
+            )
+
+        # Phases 3+4: response headers + usage accounting.
+        hdr_result = self.server.process(req_ctx, ResponseHeaders())
+        try:
+            self.server.process(req_ctx, ResponseBody(body=resp_body))
+            self.metrics.record_usage(
+                req_ctx.model,
+                req_ctx.usage.prompt_tokens,
+                req_ctx.usage.completion_tokens,
+            )
+        except ProcessingError:
+            pass  # non-JSON upstream bodies (e.g. SSE streams) skip accounting
+
+        headers = {"x-served-by": pod.name, **hdr_result.set_headers}
+        return web.Response(body=resp_body, status=status, headers=headers,
+                            content_type="application/json")
+
+    # -- ops endpoints -----------------------------------------------------
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        if self.datastore.has_synced_pool():
+            return web.Response(text="ok")
+        return web.Response(status=503, text="InferencePool not synced")
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        models = [
+            {"id": m.spec.model_name, "object": "model",
+             "criticality": m.spec.criticality.value}
+            for m in self.datastore.all_models()
+        ]
+        return web.json_response({"object": "list", "data": models})
+
+
+def build_from_config(config_path: str, static_pods: list[str] | None = None):
+    """Assemble datastore/provider/scheduler/proxy from a YAML config file.
+
+    The config is a multi-doc YAML of InferencePool/InferenceModel documents
+    (CRD shape).  ``static_pods`` ("name=host:port") seeds membership when no
+    controller is running (the controllers package supersedes this on k8s).
+    """
+    import yaml
+
+    with open(config_path) as f:
+        docs = list(yaml.safe_load_all(f))
+    pools, models = v1alpha1.from_documents(docs)
+
+    datastore = Datastore()
+    for pool in pools:
+        datastore.set_pool(pool)
+    for model in models:
+        datastore.store_model(model)
+    for spec in static_pods or []:
+        name, _, addr = spec.partition("=")
+        datastore.store_pod(Pod(name=name, address=addr or name))
+
+    provider = Provider(PodMetricsClient(), datastore)
+    scheduler = Scheduler(provider)
+    handler_server = Server(scheduler, datastore)
+    proxy = GatewayProxy(handler_server, provider, datastore)
+    return proxy, provider, datastore
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="TPU-native inference gateway")
+    parser.add_argument("--config", required=True, help="pool/model YAML")
+    parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--pod", action="append", default=[],
+                        help="static pod membership name=host:port (repeatable)")
+    parser.add_argument("--refresh-metrics-interval", type=float, default=0.05)
+    parser.add_argument("--refresh-pods-interval", type=float, default=10.0)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    proxy, provider, _ = build_from_config(args.config, args.pod)
+    provider.init(
+        refresh_pods_interval_s=args.refresh_pods_interval,
+        refresh_metrics_interval_s=args.refresh_metrics_interval,
+    )
+    try:
+        web.run_app(proxy.build_app(), port=args.port)
+    finally:
+        provider.stop()
+
+
+if __name__ == "__main__":
+    main()

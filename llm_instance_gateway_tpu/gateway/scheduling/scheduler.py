@@ -20,9 +20,6 @@ reference parity, as the parity tests do):
 - ``prefill_aware=True`` routes on the prefill queue (TTFT-gating signal under
   prefill/decode disaggregation) before total queue depth.
 
-When an optional native library is present (``native/libligsched.so``), the
-flat hot loop (bucketing filters over large pools) runs in C++; the decision
-tree and semantics stay identical (see ``native.py``).
 """
 
 from __future__ import annotations

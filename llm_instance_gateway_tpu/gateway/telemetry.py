@@ -1,0 +1,133 @@
+"""Gateway self-metrics: scheduler decisions, pick latency, shed rate.
+
+The reference EPP *consumes* Prometheus but never *exports* it (acknowledged
+TODO, ``backend/provider.go:140``; SURVEY.md §5).  This module resolves that
+gap: lightweight counters/histograms exposed in Prometheus text format by the
+proxy's ``/metrics`` endpoint and the load rig.
+
+Hand-rolled rather than prometheus_client so the request path stays at a few
+dict operations under a lock-free fast path (GIL-atomic int adds).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+@dataclass
+class Histogram:
+    buckets: tuple[float, ...] = _BUCKETS
+    counts: list[int] = field(default_factory=lambda: [0] * (len(_BUCKETS) + 1))
+    total: float = 0.0
+    n: int = 0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.total += v
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+
+class GatewayMetrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests_total: dict[str, int] = {}  # by model
+        self.scheduled_total: dict[str, int] = {}  # by target pod
+        self.shed_total = 0
+        self.errors_total = 0
+        self.tokens_prompt_total: dict[str, int] = {}  # by model
+        self.tokens_completion_total: dict[str, int] = {}
+        self.pick_latency = Histogram()
+        self.lora_affinity_hits = 0  # picked pod already had the adapter
+
+    # -- recording ---------------------------------------------------------
+    def record_request(self, model: str) -> None:
+        with self._lock:
+            self.requests_total[model] = self.requests_total.get(model, 0) + 1
+
+    def record_pick(self, pod_name: str, seconds: float, affinity_hit: bool) -> None:
+        with self._lock:
+            self.scheduled_total[pod_name] = self.scheduled_total.get(pod_name, 0) + 1
+            self.pick_latency.observe(seconds)
+            if affinity_hit:
+                self.lora_affinity_hits += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors_total += 1
+
+    def record_usage(self, model: str, prompt: int, completion: int) -> None:
+        with self._lock:
+            self.tokens_prompt_total[model] = (
+                self.tokens_prompt_total.get(model, 0) + prompt
+            )
+            self.tokens_completion_total[model] = (
+                self.tokens_completion_total.get(model, 0) + completion
+            )
+
+    # -- export ------------------------------------------------------------
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                "# TYPE gateway_requests_total counter",
+            ]
+            for model, n in sorted(self.requests_total.items()):
+                lines.append(f'gateway_requests_total{{model="{model}"}} {n}')
+            lines.append("# TYPE gateway_scheduled_total counter")
+            for pod, n in sorted(self.scheduled_total.items()):
+                lines.append(f'gateway_scheduled_total{{pod="{pod}"}} {n}')
+            lines += [
+                "# TYPE gateway_shed_total counter",
+                f"gateway_shed_total {self.shed_total}",
+                "# TYPE gateway_errors_total counter",
+                f"gateway_errors_total {self.errors_total}",
+                "# TYPE gateway_lora_affinity_hits_total counter",
+                f"gateway_lora_affinity_hits_total {self.lora_affinity_hits}",
+                "# TYPE gateway_pick_latency_seconds summary",
+                f"gateway_pick_latency_seconds_count {self.pick_latency.n}",
+                f"gateway_pick_latency_seconds_sum {self.pick_latency.total}",
+                f'gateway_pick_latency_seconds{{quantile="0.5"}} {self.pick_latency.quantile(0.5)}',
+                f'gateway_pick_latency_seconds{{quantile="0.99"}} {self.pick_latency.quantile(0.99)}',
+            ]
+            for fam, table in (
+                ("gateway_prompt_tokens_total", self.tokens_prompt_total),
+                ("gateway_completion_tokens_total", self.tokens_completion_total),
+            ):
+                lines.append(f"# TYPE {fam} counter")
+                for model, n in sorted(table.items()):
+                    lines.append(f'{fam}{{model="{model}"}} {n}')
+            return "\n".join(lines) + "\n"
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self.t0
+        return False

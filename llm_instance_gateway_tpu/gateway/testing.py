@@ -1,0 +1,108 @@
+"""Hermetic test harness for the gateway.
+
+Parity: reference ``pkg/ext-proc/test/utils.go:21-80`` — ``StartExtProc``
+wires a REAL gRPC ext-proc server + REAL scheduler over a fake metrics client
+and an in-memory datastore with N fake pods; ``GenerateRequest`` and
+``FakePod`` build inputs.  Used by the hermetic test and the load benchmark
+(``test/benchmark/benchmark.go``).
+"""
+
+from __future__ import annotations
+
+import json
+
+from llm_instance_gateway_tpu.api.v1alpha1 import (
+    Criticality,
+    InferenceModel,
+    InferenceModelSpec,
+    InferencePool,
+    InferencePoolSpec,
+    TargetModel,
+)
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.extproc.service import build_grpc_server
+from llm_instance_gateway_tpu.gateway.handlers.server import Server
+from llm_instance_gateway_tpu.gateway.metrics_client import FakePodMetricsClient
+from llm_instance_gateway_tpu.gateway.provider import Provider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+
+def fake_pod(index: int) -> Pod:
+    """test/utils.go:74-80."""
+    return Pod(name=f"pod-{index}", address=f"192.168.1.{index + 1}:8000")
+
+
+def fake_metrics(
+    queue: int = 0,
+    kv: float = 0.0,
+    adapters: dict[str, int] | None = None,
+    max_adapters: int = 4,
+    prefill: int = 0,
+) -> Metrics:
+    return Metrics(
+        waiting_queue_size=queue,
+        kv_cache_usage_percent=kv,
+        active_adapters=dict(adapters or {}),
+        max_active_adapters=max_adapters,
+        prefill_queue_size=prefill,
+    )
+
+
+def generate_request(model: str, prompt: str = "test prompt") -> bytes:
+    """test/utils.go:57-66."""
+    return json.dumps(
+        {"model": model, "prompt": prompt, "max_tokens": 100, "temperature": 0}
+    ).encode()
+
+
+def make_model(
+    name: str,
+    criticality: Criticality = Criticality.CRITICAL,
+    targets: list[tuple[str, int]] | None = None,
+) -> InferenceModel:
+    return InferenceModel(
+        name=name,
+        spec=InferenceModelSpec(
+            model_name=name,
+            criticality=criticality,
+            target_models=[TargetModel(n, w) for n, w in (targets or [])],
+        ),
+    )
+
+
+def start_ext_proc(
+    pod_metrics: dict[Pod, Metrics],
+    models: list[InferenceModel],
+    port: int = 9002,
+    **scheduler_kwargs,
+):
+    """StartExtProc (test/utils.go:21-51): real gRPC server, fake metrics.
+
+    Returns the started grpc server; caller must ``server.stop(None)``.
+    """
+    datastore = Datastore(pods=list(pod_metrics))
+    datastore.set_pool(
+        InferencePool(name="test-pool", spec=InferencePoolSpec(selector={"app": "t"}))
+    )
+    for model in models:
+        datastore.store_model(model)
+    client = FakePodMetricsClient(
+        res={pod.name: m for pod, m in pod_metrics.items()}
+    )
+    provider = Provider(client, datastore)
+    provider.refresh_pods_once()
+    provider.refresh_metrics_once()
+    scheduler = Scheduler(provider, **scheduler_kwargs)
+    handler_server = Server(scheduler, datastore)
+    grpc_server = build_grpc_server(handler_server, datastore, port=port)
+    grpc_server.start()
+    return grpc_server
+
+
+def static_provider(pod_metrics: dict[Pod, Metrics]):
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+
+    return StaticProvider(
+        [PodMetrics(pod=p, metrics=m) for p, m in pod_metrics.items()]
+    )

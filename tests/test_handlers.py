@@ -1,0 +1,138 @@
+"""Handler core tests.
+
+Parity: ``handlers/response_test.go:36-87`` (usage parsing + malformed body)
+and the request-body behaviors of ``handlers/request.go`` (model resolution,
+no-passthrough, traffic split rewrite, Content-Length, 429 mapping).
+"""
+
+import json
+
+import pytest
+
+from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    RequestBody,
+    RequestHeaders,
+    ResponseBody,
+    ResponseHeaders,
+)
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    ProcessingError,
+    RequestContext,
+    Server,
+)
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.testing import (
+    fake_metrics,
+    fake_pod,
+    generate_request,
+    make_model,
+)
+from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+
+def make_server(models, pod_metrics, **sched_kwargs):
+    ds = Datastore(pods=list(pod_metrics))
+    for m in models:
+        ds.store_model(m)
+    provider = StaticProvider(
+        [PodMetrics(pod=p, metrics=m) for p, m in pod_metrics.items()]
+    )
+    sched_kwargs.setdefault("token_aware", False)
+    sched_kwargs.setdefault("prefill_aware", False)
+    return Server(Scheduler(provider, **sched_kwargs), ds)
+
+
+class TestRequestPhases:
+    def test_request_headers_clears_route_cache(self):
+        server = make_server([], {})
+        result = server.process(RequestContext(), RequestHeaders())
+        assert result.clear_route_cache
+
+    def test_body_schedules_and_sets_target_header(self):
+        pods = {
+            fake_pod(0): fake_metrics(queue=0, kv=0.1, adapters={"my-model": 1}),
+            fake_pod(1): fake_metrics(queue=50, kv=0.9),
+        }
+        server = make_server([make_model("my-model")], pods)
+        ctx = RequestContext()
+        result = server.process(ctx, RequestBody(body=generate_request("my-model")))
+        assert result.set_headers["target-pod"] == "192.168.1.1:8000"
+        assert result.body is not None
+        assert result.set_headers["Content-Length"] == str(len(result.body))
+        assert ctx.target_pod.name == "pod-0"
+        assert ctx.model == "my-model"
+
+    def test_traffic_split_rewrites_body(self):
+        pods = {fake_pod(0): fake_metrics()}
+        model = make_model("logical", targets=[("rollout-v2", 100)])
+        server = make_server([model], pods)
+        ctx = RequestContext()
+        result = server.process(ctx, RequestBody(body=generate_request("logical")))
+        rewritten = json.loads(result.body)
+        assert rewritten["model"] == "rollout-v2"
+        assert ctx.resolved_target_model == "rollout-v2"
+        # Content-Length tracks the mutated body (request.go:89-96).
+        assert int(result.set_headers["Content-Length"]) == len(result.body)
+
+    def test_no_rewrite_when_model_unchanged(self):
+        pods = {fake_pod(0): fake_metrics()}
+        server = make_server([make_model("direct")], pods)
+        body = generate_request("direct")
+        result = server.process(RequestContext(), RequestBody(body=body))
+        assert result.body == body  # byte-identical: no remarshal (request.go:59-70)
+
+    def test_unregistered_model_rejected(self):
+        # No passthrough (request.go:39-45).
+        server = make_server([make_model("known")], {fake_pod(0): fake_metrics()})
+        with pytest.raises(ProcessingError, match="InferenceModel"):
+            server.process(RequestContext(), RequestBody(body=generate_request("unknown")))
+
+    def test_malformed_json_rejected(self):
+        server = make_server([], {fake_pod(0): fake_metrics()})
+        with pytest.raises(ProcessingError, match="unmarshaling"):
+            server.process(RequestContext(), RequestBody(body=b"{not json"))
+
+    def test_missing_model_rejected(self):
+        server = make_server([], {fake_pod(0): fake_metrics()})
+        with pytest.raises(ProcessingError, match="model not found"):
+            server.process(RequestContext(), RequestBody(body=b'{"prompt": "x"}'))
+
+    def test_shed_maps_to_429(self):
+        # Saturated pool + sheddable model -> immediate 429 (server.go:100-109).
+        pods = {fake_pod(0): fake_metrics(queue=50, kv=0.95)}
+        model = make_model("batch", criticality=Criticality.SHEDDABLE)
+        server = make_server([model], pods)
+        result = server.process(RequestContext(), RequestBody(body=generate_request("batch")))
+        assert result.immediate_status == 429
+
+
+class TestResponsePhases:
+    def test_response_headers_debug_marker(self):
+        server = make_server([], {})
+        result = server.process(RequestContext(), ResponseHeaders())
+        assert result.set_headers["x-went-into-resp-headers"] == "true"
+
+    def test_usage_parsed(self):
+        # response_test.go:36-60.
+        server = make_server([], {})
+        ctx = RequestContext()
+        body = json.dumps(
+            {
+                "id": "cmpl-573498d260f2423f9e42817bbba3743a",
+                "object": "text_completion",
+                "usage": {"prompt_tokens": 11, "total_tokens": 111, "completion_tokens": 100},
+            }
+        ).encode()
+        server.process(ctx, ResponseBody(body=body))
+        assert ctx.usage.prompt_tokens == 11
+        assert ctx.usage.completion_tokens == 100
+        assert ctx.usage.total_tokens == 111
+
+    def test_malformed_response_body_errors(self):
+        # response_test.go:62-87.
+        server = make_server([], {})
+        with pytest.raises(ProcessingError, match="unmarshaling"):
+            server.process(RequestContext(), ResponseBody(body=b"not json"))
