@@ -1,0 +1,23 @@
+"""Control plane: reconcilers keeping the datastore in sync with intent.
+
+Reference parity: the three controller-runtime reconcilers
+(``pkg/ext-proc/backend/{inferencepool,inferencemodel,endpointslice}_reconciler.go``)
+re-expressed as transport-independent ``update_datastore`` cores plus
+pluggable watch sources (file polling here; a k8s informer adapter slots into
+the same seam on GKE).  The reference's own tests call ``updateDatastore``
+directly (SURVEY.md §4) — ours do too.
+"""
+
+from llm_instance_gateway_tpu.gateway.controllers.reconcilers import (
+    Endpoint,
+    EndpointsReconciler,
+    InferenceModelReconciler,
+    InferencePoolReconciler,
+)
+
+__all__ = [
+    "Endpoint",
+    "EndpointsReconciler",
+    "InferenceModelReconciler",
+    "InferencePoolReconciler",
+]

@@ -1,0 +1,147 @@
+"""Watch sources: file-polling config watcher and health-probing membership.
+
+The k8s deployment uses informers; everywhere else these two sources drive
+the same reconcilers:
+
+- ``ConfigWatcher`` polls a multi-doc YAML of InferencePool/InferenceModel
+  documents (mtime-gated, like the sidecar's PollingObserver — the watchdog
+  package the reference uses isn't in this image, ``sidecar.py:247-252``).
+- ``EndpointProber`` turns a static endpoint list into *liveness-driven*
+  membership by probing each replica's ``/health``: the local equivalent of
+  EndpointSlice Ready conditions (``endpointslice_reconciler.go:107-111``),
+  so a dead replica leaves the scheduler pool within one probe interval
+  instead of serving stale metrics forever.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import urllib.request
+from dataclasses import dataclass
+
+import yaml
+
+from llm_instance_gateway_tpu.api import v1alpha1
+from llm_instance_gateway_tpu.gateway.controllers.reconcilers import (
+    Endpoint,
+    EndpointsReconciler,
+    InferenceModelReconciler,
+    InferencePoolReconciler,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ConfigWatcher:
+    def __init__(
+        self,
+        path: str,
+        pool_reconciler: InferencePoolReconciler,
+        model_reconciler: InferenceModelReconciler,
+        poll_interval_s: float = 2.0,
+    ):
+        self.path = path
+        self.pool_reconciler = pool_reconciler
+        self.model_reconciler = model_reconciler
+        self.poll_interval_s = poll_interval_s
+        self._mtime = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sync_once(self) -> bool:
+        """Parse + reconcile if the file changed; returns whether it did."""
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return False
+        if mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        try:
+            with open(self.path) as f:
+                docs = list(yaml.safe_load_all(f))
+            pools, models = v1alpha1.from_documents(docs)
+        except (OSError, yaml.YAMLError, ValueError) as e:
+            logger.error("config reload failed (keeping last good state): %s", e)
+            return False
+        for pool in pools:
+            self.pool_reconciler.reconcile(pool)
+        self.model_reconciler.resync(models)
+        logger.info("config synced: %d pools, %d models", len(pools), len(models))
+        return True
+
+    def start(self) -> None:
+        self.sync_once()
+
+        def loop():
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.sync_once()
+                except Exception:
+                    logger.exception("config watch error")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+@dataclass
+class StaticEndpoint:
+    name: str
+    address: str  # host:port of the serving endpoint
+    zone: str = ""
+
+
+class EndpointProber:
+    def __init__(
+        self,
+        endpoints: list[StaticEndpoint],
+        reconciler: EndpointsReconciler,
+        probe_interval_s: float = 5.0,
+        probe_timeout_s: float = 2.0,
+        health_path: str = "/health",
+    ):
+        self.endpoints = list(endpoints)
+        self.reconciler = reconciler
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.health_path = health_path
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _probe(self, ep: StaticEndpoint) -> bool:
+        url = f"http://{ep.address}{self.health_path}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.probe_timeout_s) as resp:
+                return resp.status == 200
+        except (OSError, urllib.error.URLError):
+            return False
+
+    def probe_once(self) -> list[Endpoint]:
+        results = [
+            Endpoint(name=ep.name, address=ep.address, ready=self._probe(ep),
+                     zone=ep.zone)
+            for ep in self.endpoints
+        ]
+        self.reconciler.reconcile(results)
+        return results
+
+    def start(self) -> None:
+        self.probe_once()
+
+        def loop():
+            while not self._stop.wait(self.probe_interval_s):
+                try:
+                    self.probe_once()
+                except Exception:
+                    logger.exception("endpoint probe error")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

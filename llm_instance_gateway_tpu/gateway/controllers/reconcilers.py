@@ -1,0 +1,118 @@
+"""Reconciler cores: pool, model, endpoint membership.
+
+Each reconciler owns one slice of datastore state and is driven by a watch
+source (``filewatch.ConfigWatcher`` locally, a k8s informer on GKE).  The
+semantics mirror the reference reconcilers line by line; citations inline.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu.api.v1alpha1 import InferenceModel, InferencePool
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+logger = logging.getLogger(__name__)
+
+
+class InferencePoolReconciler:
+    """inferencepool_reconciler.go:28-50: copy the watched pool into the
+    datastore, gated on name/namespace and ResourceVersion change."""
+
+    def __init__(self, datastore: Datastore, pool_name: str, namespace: str = "default"):
+        self.datastore = datastore
+        self.pool_name = pool_name
+        self.namespace = namespace
+
+    def reconcile(self, pool: InferencePool) -> bool:
+        if pool.name != self.pool_name or pool.namespace != self.namespace:
+            return False
+        try:
+            current = self.datastore.get_pool()
+            if current.resource_version == pool.resource_version:
+                return False  # ResourceVersion gate (:45-50)
+        except LookupError:
+            pass
+        self.datastore.set_pool(pool)
+        logger.info("updated InferencePool %s (rv %s)", pool.name, pool.resource_version)
+        return True
+
+
+class InferenceModelReconciler:
+    """inferencemodel_reconciler.go:23-55: store models whose PoolRef targets
+    our pool, delete those that stop targeting it (keyed by ModelName)."""
+
+    def __init__(self, datastore: Datastore, pool_name: str, namespace: str = "default"):
+        self.datastore = datastore
+        self.pool_name = pool_name
+        self.namespace = namespace
+
+    def reconcile(self, model: InferenceModel, deleted: bool = False) -> None:
+        if model.namespace != self.namespace:
+            return
+        targets_us = (
+            model.spec.pool_ref is not None
+            and model.spec.pool_ref.name == self.pool_name
+        )
+        if deleted or not targets_us:
+            # updateDatastore deletes when PoolRef moved away (:45-55).
+            self.datastore.delete_model(model.spec.model_name)
+            return
+        self.datastore.store_model(model)
+
+    def resync(self, models: list[InferenceModel]) -> None:
+        """Full-state reconcile for file sources (k8s gives us events; a file
+        gives us the whole desired state, so compute deletions by diff)."""
+        desired = {
+            m.spec.model_name: m
+            for m in models
+            if m.namespace == self.namespace
+            and m.spec.pool_ref is not None
+            and m.spec.pool_ref.name == self.pool_name
+        }
+        existing = {m.spec.model_name for m in self.datastore.all_models()}
+        for name in existing - set(desired):
+            self.datastore.delete_model(name)
+        for model in desired.values():
+            self.datastore.store_model(model)
+
+
+@dataclass
+class Endpoint:
+    """One replica endpoint (the EndpointSlice entry equivalent)."""
+
+    name: str
+    address: str  # host only or host:port; port filled from pool if absent
+    ready: bool = True
+    zone: str = ""
+
+
+class EndpointsReconciler:
+    """endpointslice_reconciler.go:33-111 equivalent: Ready (+zone-matching)
+    endpoints become scheduler pods at the pool's target port; stale pods are
+    removed.  Gated on pool availability (predicates :81-105)."""
+
+    def __init__(self, datastore: Datastore, zone: str = ""):
+        self.datastore = datastore
+        self.zone = zone
+
+    def _valid(self, ep: Endpoint) -> bool:
+        # validPod (:107-111): Ready, and zone-matching when a zone is set.
+        return ep.ready and (not self.zone or ep.zone == self.zone)
+
+    def reconcile(self, endpoints: list[Endpoint]) -> None:
+        if not self.datastore.has_synced_pool():
+            return  # pool gate (:41-48)
+        port = self.datastore.get_pool().spec.target_port_number
+        desired: dict[str, Pod] = {}
+        for ep in endpoints:
+            if not self._valid(ep):
+                continue
+            address = ep.address if ":" in ep.address else f"{ep.address}:{port}"
+            desired[ep.name] = Pod(name=ep.name, address=address)
+        for name in self.datastore.pod_names() - set(desired):
+            self.datastore.delete_pod(name)  # remove stale (:64-79)
+        for pod in desired.values():
+            self.datastore.store_pod(pod)

@@ -1,0 +1,17 @@
+"""JAX model definitions: pytree params, functional forwards, LoRA slots.
+
+The reference gateway routes to external vLLM servers and contains no model
+code (SURVEY.md §2); this package is the TPU-native equivalent of that
+delegated layer — the models the pool's replicas serve.  Pure-JAX pytrees
+(no framework modules) so pjit/GSPMD sharding specs apply directly.
+"""
+
+from llm_instance_gateway_tpu.models.configs import (
+    ModelConfig,
+    GEMMA_2B,
+    LLAMA3_8B,
+    TINY_TEST,
+    MIXTRAL_8X7B,
+)
+
+__all__ = ["ModelConfig", "GEMMA_2B", "LLAMA3_8B", "TINY_TEST", "MIXTRAL_8X7B"]

@@ -1,0 +1,141 @@
+"""Model architecture configs for the families the pools serve.
+
+The reference's PoC pools serve Llama-2-7b + LoRA on vLLM
+(``examples/poc/manifests/vllm/vllm-lora-deployment.yaml:23-60``); the
+BASELINE.json milestone configs call for Gemma-2B, Llama-3-8B and a
+Mixtral-8x7B + Gemma-7B mixed pool.  These dataclasses cover all of them with
+one decoder family (RoPE + GQA + RMSNorm + gated MLP, optionally MoE).
+
+All dims are chosen/padded TPU-first: head_dim and d_model multiples of 128
+(MXU lane width), d_ff multiples of 128, vocab padded to 128 so the final
+projection tiles cleanly onto the systolic array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+def pad_to(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    head_dim: int = 0  # 0 -> d_model // n_heads
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    # Gemma-style differences.
+    tie_embeddings: bool = False
+    embedding_scale: bool = False  # Gemma multiplies embeddings by sqrt(d_model)
+    norm_plus_one: bool = False  # Gemma RMSNorm uses (1 + w) weighting
+    gelu_mlp: bool = False  # Gemma uses GeLU gating; Llama uses SiLU
+    # MoE (Mixtral): 0 experts = dense.
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    # LoRA serving slots (compile-time constants: resizing reshapes buffers
+    # and recompiles, so they mirror vLLM's --max-loras / max rank flags).
+    max_lora_slots: int = 4
+    max_lora_rank: int = 16
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        return pad_to(self.vocab_size, 128)
+
+    def tiny(self) -> "ModelConfig":
+        """Shrink to test size, keeping structure (ratios, GQA, MoE-ness)."""
+        return replace(
+            self,
+            name=self.name + "-tiny",
+            # Covers the byte-level tokenizer (259 ids) so tiny models serve
+            # real text end-to-end.
+            vocab_size=320,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=max(1, self.n_kv_heads * 4 // self.n_heads),
+            d_ff=128,
+            head_dim=16,
+            max_seq_len=128,
+            max_lora_rank=4,
+        )
+
+
+LLAMA3_8B = ModelConfig(
+    name="llama3-8b",
+    vocab_size=128_256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14_336,
+    rope_theta=500_000.0,
+    max_seq_len=8192,
+)
+
+GEMMA_2B = ModelConfig(
+    name="gemma-2b",
+    vocab_size=256_128,
+    d_model=2048,
+    n_layers=18,
+    n_heads=8,
+    n_kv_heads=1,
+    d_ff=16_384,
+    head_dim=256,
+    rope_theta=10_000.0,
+    tie_embeddings=True,
+    embedding_scale=True,
+    norm_plus_one=True,
+    gelu_mlp=True,
+    max_seq_len=8192,
+)
+
+GEMMA_7B = ModelConfig(
+    name="gemma-7b",
+    vocab_size=256_128,
+    d_model=3072,
+    n_layers=28,
+    n_heads=16,
+    n_kv_heads=16,
+    head_dim=256,
+    d_ff=24_576,
+    rope_theta=10_000.0,
+    tie_embeddings=True,
+    embedding_scale=True,
+    norm_plus_one=True,
+    gelu_mlp=True,
+    max_seq_len=8192,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32_000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14_336,
+    rope_theta=1_000_000.0,
+    n_experts=8,
+    n_experts_per_token=2,
+    max_seq_len=32_768,
+)
+
+TINY_TEST = LLAMA3_8B.tiny()
+TINY_MOE_TEST = MIXTRAL_8X7B.tiny()

@@ -1,0 +1,22 @@
+"""Gemma family entry points (2B/7B): tied embeddings, sqrt(d) embedding
+scale, (1+w) RMSNorm, GeLU gating, MQA (2B) — all handled by config flags in
+``models.transformer``.  BASELINE.json's smoke-test config is Gemma-2B on
+v5e-1.
+"""
+
+from __future__ import annotations
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import GEMMA_2B, GEMMA_7B
+
+CONFIGS = {
+    "gemma-2b": GEMMA_2B,
+    "gemma-7b": GEMMA_7B,
+    "gemma-tiny": GEMMA_2B.tiny(),
+}
+
+init_params = transformer.init_params
+init_decode_cache = transformer.init_decode_cache
+insert_prefill = transformer.insert_prefill
+prefill = transformer.prefill
+decode_step = transformer.decode_step

@@ -1,0 +1,36 @@
+"""Llama-3 family entry points.
+
+The flagship family: the reference PoC pool serves Llama-2-7b + LoRA
+(``examples/poc/manifests/vllm/vllm-lora-deployment.yaml:23-37``), and the
+BASELINE.json scale-out configs are 4x Llama-3-8B.  All heavy lifting lives
+in ``models.transformer``; this module binds the config family.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import LLAMA3_8B, TINY_TEST, ModelConfig
+
+CONFIGS = {"llama3-8b": LLAMA3_8B, "llama3-tiny": TINY_TEST}
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None):
+    kwargs = {} if dtype is None else {"dtype": dtype}
+    return transformer.init_params(cfg, key, **kwargs)
+
+
+init_decode_cache = transformer.init_decode_cache
+insert_prefill = transformer.insert_prefill
+
+
+def prefill_fn(cfg: ModelConfig):
+    """Jittable prefill closure (config static)."""
+    return functools.partial(transformer.prefill, cfg)
+
+
+def decode_fn(cfg: ModelConfig):
+    return functools.partial(transformer.decode_step, cfg)

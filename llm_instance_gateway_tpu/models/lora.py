@@ -1,0 +1,163 @@
+"""Multi-LoRA serving slots: padded adapter buffers with no-recompile swap.
+
+The reference multiplexes LoRA adapters on vLLM via HTTP hot-load
+(``tools/dynamic-lora-sidecar/sidecar/sidecar.py:177-213``) with CUDA-side
+slot limits (``--max-loras 4``).  On TPU the equivalent must dodge XLA's
+recompile-on-shape-change (SURVEY.md §7 "hard parts"): adapters live in
+PRE-ALLOCATED buffers of compile-time shape ``[n_layers, n_slots, d,
+r_max]`` — loading an adapter is a pure device-buffer donation
+(``buffers.at[:, slot].set(...)``), never a new program.
+
+Adapters with rank r < r_max are zero-padded: padded lanes contribute exactly
+0 to the delta, so correctness is rank-independent.  Per-slot ``scale`` holds
+alpha/r.  Slot id -1 means "no adapter" (one_hot(-1) == 0 vector -> delta 0),
+which lets base-model and adapter requests share one decode batch — the
+multiplexing the gateway's LoRA-affinity routing assumes.
+
+Targets: the attention projections q/k/v/o and the MLP gate/up/down, matching
+what vLLM serves for Llama-family adapters.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TARGETS = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def target_dims(cfg) -> dict[str, tuple[int, int]]:
+    """(d_in, d_out) per LoRA target for this architecture."""
+    hd = cfg.resolved_head_dim
+    d = cfg.d_model
+    return {
+        "q": (d, cfg.n_heads * hd),
+        "k": (d, cfg.n_kv_heads * hd),
+        "v": (d, cfg.n_kv_heads * hd),
+        "o": (cfg.n_heads * hd, d),
+        "gate": (d, cfg.d_ff),
+        "up": (d, cfg.d_ff),
+        "down": (cfg.d_ff, d),
+    }
+
+
+def init_lora_buffers(cfg, dtype=jnp.bfloat16) -> dict[str, Any]:
+    """All-zero slot buffers (zero delta == base model for every slot)."""
+    dims = target_dims(cfg)
+    bufs: dict[str, Any] = {"scale": jnp.zeros((cfg.max_lora_slots,), jnp.float32)}
+    for t in TARGETS:
+        d_in, d_out = dims[t]
+        bufs[f"{t}_a"] = jnp.zeros(
+            (cfg.n_layers, cfg.max_lora_slots, d_in, cfg.max_lora_rank), dtype
+        )
+        bufs[f"{t}_b"] = jnp.zeros(
+            (cfg.n_layers, cfg.max_lora_slots, cfg.max_lora_rank, d_out), dtype
+        )
+    return bufs
+
+
+def load_adapter(
+    bufs: dict[str, Any],
+    cfg,
+    slot: int,
+    adapter: dict[str, Any],
+    alpha: float,
+    rank: int,
+) -> dict[str, Any]:
+    """Write an adapter into ``slot`` (host-side; returns updated buffers).
+
+    ``adapter`` maps target -> {"a": [n_layers, d_in, r], "b": [n_layers, r,
+    d_out]} with r <= max_lora_rank; missing targets stay zero.  Scale
+    alpha/r is folded into the per-slot scale vector.
+    """
+    if not 0 <= slot < cfg.max_lora_slots:
+        raise ValueError(f"slot {slot} out of range [0, {cfg.max_lora_slots})")
+    if rank > cfg.max_lora_rank:
+        raise ValueError(f"rank {rank} exceeds max_lora_rank {cfg.max_lora_rank}")
+    dims = target_dims(cfg)
+    out = dict(bufs)
+    for t in TARGETS:
+        d_in, d_out = dims[t]
+        a_buf = np.zeros((cfg.n_layers, d_in, cfg.max_lora_rank), np.float32)
+        b_buf = np.zeros((cfg.n_layers, cfg.max_lora_rank, d_out), np.float32)
+        if t in adapter:
+            a = np.asarray(adapter[t]["a"], np.float32)
+            b = np.asarray(adapter[t]["b"], np.float32)
+            if a.shape != (cfg.n_layers, d_in, rank):
+                raise ValueError(f"{t}.a shape {a.shape} != {(cfg.n_layers, d_in, rank)}")
+            if b.shape != (cfg.n_layers, rank, d_out):
+                raise ValueError(f"{t}.b shape {b.shape} != {(cfg.n_layers, rank, d_out)}")
+            a_buf[:, :, :rank] = a
+            b_buf[:, :rank, :] = b
+        dtype = out[f"{t}_a"].dtype
+        out[f"{t}_a"] = out[f"{t}_a"].at[:, slot].set(jnp.asarray(a_buf, dtype))
+        out[f"{t}_b"] = out[f"{t}_b"].at[:, slot].set(jnp.asarray(b_buf, dtype))
+    out["scale"] = out["scale"].at[slot].set(alpha / rank)
+    return out
+
+
+def unload_adapter(bufs: dict[str, Any], cfg, slot: int) -> dict[str, Any]:
+    """Zero a slot (slot becomes base-model passthrough)."""
+    out = dict(bufs)
+    for t in TARGETS:
+        out[f"{t}_a"] = out[f"{t}_a"].at[:, slot].set(0.0)
+        out[f"{t}_b"] = out[f"{t}_b"].at[:, slot].set(0.0)
+    out["scale"] = out["scale"].at[slot].set(0.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Application inside the forward pass.  ``layer_bufs`` is the per-layer slice
+# {t_a: [n_slots, d_in, r], t_b: [n_slots, r, d_out], scale: [n_slots]}.
+# ---------------------------------------------------------------------------
+
+
+def lora_delta(
+    x: jax.Array,          # [B, S, d_in] or [B, d_in]
+    a: jax.Array,          # [n_slots, d_in, r]
+    b: jax.Array,          # [n_slots, r, d_out]
+    scale: jax.Array,      # [n_slots]
+    slot_ids: jax.Array,   # [B] int32, -1 = no adapter
+) -> jax.Array:
+    """Per-row multi-adapter delta: scale[s] * (x @ a[s]) @ b[s], s=slot_ids[row].
+
+    Implemented with a one-hot mix instead of gather: ``one_hot`` rows for
+    slot -1 are all-zero, giving an exact 0 delta for base-model rows, and the
+    mixing contraction is a small matmul the MXU handles natively (n_slots is
+    4-8; the r-rank matmuls dominate and stay tiny).
+    """
+    n_slots = a.shape[0]
+    onehot = jax.nn.one_hot(slot_ids, n_slots, dtype=x.dtype)  # [B, n_slots]
+    a_sel = jnp.einsum("bs,sir->bir", onehot, a)  # [B, d_in, r]
+    b_sel = jnp.einsum("bs,sro->bro", onehot, b)  # [B, r, d_out]
+    s_sel = (onehot.astype(jnp.float32) @ scale).astype(x.dtype)  # [B]
+    if x.ndim == 3:
+        mid = jnp.einsum("bsi,bir->bsr", x, a_sel)
+        delta = jnp.einsum("bsr,bro->bso", mid, b_sel)
+        return delta * s_sel[:, None, None]
+    mid = jnp.einsum("bi,bir->br", x, a_sel)
+    delta = jnp.einsum("br,bro->bo", mid, b_sel)
+    return delta * s_sel[:, None]
+
+
+def layer_slice(bufs: dict[str, Any], layer: jax.Array | int) -> dict[str, Any]:
+    """Per-layer view for use inside lax.scan over layers."""
+    out = {"scale": bufs["scale"]}
+    for t in TARGETS:
+        out[f"{t}_a"] = jax.lax.dynamic_index_in_dim(
+            bufs[f"{t}_a"], layer, axis=0, keepdims=False
+        )
+        out[f"{t}_b"] = jax.lax.dynamic_index_in_dim(
+            bufs[f"{t}_b"], layer, axis=0, keepdims=False
+        )
+    return out
+
+
+def stack_for_scan(bufs: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
+    """Split buffers into (per-layer stacked pytree, broadcast pytree) for scan."""
+    per_layer = {k: v for k, v in bufs.items() if k != "scale"}
+    broadcast = {"scale": bufs["scale"]}
+    return per_layer, broadcast

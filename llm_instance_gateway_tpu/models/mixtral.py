@@ -1,0 +1,20 @@
+"""Mixtral family entry points (8x7B MoE): top-2 routed experts per token.
+
+BASELINE.json's criticality-tiered mixed pool pairs Mixtral-8x7B with
+Gemma-7B on v5e-32.  The MoE MLP lives in ``transformer._moe_mlp``; expert
+weights carry a leading expert axis that ``parallel.sharding`` maps onto the
+mesh's expert/tensor axes.
+"""
+
+from __future__ import annotations
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import MIXTRAL_8X7B, TINY_MOE_TEST
+
+CONFIGS = {"mixtral-8x7b": MIXTRAL_8X7B, "mixtral-tiny": TINY_MOE_TEST}
+
+init_params = transformer.init_params
+init_decode_cache = transformer.init_decode_cache
+insert_prefill = transformer.insert_prefill
+prefill = transformer.prefill
+decode_step = transformer.decode_step

@@ -1,0 +1,268 @@
+"""Core decoder-only transformer: one implementation, three families.
+
+Covers Llama-3 (RoPE+GQA+SwiGLU), Gemma (tied embeddings, sqrt(d) embedding
+scale, GeLU gate, (1+w) RMSNorm, shared KV head) and Mixtral (top-k MoE MLP)
+via ``ModelConfig`` flags — the families the pool configs in BASELINE.json
+serve.
+
+TPU-first structure:
+- Parameters are stacked over layers (``[n_layers, ...]`` leaves) and the
+  forward runs ``lax.scan`` over them: one layer gets traced/compiled once,
+  not n_layers times, and pjit shards every layer identically.
+- The decode path is a fixed-shape step function: batch = the engine's decode
+  slots, cache = ``[n_layers, B, S_max, n_kv, hd]``; one compilation serves
+  the entire serving lifetime (XLA recompile storms are the TPU-serving
+  failure mode the design avoids, SURVEY.md §7).
+- bfloat16 params/activations, f32 softmax/norms, f32 logits.
+- Multi-LoRA deltas (``models.lora``) apply to every projection with per-row
+  slot ids, so one decode batch multiplexes adapters + base model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from llm_instance_gateway_tpu.models import lora as lora_lib
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+from llm_instance_gateway_tpu.ops.attention import decode_attention, prefill_attention
+from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm, swiglu
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    hd = cfg.resolved_head_dim
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.padded_vocab
+    n_l = cfg.n_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+    layers: Params = {
+        "attn_norm": jnp.ones((n_l, d), dtype),
+        "mlp_norm": jnp.ones((n_l, d), dtype),
+        "wq": dense(next(keys), (n_l, d, cfg.n_heads * hd), d),
+        "wk": dense(next(keys), (n_l, d, cfg.n_kv_heads * hd), d),
+        "wv": dense(next(keys), (n_l, d, cfg.n_kv_heads * hd), d),
+        "wo": dense(next(keys), (n_l, cfg.n_heads * hd, d), cfg.n_heads * hd),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        layers["router"] = dense(next(keys), (n_l, d, e), d)
+        layers["w_gate"] = dense(next(keys), (n_l, e, d, f), d)
+        layers["w_up"] = dense(next(keys), (n_l, e, d, f), d)
+        layers["w_down"] = dense(next(keys), (n_l, e, f, d), f)
+    else:
+        layers["w_gate"] = dense(next(keys), (n_l, d, f), d)
+        layers["w_up"] = dense(next(keys), (n_l, d, f), d)
+        layers["w_down"] = dense(next(keys), (n_l, f, d), f)
+
+    params: Params = {
+        "embed": (jax.random.normal(next(keys), (v, d), jnp.float32) * 0.02).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, v), d)
+    return params
+
+
+def init_decode_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+) -> Params:
+    hd = cfg.resolved_head_dim
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, hd)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def _project(x, w, layer_lora, target, slot_ids):
+    """x @ w plus the per-row LoRA delta for ``target``."""
+    out = x @ w
+    if layer_lora is not None:
+        out = out + lora_lib.lora_delta(
+            x,
+            layer_lora[f"{target}_a"],
+            layer_lora[f"{target}_b"],
+            layer_lora["scale"],
+            slot_ids,
+        )
+    return out
+
+
+def _mlp(cfg: ModelConfig, lp: Params, x, layer_lora, slot_ids):
+    if cfg.n_experts:
+        return _moe_mlp(cfg, lp, x)
+    gate = _project(x, lp["w_gate"], layer_lora, "gate", slot_ids)
+    up = _project(x, lp["w_up"], layer_lora, "up", slot_ids)
+    return _project(swiglu(gate, up, cfg.gelu_mlp), lp["w_down"], layer_lora, "down", slot_ids)
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Params, x):
+    """Top-k mixture-of-experts MLP (Mixtral style).
+
+    v0 strategy: compute every expert and mix by the (renormalized) top-k
+    gate weights.  FLOP-inflated by n_experts/k but shape-static and
+    trivially shardable over an expert axis; the dropless dispatch kernel is
+    a later ops/ optimization.  LoRA is not applied to expert weights
+    (matching vLLM, which targets attention + dense MLP only).
+    """
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
+    e = cfg.n_experts
+    topv, topi = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
+    gates = jax.nn.softmax(topv, axis=-1)  # renormalize over selected experts
+    # Scatter gate weights back to a dense [..., E] mix vector.
+    dense_gates = jnp.sum(
+        jax.nn.one_hot(topi, e, dtype=jnp.float32) * gates[..., None], axis=-2
+    )  # [..., E]
+    hidden = jnp.einsum("...d,edf->...ef", x, lp["w_gate"])
+    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
+    act = swiglu(hidden, up, cfg.gelu_mlp)
+    per_expert = jnp.einsum("...ef,efd->...ed", act, lp["w_down"])
+    return jnp.einsum("...ed,...e->...d", per_expert, dense_gates.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+
+def prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,       # [B, S] int32
+    positions: jax.Array,    # [B, S] int32 (right-padded prompts: 0..len-1)
+    lora_bufs: Params | None = None,
+    slot_ids: jax.Array | None = None,  # [B] int32, -1 = base model
+):
+    """Full-prompt forward.  Returns (logits [B,S,V] f32, k [L,B,S,K,hd], v)."""
+    b, s = tokens.shape
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    h = params["embed"][tokens]  # activation dtype follows param dtype
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, bcast = lora_lib.stack_for_scan(lora_bufs)
+
+    def layer_fn(h, xs):
+        lp, ll = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        hd = cfg.resolved_head_dim
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, s, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = prefill_attention(q, k, v, positions)
+        h = h + _project(attn.reshape(b, s, -1), lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k, v)
+
+    xs = (params["layers"], per_layer_lora)
+    h, (k_all, v_all) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    return logits, k_all, v_all
+
+
+# ---------------------------------------------------------------------------
+# Decode step
+# ---------------------------------------------------------------------------
+
+
+def decode_step(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,           # init_decode_cache layout
+    tokens: jax.Array,       # [B] int32 — current token per slot
+    positions: jax.Array,    # [B] int32 — position of ``tokens``
+    lora_bufs: Params | None = None,
+    slot_ids: jax.Array | None = None,
+):
+    """One decode step for every slot.  Returns (logits [B,V] f32, new cache).
+
+    Inactive slots simply decode garbage into their own lane (masked out by
+    the engine); lockstep batching keeps the step shape-static.
+    """
+    b = tokens.shape[0]
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    h = params["embed"][tokens]  # [B, D]; activation dtype follows param dtype
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    lengths = positions + 1
+    batch_idx = jnp.arange(b)
+
+    def layer_fn(h, xs):
+        lp, ll, k_cache, v_cache = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        hd = cfg.resolved_head_dim
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+        k_cache = k_cache.at[batch_idx, positions].set(k)
+        v_cache = v_cache.at[batch_idx, positions].set(v)
+        attn = decode_attention(q, k_cache, v_cache, lengths)
+        h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_cache, v_cache)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "length": lengths}
+    return logits, new_cache
+
+
+def insert_prefill(
+    cache: Params,
+    k_prompt: jax.Array,  # [L, 1, S, K, hd] from prefill
+    v_prompt: jax.Array,
+    slot: jax.Array | int,
+    length: jax.Array | int,
+) -> Params:
+    """Insert a prefilled sequence's KV into a decode slot (JetStream-style
+    prefill->insert->generate).  ``length`` is the true prompt length; the
+    padded tail beyond it is garbage but masked by ``cache['length']``.
+    """
+    s = k_prompt.shape[2]
+    k = cache["k"]
+    v = cache["v"]
+    k = jax.lax.dynamic_update_slice(k, k_prompt.astype(k.dtype), (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(v, v_prompt.astype(v.dtype), (0, slot, 0, 0, 0))
+    length_vec = cache["length"].at[slot].set(length)
+    return {"k": k, "v": v, "length": length_vec}

@@ -1,0 +1,6 @@
+"""Core numeric ops: norms, rotary embeddings, attention, LoRA deltas.
+
+XLA-level reference implementations live here (the compiler fuses these
+aggressively on TPU); hand-written Pallas kernels for the genuinely
+bandwidth-bound paths live in ``ops.pallas`` with CPU-safe fallbacks.
+"""

@@ -1,0 +1,80 @@
+"""Attention ops: GQA prefill (causal) and cached decode, XLA reference path.
+
+Shapes follow the grouped-query layout throughout: queries [.., n_kv, q_per_kv,
+head_dim] so the KV heads never need materialized repetition (a bf16
+``jnp.repeat`` of KV to 32 heads would burn HBM bandwidth for nothing — the
+einsum contracts directly against the grouped axis and XLA tiles it onto the
+MXU).
+
+Softmax runs in f32 with max-subtraction.  The Pallas flash/paged kernels in
+``ops.pallas`` are drop-in replacements for long context on real TPU; these
+XLA versions are the correctness reference and the CPU test path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def _grouped(q: jax.Array, n_kv_heads: int) -> jax.Array:
+    """[.., n_heads, hd] -> [.., n_kv, q_per_kv, hd]."""
+    *lead, n_heads, hd = q.shape
+    return q.reshape(*lead, n_kv_heads, n_heads // n_kv_heads, hd)
+
+
+def prefill_attention(
+    q: jax.Array,  # [B, S, n_heads, hd]
+    k: jax.Array,  # [B, S, n_kv, hd]
+    v: jax.Array,  # [B, S, n_kv, hd]
+    positions: jax.Array | None = None,  # [B, S] for packed/padded masking
+) -> jax.Array:
+    """Causal self-attention over a full prompt.  Returns [B, S, n_heads, hd].
+
+    With ``positions`` given, token i attends to j iff positions[j] <=
+    positions[i] AND j <= i — correct for right-padded and left-packed
+    batches alike.
+    """
+    b, s, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    qg = _grouped(q, n_kv)  # [B,S,K,G,hd]
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    # [B,K,G,S,S]
+    logits = jnp.einsum("bikgh,bjkh->bkgij", qg, k, preferred_element_type=jnp.float32)
+    logits *= scale
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    mask = causal[None, None, None]
+    if positions is not None:
+        valid = positions[:, None, :] <= positions[:, :, None]  # [B,S_i,S_j]
+        mask = mask & valid[:, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgij,bjkh->bikgh", probs, v)
+    return out.reshape(b, s, n_heads, hd)
+
+
+def decode_attention(
+    q: jax.Array,        # [B, n_heads, hd] — one new token per sequence
+    k_cache: jax.Array,  # [B, S_max, n_kv, hd]
+    v_cache: jax.Array,  # [B, S_max, n_kv, hd]
+    lengths: jax.Array,  # [B] valid tokens per sequence (including current)
+) -> jax.Array:
+    """Single-step cached attention.  Returns [B, n_heads, hd].
+
+    Reads the whole static-shaped cache and masks positions >= lengths —
+    no dynamic shapes, so one compilation serves every step.  This read is
+    the HBM-bound hot loop of decode; the Pallas paged kernel replaces it
+    on TPU for large S_max.
+    """
+    b, s_max, n_kv, hd = k_cache.shape
+    qg = _grouped(q, n_kv)  # [B,K,G,hd]
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    logits = jnp.einsum("bkgh,bskh->bkgs", qg, k_cache, preferred_element_type=jnp.float32)
+    logits *= scale
+    valid = jnp.arange(s_max)[None] < lengths[:, None]  # [B,S]
+    logits = jnp.where(valid[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskh->bkgh", probs, v_cache)
+    return out.reshape(b, n_kv * (q.shape[1] // n_kv), hd)

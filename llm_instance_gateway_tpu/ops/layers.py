@@ -1,0 +1,53 @@
+"""Elementwise/normalization building blocks (XLA-fused on TPU).
+
+These are deliberately *not* Pallas: RMSNorm and RoPE are elementwise chains
+that XLA fuses into the surrounding matmuls for free; a hand kernel would only
+forfeit fusion.  Accumulations run in float32 and cast back to the activation
+dtype (bfloat16 on TPU), the standard mixed-precision discipline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
+             plus_one: bool = False) -> jax.Array:
+    """RMSNorm in f32.  ``plus_one`` selects the Gemma (1+w) convention."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if plus_one:
+        w = 1.0 + w
+    return (normed * w).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    """Inverse frequencies for rotary embedding, shape [head_dim//2], f32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding.
+
+    x: [..., seq, heads, head_dim]; positions: broadcastable to [..., seq].
+    Uses the split-halves convention (Llama/NeoX style): pairs (x_i, x_{i+d/2}).
+    Computed in f32, cast back — sin/cos precision matters at long context.
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_frequencies(head_dim, theta)  # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(gate: jax.Array, up: jax.Array, gelu: bool = False) -> jax.Array:
+    """Gated MLP activation: SiLU (Llama/Mixtral) or tanh-GeLU (Gemma)."""
+    act = jax.nn.gelu(gate, approximate=True) if gelu else jax.nn.silu(gate)
+    return act * up

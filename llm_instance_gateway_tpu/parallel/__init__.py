@@ -1,0 +1,13 @@
+"""Distributed execution: device meshes, GSPMD shardings, ring attention.
+
+The reference's only parallelism is request-level DP across replica pods
+(SURVEY.md §2.5); everything intra-model was delegated to vLLM.  This package
+owns that layer for TPU: a named-axis mesh (data/fsdp/tensor/expert/sequence),
+PartitionSpecs for every model family, XLA-collective-based sequence
+parallelism (ring attention) for long context, and multi-host initialization
+over ICI/DCN.
+"""
+
+from llm_instance_gateway_tpu.parallel.mesh import MeshConfig, make_mesh
+
+__all__ = ["MeshConfig", "make_mesh"]

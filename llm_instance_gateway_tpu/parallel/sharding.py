@@ -1,0 +1,116 @@
+"""PartitionSpecs for model params, KV caches, LoRA buffers, and activations.
+
+The GSPMD recipe (scaling-book style): annotate shardings on the jit
+boundary, let XLA insert the collectives.  Megatron-style tensor parallelism
+for the decoder: column-shard the up-projections (heads / ffn columns),
+row-shard the down-projections, so each layer needs exactly one
+reduce(-scatter) on the attention output and one on the MLP output — both
+riding ICI.
+
+Weights additionally shard over ``fsdp`` on their non-tensor dim (zero-cost
+when fsdp=1).  KV caches shard heads over ``tensor`` and batch over ``data``.
+LoRA buffers shard ``b`` (rank -> d_out) over ``tensor`` on d_out and keep
+``a`` replicated (rank dims are tiny); the delta then composes with the
+column-sharded base projection without extra collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_instance_gateway_tpu.models import lora as lora_lib
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+
+
+def param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    """PartitionSpec pytree matching ``transformer.init_params`` layout."""
+    layers: dict[str, Any] = {
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        # [L, D, H*hd]: column-shard heads over tensor, D over fsdp.
+        "wq": P(None, "fsdp", "tensor"),
+        "wk": P(None, "fsdp", "tensor"),
+        "wv": P(None, "fsdp", "tensor"),
+        # [L, H*hd, D]: row-shard (same tensor axis contracts away).
+        "wo": P(None, "tensor", "fsdp"),
+    }
+    if cfg.n_experts:
+        layers.update(
+            {
+                "router": P(None, None, None),
+                # [L, E, D, F]: experts over expert axis, ffn over tensor.
+                "w_gate": P(None, "expert", "fsdp", "tensor"),
+                "w_up": P(None, "expert", "fsdp", "tensor"),
+                "w_down": P(None, "expert", "tensor", "fsdp"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": P(None, "fsdp", "tensor"),
+                "w_up": P(None, "fsdp", "tensor"),
+                "w_down": P(None, "tensor", "fsdp"),
+            }
+        )
+    specs: dict[str, Any] = {
+        # [V, D]: vocab over tensor (embedding lookups all-gather a slice;
+        # the final projection contracts D and psums over tensor).
+        "embed": P("tensor", None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("fsdp", "tensor")
+    return specs
+
+
+def cache_specs(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> dict[str, Any]:
+    """Decode cache [L, B, S, K, hd]: batch over data, KV heads over tensor.
+
+    MQA/GQA caches whose kv-head count doesn't divide the tensor axis (e.g.
+    Gemma-2B's single KV head on a tensor=4 mesh) replicate the head dim —
+    the attention einsums then read the replicated cache and XLA partitions
+    on the query heads instead.
+    """
+    head_axis: str | None = "tensor"
+    if cfg is not None and mesh is not None:
+        if cfg.n_kv_heads % mesh.shape["tensor"] != 0:
+            head_axis = None
+    kv = P(None, "data", None, head_axis, None)
+    return {"k": kv, "v": kv, "length": P("data")}
+
+
+def lora_specs(cfg: ModelConfig) -> dict[str, Any]:
+    specs: dict[str, Any] = {"scale": P(None)}
+    for t in lora_lib.TARGETS:
+        # a: [L, S, d_in, r] replicated (tiny); b: [L, S, r, d_out] column-
+        # sharded to match the base projection's output sharding.
+        specs[f"{t}_a"] = P(None, None, None, None)
+        specs[f"{t}_b"] = P(None, None, None, "tensor")
+    # Row-sharded targets contract d_out == D over fsdp instead.
+    specs["o_b"] = P(None, None, None, "fsdp")
+    specs["down_b"] = P(None, None, None, "fsdp")
+    return specs
+
+
+def activation_specs() -> dict[str, Any]:
+    return {
+        "tokens_2d": P("data", "sequence"),   # [B, S]
+        "tokens_1d": P("data"),               # [B]
+        "logits_prefill": P("data", "sequence", "tensor"),
+        "logits_decode": P("data", "tensor"),
+    }
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """device_put a pytree with NamedShardings from a matching spec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,6 @@
+"""TPU model server: continuous-batching engine, KV management, HTTP API.
+
+This is the replica the gateway routes to — the JetStream/MaxText-equivalent
+the reference delegates to vLLM (SURVEY.md §2: "the TPU-native framework's
+native layer is the model-server side we must supply").
+"""

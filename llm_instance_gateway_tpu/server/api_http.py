@@ -1,0 +1,305 @@
+"""Model-server HTTP API: OpenAI-style inference + admin + metrics.
+
+The surface the gateway (and the LoRA sidecar) expects from a pool replica —
+the union of what vLLM exposed to the reference:
+
+- ``POST /v1/completions``        OpenAI completions (prompt string or token ids)
+- ``POST /v1/chat/completions``   chat shim (concatenates message contents)
+- ``GET  /v1/models``             base model + resident adapters (sidecar diff
+                                  source, ``sidecar.py:140-155``)
+- ``POST /v1/load_lora_adapter``  ``{"lora_name": ..., "lora_path": ...}``
+                                  (vLLM-compatible field names, sidecar.py:177-195)
+- ``POST /v1/unload_lora_adapter`` ``{"lora_name": ...}``
+- ``GET  /metrics``               tpu:* exposition (gateway scrape contract)
+- ``GET  /health``                200 once the engine loop is up
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import queue as queue_mod
+import time
+
+from aiohttp import web
+
+from llm_instance_gateway_tpu.server import metrics as metrics_mod
+from llm_instance_gateway_tpu.server.engine import Engine, Request, SamplingParams
+from llm_instance_gateway_tpu.server.lora_manager import AdapterError, LoRAManager
+from llm_instance_gateway_tpu.server.tokenizer import load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class ModelServer:
+    def __init__(self, engine: Engine, tokenizer, model_name: str,
+                 lora_manager: LoRAManager | None = None):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.lora = lora_manager
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_post("/v1/load_lora_adapter", self.handle_load_adapter)
+        app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/health", self.handle_health)
+        return app
+
+    # -- helpers -----------------------------------------------------------
+    def _resolve_model(self, requested: str) -> str | None:
+        """Adapter name if the request targets a resident adapter, else None
+        (base model).  Unknown names raise AdapterError -> 404, matching
+        vLLM's behavior the sidecar relies on."""
+        if requested in (self.model_name, "", None):
+            return None
+        if self.lora is not None and requested in self.lora.running_adapters():
+            return requested
+        raise AdapterError(f"model {requested!r} is not served by this replica")
+
+    def _encode_prompt(self, body: dict) -> list[int]:
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return list(prompt)  # pre-tokenized
+        if isinstance(prompt, list):
+            prompt = " ".join(str(p) for p in prompt)
+        return self.tokenizer.encode(str(prompt))
+
+    def _make_request(self, body: dict, prompt_tokens: list[int], adapter) -> Request:
+        return Request(
+            prompt_tokens=prompt_tokens,
+            max_new_tokens=int(body.get("max_tokens", 64)),
+            sampling=SamplingParams(
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+            ),
+            adapter=adapter,
+        )
+
+    async def _run(self, req: Request) -> Request:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.engine.generate, req)
+
+    # -- inference ---------------------------------------------------------
+    async def handle_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        try:
+            adapter = self._resolve_model(body.get("model", self.model_name))
+        except AdapterError as e:
+            return _err(404, str(e))
+        prompt_tokens = self._encode_prompt(body)
+        req = self._make_request(body, prompt_tokens, adapter)
+        try:
+            req = await self._run(req)
+        except ValueError as e:
+            return _err(400, str(e))
+        except queue_mod.Full:
+            # Backpressure the gateway cleanly; its scheduler already sees the
+            # queue depth via /metrics and will shed/redirect.
+            return _err(429, "prefill queue is full")
+        if req.error:
+            return _err(500, req.error)
+        text = self.tokenizer.decode(req.output_tokens)
+        return web.json_response({
+            "id": f"cmpl-{req.request_id}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [{
+                "index": 0,
+                "text": text,
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(req.prompt_tokens),
+                "completion_tokens": len(req.output_tokens),
+                "total_tokens": len(req.prompt_tokens) + len(req.output_tokens),
+            },
+            "ttft_ms": round(req.ttft_s * 1000, 2),
+        })
+
+    async def handle_chat(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        messages = body.get("messages", [])
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
+        ) + "\nassistant:"
+        try:
+            adapter = self._resolve_model(body.get("model", self.model_name))
+        except AdapterError as e:
+            return _err(404, str(e))
+        req = self._make_request(body, self.tokenizer.encode(prompt), adapter)
+        try:
+            req = await self._run(req)
+        except ValueError as e:
+            return _err(400, str(e))
+        except queue_mod.Full:
+            return _err(429, "prefill queue is full")
+        if req.error:
+            return _err(500, req.error)
+        return web.json_response({
+            "id": f"chatcmpl-{req.request_id}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": self.tokenizer.decode(req.output_tokens)},
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(req.prompt_tokens),
+                "completion_tokens": len(req.output_tokens),
+                "total_tokens": len(req.prompt_tokens) + len(req.output_tokens),
+            },
+        })
+
+    # -- admin -------------------------------------------------------------
+    async def handle_models(self, request: web.Request) -> web.Response:
+        data = [{"id": self.model_name, "object": "model", "root": self.model_name}]
+        if self.lora is not None:
+            data += [
+                {"id": name, "object": "model", "root": self.model_name,
+                 "parent": self.model_name}
+                for name in self.lora.running_adapters()
+            ]
+        return web.json_response({"object": "list", "data": data})
+
+    async def handle_load_adapter(self, request: web.Request) -> web.Response:
+        if self.lora is None:
+            return _err(400, "LoRA serving is not enabled")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        name = body.get("lora_name")
+        path = body.get("lora_path")
+        if not name or not path:
+            return _err(400, "lora_name and lora_path are required")
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: self.lora.load(name, checkpoint_path=path)
+            )
+        except AdapterError as e:
+            return _err(409, str(e))
+        except Exception as e:
+            logger.exception("adapter load failed")
+            return _err(500, f"failed to load adapter: {e}")
+        return web.json_response({"status": "ok", "loaded": name})
+
+    async def handle_unload_adapter(self, request: web.Request) -> web.Response:
+        if self.lora is None:
+            return _err(400, "LoRA serving is not enabled")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        name = body.get("lora_name")
+        if not name:
+            return _err(400, "lora_name is required")
+        removed = self.lora.unload(name)
+        if not removed:
+            return _err(404, f"adapter {name!r} not loaded")
+        return web.json_response({"status": "ok", "unloaded": name})
+
+    # -- ops ---------------------------------------------------------------
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        snap = self.engine.metrics_snapshot()
+        return web.Response(
+            text=metrics_mod.render(snap), content_type="text/plain"
+        )
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}},
+        status=status,
+    )
+
+
+def main(argv=None) -> None:
+    import jax.numpy as jnp
+    import jax
+
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.models.configs import ModelConfig
+    from llm_instance_gateway_tpu.models import llama, gemma, mixtral
+    from llm_instance_gateway_tpu.server.engine import EngineConfig
+
+    all_configs: dict[str, ModelConfig] = {}
+    for mod in (llama, gemma, mixtral):
+        all_configs.update(mod.CONFIGS)
+
+    parser = argparse.ArgumentParser(description="TPU model server")
+    parser.add_argument("--model", default="llama3-tiny", choices=sorted(all_configs))
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--decode-slots", type=int, default=8)
+    parser.add_argument("--max-seq-len", type=int, default=1024)
+    parser.add_argument("--max-loras", type=int, default=4)
+    parser.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
+    parser.add_argument("--checkpoint", default=None, help="Orbax params dir")
+    parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    parser.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "axon"],
+        help="override the JAX platform (the image's sitecustomize pins the "
+             "TPU; pass cpu for hermetic runs)",
+    )
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import dataclasses
+    cfg = dataclasses.replace(all_configs[args.model], max_lora_slots=args.max_loras)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    tokenizer = load_tokenizer(args.tokenizer)
+    if tokenizer.vocab_size > cfg.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab {tokenizer.vocab_size} exceeds model vocab {cfg.vocab_size}"
+        )
+    if args.checkpoint:
+        import orbax.checkpoint as ocp
+        params = ocp.PyTreeCheckpointer().restore(args.checkpoint)
+        logger.info("restored params from %s", args.checkpoint)
+    else:
+        logger.warning("no --checkpoint: serving RANDOM weights (dev mode)")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+
+    lora_manager = LoRAManager(cfg, dtype=dtype)
+    engine = Engine(
+        cfg, params,
+        EngineConfig(decode_slots=args.decode_slots, max_seq_len=args.max_seq_len),
+        lora_manager=lora_manager,
+        eos_id=tokenizer.eos_id,
+        dtype=dtype,
+    )
+    engine.start()
+    server = ModelServer(engine, tokenizer, args.model, lora_manager)
+    try:
+        web.run_app(server.build_app(), port=args.port)
+    finally:
+        engine.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,389 @@
+"""Continuous-batching serving engine: prefill/decode split over static slots.
+
+JetStream-style architecture (the TPU answer to vLLM's continuous batching the
+reference routes to, SURVEY.md §2.5/§7):
+
+- **Prefill** runs one prompt at a time, padded to a small set of bucket
+  lengths (a handful of compiled shapes, never per-request recompiles), and
+  produces the prompt KV + the first sampled token.
+- **Insert** writes the prompt KV into a free row of the static decode cache
+  (``[n_layers, decode_slots, max_seq_len, n_kv, hd]``).
+- **Generate** advances ALL active slots one token per step with a single
+  fixed-shape jitted function (decode + sampling fused into one program,
+  cache donated so XLA updates it in place).
+
+The engine thread interleaves: one prefill admission, then decode steps.
+Queues are explicit and exported: ``prefill_queue`` (admission backlog) and
+``decode_wait`` (prefilled but waiting for a free slot) — the two signals the
+gateway's prefill-aware scheduler routes on (``gateway/scheduling``).
+
+Requests carry an optional LoRA adapter name; the slot id from
+``LoRAManager`` rides into the decode batch per-row, so one batch multiplexes
+adapters and the base model (the premise of the gateway's affinity routing).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import queue as queue_mod
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+from llm_instance_gateway_tpu.server.sampling import sample
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EngineConfig:
+    decode_slots: int = 8
+    max_seq_len: int = 1024
+    prefill_buckets: tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024)
+    max_queue: int = 256
+    # Tokens/sec EMA smoothing for the exported throughput gauge.
+    tps_ema_alpha: float = 0.2
+
+
+@dataclass
+class SamplingParams:
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+@dataclass
+class Request:
+    prompt_tokens: list[int]
+    max_new_tokens: int = 64
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    adapter: str | None = None
+    stop_token_ids: tuple[int, ...] = ()
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    # Lifecycle (filled by the engine).
+    output_tokens: list[int] = field(default_factory=list)
+    finish_reason: str | None = None
+    error: str | None = None
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    done: threading.Event = field(default_factory=threading.Event)
+    # Incremental consumption point for streaming responses.
+    stream_event: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def ttft_s(self) -> float:
+        return (self.t_first_token - self.t_submit) if self.t_first_token else 0.0
+
+
+@dataclass
+class _Slot:
+    request: Request
+    lora_slot: int
+    position: int  # position of the NEXT token to generate
+
+
+class Engine:
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params,
+        engine_cfg: EngineConfig | None = None,
+        lora_manager=None,
+        eos_id: int | None = None,
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg or EngineConfig()
+        self.params = params
+        self.lora = lora_manager
+        self.eos_id = eos_id
+        self._rng = jax.random.PRNGKey(seed)
+
+        b = self.cfg.decode_slots
+        self.cache = transformer.init_decode_cache(
+            model_cfg, b, self.cfg.max_seq_len, dtype=dtype
+        )
+        self.slots: list[_Slot | None] = [None] * b
+        self._slot_tokens = np.zeros((b,), np.int32)
+        self._slot_positions = np.zeros((b,), np.int32)
+        self._slot_lora = np.full((b,), -1, np.int32)
+        self._slot_temp = np.zeros((b,), np.float32)
+        self._slot_topk = np.zeros((b,), np.int32)
+        self._slot_topp = np.ones((b,), np.float32)
+
+        self.prefill_queue: queue_mod.Queue[Request] = queue_mod.Queue(
+            maxsize=self.cfg.max_queue
+        )
+        self._work = threading.Condition()
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+        # Telemetry (exported by server.metrics in the gateway contract).
+        self._lock = threading.Lock()
+        self.total_generated = 0
+        self.total_requests = 0
+        self.decode_tps_ema = 0.0
+        self.ttft_history: list[float] = []
+
+        self._jit_prefill = jax.jit(functools.partial(self._prefill_impl, model_cfg))
+        self._jit_decode = jax.jit(
+            functools.partial(self._decode_impl, model_cfg),
+            donate_argnames=("cache",),
+        )
+        # Insert donates the cache too: without donation every admission would
+        # copy the full multi-GB decode cache.
+        self._jit_insert = jax.jit(
+            transformer.insert_prefill, donate_argnames=("cache",)
+        )
+
+    # ------------------------------------------------------------------
+    # jitted compute
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _prefill_impl(
+        model_cfg, params, lora_bufs, tokens, positions, true_len,
+        lora_slot, temp, topk, topp, key,
+    ):
+        """Prefill one padded prompt; sample the first new token."""
+        slot_ids = jnp.full((1,), lora_slot, jnp.int32)
+        logits, k, v = transformer.prefill(
+            model_cfg, params, tokens, positions, lora_bufs=lora_bufs,
+            slot_ids=slot_ids,
+        )
+        last = logits[:, true_len - 1]  # [1, V]
+        first_token = sample(
+            last, key,
+            temperature=jnp.full((1,), temp, jnp.float32),
+            top_k=jnp.full((1,), topk, jnp.int32),
+            top_p=jnp.full((1,), topp, jnp.float32),
+        )
+        return first_token[0], k, v
+
+    @staticmethod
+    def _decode_impl(
+        model_cfg, params, lora_bufs, cache, tokens, positions,
+        slot_ids, temp, topk, topp, key,
+    ):
+        """One decode step for all slots + fused sampling."""
+        logits, cache = transformer.decode_step(
+            model_cfg, params, cache, tokens, positions,
+            lora_bufs=lora_bufs, slot_ids=slot_ids,
+        )
+        next_tokens = sample(logits, key, temp, topk, topp)
+        return next_tokens, cache
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
+        if len(request.prompt_tokens) >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}"
+            )
+        self._bucket(len(request.prompt_tokens))  # validate here, not mid-batch
+        request.t_submit = time.time()
+        if request.adapter is not None and self.lora is not None:
+            # Resolve eagerly so unknown adapters fail fast (404, not mid-batch).
+            self.lora.slot_for(request.adapter)
+        self.prefill_queue.put_nowait(request)
+        with self._lock:
+            self.total_requests += 1
+        with self._work:
+            self._work.notify()
+        return request
+
+    def generate(self, request: Request, timeout_s: float = 600.0) -> Request:
+        """Submit and block until completion (HTTP layer calls this)."""
+        self.submit(request)
+        if not request.done.wait(timeout_s):
+            request.error = "generation timed out"
+        return request
+
+    # ------------------------------------------------------------------
+    # metrics snapshot (the scrape contract, gateway/metrics_client.py)
+    # ------------------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        active = sum(1 for s in self.slots if s is not None)
+        used_tokens = sum(
+            (s.position if s is not None else 0) for s in self.slots
+        )
+        capacity = self.cfg.decode_slots * self.cfg.max_seq_len
+        with self._lock:
+            tps = self.decode_tps_ema
+        running_adapters = self.lora.running_adapters() if self.lora else []
+        max_lora = self.lora.max_slots if self.lora else 0
+        return {
+            "prefill_queue_size": self.prefill_queue.qsize(),
+            "decode_queue_size": 0,  # admission is prefill-gated; slots absorb
+            "num_requests_running": active,
+            "num_requests_waiting": self.prefill_queue.qsize(),
+            "kv_cache_usage_perc": used_tokens / capacity if capacity else 0.0,
+            "kv_tokens_capacity": capacity,
+            "kv_tokens_free": capacity - used_tokens,
+            "decode_tokens_per_sec": tps,
+            "running_lora_adapters": running_adapters,
+            "max_lora": max_lora,
+        }
+
+    # ------------------------------------------------------------------
+    # engine loop
+    # ------------------------------------------------------------------
+
+    def _free_slot_index(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b and b <= self.cfg.max_seq_len:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest prefill bucket")
+
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _lora_buffers(self):
+        return self.lora.buffers if self.lora is not None else None
+
+    def _loop(self) -> None:
+        while self._running:
+            did_work = False
+            # 1) Admit one queued request if a slot is free (prefill).
+            if self._free_slot_index() is not None and not self.prefill_queue.empty():
+                try:
+                    req = self.prefill_queue.get_nowait()
+                except queue_mod.Empty:
+                    req = None
+                if req is not None:
+                    self._do_prefill(req)
+                    did_work = True
+            # 2) One decode step for all active slots.
+            if any(s is not None for s in self.slots):
+                self._do_decode_step()
+                did_work = True
+            if not did_work:
+                with self._work:
+                    self._work.wait(timeout=0.05)
+
+    def _do_prefill(self, req: Request) -> None:
+        try:
+            slot_idx = self._free_slot_index()
+            n = len(req.prompt_tokens)
+            bucket = self._bucket(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_tokens
+            positions = np.zeros((1, bucket), np.int32)
+            positions[0, :n] = np.arange(n)
+            lora_slot = (
+                self.lora.slot_for(req.adapter) if self.lora is not None else -1
+            )
+            sp = req.sampling
+            first_token, k, v = self._jit_prefill(
+                self.params, self._lora_buffers(),
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.int32(n), jnp.int32(lora_slot),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), self._next_key(),
+            )
+            # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
+            self.cache = self._jit_insert(
+                self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
+            )
+            tok = int(first_token)
+            req.t_first_token = time.time()
+            req.output_tokens.append(tok)
+            req.stream_event.set()
+            with self._lock:
+                self.total_generated += 1
+                self.ttft_history.append(req.ttft_s)
+                if len(self.ttft_history) > 1000:
+                    del self.ttft_history[:500]
+            if self._is_finished(req, tok):
+                self._finish(req, "stop" if self._is_stop(req, tok) else "length")
+                return
+            self.slots[slot_idx] = _Slot(request=req, lora_slot=lora_slot, position=n)
+            self._slot_tokens[slot_idx] = tok
+            self._slot_positions[slot_idx] = n
+            self._slot_lora[slot_idx] = lora_slot
+            self._slot_temp[slot_idx] = sp.temperature
+            self._slot_topk[slot_idx] = sp.top_k
+            self._slot_topp[slot_idx] = sp.top_p
+        except Exception as e:  # engine must survive a poison request
+            logger.exception("prefill failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
+    def _do_decode_step(self) -> None:
+        t0 = time.perf_counter()
+        next_tokens, self.cache = self._jit_decode(
+            self.params, self._lora_buffers(), self.cache,
+            jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
+            jnp.asarray(self._slot_lora),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp), self._next_key(),
+        )
+        next_np = np.asarray(next_tokens)
+        step_s = time.perf_counter() - t0
+        n_active = 0
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            n_active += 1
+            tok = int(next_np[i])
+            req = slot.request
+            req.output_tokens.append(tok)
+            req.stream_event.set()
+            slot.position += 1
+            self._slot_tokens[i] = tok
+            self._slot_positions[i] = slot.position
+            if self._is_finished(req, tok) or slot.position >= self.cfg.max_seq_len - 1:
+                self._finish(req, "stop" if self._is_stop(req, tok) else "length")
+                self.slots[i] = None
+                self._slot_lora[i] = -1
+        with self._lock:
+            self.total_generated += n_active
+            inst = n_active / step_s if step_s > 0 else 0.0
+            a = self.cfg.tps_ema_alpha
+            self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
+
+    def _is_stop(self, req: Request, tok: int) -> bool:
+        return tok == self.eos_id or tok in req.stop_token_ids
+
+    def _is_finished(self, req: Request, tok: int) -> bool:
+        return self._is_stop(req, tok) or len(req.output_tokens) >= req.max_new_tokens
+
+    def _finish(self, req: Request, reason: str) -> None:
+        req.finish_reason = reason
+        req.t_done = time.time()
+        req.stream_event.set()
+        req.done.set()

@@ -1,0 +1,159 @@
+"""LoRA adapter lifecycle on the serving process: registry + Orbax hot-swap.
+
+This replaces the reference's vLLM-side adapter machinery: where the sidecar
+POSTs ``/v1/load_lora_adapter`` and vLLM pulls safetensors into CUDA slots
+(``tools/dynamic-lora-sidecar/sidecar/sidecar.py:177-213``), our server
+restores an **Orbax checkpoint** directly into the pre-allocated JAX slot
+buffers (``models.lora``) — no recompilation, no process restart, and the
+swap is one device-buffer write (BASELINE.json north star: "hot-swaps
+adapters into a JAX/XLA serving process via Orbax restore").
+
+Checkpoint layout (written by ``save_adapter`` / the training pipeline):
+a pytree ``{"meta": {"alpha": f, "rank": r}, "weights": {target: {"a": ...,
+"b": ...}}}`` saved with ``orbax.checkpoint.PyTreeCheckpointer``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_instance_gateway_tpu.models import lora as lora_lib
+
+logger = logging.getLogger(__name__)
+
+
+class AdapterError(Exception):
+    pass
+
+
+@dataclass
+class AdapterInfo:
+    name: str
+    slot: int
+    rank: int
+    alpha: float
+    source: str  # checkpoint path or "inline"
+
+
+def save_adapter(path: str, weights: dict, alpha: float, rank: int) -> None:
+    """Write an adapter checkpoint (numpy pytree) via Orbax."""
+    import orbax.checkpoint as ocp
+
+    tree = {
+        "meta": {"alpha": np.float32(alpha), "rank": np.int32(rank)},
+        "weights": {
+            t: {k: np.asarray(v, np.float32) for k, v in tv.items()}
+            for t, tv in weights.items()
+        },
+    }
+    ocp.PyTreeCheckpointer().save(path, tree)
+
+
+def load_adapter_checkpoint(path: str) -> tuple[dict, float, int]:
+    import orbax.checkpoint as ocp
+
+    tree = ocp.PyTreeCheckpointer().restore(path)
+    meta = tree["meta"]
+    return tree["weights"], float(meta["alpha"]), int(meta["rank"])
+
+
+class LoRAManager:
+    """Thread-safe adapter registry bound to the engine's slot buffers.
+
+    Mirrors the metric semantics of ``vllm:lora_requests_info``
+    (``backend/vllm/metrics.go:19-32``): ``running_adapters`` is the set the
+    gateway's affinity filter matches against; ``max_slots`` is max_lora.
+    """
+
+    def __init__(self, cfg, dtype=jnp.bfloat16):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        # Serializes whole load/unload operations: the buffer update is a
+        # read-modify-write of self.buffers, and concurrent HTTP admin calls
+        # run in separate executor threads — without this, the second writer
+        # would silently drop the first one's weights.
+        self._mutate_lock = threading.Lock()
+        self._adapters: dict[str, AdapterInfo] = {}
+        self._free_slots = list(range(cfg.max_lora_slots))
+        self.buffers = lora_lib.init_lora_buffers(cfg, dtype=dtype)
+
+    # -- queries -----------------------------------------------------------
+    def running_adapters(self) -> list[str]:
+        with self._lock:
+            return sorted(self._adapters)
+
+    @property
+    def max_slots(self) -> int:
+        return self.cfg.max_lora_slots
+
+    def slot_for(self, adapter_name: str | None) -> int:
+        """Slot id for a request (-1 = base model). Raises if not resident."""
+        if adapter_name is None:
+            return -1
+        with self._lock:
+            info = self._adapters.get(adapter_name)
+        if info is None:
+            raise AdapterError(f"adapter {adapter_name!r} is not loaded")
+        return info.slot
+
+    # -- mutations ---------------------------------------------------------
+    def load(
+        self,
+        name: str,
+        weights: dict | None = None,
+        alpha: float = 16.0,
+        rank: int = 8,
+        checkpoint_path: str | None = None,
+    ) -> AdapterInfo:
+        """Load an adapter into a free slot (idempotent per name)."""
+        if not name or not all(c.isalnum() or c in "._-" for c in name):
+            raise AdapterError(
+                f"invalid adapter name {name!r}: use [A-Za-z0-9._-] "
+                "(names flow into Prometheus labels and routing configs)"
+            )
+        with self._mutate_lock:
+            with self._lock:
+                if name in self._adapters:
+                    return self._adapters[name]  # resident (sidecar.py:185-188)
+                if not self._free_slots:
+                    raise AdapterError(
+                        f"no free adapter slots (max {self.cfg.max_lora_slots})"
+                    )
+                slot = self._free_slots.pop(0)
+            try:
+                if checkpoint_path is not None:
+                    weights, alpha, rank = load_adapter_checkpoint(checkpoint_path)
+                if weights is None:
+                    raise AdapterError("either weights or checkpoint_path required")
+                self.buffers = lora_lib.load_adapter(
+                    self.buffers, self.cfg, slot, weights, alpha, rank
+                )
+            except Exception:
+                with self._lock:
+                    self._free_slots.insert(0, slot)
+                raise
+            info = AdapterInfo(
+                name=name, slot=slot, rank=rank, alpha=alpha,
+                source=checkpoint_path or "inline",
+            )
+            with self._lock:
+                self._adapters[name] = info
+        logger.info("loaded adapter %s into slot %d (rank %d)", name, slot, rank)
+        return info
+
+    def unload(self, name: str) -> bool:
+        with self._mutate_lock:
+            with self._lock:
+                info = self._adapters.pop(name, None)
+            if info is None:
+                return False
+            self.buffers = lora_lib.unload_adapter(self.buffers, self.cfg, info.slot)
+            with self._lock:
+                self._free_slots.append(info.slot)
+        logger.info("unloaded adapter %s from slot %d", name, info.slot)
+        return True

@@ -1,0 +1,53 @@
+"""Prometheus exposition for the model server — the gateway's scrape contract.
+
+Exports exactly the ``tpu:*`` families ``gateway/metrics_client.py`` consumes
+(the TPU equivalent of vLLM's ``vllm:*`` names, ``backend/vllm/metrics.go:19-32``),
+including the labeled LoRA info gauge whose value is a unix timestamp so the
+gateway's latest-series selection works unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline).
+
+    Adapter names are validated at load time, but escape anyway — one bad
+    label must not poison the whole exposition the gateway scrapes.
+    """
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render(snapshot: dict, extra: dict | None = None) -> str:
+    """Render an ``Engine.metrics_snapshot()`` dict to exposition text."""
+    lines = [
+        "# TYPE tpu:prefill_queue_size gauge",
+        f"tpu:prefill_queue_size {snapshot['prefill_queue_size']}",
+        "# TYPE tpu:decode_queue_size gauge",
+        f"tpu:decode_queue_size {snapshot['decode_queue_size']}",
+        "# TYPE tpu:num_requests_running gauge",
+        f"tpu:num_requests_running {snapshot['num_requests_running']}",
+        "# TYPE tpu:num_requests_waiting gauge",
+        f"tpu:num_requests_waiting {snapshot['num_requests_waiting']}",
+        "# TYPE tpu:kv_cache_usage_perc gauge",
+        f"tpu:kv_cache_usage_perc {snapshot['kv_cache_usage_perc']:.6f}",
+        "# TYPE tpu:kv_tokens_capacity gauge",
+        f"tpu:kv_tokens_capacity {snapshot['kv_tokens_capacity']}",
+        "# TYPE tpu:kv_tokens_free gauge",
+        f"tpu:kv_tokens_free {snapshot['kv_tokens_free']}",
+        "# TYPE tpu:decode_tokens_per_sec gauge",
+        f"tpu:decode_tokens_per_sec {snapshot['decode_tokens_per_sec']:.3f}",
+        "# TYPE tpu:lora_requests_info gauge",
+        'tpu:lora_requests_info{running_lora_adapters="%s",max_lora="%d"} %f'
+        % (
+            escape_label(",".join(snapshot.get("running_lora_adapters", []))),
+            snapshot.get("max_lora", 0),
+            time.time(),
+        ),
+    ]
+    for name, value in (extra or {}).items():
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,52 @@
+"""Token sampling: greedy, temperature, top-k, top-p — batched and jittable.
+
+All paths are shape-static (top-k uses a fixed k; top-p masks a sorted copy)
+so the decode step compiles once regardless of per-request sampling params.
+Per-row parameters arrive as arrays, letting one batch mix sampling configs —
+required for multiplexed serving where every slot is a different request.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,        # [B, V] f32
+    key: jax.Array,
+    temperature: jax.Array,   # [B] f32; 0 = greedy
+    top_k: jax.Array,         # [B] int32; 0 = disabled
+    top_p: jax.Array,         # [B] f32; 1.0 = disabled
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # Temperature scaling (guard zero; greedy rows are selected at the end).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    # Top-k: mask everything below the k-th largest.  Fixed-shape sort.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    masked = jnp.where(scaled >= kth, scaled, NEG_INF)
+
+    # Top-p over the already-top-k-masked distribution.  Top-k masking cannot
+    # reorder a descending sort, so the sorted masked values are derivable
+    # from the first sort — no second O(V log V) sort in the decode hot loop.
+    ranks = jnp.arange(v)[None, :]
+    sorted_masked = jnp.where(ranks <= k_idx[:, None], sorted_desc, NEG_INF)
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    cumulative = jnp.cumsum(probs_sorted, axis=-1)
+    # Keep tokens while exclusive-cumulative < top_p; the top-1 token is kept
+    # unconditionally so top_p=0 degrades to argmax instead of a full mask.
+    cutoff_mask = ((cumulative - probs_sorted) < top_p[:, None]) | (ranks == 0)
+    threshold = jnp.where(cutoff_mask, sorted_masked, jnp.inf).min(axis=-1)  # [B]
+    masked = jnp.where(masked >= threshold[:, None], masked, NEG_INF)
+
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,56 @@
+"""Tokenizers for the model server.
+
+Default is a self-contained byte-level tokenizer (UTF-8 bytes + specials) so
+the server runs hermetically — this environment has zero egress, and the
+reference's tokenization also lives outside the repo (inside vLLM).  Real
+checkpoints bring their own tokenizer: ``HFTokenizer`` wraps a *local*
+``transformers`` tokenizer directory when one is provided.
+"""
+
+from __future__ import annotations
+
+BOS_ID = 256
+EOS_ID = 257
+PAD_ID = 258
+BYTE_VOCAB = 259
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes, then BOS/EOS/PAD."""
+
+    vocab_size = BYTE_VOCAB
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+    pad_id = PAD_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [BOS_ID] + ids if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Local HuggingFace tokenizer wrapper (no network access)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # local files only
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = self._tok.vocab_size
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+        self.pad_id = self._tok.pad_token_id or self.eos_id
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: str | None = None):
+    return HFTokenizer(path) if path else ByteTokenizer()

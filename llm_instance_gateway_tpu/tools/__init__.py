@@ -1,0 +1,1 @@
+"""Operational tools: the dynamic LoRA rollout sidecar."""

@@ -1,0 +1,269 @@
+"""Dynamic LoRA rollout sidecar: config-driven adapter reconciliation.
+
+Parity: reference ``tools/dynamic-lora-sidecar/sidecar/sidecar.py`` — a
+per-replica reconciler that watches a mounted ConfigMap file and drives the
+model server's adapter set to match:
+
+- config schema is ``tpuLoRAConfig`` with the same shape as the reference's
+  ``vLLMLoRAConfig`` (host/port, ``ensureExist.models[]``,
+  ``ensureNotExist.models[]`` with id/source/base-model) — validated with
+  jsonschema like the reference (``sidecar.py:68-80``, ``validation.yaml``);
+  the legacy ``vLLMLoRAConfig`` key is accepted for drop-in compatibility.
+- reconcile = health-gate (poll ``/health`` up to 300 s, ``sidecar.py:158-175``)
+  -> diff ``ensureExist - ensureNotExist`` against ``GET /v1/models``
+  (``:140-155``, ``:215-239``) -> ``POST /v1/load_lora_adapter`` /
+  ``/v1/unload_lora_adapter`` (``:177-213``).
+
+The TPU difference is entirely server-side: ``source`` is an Orbax
+checkpoint path and the load endpoint restores it into a pre-allocated JAX
+adapter slot (``server/lora_manager.py``) instead of vLLM pulling
+safetensors into CUDA memory.  File watching is mtime-polling (the watchdog
+package isn't in this image; the reference used PollingObserver anyway).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+import yaml
+
+try:
+    import jsonschema
+except ImportError:  # pragma: no cover
+    jsonschema = None
+
+logger = logging.getLogger(__name__)
+
+CONFIG_SCHEMA = {
+    "title": "tpuLoRAConfig",
+    "type": "object",
+    "properties": {
+        "tpuLoRAConfig": {"$ref": "#/$defs/config"},
+        "vLLMLoRAConfig": {"$ref": "#/$defs/config"},  # drop-in compat
+    },
+    "$defs": {
+        "config": {
+            "type": "object",
+            "properties": {
+                "host": {"type": "string", "default": "localhost"},
+                "port": {"type": "integer", "default": 8000},
+                "name": {"type": "string"},
+                "ensureExist": {
+                    "type": "object",
+                    "properties": {"models": {"$ref": "#/$defs/models"}},
+                    "required": ["models"],
+                },
+                "ensureNotExist": {
+                    "type": "object",
+                    "properties": {"models": {"$ref": "#/$defs/models"}},
+                    "required": ["models"],
+                },
+            },
+        },
+        "models": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "id": {"type": "string"},
+                    "source": {"type": "string"},
+                    "base-model": {"type": "string"},
+                },
+                "required": ["id", "source"],
+            },
+        },
+    },
+}
+
+
+@dataclass(frozen=True)
+class LoraAdapter:
+    """sidecar.py:46-60 (identity is the id, like the reference's __eq__)."""
+
+    id: str
+    source: str = ""
+    base_model: str = ""
+
+    def __eq__(self, other):
+        return isinstance(other, LoraAdapter) and self.id == other.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+
+class LoraReconciler:
+    def __init__(
+        self,
+        config_file: str,
+        config_validation: bool = True,
+        health_check_timeout_s: float = 300.0,
+        health_check_interval_s: float = 15.0,
+        http_timeout_s: float = 60.0,
+    ):
+        self.config_file = config_file
+        self.config_validation = config_validation
+        self.health_check_timeout_s = health_check_timeout_s
+        self.health_check_interval_s = health_check_interval_s
+        self.http_timeout_s = http_timeout_s
+
+    # -- config (sidecar.py:82-96) ------------------------------------------
+    @property
+    def config(self) -> dict:
+        try:
+            with open(self.config_file) as f:
+                c = yaml.safe_load(f) or {}
+            if self.config_validation and jsonschema is not None:
+                jsonschema.validate(instance=c, schema=CONFIG_SCHEMA)
+            return c.get("tpuLoRAConfig") or c.get("vLLMLoRAConfig") or {}
+        except (OSError, yaml.YAMLError) as e:
+            logger.error("cannot load config %s: %s", self.config_file, e)
+            return {}
+        except Exception as e:  # jsonschema.ValidationError
+            logger.error("config validation error for %s: %s", self.config_file, e)
+            return {}
+
+    @property
+    def model_server(self) -> str:
+        c = self.config
+        return f"{c.get('host', 'localhost')}:{c.get('port', 8000)}"
+
+    def _adapters(self, key: str) -> set[LoraAdapter]:
+        models = self.config.get(key, {}).get("models", [])
+        return {
+            LoraAdapter(m["id"], m.get("source", ""), m.get("base-model", ""))
+            for m in models
+        }
+
+    # -- HTTP helpers ---------------------------------------------------------
+    def _get(self, path: str) -> dict:
+        url = f"http://{self.model_server}{path}"
+        with urllib.request.urlopen(url, timeout=self.http_timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _post(self, path: str, payload: dict) -> tuple[int, str]:
+        url = f"http://{self.model_server}{path}"
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.http_timeout_s) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    # -- reconcile steps ------------------------------------------------------
+    def is_server_healthy(self) -> bool:
+        """sidecar.py:158-175: poll /health until 200 or timeout."""
+        deadline = time.monotonic() + self.health_check_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                self._get("/health")
+                return True
+            except (OSError, urllib.error.URLError, json.JSONDecodeError):
+                logger.info("server %s not healthy yet, retrying", self.model_server)
+                time.sleep(self.health_check_interval_s)
+        return False
+
+    def registered_adapters(self) -> set[str]:
+        """sidecar.py:140-155: adapter ids currently on the server."""
+        try:
+            data = self._get("/v1/models")
+        except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
+            logger.error("cannot list models: %s", e)
+            return set()
+        return {m["id"] for m in data.get("data", []) if m.get("parent")}
+
+    def load_adapter(self, adapter: LoraAdapter) -> str | None:
+        """sidecar.py:177-195: skip if registered, else POST load."""
+        if adapter.id in self.registered_adapters():
+            logger.info("adapter %s already loaded", adapter.id)
+            return None
+        status, body = self._post(
+            "/v1/load_lora_adapter",
+            {"lora_name": adapter.id, "lora_path": adapter.source},
+        )
+        if status != 200:
+            return f"load {adapter.id}: HTTP {status} {body}"
+        logger.info("loaded adapter %s from %s", adapter.id, adapter.source)
+        return None
+
+    def unload_adapter(self, adapter: LoraAdapter) -> str | None:
+        """sidecar.py:197-213: skip if absent, else POST unload."""
+        if adapter.id not in self.registered_adapters():
+            return None
+        status, body = self._post(
+            "/v1/unload_lora_adapter", {"lora_name": adapter.id}
+        )
+        if status != 200:
+            return f"unload {adapter.id}: HTTP {status} {body}"
+        logger.info("unloaded adapter %s", adapter.id)
+        return None
+
+    def reconcile(self) -> list[str]:
+        """sidecar.py:215-239: health-gate, then drive to desired state.
+
+        Returns accumulated errors (empty = converged).
+        """
+        if not self.is_server_healthy():
+            msg = f"server {self.model_server} unhealthy past timeout"
+            logger.error(msg)
+            return [msg]
+        errors = []
+        ensure_exist = self._adapters("ensureExist")
+        ensure_not_exist = self._adapters("ensureNotExist")
+        to_load = ensure_exist - ensure_not_exist  # sidecar.py:230
+        for adapter in sorted(to_load, key=lambda a: a.id):
+            err = self.load_adapter(adapter)
+            if err:
+                errors.append(err)
+        for adapter in sorted(ensure_not_exist, key=lambda a: a.id):
+            err = self.unload_adapter(adapter)
+            if err:
+                errors.append(err)
+        logger.info("reconcile complete (%d errors)", len(errors))
+        return errors
+
+
+def watch(reconciler: LoraReconciler, poll_interval_s: float = 2.0) -> None:
+    """Mtime-gated watch loop (PollingObserver equivalent, sidecar.py:242-261)."""
+    last_mtime = 0.0
+    reconciler.reconcile()
+    while True:
+        try:
+            mtime = os.stat(reconciler.config_file).st_mtime
+            if mtime != last_mtime:
+                last_mtime = mtime
+                reconciler.reconcile()
+        except OSError:
+            pass
+        time.sleep(poll_interval_s)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="dynamic LoRA rollout sidecar")
+    parser.add_argument(
+        "--config",
+        default=os.environ.get("DYNAMIC_LORA_ROLLOUT_CONFIG", "/config/config.yaml"),
+        help="adapter rollout config file (ConfigMap mount)",
+    )
+    parser.add_argument("--once", action="store_true", help="reconcile once and exit")
+    parser.add_argument("--poll-interval", type=float, default=2.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    reconciler = LoraReconciler(args.config)
+    if args.once:
+        errors = reconciler.reconcile()
+        raise SystemExit(1 if errors else 0)
+    watch(reconciler, args.poll_interval)
+
+
+if __name__ == "__main__":
+    main()

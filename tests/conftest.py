@@ -3,17 +3,28 @@
 Multi-chip sharding tests run on a virtual 8-device CPU mesh
 (``xla_force_host_platform_device_count``), per the reference's
 "multi-node-without-a-cluster" test strategy (SURVEY.md §4): fake the fleet,
-test the real algorithms.  Must run before the first ``import jax``.
+test the real algorithms.  jax is typically ALREADY imported by the image's
+sitecustomize when this file runs — the ``jax.config.update`` below (not
+env-var ordering) is the load-bearing mechanism keeping tests off the TPU.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon (the real TPU) already captured into jax.config, so a
+# plain env-var override here is too late — update the config directly (legal
+# until the first backend initialization).  Unit tests must stay off the TPU:
+# slow per-test compiles, single shared chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,214 @@
+"""Model correctness tests (CPU, tiny configs).
+
+The key invariant is prefill/decode parity: running the prompt through
+``prefill`` and then decoding token-by-token from an inserted cache must
+produce the same logits as prefill produced at those positions — this is the
+correctness contract the serving engine relies on (JetStream-style
+prefill -> insert -> generate).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.models import lora as lora_lib
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import (
+    GEMMA_2B,
+    MIXTRAL_8X7B,
+    TINY_TEST,
+    ModelConfig,
+)
+
+TINY_GEMMA = GEMMA_2B.tiny()
+TINY_MOE = MIXTRAL_8X7B.tiny()
+
+
+def make_model(cfg, seed=0, dtype=jnp.float32):
+    # float32 on CPU: bf16 emulation is slow and loosens parity tolerances.
+    return transformer.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+
+
+def random_tokens(cfg, b, s, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0, cfg.vocab_size)
+
+
+@pytest.mark.parametrize("cfg", [TINY_TEST, TINY_GEMMA, TINY_MOE], ids=lambda c: c.name)
+def test_prefill_shapes_and_finiteness(cfg):
+    params = make_model(cfg)
+    b, s = 2, 8
+    tokens = random_tokens(cfg, b, s)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    logits, k, v = transformer.prefill(cfg, params, tokens, positions)
+    assert logits.shape == (b, s, cfg.padded_vocab)
+    assert k.shape == (cfg.n_layers, b, s, cfg.n_kv_heads, cfg.resolved_head_dim)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+@pytest.mark.parametrize("cfg", [TINY_TEST, TINY_GEMMA], ids=lambda c: c.name)
+def test_prefill_decode_parity(cfg):
+    """Decode from an inserted prefill cache must match prefill logits."""
+    params = make_model(cfg)
+    s = 6
+    tokens = random_tokens(cfg, 1, s)
+    positions = jnp.arange(s)[None]
+    ref_logits, k, v = transformer.prefill(cfg, params, tokens, positions)
+
+    # Insert prompt[:3] into a decode cache, then decode tokens 3..5.
+    split = 3
+    cache = transformer.init_decode_cache(cfg, batch=2, max_len=16, dtype=jnp.float32)
+    cache = transformer.insert_prefill(
+        cache, k[:, :, :split], v[:, :, :split], slot=0, length=split
+    )
+    for i in range(split, s):
+        step_tokens = jnp.array([tokens[0, i], 0], jnp.int32)
+        step_positions = jnp.array([i, 0], jnp.int32)
+        logits, cache = transformer.decode_step(
+            cfg, params, cache, step_tokens, step_positions
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(ref_logits[0, i]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_causality():
+    """Changing a later token must not affect earlier logits."""
+    cfg = TINY_TEST
+    params = make_model(cfg)
+    tokens = random_tokens(cfg, 1, 8)
+    positions = jnp.arange(8)[None]
+    logits_a, *_ = transformer.prefill(cfg, params, tokens, positions)
+    tokens_b = tokens.at[0, 5].set((tokens[0, 5] + 1) % cfg.vocab_size)
+    logits_b, *_ = transformer.prefill(cfg, params, tokens_b, positions)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :5]), np.asarray(logits_b[0, :5]), rtol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits_a[0, 5]), np.asarray(logits_b[0, 5]))
+
+
+def test_padding_invariance():
+    """Right-padding a prompt must not change its logits (position masking)."""
+    cfg = TINY_TEST
+    params = make_model(cfg)
+    tokens = random_tokens(cfg, 1, 4)
+    positions = jnp.arange(4)[None]
+    logits_short, *_ = transformer.prefill(cfg, params, tokens, positions)
+    padded = jnp.concatenate([tokens, jnp.zeros((1, 4), tokens.dtype)], axis=1)
+    padded_pos = jnp.concatenate([positions, jnp.zeros((1, 4), jnp.int32)], axis=1)
+    logits_padded, *_ = transformer.prefill(cfg, params, padded, padded_pos)
+    np.testing.assert_allclose(
+        np.asarray(logits_short[0]), np.asarray(logits_padded[0, :4]), rtol=2e-4, atol=2e-4
+    )
+
+
+class TestLoRA:
+    def make_adapter(self, cfg, rank, seed=3, targets=("q", "v")):
+        dims = lora_lib.target_dims(cfg)
+        rng = np.random.RandomState(seed)
+        return {
+            t: {
+                "a": rng.randn(cfg.n_layers, dims[t][0], rank) * 0.1,
+                "b": rng.randn(cfg.n_layers, rank, dims[t][1]) * 0.1,
+            }
+            for t in targets
+        }
+
+    def test_empty_slots_match_base(self):
+        cfg = TINY_TEST
+        params = make_model(cfg)
+        bufs = lora_lib.init_lora_buffers(cfg, dtype=jnp.float32)
+        tokens = random_tokens(cfg, 2, 4)
+        positions = jnp.broadcast_to(jnp.arange(4), (2, 4))
+        base, *_ = transformer.prefill(cfg, params, tokens, positions)
+        slot_ids = jnp.array([0, -1], jnp.int32)  # zeroed slot == no adapter
+        with_lora, *_ = transformer.prefill(
+            cfg, params, tokens, positions, lora_bufs=bufs, slot_ids=slot_ids
+        )
+        np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora), rtol=1e-5)
+
+    def test_adapter_changes_only_its_rows(self):
+        cfg = TINY_TEST
+        params = make_model(cfg)
+        bufs = lora_lib.init_lora_buffers(cfg, dtype=jnp.float32)
+        bufs = lora_lib.load_adapter(bufs, cfg, slot=1, adapter=self.make_adapter(cfg, 2),
+                                     alpha=8.0, rank=2)
+        tokens = random_tokens(cfg, 2, 4)
+        positions = jnp.broadcast_to(jnp.arange(4), (2, 4))
+        base, *_ = transformer.prefill(cfg, params, tokens, positions)
+        slot_ids = jnp.array([1, -1], jnp.int32)
+        mixed, *_ = transformer.prefill(
+            cfg, params, tokens, positions, lora_bufs=bufs, slot_ids=slot_ids
+        )
+        # Row 0 (adapter) differs; row 1 (base) identical.
+        assert not np.allclose(np.asarray(base[0]), np.asarray(mixed[0]))
+        np.testing.assert_allclose(np.asarray(base[1]), np.asarray(mixed[1]), rtol=1e-5)
+
+    def test_rank_padding_equivalence(self):
+        """A rank-r adapter must behave identically under any max_lora_rank >= r."""
+        cfg_small = TINY_TEST  # max_lora_rank=4
+        import dataclasses
+        cfg_big = dataclasses.replace(cfg_small, max_lora_rank=8)
+        params = make_model(cfg_small)
+        adapter = self.make_adapter(cfg_small, rank=2)
+        tokens = random_tokens(cfg_small, 1, 4)
+        positions = jnp.arange(4)[None]
+        outs = []
+        for cfg in (cfg_small, cfg_big):
+            bufs = lora_lib.init_lora_buffers(cfg, dtype=jnp.float32)
+            bufs = lora_lib.load_adapter(bufs, cfg, 0, adapter, alpha=4.0, rank=2)
+            logits, *_ = transformer.prefill(
+                cfg, params, tokens, positions, lora_bufs=bufs,
+                slot_ids=jnp.array([0], jnp.int32),
+            )
+            outs.append(np.asarray(logits))
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
+
+    def test_unload_restores_base(self):
+        cfg = TINY_TEST
+        bufs = lora_lib.init_lora_buffers(cfg, dtype=jnp.float32)
+        loaded = lora_lib.load_adapter(bufs, cfg, 0, self.make_adapter(cfg, 2), 8.0, 2)
+        unloaded = lora_lib.unload_adapter(loaded, cfg, 0)
+        for k in bufs:
+            np.testing.assert_array_equal(np.asarray(bufs[k]), np.asarray(unloaded[k]))
+
+    def test_slot_and_rank_validation(self):
+        cfg = TINY_TEST
+        bufs = lora_lib.init_lora_buffers(cfg)
+        with pytest.raises(ValueError, match="slot"):
+            lora_lib.load_adapter(bufs, cfg, 99, {}, 8.0, 2)
+        with pytest.raises(ValueError, match="rank"):
+            lora_lib.load_adapter(bufs, cfg, 0, {}, 8.0, 999)
+
+
+class TestSampling:
+    def test_greedy_and_temperature(self):
+        from llm_instance_gateway_tpu.server.sampling import sample
+        logits = jnp.array([[0.0, 5.0, 1.0], [10.0, 0.0, 0.0]], jnp.float32)
+        toks = sample(
+            logits, jax.random.PRNGKey(0),
+            temperature=jnp.array([0.0, 0.0]),
+            top_k=jnp.array([0, 0]), top_p=jnp.array([1.0, 1.0]),
+        )
+        assert toks.tolist() == [1, 0]
+
+    def test_top_k_restricts_support(self):
+        from llm_instance_gateway_tpu.server.sampling import sample
+        logits = jnp.array([[1.0, 2.0, 3.0, 4.0]], jnp.float32)
+        seen = set()
+        for i in range(50):
+            t = sample(logits, jax.random.PRNGKey(i),
+                       temperature=jnp.array([5.0]),
+                       top_k=jnp.array([2]), top_p=jnp.array([1.0]))
+            seen.add(int(t[0]))
+        assert seen <= {2, 3}
+
+    def test_top_p_restricts_support(self):
+        from llm_instance_gateway_tpu.server.sampling import sample
+        # ~[0.64, 0.23, 0.09, 0.03]: top_p=0.5 keeps only token 0.
+        logits = jnp.array([[4.0, 3.0, 2.0, 1.0]], jnp.float32)
+        for i in range(30):
+            t = sample(logits, jax.random.PRNGKey(i),
+                       temperature=jnp.array([1.0]),
+                       top_k=jnp.array([0]), top_p=jnp.array([0.5]))
+            assert int(t[0]) == 0
