@@ -1,0 +1,175 @@
+"""Gateway assembly shared by both transports (HTTP proxy, gRPC ext-proc).
+
+Builds datastore + reconcilers + membership sources + provider + scheduler +
+handler core from a pool/model YAML and CLI-ish options.  Pod membership
+sources, in precedence order:
+
+- ``--pod name=host[:port][,zone]`` static entries (port defaults to the
+  pool's targetPortNumber);
+- ``--discover-dns <hostname>``: periodic A-record resolution of a headless
+  Service — the k8s-API-free way the EPP tracks per-pod endpoints on GKE
+  (the reference used an EndpointSlice informer; DNS gives the same set for
+  a headless Service without RBAC);
+- with ``--probe-endpoints``, entries from either source are health-probed
+  and only Ready ones become schedulable (EndpointSlice Ready parity).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import yaml
+
+from llm_instance_gateway_tpu.api import v1alpha1
+from llm_instance_gateway_tpu.gateway.controllers import (
+    EndpointsReconciler,
+    InferenceModelReconciler,
+    InferencePoolReconciler,
+)
+from llm_instance_gateway_tpu.gateway.controllers.filewatch import (
+    ConfigWatcher,
+    DNSDiscoverer,
+    EndpointProber,
+    StaticEndpoint,
+)
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.server import Server
+from llm_instance_gateway_tpu.gateway.metrics_client import PodMetricsClient
+from llm_instance_gateway_tpu.gateway.provider import Provider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class GatewayComponents:
+    datastore: Datastore
+    provider: Provider
+    scheduler: Scheduler
+    handler_server: Server
+    watchers: list = field(default_factory=list)
+
+    def start_provider(self, pods_interval_s: float = 10.0,
+                       metrics_interval_s: float = 0.05) -> None:
+        self.provider.init(
+            refresh_pods_interval_s=pods_interval_s,
+            refresh_metrics_interval_s=metrics_interval_s,
+        )
+
+    def stop(self) -> None:
+        self.provider.stop()
+        for w in self.watchers:
+            w.stop()
+
+
+def build_gateway(
+    config_path: str,
+    static_pods: list[str] | None = None,
+    discover_dns: str | None = None,
+    watch_config: bool = False,
+    probe_endpoints: bool = False,
+    probe_interval_s: float = 5.0,
+    zone: str = "",
+) -> GatewayComponents:
+    with open(config_path) as f:
+        docs = list(yaml.safe_load_all(f))
+    pools, models = v1alpha1.from_documents(docs)
+    if not pools:
+        raise ValueError(f"no InferencePool document in {config_path}")
+    pool_name = pools[0].name
+
+    datastore = Datastore()
+    watchers: list = []
+    pool_rec = InferencePoolReconciler(datastore, pool_name)
+    model_rec = InferenceModelReconciler(datastore, pool_name)
+    for pool in pools:
+        pool_rec.reconcile(pool)
+    model_rec.resync(models)
+    target_port = datastore.get_pool().spec.target_port_number
+
+    if watch_config:
+        watcher = ConfigWatcher(config_path, pool_rec, model_rec)
+        watcher.start()
+        watchers.append(watcher)
+
+    endpoints: list[StaticEndpoint] = []
+    for spec in static_pods or []:
+        name, _, rest = spec.partition("=")
+        addr, _, ep_zone = rest.partition(",")
+        addr = addr or name
+        if ":" not in addr:
+            # Fill the pool port BEFORE any probing so /health hits the
+            # serving port, not :80.
+            addr = f"{addr}:{target_port}"
+        endpoints.append(StaticEndpoint(name=name, address=addr, zone=ep_zone))
+
+    endpoints_rec = EndpointsReconciler(datastore, zone=zone)
+    if discover_dns:
+        discoverer = DNSDiscoverer(
+            discover_dns, target_port, endpoints_rec,
+            probe=probe_endpoints, interval_s=probe_interval_s,
+        )
+        discoverer.start()
+        watchers.append(discoverer)
+    if endpoints:
+        if probe_endpoints:
+            prober = EndpointProber(
+                endpoints, endpoints_rec, probe_interval_s=probe_interval_s
+            )
+            prober.start()
+            watchers.append(prober)
+        else:
+            for ep in endpoints:
+                datastore.store_pod(Pod(name=ep.name, address=ep.address))
+    elif probe_endpoints and not discover_dns:
+        logger.warning(
+            "--probe-endpoints set but no --pod/--discover-dns source: "
+            "membership will stay empty"
+        )
+
+    provider = Provider(PodMetricsClient(), datastore)
+    scheduler = Scheduler(provider)
+    handler_server = Server(scheduler, datastore)
+    return GatewayComponents(
+        datastore=datastore, provider=provider, scheduler=scheduler,
+        handler_server=handler_server, watchers=watchers,
+    )
+
+
+def add_common_args(parser) -> None:
+    parser.add_argument("--config", required=True, help="pool/model YAML")
+    parser.add_argument("--pod", action="append", default=[],
+                        help="pod membership name=host[:port][,zone] (repeatable)")
+    parser.add_argument("--discover-dns", default=None, metavar="HOSTNAME",
+                        help="discover pods by resolving a headless Service DNS name")
+    parser.add_argument("--watch-config", action="store_true",
+                        help="hot-reload pool/model config on file change")
+    parser.add_argument("--probe-endpoints", action="store_true",
+                        help="health-probe pods; only Ready ones are routable")
+    parser.add_argument("--zone", default="",
+                        help="only admit endpoints in this zone (empty = all)")
+    parser.add_argument("--refresh-metrics-interval", type=float, default=0.05)
+    parser.add_argument("--refresh-pods-interval", type=float, default=10.0)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+
+
+def components_from_args(args) -> GatewayComponents:
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    comps = build_gateway(
+        args.config,
+        static_pods=args.pod,
+        discover_dns=args.discover_dns,
+        watch_config=args.watch_config,
+        probe_endpoints=args.probe_endpoints,
+        zone=args.zone,
+    )
+    comps.start_provider(
+        pods_interval_s=args.refresh_pods_interval,
+        metrics_interval_s=args.refresh_metrics_interval,
+    )
+    return comps

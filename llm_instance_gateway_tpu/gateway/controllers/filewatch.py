@@ -96,6 +96,82 @@ class StaticEndpoint:
     zone: str = ""
 
 
+class DNSDiscoverer:
+    """Headless-Service pod discovery: resolve A records, optionally probe.
+
+    On GKE a headless Service (``clusterIP: None``) publishes one A record
+    per Ready pod — kube-dns already applies readiness, so probing is
+    belt-and-braces (and catches pods that pass k8s readiness but wedge at
+    the app layer).  This is the RBAC-free alternative to the reference's
+    EndpointSlice informer.
+    """
+
+    def __init__(
+        self,
+        hostname: str,
+        port: int,
+        reconciler: "EndpointsReconciler",
+        probe: bool = True,
+        interval_s: float = 5.0,
+        probe_timeout_s: float = 2.0,
+    ):
+        self.hostname = hostname
+        self.port = port
+        self.reconciler = reconciler
+        self.probe = probe
+        self.interval_s = interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _resolve(self) -> list[str]:
+        import socket
+
+        try:
+            infos = socket.getaddrinfo(
+                self.hostname, self.port, proto=socket.IPPROTO_TCP
+            )
+        except socket.gaierror as e:
+            logger.warning("DNS discovery for %s failed: %s", self.hostname, e)
+            return []
+        return sorted({info[4][0] for info in infos})
+
+    def _healthy(self, address: str) -> bool:
+        try:
+            with urllib.request.urlopen(
+                f"http://{address}/health", timeout=self.probe_timeout_s
+            ) as resp:
+                return resp.status == 200
+        except (OSError, urllib.error.URLError):
+            return False
+
+    def discover_once(self) -> list[Endpoint]:
+        endpoints = []
+        for ip in self._resolve():
+            host = f"[{ip}]" if ":" in ip else ip  # bracket IPv6 literals
+            address = f"{host}:{self.port}"
+            ready = self._healthy(address) if self.probe else True
+            endpoints.append(Endpoint(name=ip, address=address, ready=ready))
+        self.reconciler.reconcile(endpoints)
+        return endpoints
+
+    def start(self) -> None:
+        self.discover_once()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.discover_once()
+                except Exception:
+                    logger.exception("DNS discovery error")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 class EndpointProber:
     def __init__(
         self,

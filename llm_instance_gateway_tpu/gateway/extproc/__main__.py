@@ -1,0 +1,50 @@
+"""gRPC ext-proc gateway entrypoint (the Envoy-sidecar deployment mode).
+
+Parity with the reference EPP binary (``pkg/ext-proc/main.go:59-158``): serve
+the ExternalProcessor + Health gRPC services over the same
+datastore/provider/scheduler assembly the standalone proxy uses.
+
+Run:  python -m llm_instance_gateway_tpu.gateway.extproc \
+        --config pool.yaml --port 9002 --discover-dns my-pool --probe-endpoints
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from llm_instance_gateway_tpu.gateway import bootstrap
+from llm_instance_gateway_tpu.gateway.extproc.service import build_grpc_server
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="TPU-native ext-proc endpoint picker")
+    parser.add_argument("--port", type=int, default=9002)  # main.go:33 default
+    parser.add_argument("--grpc-workers", type=int, default=16)
+    bootstrap.add_common_args(parser)
+    args = parser.parse_args(argv)
+
+    comps = bootstrap.components_from_args(args)
+    server = build_grpc_server(
+        comps.handler_server, comps.datastore,
+        port=args.port, max_workers=args.grpc_workers,
+    )
+    server.start()
+    logger.info("ext-proc gRPC server listening on :%d", args.port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):  # main.go SIGTERM handling
+        signal.signal(sig, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.stop(grace=5).wait(10)
+        comps.stop()
+
+
+if __name__ == "__main__":
+    main()

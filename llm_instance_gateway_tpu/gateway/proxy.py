@@ -27,7 +27,6 @@ import logging
 import aiohttp
 from aiohttp import web
 
-from llm_instance_gateway_tpu.api import v1alpha1
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
     RequestBody,
@@ -40,11 +39,7 @@ from llm_instance_gateway_tpu.gateway.handlers.server import (
     RequestContext,
     Server,
 )
-from llm_instance_gateway_tpu.gateway.metrics_client import PodMetricsClient
-from llm_instance_gateway_tpu.gateway.provider import Provider
-from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
 from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics, Timer
-from llm_instance_gateway_tpu.gateway.types import Pod
 
 logger = logging.getLogger(__name__)
 
@@ -178,59 +173,20 @@ class GatewayProxy:
         return web.json_response({"object": "list", "data": models})
 
 
-def build_from_config(config_path: str, static_pods: list[str] | None = None):
-    """Assemble datastore/provider/scheduler/proxy from a YAML config file.
-
-    The config is a multi-doc YAML of InferencePool/InferenceModel documents
-    (CRD shape).  ``static_pods`` ("name=host:port") seeds membership when no
-    controller is running (the controllers package supersedes this on k8s).
-    """
-    import yaml
-
-    with open(config_path) as f:
-        docs = list(yaml.safe_load_all(f))
-    pools, models = v1alpha1.from_documents(docs)
-
-    datastore = Datastore()
-    for pool in pools:
-        datastore.set_pool(pool)
-    for model in models:
-        datastore.store_model(model)
-    for spec in static_pods or []:
-        name, _, addr = spec.partition("=")
-        datastore.store_pod(Pod(name=name, address=addr or name))
-
-    provider = Provider(PodMetricsClient(), datastore)
-    scheduler = Scheduler(provider)
-    handler_server = Server(scheduler, datastore)
-    proxy = GatewayProxy(handler_server, provider, datastore)
-    return proxy, provider, datastore
-
-
 def main(argv: list[str] | None = None) -> None:
+    from llm_instance_gateway_tpu.gateway import bootstrap
+
     parser = argparse.ArgumentParser(description="TPU-native inference gateway")
-    parser.add_argument("--config", required=True, help="pool/model YAML")
     parser.add_argument("--port", type=int, default=8081)
-    parser.add_argument("--pod", action="append", default=[],
-                        help="static pod membership name=host:port (repeatable)")
-    parser.add_argument("--refresh-metrics-interval", type=float, default=0.05)
-    parser.add_argument("--refresh-pods-interval", type=float, default=10.0)
-    parser.add_argument("-v", "--verbose", action="count", default=0)
+    bootstrap.add_common_args(parser)
     args = parser.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
-    proxy, provider, _ = build_from_config(args.config, args.pod)
-    provider.init(
-        refresh_pods_interval_s=args.refresh_pods_interval,
-        refresh_metrics_interval_s=args.refresh_metrics_interval,
-    )
+    comps = bootstrap.components_from_args(args)
+    proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore)
     try:
         web.run_app(proxy.build_app(), port=args.port)
     finally:
-        provider.stop()
+        comps.stop()
 
 
 if __name__ == "__main__":

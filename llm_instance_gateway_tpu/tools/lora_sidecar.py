@@ -161,15 +161,22 @@ class LoraReconciler:
 
     # -- reconcile steps ------------------------------------------------------
     def is_server_healthy(self) -> bool:
-        """sidecar.py:158-175: poll /health until 200 or timeout."""
+        """sidecar.py:158-175: poll /health until 200 or timeout.
+
+        Status-only check: /health bodies are free-form (our server returns
+        plain text, vLLM returns empty) — never parse them.
+        """
         deadline = time.monotonic() + self.health_check_timeout_s
         while time.monotonic() < deadline:
             try:
-                self._get("/health")
-                return True
-            except (OSError, urllib.error.URLError, json.JSONDecodeError):
-                logger.info("server %s not healthy yet, retrying", self.model_server)
-                time.sleep(self.health_check_interval_s)
+                url = f"http://{self.model_server}/health"
+                with urllib.request.urlopen(url, timeout=self.http_timeout_s) as resp:
+                    if resp.status == 200:
+                        return True
+            except (OSError, urllib.error.URLError):
+                pass
+            logger.info("server %s not healthy yet, retrying", self.model_server)
+            time.sleep(self.health_check_interval_s)
         return False
 
     def registered_adapters(self) -> set[str]:

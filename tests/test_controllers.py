@@ -1,0 +1,198 @@
+"""Control-plane tests.
+
+Parity: reference reconciler tests call updateDatastore directly on
+hand-built datastores (``inferencemodel_reconciler_test.go:41-147``,
+``endpointslice_reconcilier_test.go:18-202``) — same approach here, plus the
+file-watch source.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from llm_instance_gateway_tpu.api.v1alpha1 import (
+    InferenceModel,
+    InferenceModelSpec,
+    InferencePool,
+    InferencePoolSpec,
+    PoolRef,
+)
+from llm_instance_gateway_tpu.gateway.controllers import (
+    Endpoint,
+    EndpointsReconciler,
+    InferenceModelReconciler,
+    InferencePoolReconciler,
+)
+from llm_instance_gateway_tpu.gateway.controllers.filewatch import ConfigWatcher
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+
+
+def model(name, pool="my-pool", namespace="default", rv="1"):
+    return InferenceModel(
+        name=name, namespace=namespace, resource_version=rv,
+        spec=InferenceModelSpec(model_name=name, pool_ref=PoolRef(name=pool)),
+    )
+
+
+def pool(name="my-pool", rv="1", port=8000):
+    return InferencePool(
+        name=name, resource_version=rv,
+        spec=InferencePoolSpec(selector={"app": "x"}, target_port_number=port),
+    )
+
+
+class TestPoolReconciler:
+    def test_copies_matching_pool(self):
+        ds = Datastore()
+        r = InferencePoolReconciler(ds, "my-pool")
+        assert r.reconcile(pool())
+        assert ds.get_pool().name == "my-pool"
+
+    def test_ignores_other_pools(self):
+        ds = Datastore()
+        r = InferencePoolReconciler(ds, "my-pool")
+        assert not r.reconcile(pool(name="other"))
+        assert not ds.has_synced_pool()
+
+    def test_resource_version_gate(self):
+        # inferencepool_reconciler.go:45-50.
+        ds = Datastore()
+        r = InferencePoolReconciler(ds, "my-pool")
+        assert r.reconcile(pool(rv="1"))
+        assert not r.reconcile(pool(rv="1"))  # same RV -> no-op
+        assert r.reconcile(pool(rv="2"))
+
+
+class TestModelReconciler:
+    # inferencemodel_reconciler_test.go:41-147 cases.
+    def test_add_update_model(self):
+        ds = Datastore()
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.reconcile(model("m1"))
+        assert ds.fetch_model("m1") is not None
+        r.reconcile(model("m1", rv="2"))
+        assert ds.fetch_model("m1").resource_version == "2"
+
+    def test_delete_on_poolref_move(self):
+        ds = Datastore()
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.reconcile(model("m1"))
+        r.reconcile(model("m1", pool="other-pool"))  # moved away
+        assert ds.fetch_model("m1") is None
+
+    def test_ignore_unrelated_pool(self):
+        ds = Datastore()
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.reconcile(model("m1", pool="other-pool"))
+        assert ds.fetch_model("m1") is None
+
+    def test_explicit_delete(self):
+        ds = Datastore()
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.reconcile(model("m1"))
+        r.reconcile(model("m1"), deleted=True)
+        assert ds.fetch_model("m1") is None
+
+    def test_resync_diffs_deletions(self):
+        ds = Datastore()
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.resync([model("m1"), model("m2")])
+        assert {m.name for m in ds.all_models()} == {"m1", "m2"}
+        r.resync([model("m2")])
+        assert {m.name for m in ds.all_models()} == {"m2"}
+
+
+class TestEndpointsReconciler:
+    # endpointslice_reconcilier_test.go:18-202 cases.
+    def setup_ds(self):
+        ds = Datastore()
+        ds.set_pool(pool(port=9009))
+        return ds
+
+    def test_ready_endpoints_become_pods_with_target_port(self):
+        ds = self.setup_ds()
+        r = EndpointsReconciler(ds)
+        r.reconcile([
+            Endpoint("pod1", "10.0.0.1", ready=True),
+            Endpoint("pod2", "10.0.0.2", ready=False),
+        ])
+        assert ds.pod_names() == {"pod1"}
+        assert ds.get_pod("pod1").address == "10.0.0.1:9009"
+
+    def test_zone_filtering(self):
+        ds = self.setup_ds()
+        r = EndpointsReconciler(ds, zone="us-central1-a")
+        r.reconcile([
+            Endpoint("near", "10.0.0.1", zone="us-central1-a"),
+            Endpoint("far", "10.0.0.2", zone="us-central1-b"),
+        ])
+        assert ds.pod_names() == {"near"}
+
+    def test_stale_pods_removed(self):
+        ds = self.setup_ds()
+        r = EndpointsReconciler(ds)
+        r.reconcile([Endpoint("pod1", "10.0.0.1"), Endpoint("pod2", "10.0.0.2")])
+        r.reconcile([Endpoint("pod2", "10.0.0.2")])
+        assert ds.pod_names() == {"pod2"}
+
+    def test_gated_on_pool_sync(self):
+        ds = Datastore()  # no pool
+        r = EndpointsReconciler(ds)
+        r.reconcile([Endpoint("pod1", "10.0.0.1")])
+        assert ds.pod_names() == set()
+
+    def test_explicit_port_respected(self):
+        ds = self.setup_ds()
+        r = EndpointsReconciler(ds)
+        r.reconcile([Endpoint("pod1", "10.0.0.1:7777")])
+        assert ds.get_pod("pod1").address == "10.0.0.1:7777"
+
+
+class TestConfigWatcher:
+    CONFIG = textwrap.dedent("""\
+        kind: InferencePool
+        metadata: {name: my-pool, resourceVersion: "1"}
+        spec: {selector: {app: x}, targetPortNumber: 8000}
+        ---
+        kind: InferenceModel
+        metadata: {name: m1}
+        spec:
+          modelName: m1
+          poolRef: {name: my-pool}
+    """)
+
+    def test_sync_and_resync(self, tmp_path):
+        path = tmp_path / "config.yaml"
+        path.write_text(self.CONFIG)
+        ds = Datastore()
+        watcher = ConfigWatcher(
+            str(path),
+            InferencePoolReconciler(ds, "my-pool"),
+            InferenceModelReconciler(ds, "my-pool"),
+        )
+        assert watcher.sync_once()
+        assert ds.get_pool().name == "my-pool"
+        assert ds.fetch_model("m1") is not None
+        # Unchanged mtime -> no resync.
+        assert not watcher.sync_once()
+        # Model removed from config -> deleted on resync.
+        path.write_text(self.CONFIG.split("---")[0])
+        os.utime(path, (1, 1))
+        assert watcher.sync_once()
+        assert ds.fetch_model("m1") is None
+
+    def test_bad_config_keeps_last_good_state(self, tmp_path):
+        path = tmp_path / "config.yaml"
+        path.write_text(self.CONFIG)
+        ds = Datastore()
+        watcher = ConfigWatcher(
+            str(path),
+            InferencePoolReconciler(ds, "my-pool"),
+            InferenceModelReconciler(ds, "my-pool"),
+        )
+        watcher.sync_once()
+        path.write_text("kind: InferenceModel\nmetadata: {name: bad}\nspec: {criticality: Turbo}")
+        os.utime(path, (2, 2))
+        assert not watcher.sync_once()
+        assert ds.fetch_model("m1") is not None  # last good state retained

@@ -1,0 +1,170 @@
+"""LoRA sidecar tests.
+
+Parity: reference ``tools/dynamic-lora-sidecar/sidecar/test_sidecar.py:1-186``
+— mock the HTTP surface and drive reconcile() against config fixtures.  Here
+the "mock" is a real in-process HTTP server recording load/unload calls,
+which also exercises the vLLM-compatible wire format end-to-end.
+"""
+
+import json
+import threading
+import http.server
+
+import pytest
+
+from llm_instance_gateway_tpu.tools.lora_sidecar import (
+    LoraAdapter,
+    LoraReconciler,
+)
+
+
+class FakeModelServer:
+    """Minimal /health /v1/models /v1/(un)load_lora_adapter endpoint."""
+
+    def __init__(self):
+        self.loaded: dict[str, str] = {}
+        self.calls: list[tuple[str, str]] = []
+        self.healthy = True
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if not outer.healthy:
+                    self._send(503, {"error": "warming up"})
+                    return
+                if self.path == "/health":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/v1/models":
+                    data = [{"id": "base", "object": "model"}] + [
+                        {"id": name, "object": "model", "parent": "base"}
+                        for name in sorted(outer.loaded)
+                    ]
+                    self._send(200, {"object": "list", "data": data})
+                else:
+                    self._send(404, {})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                if self.path == "/v1/load_lora_adapter":
+                    outer.calls.append(("load", body["lora_name"]))
+                    outer.loaded[body["lora_name"]] = body["lora_path"]
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/v1/unload_lora_adapter":
+                    outer.calls.append(("unload", body["lora_name"]))
+                    if body["lora_name"] in outer.loaded:
+                        del outer.loaded[body["lora_name"]]
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(404, {"error": "not loaded"})
+                else:
+                    self._send(404, {})
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_port
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def fake_server():
+    s = FakeModelServer()
+    yield s
+    s.close()
+
+
+def write_config(tmp_path, port, ensure_exist=(), ensure_not_exist=(), key="tpuLoRAConfig"):
+    cfg = {
+        key: {
+            "host": "127.0.0.1",
+            "port": port,
+            "name": "test-rollout",
+            "ensureExist": {
+                "models": [{"id": i, "source": f"/ckpt/{i}"} for i in ensure_exist]
+            },
+            "ensureNotExist": {
+                "models": [{"id": i, "source": f"/ckpt/{i}"} for i in ensure_not_exist]
+            },
+        }
+    }
+    import yaml
+    path = tmp_path / "config.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def make_reconciler(path):
+    return LoraReconciler(
+        path, health_check_timeout_s=2.0, health_check_interval_s=0.1,
+        http_timeout_s=5.0,
+    )
+
+
+class TestReconcile:
+    def test_loads_missing_adapters(self, fake_server, tmp_path):
+        path = write_config(tmp_path, fake_server.port, ensure_exist=("a1", "a2"))
+        errors = make_reconciler(path).reconcile()
+        assert errors == []
+        assert set(fake_server.loaded) == {"a1", "a2"}
+        assert fake_server.loaded["a1"] == "/ckpt/a1"
+
+    def test_skips_already_loaded(self, fake_server, tmp_path):
+        fake_server.loaded["a1"] = "/ckpt/a1"
+        path = write_config(tmp_path, fake_server.port, ensure_exist=("a1",))
+        make_reconciler(path).reconcile()
+        assert ("load", "a1") not in fake_server.calls  # sidecar.py:185-188
+
+    def test_unloads_ensure_not_exist(self, fake_server, tmp_path):
+        fake_server.loaded["old"] = "/ckpt/old"
+        path = write_config(tmp_path, fake_server.port, ensure_not_exist=("old",))
+        errors = make_reconciler(path).reconcile()
+        assert errors == []
+        assert "old" not in fake_server.loaded
+
+    def test_not_exist_wins_over_exist(self, fake_server, tmp_path):
+        # to_load = ensureExist - ensureNotExist (sidecar.py:230).
+        path = write_config(tmp_path, fake_server.port,
+                            ensure_exist=("both",), ensure_not_exist=("both",))
+        make_reconciler(path).reconcile()
+        assert ("load", "both") not in fake_server.calls
+        assert "both" not in fake_server.loaded
+
+    def test_unhealthy_server_reports_error(self, fake_server, tmp_path):
+        fake_server.healthy = False
+        path = write_config(tmp_path, fake_server.port, ensure_exist=("a1",))
+        errors = make_reconciler(path).reconcile()
+        assert errors and "unhealthy" in errors[0]
+        assert fake_server.loaded == {}
+
+    def test_vllm_config_key_compat(self, fake_server, tmp_path):
+        path = write_config(tmp_path, fake_server.port, ensure_exist=("compat",),
+                            key="vLLMLoRAConfig")
+        errors = make_reconciler(path).reconcile()
+        assert errors == []
+        assert "compat" in fake_server.loaded
+
+    def test_invalid_config_is_rejected(self, fake_server, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text("tpuLoRAConfig:\n  ensureExist:\n    models: [{source: nope}]\n")
+        r = make_reconciler(str(path))
+        assert r.config == {}  # schema validation rejects (sidecar.py:68-80)
+
+
+class TestAdapterIdentity:
+    def test_identity_is_id(self):
+        # sidecar.py:55-60: equality/hash by id only.
+        assert LoraAdapter("x", "/a") == LoraAdapter("x", "/b")
+        assert len({LoraAdapter("x", "/a"), LoraAdapter("x", "/b")}) == 1
