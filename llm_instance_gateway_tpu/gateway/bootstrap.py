@@ -31,14 +31,15 @@ from llm_instance_gateway_tpu.gateway.controllers.filewatch import (
     ConfigWatcher,
     DNSDiscoverer,
     EndpointProber,
+    MembershipAggregator,
     StaticEndpoint,
 )
+from llm_instance_gateway_tpu.gateway.controllers.reconcilers import Endpoint
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.server import Server
 from llm_instance_gateway_tpu.gateway.metrics_client import PodMetricsClient
 from llm_instance_gateway_tpu.gateway.provider import Provider
-from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
-from llm_instance_gateway_tpu.gateway.types import Pod
+from llm_instance_gateway_tpu.gateway.scheduling.native import make_scheduler
 
 logger = logging.getLogger(__name__)
 
@@ -47,7 +48,7 @@ logger = logging.getLogger(__name__)
 class GatewayComponents:
     datastore: Datastore
     provider: Provider
-    scheduler: Scheduler
+    scheduler: object  # Scheduler or NativeScheduler (same .schedule interface)
     handler_server: Server
     watchers: list = field(default_factory=list)
 
@@ -105,24 +106,34 @@ def build_gateway(
             addr = f"{addr}:{target_port}"
         endpoints.append(StaticEndpoint(name=name, address=addr, zone=ep_zone))
 
+    # All membership flows through one aggregator: the reconciler is
+    # full-state, so independent sources must publish a merged view, and the
+    # static path must go through the reconciler too or zone filtering would
+    # be silently skipped.
     endpoints_rec = EndpointsReconciler(datastore, zone=zone)
+    aggregator = MembershipAggregator(endpoints_rec)
     if discover_dns:
         discoverer = DNSDiscoverer(
-            discover_dns, target_port, endpoints_rec,
+            discover_dns, target_port,
             probe=probe_endpoints, interval_s=probe_interval_s,
+            publish=aggregator.sink("dns"),
         )
         discoverer.start()
         watchers.append(discoverer)
     if endpoints:
         if probe_endpoints:
             prober = EndpointProber(
-                endpoints, endpoints_rec, probe_interval_s=probe_interval_s
+                endpoints, probe_interval_s=probe_interval_s,
+                publish=aggregator.sink("static"),
             )
             prober.start()
             watchers.append(prober)
         else:
-            for ep in endpoints:
-                datastore.store_pod(Pod(name=ep.name, address=ep.address))
+            aggregator.publish(
+                "static",
+                [Endpoint(name=ep.name, address=ep.address, ready=True,
+                          zone=ep.zone) for ep in endpoints],
+            )
     elif probe_endpoints and not discover_dns:
         logger.warning(
             "--probe-endpoints set but no --pod/--discover-dns source: "
@@ -130,7 +141,9 @@ def build_gateway(
         )
 
     provider = Provider(PodMetricsClient(), datastore)
-    scheduler = Scheduler(provider)
+    # C++ hot path when buildable, Python tree otherwise (identical
+    # semantics, fuzz-verified in tests/test_native_scheduler.py).
+    scheduler = make_scheduler(provider)
     handler_server = Server(scheduler, datastore)
     return GatewayComponents(
         datastore=datastore, provider=provider, scheduler=scheduler,

@@ -15,11 +15,13 @@ the same reconcilers:
 
 from __future__ import annotations
 
+import concurrent.futures as futures
 import logging
 import os
 import threading
 import urllib.request
 from dataclasses import dataclass
+from typing import Callable
 
 import yaml
 
@@ -96,6 +98,59 @@ class StaticEndpoint:
     zone: str = ""
 
 
+def probe_health(address: str, timeout_s: float = 2.0,
+                 health_path: str = "/health") -> bool:
+    """Shared readiness probe (status-only; bodies are free-form)."""
+    try:
+        with urllib.request.urlopen(
+            f"http://{address}{health_path}", timeout=timeout_s
+        ) as resp:
+            return resp.status == 200
+    except (OSError, urllib.error.URLError):
+        return False
+
+
+def probe_health_many(addresses: list[str], timeout_s: float = 2.0,
+                      health_path: str = "/health") -> dict[str, bool]:
+    """Concurrent probes: a pool of dead replicas costs one timeout, not N."""
+    if not addresses:
+        return {}
+    with futures.ThreadPoolExecutor(max_workers=min(16, len(addresses))) as ex:
+        results = ex.map(
+            lambda a: (a, probe_health(a, timeout_s, health_path)), addresses
+        )
+        return dict(results)
+
+
+class MembershipAggregator:
+    """Merges endpoint lists from multiple sources into one reconcile.
+
+    ``EndpointsReconciler.reconcile`` is full-state (it deletes pods absent
+    from its input, reference endpointslice semantics), so independent
+    sources (static --pod list, DNS discovery) must publish through one
+    aggregator or they'd continuously delete each other's pods.  Endpoints
+    are keyed by name; the last source to publish a name wins.
+    """
+
+    def __init__(self, reconciler: EndpointsReconciler):
+        self._reconciler = reconciler
+        self._lock = threading.Lock()
+        self._sources: dict[str, list[Endpoint]] = {}
+
+    def publish(self, source: str, endpoints: list[Endpoint]) -> None:
+        with self._lock:
+            self._sources[source] = list(endpoints)
+            merged: dict[str, Endpoint] = {}
+            for eps in self._sources.values():
+                for ep in eps:
+                    merged[ep.name] = ep
+            union = list(merged.values())
+        self._reconciler.reconcile(union)
+
+    def sink(self, source: str) -> Callable[[list[Endpoint]], None]:
+        return lambda endpoints: self.publish(source, endpoints)
+
+
 class DNSDiscoverer:
     """Headless-Service pod discovery: resolve A records, optionally probe.
 
@@ -110,14 +165,19 @@ class DNSDiscoverer:
         self,
         hostname: str,
         port: int,
-        reconciler: "EndpointsReconciler",
+        reconciler: "EndpointsReconciler | None" = None,
         probe: bool = True,
         interval_s: float = 5.0,
         probe_timeout_s: float = 2.0,
+        publish: Callable[[list[Endpoint]], None] | None = None,
     ):
         self.hostname = hostname
         self.port = port
-        self.reconciler = reconciler
+        if publish is None:
+            if reconciler is None:
+                raise ValueError("need a reconciler or a publish sink")
+            publish = reconciler.reconcile
+        self._publish = publish
         self.probe = probe
         self.interval_s = interval_s
         self.probe_timeout_s = probe_timeout_s
@@ -136,23 +196,22 @@ class DNSDiscoverer:
             return []
         return sorted({info[4][0] for info in infos})
 
-    def _healthy(self, address: str) -> bool:
-        try:
-            with urllib.request.urlopen(
-                f"http://{address}/health", timeout=self.probe_timeout_s
-            ) as resp:
-                return resp.status == 200
-        except (OSError, urllib.error.URLError):
-            return False
-
     def discover_once(self) -> list[Endpoint]:
-        endpoints = []
+        addresses = {}
         for ip in self._resolve():
             host = f"[{ip}]" if ":" in ip else ip  # bracket IPv6 literals
-            address = f"{host}:{self.port}"
-            ready = self._healthy(address) if self.probe else True
-            endpoints.append(Endpoint(name=ip, address=address, ready=ready))
-        self.reconciler.reconcile(endpoints)
+            addresses[ip] = f"{host}:{self.port}"
+        if self.probe:
+            health = probe_health_many(
+                list(addresses.values()), self.probe_timeout_s
+            )
+        else:
+            health = {a: True for a in addresses.values()}
+        endpoints = [
+            Endpoint(name=ip, address=addr, ready=health.get(addr, False))
+            for ip, addr in addresses.items()
+        ]
+        self._publish(endpoints)
         return endpoints
 
     def start(self) -> None:
@@ -176,34 +235,35 @@ class EndpointProber:
     def __init__(
         self,
         endpoints: list[StaticEndpoint],
-        reconciler: EndpointsReconciler,
+        reconciler: EndpointsReconciler | None = None,
         probe_interval_s: float = 5.0,
         probe_timeout_s: float = 2.0,
         health_path: str = "/health",
+        publish: Callable[[list[Endpoint]], None] | None = None,
     ):
         self.endpoints = list(endpoints)
-        self.reconciler = reconciler
+        if publish is None:
+            if reconciler is None:
+                raise ValueError("need a reconciler or a publish sink")
+            publish = reconciler.reconcile
+        self._publish = publish
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.health_path = health_path
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def _probe(self, ep: StaticEndpoint) -> bool:
-        url = f"http://{ep.address}{self.health_path}"
-        try:
-            with urllib.request.urlopen(url, timeout=self.probe_timeout_s) as resp:
-                return resp.status == 200
-        except (OSError, urllib.error.URLError):
-            return False
-
     def probe_once(self) -> list[Endpoint]:
+        health = probe_health_many(
+            [ep.address for ep in self.endpoints],
+            self.probe_timeout_s, self.health_path,
+        )
         results = [
-            Endpoint(name=ep.name, address=ep.address, ready=self._probe(ep),
-                     zone=ep.zone)
+            Endpoint(name=ep.name, address=ep.address,
+                     ready=health.get(ep.address, False), zone=ep.zone)
             for ep in self.endpoints
         ]
-        self.reconciler.reconcile(results)
+        self._publish(results)
         return results
 
     def start(self) -> None:

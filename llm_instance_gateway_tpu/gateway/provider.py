@@ -37,11 +37,22 @@ class Provider:
         self._executor = futures.ThreadPoolExecutor(
             max_workers=max_fetch_workers, thread_name_prefix="metrics-fetch"
         )
+        # Monotonic snapshot version: bumped on every state change so
+        # consumers (the native scheduler's array cache) can reuse flattened
+        # views between refreshes instead of re-marshalling per request.
+        self.version = 0
 
     # -- snapshot accessors (provider.go:34-58) ----------------------------
     def all_pod_metrics(self) -> list[PodMetrics]:
         with self._lock:
             return list(self._metrics.values())
+
+    def snapshot(self) -> tuple[int, list[PodMetrics]]:
+        """(version, pods) read atomically — consumers caching flattened
+        views must take both under the same lock or a concurrent refresh can
+        tag stale arrays with a newer version."""
+        with self._lock:
+            return self.version, list(self._metrics.values())
 
     def get_pod_metrics(self, pod_name: str) -> PodMetrics | None:
         with self._lock:
@@ -50,6 +61,7 @@ class Provider:
     def update_pod_metrics(self, pod: Pod, metrics: Metrics) -> None:
         with self._lock:
             self._metrics[pod.name] = PodMetrics(pod=pod, metrics=metrics)
+            self.version += 1
 
     # -- lifecycle (provider.go:60-101) ------------------------------------
     def init(
@@ -100,6 +112,7 @@ class Provider:
             for name in list(self._metrics):
                 if name not in want:
                     del self._metrics[name]
+            self.version += 1
 
     def refresh_metrics_once(self) -> list[str]:
         """Parallel scrape of every pod (provider.go:134-179); returns errors."""
@@ -115,6 +128,7 @@ class Provider:
                 updated = results.get(pm.pod.name)
                 if updated is not None and pm.pod.name in self._metrics:
                     self._metrics[pm.pod.name] = PodMetrics(pod=pm.pod, metrics=updated)
+            self.version += 1
         if errs:
             logger.debug("metrics refresh errors: %s", "; ".join(errs))
         return errs

@@ -1,0 +1,247 @@
+"""ctypes binding for the C++ scheduler hot path (native/scheduler.cc).
+
+``NativeScheduler`` is a drop-in for ``Scheduler`` — identical decision-tree
+semantics (fuzz-verified against the Python tree), with candidate-set
+computation in C++ and the final random pick kept in Python so RNG behavior
+matches.  Falls back transparently when the shared library can't be built
+(``available()`` is False); callers should construct via ``make_scheduler``.
+
+The library auto-builds on first use via the Makefile next to the source —
+the image ships g++/make, and the build is one translation unit (<1 s).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import random
+import subprocess
+import threading
+
+import numpy as np
+
+from llm_instance_gateway_tpu.gateway.scheduling.config import (
+    DEFAULT_CONFIG,
+    SchedulerConfig,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    PodMetricsProvider,
+    Scheduler,
+    SchedulingError,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libligsched.so")
+
+LIG_SHED = -1
+LIG_ERROR = -2
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_NATIVE_DIR, "scheduler.cc")
+        stale = (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+        )
+        if stale:  # never serve semantics older than the source
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-s", "-B"],
+                    check=True, capture_output=True, timeout=60,
+                )
+            except (subprocess.SubprocessError, OSError) as e:
+                logger.warning("native scheduler build failed: %s", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native scheduler load failed: %s", e)
+            return None
+        lib.lig_schedule_candidates.restype = ctypes.c_int32
+        lib.lig_schedule_candidates.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),   # waiting
+            ctypes.POINTER(ctypes.c_int32),   # prefill
+            ctypes.POINTER(ctypes.c_double),  # kv_usage
+            ctypes.POINTER(ctypes.c_int64),   # kv_free
+            ctypes.POINTER(ctypes.c_int64),   # kv_capacity
+            ctypes.POINTER(ctypes.c_uint8),   # has_affinity
+            ctypes.POINTER(ctypes.c_int32),   # n_active
+            ctypes.POINTER(ctypes.c_int32),   # max_active
+            ctypes.c_uint8,                   # critical
+            ctypes.c_int64,                   # prompt_tokens
+            ctypes.c_double,                  # kv_cache_threshold
+            ctypes.c_int32,                   # queue_threshold_critical
+            ctypes.c_int32,                   # queueing_threshold_lora
+            ctypes.c_double,                  # token_headroom_factor
+            ctypes.c_int32,                   # prefill_queue_threshold
+            ctypes.c_uint8,                   # token_aware
+            ctypes.c_uint8,                   # prefill_aware
+            ctypes.POINTER(ctypes.c_int32),   # out
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load_library() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeScheduler:
+    """Same interface as Scheduler.schedule; C++ candidate computation."""
+
+    def __init__(
+        self,
+        pod_metrics_provider: PodMetricsProvider,
+        cfg: SchedulerConfig = DEFAULT_CONFIG,
+        token_aware: bool = True,
+        prefill_aware: bool = True,
+        rng: random.Random | None = None,
+    ):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native scheduler library unavailable")
+        self._lib = lib
+        self._provider = pod_metrics_provider
+        self.cfg = cfg
+        self.token_aware = token_aware
+        self.prefill_aware = prefill_aware
+        self._rng = rng or random.Random()
+        self._snapshot: dict | None = None
+        # The gRPC transport calls schedule() from a thread pool; the cached
+        # arrays (including the C++ output buffer) are shared state.
+        self._call_lock = threading.Lock()
+
+    def _arrays(self, req: LLMRequest, pods: list[PodMetrics],
+                version: int | None):
+        """Flattened metric arrays, cached per provider snapshot version.
+
+        Marshalling Python attributes into arrays costs more than the C++
+        tree itself; metrics only change at scrape cadence (50 ms), so the
+        arrays are rebuilt once per snapshot and shared by every request in
+        between.  Per-adapter residency vectors are cached the same way.
+        ``version`` must be read atomically WITH ``pods`` (Provider.snapshot)
+        or None to disable caching.
+        """
+        cached = self._snapshot
+        if version is None or cached is None or cached["version"] != version \
+                or cached["n"] != len(pods):
+            n = len(pods)
+            cached = {
+                "version": version,
+                "n": n,
+                "waiting": np.fromiter(
+                    (pm.metrics.total_queue_size for pm in pods), np.int32, n),
+                "prefill": np.fromiter(
+                    (pm.metrics.prefill_queue_size for pm in pods), np.int32, n),
+                "kv_usage": np.fromiter(
+                    (pm.metrics.kv_cache_usage_percent for pm in pods), np.float64, n),
+                "kv_free": np.fromiter(
+                    (pm.metrics.kv_tokens_free for pm in pods), np.int64, n),
+                "kv_capacity": np.fromiter(
+                    (pm.metrics.kv_tokens_capacity for pm in pods), np.int64, n),
+                "n_active": np.fromiter(
+                    (len(pm.metrics.active_adapters) for pm in pods), np.int32, n),
+                "max_active": np.fromiter(
+                    (pm.metrics.max_active_adapters for pm in pods), np.int32, n),
+                "affinity": {},
+                "out": np.empty(n, np.int32),
+            }
+            self._snapshot = cached
+        adapter = req.resolved_target_model
+        affinity = cached["affinity"].get(adapter)
+        if affinity is None:
+            affinity = np.fromiter(
+                (adapter in pm.metrics.active_adapters for pm in pods),
+                np.uint8, cached["n"],
+            )
+            cached["affinity"][adapter] = affinity
+        return cached, affinity
+
+    def candidates(self, req: LLMRequest, pods: list[PodMetrics],
+                   version: int | None = None) -> list[int]:
+        n = len(pods)
+        if n == 0:
+            # Parity: the Python tree's failure branches land in the drop
+            # filter on an empty pool, i.e. shed -> 429.
+            raise SchedulingError(
+                "failed to apply filter, resulted 0 pods: no pods", shed=True
+            )
+        with self._call_lock:
+            return self._candidates_locked(req, pods, n, version)
+
+    def _candidates_locked(self, req, pods, n, version) -> list[int]:
+        cached, affinity = self._arrays(req, pods, version)
+        waiting = cached["waiting"]
+        prefill = cached["prefill"]
+        kv_usage = cached["kv_usage"]
+        kv_free = cached["kv_free"]
+        n_active = cached["n_active"]
+        max_active = cached["max_active"]
+        out = cached["out"]
+        count = self._lib.lig_schedule_candidates(
+            n,
+            _ptr(waiting, ctypes.c_int32), _ptr(prefill, ctypes.c_int32),
+            _ptr(kv_usage, ctypes.c_double), _ptr(kv_free, ctypes.c_int64),
+            _ptr(cached["kv_capacity"], ctypes.c_int64),
+            _ptr(affinity, ctypes.c_uint8), _ptr(n_active, ctypes.c_int32),
+            _ptr(max_active, ctypes.c_int32),
+            1 if req.critical else 0,
+            req.prompt_tokens,
+            self.cfg.kv_cache_threshold,
+            self.cfg.queue_threshold_critical,
+            self.cfg.queueing_threshold_lora,
+            self.cfg.token_headroom_factor,
+            self.cfg.prefill_queue_threshold,
+            1 if self.token_aware else 0,
+            1 if self.prefill_aware else 0,
+            _ptr(out, ctypes.c_int32),
+        )
+        if count == LIG_SHED:
+            raise SchedulingError(
+                "failed to apply filter, resulted 0 pods: dropping request due "
+                "to limited backend resources",
+                shed=True,
+            )
+        if count < 0:
+            raise SchedulingError(f"native scheduler error {count}")
+        return out[:count].tolist()
+
+    def schedule(self, req: LLMRequest) -> Pod:
+        snapshot = getattr(self._provider, "snapshot", None)
+        if snapshot is not None:
+            version, pods = snapshot()  # atomic (version, pods) pair
+        else:
+            version, pods = None, self._provider.all_pod_metrics()
+        idxs = self.candidates(req, pods, version)
+        return pods[idxs[self._rng.randrange(len(idxs))]].pod
+
+
+def make_scheduler(provider, cfg: SchedulerConfig = DEFAULT_CONFIG,
+                   prefer_native: bool = True, **kwargs):
+    """Native scheduler when buildable, Python tree otherwise."""
+    if prefer_native and available():
+        try:
+            return NativeScheduler(provider, cfg, **kwargs)
+        except RuntimeError:
+            pass
+    return Scheduler(provider, cfg, **kwargs)

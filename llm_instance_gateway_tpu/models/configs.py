@@ -45,6 +45,9 @@ class ModelConfig:
     # and recompiles, so they mirror vLLM's --max-loras / max rank flags).
     max_lora_slots: int = 4
     max_lora_rank: int = 16
+    # Pallas flash-attention for prefill (right-padded batches only; falls
+    # back to the XLA reference when shapes miss the tiling constraints).
+    use_flash_attention: bool = False
 
     @property
     def resolved_head_dim(self) -> int:

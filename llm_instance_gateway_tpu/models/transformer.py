@@ -174,7 +174,14 @@ def prefill(
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        attn = prefill_attention(q, k, v, positions)
+        if cfg.use_flash_attention:
+            # Right-padded batches: causal tiling alone keeps real positions
+            # exact (pallas_attention.flash_attention docstring).
+            from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention
+
+            attn = flash_attention(q, k, v)
+        else:
+            attn = prefill_attention(q, k, v, positions)
         h = h + _project(attn.reshape(b, s, -1), lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)

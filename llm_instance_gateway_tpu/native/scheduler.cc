@@ -1,0 +1,197 @@
+// Native scheduler hot path: the filter decision tree over flat arrays.
+//
+// The gateway's per-request work is pure CPU: walk the filter tree over a
+// snapshot of pod metrics (reference hot loop #2, SURVEY.md §3.2).  The
+// reference runs this in Go; our Python tree is the semantic source of truth
+// and this C++ mirror exists for large pools where the Python loop's
+// per-pod overhead dominates pick latency (200+ pods at tens of kHz).
+//
+// Contract: lig_schedule_candidates() fills `out` with the indices of the
+// surviving candidate set (the final random pick stays in Python so RNG
+// behavior is unchanged) and returns the count; returns LIG_SHED (-1) for
+// the load-shedding drop and LIG_ERROR (-2) on invalid input.  Semantics
+// mirror gateway/scheduling/{filter,scheduler}.py exactly; the parity test
+// (tests/test_native_scheduler.py) fuzzes both against each other.
+//
+// Build: make -C llm_instance_gateway_tpu/native  (emits libligsched.so)
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Pods {
+  int n;
+  const int32_t* waiting;        // total queue depth
+  const int32_t* prefill;        // prefill queue depth
+  const double* kv_usage;        // 0..1
+  const int64_t* kv_free;        // free KV tokens
+  const uint8_t* has_affinity;   // request's adapter resident on pod?
+  const int32_t* n_active;       // resident adapter count
+  const int32_t* max_active;     // adapter slot count
+};
+
+struct Config {
+  double kv_cache_threshold;
+  int32_t queue_threshold_critical;
+  int32_t queueing_threshold_lora;
+  double token_headroom_factor;
+  int32_t prefill_queue_threshold;
+  bool token_aware;
+  bool prefill_aware;
+};
+
+using Set = std::vector<int32_t>;
+
+// Bucketing filters: keep pods in [min, min + (max-min)/len(set)]
+// (integer division for queues, float for kv — filter.go:117/:149 parity).
+
+Set least_queuing(const Pods& p, const Set& in) {
+  int32_t lo = INT32_MAX, hi = 0;
+  for (int32_t i : in) {
+    lo = p.waiting[i] < lo ? p.waiting[i] : lo;
+    hi = p.waiting[i] > hi ? p.waiting[i] : hi;
+  }
+  const int32_t cut = lo + (hi - lo) / static_cast<int32_t>(in.size());
+  Set out;
+  for (int32_t i : in)
+    if (p.waiting[i] <= cut) out.push_back(i);
+  return out;
+}
+
+Set least_prefill(const Pods& p, const Set& in) {
+  int32_t lo = INT32_MAX, hi = 0;
+  for (int32_t i : in) {
+    lo = p.prefill[i] < lo ? p.prefill[i] : lo;
+    hi = p.prefill[i] > hi ? p.prefill[i] : hi;
+  }
+  const int32_t cut = lo + (hi - lo) / static_cast<int32_t>(in.size());
+  Set out;
+  for (int32_t i : in)
+    if (p.prefill[i] <= cut) out.push_back(i);
+  return out;
+}
+
+Set least_kv(const Pods& p, const Set& in) {
+  double lo = 1e300, hi = 0.0;
+  for (int32_t i : in) {
+    lo = p.kv_usage[i] < lo ? p.kv_usage[i] : lo;
+    hi = p.kv_usage[i] > hi ? p.kv_usage[i] : hi;
+  }
+  const double cut = lo + (hi - lo) / static_cast<double>(in.size());
+  Set out;
+  for (int32_t i : in)
+    if (p.kv_usage[i] <= cut) out.push_back(i);
+  return out;
+}
+
+// Queue stage: optional prefill bucketing, then total-queue bucketing
+// (scheduler.py queue_filter()).
+Set queue_stage(const Pods& p, const Config& c, const Set& in) {
+  Set s = in;
+  if (c.prefill_aware) s = least_prefill(p, s);
+  return least_queuing(p, s);
+}
+
+// queueAndKVCacheFilter (scheduler.go:49-56).
+Set queue_kv(const Pods& p, const Config& c, const Set& in) {
+  return least_kv(p, queue_stage(p, c, in));
+}
+
+// queueLoRAAndKVCacheFilter (scheduler.go:35-46): queue -> low-cost-LoRA
+// predicate (failure passes the queue-stage output through) -> least-KV.
+Set queue_lora_kv(const Pods& p, const Config& c, const Set& in) {
+  Set q = queue_stage(p, c, in);
+  Set lora;
+  for (int32_t i : q)
+    if (p.has_affinity[i] || p.n_active[i] < p.max_active[i]) lora.push_back(i);
+  return least_kv(p, lora.empty() ? q : lora);
+}
+
+}  // namespace
+
+extern "C" {
+
+constexpr int32_t LIG_SHED = -1;
+constexpr int32_t LIG_ERROR = -2;
+
+int32_t lig_schedule_candidates(
+    int32_t n_pods, const int32_t* waiting, const int32_t* prefill,
+    const double* kv_usage, const int64_t* kv_free,
+    const int64_t* kv_capacity, const uint8_t* has_affinity,
+    const int32_t* n_active, const int32_t* max_active,
+    // request
+    uint8_t critical, int64_t prompt_tokens,
+    // config
+    double kv_cache_threshold, int32_t queue_threshold_critical,
+    int32_t queueing_threshold_lora, double token_headroom_factor,
+    int32_t prefill_queue_threshold, uint8_t token_aware,
+    uint8_t prefill_aware,
+    // out: caller-allocated buffer of n_pods ints
+    int32_t* out) {
+  if (n_pods <= 0 || !waiting || !prefill || !kv_usage || !kv_free ||
+      !kv_capacity || !has_affinity || !n_active || !max_active || !out)
+    return LIG_ERROR;
+
+  const Pods p{n_pods, waiting, prefill, kv_usage, kv_free,
+               has_affinity, n_active, max_active};
+  const Config c{kv_cache_threshold, queue_threshold_critical,
+                 queueing_threshold_lora, token_headroom_factor,
+                 prefill_queue_threshold, token_aware != 0,
+                 prefill_aware != 0};
+
+  Set all(n_pods);
+  for (int32_t i = 0; i < n_pods; ++i) all[i] = i;
+
+  // Token-headroom gate (advisory: falls back to the full set).  Pods that
+  // don't export KV-token metrics (capacity <= 0) pass trivially — filter.py
+  // token_headroom parity.
+  Set pool = all;
+  if (c.token_aware && prompt_tokens > 0) {
+    const int64_t need =
+        static_cast<int64_t>(prompt_tokens * c.token_headroom_factor);
+    Set fit;
+    for (int32_t i : all)
+      if (kv_capacity[i] <= 0 || kv_free[i] >= need) fit.push_back(i);
+    if (!fit.empty()) pool = fit;
+  }
+
+  Set result;
+  if (critical) {
+    // lowLatencyFilter (scheduler.go:58-72).
+    Set lowq;
+    for (int32_t i : pool)
+      if (p.waiting[i] < c.queueing_threshold_lora) lowq.push_back(i);
+    if (!lowq.empty()) {
+      Set aff;
+      for (int32_t i : lowq)
+        if (p.has_affinity[i]) aff.push_back(i);
+      if (!aff.empty()) {
+        result = queue_kv(p, c, aff);
+      } else {
+        Set room;
+        for (int32_t i : lowq)
+          if (p.n_active[i] < p.max_active[i]) room.push_back(i);
+        result = queue_kv(p, c, room.empty() ? lowq : room);
+      }
+    } else {
+      result = queue_lora_kv(p, c, pool);
+    }
+  } else {
+    // sheddableRequestFilter (scheduler.go:74-90).
+    Set ok;
+    for (int32_t i : pool)
+      if (p.waiting[i] <= c.queue_threshold_critical &&
+          p.kv_usage[i] <= c.kv_cache_threshold)
+        ok.push_back(i);
+    if (ok.empty()) return LIG_SHED;
+    result = queue_lora_kv(p, c, ok);
+  }
+
+  if (result.empty()) return LIG_SHED;  // tree exhausted: drop (parity)
+  for (std::size_t k = 0; k < result.size(); ++k) out[k] = result[k];
+  return static_cast<int32_t>(result.size());
+}
+
+}  // extern "C"

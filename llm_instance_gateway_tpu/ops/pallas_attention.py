@@ -1,0 +1,155 @@
+"""Pallas TPU flash attention for prefill.
+
+Why a hand kernel here and nowhere else (yet): prefill attention is the one
+op where XLA's default schedule materializes the [S, S] score matrix in HBM
+for long sequences — flash tiling keeps scores in VMEM and streams K/V
+blocks, turning an O(S^2) HBM traffic pattern into O(S).  Everything
+elementwise (norms, RoPE, activations) stays XLA-fused, per the guide's
+"don't hand-schedule what the compiler already does".
+
+Kernel shape: grid (B, H, S/BLOCK_Q); each program holds one query block in
+VMEM and loops over K/V blocks with the online-softmax recurrence in f32
+scratch.  GQA is native: the K/V BlockSpec index-maps query head h to KV
+head h // (H/K), so grouped heads share the same streamed K/V block without
+materialized repetition.  Causal blocks strictly above the diagonal are
+skipped (their programs still run but do no FLOPs via @pl.when).
+
+Use ``flash_attention`` for the auto-dispatching entry: it falls back to the
+XLA reference (``ops.attention.prefill_attention``) when shapes don't meet
+the tiling constraints (tiny test models) or off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from llm_instance_gateway_tpu.ops.attention import prefill_attention
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    # Blocks keep their leading (batch, head) unit dims:
+    # q_ref: [1, 1, BLOCK_Q, hd]; k_ref/v_ref: [1, 1, S, hd].
+    qi = pl.program_id(2)
+    s_total = k_ref.shape[2]
+    n_kblocks = s_total // block_k
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    bq = q.shape[0]
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+
+    q_start = qi * bq
+
+    def body(kb, carry):
+        m, l, o = carry
+        k_start = kb * block_k
+        k = k_ref[0, 0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, o_new
+
+    # Causal: only blocks up to (and including) the diagonal contribute.
+    last_block = (
+        jnp.minimum((q_start + bq + block_k - 1) // block_k, n_kblocks)
+        if causal else n_kblocks
+    )
+    m, l, o = jax.lax.fori_loop(0, last_block, body, (m0, l0, o0))
+    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention_bhsd(
+    q: jax.Array,  # [B, H, S, hd]
+    k: jax.Array,  # [B, K, S, hd]
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    b, h, s, hd = q.shape
+    n_kv = k.shape[1]
+    g = h // n_kv
+    scale = float(1.0 / (hd ** 0.5))
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, hd),
+                             lambda bi, hi, qi: (bi, hi, qi, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, s, hd),
+                             lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, s, hd),
+                             lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                                   lambda bi, hi, qi: (bi, hi, qi, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def supports(s: int, hd: int, block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> bool:
+    """Shape gate for the kernel path (pad upstream or fall back)."""
+    return s % block_q == 0 and s % block_k == 0 and hd % 128 == 0
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, hd] (model layout)
+    k: jax.Array,  # [B, S, K, hd]
+    v: jax.Array,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """Auto-dispatch: Pallas kernel when shapes allow, XLA reference otherwise.
+
+    NOTE: the kernel path is purely causal — use it for right-padded batches
+    (pad tokens trail real ones, so causality alone keeps real positions
+    exact; pad rows are garbage the caller ignores).  Packed batches with
+    position-based masks must use the XLA path.
+    """
+    b, s, h, hd = q.shape
+    if not supports(s, hd):
+        return prefill_attention(q, k, v)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = flash_attention_bhsd(qt, kt, vt, causal=causal, interpret=interpret)
+    return out.transpose(0, 2, 1, 3)

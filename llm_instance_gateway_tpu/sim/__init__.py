@@ -1,0 +1,13 @@
+"""Discrete-event simulator of TPU continuous batching + gateway routing.
+
+Parity: reference ``simulations/llm_ig_simulation`` (simpy model of
+vLLM-style continuous batching + the routing heuristics, SURVEY.md §2.3),
+rebuilt for the TPU serving model and with one structural upgrade: the
+simulated gateway runs the PRODUCTION filter tree (``gateway.scheduling``)
+over simulated ``PodMetrics`` — the reference re-implemented its heuristics
+in the simulator and could drift; here a threshold retuned in simulation is
+the literal config deployed.
+
+simpy is not in this image; ``core.py`` carries a purpose-built event loop
+(the reference only used simpy's store/timeout subset anyway).
+"""

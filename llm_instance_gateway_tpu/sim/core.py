@@ -1,0 +1,247 @@
+"""Simulation core: TPU continuous-batching replica + event loop.
+
+Server model (the TPU analog of the reference's ``llmactor.py`` +
+``continous_batching.py``): each replica is a prefill/decode disaggregated
+engine with ``decode_slots`` concurrent sequences and a token-denominated KV
+budget.  Per iteration it either prefills one queued request (bucketed) or
+advances every active slot one token — the same policy as
+``server/engine.py``'s loop, so simulated queues/latencies have the same
+shape as the real engine's.
+
+Latency model (BASELINE.md form, TPU-recalibrated):
+    T_prefill = max(c_min, c0 + c1 * prompt_tokens)
+    T_decode  = c3 + c4 * total_kv_tokens_in_batch + c_batch * batch_size
+Defaults come from measuring this repo's engine on a v5e chip via
+``sim.calibrate`` (see bench.py); the reference's A100 constants
+(``constants.py:1-8``) remain available as ``A100_VLLM`` for comparison.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+
+@dataclass(frozen=True)
+class LatencyModel:
+    prefill_min_s: float
+    prefill_base_s: float
+    prefill_per_token_s: float
+    decode_base_s: float
+    decode_per_kv_token_s: float
+    decode_per_seq_s: float
+    adapter_load_s: float = 0.5  # Orbax restore of one adapter
+
+    def prefill_s(self, prompt_tokens: int) -> float:
+        return max(
+            self.prefill_min_s,
+            self.prefill_base_s + self.prefill_per_token_s * prompt_tokens,
+        )
+
+    def decode_s(self, total_kv_tokens: int, batch: int) -> float:
+        return (
+            self.decode_base_s
+            + self.decode_per_kv_token_s * total_kv_tokens
+            + self.decode_per_seq_s * batch
+        )
+
+
+# Reference calibration: A100-40GB, llama-3 arch on vLLM (constants.py:1-8).
+A100_VLLM = LatencyModel(
+    prefill_min_s=0.04,
+    prefill_base_s=0.01969,
+    prefill_per_token_s=6.769375513e-5,
+    decode_base_s=0.014,
+    decode_per_kv_token_s=5.353485087e-7,
+    decode_per_seq_s=1.026494433e-4,
+)
+
+# Placeholder v5e shape until sim.calibrate refits from the live engine:
+# prefill is MXU-bound (similar slope), decode is HBM-bound with a higher
+# fixed cost per step on one chip and near-flat batch scaling in the slot
+# regime.
+V5E_DEFAULT = LatencyModel(
+    prefill_min_s=0.02,
+    prefill_base_s=0.012,
+    prefill_per_token_s=5.5e-5,
+    decode_base_s=0.010,
+    decode_per_kv_token_s=3.0e-7,
+    decode_per_seq_s=6.0e-5,
+)
+
+
+@dataclass
+class SimRequest:
+    rid: int
+    arrival_s: float
+    prompt_tokens: int
+    output_tokens: int
+    model: str
+    adapter: str | None = None
+    critical: bool = False
+    slo_s_per_token: float = 0.025
+    # lifecycle
+    t_first_token: float = -1.0
+    t_done: float = -1.0
+    generated: int = 0
+    shed: bool = False
+
+    @property
+    def ttft_s(self) -> float:
+        return self.t_first_token - self.arrival_s if self.t_first_token >= 0 else -1
+
+    @property
+    def latency_per_output_token_s(self) -> float:
+        if self.t_done < 0 or self.output_tokens == 0:
+            return -1
+        return (self.t_done - self.arrival_s) / self.output_tokens
+
+
+@dataclass
+class _ActiveSeq:
+    request: SimRequest
+    kv_tokens: int
+
+
+class SimServer:
+    """One TPU replica: prefill queue + decode slots + KV budget + adapters."""
+
+    def __init__(
+        self,
+        name: str,
+        latency: LatencyModel,
+        decode_slots: int = 16,
+        kv_capacity_tokens: int = 44_448,
+        max_adapters: int = 4,
+    ):
+        self.name = name
+        self.pod = Pod(name=name, address=f"{name}:8000")
+        self.latency = latency
+        self.decode_slots = decode_slots
+        self.kv_capacity_tokens = kv_capacity_tokens
+        self.max_adapters = max_adapters
+        self.prefill_queue: list[SimRequest] = []
+        self.active: list[_ActiveSeq] = []
+        self.resident_adapters: dict[str, int] = {}
+        self.busy_until = 0.0
+        self.tokens_generated = 0
+
+    # -- metrics the production scheduler consumes -------------------------
+    def metrics(self) -> PodMetrics:
+        used = sum(a.kv_tokens for a in self.active)
+        return PodMetrics(
+            pod=self.pod,
+            metrics=Metrics(
+                active_adapters=dict(self.resident_adapters),
+                max_active_adapters=self.max_adapters,
+                running_queue_size=len(self.active),
+                waiting_queue_size=len(self.prefill_queue),
+                prefill_queue_size=len(self.prefill_queue),
+                decode_queue_size=0,
+                kv_cache_usage_percent=used / self.kv_capacity_tokens,
+                kv_tokens_capacity=self.kv_capacity_tokens,
+                kv_tokens_free=self.kv_capacity_tokens - used,
+            ),
+        )
+
+    # -- engine iteration (mirrors server/engine.py:_loop) ------------------
+    def kv_free(self) -> int:
+        return self.kv_capacity_tokens - sum(a.kv_tokens for a in self.active)
+
+    def _admit_would_fit(self, req: SimRequest) -> bool:
+        return req.prompt_tokens + req.output_tokens <= self.kv_free()
+
+    def step(self, now: float) -> float:
+        """Run one engine iteration starting at ``now``; return its duration.
+
+        Returns 0.0 when idle (nothing to do).
+        """
+        # Admission: prefill one queued request if a slot is free and the
+        # full sequence fits in KV (the engine's slot admission gate).
+        if (
+            self.prefill_queue
+            and len(self.active) < self.decode_slots
+            and self._admit_would_fit(self.prefill_queue[0])
+        ):
+            req = self.prefill_queue.pop(0)
+            duration = self.latency.prefill_s(req.prompt_tokens)
+            if req.adapter and req.adapter not in self.resident_adapters:
+                self.resident_adapters[req.adapter] = 0
+                duration += self.latency.adapter_load_s
+                if len(self.resident_adapters) > self.max_adapters:
+                    # Evict LRU-ish: drop an idle adapter (cost already paid).
+                    for name, refs in list(self.resident_adapters.items()):
+                        if refs == 0 and name != req.adapter:
+                            del self.resident_adapters[name]
+                            break
+            if req.adapter:
+                self.resident_adapters[req.adapter] = (
+                    self.resident_adapters.get(req.adapter, 0) + 1
+                )
+            req.t_first_token = now + duration
+            req.generated = 1
+            self.tokens_generated += 1
+            if req.generated >= req.output_tokens:
+                req.t_done = now + duration  # single-token request: done
+                if req.adapter:  # release the refcount taken above
+                    refs = self.resident_adapters.get(req.adapter, 1)
+                    self.resident_adapters[req.adapter] = max(0, refs - 1)
+            else:
+                self.active.append(_ActiveSeq(req, req.prompt_tokens + 1))
+            return duration
+
+        if self.active:
+            total_kv = sum(a.kv_tokens for a in self.active)
+            duration = self.latency.decode_s(total_kv, len(self.active))
+            finished = []
+            for seq in self.active:
+                seq.request.generated += 1
+                seq.kv_tokens += 1
+                self.tokens_generated += 1
+                if seq.request.generated >= seq.request.output_tokens:
+                    seq.request.t_done = now + duration
+                    finished.append(seq)
+            for seq in finished:
+                self.active.remove(seq)
+                if seq.request.adapter:
+                    refs = self.resident_adapters.get(seq.request.adapter, 1)
+                    self.resident_adapters[seq.request.adapter] = max(0, refs - 1)
+            return duration
+        return 0.0
+
+
+class EventLoop:
+    """Minimal DES driver: servers advance via their own iteration events."""
+
+    def __init__(self, servers: list[SimServer]):
+        self.servers = servers
+        self.now = 0.0
+        self._events: list[tuple[float, int, object]] = []
+        self._seq = 0
+
+    def schedule(self, t: float, item) -> None:
+        heapq.heappush(self._events, (t, self._seq, item))
+        self._seq += 1
+
+    def kick(self, server: SimServer) -> None:
+        """Ensure a server has a pending iteration event."""
+        if server.busy_until <= self.now:
+            self.schedule(self.now, server)
+
+    def run(self, until: float) -> None:
+        while self._events:
+            t, _, item = heapq.heappop(self._events)
+            if t > until:
+                break
+            self.now = t
+            if isinstance(item, SimServer):
+                duration = item.step(self.now)
+                if duration > 0:
+                    item.busy_until = self.now + duration
+                    self.schedule(item.busy_until, item)
+                # idle servers get re-kicked on arrival
+            elif callable(item):
+                item(self)

@@ -1,0 +1,226 @@
+"""Simulation driver: workload generation, routing sweep, metrics report.
+
+Parity: reference ``src/main.py:13-363`` — sweep QPS x routing policy, emit
+per-tier TTFT / TPOT / latency-per-token / SLO-attainment / shed counts.
+Workload matches the reference's ShareGPT-derived shape: prompt ~N(202,20),
+output ~N(179,17) (``main.py:20-27``), split across criticality tiers with
+the reference's 25 ms / 500 ms per-output-token SLOs, plus a LoRA adapter
+mix for affinity-sensitive policies.
+
+Routing policies:
+- ``random``      uniform over replicas (reference loadbalancer 'random')
+- ``least_queue`` min prefill backlog    (reference 'leastPseudo')
+- ``least_kv``    min KV utilization     (reference 'least')
+- ``production``  the REAL filter tree (gateway.scheduling.Scheduler) over
+  live simulated metrics — criticality tiers, LoRA affinity, shedding; what
+  the deployed gateway actually does (reference 'smart', minus drift).
+
+Run:  python -m llm_instance_gateway_tpu.sim.run --qps 20 30 --policies random production
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random as pyrandom
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    SchedulingError,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.sim.core import (
+    A100_VLLM,
+    EventLoop,
+    LatencyModel,
+    SimRequest,
+    SimServer,
+    V5E_DEFAULT,
+)
+
+
+@dataclass
+class WorkloadConfig:
+    qps: float = 20.0
+    duration_s: float = 120.0
+    prompt_mean: float = 202.0   # main.py:20-27
+    prompt_std: float = 20.0
+    output_mean: float = 179.0
+    output_std: float = 17.0
+    critical_fraction: float = 0.3
+    sheddable_fraction: float = 0.2
+    adapters: tuple[str, ...] = ("sql-lora", "tweet-lora", "chat-lora", "code-lora")
+    adapter_fraction: float = 0.8
+    slo_critical_s: float = 0.025   # notebook cell 18 tiers
+    slo_default_s: float = 0.5
+    seed: int = 0
+
+
+def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
+    rng = pyrandom.Random(cfg.seed)
+    reqs: list[SimRequest] = []
+    t = 0.0
+    rid = 0
+    while t < cfg.duration_s:
+        t += rng.expovariate(cfg.qps)
+        u = rng.random()
+        critical = u < cfg.critical_fraction
+        sheddable = u > 1.0 - cfg.sheddable_fraction
+        adapter = (
+            rng.choice(cfg.adapters)
+            if rng.random() < cfg.adapter_fraction
+            else None
+        )
+        reqs.append(
+            SimRequest(
+                rid=rid,
+                arrival_s=t,
+                prompt_tokens=max(8, int(rng.gauss(cfg.prompt_mean, cfg.prompt_std))),
+                output_tokens=max(4, int(rng.gauss(cfg.output_mean, cfg.output_std))),
+                model=adapter or "base",
+                adapter=adapter,
+                critical=critical and not sheddable,
+                slo_s_per_token=cfg.slo_critical_s if critical else cfg.slo_default_s,
+            )
+        )
+        rid += 1
+    return reqs
+
+
+class _SimProvider:
+    def __init__(self, servers: list[SimServer]):
+        self.servers = servers
+
+    def all_pod_metrics(self):
+        return [s.metrics() for s in self.servers]
+
+
+def make_router(policy: str, servers: list[SimServer], seed: int = 0):
+    rng = pyrandom.Random(seed)
+    by_name = {s.pod.name: s for s in servers}
+    if policy == "random":
+        return lambda req: rng.choice(servers)
+    if policy == "least_queue":
+        return lambda req: min(servers, key=lambda s: len(s.prefill_queue) + len(s.active))
+    if policy == "least_kv":
+        return lambda req: min(servers, key=lambda s: -s.kv_free())
+    if policy == "production":
+        scheduler = Scheduler(_SimProvider(servers), rng=pyrandom.Random(seed))
+
+        def route(req: SimRequest):
+            llm_req = LLMRequest(
+                model=req.model,
+                resolved_target_model=req.adapter or req.model,
+                critical=req.critical,
+                prompt_tokens=req.prompt_tokens,
+            )
+            pod = scheduler.schedule(llm_req)  # may raise SchedulingError
+            return by_name[pod.name]
+
+        return route
+    raise ValueError(f"unknown policy {policy!r}")
+
+
+@dataclass
+class SimResult:
+    policy: str
+    qps: float
+    completed: int = 0
+    shed: int = 0
+    ttfts: list[float] = field(default_factory=list)
+    per_token: list[float] = field(default_factory=list)
+    slo_hits: int = 0
+    slo_total: int = 0
+    tokens: int = 0
+
+    def summary(self) -> dict:
+        def pct(vals, p):
+            if not vals:
+                return 0.0
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+        return {
+            "policy": self.policy,
+            "qps": self.qps,
+            "completed": self.completed,
+            "shed": self.shed,
+            "tokens": self.tokens,
+            "ttft_p50_s": round(pct(self.ttfts, 0.5), 4),
+            "ttft_p99_s": round(pct(self.ttfts, 0.99), 4),
+            "latency_per_token_p50_s": round(pct(self.per_token, 0.5), 5),
+            "slo_attainment": round(self.slo_hits / self.slo_total, 4)
+            if self.slo_total else 1.0,
+        }
+
+
+def simulate(
+    policy: str,
+    workload: WorkloadConfig,
+    n_servers: int = 6,
+    latency: LatencyModel = V5E_DEFAULT,
+    decode_slots: int = 16,
+) -> SimResult:
+    servers = [
+        SimServer(f"sim-{i}", latency, decode_slots=decode_slots)
+        for i in range(n_servers)
+    ]
+    loop = EventLoop(servers)
+    router = make_router(policy, servers, seed=workload.seed)
+    requests = generate_workload(workload)
+    result = SimResult(policy=policy, qps=workload.qps)
+
+    def arrival(req: SimRequest):
+        def fire(lp: EventLoop):
+            try:
+                server = router(req)
+            except SchedulingError:
+                req.shed = True
+                result.shed += 1
+                return
+            server.prefill_queue.append(req)
+            lp.kick(server)
+
+        return fire
+
+    for req in requests:
+        loop.schedule(req.arrival_s, arrival(req))
+    # Drain: run past the workload end until queues flush.
+    loop.run(until=workload.duration_s * 3)
+
+    for req in requests:
+        if req.shed:
+            continue
+        if req.t_done < 0:
+            continue  # still in flight at drain cutoff
+        result.completed += 1
+        result.tokens += req.generated
+        result.ttfts.append(req.ttft_s)
+        lpt = req.latency_per_output_token_s
+        result.per_token.append(lpt)
+        result.slo_total += 1
+        if lpt <= req.slo_s_per_token:
+            result.slo_hits += 1
+    return result
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="routing-policy simulator")
+    parser.add_argument("--qps", type=float, nargs="+", default=[20.0, 30.0])
+    parser.add_argument("--policies", nargs="+",
+                        default=["random", "least_queue", "production"])
+    parser.add_argument("--servers", type=int, default=6)
+    parser.add_argument("--duration", type=float, default=120.0)
+    parser.add_argument("--latency-model", choices=["v5e", "a100"], default="v5e")
+    args = parser.parse_args(argv)
+    latency = V5E_DEFAULT if args.latency_model == "v5e" else A100_VLLM
+    for qps in args.qps:
+        for policy in args.policies:
+            cfg = WorkloadConfig(qps=qps, duration_s=args.duration)
+            result = simulate(policy, cfg, n_servers=args.servers, latency=latency)
+            print(json.dumps(result.summary()))
+
+
+if __name__ == "__main__":
+    main()

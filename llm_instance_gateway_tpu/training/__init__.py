@@ -1,0 +1,6 @@
+"""Training: LoRA fine-tuning + full-parameter train steps (sharded).
+
+The reference repo serves adapters but doesn't produce them; a complete
+TPU-native stack owns that loop too — the adapters the sidecar hot-swaps are
+Orbax checkpoints written by ``lora_finetune.save_trained_adapter``.
+"""

@@ -1,0 +1,100 @@
+"""Sharded train steps: full-parameter and LoRA-only fine-tuning.
+
+GSPMD training recipe: params sharded by ``parallel.sharding.param_specs``
+(fsdp + tensor), batch sharded over (data, sequence), optimizer state
+mirrors the param sharding, and the whole step — forward, causal-LM loss,
+backward, optax update — is one jitted program; XLA inserts the
+reduce-scatters/all-gathers over the mesh axes (dp/fsdp/tp/sp, ep for MoE).
+
+``lora_train_step`` freezes the base model and differentiates only the
+adapter slot buffers — the loop that produces the Orbax adapters the serving
+stack hot-swaps (models/lora.py zero-padding means the gradient is naturally
+confined to the real ranks' subspace plus harmless padded lanes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+
+
+def causal_lm_loss(cfg: ModelConfig, params, tokens, positions, lora_bufs=None,
+                   slot_ids=None) -> jax.Array:
+    """Next-token cross-entropy, masked to real (non-pad) positions.
+
+    Position 0 repeated marks padding (matching the serving convention);
+    the mask keeps pad targets out of the mean.
+    """
+    logits, _, _ = transformer.prefill(
+        cfg, params, tokens[:, :-1], positions[:, :-1],
+        lora_bufs=lora_bufs, slot_ids=slot_ids,
+    )
+    targets = tokens[:, 1:]
+    mask = (positions[:, 1:] > 0).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -(token_logp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.0):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def make_full_train_step(cfg: ModelConfig, optimizer):
+    """Full-parameter train step (not jitted; caller applies jit+shardings)."""
+
+    def step(params, opt_state, tokens, positions):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(cfg, p, tokens, positions)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_lora_train_step(cfg: ModelConfig, optimizer):
+    """Adapter-only train step: base params are frozen inputs."""
+
+    def step(params, lora_bufs, opt_state, tokens, positions, slot_ids):
+        def loss_fn(bufs):
+            return causal_lm_loss(
+                cfg, params, tokens, positions, lora_bufs=bufs, slot_ids=slot_ids
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(lora_bufs)
+        # The scale vector is serving metadata (alpha/r), not a trainable.
+        grads = {**grads, "scale": jnp.zeros_like(lora_bufs["scale"])}
+        updates, opt_state = optimizer.update(grads, opt_state, lora_bufs)
+        lora_bufs = optax.apply_updates(lora_bufs, updates)
+        return lora_bufs, opt_state, loss
+
+    return step
+
+
+def extract_adapter(cfg: ModelConfig, lora_bufs, slot: int, rank: int) -> dict:
+    """Pull one trained slot back out as a rank-r adapter weight dict
+    (inverse of models.lora.load_adapter) for Orbax export."""
+    from llm_instance_gateway_tpu.models import lora as lora_lib
+
+    weights: dict[str, Any] = {}
+    for t in lora_lib.TARGETS:
+        a = jax.device_get(lora_bufs[f"{t}_a"][:, slot, :, :rank])
+        b = jax.device_get(lora_bufs[f"{t}_b"][:, slot, :rank, :])
+        weights[t] = {"a": a, "b": b}
+    return weights
+
+
+def save_trained_adapter(path: str, cfg: ModelConfig, lora_bufs, slot: int,
+                         rank: int, alpha: float) -> None:
+    from llm_instance_gateway_tpu.server.lora_manager import save_adapter
+
+    save_adapter(path, extract_adapter(cfg, lora_bufs, slot, rank), alpha, rank)

