@@ -129,8 +129,13 @@ class GatewayProxy:
                     self.server.target_pod_header: pod.address,
                 },
             ) as upstream:
-                resp_body = await upstream.read()
                 status = upstream.status
+                if "text/event-stream" in upstream.headers.get("Content-Type", ""):
+                    # Streamed generation: relay SSE chunks as they arrive —
+                    # buffering would defeat streaming, and usage accounting
+                    # happens from the stream's final chunk if present.
+                    return await self._relay_stream(request, upstream, pod, req_ctx)
+                resp_body = await upstream.read()
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             self.metrics.record_error()
             logger.warning("upstream %s failed: %s", pod.address, e)
@@ -154,6 +159,58 @@ class GatewayProxy:
         headers = {"x-served-by": pod.name, **hdr_result.set_headers}
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
+
+    async def _relay_stream(self, request: web.Request, upstream, pod,
+                            req_ctx) -> web.StreamResponse:
+        """Relay an SSE stream; never raises once headers are sent.
+
+        A mid-stream upstream failure must terminate THIS prepared response
+        (error event + [DONE]) — bubbling up would make the handler try to
+        send a second response on the same request.  SSE lines are re-framed
+        through a byte buffer so a data line split across transport chunks
+        still parses (usage rides the final chunk).
+        """
+        resp = web.StreamResponse(
+            status=upstream.status,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "x-served-by": pod.name,
+            },
+        )
+        await resp.prepare(request)
+        last_data_line = b""
+        buf = b""
+        try:
+            async for chunk in upstream.content.iter_any():
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    if line.startswith(b"data: ") and line != b"data: [DONE]":
+                        last_data_line = line
+                await resp.write(chunk)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            self.metrics.record_error()
+            logger.warning("upstream stream from %s broke: %s", pod.address, e)
+            try:
+                await resp.write(
+                    b'data: {"error": {"message": "upstream stream interrupted"}}\n\n'
+                    b"data: [DONE]\n\n"
+                )
+            except ConnectionResetError:
+                pass
+            return resp
+        try:
+            final = json.loads(last_data_line[len(b"data: "):])
+            usage = final.get("usage") or {}
+            self.metrics.record_usage(
+                req_ctx.model,
+                int(usage.get("prompt_tokens", 0) or 0),
+                int(usage.get("completion_tokens", 0) or 0),
+            )
+        except (json.JSONDecodeError, ValueError):
+            pass
+        return resp
 
     # -- ops endpoints -----------------------------------------------------
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -87,6 +87,84 @@ class ModelServer:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self.engine.generate, req)
 
+    # -- streaming ---------------------------------------------------------
+    async def _stream_sse(self, http_request: web.Request, req, model: str,
+                          object_name: str, make_delta,
+                          timeout_s: float = 600.0):
+        """Server-sent-events generation stream (OpenAI stream=true shape).
+
+        Tokens appear in ``req.output_tokens`` as the engine decodes (in
+        K-step blocks); each wake decodes only the unconsumed suffix and
+        emits it as one chunk.  A suffix ending in a replacement char is held
+        back whole — likely a multi-byte UTF-8 sequence the next block
+        completes.  Submission happens BEFORE headers so saturation is a real
+        429 (the gateway's backpressure contract), and the done flag is read
+        BEFORE the token count so the final re-diff can't drop a tail.
+        """
+        try:
+            self.engine.submit(req)
+        except ValueError as e:
+            return _err(400, str(e))
+        except queue_mod.Full:
+            return _err(429, "prefill queue is full")
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "x-accel-buffering": "no",
+            }
+        )
+        await resp.prepare(http_request)
+        loop = asyncio.get_running_loop()
+        consumed = 0  # tokens already emitted as text
+        deadline = time.monotonic() + timeout_s
+
+        async def emit(payload: dict) -> None:
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        while True:
+            await loop.run_in_executor(None, req.stream_event.wait, 0.25)
+            req.stream_event.clear()
+            done = req.done.is_set()  # read BEFORE the token count
+            n = len(req.output_tokens)
+            if n > consumed:
+                text = self.tokenizer.decode(req.output_tokens[consumed:])
+                if text.endswith("�") and not done:
+                    pass  # incomplete sequence: re-decode this suffix next wake
+                elif text:
+                    consumed = n
+                    await emit({
+                        "id": f"cmpl-{req.request_id}",
+                        "object": object_name,
+                        "model": model,
+                        "choices": [make_delta(text, None)],
+                    })
+            if done:
+                # Final re-diff: anything appended since the last emit (or a
+                # held-back tail) rides the final chunk.
+                tail = (
+                    self.tokenizer.decode(req.output_tokens[consumed:])
+                    if len(req.output_tokens) > consumed else ""
+                )
+                await emit({
+                    "id": f"cmpl-{req.request_id}",
+                    "object": object_name,
+                    "model": model,
+                    "choices": [make_delta(tail, req.finish_reason or "stop")],
+                    "usage": {
+                        "prompt_tokens": len(req.prompt_tokens),
+                        "completion_tokens": len(req.output_tokens),
+                        "total_tokens": len(req.prompt_tokens) + len(req.output_tokens),
+                    },
+                })
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+            if time.monotonic() > deadline:
+                await emit({"error": {"message": "generation timed out"}})
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+
     # -- inference ---------------------------------------------------------
     async def handle_completions(self, request: web.Request) -> web.Response:
         try:
@@ -99,6 +177,12 @@ class ModelServer:
             return _err(404, str(e))
         prompt_tokens = self._encode_prompt(body)
         req = self._make_request(body, prompt_tokens, adapter)
+        if body.get("stream"):
+            return await self._stream_sse(
+                request, req, body.get("model", self.model_name),
+                "text_completion",
+                lambda delta, fin: {"index": 0, "text": delta, "finish_reason": fin},
+            )
         try:
             req = await self._run(req)
         except ValueError as e:
@@ -142,6 +226,16 @@ class ModelServer:
         except AdapterError as e:
             return _err(404, str(e))
         req = self._make_request(body, self.tokenizer.encode(prompt), adapter)
+        if body.get("stream"):
+            return await self._stream_sse(
+                request, req, body.get("model", self.model_name),
+                "chat.completion.chunk",
+                lambda delta, fin: {
+                    "index": 0,
+                    "delta": ({"content": delta} if delta else {}),
+                    "finish_reason": fin,
+                },
+            )
         try:
             req = await self._run(req)
         except ValueError as e:

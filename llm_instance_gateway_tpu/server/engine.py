@@ -301,18 +301,28 @@ class Engine:
     def _loop(self) -> None:
         while self._running:
             did_work = False
-            # 1) Admit one queued request if a slot is free (prefill).
-            if self._free_slot_index() is not None and not self.prefill_queue.empty():
+            # 1) Drain admissions: fill EVERY free slot before decoding.
+            # With multi-step decode a slot left empty idles for a whole
+            # K-step block; prefilling back-to-back keeps the batch full.
+            while self._free_slot_index() is not None and not self.prefill_queue.empty():
                 try:
                     req = self.prefill_queue.get_nowait()
                 except queue_mod.Empty:
-                    req = None
-                if req is not None:
-                    self._do_prefill(req)
-                    did_work = True
-            # 2) One decode step for all active slots.
+                    break
+                self._do_prefill(req)
+                did_work = True
+            # 2) One fused decode block for all active slots.
             if any(s is not None for s in self.slots):
-                self._do_decode_step()
+                try:
+                    self._do_decode_step()
+                except Exception as e:  # engine must survive; fail the batch
+                    logger.exception("decode step failed")
+                    for i, slot in enumerate(self.slots):
+                        if slot is not None:
+                            slot.request.error = str(e)
+                            self._finish(slot.request, "error")
+                            self.slots[i] = None
+                            self._slot_lora[i] = -1
                 did_work = True
             if not did_work:
                 with self._work:

@@ -1,0 +1,162 @@
+"""Fit the simulator's latency model from the live engine on real hardware.
+
+The reference calibrated its simulator constants offline against vLLM on
+A100 (``constants.py:1-8``, notebook cells 2 & 5); this module does the same
+against OUR engine on the TPU it will serve from, so retuned scheduler
+thresholds transfer (SURVEY.md §7 step 7: "refit prefill/decode constants to
+TPU continuous batching ... before burning TPU hours").
+
+Method: time the engine's jitted prefill across bucket lengths (linear fit
+prefill = c0 + c1 * tokens) and decode blocks across batch sizes and cache
+fills (least-squares fit decode = c3 + c4 * kv_tokens + c_batch * batch),
+all including the host dispatch/readback overhead the serving loop actually
+pays.
+
+Run:  python -m llm_instance_gateway_tpu.sim.calibrate          # bench model
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from llm_instance_gateway_tpu.sim.core import LatencyModel
+
+
+def _time_call(fn, n: int = 5) -> float:
+    fn()  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def calibrate_from_engine(
+    engine,
+    prefill_lengths: tuple[int, ...] = (64, 128, 256),
+    decode_fills: tuple[int, ...] = (32, 128, 256, 448),
+    repeats: int = 5,
+) -> LatencyModel:
+    import jax
+    import jax.numpy as jnp
+
+    cfg = engine.model_cfg
+    usable = [
+        b for b in prefill_lengths
+        if b in engine.cfg.prefill_buckets and b < engine.cfg.max_seq_len
+    ] or [engine.cfg.prefill_buckets[0]]
+
+    # --- prefill: one padded prompt per bucket, incl. first-token readback.
+    xs, ys = [], []
+    for bucket in usable:
+        tokens = jnp.zeros((1, bucket), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(bucket), (1, bucket)).astype(jnp.int32)
+
+        def call(bucket=bucket, tokens=tokens, positions=positions):
+            first, k, v = engine._jit_prefill(
+                engine.params, engine._lora_buffers(), tokens, positions,
+                jnp.int32(bucket), jnp.int32(-1),
+                jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
+                jax.random.PRNGKey(0),
+            )
+            np.asarray(first)
+
+        xs.append(bucket)
+        ys.append(_time_call(call, repeats))
+    if len(xs) >= 2:
+        c1, c0 = np.polyfit(np.asarray(xs, np.float64), np.asarray(ys, np.float64), 1)
+        c1 = max(float(c1), 1e-7)
+        c0 = max(float(c0), 1e-4)
+    else:
+        c0, c1 = ys[0], 1e-6
+
+    # --- decode: the engine always steps ALL slots (lockstep batching), so
+    # batch size is structurally constant and cannot be a regressor — the
+    # varying signal is cache occupancy.  Fit per-step cost against total KV
+    # tokens read (b_slots * fill); attribute the batch-proportional part of
+    # the base cost to per_seq so the sim scales sanely at other slot counts.
+    n_steps = max(1, engine.cfg.decode_steps_per_sync)
+    b_slots = engine.cfg.decode_slots
+    kv_totals, times = [], []
+    for fill in decode_fills:
+        if fill >= engine.cfg.max_seq_len:
+            continue
+        tokens = jnp.zeros((b_slots,), jnp.int32)
+        positions = jnp.full((b_slots,), fill, jnp.int32)
+        slots = jnp.full((b_slots,), -1, jnp.int32)
+        t = jnp.zeros((b_slots,), jnp.float32)
+        k = jnp.zeros((b_slots,), jnp.int32)
+        p = jnp.ones((b_slots,), jnp.float32)
+
+        def call(tokens=tokens, positions=positions, slots=slots, t=t, k=k, p=p):
+            toks, engine.cache = engine._jit_decode(
+                engine.params, engine._lora_buffers(), engine.cache,
+                tokens, positions, slots, t, k, p,
+                jax.random.PRNGKey(0), n_steps=n_steps,
+            )
+            np.asarray(toks)
+
+        kv_totals.append(float(b_slots * fill))
+        times.append(_time_call(call, repeats) / n_steps)
+    if len(kv_totals) >= 2:
+        c4, c3 = np.polyfit(np.asarray(kv_totals), np.asarray(times), 1)
+        c4 = max(float(c4), 0.0)
+        c3 = max(float(c3), 1e-5)
+    else:
+        c3, c4 = times[0], 0.0
+    # Split the fixed per-step cost: half stays as the step floor, half
+    # scales with batch (a heuristic the fit cannot identify — documented).
+    c_batch = (c3 / 2.0) / b_slots
+    c3 = c3 / 2.0
+
+    return LatencyModel(
+        prefill_min_s=min(ys),
+        prefill_base_s=c0,
+        prefill_per_token_s=c1,
+        decode_base_s=c3,
+        decode_per_kv_token_s=c4,
+        decode_per_seq_s=c_batch,
+    )
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import runpy
+    import os
+
+    bench = runpy.run_path(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "bench.py")
+    )
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+    cfg = bench["bench_model_cfg"]()
+    on_cpu = jax.default_backend() == "cpu"
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    engine = Engine(
+        cfg, params,
+        EngineConfig(decode_slots=4 if on_cpu else 16,
+                     max_seq_len=cfg.max_seq_len,
+                     prefill_buckets=(32, 64, 128) if on_cpu else (64, 128, 256),
+                     decode_steps_per_sync=1 if on_cpu else 8),
+        dtype=dtype,
+    )
+    model = calibrate_from_engine(engine)
+    print(json.dumps({
+        "model": cfg.name,
+        "prefill_min_s": round(model.prefill_min_s, 6),
+        "prefill_base_s": round(model.prefill_base_s, 6),
+        "prefill_per_token_s": round(model.prefill_per_token_s, 9),
+        "decode_base_s": round(model.decode_base_s, 6),
+        "decode_per_kv_token_s": round(model.decode_per_kv_token_s, 12),
+        "decode_per_seq_s": round(model.decode_per_seq_s, 9),
+    }))
+
+
+if __name__ == "__main__":
+    main()

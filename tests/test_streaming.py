@@ -1,0 +1,147 @@
+"""SSE streaming tests: server emits incremental chunks; proxy relays them."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.server import Server as HandlerServer
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.testing import fake_metrics, make_model
+from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import TINY_TEST
+from llm_instance_gateway_tpu.server.api_http import ModelServer
+from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+from llm_instance_gateway_tpu.server.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def model_server():
+    params = transformer.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = Engine(
+        TINY_TEST, params,
+        EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(8, 16, 32),
+                     decode_steps_per_sync=2),
+        eos_id=None, dtype=jnp.float32,
+    )
+    engine.start()
+    server = ModelServer(engine, ByteTokenizer(), "llama3-tiny")
+    yield server
+    engine.stop()
+
+
+def parse_sse(raw: bytes):
+    chunks = []
+    for line in raw.split(b"\n"):
+        if line.startswith(b"data: "):
+            payload = line[6:]
+            if payload == b"[DONE]":
+                chunks.append("DONE")
+            else:
+                chunks.append(json.loads(payload))
+    return chunks
+
+
+def test_server_streams_chunks(model_server):
+    async def run():
+        client = TestClient(TestServer(model_server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/completions", json={
+                "model": "llama3-tiny", "prompt": "hi", "max_tokens": 12,
+                "stream": True,
+            })
+            assert resp.status == 200
+            assert "text/event-stream" in resp.headers["Content-Type"]
+            raw = await resp.read()
+        finally:
+            await client.close()
+        chunks = parse_sse(raw)
+        assert chunks[-1] == "DONE"
+        final = chunks[-2]
+        assert final["usage"]["completion_tokens"] == 12
+        assert final["choices"][0]["finish_reason"] == "length"
+        streamed_text = "".join(
+            c["choices"][0].get("text", "") for c in chunks[:-1] if c != "DONE"
+        )
+        # Streamed text must equal the non-streamed result for the same input.
+        resp2_client = TestClient(TestServer(model_server.build_app()))
+        await resp2_client.start_server()
+        try:
+            r2 = await resp2_client.post("/v1/completions", json={
+                "model": "llama3-tiny", "prompt": "hi", "max_tokens": 12,
+            })
+            body2 = await r2.json()
+        finally:
+            await resp2_client.close()
+        assert streamed_text == body2["choices"][0]["text"]
+
+    asyncio.run(run())
+
+
+def test_chat_stream_delta_shape(model_server):
+    async def run():
+        client = TestClient(TestServer(model_server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "llama3-tiny",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6, "stream": True,
+            })
+            raw = await resp.read()
+        finally:
+            await client.close()
+        chunks = parse_sse(raw)
+        assert chunks[-1] == "DONE"
+        assert chunks[0]["object"] == "chat.completion.chunk"
+        assert any("content" in c["choices"][0].get("delta", {})
+                   for c in chunks[:-1] if c != "DONE")
+
+    asyncio.run(run())
+
+
+def test_proxy_relays_stream(model_server):
+    async def run():
+        upstream_client = TestServer(model_server.build_app())
+        await upstream_client.start_server()
+        addr = f"127.0.0.1:{upstream_client.port}"
+        ds = Datastore(pods=[Pod("r1", addr)])
+        ds.set_pool(InferencePool(name="pool"))
+        ds.store_model(make_model("llama3-tiny"))
+        provider = StaticProvider(
+            [PodMetrics(pod=Pod("r1", addr), metrics=fake_metrics())]
+        )
+        proxy = GatewayProxy(
+            HandlerServer(Scheduler(provider, token_aware=False, prefill_aware=False), ds),
+            provider, ds,
+        )
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/completions", json={
+                "model": "llama3-tiny", "prompt": "stream me", "max_tokens": 8,
+                "stream": True,
+            })
+            assert resp.status == 200
+            assert "text/event-stream" in resp.headers["Content-Type"]
+            assert resp.headers["x-served-by"] == "r1"
+            raw = await resp.read()
+        finally:
+            await client.close()
+            await upstream_client.close()
+        chunks = parse_sse(raw)
+        assert chunks[-1] == "DONE"
+        # Usage accounted at the gateway from the stream's final chunk.
+        text = proxy.metrics.render()
+        assert 'gateway_completion_tokens_total{model="llama3-tiny"} 8' in text
+
+    asyncio.run(run())
