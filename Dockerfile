@@ -1,0 +1,27 @@
+# Framework image: gateway, model server, and sidecar all run from this one
+# image (the deploy/ manifests select the entrypoint via `command:`).
+# Parity: reference multistage Dockerfile -> distroless EPP image
+# (Dockerfile:1-20); here the runtime is Python+JAX, and the TPU runtime
+# libraries come from the libtpu wheel.
+FROM python:3.12-slim AS base
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /srv/tpu-inference-gateway
+
+# Pinned serving deps; jax[tpu] pulls libtpu for GKE TPU node pools.
+RUN pip install --no-cache-dir \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        optax orbax-checkpoint aiohttp grpcio protobuf pyyaml jsonschema numpy
+
+COPY llm_instance_gateway_tpu/ llm_instance_gateway_tpu/
+COPY bench.py ./
+
+# Pre-build the native scheduler so first pick isn't a compile.
+RUN make -C llm_instance_gateway_tpu/native
+
+ENV PYTHONPATH=/srv/tpu-inference-gateway
+ENTRYPOINT ["python"]
+CMD ["-m", "llm_instance_gateway_tpu.gateway.proxy", "--help"]

@@ -1,0 +1,35 @@
+# Developer entrypoints (kubebuilder-style targets, reference Makefile parity).
+
+IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
+
+.PHONY: test test-e2e native bench loadgen sim docker-build install deploy undeploy fmt
+
+test:            ## unit + integration tests (CPU, virtual 8-device mesh)
+	python -m pytest tests/ -q -m "not e2e"
+
+test-e2e:        ## full local stack: server + gateway + sidecar as processes
+	python -m pytest tests/test_e2e_local.py -q -m e2e
+
+native:          ## build the C++ scheduler hot path
+	$(MAKE) -C llm_instance_gateway_tpu/native
+
+bench:           ## north-star benchmark (one JSON line; runs on the TPU)
+	python bench.py
+
+loadgen:         ## gateway load rig (200 fake pods x 5 adapters)
+	python -m llm_instance_gateway_tpu.gateway.loadgen --requests 10000
+
+sim:             ## routing-policy simulation sweep
+	python -m llm_instance_gateway_tpu.sim.run --qps 20 30 --policies random production
+
+docker-build:    ## build the framework image
+	docker build -t $(IMG) .
+
+install:         ## install CRDs
+	kubectl apply -f deploy/crds/
+
+deploy: install  ## deploy gateway + model-server pool
+	kubectl apply -f deploy/gateway/ -f deploy/model-server/
+
+undeploy:
+	kubectl delete -f deploy/gateway/ -f deploy/model-server/ --ignore-not-found

@@ -112,10 +112,12 @@ def main() -> None:
         max_seq_len=cfg.max_seq_len,
         prefill_buckets=(32, 128) if on_cpu else (128, 256),
         # Amortize per-dispatch latency (the device->host token readback
-        # costs ~77ms through the remote-TPU relay; measured K sweep:
-        # K=1 -> 208 tok/s, K=8 -> 1001, K=32 -> 1662 device-side; end-to-end
-        # bench: K=8 -> 271, K=16+drained admissions -> 492, K=32 -> 511).
-        decode_steps_per_sync=1 if on_cpu else 32,
+        # costs ~77ms through the remote-TPU relay; measured end-to-end:
+        # sync K=8 -> 271, K=32 -> 511; pipelined K=8 -> 1046 tok/s).
+        decode_steps_per_sync=1 if on_cpu else 8,
+        # Hide the readback entirely: block N+1 dispatches from the device
+        # carry while block N's tokens transfer.
+        pipeline_decode=not on_cpu,
     )
 
     # Phase A: TRUE single-tenant baseline — no LoRA machinery at all

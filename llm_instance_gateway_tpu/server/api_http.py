@@ -348,6 +348,11 @@ def main(argv=None) -> None:
     parser.add_argument("--decode-slots", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=1024)
     parser.add_argument("--max-loras", type=int, default=4)
+    parser.add_argument("--decode-steps", type=int, default=8,
+                        help="fused decode steps per host sync (K)")
+    parser.add_argument("--pipeline-decode", action="store_true",
+                        help="overlap token readback with the next decode "
+                             "block (finish detection lags one block)")
     parser.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     parser.add_argument("--checkpoint", default=None, help="Orbax params dir")
     parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
@@ -382,7 +387,11 @@ def main(argv=None) -> None:
     lora_manager = LoRAManager(cfg, dtype=dtype)
     engine = Engine(
         cfg, params,
-        EngineConfig(decode_slots=args.decode_slots, max_seq_len=args.max_seq_len),
+        EngineConfig(
+            decode_slots=args.decode_slots, max_seq_len=args.max_seq_len,
+            decode_steps_per_sync=args.decode_steps,
+            pipeline_decode=args.pipeline_decode,
+        ),
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,
         dtype=dtype,

@@ -54,6 +54,11 @@ class EngineConfig:
     # tunnel); K>1 amortizes it at the cost of up to K-1 tokens decoded past
     # a stop condition (trimmed host-side) and K-step admission latency.
     decode_steps_per_sync: int = 1
+    # Pipelined decode: dispatch block N+1 from the device-resident token
+    # carry BEFORE reading block N's tokens, overlapping the host readback
+    # with compute.  Finish detection lags one block (a finishing slot decodes
+    # one extra garbage block, trimmed host-side), so pair with moderate K.
+    pipeline_decode: bool = False
     # Tokens/sec EMA smoothing for the exported throughput gauge.
     tps_ema_alpha: float = 0.2
 
@@ -94,6 +99,9 @@ class _Slot:
     request: Request
     lora_slot: int
     position: int  # position of the NEXT token to generate
+    # Pipelined mode: device array holding the prefill's first sampled token,
+    # materialized when this slot's first decode block is processed.
+    pending_first: object = None
 
 
 class Engine:
@@ -201,10 +209,12 @@ class Engine:
             return (cache, next_tokens, positions + 1), next_tokens
 
         keys = jax.random.split(key, n_steps)
-        (cache, _, _), toks = jax.lax.scan(
+        (cache, next_tokens, next_positions), toks = jax.lax.scan(
             one_step, (cache, tokens, positions), keys
         )
-        return toks, cache
+        # next_tokens/next_positions are the device-side carry for pipelined
+        # dispatch of the following block (no host round-trip needed).
+        return toks, next_tokens, next_positions, cache
 
     # ------------------------------------------------------------------
     # public API
@@ -212,7 +222,8 @@ class Engine:
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        target = self._loop_pipelined if self.cfg.pipeline_decode else self._loop
+        self._thread = threading.Thread(target=target, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
@@ -317,12 +328,7 @@ class Engine:
                     self._do_decode_step()
                 except Exception as e:  # engine must survive; fail the batch
                     logger.exception("decode step failed")
-                    for i, slot in enumerate(self.slots):
-                        if slot is not None:
-                            slot.request.error = str(e)
-                            self._finish(slot.request, "error")
-                            self.slots[i] = None
-                            self._slot_lora[i] = -1
+                    self._fail_all_slots(e)
                 did_work = True
             if not did_work:
                 with self._work:
@@ -379,7 +385,7 @@ class Engine:
     def _do_decode_step(self) -> None:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
         t0 = time.perf_counter()
-        step_tokens, self.cache = self._jit_decode(
+        step_tokens, _, _, self.cache = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
             jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
             jnp.asarray(self._slot_lora),
@@ -410,6 +416,180 @@ class Engine:
             req.stream_event.set()
             if not finished:
                 self._slot_positions[i] = slot.position
+        with self._lock:
+            self.total_generated += n_tokens
+            inst = n_tokens / step_s if step_s > 0 else 0.0
+            a = self.cfg.tps_ema_alpha
+            self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
+
+    # ------------------------------------------------------------------
+    # pipelined decode: overlap host readback with the next device block
+    # ------------------------------------------------------------------
+
+    def _loop_pipelined(self) -> None:
+        """Two-deep pipeline: dispatch block N+1 from the device-resident
+        token/position carry BEFORE materializing block N's tokens, so the
+        (expensive, relay-bound) device->host readback overlaps compute.
+
+        Consequences handled here:
+        - finish detection lags one block: a finishing slot decodes one extra
+          block of garbage into its own lane (trimmed; its row in the
+          already-dispatched block is invalidated on free);
+        - prefill first-tokens stay on device (async-copied) and materialize
+          when their slot's first block is processed.
+        """
+        b = self.cfg.decode_slots
+        self._dev_tokens = jnp.zeros((b,), jnp.int32)
+        self._dev_positions = jnp.zeros((b,), jnp.int32)
+        inflight: dict | None = None
+        while self._running:
+            did_work = False
+            while self._free_slot_index() is not None and not self.prefill_queue.empty():
+                try:
+                    req = self.prefill_queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                self._do_prefill_pipelined(req)
+                did_work = True
+            block = None
+            if any(s is not None for s in self.slots):
+                try:
+                    block = self._dispatch_block()
+                except Exception as e:
+                    logger.exception("pipelined decode dispatch failed")
+                    self._fail_all_slots(e)
+                did_work = True
+            if inflight is not None:
+                self._process_block(inflight, current=block)
+                did_work = True
+            inflight = block
+            if not did_work:
+                with self._work:
+                    self._work.wait(timeout=0.05)
+        if inflight is not None:
+            self._process_block(inflight, current=None)
+
+    def _fail_all_slots(self, e: Exception) -> None:
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                slot.request.error = str(e)
+                self._finish(slot.request, "error")
+                self.slots[i] = None
+                self._slot_lora[i] = -1
+
+    def _do_prefill_pipelined(self, req: Request) -> None:
+        """Prefill + insert with NO synchronous readback: the first token is
+        scattered into the device carry and async-copied for later use."""
+        try:
+            slot_idx = self._free_slot_index()
+            n = len(req.prompt_tokens)
+            bucket = self._bucket(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_tokens
+            positions = np.zeros((1, bucket), np.int32)
+            positions[0, :n] = np.arange(n)
+            lora_slot = (
+                self.lora.slot_for(req.adapter) if self.lora is not None else -1
+            )
+            sp = req.sampling
+            first_token, k, v = self._jit_prefill(
+                self.params, self._lora_buffers(),
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.int32(n), jnp.int32(lora_slot),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), self._next_key(),
+            )
+            self.cache = self._jit_insert(
+                self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
+            )
+            self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
+            self._dev_positions = self._dev_positions.at[slot_idx].set(n)
+            try:
+                first_token.copy_to_host_async()
+            except AttributeError:
+                pass
+            # t_first_token is stamped when the token MATERIALIZES in
+            # _process_block — stamping here would understate TTFT by a block.
+            slot = _Slot(request=req, lora_slot=lora_slot, position=n)
+            slot.pending_first = first_token
+            self.slots[slot_idx] = slot
+            self._slot_lora[slot_idx] = lora_slot
+            self._slot_temp[slot_idx] = sp.temperature
+            self._slot_topk[slot_idx] = sp.top_k
+            self._slot_topp[slot_idx] = sp.top_p
+        except Exception as e:
+            logger.exception("pipelined prefill failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
+    def _dispatch_block(self) -> dict:
+        n_steps = max(1, self.cfg.decode_steps_per_sync)
+        toks, next_tokens, next_positions, self.cache = self._jit_decode(
+            self.params, self._lora_buffers(), self.cache,
+            self._dev_tokens, self._dev_positions,
+            jnp.asarray(self._slot_lora),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp), self._next_key(),
+            n_steps=n_steps,
+        )
+        self._dev_tokens = next_tokens
+        self._dev_positions = next_positions
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        return {
+            "toks": toks,
+            "rows": list(self.slots),  # request refs valid at dispatch time
+            "n_steps": n_steps,
+            "t0": time.perf_counter(),
+        }
+
+    def _process_block(self, blk: dict, current: dict | None) -> None:
+        toks_np = np.asarray(blk["toks"])  # overlaps with `current` computing
+        n_tokens = 0
+        for i, slot in enumerate(blk["rows"]):
+            if slot is None:
+                continue
+            req = slot.request
+            if req.done.is_set():
+                continue
+            finished = False
+            pending = getattr(slot, "pending_first", None)
+            if pending is not None:
+                tok0 = int(np.asarray(pending))
+                slot.pending_first = None
+                req.t_first_token = time.time()
+                req.output_tokens.append(tok0)
+                n_tokens += 1
+                with self._lock:
+                    self.ttft_history.append(req.ttft_s)
+                    if len(self.ttft_history) > 1000:
+                        del self.ttft_history[:500]
+                if self._is_finished(req, tok0):
+                    finished = True
+            if not finished:
+                for k in range(blk["n_steps"]):
+                    tok = int(toks_np[k, i])
+                    req.output_tokens.append(tok)
+                    n_tokens += 1
+                    slot.position += 1
+                    if (
+                        self._is_finished(req, tok)
+                        or slot.position >= self.cfg.max_seq_len - 1
+                    ):
+                        finished = True
+                        break
+            req.stream_event.set()
+            if finished:
+                self._finish(req, "stop" if self._is_stop(req, req.output_tokens[-1])
+                             else "length")
+                if self.slots[i] is slot:
+                    self.slots[i] = None
+                    self._slot_lora[i] = -1
+                if current is not None and current["rows"][i] is slot:
+                    current["rows"][i] = None  # its lane in-flight is garbage
+        step_s = time.perf_counter() - blk["t0"]
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0

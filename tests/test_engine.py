@@ -109,14 +109,16 @@ class TestGeneration:
         with pytest.raises(ValueError, match="exceeds"):
             engine.submit(make_req(tuple(range(100))))
 
-    def test_multistep_decode_matches_single_step(self, engine_env):
-        """decode_steps_per_sync must not change outputs (greedy)."""
+    @pytest.mark.parametrize("pipeline", [False, True], ids=["sync", "pipelined"])
+    def test_multistep_decode_matches_single_step(self, engine_env, pipeline):
+        """decode_steps_per_sync / pipelining must not change outputs (greedy)."""
         engine, _, params = engine_env
         want = engine.generate(make_req((7, 8, 9), max_new=7), timeout_s=60).output_tokens
         multi = Engine(
             CFG, params,
             EngineConfig(decode_slots=4, max_seq_len=64,
-                         prefill_buckets=(8, 16, 32), decode_steps_per_sync=4),
+                         prefill_buckets=(8, 16, 32), decode_steps_per_sync=4,
+                         pipeline_decode=pipeline),
             lora_manager=None, eos_id=None, dtype=jnp.float32,
         )
         multi.start()
@@ -125,6 +127,33 @@ class TestGeneration:
         finally:
             multi.stop()
         assert got == want
+
+    def test_pipelined_concurrent_consistency(self, engine_env):
+        """Pipelined engine under churn (slot reuse, mixed lengths) must match
+        the sequential reference outputs exactly."""
+        engine, _, params = engine_env
+        prompts = [(5, 6, 7), (9, 9), (1, 2, 3, 4, 5, 6), (200, 100), (42,), (3, 3, 3)]
+        want = [
+            engine.generate(make_req(p, max_new=5 + (i % 3)), timeout_s=60).output_tokens
+            for i, p in enumerate(prompts)
+        ]
+        piped = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=2, max_seq_len=64,
+                         prefill_buckets=(8, 16, 32), decode_steps_per_sync=3,
+                         pipeline_decode=True),
+            lora_manager=None, eos_id=None, dtype=jnp.float32,
+        )
+        piped.start()
+        try:
+            reqs = [make_req(p, max_new=5 + (i % 3)) for i, p in enumerate(prompts)]
+            for r in reqs:
+                piped.submit(r)
+            for r in reqs:
+                assert r.done.wait(60)
+        finally:
+            piped.stop()
+        assert [r.output_tokens for r in reqs] == want
 
 
 class TestLoRAMultiplexing:
