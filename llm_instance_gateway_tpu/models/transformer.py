@@ -29,6 +29,7 @@ from llm_instance_gateway_tpu.models import lora as lora_lib
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.ops.attention import decode_attention, prefill_attention
 from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm, swiglu
+from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
 
 Params = dict[str, Any]
 
@@ -94,8 +95,8 @@ def init_decode_cache(
 
 
 def _project(x, w, layer_lora, target, slot_ids):
-    """x @ w plus the per-row LoRA delta for ``target``."""
-    out = x @ w
+    """x @ w plus the per-row LoRA delta for ``target`` (w may be int8)."""
+    out = q_matmul(x, w)
     if layer_lora is not None:
         out = out + lora_lib.lora_delta(
             x,
@@ -191,7 +192,7 @@ def prefill(
     h, (k_all, v_all) = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (h @ head).astype(jnp.float32)
+    logits = q_matmul(h, head).astype(jnp.float32)
     return logits, k_all, v_all
 
 
@@ -250,7 +251,7 @@ def decode_step(
     h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (h @ head).astype(jnp.float32)
+    logits = q_matmul(h, head).astype(jnp.float32)
     new_cache = {"k": k_new, "v": v_new, "length": lengths}
     return logits, new_cache
 

@@ -1,0 +1,75 @@
+"""Weight-only int8 quantization for serving.
+
+Decode throughput on a single chip is bounded by reading the weights from
+HBM every step; storing the big projection matrices as int8 with per-output-
+channel scales halves that traffic versus bf16.  The matmul runs as
+``(x @ w_int8.astype(bf16)) * scale`` — XLA fuses the widening into the MXU
+feed, so HBM sees int8 while the MXU still computes in bf16, and the
+per-column scale is algebraically exact to apply after the contraction.
+
+Quantized leaves are dicts ``{"q": int8 [..., in, out], "s": f32 [..., out]}``
+in place of the dense array; ``models.transformer`` dispatches through
+``matmul`` below so dense and quantized checkpoints share one forward.
+Symmetric per-channel quantization of ~normal weights keeps relative error
+around 0.4% per matmul (validated in tests/test_quant.py).
+
+Scope (v1): the seven per-layer projections + lm_head.  Embeddings (gather,
+not matmul), norms, MoE expert stacks, and LoRA buffers stay bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
+    """Symmetric per-output-channel int8 quantization (last axis = out)."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0  # [..., 1, out]
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.squeeze(-2).astype(jnp.float32)}
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def matmul(x: jax.Array, w: Any) -> jax.Array:
+    """x @ w for dense arrays or quantized {"q","s"} leaves."""
+    if is_quantized(w):
+        y = x @ w["q"].astype(x.dtype)
+        return y * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_params(params: dict, quantize_lm_head: bool = True) -> dict:
+    """Return a params tree with the big projections int8-quantized."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in QUANT_TARGETS:
+        w = layers.get(name)
+        if w is None or is_quantized(w):
+            continue
+        if w.ndim == 4:  # MoE expert stacks: keep dense in v1
+            continue
+        layers[name] = quantize_weight(w)
+    out["layers"] = layers
+    if quantize_lm_head and "lm_head" in params and not is_quantized(params["lm_head"]):
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
+def quantized_bytes(params: dict) -> tuple[int, int]:
+    """(bytes_now, bytes_if_dense_bf16) for the weight tree — memory audit."""
+    now = 0
+    dense = 0
+    for leaf in jax.tree.leaves(params):
+        now += leaf.size * leaf.dtype.itemsize
+        dense += leaf.size * (2 if leaf.dtype == jnp.int8 else leaf.dtype.itemsize)
+    return now, dense

@@ -353,6 +353,8 @@ def main(argv=None) -> None:
     parser.add_argument("--pipeline-decode", action="store_true",
                         help="overlap token readback with the next decode "
                              "block (finish detection lags one block)")
+    parser.add_argument("--quantize", choices=["none", "int8"], default="none",
+                        help="weight-only quantization of the big projections")
     parser.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     parser.add_argument("--checkpoint", default=None, help="Orbax params dir")
     parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
@@ -383,6 +385,11 @@ def main(argv=None) -> None:
     else:
         logger.warning("no --checkpoint: serving RANDOM weights (dev mode)")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    if args.quantize == "int8":
+        from llm_instance_gateway_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
+        logger.info("weights quantized to int8 (per-output-channel)")
 
     lora_manager = LoRAManager(cfg, dtype=dtype)
     engine = Engine(

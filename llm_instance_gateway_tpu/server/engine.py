@@ -334,49 +334,63 @@ class Engine:
                 with self._work:
                     self._work.wait(timeout=0.05)
 
+    def _prefill_common(self, req: Request):
+        """Shared admission path: bucket, prefill, insert.  Returns
+        (slot_idx, first_token_device, n, lora_slot)."""
+        slot_idx = self._free_slot_index()
+        n = len(req.prompt_tokens)
+        bucket = self._bucket(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        positions = np.zeros((1, bucket), np.int32)
+        positions[0, :n] = np.arange(n)
+        lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
+        sp = req.sampling
+        first_token, k, v = self._jit_prefill(
+            self.params, self._lora_buffers(),
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.int32(n), jnp.int32(lora_slot),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p), self._next_key(),
+        )
+        # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
+        self.cache = self._jit_insert(
+            self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
+        )
+        return slot_idx, first_token, n, lora_slot
+
+    def _register_slot(self, slot_idx: int, slot: _Slot) -> None:
+        sp = slot.request.sampling
+        self.slots[slot_idx] = slot
+        self._slot_lora[slot_idx] = slot.lora_slot
+        self._slot_temp[slot_idx] = sp.temperature
+        self._slot_topk[slot_idx] = sp.top_k
+        self._slot_topp[slot_idx] = sp.top_p
+
+    def _record_ttft(self, req: Request) -> None:
+        with self._lock:
+            self.ttft_history.append(req.ttft_s)
+            if len(self.ttft_history) > 1000:
+                del self.ttft_history[:500]
+
     def _do_prefill(self, req: Request) -> None:
         try:
-            slot_idx = self._free_slot_index()
-            n = len(req.prompt_tokens)
-            bucket = self._bucket(n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_tokens
-            positions = np.zeros((1, bucket), np.int32)
-            positions[0, :n] = np.arange(n)
-            lora_slot = (
-                self.lora.slot_for(req.adapter) if self.lora is not None else -1
-            )
-            sp = req.sampling
-            first_token, k, v = self._jit_prefill(
-                self.params, self._lora_buffers(),
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.int32(n), jnp.int32(lora_slot),
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), self._next_key(),
-            )
-            # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
-            self.cache = self._jit_insert(
-                self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
-            )
+            slot_idx, first_token, n, lora_slot = self._prefill_common(req)
             tok = int(first_token)
             req.t_first_token = time.time()
             req.output_tokens.append(tok)
             req.stream_event.set()
             with self._lock:
                 self.total_generated += 1
-                self.ttft_history.append(req.ttft_s)
-                if len(self.ttft_history) > 1000:
-                    del self.ttft_history[:500]
+            self._record_ttft(req)
             if self._is_finished(req, tok):
                 self._finish(req, "stop" if self._is_stop(req, tok) else "length")
                 return
-            self.slots[slot_idx] = _Slot(request=req, lora_slot=lora_slot, position=n)
+            self._register_slot(
+                slot_idx, _Slot(request=req, lora_slot=lora_slot, position=n)
+            )
             self._slot_tokens[slot_idx] = tok
             self._slot_positions[slot_idx] = n
-            self._slot_lora[slot_idx] = lora_slot
-            self._slot_temp[slot_idx] = sp.temperature
-            self._slot_topk[slot_idx] = sp.top_k
-            self._slot_topp[slot_idx] = sp.top_p
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill failed for %s", req.request_id)
             req.error = str(e)
@@ -460,14 +474,26 @@ class Engine:
                     self._fail_all_slots(e)
                 did_work = True
             if inflight is not None:
-                self._process_block(inflight, current=block)
+                try:
+                    self._process_block(inflight, current=block)
+                except Exception as e:
+                    # Async JAX errors surface at materialization, not at
+                    # dispatch — the sync loop's "engine must survive; fail
+                    # the batch" invariant applies here too.
+                    logger.exception("pipelined block materialization failed")
+                    self._fail_all_slots(e)
+                    block = None
                 did_work = True
             inflight = block
             if not did_work:
                 with self._work:
                     self._work.wait(timeout=0.05)
         if inflight is not None:
-            self._process_block(inflight, current=None)
+            try:
+                self._process_block(inflight, current=None)
+            except Exception as e:
+                logger.exception("final block materialization failed")
+                self._fail_all_slots(e)
 
     def _fail_all_slots(self, e: Exception) -> None:
         for i, slot in enumerate(self.slots):
@@ -481,27 +507,7 @@ class Engine:
         """Prefill + insert with NO synchronous readback: the first token is
         scattered into the device carry and async-copied for later use."""
         try:
-            slot_idx = self._free_slot_index()
-            n = len(req.prompt_tokens)
-            bucket = self._bucket(n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_tokens
-            positions = np.zeros((1, bucket), np.int32)
-            positions[0, :n] = np.arange(n)
-            lora_slot = (
-                self.lora.slot_for(req.adapter) if self.lora is not None else -1
-            )
-            sp = req.sampling
-            first_token, k, v = self._jit_prefill(
-                self.params, self._lora_buffers(),
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.int32(n), jnp.int32(lora_slot),
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), self._next_key(),
-            )
-            self.cache = self._jit_insert(
-                self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
-            )
+            slot_idx, first_token, n, lora_slot = self._prefill_common(req)
             self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
             self._dev_positions = self._dev_positions.at[slot_idx].set(n)
             try:
@@ -512,11 +518,7 @@ class Engine:
             # _process_block — stamping here would understate TTFT by a block.
             slot = _Slot(request=req, lora_slot=lora_slot, position=n)
             slot.pending_first = first_token
-            self.slots[slot_idx] = slot
-            self._slot_lora[slot_idx] = lora_slot
-            self._slot_temp[slot_idx] = sp.temperature
-            self._slot_topk[slot_idx] = sp.top_k
-            self._slot_topp[slot_idx] = sp.top_p
+            self._register_slot(slot_idx, slot)
         except Exception as e:
             logger.exception("pipelined prefill failed for %s", req.request_id)
             req.error = str(e)
@@ -562,10 +564,7 @@ class Engine:
                 req.t_first_token = time.time()
                 req.output_tokens.append(tok0)
                 n_tokens += 1
-                with self._lock:
-                    self.ttft_history.append(req.ttft_s)
-                    if len(self.ttft_history) > 1000:
-                        del self.ttft_history[:500]
+                self._record_ttft(req)
                 if self._is_finished(req, tok0):
                     finished = True
             if not finished:

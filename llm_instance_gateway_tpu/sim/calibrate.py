@@ -91,7 +91,7 @@ def calibrate_from_engine(
         p = jnp.ones((b_slots,), jnp.float32)
 
         def call(tokens=tokens, positions=positions, slots=slots, t=t, k=k, p=p):
-            toks, engine.cache = engine._jit_decode(
+            toks, _, _, engine.cache = engine._jit_decode(
                 engine.params, engine._lora_buffers(), engine.cache,
                 tokens, positions, slots, t, k, p,
                 jax.random.PRNGKey(0), n_steps=n_steps,
