@@ -152,8 +152,14 @@ def prefill(
     positions: jax.Array,    # [B, S] int32 (right-padded prompts: 0..len-1)
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,  # [B] int32, -1 = base model
+    attention_fn=None,       # override: (q, k, v, positions) -> attn output
 ):
-    """Full-prompt forward.  Returns (logits [B,S,V] f32, k [L,B,S,K,hd], v)."""
+    """Full-prompt forward.  Returns (logits [B,S,V] f32, k [L,B,S,K,hd], v).
+
+    ``attention_fn`` swaps the attention implementation — used by
+    ``parallel.long_context`` to run ring attention over a sequence-sharded
+    mesh for prompts that exceed one device's budget.
+    """
     b, s = tokens.shape
     if slot_ids is None:
         slot_ids = jnp.full((b,), -1, jnp.int32)
@@ -175,7 +181,9 @@ def prefill(
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        if cfg.use_flash_attention:
+        if attention_fn is not None:
+            attn = attention_fn(q, k, v, positions)
+        elif cfg.use_flash_attention:
             # Right-padded batches: causal tiling alone keeps real positions
             # exact (pallas_attention.flash_attention docstring).
             from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention

@@ -1,0 +1,58 @@
+"""Long-context prefill: the full model forward with ring attention.
+
+For prompts beyond one device's HBM/FLOP budget, the sequence axis shards
+across the mesh: activations are [B, S/seq_shards, ...] per device, MLP and
+projections are embarrassingly parallel in S, and attention rotates K/V
+blocks around the ring (``parallel.ring_attention``).  This is the
+"long-context is a model-server concern" half of SURVEY.md §5 — the gateway
+half (token-aware routing on KV headroom) already exists in the scheduler.
+
+Usage:
+    fn = make_sharded_prefill(cfg, mesh)
+    logits, k, v = fn(params, tokens, positions)   # jitted, sharded
+
+Constraints: right-padded batches (ring attention is causal-only), sequence
+length divisible by the mesh's ``sequence`` axis.  The returned prompt KV is
+sharded over sequence too — for serving, ``gather_kv`` pulls it together for
+insertion into a replicated decode cache (decode itself is latency-bound and
+runs data/tensor-parallel, not sequence-parallel).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+from llm_instance_gateway_tpu.parallel.ring_attention import ring_attention
+
+
+def make_sharded_prefill(cfg: ModelConfig, mesh: Mesh):
+    """Jitted sequence-parallel prefill over ``mesh``."""
+
+    def attention_fn(q, k, v, positions):
+        # positions are unused: ring attention reconstructs global causality
+        # from block indices (right-padded batches only).
+        return ring_attention(q, k, v, mesh)
+
+    def fn(params, tokens, positions, lora_bufs=None, slot_ids=None):
+        return transformer.prefill(
+            cfg, params, tokens, positions,
+            lora_bufs=lora_bufs, slot_ids=slot_ids,
+            attention_fn=attention_fn,
+        )
+
+    # Inputs arrive pre-sharded (shard_inputs / sharding.shard_pytree); jit
+    # reads their placements, so no in_shardings pytree is needed here.
+    return jax.jit(fn)
+
+
+def shard_inputs(mesh: Mesh, tokens, positions):
+    s = NamedSharding(mesh, P("data", "sequence"))
+    return jax.device_put(tokens, s), jax.device_put(positions, s)
+
+
+def gather_kv(k, v):
+    """Materialize sequence-sharded prompt KV contiguously (for cache insert)."""
+    return jax.device_get(k), jax.device_get(v)

@@ -51,13 +51,14 @@ class EngineConfig:
     max_queue: int = 256
     # Decode steps fused into one jitted program per host sync.  Each host
     # round-trip costs dispatch latency (tens of ms through a remote TPU
-    # tunnel); K>1 amortizes it at the cost of up to K-1 tokens decoded past
-    # a stop condition (trimmed host-side) and K-step admission latency.
+    # tunnel); K>1 amortizes it.  Stop detection is device-side (rows freeze
+    # at EOS/budget and emit invalid steps), so large K costs only K-step
+    # admission latency, not wasted tokens.
     decode_steps_per_sync: int = 1
-    # Pipelined decode: dispatch block N+1 from the device-resident token
-    # carry BEFORE reading block N's tokens, overlapping the host readback
-    # with compute.  Finish detection lags one block (a finishing slot decodes
-    # one extra garbage block, trimmed host-side), so pair with moderate K.
+    # Pipelined decode: dispatch block N+1 from the device-resident token/
+    # position/budget carry BEFORE reading block N's tokens, overlapping the
+    # host readback with compute.  Slot FREEING still lags one block (the
+    # frozen row just decodes invalid steps until the host sees the stop).
     pipeline_decode: bool = False
     # Tokens/sec EMA smoothing for the exported throughput gauge.
     tps_ema_alpha: float = 0.2
@@ -133,6 +134,9 @@ class Engine:
         self._slot_temp = np.zeros((b,), np.float32)
         self._slot_topk = np.zeros((b,), np.int32)
         self._slot_topp = np.ones((b,), np.float32)
+        # Per-row token budget for device-side stop (0 = frozen row).
+        self._slot_remaining = np.zeros((b,), np.int32)
+        self._eos_for_device = jnp.int32(-1 if eos_id is None else eos_id)
 
         self.prefill_queue: queue_mod.Queue[Request] = queue_mod.Queue(
             maxsize=self.cfg.max_queue
@@ -187,34 +191,48 @@ class Engine:
     @staticmethod
     def _decode_impl(
         model_cfg, params, lora_bufs, cache, tokens, positions,
-        slot_ids, temp, topk, topp, key, n_steps: int,
+        slot_ids, temp, topk, topp, key, remaining, eos_id, n_steps: int,
     ):
-        """``n_steps`` fused decode+sample steps (lax.scan over steps).
+        """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
 
-        Returns tokens [n_steps, B] and the advanced cache.  Positions are
-        clamped below max_seq_len so slots that hit their cap decode garbage
-        into their own last cell instead of writing out of bounds (the host
-        trims past stop conditions anyway).
+        Each row carries an activity state: ``remaining`` token budget and an
+        implicit frozen flag (remaining <= 0 or EOS emitted).  Frozen rows
+        stop advancing their position (their cache cell is overwritten in
+        place — harmless, the lane is re-inserted on reuse) and emit
+        ``valid=False`` steps, so a row that stops mid-block wastes no host
+        tokens and large K blocks stay cheap at sequence tails.
+
+        Returns (toks [K,B], valid [K,B], next_tokens, next_positions,
+        next_remaining, cache).  Positions are clamped below max_seq_len so
+        capped slots never write out of bounds.
         """
         max_len = cache["k"].shape[2]
 
         def one_step(carry, step_key):
-            cache, tokens, positions = carry
+            cache, tokens, positions, remaining = carry
+            active = remaining > 0
             safe_pos = jnp.minimum(positions, max_len - 1)
             logits, cache = transformer.decode_step(
                 model_cfg, params, cache, tokens, safe_pos,
                 lora_bufs=lora_bufs, slot_ids=slot_ids,
             )
-            next_tokens = sample(logits, step_key, temp, topk, topp)
-            return (cache, next_tokens, positions + 1), next_tokens
+            sampled = sample(logits, step_key, temp, topk, topp)
+            valid = active
+            # EOS emitted now is a valid token but deactivates the row.
+            hit_eos = valid & (sampled == eos_id)
+            remaining = jnp.where(valid, remaining - 1, remaining)
+            remaining = jnp.where(hit_eos, 0, remaining)
+            next_tokens = jnp.where(active, sampled, tokens)
+            next_positions = positions + active.astype(positions.dtype)
+            return (cache, next_tokens, next_positions, remaining), (sampled, valid)
 
         keys = jax.random.split(key, n_steps)
-        (cache, next_tokens, next_positions), toks = jax.lax.scan(
-            one_step, (cache, tokens, positions), keys
+        (cache, next_tokens, next_positions, next_remaining), (toks, valid) = (
+            jax.lax.scan(one_step, (cache, tokens, positions, remaining), keys)
         )
-        # next_tokens/next_positions are the device-side carry for pipelined
+        # The token/position/budget carries live on device for pipelined
         # dispatch of the following block (no host round-trip needed).
-        return toks, next_tokens, next_positions, cache
+        return toks, valid, next_tokens, next_positions, next_remaining, cache
 
     # ------------------------------------------------------------------
     # public API
@@ -366,6 +384,8 @@ class Engine:
         self._slot_temp[slot_idx] = sp.temperature
         self._slot_topk[slot_idx] = sp.top_k
         self._slot_topp[slot_idx] = sp.top_p
+        # Budget for device-side stop: the prefill already produced token 1.
+        self._slot_remaining[slot_idx] = max(0, slot.request.max_new_tokens - 1)
 
     def _record_ttft(self, req: Request) -> None:
         with self._lock:
@@ -399,15 +419,17 @@ class Engine:
     def _do_decode_step(self) -> None:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
         t0 = time.perf_counter()
-        step_tokens, _, _, self.cache = self._jit_decode(
+        step_tokens, step_valid, _, _, _, self.cache = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
             jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
             jnp.asarray(self._slot_lora),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp), self._next_key(),
+            jnp.asarray(self._slot_remaining), self._eos_for_device,
             n_steps=n_steps,
         )
         toks_np = np.asarray(step_tokens)  # [n_steps, B]
+        valid_np = np.asarray(step_valid)
         step_s = time.perf_counter() - t0
         n_tokens = 0
         for i, slot in enumerate(self.slots):
@@ -416,15 +438,19 @@ class Engine:
             req = slot.request
             finished = False
             for k in range(n_steps):
+                if not valid_np[k, i]:
+                    continue  # device froze this row (budget/EOS)
                 tok = int(toks_np[k, i])
                 req.output_tokens.append(tok)
                 n_tokens += 1
                 slot.position += 1
                 self._slot_tokens[i] = tok
+                self._slot_remaining[i] = max(0, self._slot_remaining[i] - 1)
                 if self._is_finished(req, tok) or slot.position >= self.cfg.max_seq_len - 1:
                     self._finish(req, "stop" if self._is_stop(req, tok) else "length")
                     self.slots[i] = None
                     self._slot_lora[i] = -1
+                    self._slot_remaining[i] = 0
                     finished = True
                     break  # tokens past the stop condition are trimmed
             req.stream_event.set()
@@ -442,19 +468,22 @@ class Engine:
 
     def _loop_pipelined(self) -> None:
         """Two-deep pipeline: dispatch block N+1 from the device-resident
-        token/position carry BEFORE materializing block N's tokens, so the
-        (expensive, relay-bound) device->host readback overlaps compute.
+        token/position/budget carry BEFORE materializing block N's tokens, so
+        the (expensive, relay-bound) device->host readback overlaps compute.
 
         Consequences handled here:
-        - finish detection lags one block: a finishing slot decodes one extra
-          block of garbage into its own lane (trimmed; its row in the
-          already-dispatched block is invalidated on free);
+        - stop detection is device-side (budget/EOS freeze rows and emit
+          invalid steps), so a finishing slot wastes no trimmed tokens; rows
+          freed for host-only reasons (custom stop ids) get their device
+          budget zeroed before the next dispatch;
         - prefill first-tokens stay on device (async-copied) and materialize
           when their slot's first block is processed.
         """
         b = self.cfg.decode_slots
         self._dev_tokens = jnp.zeros((b,), jnp.int32)
         self._dev_positions = jnp.zeros((b,), jnp.int32)
+        self._dev_remaining = jnp.zeros((b,), jnp.int32)
+        self._pending_budget_zero: list[int] = []
         inflight: dict | None = None
         while self._running:
             did_work = False
@@ -502,14 +531,23 @@ class Engine:
                 self._finish(slot.request, "error")
                 self.slots[i] = None
                 self._slot_lora[i] = -1
+                self._slot_remaining[i] = 0
 
     def _do_prefill_pipelined(self, req: Request) -> None:
         """Prefill + insert with NO synchronous readback: the first token is
         scattered into the device carry and async-copied for later use."""
         try:
             slot_idx, first_token, n, lora_slot = self._prefill_common(req)
+            # A queued budget-zero for this lane belongs to the PREVIOUS
+            # occupant — drop it or it would freeze the new request.
+            self._pending_budget_zero = [
+                i for i in self._pending_budget_zero if i != slot_idx
+            ]
             self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
             self._dev_positions = self._dev_positions.at[slot_idx].set(n)
+            self._dev_remaining = self._dev_remaining.at[slot_idx].set(
+                max(0, req.max_new_tokens - 1)
+            )
             try:
                 first_token.copy_to_host_async()
             except AttributeError:
@@ -526,22 +564,32 @@ class Engine:
 
     def _dispatch_block(self) -> dict:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
-        toks, next_tokens, next_positions, self.cache = self._jit_decode(
-            self.params, self._lora_buffers(), self.cache,
-            self._dev_tokens, self._dev_positions,
-            jnp.asarray(self._slot_lora),
-            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
-            jnp.asarray(self._slot_topp), self._next_key(),
-            n_steps=n_steps,
+        if self._pending_budget_zero:
+            idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
+            self._dev_remaining = self._dev_remaining.at[idxs].set(0)
+            self._pending_budget_zero.clear()
+        toks, valid, next_tokens, next_positions, next_remaining, self.cache = (
+            self._jit_decode(
+                self.params, self._lora_buffers(), self.cache,
+                self._dev_tokens, self._dev_positions,
+                jnp.asarray(self._slot_lora),
+                jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+                jnp.asarray(self._slot_topp), self._next_key(),
+                self._dev_remaining, self._eos_for_device,
+                n_steps=n_steps,
+            )
         )
         self._dev_tokens = next_tokens
         self._dev_positions = next_positions
-        try:
-            toks.copy_to_host_async()
-        except AttributeError:
-            pass
+        self._dev_remaining = next_remaining
+        for arr in (toks, valid):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
         return {
             "toks": toks,
+            "valid": valid,
             "rows": list(self.slots),  # request refs valid at dispatch time
             "n_steps": n_steps,
             "t0": time.perf_counter(),
@@ -549,6 +597,7 @@ class Engine:
 
     def _process_block(self, blk: dict, current: dict | None) -> None:
         toks_np = np.asarray(blk["toks"])  # overlaps with `current` computing
+        valid_np = np.asarray(blk["valid"])
         n_tokens = 0
         for i, slot in enumerate(blk["rows"]):
             if slot is None:
@@ -569,6 +618,8 @@ class Engine:
                     finished = True
             if not finished:
                 for k in range(blk["n_steps"]):
+                    if not valid_np[k, i]:
+                        continue  # device froze this row (budget/EOS)
                     tok = int(toks_np[k, i])
                     req.output_tokens.append(tok)
                     n_tokens += 1
@@ -586,6 +637,9 @@ class Engine:
                 if self.slots[i] is slot:
                     self.slots[i] = None
                     self._slot_lora[i] = -1
+                    # Host-only stop reasons (custom ids, length cap) leave a
+                    # positive device budget — zero it before the next dispatch.
+                    self._pending_budget_zero.append(i)
                 if current is not None and current["rows"][i] is slot:
                     current["rows"][i] = None  # its lane in-flight is garbage
         step_s = time.perf_counter() - blk["t0"]

@@ -128,6 +128,29 @@ class TestGeneration:
             multi.stop()
         assert got == want
 
+    @pytest.mark.parametrize("pipeline", [False, True], ids=["sync", "pipelined"])
+    def test_device_side_eos_stops_mid_block(self, engine_env, pipeline):
+        """With eos set and K > max_new, the device freezes the row at EOS:
+        output ends exactly at the stop token, no trailing garbage."""
+        engine, _, params = engine_env
+        # Find what greedy emits first so we can use it as the EOS id.
+        probe = engine.generate(make_req((5, 6, 7), max_new=3), timeout_s=60)
+        eos = probe.output_tokens[1]  # second token: EOS must hit mid-decode
+        eng = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(8, 16),
+                         decode_steps_per_sync=6, pipeline_decode=pipeline),
+            lora_manager=None, eos_id=eos, dtype=jnp.float32,
+        )
+        eng.start()
+        try:
+            req = eng.generate(make_req((5, 6, 7), max_new=20), timeout_s=60)
+        finally:
+            eng.stop()
+        assert req.finish_reason == "stop"
+        assert req.output_tokens[-1] == eos
+        assert req.output_tokens == probe.output_tokens[:2]
+
     def test_pipelined_concurrent_consistency(self, engine_env):
         """Pipelined engine under churn (slot reuse, mixed lengths) must match
         the sequential reference outputs exactly."""
