@@ -23,6 +23,8 @@ import argparse
 import asyncio
 import json
 import logging
+import time
+import uuid
 
 import aiohttp
 from aiohttp import web
@@ -84,6 +86,13 @@ class GatewayProxy:
     async def handle_completion(self, request: web.Request) -> web.Response:
         body = await request.read()
         req_ctx = RequestContext()
+        # Request-scoped tracing: honor an inbound id or mint one; it rides
+        # to the replica and back so one id follows the request across the
+        # gateway, the scheduler decision, and the model server (SURVEY.md
+        # §5: the reference's only decision-path observability was verbose
+        # logs; this is the structured equivalent).
+        request_id = request.headers.get("x-request-id") or uuid.uuid4().hex[:16]
+        t_start = time.perf_counter()
         loop = asyncio.get_running_loop()
 
         # Phase 1+2: headers then body, through the same core the gRPC
@@ -126,6 +135,7 @@ class GatewayProxy:
                 data=out_body,
                 headers={
                     "Content-Type": "application/json",
+                    "x-request-id": request_id,
                     self.server.target_pod_header: pod.address,
                 },
             ) as upstream:
@@ -156,7 +166,15 @@ class GatewayProxy:
         except ProcessingError:
             pass  # non-JSON upstream bodies (e.g. SSE streams) skip accounting
 
-        headers = {"x-served-by": pod.name, **hdr_result.set_headers}
+        logger.info(
+            "request=%s model=%s target=%s pod=%s status=%d prompt_tokens=%d "
+            "completion_tokens=%d pick_us=%.0f total_ms=%.1f",
+            request_id, req_ctx.model, req_ctx.resolved_target_model, pod.name,
+            status, req_ctx.usage.prompt_tokens, req_ctx.usage.completion_tokens,
+            t.seconds * 1e6, (time.perf_counter() - t_start) * 1e3,
+        )
+        headers = {"x-served-by": pod.name, "x-request-id": request_id,
+                   **hdr_result.set_headers}
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
 
