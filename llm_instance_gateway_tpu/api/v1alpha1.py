@@ -76,11 +76,17 @@ class InferenceModel:
 
 @dataclass
 class InferencePoolSpec:
-    """inferencepool_types.go:26-46: selector + target port; TPU topology added."""
+    """inferencepool_types.go:26-46: selector + target port; TPU topology added.
+
+    ``scheduler`` carries per-pool scheduler threshold overrides — the
+    reference hard-coded these with a TODO to move them into InferencePool
+    config (scheduler.go:16-24); here the pool document IS the config source.
+    """
 
     selector: dict[str, str] = field(default_factory=dict)
     target_port_number: int = 8000
     slice_topology: str = "v5e-1"
+    scheduler: dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -167,6 +173,7 @@ def inference_pool_from_doc(doc: Mapping[str, Any]) -> InferencePool:
             selector=dict(spec.get("selector", {})),
             target_port_number=int(spec.get("targetPortNumber", 8000)),
             slice_topology=spec.get("sliceTopology", "v5e-1"),
+            scheduler=dict(spec.get("schedulerConfig", {})),
         ),
     )
 

@@ -51,6 +51,7 @@ class GatewayComponents:
     scheduler: object  # Scheduler or NativeScheduler (same .schedule interface)
     handler_server: Server
     watchers: list = field(default_factory=list)
+    pool_reconciler: InferencePoolReconciler | None = None
 
     def start_provider(self, pods_interval_s: float = 10.0,
                        metrics_interval_s: float = 0.05) -> None:
@@ -83,7 +84,23 @@ def build_gateway(
 
     datastore = Datastore()
     watchers: list = []
-    pool_rec = InferencePoolReconciler(datastore, pool_name)
+    scheduler_holder: list = []  # filled below; hook needs a forward ref
+
+    def on_pool_update(pool) -> None:
+        """Hot-reload hook: re-validate and push thresholds into the live
+        scheduler.  A bad reloaded doc keeps the last good config (loudly)."""
+        if not scheduler_holder:
+            return
+        from llm_instance_gateway_tpu.gateway.scheduling.config import from_pool_spec
+
+        try:
+            scheduler_holder[0].update_config(from_pool_spec(pool.spec.scheduler))
+            logger.info("scheduler thresholds reloaded from pool %s", pool.name)
+        except ValueError as e:
+            logger.error("rejected reloaded schedulerConfig (keeping last "
+                         "good thresholds): %s", e)
+
+    pool_rec = InferencePoolReconciler(datastore, pool_name, on_update=on_pool_update)
     model_rec = InferenceModelReconciler(datastore, pool_name)
     for pool in pools:
         pool_rec.reconcile(pool)
@@ -141,13 +158,20 @@ def build_gateway(
         )
 
     provider = Provider(PodMetricsClient(), datastore)
+    # Thresholds come from the pool document (schedulerConfig section) —
+    # the resolution of the reference's config TODO, end to end.
+    from llm_instance_gateway_tpu.gateway.scheduling.config import from_pool_spec
+
+    scheduler_cfg = from_pool_spec(datastore.get_pool().spec.scheduler)
     # C++ hot path when buildable, Python tree otherwise (identical
     # semantics, fuzz-verified in tests/test_native_scheduler.py).
-    scheduler = make_scheduler(provider)
+    scheduler = make_scheduler(provider, scheduler_cfg)
+    scheduler_holder.append(scheduler)  # arm the hot-reload hook
     handler_server = Server(scheduler, datastore)
     return GatewayComponents(
         datastore=datastore, provider=provider, scheduler=scheduler,
         handler_server=handler_server, watchers=watchers,
+        pool_reconciler=pool_rec,
     )
 
 

@@ -21,10 +21,15 @@ class InferencePoolReconciler:
     """inferencepool_reconciler.go:28-50: copy the watched pool into the
     datastore, gated on name/namespace and ResourceVersion change."""
 
-    def __init__(self, datastore: Datastore, pool_name: str, namespace: str = "default"):
+    def __init__(self, datastore: Datastore, pool_name: str,
+                 namespace: str = "default", on_update=None):
         self.datastore = datastore
         self.pool_name = pool_name
         self.namespace = namespace
+        # Called with the new pool after every accepted update — lets the
+        # bootstrap propagate pool-carried settings (scheduler thresholds)
+        # into live components on hot reload.
+        self.on_update = on_update
 
     def reconcile(self, pool: InferencePool) -> bool:
         if pool.name != self.pool_name or pool.namespace != self.namespace:
@@ -37,6 +42,11 @@ class InferencePoolReconciler:
             pass
         self.datastore.set_pool(pool)
         logger.info("updated InferencePool %s (rv %s)", pool.name, pool.resource_version)
+        if self.on_update is not None:
+            try:
+                self.on_update(pool)
+            except Exception:
+                logger.exception("pool on_update hook failed")
         return True
 
 

@@ -34,3 +34,47 @@ class SchedulerConfig:
 
 
 DEFAULT_CONFIG = SchedulerConfig()
+
+# Pool-document key (camelCase, CRD style) -> dataclass field.
+_POOL_KEYS = {
+    "kvCacheThreshold": "kv_cache_threshold",
+    "queueThresholdCritical": "queue_threshold_critical",
+    "queueingThresholdLoRA": "queueing_threshold_lora",
+    "tokenHeadroomFactor": "token_headroom_factor",
+    "prefillQueueThreshold": "prefill_queue_threshold",
+}
+
+
+def from_pool_spec(overrides: dict) -> SchedulerConfig:
+    """SchedulerConfig from an InferencePool's ``schedulerConfig`` section.
+
+    The end of the reference's threshold TODO (scheduler.go:16-24): per-pool
+    values arrive through the same declarative document as the pool itself.
+    Unknown keys raise — silent typos in thresholds are how shedding policies
+    quietly stop working.
+    """
+    if not overrides:
+        return DEFAULT_CONFIG
+    unknown = set(overrides) - set(_POOL_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown schedulerConfig keys {sorted(unknown)}; "
+            f"valid: {sorted(_POOL_KEYS)}"
+        )
+    import dataclasses
+
+    kwargs = {}
+    for doc_key, field_name in _POOL_KEYS.items():
+        if doc_key in overrides:
+            current = getattr(DEFAULT_CONFIG, field_name)
+            raw = overrides[doc_key]
+            if isinstance(current, int):
+                if float(raw) != int(float(raw)):
+                    raise ValueError(
+                        f"{doc_key} must be an integer, got {raw!r} "
+                        "(silent truncation would change the policy)"
+                    )
+                kwargs[field_name] = int(float(raw))
+            else:
+                kwargs[field_name] = float(raw)
+    return dataclasses.replace(DEFAULT_CONFIG, **kwargs)

@@ -226,6 +226,10 @@ class NativeScheduler:
             raise SchedulingError(f"native scheduler error {count}")
         return out[:count].tolist()
 
+    def update_config(self, cfg: SchedulerConfig) -> None:
+        """Swap thresholds at runtime — cfg fields cross the FFI per call."""
+        self.cfg = cfg
+
     def schedule(self, req: LLMRequest) -> Pod:
         snapshot = getattr(self._provider, "snapshot", None)
         if snapshot is not None:

@@ -173,10 +173,19 @@ class Scheduler:
     ):
         self._provider = pod_metrics_provider
         self.cfg = cfg
+        self._token_aware = token_aware
+        self._prefill_aware = prefill_aware
         self._tree = tree or build_default_tree(
             cfg, token_aware=token_aware, prefill_aware=prefill_aware
         )
         self._rng = rng or random.Random()
+
+    def update_config(self, cfg: SchedulerConfig) -> None:
+        """Swap thresholds at runtime (pool hot-reload); rebuilds the tree."""
+        self.cfg = cfg
+        self._tree = build_default_tree(
+            cfg, token_aware=self._token_aware, prefill_aware=self._prefill_aware
+        )
 
     def schedule(self, req: LLMRequest) -> Pod:
         pods = self._provider.all_pod_metrics()

@@ -85,7 +85,12 @@ class ModelServer:
 
     async def _run(self, req: Request) -> Request:
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.engine.generate, req)
+        try:
+            return await loop.run_in_executor(None, self.engine.generate, req)
+        except asyncio.CancelledError:
+            # Non-streaming client disconnected: free the slot too.
+            req.cancelled.set()
+            raise
 
     # -- streaming ---------------------------------------------------------
     async def _stream_sse(self, http_request: web.Request, req, model: str,
@@ -108,21 +113,37 @@ class ModelServer:
         except queue_mod.Full:
             return _err(429, "prefill queue is full")
 
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "x-accel-buffering": "no",
-            }
-        )
-        await resp.prepare(http_request)
-        loop = asyncio.get_running_loop()
-        consumed = 0  # tokens already emitted as text
-        deadline = time.monotonic() + timeout_s
+        # From here the request occupies engine capacity: ANY abnormal exit
+        # (client disconnect during prepare, write failure, handler cancel)
+        # must release the slot.
+        try:
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "x-accel-buffering": "no",
+                }
+            )
+            await resp.prepare(http_request)
+            loop = asyncio.get_running_loop()
+            consumed = 0  # tokens already emitted as text
+            deadline = time.monotonic() + timeout_s
 
-        async def emit(payload: dict) -> None:
-            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+            async def emit(payload: dict) -> None:
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
+            return await self._stream_sse_loop(
+                req, model, object_name, make_delta, resp, loop, consumed,
+                deadline, emit,
+            )
+        except (asyncio.CancelledError, ConnectionResetError):
+            # Client went away mid-stream: release the decode slot instead of
+            # generating to completion for nobody.
+            req.cancelled.set()
+            raise
+
+    async def _stream_sse_loop(self, req, model, object_name, make_delta,
+                               resp, loop, consumed, deadline, emit):
         while True:
             await loop.run_in_executor(None, req.stream_event.wait, 0.25)
             req.stream_event.clear()
@@ -161,6 +182,7 @@ class ModelServer:
                 await resp.write(b"data: [DONE]\n\n")
                 return resp
             if time.monotonic() > deadline:
+                req.cancelled.set()  # stop burning the slot for a dead stream
                 await emit({"error": {"message": "generation timed out"}})
                 await resp.write(b"data: [DONE]\n\n")
                 return resp

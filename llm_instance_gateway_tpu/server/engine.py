@@ -89,6 +89,9 @@ class Request:
     done: threading.Event = field(default_factory=threading.Event)
     # Incremental consumption point for streaming responses.
     stream_event: threading.Event = field(default_factory=threading.Event)
+    # Set by the transport when the client went away: the engine frees the
+    # slot at the next block boundary instead of decoding to completion.
+    cancelled: threading.Event = field(default_factory=threading.Event)
 
     @property
     def ttft_s(self) -> float:
@@ -275,6 +278,7 @@ class Engine:
         self.submit(request)
         if not request.done.wait(timeout_s):
             request.error = "generation timed out"
+            request.cancelled.set()  # release the slot; nobody is waiting
         return request
 
     # ------------------------------------------------------------------
@@ -436,6 +440,12 @@ class Engine:
             if slot is None:
                 continue
             req = slot.request
+            if req.cancelled.is_set():
+                self._finish(req, "cancelled")
+                self.slots[i] = None
+                self._slot_lora[i] = -1
+                self._slot_remaining[i] = 0
+                continue
             finished = False
             for k in range(n_steps):
                 if not valid_np[k, i]:
@@ -604,6 +614,15 @@ class Engine:
                 continue
             req = slot.request
             if req.done.is_set():
+                continue
+            if req.cancelled.is_set():
+                self._finish(req, "cancelled")
+                if self.slots[i] is slot:
+                    self.slots[i] = None
+                    self._slot_lora[i] = -1
+                    self._pending_budget_zero.append(i)
+                if current is not None and current["rows"][i] is slot:
+                    current["rows"][i] = None
                 continue
             finished = False
             pending = getattr(slot, "pending_first", None)
