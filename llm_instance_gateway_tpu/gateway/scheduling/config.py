@@ -68,13 +68,21 @@ def from_pool_spec(overrides: dict) -> SchedulerConfig:
         if doc_key in overrides:
             current = getattr(DEFAULT_CONFIG, field_name)
             raw = overrides[doc_key]
+            try:
+                value = float(raw)
+            except (TypeError, ValueError) as e:
+                # Normalize to ValueError so the hot-reload hook's
+                # keep-last-good handler catches nulls/lists too.
+                raise ValueError(
+                    f"{doc_key} must be a number, got {raw!r}"
+                ) from e
             if isinstance(current, int):
-                if float(raw) != int(float(raw)):
+                if value != int(value):
                     raise ValueError(
                         f"{doc_key} must be an integer, got {raw!r} "
                         "(silent truncation would change the policy)"
                     )
-                kwargs[field_name] = int(float(raw))
+                kwargs[field_name] = int(value)
             else:
-                kwargs[field_name] = float(raw)
+                kwargs[field_name] = value
     return dataclasses.replace(DEFAULT_CONFIG, **kwargs)

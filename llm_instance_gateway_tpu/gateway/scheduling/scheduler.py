@@ -175,14 +175,27 @@ class Scheduler:
         self.cfg = cfg
         self._token_aware = token_aware
         self._prefill_aware = prefill_aware
+        self._custom_tree = tree is not None
         self._tree = tree or build_default_tree(
             cfg, token_aware=token_aware, prefill_aware=prefill_aware
         )
         self._rng = rng or random.Random()
 
     def update_config(self, cfg: SchedulerConfig) -> None:
-        """Swap thresholds at runtime (pool hot-reload); rebuilds the tree."""
+        """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.
+
+        A caller-injected custom tree is left untouched — thresholds belong
+        to the default tree; silently replacing a custom policy on reload
+        would be a worse surprise than ignoring the new numbers.
+        """
         self.cfg = cfg
+        if self._custom_tree:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "scheduler has a custom filter tree; ignoring threshold reload"
+            )
+            return
         self._tree = build_default_tree(
             cfg, token_aware=self._token_aware, prefill_aware=self._prefill_aware
         )

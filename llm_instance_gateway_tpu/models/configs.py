@@ -48,6 +48,10 @@ class ModelConfig:
     # Pallas flash-attention for prefill (right-padded batches only; falls
     # back to the XLA reference when shapes miss the tiling constraints).
     use_flash_attention: bool = False
+    # Pallas cached-decode attention kernel (ops/pallas_decode_attention).
+    # Interpret-mode parity is tested; flip on after validating on the
+    # target chip generation.
+    use_pallas_decode: bool = False
 
     @property
     def resolved_head_dim(self) -> int:

@@ -249,7 +249,14 @@ def decode_step(
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
-        attn = decode_attention(q, k_cache, v_cache, lengths)
+        if cfg.use_pallas_decode:
+            from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+                decode_attention as pallas_decode,
+            )
+
+            attn = pallas_decode(q, k_cache, v_cache, lengths)
+        else:
+            attn = decode_attention(q, k_cache, v_cache, lengths)
         h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)

@@ -1,0 +1,112 @@
+"""Pallas TPU kernel for cached decode attention.
+
+Decode attention reads the whole static KV cache every step — the HBM-bound
+inner loop of serving.  The XLA reference (``ops.attention.decode_attention``)
+materializes [B, K, G, S] logits between two einsums; this kernel streams the
+cache in blocks with the online-softmax recurrence, keeping per-program state
+in VMEM: one grid cell per (batch row, KV head) computes that head group's
+output for the row's single query token.
+
+Length masking is exact (positions >= length contribute nothing), matching
+the engine's garbage-tail cache contract.  ``decode_attention`` is the
+auto-dispatching entry with the XLA fallback for unsupported shapes/CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from llm_instance_gateway_tpu.ops.attention import decode_attention as xla_decode
+
+NEG_INF = -1e30
+
+BLOCK_S = 128
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_s: int,
+                   scale: float):
+    # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, S, 1, hd]; len_ref: [B] (SMEM,
+    # scalar-prefetched — index by this program's batch row).
+    g, hd = q_ref.shape[2], q_ref.shape[3]
+    length = len_ref[pl.program_id(0)]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
+    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    o0 = jnp.zeros((g, hd), jnp.float32)
+
+    def body(sb, carry):
+        m, l, o = carry
+        start = sb * block_s
+        k = k_ref[0, pl.ds(start, block_s), 0, :].astype(jnp.float32)  # [BS, hd]
+        v = v_ref[0, pl.ds(start, block_s), 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, BS]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (g, block_s), 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, o_new
+
+    # Only blocks that can contain valid positions (< length) do work.
+    n_blocks = (length + block_s - 1) // block_s
+    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
+    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(
+    q: jax.Array,        # [B, n_heads, hd]
+    k_cache: jax.Array,  # [B, S, n_kv, hd]
+    v_cache: jax.Array,
+    lengths: jax.Array,  # [B] int32
+    block_s: int = BLOCK_S,
+    interpret: bool = False,
+) -> jax.Array:
+    b, n_heads, hd = q.shape
+    s_max, n_kv = k_cache.shape[1], k_cache.shape[2]
+    g = n_heads // n_kv
+    scale = float(1.0 / (hd ** 0.5))
+    qg = q.reshape(b, n_kv, g, hd)
+    kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,  # lengths: needed for the block count
+            grid=(b, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, hd), lambda bi, ki, lens: (bi, ki, 0, 0)),
+                pl.BlockSpec((1, s_max, 1, hd), lambda bi, ki, lens: (bi, 0, ki, 0)),
+                pl.BlockSpec((1, s_max, 1, hd), lambda bi, ki, lens: (bi, 0, ki, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, hd), lambda bi, ki, lens: (bi, ki, 0, 0)),
+        ),
+        interpret=interpret,
+    )(lengths, qg, k_cache, v_cache)
+    return out.reshape(b, n_heads, hd)
+
+
+def supports(s_max: int, hd: int, block_s: int = BLOCK_S) -> bool:
+    return s_max % block_s == 0 and hd % 128 == 0
+
+
+def decode_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, lengths: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Auto-dispatch: Pallas kernel when shapes allow, XLA reference otherwise."""
+    s_max, hd = k_cache.shape[1], k_cache.shape[3]
+    if not supports(s_max, hd):
+        return xla_decode(q, k_cache, v_cache, lengths)
+    return decode_attention_pallas(q, k_cache, v_cache, lengths, interpret=interpret)

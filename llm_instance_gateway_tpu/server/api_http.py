@@ -113,9 +113,10 @@ class ModelServer:
         except queue_mod.Full:
             return _err(429, "prefill queue is full")
 
-        # From here the request occupies engine capacity: ANY abnormal exit
-        # (client disconnect during prepare, write failure, handler cancel)
-        # must release the slot.
+        # From here the request occupies engine capacity: ANY exit before
+        # completion (disconnect during prepare, write failure, handler
+        # cancel, unexpected exception) must release the slot — enforced by
+        # the finally below, not by enumerating exception types.
         try:
             resp = web.StreamResponse(
                 headers={
@@ -136,11 +137,12 @@ class ModelServer:
                 req, model, object_name, make_delta, resp, loop, consumed,
                 deadline, emit,
             )
-        except (asyncio.CancelledError, ConnectionResetError):
-            # Client went away mid-stream: release the decode slot instead of
-            # generating to completion for nobody.
-            req.cancelled.set()
-            raise
+        finally:
+            if not req.done.is_set():
+                # Stream ended without the request completing (disconnect,
+                # deadline, any exception): release the decode slot instead
+                # of generating to completion for nobody.
+                req.cancelled.set()
 
     async def _stream_sse_loop(self, req, model, object_name, make_delta,
                                resp, loop, consumed, deadline, emit):

@@ -398,6 +398,9 @@ class Engine:
                 del self.ttft_history[:500]
 
     def _do_prefill(self, req: Request) -> None:
+        if req.cancelled.is_set():  # died while queued: skip the prefill
+            self._finish(req, "cancelled")
+            return
         try:
             slot_idx, first_token, n, lora_slot = self._prefill_common(req)
             tok = int(first_token)
@@ -546,6 +549,9 @@ class Engine:
     def _do_prefill_pipelined(self, req: Request) -> None:
         """Prefill + insert with NO synchronous readback: the first token is
         scattered into the device carry and async-copied for later use."""
+        if req.cancelled.is_set():  # died while queued: skip the prefill
+            self._finish(req, "cancelled")
+            return
         try:
             slot_idx, first_token, n, lora_slot = self._prefill_common(req)
             # A queued budget-zero for this lane belongs to the PREVIOUS

@@ -213,13 +213,25 @@ def main(argv=None) -> None:
     parser.add_argument("--servers", type=int, default=6)
     parser.add_argument("--duration", type=float, default=120.0)
     parser.add_argument("--latency-model", choices=["v5e", "a100"], default="v5e")
+    parser.add_argument("--csv", default=None, metavar="PATH",
+                        help="also write results as CSV (reference main.py parity)")
     args = parser.parse_args(argv)
     latency = V5E_DEFAULT if args.latency_model == "v5e" else A100_VLLM
+    rows = []
     for qps in args.qps:
         for policy in args.policies:
             cfg = WorkloadConfig(qps=qps, duration_s=args.duration)
             result = simulate(policy, cfg, n_servers=args.servers, latency=latency)
-            print(json.dumps(result.summary()))
+            summary = result.summary()
+            rows.append(summary)
+            print(json.dumps(summary))
+    if args.csv:
+        import csv
+
+        with open(args.csv, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=list(rows[0]))
+            writer.writeheader()
+            writer.writerows(rows)
 
 
 if __name__ == "__main__":
