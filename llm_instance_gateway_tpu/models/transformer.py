@@ -271,6 +271,86 @@ def decode_step(
     return logits, new_cache
 
 
+def prefill_with_cache(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,      # [C] int32 — one chunk for ONE slot
+    positions: jax.Array,   # [C] int32 — absolute positions of the chunk
+    slot: jax.Array,        # scalar int32 — cache lane
+    lane_end: jax.Array,    # scalar int32 — valid tokens in the lane AFTER
+                            # this chunk (true prompt progress, excl. padding)
+    last_index: jax.Array,  # scalar int32 — chunk index of the last REAL token
+    lora_bufs: Params | None = None,
+    lora_slot: jax.Array | int = -1,
+):
+    """Chunked prefill: run one prompt chunk against an existing cache lane.
+
+    Long prompts stream through in fixed-size chunks: each chunk's K/V are
+    scattered into the slot's cache rows at their absolute positions, and the
+    chunk's queries attend to EVERYTHING cached so far (previous chunks) plus
+    causally within the chunk — so N chunks reproduce a monolithic prefill
+    exactly (parity-tested) while compiling only one chunk-sized program.
+
+    A padded final chunk passes pad positions CONTINUING past the prompt
+    (start+i): pads scatter into unused cells beyond ``lane_end`` (masked by
+    the cache length) instead of overwriting real tokens, and ``last_index``
+    selects the true final token's logits.
+
+    Returns (last_logits [V] f32, new cache).
+    """
+    c = tokens.shape[0]
+    hd = cfg.resolved_head_dim
+    s_max = cache["k"].shape[2]
+    slot_ids = jnp.full((1,), lora_slot, jnp.int32)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    h = params["embed"][tokens][None]  # [1, C, D]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+    pos2d = positions[None]  # [1, C]
+
+    def layer_fn(h, xs):
+        lp, ll, k_cache, v_cache = xs  # caches: [B, S, K, hd] (this layer)
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        q = apply_rope(q, pos2d, cfg.rope_theta)
+        k = apply_rope(k, pos2d, cfg.rope_theta)
+        # Scatter the chunk's K/V into the slot's lane at absolute positions.
+        k_cache = k_cache.at[slot, positions].set(k[0])
+        v_cache = v_cache.at[slot, positions].set(v[0])
+        # Chunk queries vs the whole lane, masked to cache index <= q position.
+        lane_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, keepdims=False)
+        lane_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, keepdims=False)
+        qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
+        logits = jnp.einsum(
+            "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = jnp.arange(s_max)[None, :] <= positions[:, None]  # [C, S]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("kgij,jkh->ikgh", probs, lane_v).reshape(1, c, -1)
+        h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_cache, v_cache)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    last_h = jax.lax.dynamic_index_in_dim(h[0], last_index, 0, keepdims=False)
+    last_logits = q_matmul(last_h, head).astype(jnp.float32)
+    length_vec = cache["length"].at[slot].set(lane_end)
+    return last_logits, {"k": k_new, "v": v_new, "length": length_vec}
+
+
 def insert_prefill(
     cache: Params,
     k_prompt: jax.Array,  # [L, 1, S, K, hd] from prefill

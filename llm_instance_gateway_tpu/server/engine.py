@@ -98,6 +98,10 @@ class Request:
         return (self.t_first_token - self.t_submit) if self.t_first_token else 0.0
 
 
+class _PrefillCancelled(Exception):
+    """Admission aborted because the request was cancelled mid-prefill."""
+
+
 @dataclass
 class _Slot:
     request: Request
@@ -165,6 +169,18 @@ class Engine:
         # copy the full multi-GB decode cache.
         self._jit_insert = jax.jit(
             transformer.insert_prefill, donate_argnames=("cache",)
+        )
+        # Chunked prefill for prompts beyond the largest bucket: one
+        # chunk-sized program streams the prompt into the cache lane.
+        self._jit_chunk = jax.jit(
+            functools.partial(transformer.prefill_with_cache, model_cfg),
+            donate_argnames=("cache",),
+        )
+        self._jit_sample_one = jax.jit(
+            lambda logits, key, t, k, p: sample(
+                logits[None], key, jnp.full((1,), t, jnp.float32),
+                jnp.full((1,), k, jnp.int32), jnp.full((1,), p, jnp.float32),
+            )[0]
         )
 
     # ------------------------------------------------------------------
@@ -261,7 +277,15 @@ class Engine:
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
                 f"{self.cfg.max_seq_len}"
             )
-        self._bucket(len(request.prompt_tokens))  # validate here, not mid-batch
+        # Prompts beyond the largest bucket stream through chunked prefill;
+        # within a bucket, validate the bucket fit here rather than mid-batch.
+        if self._max_bucket() <= 0:
+            raise ValueError(
+                f"no usable prefill bucket <= max_seq_len "
+                f"{self.cfg.max_seq_len}: {self.cfg.prefill_buckets}"
+            )
+        if len(request.prompt_tokens) <= self._max_bucket():
+            self._bucket(len(request.prompt_tokens))
         request.t_submit = time.time()
         if request.adapter is not None and self.lora is not None:
             # Resolve eagerly so unknown adapters fail fast (404, not mid-batch).
@@ -324,6 +348,12 @@ class Engine:
                 return b
         raise ValueError(f"prompt length {n} exceeds largest prefill bucket")
 
+    def _max_bucket(self) -> int:
+        return max(
+            (b for b in self.cfg.prefill_buckets if b <= self.cfg.max_seq_len),
+            default=0,
+        )
+
     def _next_key(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -357,17 +387,20 @@ class Engine:
                     self._work.wait(timeout=0.05)
 
     def _prefill_common(self, req: Request):
-        """Shared admission path: bucket, prefill, insert.  Returns
-        (slot_idx, first_token_device, n, lora_slot)."""
+        """Shared admission path: bucket (or chunked) prefill + insert.
+        Returns (slot_idx, first_token_device, n, lora_slot)."""
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
+        lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
+        sp = req.sampling
+        if n > self._max_bucket():
+            first_token = self._chunked_prefill(req, slot_idx, lora_slot)
+            return slot_idx, first_token, n, lora_slot
         bucket = self._bucket(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.zeros((1, bucket), np.int32)
         positions[0, :n] = np.arange(n)
-        lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
-        sp = req.sampling
         first_token, k, v = self._jit_prefill(
             self.params, self._lora_buffers(),
             jnp.asarray(tokens), jnp.asarray(positions),
@@ -380,6 +413,42 @@ class Engine:
             self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
         )
         return slot_idx, first_token, n, lora_slot
+
+    def _chunked_prefill(self, req: Request, slot_idx: int, lora_slot: int):
+        """Stream a long prompt through the cache lane chunk by chunk.
+
+        One chunk-sized compiled program regardless of prompt length; pads in
+        the final chunk scatter past the true prompt end (see
+        transformer.prefill_with_cache).  Returns the first sampled token
+        (device scalar).
+        """
+        chunk = self._max_bucket()
+        prompt = req.prompt_tokens
+        n = len(prompt)
+        sp = req.sampling
+        last_logits = None
+        for start in range(0, n, chunk):
+            if req.cancelled.is_set():
+                # Long-prompt client died mid-stream-in: stop dispatching
+                # chunk programs; the lane's partial KV is overwritten on
+                # reuse.
+                raise _PrefillCancelled()
+            piece = prompt[start:start + chunk]
+            c = len(piece)
+            tokens = np.zeros((chunk,), np.int32)
+            tokens[:c] = piece
+            positions = start + np.arange(chunk, dtype=np.int32)
+            last_logits, self.cache = self._jit_chunk(
+                self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.int32(slot_idx), jnp.int32(start + c), jnp.int32(c - 1),
+                lora_bufs=self._lora_buffers(), lora_slot=jnp.int32(lora_slot),
+            )
+        return self._jit_sample_one(
+            last_logits, self._next_key(),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p),
+        )
 
     def _register_slot(self, slot_idx: int, slot: _Slot) -> None:
         sp = slot.request.sampling
@@ -418,6 +487,8 @@ class Engine:
             )
             self._slot_tokens[slot_idx] = tok
             self._slot_positions[slot_idx] = n
+        except _PrefillCancelled:
+            self._finish(req, "cancelled")
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill failed for %s", req.request_id)
             req.error = str(e)
@@ -573,6 +644,8 @@ class Engine:
             slot = _Slot(request=req, lora_slot=lora_slot, position=n)
             slot.pending_first = first_token
             self._register_slot(slot_idx, slot)
+        except _PrefillCancelled:
+            self._finish(req, "cancelled")
         except Exception as e:
             logger.exception("pipelined prefill failed for %s", req.request_id)
             req.error = str(e)
