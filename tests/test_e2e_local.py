@@ -62,6 +62,27 @@ def _post(url: str, payload: dict, timeout_s: float = 120.0):
         return e.code, json.loads(e.read() or b"{}")
 
 
+def _launch_module(args, log_path, cwd=None):
+    """Start `python -m <args>` with the repo env; returns (proc, log)."""
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args, env=_env(),
+        stdout=log, stderr=subprocess.STDOUT, cwd=cwd,
+    )
+    return proc, log
+
+
+def _teardown_procs(procs):
+    for proc, log in procs:
+        proc.send_signal(signal.SIGTERM)
+    for proc, log in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
+
+
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("e2e")
@@ -86,23 +107,12 @@ spec:
     procs = []
 
     def launch(args, log_name):
-        log = open(tmp / log_name, "w")
-        proc = subprocess.Popen(
-            [sys.executable, "-m"] + args, env=_env(),
-            stdout=log, stderr=subprocess.STDOUT, cwd=str(tmp),
-        )
-        procs.append((proc, log))
-        return proc
+        entry = _launch_module(args, tmp / log_name, cwd=str(tmp))
+        procs.append(entry)
+        return entry[0]
 
     def teardown():
-        for proc, log in procs:
-            proc.send_signal(signal.SIGTERM)
-        for proc, log in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-            log.close()
+        _teardown_procs(procs)
 
     try:
         launch(
@@ -199,3 +209,60 @@ def test_saturation_backpressure(stack):
         {"model": "ghost", "prompt": "x"},
     )
     assert status == 404
+
+
+def test_extproc_binary_serves_grpc(stack):
+    """The gRPC EPP binary (Envoy deployment mode) routes over a real socket."""
+    import grpc
+
+    sys.path.insert(0, REPO)
+    from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+    from llm_instance_gateway_tpu.gateway.extproc.service import (
+        make_health_stub,
+        make_process_stub,
+    )
+
+    port = 18820
+    entry = _launch_module(
+        ["llm_instance_gateway_tpu.gateway.extproc",
+         "--config", str(stack["pool"]), "--port", str(port),
+         "--pod", f"r1=127.0.0.1:{SERVER_PORT}", "--probe-endpoints"],
+        stack["tmp"] / "extproc.log",
+    )
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        health = make_health_stub(channel)
+        deadline = time.monotonic() + 30
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status = health(pb.HealthCheckRequest(), timeout=2).status
+                if status == pb.HealthCheckResponse.SERVING:
+                    break
+            except grpc.RpcError:
+                pass
+            time.sleep(0.5)
+        assert status == pb.HealthCheckResponse.SERVING
+        # Provider needs a pod-refresh cycle before the scheduler sees r1.
+        stub = make_process_stub(channel)
+        body = json.dumps({"model": "llama3-tiny", "prompt": "x",
+                           "max_tokens": 2}).encode()
+        deadline = time.monotonic() + 30
+        headers = {}
+        while time.monotonic() < deadline:
+            try:
+                resp = next(stub(iter([pb.ProcessingRequest(
+                    request_body=pb.HttpBody(body=body))])))
+            except grpc.RpcError:
+                time.sleep(1.0)  # warm-up window: retry like the health loop
+                continue
+            if resp.WhichOneof("response") == "request_body":
+                headers = {h.key: h.raw_value.decode() for h in
+                           resp.request_body.response.header_mutation.set_headers}
+                if headers.get("target-pod"):
+                    break
+            time.sleep(1.0)
+        assert headers.get("target-pod") == f"127.0.0.1:{SERVER_PORT}"
+        channel.close()
+    finally:
+        _teardown_procs([entry])

@@ -1,0 +1,58 @@
+"""Gateway self-telemetry unit tests (render format + histogram math)."""
+
+from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics, Histogram
+from llm_instance_gateway_tpu.utils import prom_parse
+
+
+class TestHistogram:
+    def test_quantiles(self):
+        h = Histogram()
+        for v in (0.0001, 0.0002, 0.0003, 0.04, 0.2):
+            h.observe(v)
+        assert h.n == 5
+        assert h.quantile(0.5) <= 0.001
+        assert h.quantile(0.99) >= 0.1
+
+    def test_overflow_bucket(self):
+        h = Histogram()
+        h.observe(100.0)  # beyond the largest bucket
+        assert h.quantile(0.5) == float("inf")
+
+
+class TestRender:
+    def test_exposition_parses_and_counts(self):
+        m = GatewayMetrics()
+        m.record_request("sql-assist")
+        m.record_pick("pod-a", 0.0002, affinity_hit=True)
+        m.record_shed()
+        m.record_usage("sql-assist", 10, 20)
+        families = prom_parse.parse_text(m.render())
+        assert families["gateway_requests_total"][0].labels["model"] == "sql-assist"
+        assert families["gateway_shed_total"][0].value == 1
+        assert families["gateway_lora_affinity_hits_total"][0].value == 1
+        assert families["gateway_completion_tokens_total"][0].value == 20
+        assert families["gateway_pick_latency_seconds_count"][0].value == 1
+
+    def test_render_under_concurrent_mutation(self):
+        """render() must stay well-formed while another thread records."""
+        import threading
+
+        m = GatewayMetrics()
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                m.record_pick(f"pod-{i % 3}", 0.001, affinity_hit=(i % 2 == 0))
+                m.record_usage("m", 1, 2)
+                i += 1
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for _ in range(50):
+                families = prom_parse.parse_text(m.render())
+                assert "gateway_shed_total" in families  # parses every time
+        finally:
+            stop.set()
+            t.join()
