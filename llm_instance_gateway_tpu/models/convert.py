@@ -1,0 +1,163 @@
+"""Convert HuggingFace checkpoints into this framework's param layout.
+
+The serving path restores Orbax pytrees (``api_http --checkpoint``); real
+deployments start from HF-format weights.  This module maps a
+``LlamaForCausalLM``-style state dict (Llama-2/3; other model types are
+rejected loudly until their config flags are mapped) onto
+``transformer.init_params``'s stacked-layer layout, and a numerics test
+(tests/test_convert.py) holds our decoder to the canonical implementation's
+logits.
+
+Conventions verified by that test:
+- RoPE: split-halves (rotate_half) convention, matching HF Llama.
+- GQA: q [d, H*hd], k/v [d, K*hd] column layouts transpose from HF's
+  [out, in] Linear weights.
+- RMSNorm pre-norm placement, f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_instance_gateway_tpu.models.configs import LLAMA3_8B, ModelConfig
+
+
+def config_from_hf(hf_config) -> ModelConfig:
+    """ModelConfig from a transformers LlamaConfig-like object.
+
+    Loud rejections instead of silent wrong math:
+    - non-Llama model types (Gemma needs embedding_scale/norm_plus_one/
+      gelu_mlp mapping; Mixtral needs the expert stack layout);
+    - rope_scaling (Llama-3.1+ long-context scaling is not implemented in
+      ``ops.layers.apply_rope`` yet — converting anyway would serve
+      divergent logits).
+    """
+    model_type = getattr(hf_config, "model_type", "llama")
+    if model_type not in ("llama",):
+        raise NotImplementedError(
+            f"HF model_type {model_type!r} not supported by the converter yet "
+            "(only 'llama'); Gemma/Mixtral need their config-flag mappings"
+        )
+    if getattr(hf_config, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={hf_config.rope_scaling!r} is not implemented; "
+            "converting would silently change long-context frequencies"
+        )
+    return dataclasses.replace(
+        LLAMA3_8B,
+        name=getattr(hf_config, "name_or_path", "") or "hf-llama",
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        head_dim=getattr(hf_config, "head_dim", 0)
+        or hf_config.hidden_size // hf_config.num_attention_heads,
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+def params_from_hf_state_dict(cfg: ModelConfig, state_dict, dtype=jnp.bfloat16):
+    """Map an HF Llama state dict onto our stacked-layer pytree.
+
+    HF Linear weights are [out, in]; ours are [in, out] — transposed here.
+    The embedding row space is padded to ``cfg.padded_vocab``.
+    """
+
+    # Per-tensor dtype cast at stack time: staging whole stacked layers in
+    # f32 would triple peak host memory on an 8B conversion.
+    def t(name):  # tensor -> [in, out] in the target dtype
+        return jnp.asarray(np.asarray(state_dict[name]).T, dtype)
+
+    def stack(fmt):
+        return jnp.stack([t(fmt.format(i)) for i in range(cfg.n_layers)])
+
+    def stack_raw(fmt):  # norms: 1-D, no transpose
+        return jnp.stack(
+            [jnp.asarray(np.asarray(state_dict[fmt.format(i)]), dtype)
+             for i in range(cfg.n_layers)]
+        )
+
+    embed = np.asarray(state_dict["model.embed_tokens.weight"])
+    padded = jnp.zeros((cfg.padded_vocab, cfg.d_model), dtype)
+    padded = padded.at[: embed.shape[0]].set(jnp.asarray(embed, dtype))
+
+    layers = {
+        "attn_norm": stack_raw("model.layers.{}.input_layernorm.weight"),
+        "mlp_norm": stack_raw("model.layers.{}.post_attention_layernorm.weight"),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+    }
+    params = {
+        "embed": padded,
+        "layers": layers,
+        "final_norm": jnp.asarray(
+            np.asarray(state_dict["model.norm.weight"]), dtype),
+    }
+    if not cfg.tie_embeddings:
+        head = jnp.asarray(np.asarray(state_dict["lm_head.weight"]).T, dtype)
+        padded_head = jnp.zeros((cfg.d_model, cfg.padded_vocab), dtype)
+        params["lm_head"] = padded_head.at[:, : head.shape[1]].set(head)
+    return params
+
+
+def from_hf_llama(model, dtype=jnp.bfloat16):
+    """(cfg, params) from a loaded transformers LlamaForCausalLM."""
+    cfg = config_from_hf(model.config)
+    state = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    return cfg, params_from_hf_state_dict(cfg, state, dtype=dtype)
+
+
+def save_hf_as_orbax(model, path: str, dtype=jnp.bfloat16) -> ModelConfig:
+    """Convert + write the serving checkpoint (api_http --checkpoint).
+
+    The params pytree goes to ``<path>/params`` and the architecture to
+    ``<path>/model_config.json`` so the server reconstructs the exact
+    ModelConfig without a preset (--model is ignored when present).
+    """
+    import json
+    import os
+
+    import orbax.checkpoint as ocp
+
+    cfg, params = from_hf_llama(model, dtype=dtype)
+    os.makedirs(path, exist_ok=True)
+    ocp.PyTreeCheckpointer().save(os.path.join(path, "params"), params)
+    with open(os.path.join(path, "model_config.json"), "w") as f:
+        json.dump(dataclasses.asdict(cfg), f, indent=1)
+    return cfg
+
+
+def load_serving_checkpoint(path: str):
+    """(cfg_or_None, params) from a checkpoint directory.
+
+    Accepts both layouts: a bare Orbax params tree (preset-config servers)
+    or the ``params`` + ``model_config.json`` pair save_hf_as_orbax writes.
+    """
+    import json
+    import os
+
+    import orbax.checkpoint as ocp
+
+    cfg = None
+    params_path = path
+    cfg_file = os.path.join(path, "model_config.json")
+    if os.path.exists(cfg_file):
+        with open(cfg_file) as f:
+            cfg = ModelConfig(**json.load(f))
+        params_path = os.path.join(path, "params")
+    params = ocp.PyTreeCheckpointer().restore(params_path)
+    return cfg, params

@@ -398,17 +398,27 @@ def main(argv=None) -> None:
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     tokenizer = load_tokenizer(args.tokenizer)
-    if tokenizer.vocab_size > cfg.vocab_size:
-        raise SystemExit(
-            f"tokenizer vocab {tokenizer.vocab_size} exceeds model vocab {cfg.vocab_size}"
-        )
     if args.checkpoint:
-        import orbax.checkpoint as ocp
-        params = ocp.PyTreeCheckpointer().restore(args.checkpoint)
+        from llm_instance_gateway_tpu.models.convert import load_serving_checkpoint
+
+        ckpt_cfg, params = load_serving_checkpoint(args.checkpoint)
+        if ckpt_cfg is not None:
+            # Converted checkpoints carry their architecture; the preset
+            # --model only contributes serving knobs like max_lora_slots.
+            cfg = dataclasses.replace(
+                ckpt_cfg, max_lora_slots=args.max_loras,
+                max_lora_rank=cfg.max_lora_rank,
+            )
+            logger.info("model config restored from checkpoint: %s", cfg.name)
         logger.info("restored params from %s", args.checkpoint)
     else:
         logger.warning("no --checkpoint: serving RANDOM weights (dev mode)")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    # Validate AFTER the checkpoint may have replaced the architecture.
+    if tokenizer.vocab_size > cfg.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab {tokenizer.vocab_size} exceeds model vocab {cfg.vocab_size}"
+        )
     if args.quantize == "int8":
         from llm_instance_gateway_tpu.ops.quant import quantize_params
 

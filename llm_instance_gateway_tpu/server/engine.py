@@ -180,6 +180,7 @@ class Engine:
             lambda logits, key, t, k, p: sample(
                 logits[None], key, jnp.full((1,), t, jnp.float32),
                 jnp.full((1,), k, jnp.int32), jnp.full((1,), p, jnp.float32),
+                valid_vocab=model_cfg.vocab_size,
             )[0]
         )
 
@@ -204,6 +205,7 @@ class Engine:
             temperature=jnp.full((1,), temp, jnp.float32),
             top_k=jnp.full((1,), topk, jnp.int32),
             top_p=jnp.full((1,), topp, jnp.float32),
+            valid_vocab=model_cfg.vocab_size,
         )
         return first_token[0], k, v
 
@@ -235,7 +237,8 @@ class Engine:
                 model_cfg, params, cache, tokens, safe_pos,
                 lora_bufs=lora_bufs, slot_ids=slot_ids,
             )
-            sampled = sample(logits, step_key, temp, topk, topp)
+            sampled = sample(logits, step_key, temp, topk, topp,
+                             valid_vocab=model_cfg.vocab_size)
             valid = active
             # EOS emitted now is a valid token but deactivates the row.
             hit_eos = valid & (sampled == eos_id)

@@ -20,9 +20,19 @@ def sample(
     temperature: jax.Array,   # [B] f32; 0 = greedy
     top_k: jax.Array,         # [B] int32; 0 = disabled
     top_p: jax.Array,         # [B] f32; 1.0 = disabled
+    valid_vocab: int | None = None,  # static: ids >= this are MXU padding
 ) -> jax.Array:
-    """Returns sampled token ids [B]."""
+    """Returns sampled token ids [B].
+
+    ``valid_vocab`` masks the vocab-padding columns (the lm_head is padded to
+    a multiple of 128 for MXU tiling with zero — hence logit 0.0 — columns);
+    without the mask, temperature sampling could emit ids the tokenizer has
+    never heard of.
+    """
     b, v = logits.shape
+    if valid_vocab is not None and valid_vocab < v:
+        pad_mask = jnp.arange(v) < valid_vocab
+        logits = jnp.where(pad_mask[None, :], logits, NEG_INF)
     greedy = jnp.argmax(logits, axis=-1)
 
     # Temperature scaling (guard zero; greedy rows are selected at the end).

@@ -75,7 +75,8 @@ class TestGeneration:
         tokens = jnp.asarray([prompt], jnp.int32)
         positions = jnp.arange(len(prompt))[None]
         logits, k, v = transformer.prefill(CFG, params, tokens, positions)
-        want = [int(jnp.argmax(logits[0, len(prompt) - 1]))]
+        # argmax over the TRUE vocab: the engine masks MXU vocab padding.
+        want = [int(jnp.argmax(logits[0, len(prompt) - 1, :CFG.vocab_size]))]
         cache = transformer.init_decode_cache(CFG, 1, 64, dtype=jnp.float32)
         cache = transformer.insert_prefill(cache, k, v, 0, len(prompt))
         pos = len(prompt)
@@ -84,7 +85,7 @@ class TestGeneration:
                 CFG, params, cache,
                 jnp.asarray([want[-1]], jnp.int32), jnp.asarray([pos], jnp.int32),
             )
-            want.append(int(jnp.argmax(lg[0])))
+            want.append(int(jnp.argmax(lg[0, :CFG.vocab_size])))
             pos += 1
         assert got == want
 

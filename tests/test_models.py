@@ -212,3 +212,20 @@ class TestSampling:
                        temperature=jnp.array([1.0]),
                        top_k=jnp.array([0]), top_p=jnp.array([0.5]))
             assert int(t[0]) == 0
+
+
+class TestVocabPadding:
+    def test_sampling_never_emits_padded_ids(self):
+        """Zero-logit padding columns must be unsampleable at any temperature."""
+        from llm_instance_gateway_tpu.server.sampling import sample
+        valid = 5
+        # Real ids have strongly NEGATIVE logits; padding columns sit at 0.0
+        # (the padded lm_head case) and would dominate without the mask.
+        logits = jnp.concatenate(
+            [jnp.full((1, valid), -10.0), jnp.zeros((1, 123))], axis=1
+        )
+        for i in range(40):
+            tok = sample(logits, jax.random.PRNGKey(i),
+                         jnp.array([2.0]), jnp.array([0]), jnp.array([1.0]),
+                         valid_vocab=valid)
+            assert int(tok[0]) < valid
