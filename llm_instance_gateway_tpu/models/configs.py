@@ -31,6 +31,11 @@ class ModelConfig:
     d_ff: int
     head_dim: int = 0  # 0 -> d_model // n_heads
     rope_theta: float = 500_000.0
+    # Llama-3.1 long-context rope scaling (factor 0 = disabled).
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     # Gemma-style differences.
@@ -64,6 +69,18 @@ class ModelConfig:
     @property
     def padded_vocab(self) -> int:
         return pad_to(self.vocab_size, 128)
+
+    @property
+    def rope_scaling(self) -> tuple | None:
+        """(factor, low_ff, high_ff, original_max) or None when disabled."""
+        if not self.rope_scaling_factor:
+            return None
+        return (
+            self.rope_scaling_factor,
+            self.rope_low_freq_factor,
+            self.rope_high_freq_factor,
+            self.rope_original_max_len,
+        )
 
     def tiny(self) -> "ModelConfig":
         """Shrink to test size, keeping structure (ratios, GQA, MoE-ness)."""

@@ -1,12 +1,12 @@
 """Convert HuggingFace checkpoints into this framework's param layout.
 
 The serving path restores Orbax pytrees (``api_http --checkpoint``); real
-deployments start from HF-format weights.  This module maps a
-``LlamaForCausalLM``-style state dict (Llama-2/3; other model types are
-rejected loudly until their config flags are mapped) onto
-``transformer.init_params``'s stacked-layer layout, and a numerics test
-(tests/test_convert.py) holds our decoder to the canonical implementation's
-logits.
+deployments start from HF-format weights.  This module maps Llama-2/3
+(incl. Llama-3.1 ``rope_scaling``) and Gemma state dicts onto
+``transformer.init_params``'s stacked-layer layout — other model types are
+rejected loudly until their mappings land — and numerics tests
+(tests/test_convert.py) hold our decoder to the canonical implementations'
+logits for every supported family.
 
 Conventions verified by that test:
 - RoPE: split-halves (rotate_half) convention, matching HF Llama.
@@ -26,29 +26,40 @@ from llm_instance_gateway_tpu.models.configs import LLAMA3_8B, ModelConfig
 
 
 def config_from_hf(hf_config) -> ModelConfig:
-    """ModelConfig from a transformers LlamaConfig-like object.
+    """ModelConfig from a transformers Llama/Gemma config object.
 
-    Loud rejections instead of silent wrong math:
-    - non-Llama model types (Gemma needs embedding_scale/norm_plus_one/
-      gelu_mlp mapping; Mixtral needs the expert stack layout);
-    - rope_scaling (Llama-3.1+ long-context scaling is not implemented in
-      ``ops.layers.apply_rope`` yet — converting anyway would serve
-      divergent logits).
+    Mapped: Llama (incl. llama3-type rope_scaling) and Gemma (embedding
+    scale, (1+w) norm, tanh-GeLU).  Loud rejections instead of silent wrong
+    math for everything else: unknown model types (Mixtral needs the expert
+    stack layout) and non-llama3 rope_scaling types.
     """
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama",):
+    if model_type not in ("llama", "gemma"):
         raise NotImplementedError(
             f"HF model_type {model_type!r} not supported by the converter yet "
-            "(only 'llama'); Gemma/Mixtral need their config-flag mappings"
+            "(llama and gemma are); Mixtral needs the expert-stack mapping"
         )
-    if getattr(hf_config, "rope_scaling", None):
-        raise NotImplementedError(
-            f"rope_scaling={hf_config.rope_scaling!r} is not implemented; "
-            "converting would silently change long-context frequencies"
-        )
+    scaling_kwargs = {}
+    rope_scaling = getattr(hf_config, "rope_scaling", None)
+    if rope_scaling:
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+        if rope_type != "llama3":
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not implemented "
+                "(only 'llama3'); converting would silently change "
+                "long-context frequencies"
+            )
+        scaling_kwargs = {
+            "rope_scaling_factor": float(rope_scaling["factor"]),
+            "rope_low_freq_factor": float(rope_scaling["low_freq_factor"]),
+            "rope_high_freq_factor": float(rope_scaling["high_freq_factor"]),
+            "rope_original_max_len": int(
+                rope_scaling["original_max_position_embeddings"]),
+        }
+    gemma = model_type == "gemma"
     return dataclasses.replace(
         LLAMA3_8B,
-        name=getattr(hf_config, "name_or_path", "") or "hf-llama",
+        name=getattr(hf_config, "name_or_path", "") or f"hf-{model_type}",
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,
@@ -62,6 +73,12 @@ def config_from_hf(hf_config) -> ModelConfig:
         norm_eps=hf_config.rms_norm_eps,
         max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Gemma conventions (parity-tested against GemmaForCausalLM):
+        # sqrt(d_model) embedding normalizer, (1+w) RMSNorm, tanh-GeLU gate.
+        embedding_scale=gemma,
+        norm_plus_one=gemma,
+        gelu_mlp=gemma,
+        **scaling_kwargs,
     )
 
 

@@ -179,8 +179,8 @@ def prefill(
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, s, cfg.n_heads, hd)
         k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         if attention_fn is not None:
             attn = attention_fn(q, k, v, positions)
         elif cfg.use_flash_attention:
@@ -245,8 +245,8 @@ def decode_step(
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, cfg.n_heads, hd)
         k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, cfg.n_kv_heads, hd)
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
-        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
         if cfg.use_pallas_decode:
@@ -320,8 +320,8 @@ def prefill_with_cache(
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
         k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
-        q = apply_rope(q, pos2d, cfg.rope_theta)
-        k = apply_rope(k, pos2d, cfg.rope_theta)
+        q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
         # Scatter the chunk's K/V into the slot's lane at absolute positions.
         k_cache = k_cache.at[slot, positions].set(k[0])
         v_cache = v_cache.at[slot, positions].set(v[0])

@@ -24,13 +24,33 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
     return (normed * w).astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
-    """Inverse frequencies for rotary embedding, shape [head_dim//2], f32."""
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling: tuple | None = None) -> jax.Array:
+    """Inverse frequencies for rotary embedding, shape [head_dim//2], f32.
+
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor, original_max)
+    applies the Llama-3.1 long-context frequency remapping: wavelengths
+    beyond ``original_max/low_freq_factor`` are stretched by ``factor``,
+    short wavelengths pass through, and the band between interpolates —
+    parity-tested against transformers' llama3 rope_type.
+    """
     exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta ** exponents)
+    freqs = 1.0 / (theta ** exponents)
+    if scaling is None:
+        return freqs
+    factor, low_ff, high_ff, original_max = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    low_freq_wavelen = original_max / low_ff
+    high_freq_wavelen = original_max / high_ff
+    smooth = (original_max / wavelen - low_ff) / (high_ff - low_ff)
+    interpolated = (1.0 - smooth) * freqs / factor + smooth * freqs
+    scaled = jnp.where(wavelen > low_freq_wavelen, freqs / factor,
+                       jnp.where(wavelen < high_freq_wavelen, freqs, interpolated))
+    return scaled
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               scaling: tuple | None = None) -> jax.Array:
     """Rotary position embedding.
 
     x: [..., seq, heads, head_dim]; positions: broadcastable to [..., seq].
@@ -38,7 +58,7 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     Computed in f32, cast back — sin/cos precision matters at long context.
     """
     head_dim = x.shape[-1]
-    freqs = rope_frequencies(head_dim, theta)  # [hd/2]
+    freqs = rope_frequencies(head_dim, theta, scaling)  # [hd/2]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
     cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
     sin = jnp.sin(angles)[..., None, :]

@@ -398,6 +398,7 @@ def main(argv=None) -> None:
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     tokenizer = load_tokenizer(args.tokenizer)
+    served_name = args.model  # CLI preset alias (cfg.name can differ from it)
     if args.checkpoint:
         from llm_instance_gateway_tpu.models.convert import load_serving_checkpoint
 
@@ -409,6 +410,7 @@ def main(argv=None) -> None:
                 ckpt_cfg, max_lora_slots=args.max_loras,
                 max_lora_rank=cfg.max_lora_rank,
             )
+            served_name = cfg.name  # checkpoint architectures bring their name
             logger.info("model config restored from checkpoint: %s", cfg.name)
         logger.info("restored params from %s", args.checkpoint)
     else:
@@ -438,7 +440,7 @@ def main(argv=None) -> None:
         dtype=dtype,
     )
     engine.start()
-    server = ModelServer(engine, tokenizer, args.model, lora_manager)
+    server = ModelServer(engine, tokenizer, served_name, lora_manager)
     try:
         web.run_app(server.build_app(), port=args.port)
     finally:

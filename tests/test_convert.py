@@ -82,3 +82,105 @@ def test_greedy_continuation_matches_hf(hf_and_ours):
         out.append(int(jnp.argmax(lg[0, : model.config.vocab_size])))
         pos += 1
     assert out == hf_out.tolist()
+
+
+class TestGemmaParity:
+    @pytest.fixture(scope="class")
+    def gemma_and_ours(self):
+        cfg = transformers.GemmaConfig(
+            vocab_size=160, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=1,
+            intermediate_size=128, head_dim=16, max_position_embeddings=256,
+            rms_norm_eps=1e-6, rope_theta=10_000.0,
+        )
+        torch.manual_seed(1)
+        model = transformers.GemmaForCausalLM(cfg)
+        model.eval()
+        our_cfg, params = from_hf_llama(model, dtype=jnp.float32)
+        return model, our_cfg, params
+
+    def test_flags_mapped(self, gemma_and_ours):
+        _, cfg, _ = gemma_and_ours
+        assert cfg.embedding_scale and cfg.norm_plus_one and cfg.gelu_mlp
+        assert cfg.tie_embeddings
+        assert cfg.n_kv_heads == 1  # MQA
+
+    def test_logits_match_hf(self, gemma_and_ours):
+        model, cfg, params = gemma_and_ours
+        ids = np.array([[2, 45, 101, 7, 88, 131]], np.int64)
+        with torch.no_grad():
+            hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+        tokens = jnp.asarray(ids, jnp.int32)
+        positions = jnp.arange(ids.shape[1])[None]
+        ours, *_ = transformer.prefill(cfg, params, tokens, positions)
+        ours = np.asarray(ours)[:, :, : model.config.vocab_size]
+        np.testing.assert_allclose(hf_logits, ours, rtol=3e-4, atol=3e-4)
+
+
+def test_unsupported_model_type_rejected():
+    cfg = transformers.MistralConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+    )
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+    with pytest.raises(NotImplementedError, match="model_type"):
+        config_from_hf(cfg)
+
+
+def test_llama3_rope_scaling_mapped():
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 8192},
+    )
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+    ours = config_from_hf(cfg)
+    assert ours.rope_scaling == (8.0, 1.0, 4.0, 8192)
+
+
+def test_unknown_rope_scaling_type_rejected():
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+    )
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+    with pytest.raises(NotImplementedError, match="rope_scaling type"):
+        config_from_hf(cfg)
+
+
+class TestRopeScaling:
+    def test_llama3_scaling_matches_hf(self):
+        """Our llama3 rope remapping must reproduce transformers' logits."""
+        from llm_instance_gateway_tpu.models.convert import (
+            config_from_hf, params_from_hf_state_dict,
+        )
+        import dataclasses as dc
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=128, max_position_embeddings=64,
+            rms_norm_eps=1e-5, rope_theta=10_000.0, tie_word_embeddings=False,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 32},
+        )
+        torch.manual_seed(2)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        model.eval()
+        cfg = config_from_hf(hf_cfg)  # scaling mapped by the converter
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+        state = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+        params = params_from_hf_state_dict(cfg, state, dtype=jnp.float32)
+        ids = np.array([[3, 17, 54, 9, 88, 120, 7, 42, 11, 99]], np.int64)
+        with torch.no_grad():
+            hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+        ours, *_ = transformer.prefill(
+            cfg, params, jnp.asarray(ids, jnp.int32),
+            jnp.arange(ids.shape[1])[None],
+        )
+        ours = np.asarray(ours)[:, :, :128]
+        np.testing.assert_allclose(hf_logits, ours, rtol=3e-4, atol=3e-4)

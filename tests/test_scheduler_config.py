@@ -52,22 +52,27 @@ class TestCancellation:
         params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
         engine = Engine(
             cfg, params,
-            EngineConfig(decode_slots=1, max_seq_len=64, prefill_buckets=(8,),
+            EngineConfig(decode_slots=1, max_seq_len=1024, prefill_buckets=(8,),
                          decode_steps_per_sync=2, pipeline_decode=pipeline),
             eos_id=None, dtype=jnp.float32,
         )
         engine.start()
         try:
-            long_req = Request(prompt_tokens=[1, 2, 3], max_new_tokens=50)
+            # Long enough that natural completion takes many seconds — the
+            # cancel (fired at the FIRST token) must deterministically win.
+            long_req = Request(prompt_tokens=[1, 2, 3], max_new_tokens=800)
             engine.submit(long_req)
-            # Let it start, then cancel (client disconnect).
-            deadline = time.monotonic() + 30
+            # Let it start, then cancel (client disconnect).  Generous
+            # deadlines: under parallel test load the first block (incl.
+            # compiles) can take tens of seconds.
+            deadline = time.monotonic() + 90
             while not long_req.output_tokens and time.monotonic() < deadline:
                 time.sleep(0.05)
+            assert long_req.output_tokens, "first token never arrived"
             long_req.cancelled.set()
-            assert long_req.done.wait(30)
+            assert long_req.done.wait(60)
             assert long_req.finish_reason == "cancelled"
-            assert len(long_req.output_tokens) < 50
+            assert len(long_req.output_tokens) < 800
             # The freed slot must serve the next request normally.
             follow_up = engine.generate(
                 Request(prompt_tokens=[4, 5], max_new_tokens=4), timeout_s=60
