@@ -2,7 +2,7 @@
 
 The serving path restores Orbax pytrees (``api_http --checkpoint``); real
 deployments start from HF-format weights.  This module maps Llama-2/3
-(incl. Llama-3.1 ``rope_scaling``) and Gemma state dicts onto
+(incl. Llama-3.1 ``rope_scaling``), Gemma, and Mixtral state dicts onto
 ``transformer.init_params``'s stacked-layer layout — other model types are
 rejected loudly until their mappings land — and numerics tests
 (tests/test_convert.py) hold our decoder to the canonical implementations'
@@ -26,18 +26,19 @@ from llm_instance_gateway_tpu.models.configs import LLAMA3_8B, ModelConfig
 
 
 def config_from_hf(hf_config) -> ModelConfig:
-    """ModelConfig from a transformers Llama/Gemma config object.
+    """ModelConfig from a transformers Llama/Gemma/Mixtral config object.
 
-    Mapped: Llama (incl. llama3-type rope_scaling) and Gemma (embedding
-    scale, (1+w) norm, tanh-GeLU).  Loud rejections instead of silent wrong
-    math for everything else: unknown model types (Mixtral needs the expert
-    stack layout) and non-llama3 rope_scaling types.
+    Mapped: Llama (incl. llama3-type rope_scaling), Gemma (embedding scale,
+    (1+w) norm, tanh-GeLU), Mixtral (expert stacks + router).  Loud
+    rejections instead of silent wrong math for everything else: unknown
+    model types, non-llama3 rope_scaling types, and sliding-window attention
+    (our decoder attends the full causal context).
     """
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "gemma"):
+    if model_type not in ("llama", "gemma", "mixtral"):
         raise NotImplementedError(
-            f"HF model_type {model_type!r} not supported by the converter yet "
-            "(llama and gemma are); Mixtral needs the expert-stack mapping"
+            f"HF model_type {model_type!r} not supported by the converter "
+            "(llama, gemma, mixtral are)"
         )
     scaling_kwargs = {}
     rope_scaling = getattr(hf_config, "rope_scaling", None)
@@ -56,6 +57,14 @@ def config_from_hf(hf_config) -> ModelConfig:
             "rope_original_max_len": int(
                 rope_scaling["original_max_position_embeddings"]),
         }
+    sliding = getattr(hf_config, "sliding_window", None)
+    max_pos = getattr(hf_config, "max_position_embeddings", 8192)
+    if sliding and sliding < max_pos:
+        raise NotImplementedError(
+            f"sliding_window={sliding} < max_position_embeddings={max_pos}: "
+            "our decoder attends the full causal context; converting would "
+            "produce divergent long-context logits"
+        )
     gemma = model_type == "gemma"
     return dataclasses.replace(
         LLAMA3_8B,
@@ -78,6 +87,11 @@ def config_from_hf(hf_config) -> ModelConfig:
         embedding_scale=gemma,
         norm_plus_one=gemma,
         gelu_mlp=gemma,
+        # Mixtral MoE (parity-tested against MixtralForCausalLM; top-k
+        # routing normalizations are algebraically identical).
+        n_experts=getattr(hf_config, "num_local_experts", 0)
+        if model_type == "mixtral" else 0,
+        n_experts_per_token=getattr(hf_config, "num_experts_per_tok", 2),
         **scaling_kwargs,
     )
 
@@ -114,10 +128,27 @@ def params_from_hf_state_dict(cfg: ModelConfig, state_dict, dtype=jnp.bfloat16):
         "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
         "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-        "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-        "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
     }
+    if cfg.n_experts:
+        # Mixtral expert naming: w1=gate, w3=up, w2=down (each [f, d] or
+        # [d, f] in HF's [out, in]); stacked here as [L, E, in, out].
+        def stack_experts(w_name):
+            return jnp.stack([
+                jnp.stack([
+                    t(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight")
+                    for e in range(cfg.n_experts)
+                ])
+                for i in range(cfg.n_layers)
+            ])
+
+        layers["router"] = stack("model.layers.{}.block_sparse_moe.gate.weight")
+        layers["w_gate"] = stack_experts("w1")
+        layers["w_up"] = stack_experts("w3")
+        layers["w_down"] = stack_experts("w2")
+    else:
+        layers["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight")
+        layers["w_up"] = stack("model.layers.{}.mlp.up_proj.weight")
+        layers["w_down"] = stack("model.layers.{}.mlp.down_proj.weight")
     params = {
         "embed": padded,
         "layers": layers,

@@ -35,10 +35,15 @@ logger = logging.getLogger(__name__)
 
 class ModelServer:
     def __init__(self, engine: Engine, tokenizer, model_name: str,
-                 lora_manager: LoRAManager | None = None):
+                 lora_manager: LoRAManager | None = None,
+                 aliases: set[str] | None = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # Extra names the base model answers to (e.g. the CLI preset alias
+        # when a checkpoint brought its own name) — existing clients keep
+        # working across a checkpoint swap.
+        self.aliases = {model_name} | (aliases or set())
         self.lora = lora_manager
 
     def build_app(self) -> web.Application:
@@ -57,7 +62,7 @@ class ModelServer:
         """Adapter name if the request targets a resident adapter, else None
         (base model).  Unknown names raise AdapterError -> 404, matching
         vLLM's behavior the sidecar relies on."""
-        if requested in (self.model_name, "", None):
+        if requested in ("", None) or requested in self.aliases:
             return None
         if self.lora is not None and requested in self.lora.running_adapters():
             return requested
@@ -440,7 +445,8 @@ def main(argv=None) -> None:
         dtype=dtype,
     )
     engine.start()
-    server = ModelServer(engine, tokenizer, served_name, lora_manager)
+    server = ModelServer(engine, tokenizer, served_name, lora_manager,
+                         aliases={args.model})
     try:
         web.run_app(server.build_app(), port=args.port)
     finally:

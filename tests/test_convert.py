@@ -127,6 +127,40 @@ def test_unsupported_model_type_rejected():
         config_from_hf(cfg)
 
 
+class TestMixtralParity:
+    @pytest.fixture(scope="class")
+    def mixtral_and_ours(self):
+        cfg = transformers.MixtralConfig(
+            vocab_size=144, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=96, num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=128, rms_norm_eps=1e-5,
+            rope_theta=10_000.0, tie_word_embeddings=False,
+        )
+        torch.manual_seed(4)
+        model = transformers.MixtralForCausalLM(cfg)
+        model.eval()
+        our_cfg, params = from_hf_llama(model, dtype=jnp.float32)
+        return model, our_cfg, params
+
+    def test_moe_config_mapped(self, mixtral_and_ours):
+        _, cfg, params = mixtral_and_ours
+        assert cfg.n_experts == 4 and cfg.n_experts_per_token == 2
+        assert params["layers"]["w_gate"].shape == (2, 4, 64, 96)
+        assert params["layers"]["router"].shape == (2, 64, 4)
+
+    def test_logits_match_hf(self, mixtral_and_ours):
+        model, cfg, params = mixtral_and_ours
+        ids = np.array([[3, 17, 54, 9, 88, 120, 7, 42]], np.int64)
+        with torch.no_grad():
+            hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+        tokens = jnp.asarray(ids, jnp.int32)
+        positions = jnp.arange(ids.shape[1])[None]
+        ours, *_ = transformer.prefill(cfg, params, tokens, positions)
+        ours = np.asarray(ours)[:, :, : model.config.vocab_size]
+        np.testing.assert_allclose(hf_logits, ours, rtol=3e-4, atol=3e-4)
+
+
 def test_llama3_rope_scaling_mapped():
     cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, num_hidden_layers=1,
@@ -184,3 +218,28 @@ class TestRopeScaling:
         )
         ours = np.asarray(ours)[:, :, :128]
         np.testing.assert_allclose(hf_logits, ours, rtol=3e-4, atol=3e-4)
+
+
+def test_sliding_window_rejected():
+    cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+        num_local_experts=2, num_experts_per_tok=1,
+        sliding_window=1024, max_position_embeddings=32768,
+    )
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        config_from_hf(cfg)
+
+
+def test_preset_alias_still_served_with_checkpoint_name(tmp_path):
+    """Both the checkpoint's own name and the CLI preset alias resolve."""
+    from llm_instance_gateway_tpu.server.api_http import ModelServer
+    server = ModelServer.__new__(ModelServer)
+    server.model_name = "hf-llama"
+    server.aliases = {"hf-llama", "llama3-tiny"}
+    server.lora = None
+    assert server._resolve_model("hf-llama") is None
+    assert server._resolve_model("llama3-tiny") is None
+    with pytest.raises(Exception):
+        server._resolve_model("ghost")
