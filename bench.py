@@ -94,11 +94,42 @@ def run_phase(engine, n_requests, prompt_len, max_new, adapters):
     }
 
 
+def _device_watchdog(timeout_s: float = 180.0) -> None:
+    """Fail fast if the chip can't be claimed (wedged relay grant).
+
+    Backend init blocks uninterruptibly inside PJRT when the single chip's
+    pool-side grant is stuck (observed after a killed TPU process) — without
+    this guard the bench hangs forever instead of reporting.  A watcher
+    thread hard-exits with a sentinel JSON line if a trivial device op
+    doesn't complete in time.
+    """
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(json.dumps({
+                "metric": "multiplexed_lora_tokens_per_sec",
+                "value": 0.0,
+                "unit": "tok/s",
+                "vs_baseline": 0.0,
+                "error": f"device unavailable after {timeout_s:.0f}s "
+                         "(wedged relay grant?)",
+            }), flush=True)
+            os._exit(2)
+
+    threading.Thread(target=watch, daemon=True).start()
+    jnp.zeros((8,)).block_until_ready()  # forces backend init + one op
+    done.set()
+
+
 def main() -> None:
     from llm_instance_gateway_tpu.models import transformer
     from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
     from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 
+    _device_watchdog()
     cfg = bench_model_cfg()
     on_cpu = jax.default_backend() == "cpu"
     dtype = jnp.float32 if on_cpu else jnp.bfloat16

@@ -313,6 +313,11 @@ class ModelServer:
         path = body.get("lora_path")
         if not name or not path:
             return _err(400, "lora_name and lora_path are required")
+        if name in self.aliases:
+            # A base-model alias would shadow the adapter in _resolve_model:
+            # requests naming it would silently get un-adapted output.
+            return _err(409, f"adapter name {name!r} collides with the base "
+                             "model's served names")
         loop = asyncio.get_running_loop()
         try:
             await loop.run_in_executor(

@@ -243,3 +243,37 @@ def test_preset_alias_still_served_with_checkpoint_name(tmp_path):
     assert server._resolve_model("llama3-tiny") is None
     with pytest.raises(Exception):
         server._resolve_model("ghost")
+
+
+def test_adapter_name_colliding_with_alias_rejected():
+    """An adapter named like a base-model alias must 409, not shadow."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    import jax
+    from llm_instance_gateway_tpu.models import transformer as tf
+    from llm_instance_gateway_tpu.models.configs import TINY_TEST
+    from llm_instance_gateway_tpu.server.api_http import ModelServer
+    from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+    from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
+    from llm_instance_gateway_tpu.server.tokenizer import ByteTokenizer
+
+    params = tf.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    lora = LoRAManager(TINY_TEST, dtype=jnp.float32)
+    engine = Engine(TINY_TEST, params,
+                    EngineConfig(decode_slots=1, max_seq_len=32,
+                                 prefill_buckets=(8,)),
+                    lora_manager=lora, dtype=jnp.float32)
+    server = ModelServer(engine, ByteTokenizer(), "hf-llama", lora,
+                         aliases={"llama3-tiny"})
+
+    async def run():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/load_lora_adapter", json={
+                "lora_name": "llama3-tiny", "lora_path": "/nope"})
+            assert resp.status == 409
+        finally:
+            await client.close()
+
+    asyncio.run(run())
