@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Regenerate the flattened *_pb2.py modules from proto/ sources.
+#
+# Output modules are flattened into this directory (no envoy/ or grpc/
+# python package nesting — a local `grpc/` dir would shadow site-packages
+# grpc) and imports are rewritten to package-absolute.  The serialized
+# descriptors keep their canonical proto paths (envoy/config/core/v3/...),
+# so cross-file type resolution in the descriptor pool is unaffected.
+#
+# CONSTRAINT: these register the canonical file paths AND symbol names
+# (envoy.*, grpc.health.v1.*) in the process-wide default descriptor pool —
+# deliberate, since wire/package parity with stock Envoy is the point.  If
+# the real grpcio-health-checking or Envoy proto packages are ever installed
+# in the same process, imports would collide; this image ships neither.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+
+protoc -I proto \
+  --python_out="$TMP" \
+  proto/envoy/config/core/v3/base.proto \
+  proto/envoy/type/v3/http_status.proto \
+  proto/envoy/service/ext_proc/v3/external_processor.proto \
+  proto/grpc/health/v1/health.proto
+
+PKG=llm_instance_gateway_tpu.gateway.extproc
+cp "$TMP"/envoy/config/core/v3/base_pb2.py envoy_base_pb2.py
+cp "$TMP"/envoy/type/v3/http_status_pb2.py envoy_http_status_pb2.py
+cp "$TMP"/envoy/service/ext_proc/v3/external_processor_pb2.py ext_proc_v3_pb2.py
+cp "$TMP"/grpc/health/v1/health_pb2.py health_v1_pb2.py
+
+sed -i \
+  -e "s/^from envoy\.config\.core\.v3 import base_pb2/from $PKG import envoy_base_pb2/" \
+  -e "s/^from envoy\.type\.v3 import http_status_pb2/from $PKG import envoy_http_status_pb2/" \
+  ext_proc_v3_pb2.py
+
+echo "regenerated: envoy_base_pb2.py envoy_http_status_pb2.py ext_proc_v3_pb2.py health_v1_pb2.py"

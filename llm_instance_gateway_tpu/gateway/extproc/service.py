@@ -1,28 +1,40 @@
-"""gRPC ExternalProcessor service over the tpu.extproc.v1 wire protocol.
+"""gRPC ExternalProcessor service speaking Envoy's real ext_proc v3 protocol.
 
 Parity: reference ``pkg/ext-proc/main.go:131-158`` (gRPC server wiring +
 health service) and ``handlers/server.go:51-121`` (the Process stream loop).
+The wire surface is ``envoy.service.ext_proc.v3.ExternalProcessor`` and
+``grpc.health.v1.Health`` with upstream message/field numbering
+(``proto/``), so a stock Envoy Gateway (EnvoyExtensionPolicy ->
+``deploy/gateway/``) and kubelet ``grpc:`` probes work against this server
+unmodified.
 
 grpc-python stub codegen (grpc_tools) is not available in this image, so the
-service is registered through grpc's generic-handler API with protobuf
-(de)serializers from the protoc-generated ``extproc_pb2`` — functionally
-identical to generated ``_pb2_grpc`` code.
+services are registered through grpc's generic-handler API with protobuf
+(de)serializers from the protoc-generated modules — functionally identical
+to generated ``_pb2_grpc`` code.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from concurrent import futures as _futures
 
 import grpc
 
-from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc import envoy_base_pb2 as corepb
+from llm_instance_gateway_tpu.gateway.extproc import envoy_http_status_pb2 as statuspb
+from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc import health_v1_pb2 as healthpb
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
     ProcessingResult,
     RequestBody,
     RequestHeaders,
+    RequestTrailers,
     ResponseBody,
     ResponseHeaders,
+    ResponseTrailers,
 )
 from llm_instance_gateway_tpu.gateway.handlers.server import (
     ProcessingError,
@@ -32,58 +44,85 @@ from llm_instance_gateway_tpu.gateway.handlers.server import (
 
 logger = logging.getLogger(__name__)
 
-SERVICE_NAME = "tpu.extproc.v1.ExternalProcessor"
-HEALTH_SERVICE_NAME = "tpu.extproc.v1.Health"
+SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
+HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
+
+
+def _headers_to_dict(header_map: corepb.HeaderMap) -> dict[str, str]:
+    """Envoy populates either ``raw_value`` (bytes) or ``value`` per entry."""
+    out: dict[str, str] = {}
+    for h in header_map.headers:
+        out[h.key] = (
+            h.raw_value.decode("utf-8", "replace") if h.raw_value else h.value
+        )
+    return out
 
 
 def _to_message(req: pb.ProcessingRequest):
     which = req.WhichOneof("request")
     if which == "request_headers":
         return RequestHeaders(
-            headers={h.key: h.raw_value.decode("utf-8", "replace")
-                     for h in req.request_headers.headers.headers}
-        )
+            headers=_headers_to_dict(req.request_headers.headers))
     if which == "request_body":
         return RequestBody(body=req.request_body.body)
     if which == "response_headers":
         return ResponseHeaders(
-            headers={h.key: h.raw_value.decode("utf-8", "replace")
-                     for h in req.response_headers.headers.headers}
-        )
+            headers=_headers_to_dict(req.response_headers.headers))
     if which == "response_body":
         return ResponseBody(
             body=req.response_body.body,
             end_of_stream=req.response_body.end_of_stream,
         )
+    if which == "request_trailers":
+        return RequestTrailers(
+            headers=_headers_to_dict(req.request_trailers.trailers))
+    if which == "response_trailers":
+        return ResponseTrailers(
+            headers=_headers_to_dict(req.response_trailers.trailers))
     return None
 
 
 def _to_proto(result: ProcessingResult) -> pb.ProcessingResponse:
     if result.immediate_status is not None:
+        # server.go:100-109: shed -> ImmediateResponse{429}.  StatusCode
+        # values are the HTTP codes themselves on the wire.
         return pb.ProcessingResponse(
             immediate_response=pb.ImmediateResponse(
-                status_code=result.immediate_status,
+                status=statuspb.HttpStatus(code=result.immediate_status),
                 details="dropping request due to limited backend resources",
             )
         )
+    if result.phase == "request_trailers":
+        return pb.ProcessingResponse(request_trailers=pb.TrailersResponse())
+    if result.phase == "response_trailers":
+        return pb.ProcessingResponse(response_trailers=pb.TrailersResponse())
     common = pb.CommonResponse(clear_route_cache=result.clear_route_cache)
     for key, value in result.set_headers.items():
+        # request.go:82-97: mutations carry HeaderValueOption{Header:
+        # {Key, RawValue}}.  append_action is set explicitly: the proto
+        # default (APPEND_IF_EXISTS_OR_ADD) would make Envoy append a second
+        # Content-Length to a client request that already carries one,
+        # mis-framing the mutated body.
         common.header_mutation.set_headers.append(
-            pb.HeaderValue(key=key, raw_value=value.encode())
+            corepb.HeaderValueOption(
+                header=corepb.HeaderValue(key=key, raw_value=value.encode()),
+                append_action=(
+                    corepb.HeaderValueOption.OVERWRITE_IF_EXISTS_OR_ADD),
+            )
         )
     if result.body is not None:
         common.body_mutation.body = result.body
     if result.phase == "request_headers":
         return pb.ProcessingResponse(
-            request_headers=pb.HeadersResponse(response=common)
-        )
+            request_headers=pb.HeadersResponse(response=common))
     if result.phase == "request_body":
-        return pb.ProcessingResponse(request_body=pb.BodyResponse(response=common))
+        return pb.ProcessingResponse(
+            request_body=pb.BodyResponse(response=common))
     if result.phase == "response_headers":
         return pb.ProcessingResponse(
-            response_headers=pb.HeadersResponse(response=common)
-        )
-    return pb.ProcessingResponse(response_body=pb.BodyResponse(response=common))
+            response_headers=pb.HeadersResponse(response=common))
+    return pb.ProcessingResponse(
+        response_body=pb.BodyResponse(response=common))
 
 
 class ExtProcService:
@@ -102,22 +141,55 @@ class ExtProcService:
                 result = self._server.process(req_ctx, msg)
             except ProcessingError as e:
                 # server.go:110-112: non-shed errors terminate the stream.
-                context.abort(grpc.StatusCode.UNKNOWN, f"failed to handle request: {e}")
+                context.abort(
+                    grpc.StatusCode.UNKNOWN, f"failed to handle request: {e}")
             yield _to_proto(result)
 
 
 class HealthService:
-    """main.go:43-52: SERVING once the InferencePool has synced."""
+    """grpc.health.v1: SERVING once the InferencePool has synced
+    (main.go:43-52)."""
+
+    # Each live Watch stream pins one executor worker (sync gRPC); cap them
+    # so health watchers can never starve the Process data path out of the
+    # shared pool.  Excess watchers get the current status once and a clean
+    # stream end — spec-conforming clients re-subscribe.
+    MAX_WATCHERS = 4
 
     def __init__(self, datastore):
         self._datastore = datastore
+        self._watchers = 0
+        self._watchers_lock = threading.Lock()
 
-    def check(self, request: pb.HealthCheckRequest, context) -> pb.HealthCheckResponse:
+    def _status(self) -> int:
         if self._datastore.has_synced_pool():
-            status = pb.HealthCheckResponse.SERVING
-        else:
-            status = pb.HealthCheckResponse.NOT_SERVING
-        return pb.HealthCheckResponse(status=status)
+            return healthpb.HealthCheckResponse.SERVING
+        return healthpb.HealthCheckResponse.NOT_SERVING
+
+    def check(self, request: healthpb.HealthCheckRequest,
+              context) -> healthpb.HealthCheckResponse:
+        return healthpb.HealthCheckResponse(status=self._status())
+
+    def watch(self, request: healthpb.HealthCheckRequest, context):
+        """Stream the current status, then updates on change (1s poll)."""
+        with self._watchers_lock:
+            admit = self._watchers < self.MAX_WATCHERS
+            if admit:
+                self._watchers += 1
+        if not admit:
+            yield healthpb.HealthCheckResponse(status=self._status())
+            return
+        try:
+            last = None
+            while context.is_active():
+                status = self._status()
+                if status != last:
+                    last = status
+                    yield healthpb.HealthCheckResponse(status=status)
+                time.sleep(1.0)
+        finally:
+            with self._watchers_lock:
+                self._watchers -= 1
 
 
 def build_grpc_server(
@@ -138,7 +210,8 @@ def build_grpc_server(
                     "Process": grpc.stream_stream_rpc_method_handler(
                         ext.process,
                         request_deserializer=pb.ProcessingRequest.FromString,
-                        response_serializer=pb.ProcessingResponse.SerializeToString,
+                        response_serializer=(
+                            pb.ProcessingResponse.SerializeToString),
                     )
                 },
             ),
@@ -147,9 +220,18 @@ def build_grpc_server(
                 {
                     "Check": grpc.unary_unary_rpc_method_handler(
                         health.check,
-                        request_deserializer=pb.HealthCheckRequest.FromString,
-                        response_serializer=pb.HealthCheckResponse.SerializeToString,
-                    )
+                        request_deserializer=(
+                            healthpb.HealthCheckRequest.FromString),
+                        response_serializer=(
+                            healthpb.HealthCheckResponse.SerializeToString),
+                    ),
+                    "Watch": grpc.unary_stream_rpc_method_handler(
+                        health.watch,
+                        request_deserializer=(
+                            healthpb.HealthCheckRequest.FromString),
+                        response_serializer=(
+                            healthpb.HealthCheckResponse.SerializeToString),
+                    ),
                 },
             ),
         )
@@ -170,6 +252,6 @@ def make_process_stub(channel: grpc.Channel):
 def make_health_stub(channel: grpc.Channel):
     return channel.unary_unary(
         f"/{HEALTH_SERVICE_NAME}/Check",
-        request_serializer=pb.HealthCheckRequest.SerializeToString,
-        response_deserializer=pb.HealthCheckResponse.FromString,
+        request_serializer=healthpb.HealthCheckRequest.SerializeToString,
+        response_deserializer=healthpb.HealthCheckResponse.FromString,
     )

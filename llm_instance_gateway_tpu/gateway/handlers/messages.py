@@ -37,7 +37,26 @@ class ResponseBody:
     end_of_stream: bool = True
 
 
-ProcessingMessage = RequestHeaders | RequestBody | ResponseHeaders | ResponseBody
+@dataclass
+class RequestTrailers:
+    """Trailer phases: Envoy sends these when the processing mode asks for
+    them (or when usage rides in trailers of a streamed response).  The EPP
+    passes trailers through unmodified — the reference has no trailer
+    handling at all and would abort the stream; answering with an empty
+    TrailersResponse is the compatible upgrade."""
+
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResponseTrailers:
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+ProcessingMessage = (
+    RequestHeaders | RequestBody | ResponseHeaders | ResponseBody
+    | RequestTrailers | ResponseTrailers
+)
 
 
 @dataclass

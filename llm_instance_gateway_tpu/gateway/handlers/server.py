@@ -19,8 +19,10 @@ from llm_instance_gateway_tpu.gateway.handlers.messages import (
     ProcessingResult,
     RequestBody,
     RequestHeaders,
+    RequestTrailers,
     ResponseBody,
     ResponseHeaders,
+    ResponseTrailers,
 )
 from llm_instance_gateway_tpu.gateway.handlers.response import Usage
 from llm_instance_gateway_tpu.gateway.scheduling.scheduler import SchedulingError
@@ -83,6 +85,10 @@ class Server:
                 return response_handlers.handle_response_headers(req_ctx, msg)
             if isinstance(msg, ResponseBody):
                 return response_handlers.handle_response_body(req_ctx, msg)
+            if isinstance(msg, RequestTrailers):
+                return ProcessingResult(phase="request_trailers")
+            if isinstance(msg, ResponseTrailers):
+                return ProcessingResult(phase="response_trailers")
         except SchedulingError as e:
             if e.shed:
                 # server.go:100-109: ResourceExhausted -> 429 TooManyRequests.

@@ -18,7 +18,7 @@ import time
 import grpc
 
 from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
-from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
 from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub
 from llm_instance_gateway_tpu.gateway.testing import (
     fake_metrics,

@@ -216,7 +216,8 @@ def test_extproc_binary_serves_grpc(stack):
     import grpc
 
     sys.path.insert(0, REPO)
-    from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+    from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
+    from llm_instance_gateway_tpu.gateway.extproc import health_v1_pb2 as healthpb
     from llm_instance_gateway_tpu.gateway.extproc.service import (
         make_health_stub,
         make_process_stub,
@@ -236,13 +237,13 @@ def test_extproc_binary_serves_grpc(stack):
         status = None
         while time.monotonic() < deadline:
             try:
-                status = health(pb.HealthCheckRequest(), timeout=2).status
-                if status == pb.HealthCheckResponse.SERVING:
+                status = health(healthpb.HealthCheckRequest(), timeout=2).status
+                if status == healthpb.HealthCheckResponse.SERVING:
                     break
             except grpc.RpcError:
                 pass
             time.sleep(0.5)
-        assert status == pb.HealthCheckResponse.SERVING
+        assert status == healthpb.HealthCheckResponse.SERVING
         # Provider needs a pod-refresh cycle before the scheduler sees r1.
         stub = make_process_stub(channel)
         body = json.dumps({"model": "llama3-tiny", "prompt": "x",
@@ -257,7 +258,7 @@ def test_extproc_binary_serves_grpc(stack):
                 time.sleep(1.0)  # warm-up window: retry like the health loop
                 continue
             if resp.WhichOneof("response") == "request_body":
-                headers = {h.key: h.raw_value.decode() for h in
+                headers = {o.header.key: o.header.raw_value.decode() for o in
                            resp.request_body.response.header_mutation.set_headers}
                 if headers.get("target-pod"):
                     break

@@ -5,6 +5,10 @@ ext-proc server on a local port with fake metrics + in-memory datastore; a
 real client opens the Process stream, sends a RequestBody, and the full
 ProcessingResponse is asserted: target-pod header = address of the best pod,
 rewritten body, Content-Length.
+
+The wire protocol is Envoy's actual ``envoy.service.ext_proc.v3`` (plus
+``grpc.health.v1``): TestWireCompat pins the upstream field numbers and
+method paths so a stock Envoy / kubelet interoperates.
 """
 
 import json
@@ -12,8 +16,12 @@ import json
 import grpc
 import pytest
 
-from llm_instance_gateway_tpu.gateway.extproc import extproc_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc import envoy_base_pb2 as corepb
+from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
+from llm_instance_gateway_tpu.gateway.extproc import health_v1_pb2 as healthpb
 from llm_instance_gateway_tpu.gateway.extproc.service import (
+    HEALTH_SERVICE_NAME,
+    SERVICE_NAME,
     make_health_stub,
     make_process_stub,
 )
@@ -50,6 +58,13 @@ def ext_proc_env():
     server.stop(None)
 
 
+def mutation_headers(common: pb.CommonResponse) -> dict[str, bytes]:
+    return {
+        o.header.key: o.header.raw_value
+        for o in common.header_mutation.set_headers
+    }
+
+
 def send_body(channel, body: bytes) -> pb.ProcessingResponse:
     stub = make_process_stub(channel)
     responses = stub(
@@ -66,7 +81,7 @@ class TestHermetic:
         resp = send_body(ext_proc_env, generate_request("sql-lora"))
         assert resp.WhichOneof("response") == "request_body"
         common = resp.request_body.response
-        headers = {h.key: h.raw_value for h in common.header_mutation.set_headers}
+        headers = mutation_headers(common)
         assert headers["target-pod"] == b"192.168.1.2:8000"
         body = json.loads(common.body_mutation.body)
         assert body["model"] == "sql-lora-v1"
@@ -84,7 +99,7 @@ class TestHermetic:
         assert exc_info.value.code() == grpc.StatusCode.UNKNOWN
 
     def test_full_stream_lifecycle(self, ext_proc_env):
-        """Drive all four phases over one stream (server.go:58-120)."""
+        """Drive all six phases over one stream (server.go:58-120 + trailers)."""
         stub = make_process_stub(ext_proc_env)
         upstream_response = json.dumps(
             {"usage": {"prompt_tokens": 5, "completion_tokens": 10, "total_tokens": 15}}
@@ -92,16 +107,118 @@ class TestHermetic:
         msgs = [
             pb.ProcessingRequest(request_headers=pb.HttpHeaders()),
             pb.ProcessingRequest(request_body=pb.HttpBody(body=generate_request("sql-lora"))),
+            pb.ProcessingRequest(request_trailers=pb.HttpTrailers()),
             pb.ProcessingRequest(response_headers=pb.HttpHeaders()),
             pb.ProcessingRequest(response_body=pb.HttpBody(body=upstream_response, end_of_stream=True)),
+            pb.ProcessingRequest(response_trailers=pb.HttpTrailers()),
         ]
         phases = [r.WhichOneof("response") for r in stub(iter(msgs))]
-        assert phases == ["request_headers", "request_body", "response_headers", "response_body"]
+        assert phases == [
+            "request_headers", "request_body", "request_trailers",
+            "response_headers", "response_body", "response_trailers",
+        ]
+
+    def test_header_values_accepted_via_value_or_raw_value(self, ext_proc_env):
+        """Envoy may populate either HeaderValue.value or .raw_value."""
+        stub = make_process_stub(ext_proc_env)
+        hdrs = pb.HttpHeaders(
+            headers=corepb.HeaderMap(headers=[
+                corepb.HeaderValue(key="x-a", value="plain"),
+                corepb.HeaderValue(key="x-b", raw_value=b"raw"),
+            ])
+        )
+        resp = next(stub(iter([pb.ProcessingRequest(request_headers=hdrs)])))
+        assert resp.WhichOneof("response") == "request_headers"
+        # request.go:128-139: headers phase answers with ClearRouteCache.
+        assert resp.request_headers.response.clear_route_cache is True
 
     def test_health_serving(self, ext_proc_env):
         health = make_health_stub(ext_proc_env)
-        resp = health(pb.HealthCheckRequest())
-        assert resp.status == pb.HealthCheckResponse.SERVING
+        resp = health(healthpb.HealthCheckRequest())
+        assert resp.status == healthpb.HealthCheckResponse.SERVING
+
+
+class TestWireCompat:
+    """Pin the upstream Envoy/grpc-health wire contract.
+
+    These are the exact field numbers from
+    envoy/service/ext_proc/v3/external_processor.proto and
+    envoy/config/core/v3/base.proto — the reference EPP's entire integration
+    surface (handlers/server.go:51-121) assumes them.  A drift here means a
+    stock Envoy cannot parse our responses (or vice versa).
+    """
+
+    def test_method_paths(self):
+        assert SERVICE_NAME == "envoy.service.ext_proc.v3.ExternalProcessor"
+        assert HEALTH_SERVICE_NAME == "grpc.health.v1.Health"
+
+    def test_processing_request_field_numbers(self):
+        f = pb.ProcessingRequest.DESCRIPTOR.fields_by_name
+        assert f["request_headers"].number == 2
+        assert f["response_headers"].number == 3
+        assert f["request_body"].number == 4
+        assert f["response_body"].number == 5
+        assert f["request_trailers"].number == 6
+        assert f["response_trailers"].number == 7
+        assert f["observability_mode"].number == 10
+
+    def test_processing_response_field_numbers(self):
+        f = pb.ProcessingResponse.DESCRIPTOR.fields_by_name
+        assert f["request_headers"].number == 1
+        assert f["response_headers"].number == 2
+        assert f["request_body"].number == 3
+        assert f["response_body"].number == 4
+        assert f["request_trailers"].number == 5
+        assert f["response_trailers"].number == 6
+        assert f["immediate_response"].number == 7
+
+    def test_common_and_mutation_field_numbers(self):
+        f = pb.CommonResponse.DESCRIPTOR.fields_by_name
+        assert f["status"].number == 1
+        assert f["header_mutation"].number == 2
+        assert f["body_mutation"].number == 3
+        assert f["trailers"].number == 4
+        assert f["clear_route_cache"].number == 5
+        hm = pb.HeaderMutation.DESCRIPTOR.fields_by_name
+        assert hm["set_headers"].number == 1
+        assert hm["remove_headers"].number == 2
+        hv = corepb.HeaderValue.DESCRIPTOR.fields_by_name
+        assert hv["key"].number == 1
+        assert hv["value"].number == 2
+        assert hv["raw_value"].number == 3
+        hvo = corepb.HeaderValueOption.DESCRIPTOR.fields_by_name
+        assert hvo["header"].number == 1
+        assert hvo["append_action"].number == 3
+        im = pb.ImmediateResponse.DESCRIPTOR.fields_by_name
+        assert im["status"].number == 1
+        assert im["grpc_status"].number == 4
+        assert im["details"].number == 5
+
+    def test_http_headers_end_of_stream_is_field_3(self):
+        f = pb.HttpHeaders.DESCRIPTOR.fields_by_name
+        assert f["headers"].number == 1
+        assert f["end_of_stream"].number == 3  # 2 is reserved (attributes)
+
+    def test_packages(self):
+        assert pb.DESCRIPTOR.package == "envoy.service.ext_proc.v3"
+        assert corepb.DESCRIPTOR.package == "envoy.config.core.v3"
+        assert healthpb.DESCRIPTOR.package == "grpc.health.v1"
+        hs = healthpb.HealthCheckResponse.DESCRIPTOR
+        assert hs.fields_by_name["status"].number == 1
+        enum = hs.enum_types_by_name["ServingStatus"]
+        assert enum.values_by_name["SERVING"].number == 1
+        assert enum.values_by_name["NOT_SERVING"].number == 2
+
+    def test_unknown_fields_are_skipped(self):
+        """A full Envoy peer sends fields this subset doesn't declare
+        (metadata_context=8, attributes=9); proto3 must skip them."""
+        # field 8, wire type 2 (length-delimited), 3 payload bytes.
+        raw = pb.ProcessingRequest(
+            request_body=pb.HttpBody(body=b"x")
+        ).SerializeToString() + bytes([0x42, 0x03, 0x01, 0x02, 0x03])
+        msg = pb.ProcessingRequest.FromString(raw)
+        assert msg.WhichOneof("request") == "request_body"
+        assert msg.request_body.body == b"x"
 
 
 class TestShedding:
@@ -113,7 +230,8 @@ class TestShedding:
             channel = grpc.insecure_channel(f"localhost:{PORT + 1}")
             resp = send_body(channel, generate_request("batch"))
             assert resp.WhichOneof("response") == "immediate_response"
-            assert resp.immediate_response.status_code == 429
+            # StatusCode values are the HTTP codes on the wire.
+            assert resp.immediate_response.status.code == 429
             channel.close()
         finally:
             server.stop(None)
