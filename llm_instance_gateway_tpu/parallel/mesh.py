@@ -87,6 +87,17 @@ def initialize_distributed() -> None:
     ``TPU_GATEWAY_NUM_PROCESSES`` for bare-metal DCN clusters.
     """
     coord = os.environ.get("TPU_GATEWAY_COORDINATOR")
+    # TPU_WORKER_HOSTNAMES with a single entry is a one-host slice (some
+    # single-chip images set it to "localhost") — multi-host init there
+    # either fails or hangs waiting for peers.
+    hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    multi_host_env = bool(
+        os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")) or len(hosts) > 1
+    if jax.distributed.is_initialized():
+        return  # idempotent
+    # Genuine multi-host init failures (unreachable coordinator, peer
+    # timeout) propagate: serving on a partial world is worse than a
+    # crash-and-restart.
     if coord:
         jax.distributed.initialize(
             coordinator_address=coord,
@@ -98,8 +109,6 @@ def initialize_distributed() -> None:
             os.environ["TPU_GATEWAY_PROCESS_ID"],
             os.environ["TPU_GATEWAY_NUM_PROCESSES"], coord,
         )
-    elif os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or os.environ.get(
-        "TPU_WORKER_HOSTNAMES"
-    ):
+    elif multi_host_env:
         jax.distributed.initialize()  # GKE/TPU-pod auto-config
         logger.info("jax.distributed initialized from TPU pod environment")

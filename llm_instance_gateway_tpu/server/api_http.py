@@ -27,7 +27,11 @@ from aiohttp import web
 
 from llm_instance_gateway_tpu.server import metrics as metrics_mod
 from llm_instance_gateway_tpu.server.engine import Engine, Request, SamplingParams
-from llm_instance_gateway_tpu.server.lora_manager import AdapterError, LoRAManager
+from llm_instance_gateway_tpu.server.lora_manager import (
+    AdapterBusyError,
+    AdapterError,
+    LoRAManager,
+)
 from llm_instance_gateway_tpu.server.tokenizer import load_tokenizer
 
 logger = logging.getLogger(__name__)
@@ -340,7 +344,10 @@ class ModelServer:
         name = body.get("lora_name")
         if not name:
             return _err(400, "lora_name is required")
-        removed = self.lora.unload(name)
+        try:
+            removed = self.lora.unload(name)
+        except AdapterBusyError as e:
+            return _err(409, str(e))
         if not removed:
             return _err(404, f"adapter {name!r} not loaded")
         return web.json_response({"status": "ok", "unloaded": name})
@@ -397,6 +404,12 @@ def main(argv=None) -> None:
         help="override the JAX platform (the image's sitecustomize pins the "
              "TPU; pass cpu for hermetic runs)",
     )
+    parser.add_argument(
+        "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
+        help="serve sharded over a device mesh, e.g. 'tensor=8' on a v5e-8 "
+             "pool or 'data=2,tensor=4'; axes: data,fsdp,tensor,expert,"
+             "sequence (parallel/mesh.py). Default: single device.",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -437,7 +450,21 @@ def main(argv=None) -> None:
         params = quantize_params(params)
         logger.info("weights quantized to int8 (per-output-channel)")
 
-    lora_manager = LoRAManager(cfg, dtype=dtype)
+    mesh = None
+    if args.mesh:
+        from llm_instance_gateway_tpu.parallel.mesh import (
+            MeshConfig, initialize_distributed, make_mesh,
+        )
+
+        initialize_distributed()  # no-op single-host; DCN wiring on pods
+        axes = {}
+        for part in args.mesh.split(","):
+            k, _, v = part.partition("=")
+            axes[k.strip()] = int(v)
+        mesh = make_mesh(MeshConfig(**axes))
+        logger.info("serving sharded over mesh %s", dict(mesh.shape))
+
+    lora_manager = LoRAManager(cfg, dtype=dtype, mesh=mesh)
     engine = Engine(
         cfg, params,
         EngineConfig(
@@ -448,6 +475,7 @@ def main(argv=None) -> None:
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,
         dtype=dtype,
+        mesh=mesh,
     )
     engine.start()
     server = ModelServer(engine, tokenizer, served_name, lora_manager,

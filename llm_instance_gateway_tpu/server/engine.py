@@ -122,6 +122,7 @@ class Engine:
         eos_id: int | None = None,
         dtype=jnp.bfloat16,
         seed: int = 0,
+        mesh=None,
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
@@ -134,6 +135,19 @@ class Engine:
         self.cache = transformer.init_decode_cache(
             model_cfg, b, self.cfg.max_seq_len, dtype=dtype
         )
+        # Sharded serving (SURVEY §2.5/§7 ICI domain): pin params and the
+        # decode cache to the mesh via GSPMD specs; every jitted step then
+        # partitions from its committed inputs — XLA inserts the ICI
+        # collectives (one psum per layer on attn/MLP outputs for Megatron
+        # tensor parallelism), nothing in the loop code changes.
+        self.mesh = mesh
+        if mesh is not None:
+            from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
+
+            self.params = sharding_lib.shard_pytree(
+                self.params, sharding_lib.param_specs(model_cfg), mesh)
+            self.cache = sharding_lib.shard_pytree(
+                self.cache, sharding_lib.cache_specs(model_cfg, mesh), mesh)
         self.slots: list[_Slot | None] = [None] * b
         self._slot_tokens = np.zeros((b,), np.int32)
         self._slot_positions = np.zeros((b,), np.int32)
@@ -291,9 +305,17 @@ class Engine:
             self._bucket(len(request.prompt_tokens))
         request.t_submit = time.time()
         if request.adapter is not None and self.lora is not None:
-            # Resolve eagerly so unknown adapters fail fast (404, not mid-batch).
-            self.lora.slot_for(request.adapter)
-        self.prefill_queue.put_nowait(request)
+            # Resolve eagerly so unknown adapters fail fast (404, not
+            # mid-batch) — and PIN the slot so unload/reload can't swap the
+            # buffers out from under this request mid-generation.  Released
+            # in _finish (or right here if admission is refused).
+            self.lora.acquire(request.adapter)
+        try:
+            self.prefill_queue.put_nowait(request)
+        except queue_mod.Full:
+            if request.adapter is not None and self.lora is not None:
+                self.lora.release(request.adapter)
+            raise
         with self._lock:
             self.total_requests += 1
         with self._work:
@@ -757,7 +779,13 @@ class Engine:
         return self._is_stop(req, tok) or len(req.output_tokens) >= req.max_new_tokens
 
     def _finish(self, req: Request, reason: str) -> None:
+        if req.done.is_set():
+            return  # idempotent: a request finishes (and releases) once
         req.finish_reason = reason
         req.t_done = time.time()
+        # Release BEFORE signalling done: a caller that wakes on done and
+        # immediately unloads the adapter must not see a stale pin.
+        if req.adapter is not None and self.lora is not None:
+            self.lora.release(req.adapter)
         req.stream_event.set()
         req.done.set()

@@ -31,6 +31,16 @@ class AdapterError(Exception):
     pass
 
 
+class AdapterBusyError(AdapterError):
+    """Adapter has in-flight requests pinned to its slot (HTTP 409).
+
+    Unloading a slot that live decodes still read would silently degrade
+    those requests to base-model output — and a subsequent load() into the
+    recycled slot would hand them a *different tenant's* weights.  The
+    sidecar reconciler simply retries on its next pass once traffic drains.
+    """
+
+
 @dataclass
 class AdapterInfo:
     name: str
@@ -70,7 +80,7 @@ class LoRAManager:
     gateway's affinity filter matches against; ``max_slots`` is max_lora.
     """
 
-    def __init__(self, cfg, dtype=jnp.bfloat16):
+    def __init__(self, cfg, dtype=jnp.bfloat16, mesh=None):
         self.cfg = cfg
         self._lock = threading.Lock()
         # Serializes whole load/unload operations: the buffer update is a
@@ -79,8 +89,27 @@ class LoRAManager:
         # would silently drop the first one's weights.
         self._mutate_lock = threading.Lock()
         self._adapters: dict[str, AdapterInfo] = {}
+        self._active: dict[str, int] = {}  # name -> in-flight request count
         self._free_slots = list(range(cfg.max_lora_slots))
         self.buffers = lora_lib.init_lora_buffers(cfg, dtype=dtype)
+        # Sharded serving: pin slot buffers to the engine's mesh so the delta
+        # matmuls compose with the column-sharded base projections without
+        # resharding (parallel/sharding.py lora_specs).
+        self._mesh = mesh
+        if mesh is not None:
+            from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
+
+            self._lora_specs = sharding_lib.lora_specs(cfg)
+            self.buffers = sharding_lib.shard_pytree(
+                self.buffers, self._lora_specs, mesh)
+
+    def _pin(self, buffers):
+        """Re-pin buffers to the mesh after an eager .at[].set mutation."""
+        if self._mesh is None:
+            return buffers
+        from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
+
+        return sharding_lib.shard_pytree(buffers, self._lora_specs, self._mesh)
 
     # -- queries -----------------------------------------------------------
     def running_adapters(self) -> list[str]:
@@ -100,6 +129,33 @@ class LoRAManager:
         if info is None:
             raise AdapterError(f"adapter {adapter_name!r} is not loaded")
         return info.slot
+
+    def acquire(self, adapter_name: str | None) -> int:
+        """Resolve AND pin: the slot cannot be unloaded/recycled until the
+        matching ``release``.  The engine acquires at admission and releases
+        at finish, so live decodes never read a repurposed slot buffer."""
+        if adapter_name is None:
+            return -1
+        with self._lock:
+            info = self._adapters.get(adapter_name)
+            if info is None:
+                raise AdapterError(f"adapter {adapter_name!r} is not loaded")
+            self._active[adapter_name] = self._active.get(adapter_name, 0) + 1
+            return info.slot
+
+    def release(self, adapter_name: str | None) -> None:
+        if adapter_name is None:
+            return
+        with self._lock:
+            n = self._active.get(adapter_name, 0)
+            if n <= 1:
+                self._active.pop(adapter_name, None)
+            else:
+                self._active[adapter_name] = n - 1
+
+    def active_requests(self, adapter_name: str) -> int:
+        with self._lock:
+            return self._active.get(adapter_name, 0)
 
     # -- mutations ---------------------------------------------------------
     def load(
@@ -130,9 +186,9 @@ class LoRAManager:
                     weights, alpha, rank = load_adapter_checkpoint(checkpoint_path)
                 if weights is None:
                     raise AdapterError("either weights or checkpoint_path required")
-                self.buffers = lora_lib.load_adapter(
+                self.buffers = self._pin(lora_lib.load_adapter(
                     self.buffers, self.cfg, slot, weights, alpha, rank
-                )
+                ))
             except Exception:
                 with self._lock:
                     self._free_slots.insert(0, slot)
@@ -149,10 +205,20 @@ class LoRAManager:
     def unload(self, name: str) -> bool:
         with self._mutate_lock:
             with self._lock:
+                # Busy-check and pop atomically: a concurrent acquire() holds
+                # the same lock and increments only while the name is still
+                # registered, so no request can slip in after the check.
+                active = self._active.get(name, 0)
+                if active:
+                    raise AdapterBusyError(
+                        f"adapter {name!r} has {active} in-flight request(s); "
+                        "retry after they drain"
+                    )
                 info = self._adapters.pop(name, None)
             if info is None:
                 return False
-            self.buffers = lora_lib.unload_adapter(self.buffers, self.cfg, info.slot)
+            self.buffers = self._pin(
+                lora_lib.unload_adapter(self.buffers, self.cfg, info.slot))
             with self._lock:
                 self._free_slots.append(info.slot)
         logger.info("unloaded adapter %s from slot %d", name, info.slot)

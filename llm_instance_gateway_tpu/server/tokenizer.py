@@ -40,13 +40,22 @@ class HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
         self.vocab_size = self._tok.vocab_size
-        self.bos_id = self._tok.bos_token_id or 0
-        self.eos_id = self._tok.eos_token_id or 0
-        self.pad_id = self._tok.pad_token_id or self.eos_id
+        # Explicit None checks: `or 0` would turn a *missing* special token
+        # into token id 0 — the engine would then hard-stop any row that
+        # samples id 0 (eos) or prepend a junk token to every prompt (bos).
+        # The engine already handles eos_id=None (no device-side stop).
+        self.bos_id = self._tok.bos_token_id  # may be None: no BOS prepended
+        self.eos_id = self._tok.eos_token_id  # may be None: length-capped only
+        self.pad_id = (
+            self._tok.pad_token_id if self._tok.pad_token_id is not None
+            else (self.eos_id if self.eos_id is not None else 0)
+        )
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
         ids = self._tok.encode(text, add_special_tokens=False)
-        return [self.bos_id] + ids if add_bos else ids
+        if add_bos and self.bos_id is not None:
+            return [self.bos_id] + ids
+        return ids
 
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
