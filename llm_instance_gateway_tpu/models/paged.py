@@ -1,0 +1,254 @@
+"""Paged KV cache: a shared block pool with per-slot block tables.
+
+vLLM's PagedAttention memory model (the signal model the reference's
+KV-threshold routing was tuned against — ``backend/vllm/metrics.go:30``
+``gpu_cache_usage_perc`` = allocated blocks / total blocks), restated for
+TPU/XLA constraints:
+
+- The pool is ONE static array ``[L, n_blocks, block, Kh, hd]`` — shapes
+  never depend on allocation state, so the decode step compiles once.
+- Per-slot block tables ``[B, max_blocks_per_seq]`` map logical sequence
+  blocks to pool blocks.  Allocation/free is host-side (the engine owns a
+  free list); the device only ever sees the table contents change.
+- Physical block 0 is reserved as the TRASH block: unallocated table
+  entries and inactive rows point at it, so scatters stay in-bounds and
+  masked-out garbage has a place to land (no dynamic shapes, no dropped
+  scatter semantics to reason about).
+- The decode read gathers each row's blocks back into a contiguous
+  ``[B, S_max, Kh, hd]`` view (one XLA gather per layer) and reuses the
+  exact same masked attention as the contiguous-lane path — so lane/paged
+  parity is testable token-for-token.
+
+Contiguous lanes (``transformer.init_decode_cache``) remain the default
+fast path: they read the same bytes without the gather.  Paging buys
+admission by ACTUAL usage — a pool smaller than ``slots x max_seq`` serves
+more concurrent short sequences in the same HBM, with usage_perc telling
+the gateway the truth about remaining headroom.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from llm_instance_gateway_tpu.models import lora as lora_lib
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+from llm_instance_gateway_tpu.models.transformer import _mlp, _project
+from llm_instance_gateway_tpu.ops.attention import decode_attention
+from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm
+from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
+
+Params = dict[str, Any]
+
+TRASH_BLOCK = 0  # physical block 0: scatter target for inactive/unallocated
+
+
+def init_paged_cache(
+    cfg: ModelConfig,
+    batch: int,
+    max_len: int,
+    n_blocks: int,
+    block: int,
+    dtype=jnp.bfloat16,
+) -> Params:
+    """Block pool + tables.  ``n_blocks`` EXCLUDES the trash block."""
+    hd = cfg.resolved_head_dim
+    max_blocks_per_seq = -(-max_len // block)
+    shape = (cfg.n_layers, n_blocks + 1, block, cfg.n_kv_heads, hd)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "tables": jnp.full((batch, max_blocks_per_seq), TRASH_BLOCK, jnp.int32),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _gather_rows(pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """[n_blocks+1, P, Kh, hd] x [B, M] -> contiguous [B, M*P, Kh, hd]."""
+    g = pool[tables]  # [B, M, P, Kh, hd]
+    b, m, p = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(b, m * p, *g.shape[3:])
+
+
+def decode_step_paged(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,           # init_paged_cache layout
+    tokens: jax.Array,       # [B] int32
+    positions: jax.Array,    # [B] int32
+    lora_bufs: Params | None = None,
+    slot_ids: jax.Array | None = None,
+):
+    """One decode step over the paged pool.
+
+    Semantics identical to ``transformer.decode_step`` (parity-tested); the
+    only differences are the scatter address (table-mapped block/offset) and
+    the gather-then-attend read.
+    """
+    b = tokens.shape[0]
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    block = cache["k"].shape[2]
+    tables = cache["tables"]
+
+    h = params["embed"][tokens]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    lengths = positions + 1
+    batch_idx = jnp.arange(b)
+    # Physical write address of each row's current position.  Rows whose
+    # table entry is unallocated write the trash block.
+    phys_block = tables[batch_idx, positions // block]  # [B]
+    offset = positions % block
+
+    def layer_fn(h, xs):
+        lp, ll, k_pool, v_pool = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        hd = cfg.resolved_head_dim
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
+        k_pool = k_pool.at[phys_block, offset].set(k)
+        v_pool = v_pool.at[phys_block, offset].set(v)
+        attn = decode_attention(
+            q, _gather_rows(k_pool, tables), _gather_rows(v_pool, tables),
+            lengths,
+        )
+        h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_pool, v_pool)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = q_matmul(h, head).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "tables": tables, "length": lengths}
+    return logits, new_cache
+
+
+def insert_prefill_paged(
+    cache: Params,
+    k_prompt: jax.Array,   # [L, 1, S_bucket, Kh, hd] from prefill
+    v_prompt: jax.Array,
+    row: jax.Array | int,          # decode-slot row owning the table entries
+    phys_blocks: jax.Array,        # [ceil(S_bucket/block)] int32 — pool
+                                   # blocks for this prompt (trash-padded)
+    table_row: jax.Array,          # [max_blocks_per_seq] int32 — the row's
+                                   # FULL new table (allocated + trash tail)
+    length: jax.Array | int,
+) -> Params:
+    """Insert a prefilled prompt's KV into allocated pool blocks.
+
+    The prompt KV is reshaped to whole blocks and written with one scatter
+    per pool array; the trailing partial block carries bucket padding into
+    a real block (masked by ``length``), and any wholly-padding blocks are
+    directed at the trash block by the engine.
+    """
+    lyr, _, s, kh, hd = k_prompt.shape
+    block = cache["k"].shape[2]
+    n_b = phys_blocks.shape[0]
+    pad = n_b * block - s
+    if pad:
+        padding = [(0, 0), (0, 0), (0, pad), (0, 0), (0, 0)]
+        k_prompt = jnp.pad(k_prompt, padding)
+        v_prompt = jnp.pad(v_prompt, padding)
+    kb = k_prompt.reshape(lyr, n_b, block, kh, hd)
+    vb = v_prompt.reshape(lyr, n_b, block, kh, hd)
+    k = cache["k"].at[:, phys_blocks].set(kb.astype(cache["k"].dtype))
+    v = cache["v"].at[:, phys_blocks].set(vb.astype(cache["v"].dtype))
+    tables = cache["tables"].at[row].set(table_row)
+    length_vec = cache["length"].at[row].set(length)
+    return {"k": k, "v": v, "tables": tables, "length": length_vec}
+
+
+def prefill_with_cache_paged(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,      # [C] int32 — one chunk for ONE row
+    positions: jax.Array,   # [C] int32 — absolute positions
+    row: jax.Array,         # scalar int32 — decode-slot row
+    lane_end: jax.Array,    # scalar int32 — valid tokens after this chunk
+    last_index: jax.Array,  # scalar int32 — chunk index of last REAL token
+    lora_bufs: Params | None = None,
+    lora_slot: jax.Array | int = -1,
+):
+    """Chunked prefill against the paged pool (parity with
+    ``transformer.prefill_with_cache``): chunk K/V scatter through the row's
+    block table; chunk queries attend to the row's gathered view."""
+    c = tokens.shape[0]
+    hd = cfg.resolved_head_dim
+    block = cache["k"].shape[2]
+    tables = cache["tables"]
+    max_blocks = tables.shape[1]
+    s_max = max_blocks * block
+    slot_ids = jnp.full((1,), lora_slot, jnp.int32)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    table_row = jax.lax.dynamic_index_in_dim(tables, row, 0, keepdims=False)
+    # Final-chunk pads can run past s_max: the lane path's scatter drops
+    # them (OOB), but a clipped table lookup would alias the row's LAST
+    # real block — route them to the trash block instead.
+    in_bounds = positions < s_max
+    phys_block = jnp.where(
+        in_bounds,
+        table_row[jnp.clip(positions // block, 0, max_blocks - 1)],
+        TRASH_BLOCK,
+    )  # [C]
+    offset = positions % block
+
+    h = params["embed"][tokens][None]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+    pos2d = positions[None]
+
+    def layer_fn(h, xs):
+        lp, ll, k_pool, v_pool = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
+        k_pool = k_pool.at[phys_block, offset].set(k[0])
+        v_pool = v_pool.at[phys_block, offset].set(v[0])
+        lane_k = _gather_rows(k_pool, table_row[None])[0]  # [S_max, Kh, hd]
+        lane_v = _gather_rows(v_pool, table_row[None])[0]
+        qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
+        logits = jnp.einsum(
+            "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = jnp.arange(s_max)[None, :] <= positions[:, None]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("kgij,jkh->ikgh", probs, lane_v).reshape(1, c, -1)
+        h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_pool, v_pool)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    last_h = jax.lax.dynamic_index_in_dim(h[0], last_index, 0, keepdims=False)
+    last_logits = q_matmul(last_h, head).astype(jnp.float32)
+    length_vec = cache["length"].at[row].set(lane_end)
+    return last_logits, {"k": k_new, "v": v_new, "tables": tables,
+                         "length": length_vec}

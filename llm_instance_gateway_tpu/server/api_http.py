@@ -405,6 +405,17 @@ def main(argv=None) -> None:
              "TPU; pass cpu for hermetic runs)",
     )
     parser.add_argument(
+        "--paged-kv-block", type=int, default=None, metavar="TOKENS",
+        help="enable the paged KV cache with this block size (e.g. 64); "
+             "kv metrics then report allocated/total blocks (vLLM "
+             "gpu_cache_usage_perc semantics)",
+    )
+    parser.add_argument(
+        "--paged-kv-blocks", type=int, default=None, metavar="N",
+        help="paged pool size in blocks; below slots*ceil(max_seq/block) "
+             "oversubscribes HBM for short-sequence traffic",
+    )
+    parser.add_argument(
         "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
         help="serve sharded over a device mesh, e.g. 'tensor=8' on a v5e-8 "
              "pool or 'data=2,tensor=4'; axes: data,fsdp,tensor,expert,"
@@ -413,6 +424,8 @@ def main(argv=None) -> None:
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
+    if args.paged_kv_blocks is not None and args.paged_kv_block is None:
+        parser.error("--paged-kv-blocks requires --paged-kv-block")
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -471,6 +484,8 @@ def main(argv=None) -> None:
             decode_slots=args.decode_slots, max_seq_len=args.max_seq_len,
             decode_steps_per_sync=args.decode_steps,
             pipeline_decode=args.pipeline_decode,
+            paged_kv_block=args.paged_kv_block,
+            paged_kv_blocks=args.paged_kv_blocks,
         ),
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,

@@ -24,6 +24,7 @@ adapters and the base model (the premise of the gateway's affinity routing).
 
 from __future__ import annotations
 
+import collections
 import functools
 import logging
 import queue as queue_mod
@@ -36,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.server.sampling import sample
@@ -62,6 +64,22 @@ class EngineConfig:
     pipeline_decode: bool = False
     # Tokens/sec EMA smoothing for the exported throughput gauge.
     tps_ema_alpha: float = 0.2
+    # Prefill-ahead depth: prompts prefilled while all decode slots are busy
+    # wait here (KV held off-cache) and insert the instant a slot frees —
+    # the decode batch never idles a slot waiting for a prefill, and the
+    # queue length is the true ``tpu:decode_queue_size`` the gateway's
+    # prefill-aware scheduler routes on.  None = decode_slots.
+    decode_wait_cap: int | None = None
+    # Paged KV cache (models/paged.py): block size in tokens; None = the
+    # default contiguous-lane cache.  With paging, the kv metrics report
+    # allocated/total blocks — vLLM's gpu_cache_usage_perc semantics, which
+    # the reference's 0.8 routing threshold was tuned against — and
+    # ``paged_kv_blocks`` may be set below slots*ceil(max_seq/block) to
+    # oversubscribe HBM for short-sequence traffic (a request that outgrows
+    # an exhausted pool fails with "kv pool exhausted"; keep the gateway's
+    # KV threshold at/below 0.8 to stay clear of it).
+    paged_kv_block: int | None = None
+    paged_kv_blocks: int | None = None
 
 
 @dataclass
@@ -102,6 +120,10 @@ class _PrefillCancelled(Exception):
     """Admission aborted because the request was cancelled mid-prefill."""
 
 
+class PagedPoolExhausted(Exception):
+    """The paged KV pool has no free blocks (oversubscribed pool)."""
+
+
 @dataclass
 class _Slot:
     request: Request
@@ -110,6 +132,21 @@ class _Slot:
     # Pipelined mode: device array holding the prefill's first sampled token,
     # materialized when this slot's first decode block is processed.
     pending_first: object = None
+
+
+@dataclass
+class _WaitingPrefill:
+    """A prefilled request parked in ``decode_wait``: prompt KV held
+    off-cache until a decode slot frees (JetStream's prefill/decode
+    disaggregation inside one engine)."""
+
+    request: Request
+    first_token: object  # device scalar (sync mode materializes eagerly)
+    k: object            # [L, 1, bucket, Kh, hd]
+    v: object
+    n: int
+    lora_slot: int
+    first_token_host: int | None = None  # sync mode: already-emitted token
 
 
 class Engine:
@@ -132,9 +169,30 @@ class Engine:
         self._rng = jax.random.PRNGKey(seed)
 
         b = self.cfg.decode_slots
-        self.cache = transformer.init_decode_cache(
-            model_cfg, b, self.cfg.max_seq_len, dtype=dtype
-        )
+        self.paged = self.cfg.paged_kv_block is not None
+        if self.paged:
+            self._block = self.cfg.paged_kv_block
+            self._max_blocks_per_seq = -(-self.cfg.max_seq_len // self._block)
+            self._n_blocks = (
+                self.cfg.paged_kv_blocks
+                if self.cfg.paged_kv_blocks is not None
+                else b * self._max_blocks_per_seq
+            )
+            self.cache = paged_lib.init_paged_cache(
+                model_cfg, b, self.cfg.max_seq_len,
+                self._n_blocks, self._block, dtype=dtype,
+            )
+            # Host-side allocator: physical block 1..n are allocatable;
+            # block 0 is the trash block (paged_lib.TRASH_BLOCK).
+            self._free_blocks: list[int] = list(range(1, self._n_blocks + 1))
+            self._row_blocks: list[list[int]] = [[] for _ in range(b)]
+            self._tables_host = np.zeros(
+                (b, self._max_blocks_per_seq), np.int32)
+            self._tables_dirty = False
+        else:
+            self.cache = transformer.init_decode_cache(
+                model_cfg, b, self.cfg.max_seq_len, dtype=dtype
+            )
         # Sharded serving (SURVEY §2.5/§7 ICI domain): pin params and the
         # decode cache to the mesh via GSPMD specs; every jitted step then
         # partitions from its committed inputs — XLA inserts the ICI
@@ -162,6 +220,11 @@ class Engine:
         self.prefill_queue: queue_mod.Queue[Request] = queue_mod.Queue(
             maxsize=self.cfg.max_queue
         )
+        # Prefilled-but-unslotted requests (the engine docstring's
+        # ``decode_wait``); plus the head-of-line request pulled off the
+        # queue but not yet admissible (e.g. a chunked prompt with no lane).
+        self.decode_wait: "collections.deque[_WaitingPrefill]" = collections.deque()
+        self._pending: Request | None = None
         self._work = threading.Condition()
         self._running = False
         self._thread: threading.Thread | None = None
@@ -173,21 +236,29 @@ class Engine:
         self.decode_tps_ema = 0.0
         self.ttft_history: list[float] = []
 
+        step_fn = (paged_lib.decode_step_paged if self.paged
+                   else transformer.decode_step)
         self._jit_prefill = jax.jit(functools.partial(self._prefill_impl, model_cfg))
         self._jit_decode = jax.jit(
-            functools.partial(self._decode_impl, model_cfg),
+            functools.partial(self._decode_impl, model_cfg, step_fn),
             donate_argnames=("cache",),
             static_argnames=("n_steps",),
         )
         # Insert donates the cache too: without donation every admission would
         # copy the full multi-GB decode cache.
         self._jit_insert = jax.jit(
-            transformer.insert_prefill, donate_argnames=("cache",)
+            paged_lib.insert_prefill_paged if self.paged
+            else transformer.insert_prefill,
+            donate_argnames=("cache",),
         )
         # Chunked prefill for prompts beyond the largest bucket: one
         # chunk-sized program streams the prompt into the cache lane.
         self._jit_chunk = jax.jit(
-            functools.partial(transformer.prefill_with_cache, model_cfg),
+            functools.partial(
+                paged_lib.prefill_with_cache_paged if self.paged
+                else transformer.prefill_with_cache,
+                model_cfg,
+            ),
             donate_argnames=("cache",),
         )
         self._jit_sample_one = jax.jit(
@@ -225,7 +296,7 @@ class Engine:
 
     @staticmethod
     def _decode_impl(
-        model_cfg, params, lora_bufs, cache, tokens, positions,
+        model_cfg, step_fn, params, lora_bufs, cache, tokens, positions,
         slot_ids, temp, topk, topp, key, remaining, eos_id, n_steps: int,
     ):
         """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
@@ -241,13 +312,16 @@ class Engine:
         next_remaining, cache).  Positions are clamped below max_seq_len so
         capped slots never write out of bounds.
         """
-        max_len = cache["k"].shape[2]
+        if "tables" in cache:  # paged: logical length = table span * block
+            max_len = cache["tables"].shape[1] * cache["k"].shape[2]
+        else:
+            max_len = cache["k"].shape[2]
 
         def one_step(carry, step_key):
             cache, tokens, positions, remaining = carry
             active = remaining > 0
             safe_pos = jnp.minimum(positions, max_len - 1)
-            logits, cache = transformer.decode_step(
+            logits, cache = step_fn(
                 model_cfg, params, cache, tokens, safe_pos,
                 lora_bufs=lora_bufs, slot_ids=slot_ids,
             )
@@ -294,6 +368,14 @@ class Engine:
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
                 f"{self.cfg.max_seq_len}"
             )
+        if self.paged and self._paged_needed(
+                len(request.prompt_tokens) + 1) > self._n_blocks:
+            # Larger than the ENTIRE pool: admission could never succeed and
+            # the request would head-of-line block the engine forever.
+            raise ValueError(
+                f"prompt needs {self._paged_needed(len(request.prompt_tokens) + 1)} "
+                f"KV blocks but the pool has {self._n_blocks}"
+            )
         # Prompts beyond the largest bucket stream through chunked prefill;
         # within a bucket, validate the bucket fit here rather than mid-batch.
         if self._max_bucket() <= 0:
@@ -336,19 +418,27 @@ class Engine:
 
     def metrics_snapshot(self) -> dict:
         active = sum(1 for s in self.slots if s is not None)
-        used_tokens = sum(
-            (s.position if s is not None else 0) for s in self.slots
-        )
-        capacity = self.cfg.decode_slots * self.cfg.max_seq_len
+        if self.paged:
+            # vLLM gpu_cache_usage_perc semantics: allocated / total blocks.
+            capacity = self._n_blocks * self._block
+            used_tokens = (self._n_blocks - len(self._free_blocks)) * self._block
+        else:
+            used_tokens = sum(
+                (s.position if s is not None else 0) for s in self.slots
+            )
+            capacity = self.cfg.decode_slots * self.cfg.max_seq_len
         with self._lock:
             tps = self.decode_tps_ema
         running_adapters = self.lora.running_adapters() if self.lora else []
         max_lora = self.lora.max_slots if self.lora else 0
+        prefill_depth = self.prefill_queue.qsize() + (
+            1 if self._pending is not None else 0)
+        decode_depth = len(self.decode_wait)
         return {
-            "prefill_queue_size": self.prefill_queue.qsize(),
-            "decode_queue_size": 0,  # admission is prefill-gated; slots absorb
+            "prefill_queue_size": prefill_depth,
+            "decode_queue_size": decode_depth,  # prefilled, awaiting a slot
             "num_requests_running": active,
-            "num_requests_waiting": self.prefill_queue.qsize(),
+            "num_requests_waiting": prefill_depth + decode_depth,
             "kv_cache_usage_perc": used_tokens / capacity if capacity else 0.0,
             "kv_tokens_capacity": capacity,
             "kv_tokens_free": capacity - used_tokens,
@@ -366,6 +456,56 @@ class Engine:
             if s is None:
                 return i
         return None
+
+    def _clear_slot(self, i: int) -> None:
+        """Release a decode slot row (and, when paged, its pool blocks)."""
+        self.slots[i] = None
+        self._slot_lora[i] = -1
+        self._slot_remaining[i] = 0
+        if self.paged:
+            self._paged_free_row(i)
+
+    # -- paged-pool allocator (host side; device sees only table contents) --
+
+    def _paged_needed(self, upto_len: int) -> int:
+        return min(-(-upto_len // self._block), self._max_blocks_per_seq)
+
+    def _paged_can_admit(self, n_prompt: int) -> bool:
+        return (not self.paged
+                or self._paged_needed(n_prompt + 1) <= len(self._free_blocks))
+
+    def _paged_ensure(self, row: int, upto_len: int) -> None:
+        """Grow ``row``'s table to cover positions < upto_len.
+
+        Raises ``PagedPoolExhausted`` (leaving the row's existing blocks
+        intact — the caller decides between backpressure and failing the
+        request)."""
+        blocks = self._row_blocks[row]
+        needed = self._paged_needed(upto_len)
+        while len(blocks) < needed:
+            if not self._free_blocks:
+                raise PagedPoolExhausted(
+                    f"kv pool exhausted: {self._n_blocks} blocks of "
+                    f"{self._block} tokens all allocated"
+                )
+            blk = self._free_blocks.pop()
+            blocks.append(blk)
+            self._tables_host[row, len(blocks) - 1] = blk
+            self._tables_dirty = True
+
+    def _paged_free_row(self, row: int) -> None:
+        blocks = self._row_blocks[row]
+        if blocks:
+            self._free_blocks.extend(blocks)
+            self._row_blocks[row] = []
+            self._tables_host[row, :] = paged_lib.TRASH_BLOCK
+            self._tables_dirty = True
+
+    def _sync_tables(self) -> None:
+        """Push host-side table changes to the device copy in the cache."""
+        if self.paged and self._tables_dirty:
+            self.cache = dict(self.cache, tables=jnp.asarray(self._tables_host))
+            self._tables_dirty = False
 
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -388,17 +528,10 @@ class Engine:
 
     def _loop(self) -> None:
         while self._running:
-            did_work = False
-            # 1) Drain admissions: fill EVERY free slot before decoding.
-            # With multi-step decode a slot left empty idles for a whole
-            # K-step block; prefilling back-to-back keeps the batch full.
-            while self._free_slot_index() is not None and not self.prefill_queue.empty():
-                try:
-                    req = self.prefill_queue.get_nowait()
-                except queue_mod.Empty:
-                    break
-                self._do_prefill(req)
-                did_work = True
+            # 1) Drain admissions: fill EVERY free slot before decoding (a
+            # slot left empty idles for a whole K-step block), then prefill
+            # AHEAD into decode_wait while slots are busy.
+            did_work = self._admit_and_insert(pipelined=False)
             # 2) One fused decode block for all active slots.
             if any(s is not None for s in self.slots):
                 try:
@@ -411,6 +544,142 @@ class Engine:
                 with self._work:
                     self._work.wait(timeout=0.05)
 
+    def _admit_and_insert(self, pipelined: bool) -> bool:
+        """Admission for both loops: drain decode_wait into freed slots,
+        direct-prefill into free slots, prefill AHEAD when slots are full.
+
+        FIFO holds: decode_wait drains before the raw queue, and a direct
+        prefill only happens when nothing is parked — so it can never jump
+        an older waiting request, whether the head waits on a slot or on
+        paged-pool blocks.  Chunked prompts (beyond the largest bucket)
+        stream straight into a cache lane, so with no lane free they
+        head-of-line block as ``_pending``.
+        """
+        did = self._drain_decode_wait(pipelined)
+        cap = (self.cfg.decode_wait_cap if self.cfg.decode_wait_cap is not None
+               else self.cfg.decode_slots)
+        while True:
+            if self._pending is None:
+                try:
+                    self._pending = self.prefill_queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+            req = self._pending
+            if req.cancelled.is_set():
+                self._pending = None
+                self._finish(req, "cancelled")
+                did = True
+                continue
+            if self._free_slot_index() is not None:
+                if self.decode_wait:
+                    # The parked head couldn't take this slot (pool
+                    # backpressure): strict FIFO — don't let a newer request
+                    # steal the blocks it is waiting for.
+                    break
+                if not self._paged_can_admit(len(req.prompt_tokens)):
+                    break  # pool backpressure: wait for block frees
+                self._pending = None
+                if pipelined:
+                    self._do_prefill_pipelined(req)
+                else:
+                    self._do_prefill(req)
+                did = True
+                continue
+            if (len(req.prompt_tokens) <= self._max_bucket()
+                    and len(self.decode_wait) < cap):
+                self._pending = None
+                self._do_prefill_ahead(req, pipelined)
+                did = True
+                continue
+            break
+        return did
+
+    def _drain_decode_wait(self, pipelined: bool) -> bool:
+        did = False
+        while self.decode_wait:
+            w = self.decode_wait[0]
+            if w.request.cancelled.is_set():
+                self.decode_wait.popleft()
+                self._finish(w.request, "cancelled")
+                did = True
+                continue
+            slot_idx = self._free_slot_index()
+            if slot_idx is None:
+                break
+            if not self._paged_can_admit(w.n):
+                break  # pool backpressure: KV stays parked off-cache
+            self.decode_wait.popleft()
+            self._insert_waiting(slot_idx, w, pipelined)
+            did = True
+        return did
+
+    def _do_prefill_ahead(self, req: Request, pipelined: bool) -> None:
+        """Prefill with NO slot: prompt KV parks in decode_wait.
+
+        In sync mode the first token is emitted immediately — TTFT is
+        prefill-bound, not slot-bound, which is the point of the
+        disaggregated design.  Pipelined mode keeps the token on device
+        (async-copied) and stamps TTFT at materialization like its other
+        admissions.
+        """
+        try:
+            n = len(req.prompt_tokens)
+            lora_slot = (self.lora.slot_for(req.adapter)
+                         if self.lora is not None else -1)
+            first_token, k, v = self._bucket_prefill(req, n, lora_slot)
+            w = _WaitingPrefill(request=req, first_token=first_token,
+                                k=k, v=v, n=n, lora_slot=lora_slot)
+            if pipelined:
+                try:
+                    first_token.copy_to_host_async()
+                except AttributeError:
+                    pass
+            else:
+                tok = int(first_token)
+                w.first_token_host = tok
+                req.t_first_token = time.time()
+                req.output_tokens.append(tok)
+                req.stream_event.set()
+                with self._lock:
+                    self.total_generated += 1
+                self._record_ttft(req)
+                if self._is_finished(req, tok):
+                    self._finish(req, "stop" if self._is_stop(req, tok)
+                                 else "length")
+                    return  # done at prefill; never needed a slot
+            self.decode_wait.append(w)
+        except Exception as e:  # engine must survive a poison request
+            logger.exception("prefill-ahead failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
+    def _insert_waiting(self, slot_idx: int, w: _WaitingPrefill,
+                        pipelined: bool) -> None:
+        """Insert a parked prefill's KV into a freed cache lane."""
+        req = w.request
+        try:
+            self._insert_prompt_kv(w.k, w.v, slot_idx, w.n)
+            slot = _Slot(request=req, lora_slot=w.lora_slot, position=w.n)
+            if pipelined:
+                self._pending_budget_zero = [
+                    i for i in self._pending_budget_zero if i != slot_idx
+                ]
+                self._dev_tokens = self._dev_tokens.at[slot_idx].set(
+                    w.first_token)
+                self._dev_positions = self._dev_positions.at[slot_idx].set(w.n)
+                self._dev_remaining = self._dev_remaining.at[slot_idx].set(
+                    max(0, req.max_new_tokens - 1))
+                slot.pending_first = w.first_token
+                self._register_slot(slot_idx, slot)
+            else:
+                self._register_slot(slot_idx, slot)
+                self._slot_tokens[slot_idx] = w.first_token_host
+                self._slot_positions[slot_idx] = w.n
+        except Exception as e:
+            logger.exception("decode-wait insert failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
     def _prefill_common(self, req: Request):
         """Shared admission path: bucket (or chunked) prefill + insert.
         Returns (slot_idx, first_token_device, n, lora_slot)."""
@@ -419,25 +688,59 @@ class Engine:
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
         sp = req.sampling
         if n > self._max_bucket():
-            first_token = self._chunked_prefill(req, slot_idx, lora_slot)
+            try:
+                first_token = self._chunked_prefill(req, slot_idx, lora_slot)
+            except Exception:
+                if self.paged:  # return any blocks a failed stream-in took
+                    self._paged_free_row(slot_idx)
+                raise
             return slot_idx, first_token, n, lora_slot
+        first_token, k, v = self._bucket_prefill(req, n, lora_slot)
+        # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
+        self._insert_prompt_kv(k, v, slot_idx, n)
+        return slot_idx, first_token, n, lora_slot
+
+    def _bucket_prefill(self, req: Request, n: int, lora_slot: int):
+        """Pad a bucketable prompt and run the jitted prefill.
+        Returns (first_token device scalar, k, v)."""
+        sp = req.sampling
         bucket = self._bucket(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.zeros((1, bucket), np.int32)
         positions[0, :n] = np.arange(n)
-        first_token, k, v = self._jit_prefill(
+        return self._jit_prefill(
             self.params, self._lora_buffers(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.int32(n), jnp.int32(lora_slot),
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p), self._next_key(),
         )
-        # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
+
+    def _insert_prompt_kv(self, k, v, slot_idx: int, n: int) -> None:
+        """Write a bucketed prefill's KV into the cache (lane or paged)."""
+        if not self.paged:
+            self.cache = self._jit_insert(
+                self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
+            )
+            return
+        try:
+            self._paged_ensure(slot_idx, n)
+        except PagedPoolExhausted:
+            self._paged_free_row(slot_idx)
+            raise
+        bucket = k.shape[2]
+        nb_bucket = -(-bucket // self._block)
+        row_bl = self._row_blocks[slot_idx]
+        # Wholly-padding bucket blocks scatter into the trash block.
+        phys = row_bl + [paged_lib.TRASH_BLOCK] * (nb_bucket - len(row_bl))
+        self._sync_tables()
         self.cache = self._jit_insert(
-            self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
+            self.cache, k, v, jnp.int32(slot_idx),
+            jnp.asarray(phys, jnp.int32),
+            jnp.asarray(self._tables_host[slot_idx]),
+            jnp.int32(n),
         )
-        return slot_idx, first_token, n, lora_slot
 
     def _chunked_prefill(self, req: Request, slot_idx: int, lora_slot: int):
         """Stream a long prompt through the cache lane chunk by chunk.
@@ -463,6 +766,9 @@ class Engine:
             tokens = np.zeros((chunk,), np.int32)
             tokens[:c] = piece
             positions = start + np.arange(chunk, dtype=np.int32)
+            if self.paged:
+                self._paged_ensure(slot_idx, start + c)
+                self._sync_tables()
             last_logits, self.cache = self._jit_chunk(
                 self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -519,8 +825,36 @@ class Engine:
             req.error = str(e)
             self._finish(req, "error")
 
+    def _paged_ensure_decode(self, n_steps: int, pipelined: bool) -> None:
+        """Pre-dispatch block growth for every active row.
+
+        Pipelined mode's host position lags a block behind the device, so it
+        reserves 2*K ahead; over-reservation is returned at free.  A row the
+        exhausted pool cannot grow fails with "kv pool exhausted" (the
+        documented oversubscription tradeoff) without touching the batch.
+        """
+        if not self.paged:
+            return
+        lag = n_steps * (2 if pipelined else 1)
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            target = min(slot.position + lag + 1, self.cfg.max_seq_len)
+            try:
+                self._paged_ensure(i, target)
+            except PagedPoolExhausted as e:
+                req = slot.request
+                logger.warning("kv pool exhausted; failing %s", req.request_id)
+                req.error = str(e)
+                self._finish(req, "error")
+                self._clear_slot(i)
+                if pipelined:
+                    self._pending_budget_zero.append(i)
+        self._sync_tables()
+
     def _do_decode_step(self) -> None:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
+        self._paged_ensure_decode(n_steps, pipelined=False)
         t0 = time.perf_counter()
         step_tokens, step_valid, _, _, _, self.cache = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
@@ -541,9 +875,7 @@ class Engine:
             req = slot.request
             if req.cancelled.is_set():
                 self._finish(req, "cancelled")
-                self.slots[i] = None
-                self._slot_lora[i] = -1
-                self._slot_remaining[i] = 0
+                self._clear_slot(i)
                 continue
             finished = False
             for k in range(n_steps):
@@ -557,9 +889,7 @@ class Engine:
                 self._slot_remaining[i] = max(0, self._slot_remaining[i] - 1)
                 if self._is_finished(req, tok) or slot.position >= self.cfg.max_seq_len - 1:
                     self._finish(req, "stop" if self._is_stop(req, tok) else "length")
-                    self.slots[i] = None
-                    self._slot_lora[i] = -1
-                    self._slot_remaining[i] = 0
+                    self._clear_slot(i)
                     finished = True
                     break  # tokens past the stop condition are trimmed
             req.stream_event.set()
@@ -595,14 +925,7 @@ class Engine:
         self._pending_budget_zero: list[int] = []
         inflight: dict | None = None
         while self._running:
-            did_work = False
-            while self._free_slot_index() is not None and not self.prefill_queue.empty():
-                try:
-                    req = self.prefill_queue.get_nowait()
-                except queue_mod.Empty:
-                    break
-                self._do_prefill_pipelined(req)
-                did_work = True
+            did_work = self._admit_and_insert(pipelined=True)
             block = None
             if any(s is not None for s in self.slots):
                 try:
@@ -638,9 +961,7 @@ class Engine:
             if slot is not None:
                 slot.request.error = str(e)
                 self._finish(slot.request, "error")
-                self.slots[i] = None
-                self._slot_lora[i] = -1
-                self._slot_remaining[i] = 0
+                self._clear_slot(i)
 
     def _do_prefill_pipelined(self, req: Request) -> None:
         """Prefill + insert with NO synchronous readback: the first token is
@@ -678,6 +999,7 @@ class Engine:
 
     def _dispatch_block(self) -> dict:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
+        self._paged_ensure_decode(n_steps, pipelined=True)
         if self._pending_budget_zero:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
             self._dev_remaining = self._dev_remaining.at[idxs].set(0)
@@ -722,8 +1044,7 @@ class Engine:
             if req.cancelled.is_set():
                 self._finish(req, "cancelled")
                 if self.slots[i] is slot:
-                    self.slots[i] = None
-                    self._slot_lora[i] = -1
+                    self._clear_slot(i)
                     self._pending_budget_zero.append(i)
                 if current is not None and current["rows"][i] is slot:
                     current["rows"][i] = None
@@ -758,8 +1079,7 @@ class Engine:
                 self._finish(req, "stop" if self._is_stop(req, req.output_tokens[-1])
                              else "length")
                 if self.slots[i] is slot:
-                    self.slots[i] = None
-                    self._slot_lora[i] = -1
+                    self._clear_slot(i)
                     # Host-only stop reasons (custom ids, length cap) leave a
                     # positive device budget — zero it before the next dispatch.
                     self._pending_budget_zero.append(i)

@@ -271,6 +271,65 @@ class TestLoRAMultiplexing:
             lora.unload("cancel-adapter")
 
 
+class TestDecodeWait:
+    """Prefill/decode disaggregation: with all slots busy, new requests are
+    prefilled AHEAD into decode_wait (truthful tpu:decode_queue_size) and
+    their first token is emitted before any slot frees."""
+
+    def test_prefill_ahead_emits_first_token_and_reports_depth(self):
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        engine = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=2, max_seq_len=64,
+                         prefill_buckets=(8, 16)),
+            lora_manager=None, eos_id=None, dtype=jnp.float32,
+        )
+        engine.start()
+        try:
+            # Two slot-hogging requests + two that must wait for a slot.
+            hogs = [make_req((1 + i, 2), max_new=40) for i in range(2)]
+            waiters = [make_req((7 + i, 3), max_new=30) for i in range(2)]
+            for r in hogs + waiters:
+                engine.submit(r)
+            # The waiters' first tokens arrive while the hogs still decode.
+            deadline = time.monotonic() + 60
+            depth_seen = 0
+            while time.monotonic() < deadline:
+                snap = engine.metrics_snapshot()
+                depth_seen = max(depth_seen, snap["decode_queue_size"])
+                if all(len(w.output_tokens) >= 1 for w in waiters):
+                    break
+                time.sleep(0.01)
+            assert all(len(w.output_tokens) >= 1 for w in waiters)
+            hog_done = [len(h.output_tokens) >= h.max_new_tokens for h in hogs]
+            assert not all(hog_done)  # waiters got token #1 before slots freed
+            assert depth_seen >= 1    # the signal the scheduler routes on
+            for r in hogs + waiters:
+                assert r.done.wait(60)
+                assert r.error is None
+                assert len(r.output_tokens) == r.max_new_tokens
+        finally:
+            engine.stop()
+
+    def test_waiting_results_match_unsaturated_results(self, engine_env):
+        """A request that waited in decode_wait produces the same greedy
+        tokens as the same request run alone (batch-consistency extends to
+        the disaggregated path)."""
+        engine, _, _ = engine_env
+        want = engine.generate(make_req((9, 4, 2), max_new=6),
+                               timeout_s=60).output_tokens
+        hogs = [make_req((1 + i, 2), max_new=30) for i in range(4)]
+        probe = make_req((9, 4, 2), max_new=6)
+        for r in hogs:
+            engine.submit(r)
+        engine.submit(probe)
+        assert probe.done.wait(60)
+        for r in hogs:
+            assert r.done.wait(60)
+        assert probe.output_tokens == want
+
+
 class TestShardedEngine:
     """Serving over a GSPMD mesh (VERDICT r1 #3): params/cache/LoRA pinned to
     an 8-way tensor-parallel virtual CPU mesh; outputs must match the
