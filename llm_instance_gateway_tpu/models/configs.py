@@ -46,6 +46,14 @@ class ModelConfig:
     # MoE (Mixtral): 0 experts = dense.
     n_experts: int = 0
     n_experts_per_token: int = 2
+    # Grouped MoE dispatch (GShard-style capacity einsum) kicks in for
+    # prefill-sized token counts; expert capacity = tokens*k/E * this factor
+    # (rounded to a TPU-friendly multiple of 8).  With ``moe_exact_fallback``
+    # a batch whose routing overflows any expert's capacity recomputes via
+    # the dense all-experts path inside a lax.cond — bit-exact results
+    # always, at dense cost only for pathologically imbalanced batches.
+    moe_capacity_factor: float = 2.0
+    moe_exact_fallback: bool = True
     # LoRA serving slots (compile-time constants: resizing reshapes buffers
     # and recompiles, so they mirror vLLM's --max-loras / max rank flags).
     max_lora_slots: int = 4

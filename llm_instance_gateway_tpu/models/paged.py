@@ -120,10 +120,16 @@ def decode_step_paged(
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k_pool = k_pool.at[phys_block, offset].set(k)
         v_pool = v_pool.at[phys_block, offset].set(v)
-        attn = decode_attention(
-            q, _gather_rows(k_pool, tables), _gather_rows(v_pool, tables),
-            lengths,
-        )
+        k_rows = _gather_rows(k_pool, tables)
+        v_rows = _gather_rows(v_pool, tables)
+        if cfg.use_pallas_decode:
+            from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+                decode_attention as pallas_decode,
+            )
+
+            attn = pallas_decode(q, k_rows, v_rows, lengths)
+        else:
+            attn = decode_attention(q, k_rows, v_rows, lengths)
         h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)

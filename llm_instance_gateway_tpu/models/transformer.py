@@ -119,12 +119,29 @@ def _mlp(cfg: ModelConfig, lp: Params, x, layer_lora, slot_ids):
 def _moe_mlp(cfg: ModelConfig, lp: Params, x):
     """Top-k mixture-of-experts MLP (Mixtral style).
 
-    v0 strategy: compute every expert and mix by the (renormalized) top-k
-    gate weights.  FLOP-inflated by n_experts/k but shape-static and
-    trivially shardable over an expert axis; the dropless dispatch kernel is
-    a later ops/ optimization.  LoRA is not applied to expert weights
-    (matching vLLM, which targets attention + dense MLP only).
+    Two shape-static strategies, chosen at TRACE time by token count:
+
+    - decode-sized batches (a handful of tokens): dense all-experts mix —
+      at tiny T the dispatch bookkeeping costs more than the E/k FLOP
+      inflation saves, and weights (not FLOPs) bound decode anyway;
+    - prefill-sized batches: GShard-style grouped capacity dispatch
+      (``_moe_grouped``) — per-token FLOPs drop from E to ~k*capacity_factor
+      expert-MLPs, with a dense lax.cond fallback keeping results bit-exact
+      when routing overflows capacity.
+
+    LoRA is not applied to expert weights (matching vLLM, which targets
+    attention + dense MLP only).
     """
+    t = 1
+    for dim in x.shape[:-1]:
+        t *= dim
+    if t < 4 * cfg.n_experts:
+        return _moe_dense(cfg, lp, x)
+    return _moe_grouped(cfg, lp, x)
+
+
+def _moe_dense(cfg: ModelConfig, lp: Params, x):
+    """Compute every expert; mix by renormalized top-k gates."""
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
     e = cfg.n_experts
     topv, topi = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
@@ -138,6 +155,68 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x):
     act = swiglu(hidden, up, cfg.gelu_mlp)
     per_expert = jnp.einsum("...ef,efd->...ed", act, lp["w_down"])
     return jnp.einsum("...ed,...e->...d", per_expert, dense_gates.astype(x.dtype))
+
+
+def _moe_grouped(cfg: ModelConfig, lp: Params, x):
+    """Grouped capacity dispatch: route tokens TO experts instead of running
+    every expert over every token.
+
+    Each token's k assignments scatter-add into per-expert capacity tiles
+    ([E, C, D], O(T*k*D) data movement — NOT a [T,k,E,C] one-hot einsum,
+    whose T*k*E*C*D cost would swamp the savings); three batched einsums
+    run each expert's MLP over its C-row tile (MXU-shaped, shardable over
+    the ``expert`` mesh axis); a gather + gate-weighted sum combines
+    results.  Expert capacity C ≈ T*k/E * capacity_factor (multiple of 8):
+    expert FLOPs scale with assignments actually made, not experts*tokens —
+    the E/k inflation of the dense path is gone.  If any expert overflows
+    C, ``moe_exact_fallback`` recomputes the batch densely inside lax.cond
+    (exactness over speed for that batch).
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xf = x.reshape(-1, d)
+    t = xf.shape[0]
+    e, k = cfg.n_experts, cfg.n_experts_per_token
+
+    router_logits = (xf @ lp["router"]).astype(jnp.float32)  # [T, E]
+    topv, topi = jax.lax.top_k(router_logits, k)
+    gates = jax.nn.softmax(topv, axis=-1)  # [T, k]
+
+    cap = int(-(-t * k * cfg.moe_capacity_factor // e))
+    cap = min(t, (cap + 7) // 8 * 8)  # MXU-friendly, never beyond T
+
+    flat_expert = topi.reshape(-1)  # [T*k]
+    flat_assign = jax.nn.one_hot(flat_expert, e, dtype=jnp.int32)  # [T*k, E]
+    # Position of each assignment within its expert's capacity tile.
+    pos = jnp.sum((jnp.cumsum(flat_assign, axis=0) - 1) * flat_assign, axis=-1)
+    kept = pos < cap  # [T*k]
+    # Overflowed assignments clip onto the last tile row with a zeroed
+    # contribution — collisions there add 0, and the combine gather masks
+    # them out the same way.
+    flat_idx = flat_expert * cap + jnp.clip(pos, 0, cap - 1)  # [T*k]
+    keep_col = kept[:, None].astype(xf.dtype)
+
+    xk = jnp.repeat(xf, k, axis=0)  # [T*k, D] (token order matches topi)
+    x_e = (
+        jnp.zeros((e * cap, d), xf.dtype)
+        .at[flat_idx].add(xk * keep_col)
+        .reshape(e, cap, d)
+    )
+    hidden = jnp.einsum("ecd,edf->ecf", x_e, lp["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", x_e, lp["w_up"])
+    act = swiglu(hidden, up, cfg.gelu_mlp)
+    out_e = jnp.einsum("ecf,efd->ecd", act, lp["w_down"])
+    gathered = out_e.reshape(e * cap, d)[flat_idx] * keep_col  # [T*k, D]
+    y = jnp.sum(
+        gathered.reshape(t, k, d) * gates.astype(xf.dtype)[..., None], axis=1
+    )
+
+    if cfg.moe_exact_fallback:
+        overflow = jnp.any(~kept)
+        y = jax.lax.cond(
+            overflow, lambda op: _moe_dense(cfg, lp, op), lambda _: y, xf
+        )
+    return y.reshape(orig_shape)
 
 
 # ---------------------------------------------------------------------------

@@ -637,16 +637,8 @@ class Engine:
             else:
                 tok = int(first_token)
                 w.first_token_host = tok
-                req.t_first_token = time.time()
-                req.output_tokens.append(tok)
-                req.stream_event.set()
-                with self._lock:
-                    self.total_generated += 1
-                self._record_ttft(req)
-                if self._is_finished(req, tok):
-                    self._finish(req, "stop" if self._is_stop(req, tok)
-                                 else "length")
-                    return  # done at prefill; never needed a slot
+                if self._emit_first_token(req, tok):
+                    return  # done at prefill; never needed a slot or blocks
             self.decode_wait.append(w)
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill-ahead failed for %s", req.request_id)
@@ -686,7 +678,6 @@ class Engine:
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
-        sp = req.sampling
         if n > self._max_bucket():
             try:
                 first_token = self._chunked_prefill(req, slot_idx, lora_slot)
@@ -797,26 +788,35 @@ class Engine:
             if len(self.ttft_history) > 1000:
                 del self.ttft_history[:500]
 
+    def _emit_first_token(self, req: Request, tok: int) -> bool:
+        """Record the prefill's first sampled token (TTFT, stream, counters);
+        True if that token already finishes the request."""
+        req.t_first_token = time.time()
+        req.output_tokens.append(tok)
+        req.stream_event.set()
+        with self._lock:
+            self.total_generated += 1
+        self._record_ttft(req)
+        if self._is_finished(req, tok):
+            self._finish(req, "stop" if self._is_stop(req, tok) else "length")
+            return True
+        return False
+
     def _do_prefill(self, req: Request) -> None:
         if req.cancelled.is_set():  # died while queued: skip the prefill
             self._finish(req, "cancelled")
             return
+        slot_idx = None
+        registered = False
         try:
             slot_idx, first_token, n, lora_slot = self._prefill_common(req)
-            tok = int(first_token)
-            req.t_first_token = time.time()
-            req.output_tokens.append(tok)
-            req.stream_event.set()
-            with self._lock:
-                self.total_generated += 1
-            self._record_ttft(req)
-            if self._is_finished(req, tok):
-                self._finish(req, "stop" if self._is_stop(req, tok) else "length")
-                return
+            if self._emit_first_token(req, int(first_token)):
+                return  # finished at prefill; the finally frees its blocks
             self._register_slot(
                 slot_idx, _Slot(request=req, lora_slot=lora_slot, position=n)
             )
-            self._slot_tokens[slot_idx] = tok
+            registered = True
+            self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
             self._slot_positions[slot_idx] = n
         except _PrefillCancelled:
             self._finish(req, "cancelled")
@@ -824,6 +824,12 @@ class Engine:
             logger.exception("prefill failed for %s", req.request_id)
             req.error = str(e)
             self._finish(req, "error")
+        finally:
+            if self.paged and slot_idx is not None and not registered:
+                # Early finish or failure after blocks were allocated: a
+                # slot-less row would strand them forever (no _clear_slot
+                # will ever run for it).
+                self._paged_free_row(slot_idx)
 
     def _paged_ensure_decode(self, n_steps: int, pipelined: bool) -> None:
         """Pre-dispatch block growth for every active row.
@@ -969,6 +975,8 @@ class Engine:
         if req.cancelled.is_set():  # died while queued: skip the prefill
             self._finish(req, "cancelled")
             return
+        slot_idx = None
+        registered = False
         try:
             slot_idx, first_token, n, lora_slot = self._prefill_common(req)
             # A queued budget-zero for this lane belongs to the PREVIOUS
@@ -990,12 +998,16 @@ class Engine:
             slot = _Slot(request=req, lora_slot=lora_slot, position=n)
             slot.pending_first = first_token
             self._register_slot(slot_idx, slot)
+            registered = True
         except _PrefillCancelled:
             self._finish(req, "cancelled")
         except Exception as e:
             logger.exception("pipelined prefill failed for %s", req.request_id)
             req.error = str(e)
             self._finish(req, "error")
+        finally:
+            if self.paged and slot_idx is not None and not registered:
+                self._paged_free_row(slot_idx)  # don't strand a slot-less row
 
     def _dispatch_block(self) -> dict:
         n_steps = max(1, self.cfg.decode_steps_per_sync)

@@ -1,0 +1,111 @@
+"""Grouped MoE dispatch tests (VERDICT r1 #8: kill the E/k FLOP inflation).
+
+Contracts:
+- prefill-sized batches route through the grouped capacity dispatch and
+  match the dense all-experts path bit-for-bit (same routing, fallback on);
+- pathologically imbalanced routing (every token to one expert) overflows
+  capacity and the lax.cond fallback keeps results exact;
+- the grouped path's compiled FLOPs are measurably below dense.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import MIXTRAL_8X7B
+
+CFG = MIXTRAL_8X7B.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.PRNGKey(3), dtype=jnp.float32)
+
+
+def moe_layer_params(params):
+    """Layer-0 slice of the stacked MoE params."""
+    return {
+        key: params["layers"][key][0]
+        for key in ("router", "w_gate", "w_up", "w_down")
+    }
+
+
+class TestGroupedDispatch:
+    def test_grouped_matches_dense_balanced(self, params):
+        lp = moe_layer_params(params)
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, CFG.d_model),
+                              jnp.float32)
+        dense = transformer._moe_dense(CFG, lp, x)
+        grouped = transformer._moe_grouped(CFG, lp, x)
+        np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_overflow_falls_back_exactly(self, params):
+        """Router biased so EVERY token picks experts (0, 1): capacity
+        overflows and the cond recomputes densely — still exact."""
+        lp = dict(moe_layer_params(params))
+        bias = np.zeros((CFG.d_model, CFG.n_experts), np.float32)
+        bias[:, 0] = 0.5
+        bias[:, 1] = 0.4
+        lp["router"] = jnp.asarray(bias)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, CFG.d_model),
+                              jnp.float32)
+        dense = transformer._moe_dense(CFG, lp, x)
+        grouped = transformer._moe_grouped(CFG, lp, x)
+        np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_no_fallback_drops_overflow_tokens(self, params):
+        """With the fallback off, overflow drops assignments (documented
+        capacity semantics) — the result must differ from dense, proving the
+        cond actually gates the recompute."""
+        cfg = dataclasses.replace(CFG, moe_exact_fallback=False)
+        lp = dict(moe_layer_params(params))
+        bias = np.zeros((CFG.d_model, CFG.n_experts), np.float32)
+        bias[:, 0] = 0.5
+        bias[:, 1] = 0.4
+        lp["router"] = jnp.asarray(bias)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, CFG.d_model),
+                              jnp.float32)
+        dense = transformer._moe_dense(cfg, lp, x)
+        grouped = transformer._moe_grouped(cfg, lp, x)
+        assert not np.allclose(np.asarray(grouped), np.asarray(dense))
+
+    def test_prefill_uses_grouped_and_decode_uses_dense(self, params):
+        """End-to-end: prefill logits (grouped path, T=64) equal a prefill
+        with the grouped path effectively disabled via huge capacity."""
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(1, 250, size=(2, 32)), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(32), (2, 32)).astype(jnp.int32)
+        logits, _, _ = transformer.prefill(CFG, params, tokens, positions)
+        dense_cfg = dataclasses.replace(CFG, moe_capacity_factor=float(CFG.n_experts))
+        logits_dense, _, _ = transformer.prefill(
+            dense_cfg, params, tokens, positions)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_dense),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grouped_flops_below_dense(self, params):
+        """Compiled-cost evidence for the FLOP drop (fallback disabled so the
+        dense branch isn't counted into the grouped program)."""
+        lp = moe_layer_params(params)
+        cfg = dataclasses.replace(CFG, moe_exact_fallback=False)
+        x = jax.random.normal(jax.random.PRNGKey(0), (256, CFG.d_model),
+                              jnp.float32)
+
+        def flops(fn):
+            compiled = jax.jit(fn).lower(x).compile()
+            analysis = compiled.cost_analysis()
+            if isinstance(analysis, list):
+                analysis = analysis[0]
+            return analysis["flops"]
+
+        dense_flops = flops(lambda v: transformer._moe_dense(cfg, lp, v))
+        grouped_flops = flops(lambda v: transformer._moe_grouped(cfg, lp, v))
+        # E=8, k=2, cf=2.0: expert-MLP work drops ~2x (plus dispatch
+        # bookkeeping); require a strict win with margin.
+        assert grouped_flops < 0.75 * dense_flops, (
+            f"grouped {grouped_flops:.3g} vs dense {dense_flops:.3g}")

@@ -147,6 +147,27 @@ class TestPagedPool:
         finally:
             free_run.stop(); tight.stop()
 
+    def test_finish_at_prefill_frees_blocks(self, params):
+        """max_new_tokens=1 finishes at prefill without ever taking a slot;
+        its allocated blocks must return to the pool (a strand here
+        deadlocks later admissions on a tight pool)."""
+        tight = make_engine(params, paged=True, n_blocks=2)
+        tight.start()
+        try:
+            one = Request(prompt_tokens=[1, 2, 3], max_new_tokens=1,
+                          sampling=SamplingParams(temperature=0.0))
+            tight.generate(one, timeout_s=60)
+            assert one.error is None and len(one.output_tokens) == 1
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and tight.metrics_snapshot()["kv_cache_usage_perc"] > 0):
+                time.sleep(0.01)
+            assert tight.metrics_snapshot()["kv_cache_usage_perc"] == 0.0
+            # The pool is actually reusable.
+            assert len(gen(tight, (4, 5, 6), max_new=6)) == 6
+        finally:
+            tight.stop()
+
     def test_prompt_larger_than_pool_rejected_at_submit(self, params):
         tight = make_engine(params, paged=True, n_blocks=2)
         tight.start()
