@@ -74,6 +74,12 @@ def build_gateway(
     probe_endpoints: bool = False,
     probe_interval_s: float = 5.0,
     zone: str = "",
+    kube_watch: bool = False,
+    kube_api: str = "",
+    kube_namespace: str = "",
+    kube_service: str = "",
+    kube_token_file: str = "",
+    kube_ca_file: str = "",
 ) -> GatewayComponents:
     with open(config_path) as f:
         docs = list(yaml.safe_load_all(f))
@@ -81,6 +87,31 @@ def build_gateway(
     if not pools:
         raise ValueError(f"no InferencePool document in {config_path}")
     pool_name = pools[0].name
+
+    # Resolve the watch namespace FIRST: the reconcilers must be pinned to
+    # the namespace the informers actually watch, or every apiserver event
+    # from a non-default namespace would be silently dropped.
+    kcfg = None
+    if kube_watch:
+        from llm_instance_gateway_tpu.gateway.controllers.k8swatch import (
+            KubeConfig,
+        )
+
+        if kube_api:
+            token = ""
+            if kube_token_file:
+                with open(kube_token_file) as f:
+                    token = f.read().strip()
+            kcfg = KubeConfig(
+                base_url=kube_api, token=token,
+                ca_file=kube_ca_file or None,
+                namespace=kube_namespace or "default",
+            )
+        else:
+            kcfg = KubeConfig.in_cluster()
+            if kube_namespace:
+                kcfg.namespace = kube_namespace
+    namespace = kcfg.namespace if kcfg else "default"
 
     datastore = Datastore()
     watchers: list = []
@@ -100,11 +131,23 @@ def build_gateway(
             logger.error("rejected reloaded schedulerConfig (keeping last "
                          "good thresholds): %s", e)
 
-    pool_rec = InferencePoolReconciler(datastore, pool_name, on_update=on_pool_update)
-    model_rec = InferenceModelReconciler(datastore, pool_name)
+    pool_rec = InferencePoolReconciler(
+        datastore, pool_name, namespace=namespace, on_update=on_pool_update)
+    model_rec = InferenceModelReconciler(
+        datastore, pool_name, namespace=namespace)
+    # YAML-seeded documents adopt the watch namespace: the file is local
+    # bootstrap state, not an apiserver object — its metadata.namespace
+    # (usually "default") must not fight the reconciler pinning.
+    import dataclasses as _dc
+
     for pool in pools:
+        if pool.namespace != namespace:
+            pool = _dc.replace(pool, namespace=namespace)
         pool_rec.reconcile(pool)
-    model_rec.resync(models)
+    model_rec.resync([
+        m if m.namespace == namespace else _dc.replace(m, namespace=namespace)
+        for m in models
+    ])
     target_port = datastore.get_pool().spec.target_port_number
 
     if watch_config:
@@ -151,11 +194,28 @@ def build_gateway(
                 [Endpoint(name=ep.name, address=ep.address, ready=True,
                           zone=ep.zone) for ep in endpoints],
             )
-    elif probe_endpoints and not discover_dns:
+    elif probe_endpoints and not discover_dns and not kube_watch:
         logger.warning(
-            "--probe-endpoints set but no --pod/--discover-dns source: "
-            "membership will stay empty"
+            "--probe-endpoints set but no --pod/--discover-dns/--kube-watch "
+            "source: membership will stay empty"
         )
+
+    if kube_watch:
+        # Apiserver watches on the two CRDs + EndpointSlices — the reference
+        # manager's watch set (main.go:81-129).  The YAML config still
+        # bootstraps pool identity/thresholds; watch events take over from
+        # there.  Membership rides the aggregator like every other source so
+        # k8s + DNS/static deployments merge instead of fighting.
+        from llm_instance_gateway_tpu.gateway.controllers.k8swatch import (
+            KubeSource,
+        )
+
+        source = KubeSource(
+            kcfg, pool_rec, model_rec, aggregator.sink("k8s"),
+            service_name=kube_service,
+        )
+        source.start()
+        watchers.append(source)
 
     provider = Provider(PodMetricsClient(), datastore)
     # Thresholds come from the pool document (schedulerConfig section) —
@@ -187,6 +247,24 @@ def add_common_args(parser) -> None:
                         help="health-probe pods; only Ready ones are routable")
     parser.add_argument("--zone", default="",
                         help="only admit endpoints in this zone (empty = all)")
+    parser.add_argument("--kube-watch", action="store_true",
+                        help="watch InferencePool/InferenceModel CRDs and "
+                             "EndpointSlices from the Kubernetes apiserver")
+    parser.add_argument("--kube-api", default="",
+                        help="apiserver base URL (default: in-cluster "
+                             "service-account config)")
+    parser.add_argument("--kube-namespace", default="",
+                        help="namespace to watch (default: in-cluster or "
+                             "'default')")
+    parser.add_argument("--kube-service", default="",
+                        help="kubernetes.io/service-name label for "
+                             "EndpointSlice membership")
+    parser.add_argument("--kube-token-file", default="",
+                        help="bearer-token file for --kube-api (in-cluster "
+                             "config reads the service-account mount)")
+    parser.add_argument("--kube-ca-file", default="",
+                        help="CA bundle for --kube-api TLS verification "
+                             "(https without it logs a loud dev-only warning)")
     parser.add_argument("--refresh-metrics-interval", type=float, default=0.05)
     parser.add_argument("--refresh-pods-interval", type=float, default=10.0)
     parser.add_argument("-v", "--verbose", action="count", default=0)
@@ -204,6 +282,12 @@ def components_from_args(args) -> GatewayComponents:
         watch_config=args.watch_config,
         probe_endpoints=args.probe_endpoints,
         zone=args.zone,
+        kube_watch=args.kube_watch,
+        kube_api=args.kube_api,
+        kube_namespace=args.kube_namespace,
+        kube_service=args.kube_service,
+        kube_token_file=args.kube_token_file,
+        kube_ca_file=args.kube_ca_file,
     )
     comps.start_provider(
         pods_interval_s=args.refresh_pods_interval,
