@@ -224,8 +224,22 @@ def build_gateway(
 
     scheduler_cfg = from_pool_spec(datastore.get_pool().spec.scheduler)
     # C++ hot path when buildable, Python tree otherwise (identical
-    # semantics, fuzz-verified in tests/test_native_scheduler.py).
-    scheduler = make_scheduler(provider, scheduler_cfg)
+    # semantics, fuzz-verified in tests/test_native_scheduler.py) — wrapped
+    # by the admission controller so the pool's admissionQueue section can
+    # turn shedding into bounded queueing (hot-reloadable either way).
+    from llm_instance_gateway_tpu.gateway.scheduling.admission import (
+        AdmissionController,
+    )
+
+    scheduler = AdmissionController(
+        make_scheduler(provider, scheduler_cfg), scheduler_cfg.admission,
+        # The hysteresis drain scheduler is built lazily on first enable —
+        # the default (disabled) path pays for nothing.
+        drain_scheduler_factory=lambda cfg: make_scheduler(
+            provider, cfg if cfg is not None else scheduler_cfg),
+    )
+    scheduler.start()
+    watchers.append(scheduler)  # stop() joins the drain thread
     scheduler_holder.append(scheduler)  # arm the hot-reload hook
     handler_server = Server(scheduler, datastore)
     return GatewayComponents(

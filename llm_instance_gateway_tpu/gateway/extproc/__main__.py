@@ -29,9 +29,20 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     comps = bootstrap.components_from_args(args)
+    # Admission queueing parks requests ON their handler threads (bounded by
+    # maxDepth x maxWaitSeconds); the worker pool must cover the full parked
+    # depth on top of the active-stream workers, or parked non-critical
+    # traffic starves Critical requests at the transport.
+    workers = args.grpc_workers
+    admission = comps.scheduler.cfg.admission
+    if admission.enabled:
+        workers = args.grpc_workers + admission.max_depth
+        logger.info(
+            "admission queue enabled: gRPC workers %d -> %d "
+            "(+maxDepth)", args.grpc_workers, workers)
     server = build_grpc_server(
         comps.handler_server, comps.datastore,
-        port=args.port, max_workers=args.grpc_workers,
+        port=args.port, max_workers=workers,
     )
     server.start()
     logger.info("ext-proc gRPC server listening on :%d", args.port)

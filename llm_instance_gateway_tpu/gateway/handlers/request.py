@@ -88,6 +88,8 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
         resolved_target_model=model_name,
         critical=is_critical(model_obj),
         prompt_tokens=estimate_prompt_tokens(body),
+        criticality=(model_obj.spec.criticality.value
+                     if model_obj.spec.criticality else "Default"),
     )
 
     request_body = msg.body

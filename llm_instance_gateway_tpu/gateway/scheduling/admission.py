@@ -1,0 +1,235 @@
+"""Saturation-gated admission queueing (the reference sim's ``smart`` policy).
+
+The plain scheduler SHEDS sheddable traffic the moment no pod passes the
+thresholds (429, reference ``scheduler.go:74-90``).  The reference's best
+simulated policy instead parks those requests in per-tier queues and
+re-admits them as capacity frees, draining tighter tiers more often
+(``simulations/.../loadbalancer.py:351-426``: saturation-gated
+queueing_signal, weighted_dequeue with probability ∝ 1/target-latency).
+
+This module carries that policy into the REAL gateway:
+
+- ``TierQueues``: pure queueing policy (bounded per-tier FIFOs + weighted
+  draw across non-empty tiers) shared verbatim by the live controller and
+  the simulator, so the sim A/Bs exactly what deploys.
+- ``AdmissionController``: wraps any scheduler (Python tree or the C++ hot
+  path).  A shed becomes a bounded wait: the request parks, a drain thread
+  retries the REAL filter tree as metrics refresh, and the transport thread
+  wakes with a pod — or sheds with 429 after ``max_wait_s`` (dequeue signal
+  == "the tree admits again", the gateway equivalent of the sim's
+  saturation-cleared check).
+
+Critical traffic never queues here — the tree never sheds it — so tiers
+are Default/Sheddable, with Default drained ``tier_weights``-times more
+often.  Opt-in per pool: ``schedulerConfig.admissionQueue`` in the
+InferencePool document, hot-reloadable like the thresholds.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu.gateway.scheduling.config import AdmissionConfig
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import SchedulingError
+
+logger = logging.getLogger(__name__)
+
+
+class TierQueues:
+    """Bounded per-tier FIFOs with weighted draw — the dequeue policy."""
+
+    def __init__(self, cfg: AdmissionConfig, rng: random.Random | None = None):
+        self.cfg = cfg
+        self._rng = rng or random.Random(0)
+        self._queues: dict[str, deque] = {t: deque() for t, _ in cfg.tier_weights}
+        self._weights = dict(cfg.tier_weights)
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> dict[str, int]:
+        return {t: len(q) for t, q in self._queues.items()}
+
+    def push(self, tier: str, item) -> bool:
+        """False = full (caller sheds immediately)."""
+        if self.depth() >= self.cfg.max_depth:
+            return False
+        self._queues.setdefault(tier, deque()).append(item)
+        return True
+
+    def pop_weighted(self):
+        """Draw a non-empty tier by weight; FIFO within the tier.
+
+        Tiers without a configured weight drain at the HIGHEST configured
+        weight: the only way an unlisted tier appears is Critical traffic
+        parked during an empty-membership window (startup, rollout gap),
+        and it must never drain behind Default."""
+        candidates = [(t, q) for t, q in self._queues.items() if q]
+        if not candidates:
+            return None
+        top = max(self._weights.values(), default=1.0)
+        weights = [self._weights.get(t, top) for t, _ in candidates]
+        tier, q = self._rng.choices(candidates, weights=weights, k=1)[0]
+        return q.popleft()
+
+    def push_front(self, tier: str, item) -> None:
+        """Return a not-yet-admissible head to its tier (preserves FIFO)."""
+        self._queues.setdefault(tier, deque()).appendleft(item)
+
+
+@dataclass
+class _Waiter:
+    llm_req: object
+    tier: str
+    event: threading.Event = field(default_factory=threading.Event)
+    pod: object = None
+    expired: bool = False  # transport gave up; drain thread must skip it
+
+
+class AdmissionController:
+    """Scheduler wrapper: shed -> bounded queue wait -> re-schedule or 429."""
+
+    def __init__(self, scheduler, cfg: AdmissionConfig | None = None,
+                 rng: random.Random | None = None, drain_scheduler=None,
+                 drain_scheduler_factory=None):
+        self._scheduler = scheduler
+        # Drain re-admission runs against hysteresis-scaled thresholds
+        # (config.drain_scaled).  The dedicated drain scheduler is built
+        # LAZILY via the factory on first enable — a disabled admission
+        # queue (the default) must not pay for a second scheduler or an
+        # idle drain thread.  Passing an instance pins it eagerly; with
+        # neither, the drain reuses the admission scheduler (margin 1.0).
+        self._drain_scheduler = drain_scheduler
+        self._drain_factory = drain_scheduler_factory
+        self._cfg = cfg or AdmissionConfig()
+        self._rng = rng or random.Random(0)
+        self._lock = threading.Lock()
+        self._queues = TierQueues(self._cfg, self._rng)
+        self._work = threading.Event()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        if self._cfg.enabled:
+            self._arm()
+
+    def _arm(self) -> None:
+        """Build the drain scheduler (if a factory was given) and start the
+        drain thread.  Idempotent."""
+        from llm_instance_gateway_tpu.gateway.scheduling.config import (
+            drain_scaled,
+        )
+
+        if self._drain_scheduler is None:
+            if self._drain_factory is not None:
+                base_cfg = getattr(self._scheduler, "cfg", None)
+                self._drain_scheduler = self._drain_factory(
+                    drain_scaled(base_cfg) if base_cfg is not None else None)
+            else:
+                self._drain_scheduler = self._scheduler
+        if self._thread is None:
+            self._running = True
+            self._thread = threading.Thread(target=self._drain_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    # -- scheduler interface (drop-in for handlers/bootstrap) ---------------
+
+    @property
+    def cfg(self):
+        """The wrapped scheduler's live SchedulerConfig (drop-in surface)."""
+        return self._scheduler.cfg
+
+    def schedule(self, llm_req):
+        try:
+            return self._scheduler.schedule(llm_req)
+        except SchedulingError as e:
+            if not e.shed or not self._cfg.enabled:
+                raise
+            tier = getattr(llm_req, "criticality", "Default") or "Default"
+            waiter = _Waiter(llm_req=llm_req, tier=tier)
+            with self._lock:
+                if not self._queues.push(tier, waiter):
+                    raise SchedulingError(
+                        "admission queue full; dropping request due to "
+                        "limited backend resources", shed=True) from e
+            self._work.set()
+            if waiter.event.wait(self._cfg.max_wait_s) and waiter.pod is not None:
+                return waiter.pod
+            waiter.expired = True
+            raise SchedulingError(
+                f"no capacity within {self._cfg.max_wait_s:.0f}s admission "
+                "wait; dropping request", shed=True) from e
+
+    def update_config(self, scheduler_cfg) -> None:
+        """Hot-reload seam (pool on_update): thresholds go to the wrapped
+        scheduler; the admissionQueue section re-arms this controller."""
+        self._scheduler.update_config(scheduler_cfg)
+        admission = getattr(scheduler_cfg, "admission", None)
+        if admission is not None and admission != self._cfg:
+            with self._lock:
+                self._cfg = admission
+                old = self._queues
+                self._queues = TierQueues(admission, self._rng)
+                # Re-park waiters under the new shape (overflow sheds via
+                # their own timeouts).
+                while True:
+                    w = old.pop_weighted()
+                    if w is None:
+                        break
+                    self._queues.push(w.tier, w)
+            logger.info("admission queue config updated: %s", admission)
+        if self._cfg.enabled:
+            self._arm()  # no-op if already armed; builds drain lazily
+        if (self._drain_scheduler is not None
+                and self._drain_scheduler is not self._scheduler):
+            from llm_instance_gateway_tpu.gateway.scheduling.config import (
+                drain_scaled,
+            )
+
+            self._drain_scheduler.update_config(drain_scaled(scheduler_cfg))
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return self._queues.depths()
+
+    # -- drain loop ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Arm if enabled (kept for call-site symmetry; disabled admission
+        costs nothing until a hot reload enables it)."""
+        if self._cfg.enabled:
+            self._arm()
+
+    def stop(self) -> None:
+        self._running = False
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _drain_loop(self) -> None:
+        while self._running:
+            # Clear BEFORE inspecting the queues: a push landing after the
+            # clear re-sets the event, so its wakeup can't be lost.
+            self._work.clear()
+            with self._lock:
+                waiter = self._queues.pop_weighted()
+            if waiter is None:
+                self._work.wait(timeout=1.0)
+                continue
+            if waiter.expired:
+                continue  # transport already 429'd it
+            try:
+                pod = self._drain_scheduler.schedule(waiter.llm_req)
+            except SchedulingError:
+                # Still saturated: the head returns to its tier and the loop
+                # backs off one metrics refresh.
+                with self._lock:
+                    self._queues.push_front(waiter.tier, waiter)
+                time.sleep(self._cfg.retry_interval_s)
+                continue
+            waiter.pod = pod
+            waiter.event.set()

@@ -11,7 +11,36 @@ simulator (``sim/``) before burning TPU hours.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Saturation-gated admission queueing (scheduling.admission).
+
+    The reference sim's 'smart' policy knobs: queue instead of shedding
+    non-critical traffic, drain tighter tiers more often
+    (``simulations/.../loadbalancer.py:351-426``)."""
+
+    enabled: bool = False
+    # A parked request sheds (429) if no capacity frees within this window.
+    max_wait_s: float = 30.0
+    # Total parked requests across tiers; beyond it, shed immediately.
+    max_depth: int = 256
+    # Drain retry cadence; metrics refresh every 50ms, so retrying much
+    # faster only burns CPU on the same snapshot.
+    retry_interval_s: float = 0.05
+    # Relative drain frequency per tier (weighted_dequeue: tighter SLO tier
+    # gets proportionally more draws).
+    tier_weights: tuple[tuple[str, float], ...] = (
+        ("Default", 4.0), ("Sheddable", 1.0))
+    # Hysteresis: the DRAIN re-admits against thresholds scaled by this
+    # factor (the reference gates dequeueing on saturation having CLEARED,
+    # not merely dipped).  Parked traffic backfilling right up to the shed
+    # line would eat the headroom critical bursts rely on.  0.7 measured
+    # (sim A/B, 4 seeds, qps 40-90 overload on 4 replicas): Default-tier
+    # SLO goodput +9pp, Sheddable +8pp, Critical within noise (-0.6pp mean).
+    drain_margin: float = 0.7
 
 
 @dataclass(frozen=True)
@@ -31,6 +60,10 @@ class SchedulerConfig:
     # (prefill/decode disaggregation: scheduler must not send long prompts to a
     # replica with a deep prefill backlog even if decode is idle).
     prefill_queue_threshold: int = 8
+    # Saturation-gated admission queueing: opt-in queue-instead-of-shed for
+    # non-critical traffic, the reference sim's 'smart' policy brought to
+    # the live gateway.
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
 
 
 DEFAULT_CONFIG = SchedulerConfig()
@@ -45,6 +78,69 @@ _POOL_KEYS = {
 }
 
 
+_ADMISSION_KEYS = {
+    "enabled": ("enabled", bool),
+    "maxWaitSeconds": ("max_wait_s", float),
+    "maxDepth": ("max_depth", int),
+    "retryIntervalSeconds": ("retry_interval_s", float),
+    "tierWeights": ("tier_weights", dict),
+    "drainMargin": ("drain_margin", float),
+}
+
+
+def drain_scaled(cfg: SchedulerConfig) -> SchedulerConfig:
+    """Thresholds the admission DRAIN schedules against: the shed thresholds
+    scaled by ``drain_margin`` (hysteresis protecting critical headroom)."""
+    import dataclasses
+
+    m = cfg.admission.drain_margin
+    return dataclasses.replace(
+        cfg,
+        kv_cache_threshold=cfg.kv_cache_threshold * m,
+        queue_threshold_critical=max(1, int(cfg.queue_threshold_critical * m)),
+    )
+
+
+def _parse_admission(section) -> AdmissionConfig:
+    if not isinstance(section, dict):
+        raise ValueError(
+            f"admissionQueue must be a mapping, got {section!r}")
+    unknown = set(section) - set(_ADMISSION_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown admissionQueue keys {sorted(unknown)}; "
+            f"valid: {sorted(_ADMISSION_KEYS)}")
+    import dataclasses
+
+    kwargs = {}
+    for doc_key, (field_name, kind) in _ADMISSION_KEYS.items():
+        if doc_key not in section:
+            continue
+        raw = section[doc_key]
+        if kind is bool:
+            if not isinstance(raw, bool):
+                raise ValueError(f"{doc_key} must be true/false, got {raw!r}")
+            kwargs[field_name] = raw
+        elif kind is dict:
+            if (not isinstance(raw, dict)
+                    or not all(isinstance(v, (int, float)) and v > 0
+                               for v in raw.values())):
+                raise ValueError(
+                    f"{doc_key} must map tier name -> positive weight, "
+                    f"got {raw!r}")
+            kwargs[field_name] = tuple(
+                (str(t), float(w)) for t, w in sorted(raw.items()))
+        else:
+            try:
+                value = float(raw)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"{doc_key} must be a number, got {raw!r}") from e
+            if value <= 0:
+                raise ValueError(f"{doc_key} must be positive, got {raw!r}")
+            kwargs[field_name] = int(value) if kind is int else value
+    return dataclasses.replace(AdmissionConfig(), **kwargs)
+
+
 def from_pool_spec(overrides: dict) -> SchedulerConfig:
     """SchedulerConfig from an InferencePool's ``schedulerConfig`` section.
 
@@ -55,15 +151,17 @@ def from_pool_spec(overrides: dict) -> SchedulerConfig:
     """
     if not overrides:
         return DEFAULT_CONFIG
-    unknown = set(overrides) - set(_POOL_KEYS)
+    unknown = set(overrides) - set(_POOL_KEYS) - {"admissionQueue"}
     if unknown:
         raise ValueError(
             f"unknown schedulerConfig keys {sorted(unknown)}; "
-            f"valid: {sorted(_POOL_KEYS)}"
+            f"valid: {sorted(_POOL_KEYS) + ['admissionQueue']}"
         )
     import dataclasses
 
     kwargs = {}
+    if "admissionQueue" in overrides:
+        kwargs["admission"] = _parse_admission(overrides["admissionQueue"])
     for doc_key, field_name in _POOL_KEYS.items():
         if doc_key in overrides:
             current = getattr(DEFAULT_CONFIG, field_name)

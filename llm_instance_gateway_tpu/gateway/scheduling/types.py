@@ -21,3 +21,7 @@ class LLMRequest:
     # kv-token-headroom predicate; requests without the hint fall back to the
     # reference's percent-based signal.
     prompt_tokens: int = 0
+    # Full criticality tier ("Critical"/"Default"/"Sheddable"): the
+    # admission queue drains tiers at different weights; ``critical`` stays
+    # the filter tree's binary signal (reference types.go parity).
+    criticality: str = "Default"

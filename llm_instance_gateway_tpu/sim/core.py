@@ -82,6 +82,7 @@ class SimRequest:
     model: str
     adapter: str | None = None
     critical: bool = False
+    tier: str = "Default"  # Critical / Default / Sheddable
     slo_s_per_token: float = 0.025
     # lifecycle
     t_first_token: float = -1.0

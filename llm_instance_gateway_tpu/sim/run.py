@@ -72,6 +72,7 @@ def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
             if rng.random() < cfg.adapter_fraction
             else None
         )
+        is_critical = critical and not sheddable
         reqs.append(
             SimRequest(
                 rid=rid,
@@ -80,7 +81,9 @@ def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
                 output_tokens=max(4, int(rng.gauss(cfg.output_mean, cfg.output_std))),
                 model=adapter or "base",
                 adapter=adapter,
-                critical=critical and not sheddable,
+                critical=is_critical,
+                tier=("Critical" if is_critical
+                      else "Sheddable" if sheddable else "Default"),
                 slo_s_per_token=cfg.slo_critical_s if critical else cfg.slo_default_s,
             )
         )
@@ -96,7 +99,8 @@ class _SimProvider:
         return [s.metrics() for s in self.servers]
 
 
-def make_router(policy: str, servers: list[SimServer], seed: int = 0):
+def make_router(policy: str, servers: list[SimServer], seed: int = 0,
+                scheduler_cfg=None):
     rng = pyrandom.Random(seed)
     by_name = {s.pod.name: s for s in servers}
     if policy == "random":
@@ -106,7 +110,9 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0):
     if policy == "least_kv":
         return lambda req: min(servers, key=lambda s: -s.kv_free())
     if policy == "production":
-        scheduler = Scheduler(_SimProvider(servers), rng=pyrandom.Random(seed))
+        kwargs = {} if scheduler_cfg is None else {"cfg": scheduler_cfg}
+        scheduler = Scheduler(_SimProvider(servers),
+                              rng=pyrandom.Random(seed), **kwargs)
 
         def route(req: SimRequest):
             llm_req = LLMRequest(
@@ -134,6 +140,17 @@ class SimResult:
     slo_total: int = 0
     tokens: int = 0
 
+    # Per-tier GOODPUT: requests finishing within their SLO over ALL tier
+    # requests (shed and unfinished count as misses) — the honest number for
+    # comparing queueing vs shedding, where "served more, slower" and "served
+    # fewer, faster" must be weighed on one scale.
+    tier_hits: dict = field(default_factory=dict)
+    tier_totals: dict = field(default_factory=dict)
+
+    def goodput(self, tier: str) -> float:
+        total = self.tier_totals.get(tier, 0)
+        return self.tier_hits.get(tier, 0) / total if total else 1.0
+
     def summary(self) -> dict:
         def pct(vals, p):
             if not vals:
@@ -152,6 +169,9 @@ class SimResult:
             "latency_per_token_p50_s": round(pct(self.per_token, 0.5), 5),
             "slo_attainment": round(self.slo_hits / self.slo_total, 4)
             if self.slo_total else 1.0,
+            "slo_goodput_by_tier": {
+                t: round(self.goodput(t), 4) for t in sorted(self.tier_totals)
+            },
         }
 
 
@@ -161,39 +181,111 @@ def simulate(
     n_servers: int = 6,
     latency: LatencyModel = V5E_DEFAULT,
     decode_slots: int = 16,
+    admission: "AdmissionConfig | None" = None,
 ) -> SimResult:
+    """``policy`` may carry a ``_queued`` suffix (e.g. ``production_queued``):
+    sheds then park in the REAL TierQueues policy (gateway
+    scheduling.admission) and re-route as capacity frees — the A/B of
+    queueing vs pure shedding runs the exact code that deploys."""
+    import dataclasses
+
+    from llm_instance_gateway_tpu.gateway.scheduling.admission import TierQueues
+    from llm_instance_gateway_tpu.gateway.scheduling.config import (
+        AdmissionConfig,
+        SchedulerConfig,
+        drain_scaled,
+    )
+
+    queued = policy.endswith("_queued")
+    base_policy = policy[: -len("_queued")] if queued else policy
     servers = [
         SimServer(f"sim-{i}", latency, decode_slots=decode_slots)
         for i in range(n_servers)
     ]
     loop = EventLoop(servers)
-    router = make_router(policy, servers, seed=workload.seed)
+    router = make_router(base_policy, servers, seed=workload.seed)
     requests = generate_workload(workload)
     result = SimResult(policy=policy, qps=workload.qps)
+
+    acfg = admission or AdmissionConfig(
+        enabled=True, max_wait_s=10.0, max_depth=512, retry_interval_s=0.05)
+    tq = TierQueues(acfg, pyrandom.Random(workload.seed)) if queued else None
+    # The drain re-admits against hysteresis-scaled thresholds, exactly as
+    # the live AdmissionController does (config.drain_scaled).
+    drain_router = router
+    if queued and base_policy == "production":
+        drain_router = make_router(
+            base_policy, servers, seed=workload.seed,
+            scheduler_cfg=drain_scaled(dataclasses.replace(
+                SchedulerConfig(), admission=acfg)),
+        )
+    parked_at: dict[int, float] = {}
+
+    def shed(req: SimRequest) -> None:
+        req.shed = True
+        result.shed += 1
+
+    def queue_tier(req: SimRequest) -> str:
+        # Unweighted tiers (Critical parked during an empty-membership
+        # window) drain at the highest configured weight — TierQueues
+        # handles it; the sim must not pre-coerce or the A/B wouldn't
+        # exercise the live code path.
+        return req.tier
 
     def arrival(req: SimRequest):
         def fire(lp: EventLoop):
             try:
                 server = router(req)
             except SchedulingError:
-                req.shed = True
-                result.shed += 1
+                if tq is not None and tq.push(queue_tier(req), req):
+                    parked_at[req.rid] = lp.now
+                else:
+                    shed(req)
                 return
             server.prefill_queue.append(req)
             lp.kick(server)
 
         return fire
 
-    for req in requests:
-        loop.schedule(req.arrival_s, arrival(req))
-    # Drain: run past the workload end until queues flush.
-    loop.run(until=workload.duration_s * 3)
+    end_s = workload.duration_s * 3
+
+    def pump(lp: EventLoop):
+        """Virtual-time drain loop: weighted-dequeue parked requests while
+        the filter tree admits again (the dequeueing_signal equivalent)."""
+        while True:
+            req = tq.pop_weighted()
+            if req is None:
+                break
+            t0 = parked_at.pop(req.rid, lp.now)
+            if lp.now - t0 > acfg.max_wait_s:
+                shed(req)  # waited out its window -> 429
+                continue
+            try:
+                server = drain_router(req)
+            except SchedulingError:
+                tq.push_front(queue_tier(req), req)
+                parked_at[req.rid] = t0
+                break  # still saturated; retry next tick
+            server.prefill_queue.append(req)
+            lp.kick(server)
+        if lp.now + acfg.retry_interval_s < end_s:
+            lp.schedule(lp.now + acfg.retry_interval_s, pump)
 
     for req in requests:
-        if req.shed:
-            continue
-        if req.t_done < 0:
-            continue  # still in flight at drain cutoff
+        loop.schedule(req.arrival_s, arrival(req))
+    if tq is not None:
+        loop.schedule(acfg.retry_interval_s, pump)
+    # Drain: run past the workload end until queues flush.
+    loop.run(until=end_s)
+
+    for req in requests:
+        result.tier_totals[req.tier] = result.tier_totals.get(req.tier, 0) + 1
+        ok = (not req.shed and req.t_done >= 0
+              and req.latency_per_output_token_s <= req.slo_s_per_token)
+        if ok:
+            result.tier_hits[req.tier] = result.tier_hits.get(req.tier, 0) + 1
+        if req.shed or req.t_done < 0:
+            continue  # shed, or still in flight at drain cutoff
         result.completed += 1
         result.tokens += req.generated
         result.ttfts.append(req.ttft_s)
@@ -209,7 +301,8 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="routing-policy simulator")
     parser.add_argument("--qps", type=float, nargs="+", default=[20.0, 30.0])
     parser.add_argument("--policies", nargs="+",
-                        default=["random", "least_queue", "production"])
+                        default=["random", "least_queue", "production",
+                                 "production_queued"])
     parser.add_argument("--servers", type=int, default=6)
     parser.add_argument("--duration", type=float, default=120.0)
     parser.add_argument("--latency-model", choices=["v5e", "a100"], default="v5e")
