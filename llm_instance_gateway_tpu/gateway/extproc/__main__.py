@@ -32,7 +32,11 @@ def main(argv: list[str] | None = None) -> None:
     # Admission queueing parks requests ON their handler threads (bounded by
     # maxDepth x maxWaitSeconds); the worker pool must cover the full parked
     # depth on top of the active-stream workers, or parked non-critical
-    # traffic starves Critical requests at the transport.
+    # traffic starves Critical requests at the transport.  The controller is
+    # ALSO told the transport's park budget, so a hot-reload that enables
+    # (or deepens) the queue later can never park more waiters than the
+    # already-sized pool absorbs — half the base workers stay free for
+    # non-parked traffic no matter what the pool document says.
     workers = args.grpc_workers
     admission = comps.scheduler.cfg.admission
     if admission.enabled:
@@ -40,6 +44,7 @@ def main(argv: list[str] | None = None) -> None:
         logger.info(
             "admission queue enabled: gRPC workers %d -> %d "
             "(+maxDepth)", args.grpc_workers, workers)
+    comps.scheduler.set_park_budget(workers - max(4, args.grpc_workers // 2))
     server = build_grpc_server(
         comps.handler_server, comps.datastore,
         port=args.port, max_workers=workers,

@@ -113,8 +113,15 @@ class AdmissionController:
         self._work = threading.Event()
         self._running = False
         self._thread: threading.Thread | None = None
+        # Transport-imposed cap on parked waiters (each occupies a handler
+        # thread).  Keeps a hot-reload that enables admission from parking
+        # more waiters than the already-sized worker pool can absorb.
+        self._park_budget: int | None = None
         if self._cfg.enabled:
             self._arm()
+
+    def set_park_budget(self, budget: int | None) -> None:
+        self._park_budget = budget
 
     def _arm(self) -> None:
         """Build the drain scheduler (if a factory was given) and start the
@@ -152,7 +159,9 @@ class AdmissionController:
             tier = getattr(llm_req, "criticality", "Default") or "Default"
             waiter = _Waiter(llm_req=llm_req, tier=tier)
             with self._lock:
-                if not self._queues.push(tier, waiter):
+                over_budget = (self._park_budget is not None
+                               and self._queues.depth() >= self._park_budget)
+                if over_budget or not self._queues.push(tier, waiter):
                     raise SchedulingError(
                         "admission queue full; dropping request due to "
                         "limited backend resources", shed=True) from e

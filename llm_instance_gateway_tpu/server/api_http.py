@@ -36,6 +36,9 @@ from llm_instance_gateway_tpu.server.tokenizer import load_tokenizer
 
 logger = logging.getLogger(__name__)
 
+MAX_N = 8          # n / best_of cap (each candidate occupies engine capacity)
+MAX_LOGPROBS = 5   # engine.LOGPROB_TOPK — the OpenAI completions maximum
+
 
 class ModelServer:
     def __init__(self, engine: Engine, tokenizer, model_name: str,
@@ -80,7 +83,8 @@ class ModelServer:
             prompt = " ".join(str(p) for p in prompt)
         return self.tokenizer.encode(str(prompt))
 
-    def _make_request(self, body: dict, prompt_tokens: list[int], adapter) -> Request:
+    def _make_request(self, body: dict, prompt_tokens: list[int], adapter,
+                      logprobs: int | None = None) -> Request:
         return Request(
             prompt_tokens=prompt_tokens,
             max_new_tokens=int(body.get("max_tokens", 64)),
@@ -90,21 +94,168 @@ class ModelServer:
                 top_p=float(body.get("top_p", 1.0)),
             ),
             adapter=adapter,
+            logprobs=logprobs,
         )
 
-    async def _run(self, req: Request) -> Request:
+    @staticmethod
+    def _parse_choice_params(body: dict) -> tuple[int, int, int | None, list[str]]:
+        """(n, best_of, logprobs, stops) with OpenAI validation rules."""
+        n = int(body.get("n", 1))
+        best_of = int(body.get("best_of", max(n, 1)))
+        if not 1 <= n <= MAX_N:
+            raise ValueError(f"n must be in [1, {MAX_N}]")
+        if not n <= best_of <= MAX_N:
+            raise ValueError(f"best_of must be in [n, {MAX_N}]")
+        logprobs = body.get("logprobs")
+        if logprobs is not None:
+            logprobs = int(logprobs)
+            if not 0 <= logprobs <= MAX_LOGPROBS:
+                raise ValueError(f"logprobs must be in [0, {MAX_LOGPROBS}]")
+        stop = body.get("stop")
+        if stop is None:
+            stops: list[str] = []
+        elif isinstance(stop, str):
+            stops = [stop]
+        elif (isinstance(stop, list)
+              and all(isinstance(s, str) for s in stop)):
+            stops = list(stop)
+        else:
+            raise ValueError("stop must be a string or a list of strings")
+        if len(stops) > 4:
+            raise ValueError("at most 4 stop sequences are supported")
+        return n, best_of, logprobs, [s for s in stops if s]
+
+    def _wait_with_stops(self, req: Request, stops: list[str],
+                         timeout_s: float = 600.0) -> Request:
+        """generate(), plus early cancellation the moment a stop string
+        appears in the decoded text (the exact cut happens afterwards in
+        _truncate_at_stop — generation must not keep burning the slot).
+
+        Decoding is incremental (only unconsumed tokens) and the stop search
+        only rescans a window the new piece could have completed, so a long
+        generation stays O(n), not O(n^2), on the executor thread."""
+        self.engine.submit(req)
+        deadline = time.monotonic() + timeout_s
+        max_stop = max((len(s) for s in stops), default=0)
+        text = ""
+        consumed = 0
+        while True:
+            req.stream_event.wait(0.25)
+            req.stream_event.clear()
+            done = req.done.is_set()
+            n = len(req.output_tokens)
+            if stops and n > consumed:
+                piece = self.tokenizer.decode(req.output_tokens[consumed:n])
+                if piece.endswith("�") and not done:
+                    pass  # incomplete UTF-8 tail: re-decode next wake
+                else:
+                    window_start = max(0, len(text) - max_stop + 1)
+                    text += piece
+                    consumed = n
+                    if any(s in text[window_start:] for s in stops):
+                        req.cancelled.set()
+                        req.done.wait(30)
+                        return req
+            if done:
+                return req
+            if time.monotonic() > deadline:
+                req.error = "generation timed out"
+                req.cancelled.set()
+                return req
+
+    def _truncate_at_stop(self, req: Request, stops: list[str]) -> tuple[str, bool]:
+        """Cut text AND the per-token records at the earliest stop match.
+        Returns (final text, whether a stop hit)."""
+        full = self.tokenizer.decode(req.output_tokens)
+        if not stops:
+            return full, False
+        hits = [(full.index(s), s) for s in stops if s in full]
+        if not hits:
+            return full, False
+        idx, _ = min(hits)
+        # Smallest token count whose decoded prefix already contains a stop
+        # ("contains a stop" is monotone in the prefix length, so binary
+        # search): everything from that token on is post-stop and dropped.
+        lo, hi = 1, len(req.output_tokens)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if any(s in self.tokenizer.decode(req.output_tokens[:mid])
+                   for s in stops):
+                hi = mid
+            else:
+                lo = mid + 1
+        keep = lo
+        del req.output_tokens[keep:]
+        del req.output_logprobs[keep:]
+        del req.output_top_logprobs[keep:]
+        req.finish_reason = "stop"
+        return full[:idx], True
+
+    def _logprobs_json(self, req: Request, k: int) -> dict:
+        """OpenAI completions ``logprobs`` object (tokens / token_logprobs /
+        top_logprobs / text_offset)."""
+        tokens, token_lps, tops, offsets = [], [], [], []
+        prev = ""
+        for i in range(len(req.output_tokens)):
+            cur = self.tokenizer.decode(req.output_tokens[: i + 1])
+            offsets.append(len(prev))
+            tokens.append(cur[len(prev):])
+            prev = cur
+            lp = (req.output_logprobs[i]
+                  if i < len(req.output_logprobs) else None)
+            token_lps.append(None if lp is None else max(lp, -1e9))
+            if k > 0 and i < len(req.output_top_logprobs):
+                # Distinct token ids can decode to the same surface string
+                # (byte-fallback, special tokens): keep the most probable
+                # id's value for a collided key rather than last-write-wins.
+                entry: dict[str, float] = {}
+                for tok, v in req.output_top_logprobs[i].items():
+                    key = self.tokenizer.decode([tok])
+                    v = max(v, -1e9)
+                    if key not in entry or v > entry[key]:
+                        entry[key] = v
+                tops.append(entry)
+        return {
+            "tokens": tokens,
+            "token_logprobs": token_lps,
+            "top_logprobs": tops if k > 0 else None,
+            "text_offset": offsets,
+        }
+
+    async def _run(self, req: Request, stops: list[str] | None = None) -> Request:
         loop = asyncio.get_running_loop()
         try:
+            if stops:
+                return await loop.run_in_executor(
+                    None, self._wait_with_stops, req, stops)
             return await loop.run_in_executor(None, self.engine.generate, req)
         except asyncio.CancelledError:
             # Non-streaming client disconnected: free the slot too.
             req.cancelled.set()
             raise
 
+    async def _run_many(self, reqs: list[Request],
+                        stops: list[str]) -> list[Request]:
+        """Run candidates concurrently; if ANY submit/run fails, cancel the
+        siblings (gather alone would leave them decoding for nobody — load
+        amplification exactly when capacity is scarce) and re-raise the
+        first failure."""
+        results = await asyncio.gather(
+            *(self._run(r, stops=stops) for r in reqs),
+            return_exceptions=True)
+        failure = next(
+            (r for r in results if isinstance(r, BaseException)), None)
+        if failure is not None:
+            for r in reqs:
+                r.cancelled.set()
+            raise failure
+        return list(results)
+
     # -- streaming ---------------------------------------------------------
     async def _stream_sse(self, http_request: web.Request, req, model: str,
                           object_name: str, make_delta,
-                          timeout_s: float = 600.0):
+                          timeout_s: float = 600.0,
+                          stops: list[str] | None = None):
         """Server-sent-events generation stream (OpenAI stream=true shape).
 
         Tokens appear in ``req.output_tokens`` as the engine decodes (in
@@ -142,6 +293,11 @@ class ModelServer:
             async def emit(payload: dict) -> None:
                 await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
+            if stops:
+                return await self._stream_sse_loop_stops(
+                    req, model, object_name, make_delta, resp, loop,
+                    deadline, emit, stops,
+                )
             return await self._stream_sse_loop(
                 req, model, object_name, make_delta, resp, loop, consumed,
                 deadline, emit,
@@ -198,6 +354,78 @@ class ModelServer:
                 await resp.write(b"data: [DONE]\n\n")
                 return resp
 
+    async def _stream_sse_loop_stops(self, req, model, object_name,
+                                     make_delta, resp, loop, deadline, emit,
+                                     stops):
+        """Character-based streaming with stop-sequence scanning.
+
+        Emitted text always lags the decoded text by ``holdback`` characters
+        (longest stop minus one) while generating, so no prefix of a stop
+        sequence ever reaches the client before the match is decided."""
+        holdback = max(len(s) for s in stops) - 1
+        max_stop = holdback + 1
+        emitted = 0
+        consumed = 0  # tokens folded into ``text`` so far
+        text = ""
+        hits: list[tuple[int, str]] = []
+
+        async def send(delta: str, fin: str | None, usage: bool = False):
+            payload = {
+                "id": f"cmpl-{req.request_id}",
+                "object": object_name,
+                "model": model,
+                "choices": [make_delta(delta, fin)],
+            }
+            if usage:
+                payload["usage"] = {
+                    "prompt_tokens": len(req.prompt_tokens),
+                    "completion_tokens": len(req.output_tokens),
+                    "total_tokens": (len(req.prompt_tokens)
+                                     + len(req.output_tokens)),
+                }
+            await emit(payload)
+
+        while True:
+            await loop.run_in_executor(None, req.stream_event.wait, 0.25)
+            req.stream_event.clear()
+            done = req.done.is_set()  # read BEFORE decoding
+            n = len(req.output_tokens)
+            if n > consumed:
+                # Incremental decode + windowed search: O(total) over the
+                # generation, not O(n^2).
+                piece = self.tokenizer.decode(req.output_tokens[consumed:n])
+                if piece.endswith("�") and not done:
+                    pass  # incomplete UTF-8 tail: re-decode next wake
+                else:
+                    window = max(0, len(text) - max_stop + 1)
+                    text += piece
+                    consumed = n
+                    hits = [(text.index(s, window), s)
+                            for s in stops if s in text[window:]]
+            if hits:
+                idx, _ = min(hits)
+                req.cancelled.set()  # free the slot; text is final
+                await loop.run_in_executor(None, req.done.wait, 30)
+                self._truncate_at_stop(req, stops)  # usage matches the cut
+                if idx > emitted:
+                    await send(text[emitted:idx], None)
+                await send("", "stop", usage=True)
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+            limit = len(text) if done else max(emitted, len(text) - holdback)
+            if limit > emitted:
+                await send(text[emitted:limit], None)
+                emitted = limit
+            if done:
+                await send("", req.finish_reason or "stop", usage=True)
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+            if time.monotonic() > deadline:
+                req.cancelled.set()
+                await emit({"error": {"message": "generation timed out"}})
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+
     # -- inference ---------------------------------------------------------
     async def handle_completions(self, request: web.Request) -> web.Response:
         try:
@@ -208,41 +436,81 @@ class ModelServer:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
             return _err(404, str(e))
+        try:
+            n, best_of, logprobs, stops = self._parse_choice_params(body)
+        except (ValueError, TypeError) as e:
+            return _err(400, str(e))
         prompt_tokens = self._encode_prompt(body)
-        req = self._make_request(body, prompt_tokens, adapter)
         if body.get("stream"):
+            if n > 1 or best_of > 1:
+                return _err(400, "streaming supports n=1 / best_of=1")
+            if logprobs is not None:
+                # Explicit rejection beats a silently-null field: chunks
+                # carry no logprobs object.
+                return _err(400, "logprobs is not supported with streaming")
+            req = self._make_request(body, prompt_tokens, adapter)
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
                 "text_completion",
                 lambda delta, fin: {"index": 0, "text": delta, "finish_reason": fin},
+                stops=stops,
             )
+        # best_of candidates decode concurrently (the engine batches them);
+        # ranking needs per-token logprobs, so candidates record at least the
+        # sampled-token values even when the client didn't ask.
+        record = logprobs if logprobs is not None else (
+            0 if best_of > n else None)
+        reqs = [
+            self._make_request(body, list(prompt_tokens), adapter,
+                               logprobs=record)
+            for _ in range(best_of)
+        ]
         try:
-            req = await self._run(req)
+            reqs = await self._run_many(reqs, stops)
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
             # Backpressure the gateway cleanly; its scheduler already sees the
             # queue depth via /metrics and will shed/redirect.
             return _err(429, "prefill queue is full")
-        if req.error:
-            return _err(500, req.error)
-        text = self.tokenizer.decode(req.output_tokens)
+        for r in reqs:
+            if r.error:
+                return _err(500, r.error)
+        texts = {id(r): self._truncate_at_stop(r, stops)[0] for r in reqs}
+        # OpenAI usage semantics: completion_tokens counts ALL generated
+        # candidates, including best_of ones not returned.
+        completion_tokens = sum(len(r.output_tokens) for r in reqs)
+        if best_of > n:
+            # OpenAI best_of: keep the n candidates with the highest mean
+            # token logprob.
+            def mean_lp(r: Request) -> float:
+                return (sum(r.output_logprobs) / len(r.output_logprobs)
+                        if r.output_logprobs else float("-inf"))
+
+            reqs.sort(key=mean_lp, reverse=True)
+            reqs = reqs[:n]
+        choices = []
+        for i, r in enumerate(reqs):
+            choice = {
+                "index": i,
+                "text": texts[id(r)],
+                "finish_reason": r.finish_reason,
+            }
+            if logprobs is not None:
+                choice["logprobs"] = self._logprobs_json(r, logprobs)
+            choices.append(choice)
         return web.json_response({
-            "id": f"cmpl-{req.request_id}",
+            "id": f"cmpl-{reqs[0].request_id}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": body.get("model", self.model_name),
-            "choices": [{
-                "index": 0,
-                "text": text,
-                "finish_reason": req.finish_reason,
-            }],
+            "choices": choices,
             "usage": {
-                "prompt_tokens": len(req.prompt_tokens),
-                "completion_tokens": len(req.output_tokens),
-                "total_tokens": len(req.prompt_tokens) + len(req.output_tokens),
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt_tokens) + completion_tokens,
             },
-            "ttft_ms": round(req.ttft_s * 1000, 2),
+            "ttft_ms": round(reqs[0].ttft_s * 1000, 2),
         })
 
     async def handle_chat(self, request: web.Request) -> web.Response:
@@ -258,8 +526,15 @@ class ModelServer:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
             return _err(404, str(e))
-        req = self._make_request(body, self.tokenizer.encode(prompt), adapter)
+        try:
+            n, best_of, _, stops = self._parse_choice_params(body)
+        except (ValueError, TypeError) as e:
+            return _err(400, str(e))
+        prompt_tokens = self.tokenizer.encode(prompt)
         if body.get("stream"):
+            if n > 1 or best_of > 1:
+                return _err(400, "streaming supports n=1 / best_of=1")
+            req = self._make_request(body, prompt_tokens, adapter)
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
                 "chat.completion.chunk",
@@ -268,30 +543,38 @@ class ModelServer:
                     "delta": ({"content": delta} if delta else {}),
                     "finish_reason": fin,
                 },
+                stops=stops,
             )
+        reqs = [self._make_request(body, list(prompt_tokens), adapter)
+                for _ in range(n)]
         try:
-            req = await self._run(req)
+            reqs = await self._run_many(reqs, stops)
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
             return _err(429, "prefill queue is full")
-        if req.error:
-            return _err(500, req.error)
+        for r in reqs:
+            if r.error:
+                return _err(500, r.error)
+        choices = []
+        for i, r in enumerate(reqs):
+            text, _ = self._truncate_at_stop(r, stops)
+            choices.append({
+                "index": i,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": r.finish_reason,
+            })
+        completion_tokens = sum(len(r.output_tokens) for r in reqs)
         return web.json_response({
-            "id": f"chatcmpl-{req.request_id}",
+            "id": f"chatcmpl-{reqs[0].request_id}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": body.get("model", self.model_name),
-            "choices": [{
-                "index": 0,
-                "message": {"role": "assistant",
-                            "content": self.tokenizer.decode(req.output_tokens)},
-                "finish_reason": req.finish_reason,
-            }],
+            "choices": choices,
             "usage": {
-                "prompt_tokens": len(req.prompt_tokens),
-                "completion_tokens": len(req.output_tokens),
-                "total_tokens": len(req.prompt_tokens) + len(req.output_tokens),
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt_tokens) + completion_tokens,
             },
         })
 

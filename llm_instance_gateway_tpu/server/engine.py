@@ -44,6 +44,27 @@ from llm_instance_gateway_tpu.server.sampling import sample
 
 logger = logging.getLogger(__name__)
 
+# Top-K alternatives computed device-side per step (the OpenAI completions
+# API maximum).  Always computed — one compiled program for the whole batch,
+# and a [B, K] top_k is noise next to the layer matmuls; the host stores
+# values only for requests that asked.
+LOGPROB_TOPK = 5
+
+
+def _logprob_info(logits, sampled, valid_vocab: int):
+    """(sampled-token logprob, top-K logprobs, top-K ids) from raw logits.
+
+    Model logprobs (pre-temperature), padded-vocab positions masked out —
+    consistent with what the sampler can actually emit.
+    """
+    masked = jnp.where(
+        jnp.arange(logits.shape[-1]) < valid_vocab, logits, -jnp.inf
+    )
+    logp = jax.nn.log_softmax(masked, axis=-1)
+    sampled_lp = jnp.take_along_axis(logp, sampled[..., None], axis=-1)[..., 0]
+    top_v, top_i = jax.lax.top_k(logp, LOGPROB_TOPK)
+    return sampled_lp, top_v, top_i
+
 
 @dataclass
 class EngineConfig:
@@ -97,8 +118,13 @@ class Request:
     adapter: str | None = None
     stop_token_ids: tuple[int, ...] = ()
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    # Record per-token logprobs: None = off; 0 = sampled token only (e.g.
+    # best_of ranking); 1..LOGPROB_TOPK = also that many top alternatives.
+    logprobs: int | None = None
     # Lifecycle (filled by the engine).
     output_tokens: list[int] = field(default_factory=list)
+    output_logprobs: list[float] = field(default_factory=list)
+    output_top_logprobs: list[dict[int, float]] = field(default_factory=list)
     finish_reason: str | None = None
     error: str | None = None
     t_submit: float = 0.0
@@ -147,6 +173,8 @@ class _WaitingPrefill:
     n: int
     lora_slot: int
     first_token_host: int | None = None  # sync mode: already-emitted token
+    # First-token (lp, top_v, top_i) device tuple; None once recorded.
+    lp_info: object = None
 
 
 class Engine:
@@ -261,13 +289,17 @@ class Engine:
             ),
             donate_argnames=("cache",),
         )
-        self._jit_sample_one = jax.jit(
-            lambda logits, key, t, k, p: sample(
+        def _sample_one(logits, key, t, k, p):
+            tok = sample(
                 logits[None], key, jnp.full((1,), t, jnp.float32),
                 jnp.full((1,), k, jnp.int32), jnp.full((1,), p, jnp.float32),
                 valid_vocab=model_cfg.vocab_size,
-            )[0]
-        )
+            )
+            lp, top_v, top_i = _logprob_info(
+                logits[None], tok, model_cfg.vocab_size)
+            return tok[0], (lp[0], top_v[0], top_i[0])
+
+        self._jit_sample_one = jax.jit(_sample_one)
 
     # ------------------------------------------------------------------
     # jitted compute
@@ -292,7 +324,8 @@ class Engine:
             top_p=jnp.full((1,), topp, jnp.float32),
             valid_vocab=model_cfg.vocab_size,
         )
-        return first_token[0], k, v
+        lp, top_v, top_i = _logprob_info(last, first_token, model_cfg.vocab_size)
+        return first_token[0], k, v, (lp[0], top_v[0], top_i[0])
 
     @staticmethod
     def _decode_impl(
@@ -327,6 +360,8 @@ class Engine:
             )
             sampled = sample(logits, step_key, temp, topk, topp,
                              valid_vocab=model_cfg.vocab_size)
+            lp, top_v, top_i = _logprob_info(
+                logits, sampled, model_cfg.vocab_size)
             valid = active
             # EOS emitted now is a valid token but deactivates the row.
             hit_eos = valid & (sampled == eos_id)
@@ -334,15 +369,18 @@ class Engine:
             remaining = jnp.where(hit_eos, 0, remaining)
             next_tokens = jnp.where(active, sampled, tokens)
             next_positions = positions + active.astype(positions.dtype)
-            return (cache, next_tokens, next_positions, remaining), (sampled, valid)
+            return (cache, next_tokens, next_positions, remaining), (
+                sampled, valid, lp, top_v, top_i)
 
         keys = jax.random.split(key, n_steps)
-        (cache, next_tokens, next_positions, next_remaining), (toks, valid) = (
+        carry, (toks, valid, lps, top_v, top_i) = (
             jax.lax.scan(one_step, (cache, tokens, positions, remaining), keys)
         )
+        cache, next_tokens, next_positions, next_remaining = carry
         # The token/position/budget carries live on device for pipelined
         # dispatch of the following block (no host round-trip needed).
-        return toks, valid, next_tokens, next_positions, next_remaining, cache
+        return (toks, valid, lps, top_v, top_i,
+                next_tokens, next_positions, next_remaining, cache)
 
     # ------------------------------------------------------------------
     # public API
@@ -626,8 +664,10 @@ class Engine:
             n = len(req.prompt_tokens)
             lora_slot = (self.lora.slot_for(req.adapter)
                          if self.lora is not None else -1)
-            first_token, k, v = self._bucket_prefill(req, n, lora_slot)
+            first_token, k, v, lp_info = self._bucket_prefill(
+                req, n, lora_slot)
             w = _WaitingPrefill(request=req, first_token=first_token,
+                                lp_info=lp_info,
                                 k=k, v=v, n=n, lora_slot=lora_slot)
             if pipelined:
                 try:
@@ -637,7 +677,8 @@ class Engine:
             else:
                 tok = int(first_token)
                 w.first_token_host = tok
-                if self._emit_first_token(req, tok):
+                if self._emit_first_token(req, tok, w.lp_info):
+                    w.lp_info = None
                     return  # done at prefill; never needed a slot or blocks
             self.decode_wait.append(w)
         except Exception as e:  # engine must survive a poison request
@@ -661,7 +702,7 @@ class Engine:
                 self._dev_positions = self._dev_positions.at[slot_idx].set(w.n)
                 self._dev_remaining = self._dev_remaining.at[slot_idx].set(
                     max(0, req.max_new_tokens - 1))
-                slot.pending_first = w.first_token
+                slot.pending_first = (w.first_token, w.lp_info)
                 self._register_slot(slot_idx, slot)
             else:
                 self._register_slot(slot_idx, slot)
@@ -674,26 +715,27 @@ class Engine:
 
     def _prefill_common(self, req: Request):
         """Shared admission path: bucket (or chunked) prefill + insert.
-        Returns (slot_idx, first_token_device, n, lora_slot)."""
+        Returns (slot_idx, first_token_device, n, lora_slot, lp_info)."""
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
         if n > self._max_bucket():
             try:
-                first_token = self._chunked_prefill(req, slot_idx, lora_slot)
+                first_token, lp_info = self._chunked_prefill(
+                    req, slot_idx, lora_slot)
             except Exception:
                 if self.paged:  # return any blocks a failed stream-in took
                     self._paged_free_row(slot_idx)
                 raise
-            return slot_idx, first_token, n, lora_slot
-        first_token, k, v = self._bucket_prefill(req, n, lora_slot)
+            return slot_idx, first_token, n, lora_slot, lp_info
+        first_token, k, v, lp_info = self._bucket_prefill(req, n, lora_slot)
         # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
         self._insert_prompt_kv(k, v, slot_idx, n)
-        return slot_idx, first_token, n, lora_slot
+        return slot_idx, first_token, n, lora_slot, lp_info
 
     def _bucket_prefill(self, req: Request, n: int, lora_slot: int):
         """Pad a bucketable prompt and run the jitted prefill.
-        Returns (first_token device scalar, k, v)."""
+        Returns (first_token device scalar, k, v, lp_info)."""
         sp = req.sampling
         bucket = self._bucket(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -788,11 +830,26 @@ class Engine:
             if len(self.ttft_history) > 1000:
                 del self.ttft_history[:500]
 
-    def _emit_first_token(self, req: Request, tok: int) -> bool:
+    def _store_logprobs(self, req: Request, lp, top_v, top_i) -> None:
+        """Record a token's logprob info iff the request asked for it."""
+        if req.logprobs is None:
+            return
+        req.output_logprobs.append(float(lp))
+        if req.logprobs > 0:
+            kk = min(req.logprobs, len(top_i))
+            req.output_top_logprobs.append(
+                {int(top_i[j]): float(top_v[j]) for j in range(kk)})
+
+    def _emit_first_token(self, req: Request, tok: int,
+                          lp_info=None) -> bool:
         """Record the prefill's first sampled token (TTFT, stream, counters);
         True if that token already finishes the request."""
         req.t_first_token = time.time()
         req.output_tokens.append(tok)
+        if lp_info is not None:
+            lp, top_v, top_i = lp_info
+            self._store_logprobs(req, np.asarray(lp),
+                                 np.asarray(top_v), np.asarray(top_i))
         req.stream_event.set()
         with self._lock:
             self.total_generated += 1
@@ -809,8 +866,9 @@ class Engine:
         slot_idx = None
         registered = False
         try:
-            slot_idx, first_token, n, lora_slot = self._prefill_common(req)
-            if self._emit_first_token(req, int(first_token)):
+            slot_idx, first_token, n, lora_slot, lp_info = (
+                self._prefill_common(req))
+            if self._emit_first_token(req, int(first_token), lp_info):
                 return  # finished at prefill; the finally frees its blocks
             self._register_slot(
                 slot_idx, _Slot(request=req, lora_slot=lora_slot, position=n)
@@ -862,7 +920,8 @@ class Engine:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
         self._paged_ensure_decode(n_steps, pipelined=False)
         t0 = time.perf_counter()
-        step_tokens, step_valid, _, _, _, self.cache = self._jit_decode(
+        (step_tokens, step_valid, step_lps, step_top_v, step_top_i,
+         _, _, _, self.cache) = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
             jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
             jnp.asarray(self._slot_lora),
@@ -873,6 +932,9 @@ class Engine:
         )
         toks_np = np.asarray(step_tokens)  # [n_steps, B]
         valid_np = np.asarray(step_valid)
+        lps_np = np.asarray(step_lps)
+        top_v_np = np.asarray(step_top_v)
+        top_i_np = np.asarray(step_top_i)
         step_s = time.perf_counter() - t0
         n_tokens = 0
         for i, slot in enumerate(self.slots):
@@ -889,6 +951,8 @@ class Engine:
                     continue  # device froze this row (budget/EOS)
                 tok = int(toks_np[k, i])
                 req.output_tokens.append(tok)
+                self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
+                                     top_i_np[k, i])
                 n_tokens += 1
                 slot.position += 1
                 self._slot_tokens[i] = tok
@@ -978,7 +1042,8 @@ class Engine:
         slot_idx = None
         registered = False
         try:
-            slot_idx, first_token, n, lora_slot = self._prefill_common(req)
+            slot_idx, first_token, n, lora_slot, lp_info = (
+                self._prefill_common(req))
             # A queued budget-zero for this lane belongs to the PREVIOUS
             # occupant — drop it or it would freeze the new request.
             self._pending_budget_zero = [
@@ -996,7 +1061,7 @@ class Engine:
             # t_first_token is stamped when the token MATERIALIZES in
             # _process_block — stamping here would understate TTFT by a block.
             slot = _Slot(request=req, lora_slot=lora_slot, position=n)
-            slot.pending_first = first_token
+            slot.pending_first = (first_token, lp_info)
             self._register_slot(slot_idx, slot)
             registered = True
         except _PrefillCancelled:
@@ -1016,7 +1081,8 @@ class Engine:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
             self._dev_remaining = self._dev_remaining.at[idxs].set(0)
             self._pending_budget_zero.clear()
-        toks, valid, next_tokens, next_positions, next_remaining, self.cache = (
+        (toks, valid, lps, top_v, top_i, next_tokens, next_positions,
+         next_remaining, self.cache) = (
             self._jit_decode(
                 self.params, self._lora_buffers(), self.cache,
                 self._dev_tokens, self._dev_positions,
@@ -1030,7 +1096,7 @@ class Engine:
         self._dev_tokens = next_tokens
         self._dev_positions = next_positions
         self._dev_remaining = next_remaining
-        for arr in (toks, valid):
+        for arr in (toks, valid, lps, top_v, top_i):
             try:
                 arr.copy_to_host_async()
             except AttributeError:
@@ -1038,6 +1104,9 @@ class Engine:
         return {
             "toks": toks,
             "valid": valid,
+            "lps": lps,
+            "top_v": top_v,
+            "top_i": top_i,
             "rows": list(self.slots),  # request refs valid at dispatch time
             "n_steps": n_steps,
             "t0": time.perf_counter(),
@@ -1046,6 +1115,9 @@ class Engine:
     def _process_block(self, blk: dict, current: dict | None) -> None:
         toks_np = np.asarray(blk["toks"])  # overlaps with `current` computing
         valid_np = np.asarray(blk["valid"])
+        lps_np = np.asarray(blk["lps"])
+        top_v_np = np.asarray(blk["top_v"])
+        top_i_np = np.asarray(blk["top_i"])
         n_tokens = 0
         for i, slot in enumerate(blk["rows"]):
             if slot is None:
@@ -1064,10 +1136,15 @@ class Engine:
             finished = False
             pending = getattr(slot, "pending_first", None)
             if pending is not None:
-                tok0 = int(np.asarray(pending))
+                pending_tok, pending_lp = pending
+                tok0 = int(np.asarray(pending_tok))
                 slot.pending_first = None
                 req.t_first_token = time.time()
                 req.output_tokens.append(tok0)
+                if pending_lp is not None:
+                    lp0, tv0, ti0 = pending_lp
+                    self._store_logprobs(req, np.asarray(lp0),
+                                         np.asarray(tv0), np.asarray(ti0))
                 n_tokens += 1
                 self._record_ttft(req)
                 if self._is_finished(req, tok0):
@@ -1078,6 +1155,8 @@ class Engine:
                         continue  # device froze this row (budget/EOS)
                     tok = int(toks_np[k, i])
                     req.output_tokens.append(tok)
+                    self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
+                                         top_i_np[k, i])
                     n_tokens += 1
                     slot.position += 1
                     if (
