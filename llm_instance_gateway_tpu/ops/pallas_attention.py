@@ -7,12 +7,15 @@ blocks, turning an O(S^2) HBM traffic pattern into O(S).  Everything
 elementwise (norms, RoPE, activations) stays XLA-fused, per the guide's
 "don't hand-schedule what the compiler already does".
 
-Kernel shape: grid (B, H, S/BLOCK_Q); each program holds one query block in
-VMEM and loops over K/V blocks with the online-softmax recurrence in f32
-scratch.  GQA is native: the K/V BlockSpec index-maps query head h to KV
-head h // (H/K), so grouped heads share the same streamed K/V block without
-materialized repetition.  Causal blocks strictly above the diagonal are
-skipped (their programs still run but do no FLOPs via @pl.when).
+Kernel shape: grid (B, H, S/BLOCK_Q, S/BLOCK_K) with the K-block axis
+innermost and SEQUENTIAL ("arbitrary" semantics): VMEM holds ONE
+[BLOCK_K, hd] K/V tile at a time — long-context ready, VMEM use is O(block)
+regardless of S — while the online-softmax state (m, l, o-accumulator)
+persists in f32 scratch across the K sweep and the output writes on the
+last K block.  GQA is native: the K/V BlockSpec index-maps query head h to
+KV head h // (H/K), so grouped heads share the same streamed K/V tile
+without materialized repetition.  Causal K blocks strictly above the
+diagonal skip their FLOPs via @pl.when.
 
 Use ``flash_attention`` for the auto-dispatching entry: it falls back to the
 XLA reference (``ops.attention.prefill_attention``) when shapes don't meet
@@ -36,28 +39,29 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, scale: float):
     # Blocks keep their leading (batch, head) unit dims:
-    # q_ref: [1, 1, BLOCK_Q, hd]; k_ref/v_ref: [1, 1, S, hd].
+    # q_ref: [1, 1, BLOCK_Q, hd]; k_ref/v_ref: [1, 1, BLOCK_K, hd] — one K/V
+    # tile per grid step, carried state in scratch (lane-padded to 128).
     qi = pl.program_id(2)
-    s_total = k_ref.shape[2]
-    n_kblocks = s_total // block_k
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    bq = q.shape[0]
-
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    o0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
-
+    kb = pl.program_id(3)
+    n_kblocks = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    block_k = k_ref.shape[2]
     q_start = qi * bq
+    k_start = kb * block_k
 
-    def body(kb, carry):
-        m, l, o = carry
-        k_start = kb * block_k
-        k = k_ref[0, 0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -66,23 +70,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        o_new = o * corr + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * corr + p.sum(axis=-1, keepdims=True), l_scr.shape)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, o_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    # Causal: only blocks up to (and including) the diagonal contribute.
-    last_block = (
-        jnp.minimum((q_start + bq + block_k - 1) // block_k, n_kblocks)
-        if causal else n_kblocks
-    )
-    m, l, o = jax.lax.fori_loop(0, last_block, body, (m0, l0, o0))
-    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if causal:
+        # K blocks strictly above the diagonal contribute nothing.
+        pl.when(k_start < q_start + bq)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
 
 
 def flash_attention_bhsd(
@@ -98,29 +109,36 @@ def flash_attention_bhsd(
     n_kv = k.shape[1]
     g = h // n_kv
     scale = float(1.0 / (hd ** 0.5))
-    grid = (b, h, s // block_q)
-    kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale
-    )
+    # K-block axis innermost and sequential: scratch carries the online
+    # softmax state across it; the three outer axes parallelize freely.
+    grid = (b, h, s // block_q, s // block_k)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid_spec=pl.GridSpec(
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q, hd),
-                             lambda bi, hi, qi: (bi, hi, qi, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, s, hd),
-                             lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, s, hd),
-                             lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
-                             memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec((1, 1, block_q, hd),
-                                   lambda bi, hi, qi: (bi, hi, qi, 0),
-                                   memory_space=pltpu.VMEM),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd),
+                         lambda bi, hi, qi, kb: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda bi, hi, qi, kb, g=g: (bi, hi // g, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda bi, hi, qi, kb, g=g: (bi, hi // g, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda bi, hi, qi, kb: (bi, hi, qi, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-padded)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((block_q, hd), jnp.float32),   # o accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v)
