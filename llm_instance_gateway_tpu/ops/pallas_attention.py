@@ -58,36 +58,45 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [BQ, BK]
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[...] = jnp.broadcast_to(
-            l_prev * corr + p.sum(axis=-1, keepdims=True), l_scr.shape)
-        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    def _compute(masked: bool):
+        def go():
+            q = q_ref[0, 0].astype(jnp.float32) * scale
+            k = k_ref[0, 0].astype(jnp.float32)
+            v = v_ref[0, 0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [BQ, BK]
+            if masked:
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_prev = m_scr[:, :1]
+            l_prev = l_scr[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[...] = jnp.broadcast_to(
+                l_prev * corr + p.sum(axis=-1, keepdims=True), l_scr.shape)
+            acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        return go
 
     if causal:
-        # K blocks strictly above the diagonal contribute nothing.
-        pl.when(k_start < q_start + bq)(_compute)
+        # Exactly one branch runs per step: the diagonal-straddling block
+        # pays for the iota mask, interior blocks skip it, and blocks
+        # strictly above the diagonal do nothing (their K/V DMA is also
+        # elided — the index map revisits the previous tile).
+        on_diagonal = (k_start + block_k > q_start) & (k_start < q_start + bq)
+        pl.when(on_diagonal)(_compute(masked=True))
+        pl.when(k_start + block_k <= q_start)(_compute(masked=False))
     else:
-        _compute()
+        _compute(masked=False)()
 
     @pl.when(kb == n_kblocks - 1)
     def _finalize():
@@ -113,6 +122,19 @@ def flash_attention_bhsd(
     # softmax state across it; the three outer axes parallelize freely.
     grid = (b, h, s // block_q, s // block_k)
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+
+    if causal:
+        # Blocks strictly above the diagonal never contribute: clamp their
+        # K/V index to the last contributing tile, so Pallas sees the same
+        # block as the previous step and elides the dead HBM->VMEM copy
+        # (the kernel's @pl.when skips their compute anyway).
+        def kv_index(bi, hi, qi, kb, g=g):
+            last = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi // g, jnp.minimum(kb, last), 0)
+    else:
+        def kv_index(bi, hi, qi, kb, g=g):
+            return (bi, hi // g, kb, 0)
+
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -121,11 +143,9 @@ def flash_attention_bhsd(
             pl.BlockSpec((1, 1, block_q, hd),
                          lambda bi, hi, qi, kb: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, hd),
-                         lambda bi, hi, qi, kb, g=g: (bi, hi // g, kb, 0),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, hd),
-                         lambda bi, hi, qi, kb, g=g: (bi, hi // g, kb, 0),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, hd),
