@@ -58,13 +58,17 @@ class ModelConfig:
     # and recompiles, so they mirror vLLM's --max-loras / max rank flags).
     max_lora_slots: int = 4
     max_lora_rank: int = 16
-    # Pallas flash-attention for prefill (right-padded batches only; falls
-    # back to the XLA reference when shapes miss the tiling constraints).
-    use_flash_attention: bool = False
-    # Pallas cached-decode attention kernel (ops/pallas_decode_attention).
-    # Interpret-mode parity is tested; flip on after validating on the
-    # target chip generation.
-    use_pallas_decode: bool = False
+    # Pallas flash-attention for prefill (right-padded batches only).  On by
+    # default: the dispatcher falls back to the XLA reference on CPU or when
+    # shapes miss the tiling constraints.  Validated compiled on v5e —
+    # bf16-tolerance parity, 19-22x over XLA at S=8192 (tools/
+    # onchip_pallas_check.py).
+    use_flash_attention: bool = True
+    # Pallas cached-decode attention kernel (ops/pallas_decode_attention),
+    # same auto-fallback.  Validated compiled on v5e: parity at bf16
+    # tolerance; DMA-clamping skips cache blocks past each row's length
+    # (2.1x over XLA at S_max=8192, half-full cache).
+    use_pallas_decode: bool = True
 
     @property
     def resolved_head_dim(self) -> int:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_instance_gateway_tpu.ops import pallas_decode_attention
 from llm_instance_gateway_tpu.ops.attention import prefill_attention
 
 NEG_INF = -1e30
@@ -184,7 +185,10 @@ def flash_attention(
     position-based masks must use the XLA path.
     """
     b, s, h, hd = q.shape
-    if not supports(s, hd):
+    if not supports(s, hd) or (
+        not interpret
+        and jax.default_backend() not in pallas_decode_attention.TPU_BACKENDS
+    ):
         return prefill_attention(q, k, v)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)

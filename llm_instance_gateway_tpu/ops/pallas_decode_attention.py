@@ -25,44 +25,78 @@ from llm_instance_gateway_tpu.ops.attention import decode_attention as xla_decod
 
 NEG_INF = -1e30
 
-BLOCK_S = 128
+# Platforms whose default backend runs Mosaic TPU lowering ("axon" is this
+# image's tunneled-TPU plugin).  Anything else falls back to the XLA path.
+TPU_BACKENDS = ("tpu", "axon")
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_s: int,
-                   scale: float):
-    # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, S, 1, hd]; len_ref: [B] (SMEM,
-    # scalar-prefetched — index by this program's batch row).
-    g, hd = q_ref.shape[2], q_ref.shape[3]
-    length = len_ref[pl.program_id(0)]
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, block_s: int, scale: float):
+    # q_ref: [1, K, G, hd]; k_ref/v_ref: [1, block_s, K*hd] — ALL heads of
+    # one S-tile per grid step (head fusion keeps the grid small: per-step
+    # overhead, not bandwidth, dominated the per-head variant on chip);
+    # len_ref: [B] (SMEM, scalar-prefetched).  The S-block axis is the
+    # innermost grid dim with "arbitrary" semantics: online-softmax state
+    # rides f32 VMEM scratch across the sweep, like the prefill flash kernel.
+    n_kv, g, hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    bi = pl.program_id(0)
+    sb = pl.program_id(1)
+    n_sb = pl.num_programs(1)
+    length = len_ref[bi]
+    start = sb * block_s
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
-    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g, 1), jnp.float32)
-    o0 = jnp.zeros((g, hd), jnp.float32)
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(sb, carry):
-        m, l, o = carry
-        start = sb * block_s
-        k = k_ref[0, pl.ds(start, block_s), 0, :].astype(jnp.float32)  # [BS, hd]
-        v = v_ref[0, pl.ds(start, block_s), 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [G, BS]
+    # Blocks entirely past `length` do nothing (their DMA is elided too —
+    # the index map revisits the last live tile); the straddling block masks.
+    @pl.when(start < length)
+    def _compute():
         pos = start + jax.lax.broadcasted_iota(jnp.int32, (g, block_s), 1)
-        s = jnp.where(pos < length, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        o_new = o * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, o_new
+        live = pos < length
+        for kh in range(n_kv):  # unrolled: static head offsets into the tile
+            # K/V stay in their storage dtype: the MXU consumes bf16 directly
+            # with f32 accumulation — an explicit astype of every tile was
+            # pure VPU overhead (measured on chip).
+            q = q_ref[0, kh]  # [G, hd]
+            k = k_ref[0, :, kh * hd:(kh + 1) * hd]
+            v = v_ref[0, :, kh * hd:(kh + 1) * hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, BS] f32
+            s = jnp.where(live, s, NEG_INF)
+            m_prev = m_scr[kh, :, :1]
+            l_prev = l_scr[kh, :, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[kh] = jnp.broadcast_to(
+                l_prev * corr + p.sum(axis=-1, keepdims=True),
+                l_scr.shape[1:])
+            acc_scr[kh] = acc_scr[kh] * corr + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[kh] = jnp.broadcast_to(m_new, m_scr.shape[1:])
 
-    # Only blocks that can contain valid positions (< length) do work.
-    n_blocks = (length + block_s - 1) // block_s
-    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
-    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(sb == n_sb - 1)
+    def _finalize():
+        # Rows with length == 0 never accumulate (l stays 0) and emit zeros;
+        # the engine treats such slots as garbage either way.
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _pick_block(s_max: int) -> int:
+    for bs in (512, 256, 128):
+        if s_max % bs == 0:
+            return bs
+    return 0
 
 
 def decode_attention_pallas(
@@ -70,35 +104,60 @@ def decode_attention_pallas(
     k_cache: jax.Array,  # [B, S, n_kv, hd]
     v_cache: jax.Array,
     lengths: jax.Array,  # [B] int32
-    block_s: int = BLOCK_S,
+    block_s: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     b, n_heads, hd = q.shape
     s_max, n_kv = k_cache.shape[1], k_cache.shape[2]
     g = n_heads // n_kv
     scale = float(1.0 / (hd ** 0.5))
+    if block_s is None:
+        block_s = _pick_block(s_max)
     qg = q.reshape(b, n_kv, g, hd)
+    # Mosaic requires the last two block dims be (8k, 128k)-aligned or full;
+    # a [1, S, 1, hd] head slice of the 4-D cache violates that.  View the
+    # cache as [B, S, K*hd] instead — contiguous, so the reshape is free —
+    # and slice heads as static lane columns inside the kernel.
+    k2 = k_cache.reshape(b, s_max, n_kv * hd)
+    v2 = v_cache.reshape(b, s_max, n_kv * hd)
+
+    def kv_index(bi, sb, lens, block_s=block_s):
+        # Clamp dead S-blocks (start >= length) to the last live tile so
+        # Pallas elides their HBM->VMEM copies: short rows in a long cache
+        # cost bandwidth proportional to their length, not to S_max.
+        last = jnp.maximum(lens[bi] - 1, 0) // block_s
+        return (bi, jnp.minimum(sb, last), 0)
+
     kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,  # lengths: needed for the block count
-            grid=(b, n_kv),
+            num_scalar_prefetch=1,  # lengths: drives masking + DMA clamping
+            grid=(b, s_max // block_s),
             in_specs=[
-                pl.BlockSpec((1, 1, g, hd), lambda bi, ki, lens: (bi, ki, 0, 0)),
-                pl.BlockSpec((1, s_max, 1, hd), lambda bi, ki, lens: (bi, 0, ki, 0)),
-                pl.BlockSpec((1, s_max, 1, hd), lambda bi, ki, lens: (bi, 0, ki, 0)),
+                pl.BlockSpec((1, n_kv, g, hd), lambda bi, sb, lens: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
+                pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
             ],
-            out_specs=pl.BlockSpec((1, 1, g, hd), lambda bi, ki, lens: (bi, ki, 0, 0)),
+            out_specs=pl.BlockSpec((1, n_kv, g, hd),
+                                   lambda bi, sb, lens: (bi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_kv, g, 128), jnp.float32),  # m (lane-padded)
+                pltpu.VMEM((n_kv, g, 128), jnp.float32),  # l
+                pltpu.VMEM((n_kv, g, hd), jnp.float32),   # o accumulator
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(lengths, qg, k_cache, v_cache)
+    )(lengths, qg, k2, v2)
     return out.reshape(b, n_heads, hd)
 
 
-def supports(s_max: int, hd: int, block_s: int = BLOCK_S) -> bool:
-    return s_max % block_s == 0 and hd % 128 == 0
+def supports(s_max: int, hd: int) -> bool:
+    return _pick_block(s_max) != 0 and hd % 128 == 0
 
 
 def decode_attention(
@@ -107,6 +166,8 @@ def decode_attention(
 ) -> jax.Array:
     """Auto-dispatch: Pallas kernel when shapes allow, XLA reference otherwise."""
     s_max, hd = k_cache.shape[1], k_cache.shape[3]
-    if not supports(s_max, hd):
+    if not supports(s_max, hd) or (
+        not interpret and jax.default_backend() not in TPU_BACKENDS
+    ):
         return xla_decode(q, k_cache, v_cache, lengths)
     return decode_attention_pallas(q, k_cache, v_cache, lengths, interpret=interpret)

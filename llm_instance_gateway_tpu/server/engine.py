@@ -25,6 +25,7 @@ adapters and the base model (the premise of the gateway's affinity routing).
 from __future__ import annotations
 
 import collections
+import dataclasses
 import functools
 import logging
 import queue as queue_mod
@@ -227,6 +228,19 @@ class Engine:
         # collectives (one psum per layer on attn/MLP outputs for Megatron
         # tensor parallelism), nothing in the loop code changes.
         self.mesh = mesh
+        if mesh is not None and mesh.size > 1 and (
+            model_cfg.use_flash_attention or model_cfg.use_pallas_decode
+        ):
+            # GSPMD can't partition an opaque pallas_call across the mesh;
+            # the XLA attention path shards cleanly.  Single-device meshes
+            # keep the kernels.
+            logger.info(
+                "mesh size %d > 1: disabling Pallas attention kernels "
+                "(GSPMD cannot partition pallas_call); using XLA attention",
+                mesh.size)
+            model_cfg = dataclasses.replace(
+                model_cfg, use_flash_attention=False, use_pallas_decode=False)
+            self.model_cfg = model_cfg
         if mesh is not None:
             from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
 

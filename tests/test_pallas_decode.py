@@ -45,6 +45,24 @@ class TestDecodeKernel:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_multi_block_recurrence(self):
+        # Force n_sb > 1 so the cross-block online-softmax carry (scratch
+        # m/l/acc, corr rescaling) and the dead-block DMA clamp actually run;
+        # the default _pick_block(256) would cover s=256 in a single step.
+        q, k, v, lengths = make_inputs(s=256, seed=7)
+        ref = xla_decode(q, k, v, lengths)
+        got = pda.decode_attention_pallas(q, k, v, lengths, block_s=64,
+                                          interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+        # Short rows exercise the clamp-to-last-live-tile index map.
+        short = jnp.minimum(lengths, 70)
+        ref_s = xla_decode(q, k, v, short)
+        got_s = pda.decode_attention_pallas(q, k, v, short, block_s=64,
+                                            interpret=True)
+        np.testing.assert_allclose(np.asarray(ref_s), np.asarray(got_s),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_unsupported_shapes_fall_back(self):
         q, k, v, lengths = make_inputs(hd=16, s=64)
         assert not pda.supports(64, 16)

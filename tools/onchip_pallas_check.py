@@ -1,0 +1,102 @@
+"""On-chip (real TPU) validation + timing of the Pallas kernels.
+
+Runs the compiled (non-interpret) flash-prefill and cached-decode kernels
+against the XLA references at serving-realistic shapes, reports max abs
+error and wall time.  This is the round-2 gate for flipping
+``use_flash_attention`` / ``use_pallas_decode`` defaults on TPU
+(VERDICT.md "Next round" item 6).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from llm_instance_gateway_tpu.ops import attention as xla_att
+from llm_instance_gateway_tpu.ops import pallas_attention as flash
+from llm_instance_gateway_tpu.ops import pallas_decode_attention as pdec
+
+
+def _time(fn, *args, iters=20):
+    """Time `fn` with a chained on-device loop: one dispatch, `iters` real
+    evaluations (the remote-tunnel per-call latency would otherwise drown
+    sub-ms kernels).  The output is fed back into the first arg's low bits
+    so XLA can't hoist or dedupe the iterations."""
+    out = fn(*args)  # also the parity-check value
+    jax.block_until_ready(out)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def loop(n, out0, *args):
+        def body(_, carry):
+            a, prev = carry
+            o = fn(a, *args[1:])
+            # fold a data dependency the compiler can't fold away: ×(1+eps·o)
+            # is numerically identity in bf16 but not statically foldable.
+            a = a * (1 + o.reshape(-1)[0] * 1e-30).astype(a.dtype)
+            return a, o
+        a, o = jax.lax.fori_loop(0, n, body, (args[0], out0))
+        return o
+
+    def run(n):
+        r = loop(n, out, *args)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        r = loop(n, out, *args)
+        jax.block_until_ready(r)
+        return time.perf_counter() - t0
+
+    t_n, t_2n = run(iters), run(2 * iters)
+    # Differencing cancels the (large, variable) tunnel dispatch overhead.
+    return out, max(t_2n - t_n, 1e-9) / iters * 1e3
+
+
+def check_flash(b=2, h=8, n_kv=2, s=2048, hd=128, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, hd), dtype)
+    k = jax.random.normal(kk, (b, s, n_kv, hd), dtype)
+    v = jax.random.normal(kv, (b, s, n_kv, hd), dtype)
+
+    ref_fn = jax.jit(xla_att.prefill_attention)
+    ker_fn = jax.jit(lambda q, k, v: flash.flash_attention(q, k, v))
+    ref, t_ref = _time(ref_fn, q, k, v)
+    out, t_ker = _time(ker_fn, q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"flash  b={b} h={h} kv={n_kv} s={s} hd={hd} {dtype.__name__}: "
+          f"max_err={err:.4f} xla={t_ref:.2f}ms pallas={t_ker:.2f}ms "
+          f"speedup={t_ref / t_ker:.2f}x")
+    return err, t_ref, t_ker
+
+
+def check_decode(b=8, h=32, n_kv=8, s_max=2048, hd=128, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (b, h, hd), dtype)
+    k_cache = jax.random.normal(kk, (b, s_max, n_kv, hd), dtype)
+    v_cache = jax.random.normal(kv, (b, s_max, n_kv, hd), dtype)
+    lengths = jnp.array([s_max // 2 + 17 * i for i in range(b)], jnp.int32) % s_max
+    lengths = jnp.maximum(lengths, 1)
+
+    ref_fn = jax.jit(xla_att.decode_attention)
+    ker_fn = jax.jit(lambda q, kc, vc, l: pdec.decode_attention(q, kc, vc, l))
+    ref, t_ref = _time(ref_fn, q, k_cache, v_cache, lengths, iters=50)
+    out, t_ker = _time(ker_fn, q, k_cache, v_cache, lengths, iters=50)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"decode b={b} h={h} kv={n_kv} smax={s_max} hd={hd} {dtype.__name__}: "
+          f"max_err={err:.4f} xla={t_ref:.3f}ms pallas={t_ker:.3f}ms "
+          f"speedup={t_ref / t_ker:.2f}x")
+    return err, t_ref, t_ker
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    for s in (512, 2048, 8192):
+        check_flash(s=s)
+    for s_max in (1024, 2048, 8192):
+        check_decode(s_max=s_max)
