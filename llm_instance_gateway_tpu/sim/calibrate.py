@@ -26,18 +26,25 @@ from llm_instance_gateway_tpu.sim.core import LatencyModel
 
 
 def _time_call(fn, n: int = 5) -> float:
-    fn()  # warm (compile)
+    """Average seconds per call.  ``fn`` returns a device array; only the
+    final handle is synced, so the n dispatches pipeline and the (large,
+    on a tunneled chip) per-call host round-trip is amortized instead of
+    being paid n times — it would otherwise swamp the per-token slope the
+    fit is after."""
+    np.asarray(fn())  # warm (compile) + sync
     t0 = time.perf_counter()
+    h = None
     for _ in range(n):
-        fn()
+        h = fn()
+    np.asarray(h)
     return (time.perf_counter() - t0) / n
 
 
 def calibrate_from_engine(
     engine,
-    prefill_lengths: tuple[int, ...] = (64, 128, 256),
+    prefill_lengths: tuple[int, ...] = (64, 128, 256, 384),
     decode_fills: tuple[int, ...] = (32, 128, 256, 448),
-    repeats: int = 5,
+    repeats: int = 10,
 ) -> LatencyModel:
     import jax
     import jax.numpy as jnp
@@ -55,13 +62,13 @@ def calibrate_from_engine(
         positions = jnp.broadcast_to(jnp.arange(bucket), (1, bucket)).astype(jnp.int32)
 
         def call(bucket=bucket, tokens=tokens, positions=positions):
-            first, k, v = engine._jit_prefill(
+            first, k, v, _ = engine._jit_prefill(
                 engine.params, engine._lora_buffers(), tokens, positions,
                 jnp.int32(bucket), jnp.int32(-1),
                 jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
                 jax.random.PRNGKey(0),
             )
-            np.asarray(first)
+            return first
 
         xs.append(bucket)
         ys.append(_time_call(call, repeats))
@@ -90,13 +97,18 @@ def calibrate_from_engine(
         k = jnp.zeros((b_slots,), jnp.int32)
         p = jnp.ones((b_slots,), jnp.float32)
 
-        def call(tokens=tokens, positions=positions, slots=slots, t=t, k=k, p=p):
-            toks, _, _, engine.cache = engine._jit_decode(
+        remaining = jnp.full((b_slots,), 1 << 20, jnp.int32)  # rows stay live
+
+        def call(tokens=tokens, positions=positions, slots=slots, t=t, k=k,
+                 p=p, remaining=remaining):
+            out = engine._jit_decode(
                 engine.params, engine._lora_buffers(), engine.cache,
                 tokens, positions, slots, t, k, p,
-                jax.random.PRNGKey(0), n_steps=n_steps,
+                jax.random.PRNGKey(0), remaining, jnp.int32(-1),
+                n_steps=n_steps,
             )
-            np.asarray(toks)
+            engine.cache = out[-1]  # donated in; reassign the new buffer
+            return out[0]
 
         kv_totals.append(float(b_slots * fill))
         times.append(_time_call(call, repeats) / n_steps)
@@ -142,7 +154,8 @@ def main() -> None:
         cfg, params,
         EngineConfig(decode_slots=4 if on_cpu else 16,
                      max_seq_len=cfg.max_seq_len,
-                     prefill_buckets=(32, 64, 128) if on_cpu else (64, 128, 256),
+                     prefill_buckets=(32, 64, 128) if on_cpu
+                     else (64, 128, 256, 384),
                      decode_steps_per_sync=1 if on_cpu else 8),
         dtype=dtype,
     )

@@ -59,17 +59,20 @@ A100_VLLM = LatencyModel(
     decode_per_seq_s=1.026494433e-4,
 )
 
-# Placeholder v5e shape until sim.calibrate refits from the live engine:
-# prefill is MXU-bound (similar slope), decode is HBM-bound with a higher
-# fixed cost per step on one chip and near-flat batch scaling in the slot
-# regime.
+# v5e-1, fitted by sim.calibrate from the live engine (bench-llama-1b,
+# 16 decode slots, K=8 fused steps, pipelined dispatch so the tunnel
+# round-trip is amortized — 2026-07-29 run, values rounded):
+#   prefill  = 0.0205 + 1.52e-6 * prompt_tokens      (weight-stream bound
+#              at batch 1: the base is HBM weights + dispatch, the
+#              per-token slope is small until prompts reach thousands)
+#   decode   = 0.0045 + 4.5e-8 * kv_tokens + 2.8e-4 * batch   per step
 V5E_DEFAULT = LatencyModel(
-    prefill_min_s=0.02,
-    prefill_base_s=0.012,
-    prefill_per_token_s=5.5e-5,
-    decode_base_s=0.010,
-    decode_per_kv_token_s=3.0e-7,
-    decode_per_seq_s=6.0e-5,
+    prefill_min_s=0.0176,
+    prefill_base_s=0.0205,
+    prefill_per_token_s=1.52e-6,
+    decode_base_s=0.0045,
+    decode_per_kv_token_s=4.5e-8,
+    decode_per_seq_s=2.81e-4,
 )
 
 
