@@ -143,10 +143,6 @@ class Request:
         return (self.t_first_token - self.t_submit) if self.t_first_token else 0.0
 
 
-class _PrefillCancelled(Exception):
-    """Admission aborted because the request was cancelled mid-prefill."""
-
-
 class PagedPoolExhausted(Exception):
     """The paged KV pool has no free blocks (oversubscribed pool)."""
 
@@ -176,6 +172,20 @@ class _WaitingPrefill:
     first_token_host: int | None = None  # sync mode: already-emitted token
     # First-token (lp, top_v, top_i) device tuple; None once recorded.
     lp_info: object = None
+
+
+@dataclass
+class _ChunkStream:
+    """A long prompt streaming into a RESERVED cache lane one chunk per
+    engine cycle, so active decode slots keep stepping between chunks
+    (round-1 admitted the whole prompt in one blocking burst, coupling
+    queued TTFT to running TPOT)."""
+
+    request: Request
+    slot_idx: int
+    lora_slot: int
+    next_start: int = 0
+    last_logits: object = None
 
 
 class Engine:
@@ -267,6 +277,10 @@ class Engine:
         # queue but not yet admissible (e.g. a chunked prompt with no lane).
         self.decode_wait: "collections.deque[_WaitingPrefill]" = collections.deque()
         self._pending: Request | None = None
+        # One long prompt at a time streams chunk-by-chunk into a reserved
+        # lane, interleaved with decode blocks (_stream_step).
+        self._stream: _ChunkStream | None = None
+        self._reserved_slots: set[int] = set()
         self._work = threading.Condition()
         self._running = False
         self._thread: threading.Thread | None = None
@@ -477,14 +491,17 @@ class Engine:
         else:
             used_tokens = sum(
                 (s.position if s is not None else 0) for s in self.slots
-            )
+            ) + (self._stream.next_start if self._stream is not None else 0)
             capacity = self.cfg.decode_slots * self.cfg.max_seq_len
         with self._lock:
             tps = self.decode_tps_ema
         running_adapters = self.lora.running_adapters() if self.lora else []
         max_lora = self.lora.max_slots if self.lora else 0
+        # The in-flight chunk stream counts as prefilling: invisible, the
+        # gateway would route MORE traffic to the replica busiest streaming.
         prefill_depth = self.prefill_queue.qsize() + (
-            1 if self._pending is not None else 0)
+            1 if self._pending is not None else 0) + (
+            1 if self._stream is not None else 0)
         decode_depth = len(self.decode_wait)
         return {
             "prefill_queue_size": prefill_depth,
@@ -505,7 +522,7 @@ class Engine:
 
     def _free_slot_index(self) -> int | None:
         for i, s in enumerate(self.slots):
-            if s is None:
+            if s is None and i not in self._reserved_slots:
                 return i
         return None
 
@@ -584,6 +601,12 @@ class Engine:
             # slot left empty idles for a whole K-step block), then prefill
             # AHEAD into decode_wait while slots are busy.
             did_work = self._admit_and_insert(pipelined=False)
+            # 1b) One chunk of an in-flight long-prompt stream: decode
+            # blocks run between chunks, so streaming a 32k prompt no
+            # longer freezes every active slot's TPOT.
+            if self._stream is not None:
+                self._stream_step(pipelined=False)
+                did_work = True
             # 2) One fused decode block for all active slots.
             if any(s is not None for s in self.slots):
                 try:
@@ -630,6 +653,14 @@ class Engine:
                     break
                 if not self._paged_can_admit(len(req.prompt_tokens)):
                     break  # pool backpressure: wait for block frees
+                if len(req.prompt_tokens) > self._max_bucket():
+                    if self._stream is not None:
+                        break  # one stream at a time; FIFO head waits
+                    self._pending = None
+                    if not self._start_stream(req):
+                        break  # reparked for backpressure; stop this cycle
+                    did = True
+                    continue
                 self._pending = None
                 if pipelined:
                     self._do_prefill_pipelined(req)
@@ -700,26 +731,37 @@ class Engine:
             req.error = str(e)
             self._finish(req, "error")
 
+    def _activate_slot_pipelined(self, slot_idx: int, req: Request,
+                                 lora_slot: int, n: int, first_token,
+                                 lp_info) -> None:
+        """Pipelined-mode slot activation: scatter the device-resident first
+        token/position/budget into the carry arrays and register the slot
+        with its pending first token (materialized at block processing).
+        Shared by decode_wait inserts and chunk-stream activation — the
+        device-state bookkeeping must stay identical."""
+        self._pending_budget_zero = [
+            i for i in self._pending_budget_zero if i != slot_idx
+        ]
+        self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
+        self._dev_positions = self._dev_positions.at[slot_idx].set(n)
+        self._dev_remaining = self._dev_remaining.at[slot_idx].set(
+            max(0, req.max_new_tokens - 1))
+        slot = _Slot(request=req, lora_slot=lora_slot, position=n)
+        slot.pending_first = (first_token, lp_info)
+        self._register_slot(slot_idx, slot)
+
     def _insert_waiting(self, slot_idx: int, w: _WaitingPrefill,
                         pipelined: bool) -> None:
         """Insert a parked prefill's KV into a freed cache lane."""
         req = w.request
         try:
             self._insert_prompt_kv(w.k, w.v, slot_idx, w.n)
-            slot = _Slot(request=req, lora_slot=w.lora_slot, position=w.n)
             if pipelined:
-                self._pending_budget_zero = [
-                    i for i in self._pending_budget_zero if i != slot_idx
-                ]
-                self._dev_tokens = self._dev_tokens.at[slot_idx].set(
-                    w.first_token)
-                self._dev_positions = self._dev_positions.at[slot_idx].set(w.n)
-                self._dev_remaining = self._dev_remaining.at[slot_idx].set(
-                    max(0, req.max_new_tokens - 1))
-                slot.pending_first = (w.first_token, w.lp_info)
-                self._register_slot(slot_idx, slot)
+                self._activate_slot_pipelined(
+                    slot_idx, req, w.lora_slot, w.n, w.first_token, w.lp_info)
             else:
-                self._register_slot(slot_idx, slot)
+                self._register_slot(slot_idx, _Slot(
+                    request=req, lora_slot=w.lora_slot, position=w.n))
                 self._slot_tokens[slot_idx] = w.first_token_host
                 self._slot_positions[slot_idx] = w.n
         except Exception as e:
@@ -728,20 +770,13 @@ class Engine:
             self._finish(req, "error")
 
     def _prefill_common(self, req: Request):
-        """Shared admission path: bucket (or chunked) prefill + insert.
+        """Shared admission path: bucketed prefill + insert.  Long prompts
+        never reach here — ``_admit_and_insert`` diverts them to the
+        interleaved chunk stream (``_start_stream``/``_stream_step``).
         Returns (slot_idx, first_token_device, n, lora_slot, lp_info)."""
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
-        if n > self._max_bucket():
-            try:
-                first_token, lp_info = self._chunked_prefill(
-                    req, slot_idx, lora_slot)
-            except Exception:
-                if self.paged:  # return any blocks a failed stream-in took
-                    self._paged_free_row(slot_idx)
-                raise
-            return slot_idx, first_token, n, lora_slot, lp_info
         first_token, k, v, lp_info = self._bucket_prefill(req, n, lora_slot)
         # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
         self._insert_prompt_kv(k, v, slot_idx, n)
@@ -789,44 +824,129 @@ class Engine:
             jnp.int32(n),
         )
 
-    def _chunked_prefill(self, req: Request, slot_idx: int, lora_slot: int):
-        """Stream a long prompt through the cache lane chunk by chunk.
+    # ------------------------------------------------------------------
+    # interleaved long-prompt streaming (one chunk per engine cycle)
+    # ------------------------------------------------------------------
 
-        One chunk-sized compiled program regardless of prompt length; pads in
-        the final chunk scatter past the true prompt end (see
-        transformer.prefill_with_cache).  Returns the first sampled token
-        (device scalar).
+    def _start_stream(self, req: Request) -> bool:
+        """Reserve a free lane and begin streaming a long prompt into it.
+
+        The lane is held out of ``_free_slot_index`` (not a live slot, so
+        decode steps skip it) and receives one chunk per ``_stream_step``.
+        Returns False only when the request was reparked for backpressure
+        (caller must stop admitting this cycle).
         """
+        if req.cancelled.is_set():
+            self._finish(req, "cancelled")
+            return True
+        try:
+            slot_idx = self._free_slot_index()
+            lora_slot = (self.lora.slot_for(req.adapter)
+                         if self.lora is not None else -1)
+        except Exception as e:
+            logger.exception("stream admission failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+            return True
+        self._reserved_slots.add(slot_idx)
+        if self.paged:
+            # Allocate the WHOLE prompt's blocks now, atomically with the
+            # _paged_can_admit gate the caller just passed (same engine
+            # cycle, single thread): interleaved short-request admissions
+            # and decode growth between chunks can no longer drain the pool
+            # out from under a stream mid-flight.
+            try:
+                self._paged_ensure(slot_idx, len(req.prompt_tokens))
+                self._sync_tables()
+            except PagedPoolExhausted:
+                # Defensive (the gate should prevent this): repark as the
+                # head-of-line pending request and retry when blocks free.
+                self._paged_free_row(slot_idx)
+                self._reserved_slots.discard(slot_idx)
+                self._pending = req
+                return False
+        self._stream = _ChunkStream(request=req, slot_idx=slot_idx,
+                                    lora_slot=lora_slot)
+        return True
+
+    def _abort_stream(self, reason: str) -> None:
+        st = self._stream
+        self._stream = None
+        self._reserved_slots.discard(st.slot_idx)
+        if self.paged:
+            self._paged_free_row(st.slot_idx)
+        self._finish(st.request, reason)
+
+    def _stream_step(self, pipelined: bool) -> None:
+        """Dispatch ONE chunk of the in-flight stream; on the final chunk,
+        sample the first token and activate the lane as a live decode slot."""
+        st = self._stream
+        req = st.request
+        if req.cancelled.is_set():
+            self._abort_stream("cancelled")
+            return
         chunk = self._max_bucket()
         prompt = req.prompt_tokens
         n = len(prompt)
-        sp = req.sampling
-        last_logits = None
-        for start in range(0, n, chunk):
-            if req.cancelled.is_set():
-                # Long-prompt client died mid-stream-in: stop dispatching
-                # chunk programs; the lane's partial KV is overwritten on
-                # reuse.
-                raise _PrefillCancelled()
-            piece = prompt[start:start + chunk]
-            c = len(piece)
-            tokens = np.zeros((chunk,), np.int32)
-            tokens[:c] = piece
-            positions = start + np.arange(chunk, dtype=np.int32)
+        start = st.next_start
+        piece = prompt[start:start + chunk]
+        c = len(piece)
+        tokens = np.zeros((chunk,), np.int32)
+        tokens[:c] = piece
+        positions = start + np.arange(chunk, dtype=np.int32)
+        try:
             if self.paged:
-                self._paged_ensure(slot_idx, start + c)
+                self._paged_ensure(st.slot_idx, start + c)
                 self._sync_tables()
-            last_logits, self.cache = self._jit_chunk(
+            st.last_logits, self.cache = self._jit_chunk(
                 self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.int32(slot_idx), jnp.int32(start + c), jnp.int32(c - 1),
-                lora_bufs=self._lora_buffers(), lora_slot=jnp.int32(lora_slot),
+                jnp.int32(st.slot_idx), jnp.int32(start + c), jnp.int32(c - 1),
+                lora_bufs=self._lora_buffers(),
+                lora_slot=jnp.int32(st.lora_slot),
             )
-        return self._jit_sample_one(
-            last_logits, self._next_key(),
-            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-            jnp.float32(sp.top_p),
-        )
+        except Exception as e:  # engine must survive a poison request
+            logger.exception("chunk stream failed for %s", req.request_id)
+            req.error = str(e)
+            self._abort_stream("error")
+            return
+        st.next_start = start + c
+        if st.next_start < n:
+            return  # more chunks; the loop decodes before the next one
+        # Final chunk: first token, then slot activation.
+        self._stream = None
+        self._reserved_slots.discard(st.slot_idx)
+        slot_idx = st.slot_idx
+        sp = req.sampling
+        try:
+            first_token, lp_info = self._jit_sample_one(
+                st.last_logits, self._next_key(),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p),
+            )
+            if pipelined:
+                try:
+                    first_token.copy_to_host_async()
+                except AttributeError:
+                    pass
+                self._activate_slot_pipelined(
+                    slot_idx, req, st.lora_slot, n, first_token, lp_info)
+                return
+            if self._emit_first_token(req, int(first_token), lp_info):
+                if self.paged:  # finished at prefill; free the lane's blocks
+                    self._paged_free_row(slot_idx)
+                return
+            self._register_slot(
+                slot_idx, _Slot(request=req, lora_slot=st.lora_slot,
+                                position=n))
+            self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
+            self._slot_positions[slot_idx] = n
+        except Exception as e:
+            logger.exception("stream activation failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+            if self.paged and self.slots[slot_idx] is None:
+                self._paged_free_row(slot_idx)
 
     def _register_slot(self, slot_idx: int, slot: _Slot) -> None:
         sp = slot.request.sampling
@@ -890,8 +1010,6 @@ class Engine:
             registered = True
             self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
             self._slot_positions[slot_idx] = n
-        except _PrefillCancelled:
-            self._finish(req, "cancelled")
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill failed for %s", req.request_id)
             req.error = str(e)
@@ -1010,6 +1128,9 @@ class Engine:
         inflight: dict | None = None
         while self._running:
             did_work = self._admit_and_insert(pipelined=True)
+            if self._stream is not None:
+                self._stream_step(pipelined=True)
+                did_work = True
             block = None
             if any(s is not None for s in self.slots):
                 try:
@@ -1058,28 +1179,17 @@ class Engine:
         try:
             slot_idx, first_token, n, lora_slot, lp_info = (
                 self._prefill_common(req))
-            # A queued budget-zero for this lane belongs to the PREVIOUS
-            # occupant — drop it or it would freeze the new request.
-            self._pending_budget_zero = [
-                i for i in self._pending_budget_zero if i != slot_idx
-            ]
-            self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
-            self._dev_positions = self._dev_positions.at[slot_idx].set(n)
-            self._dev_remaining = self._dev_remaining.at[slot_idx].set(
-                max(0, req.max_new_tokens - 1)
-            )
             try:
                 first_token.copy_to_host_async()
             except AttributeError:
                 pass
             # t_first_token is stamped when the token MATERIALIZES in
             # _process_block — stamping here would understate TTFT by a block.
-            slot = _Slot(request=req, lora_slot=lora_slot, position=n)
-            slot.pending_first = (first_token, lp_info)
-            self._register_slot(slot_idx, slot)
+            # (_activate_slot_pipelined also drops any queued budget-zero
+            # belonging to this lane's PREVIOUS occupant.)
+            self._activate_slot_pipelined(
+                slot_idx, req, lora_slot, n, first_token, lp_info)
             registered = True
-        except _PrefillCancelled:
-            self._finish(req, "cancelled")
         except Exception as e:
             logger.exception("pipelined prefill failed for %s", req.request_id)
             req.error = str(e)

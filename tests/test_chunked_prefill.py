@@ -112,3 +112,42 @@ def test_cancel_during_chunked_prefill_stops_chunks():
         assert req.output_tokens == []
     finally:
         engine.stop()
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_stream_interleaves_with_decode(pipeline):
+    """While a long prompt streams in chunk-by-chunk, an already-active
+    request must keep producing tokens (round 1 ran the whole chunked
+    prefill inside one admission, stalling every active slot)."""
+    params = transformer.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = Engine(
+        CFG, params,
+        EngineConfig(decode_slots=2, max_seq_len=256, prefill_buckets=(8,),
+                     decode_steps_per_sync=1, pipeline_decode=pipeline),
+        eos_id=None, dtype=jnp.float32,
+    )
+    engine.start()
+    try:
+        a = Request(prompt_tokens=[1, 2, 3], max_new_tokens=200)
+        engine.submit(a)
+        # Wait until A is actively decoding.
+        for _ in range(600):
+            if len(a.output_tokens) >= 2:
+                break
+            a.stream_event.wait(0.1)
+            a.stream_event.clear()
+        assert len(a.output_tokens) >= 2
+
+        a_before = len(a.output_tokens)
+        b = Request(prompt_tokens=list(range(1, 161)), max_new_tokens=4)
+        engine.submit(b)  # 160 tokens / 8-token chunks = 20 stream steps
+        assert b.done.wait(120) and b.error is None
+        a_during = len(a.output_tokens) - a_before
+        # One decode block runs between consecutive chunks: A must have
+        # advanced roughly one token per chunk (>= 10 allows scheduling
+        # slack); the blocking design yielded ~0.
+        assert a_during >= 10, f"A advanced only {a_during} during stream"
+        a.cancelled.set()
+        assert a.done.wait(60)
+    finally:
+        engine.stop()

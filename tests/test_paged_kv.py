@@ -198,3 +198,35 @@ class TestPagedPool:
             assert len(ok) == 6
         finally:
             tight.stop()
+
+
+class TestStreamReservation:
+    def test_stream_holds_blocks_against_competitors(self, params):
+        """A long-prompt stream allocates its WHOLE prompt's blocks at
+        admission: short requests admitted between chunks must not drain
+        the pool out from under it (the stream must never fail with
+        'kv pool exhausted' after passing admission)."""
+        engine = make_engine(params, paged=True, n_blocks=16, slots=2)
+        # Pool: 16 blocks x 8 tokens = 128 tokens.  Stream prompt: 40
+        # tokens (5 blocks) across 5 chunks of the 8-token bucket.
+        engine.start()
+        try:
+            long_req = Request(prompt_tokens=list(range(1, 41)),
+                               max_new_tokens=4,
+                               sampling=SamplingParams(temperature=0.0))
+            engine.submit(long_req)
+            shorts = []
+            for i in range(6):
+                r = Request(prompt_tokens=[3 + i, 5, 7],
+                            max_new_tokens=6,
+                            sampling=SamplingParams(temperature=0.0))
+                shorts.append(r)
+                engine.submit(r)
+            assert long_req.done.wait(120)
+            assert long_req.error is None, long_req.error
+            assert len(long_req.output_tokens) == 4
+            for r in shorts:
+                assert r.done.wait(120)
+                assert r.error is None, r.error
+        finally:
+            engine.stop()
