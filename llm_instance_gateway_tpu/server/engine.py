@@ -258,6 +258,22 @@ class Engine:
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(
                 self.cache, sharding_lib.cache_specs(model_cfg, mesh), mesh)
+        # Ring-attention prefill (parallel/long_context.py): with a
+        # sequence axis in the mesh, prompts beyond the largest bucket run
+        # as ONE sequence-parallel program over the ring instead of
+        # chunk-streaming through the cache lane — each device holds S/n of
+        # the activations, so the prompt budget scales with the mesh.
+        self._ring = None
+        if (mesh is not None and mesh.shape.get("sequence", 1) > 1
+                and not self.paged):
+            from llm_instance_gateway_tpu.parallel import long_context
+
+            self._ring = long_context.make_sharded_prefill(model_cfg, mesh)
+            # Pad ring prompts to this multiple: big enough to bound the
+            # number of compiled shapes (like prefill buckets), aligned to
+            # 8*seq_shards so every device's block is sublane-aligned.
+            self._ring_pad = max(8 * mesh.shape["sequence"],
+                                 max(self.cfg.prefill_buckets))
         self.slots: list[_Slot | None] = [None] * b
         self._slot_tokens = np.zeros((b,), np.int32)
         self._slot_positions = np.zeros((b,), np.int32)
@@ -653,7 +669,8 @@ class Engine:
                     break
                 if not self._paged_can_admit(len(req.prompt_tokens)):
                     break  # pool backpressure: wait for block frees
-                if len(req.prompt_tokens) > self._max_bucket():
+                if (len(req.prompt_tokens) > self._max_bucket()
+                        and not self._ring_usable(len(req.prompt_tokens))):
                     if self._stream is not None:
                         break  # one stream at a time; FIFO head waits
                     self._pending = None
@@ -770,17 +787,58 @@ class Engine:
             self._finish(req, "error")
 
     def _prefill_common(self, req: Request):
-        """Shared admission path: bucketed prefill + insert.  Long prompts
-        never reach here — ``_admit_and_insert`` diverts them to the
-        interleaved chunk stream (``_start_stream``/``_stream_step``).
+        """Shared admission path: bucketed (or ring sequence-parallel)
+        prefill + insert.  Long prompts only reach here when ``_ring_usable``
+        — otherwise ``_admit_and_insert`` diverts them to the interleaved
+        chunk stream (``_start_stream``/``_stream_step``).
         Returns (slot_idx, first_token_device, n, lora_slot, lp_info)."""
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
-        first_token, k, v, lp_info = self._bucket_prefill(req, n, lora_slot)
+        if n > self._max_bucket():
+            first_token, k, v, lp_info = self._ring_prefill(req, n, lora_slot)
+        else:
+            first_token, k, v, lp_info = self._bucket_prefill(req, n, lora_slot)
         # Insert prompt KV (trim to bucket; cache rows are max_seq_len).
         self._insert_prompt_kv(k, v, slot_idx, n)
         return slot_idx, first_token, n, lora_slot, lp_info
+
+    def _ring_usable(self, n: int) -> bool:
+        """True when the sequence-parallel prefill path can take this prompt."""
+        if self._ring is None:
+            return False
+        padded = -(-n // self._ring_pad) * self._ring_pad
+        return padded <= self.cfg.max_seq_len
+
+    def _ring_prefill(self, req: Request, n: int, lora_slot: int):
+        """One sequence-parallel prefill program over the mesh ring.
+
+        The prompt pads right to a multiple of ``_ring_pad`` (a bounded set
+        of compiled shapes, like buckets); pad rows sit after the real
+        tokens, so causal ring attention keeps real positions exact and the
+        garbage tail is trimmed by the length-``n`` insert.
+        """
+        from llm_instance_gateway_tpu.parallel import long_context
+
+        sp = req.sampling
+        padded = -(-n // self._ring_pad) * self._ring_pad
+        tokens = np.zeros((1, padded), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        positions = np.broadcast_to(
+            np.arange(padded, dtype=np.int32), (1, padded))
+        tok_d, pos_d = long_context.shard_inputs(
+            self.mesh, jnp.asarray(tokens), jnp.asarray(positions))
+        logits, k, v = self._ring(
+            self.params, tok_d, pos_d,
+            lora_bufs=self._lora_buffers(),
+            slot_ids=jnp.full((1,), lora_slot, jnp.int32),
+        )
+        first_token, lp_info = self._jit_sample_one(
+            logits[0, n - 1], self._next_key(),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p),
+        )
+        return first_token, k, v, lp_info
 
     def _bucket_prefill(self, req: Request, n: int, lora_slot: int):
         """Pad a bucketable prompt and run the jitted prefill.

@@ -49,3 +49,47 @@ def test_sharded_prefill_with_tensor_parallel_too():
     np.testing.assert_allclose(
         np.asarray(ref_logits), np.asarray(logits), rtol=5e-4, atol=5e-4
     )
+
+
+def test_engine_ring_prefill_serving_path():
+    """A long prompt on an engine whose mesh has a sequence axis runs ONE
+    ring-attention prefill program (not the chunk stream) and produces the
+    same greedy continuation as a single-device engine with a covering
+    bucket."""
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request,
+    )
+
+    cfg = TINY_TEST
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    prompt = list(np.random.RandomState(3).randint(1, 250, size=40))
+
+    big = Engine(
+        cfg, params,
+        EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(64,)),
+        eos_id=None, dtype=jnp.float32,
+    )
+    big.start()
+    try:
+        want = big.generate(Request(prompt_tokens=prompt, max_new_tokens=6),
+                            timeout_s=120).output_tokens
+    finally:
+        big.stop()
+
+    mesh = make_mesh(MeshConfig(data=1, tensor=4, sequence=2))
+    ring = Engine(
+        cfg, params,
+        EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(8, 16)),
+        eos_id=None, dtype=jnp.float32, mesh=mesh,
+    )
+    assert ring._ring is not None
+    assert ring._ring_usable(len(prompt))
+    ring.start()
+    try:
+        got = ring.generate(Request(prompt_tokens=prompt, max_new_tokens=6),
+                            timeout_s=240)
+    finally:
+        ring.stop()
+    assert got.error is None, got.error
+    assert got.output_tokens == want
