@@ -699,6 +699,12 @@ def main(argv=None) -> None:
              "oversubscribes HBM for short-sequence traffic",
     )
     parser.add_argument(
+        "--prefix-cache", action="store_true",
+        help="retain finished prompts' full KV blocks (content-addressed, "
+             "refcounted) so prompts sharing a prefix skip recomputing it; "
+             "requires --paged-kv-block",
+    )
+    parser.add_argument(
         "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
         help="serve sharded over a device mesh, e.g. 'tensor=8' on a v5e-8 "
              "pool or 'data=2,tensor=4'; axes: data,fsdp,tensor,expert,"
@@ -709,6 +715,8 @@ def main(argv=None) -> None:
 
     if args.paged_kv_blocks is not None and args.paged_kv_block is None:
         parser.error("--paged-kv-blocks requires --paged-kv-block")
+    if args.prefix_cache and args.paged_kv_block is None:
+        parser.error("--prefix-cache requires --paged-kv-block")
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -769,6 +777,7 @@ def main(argv=None) -> None:
             pipeline_decode=args.pipeline_decode,
             paged_kv_block=args.paged_kv_block,
             paged_kv_blocks=args.paged_kv_blocks,
+            prefix_cache=args.prefix_cache,
         ),
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,

@@ -102,6 +102,15 @@ class EngineConfig:
     # KV threshold at/below 0.8 to stay clear of it).
     paged_kv_block: int | None = None
     paged_kv_blocks: int | None = None
+    # Prefix caching (paged mode only): full prompt blocks are
+    # content-addressed (chained hashes, vLLM-style) and retained with
+    # refcounts after a request finishes; a later prompt sharing the prefix
+    # maps the cached blocks into its table and prefills only the suffix.
+    # Reuse applies on the chunk-stream path (prompts beyond the largest
+    # bucket — where the shared-system-prompt win lives); zero-ref cached
+    # blocks are evicted LRU when the pool needs space, so enabling this
+    # costs nothing but the hashing.
+    prefix_cache: bool = False
 
 
 @dataclass
@@ -228,7 +237,17 @@ class Engine:
             self._tables_host = np.zeros(
                 (b, self._max_blocks_per_seq), np.int32)
             self._tables_dirty = False
+            # Prefix cache state: chain-hash -> block, block -> (hash, refs),
+            # plus an LRU of zero-ref cached blocks (evicted on demand).
+            self._prefix_enabled = self.cfg.prefix_cache
+            self._prefix_table: dict[int, int] = {}
+            self._block_hash: dict[int, int] = {}
+            self._block_refs: dict[int, int] = {}
+            self._evictable: "collections.OrderedDict[int, int]" = (
+                collections.OrderedDict())
+            self.prefix_reused_tokens = 0
         else:
+            self._prefix_enabled = False
             self.cache = transformer.init_decode_cache(
                 model_cfg, b, self.cfg.max_seq_len, dtype=dtype
             )
@@ -502,8 +521,11 @@ class Engine:
         active = sum(1 for s in self.slots if s is not None)
         if self.paged:
             # vLLM gpu_cache_usage_perc semantics: allocated / total blocks.
+            # Zero-ref cached prefix blocks are reclaimable on demand, so
+            # they count as free for routing pressure.
             capacity = self._n_blocks * self._block
-            used_tokens = (self._n_blocks - len(self._free_blocks)) * self._block
+            used_tokens = (self._n_blocks - len(self._free_blocks)
+                           - len(self._evictable)) * self._block
         else:
             used_tokens = sum(
                 (s.position if s is not None else 0) for s in self.slots
@@ -555,9 +577,37 @@ class Engine:
     def _paged_needed(self, upto_len: int) -> int:
         return min(-(-upto_len // self._block), self._max_blocks_per_seq)
 
-    def _paged_can_admit(self, n_prompt: int) -> bool:
-        return (not self.paged
-                or self._paged_needed(n_prompt + 1) <= len(self._free_blocks))
+    def _paged_can_admit(self, n_prompt: int,
+                         prompt: list[int] | None = None,
+                         adapter: str | None = None) -> bool:
+        """Capacity gate.  With ``prompt`` given, cached-prefix blocks that
+        would map at zero cost are subtracted from the need — otherwise a
+        shared system prompt held by a live request would spuriously
+        backpressure the very workload prefix caching targets."""
+        if not self.paged:
+            return True
+        avail = len(self._free_blocks) + (
+            len(self._evictable) if self._prefix_enabled else 0)
+        needed = self._paged_needed(n_prompt + 1)
+        if prompt is not None:
+            needed -= min(self._prefix_match_len(prompt, adapter), needed)
+        return needed <= avail
+
+    def _paged_alloc_block(self) -> int:
+        """One free physical block, evicting the LRU zero-ref cached block
+        if the free list is dry.  Raises ``PagedPoolExhausted``."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        if self._prefix_enabled and self._evictable:
+            blk, h = self._evictable.popitem(last=False)  # LRU
+            self._prefix_table.pop(h, None)
+            self._block_hash.pop(blk, None)
+            self._block_refs.pop(blk, None)
+            return blk
+        raise PagedPoolExhausted(
+            f"kv pool exhausted: {self._n_blocks} blocks of "
+            f"{self._block} tokens all allocated"
+        )
 
     def _paged_ensure(self, row: int, upto_len: int) -> None:
         """Grow ``row``'s table to cover positions < upto_len.
@@ -568,12 +618,7 @@ class Engine:
         blocks = self._row_blocks[row]
         needed = self._paged_needed(upto_len)
         while len(blocks) < needed:
-            if not self._free_blocks:
-                raise PagedPoolExhausted(
-                    f"kv pool exhausted: {self._n_blocks} blocks of "
-                    f"{self._block} tokens all allocated"
-                )
-            blk = self._free_blocks.pop()
+            blk = self._paged_alloc_block()
             blocks.append(blk)
             self._tables_host[row, len(blocks) - 1] = blk
             self._tables_dirty = True
@@ -581,10 +626,99 @@ class Engine:
     def _paged_free_row(self, row: int) -> None:
         blocks = self._row_blocks[row]
         if blocks:
-            self._free_blocks.extend(blocks)
+            for blk in blocks:
+                h = self._block_hash.get(blk)
+                if h is None:
+                    self._free_blocks.append(blk)
+                else:
+                    # Cached prefix block: drop this row's reference; at
+                    # zero it parks in the evictable LRU (content kept).
+                    self._block_refs[blk] -= 1
+                    if self._block_refs[blk] == 0:
+                        self._evictable[blk] = h  # fresh key -> MRU end
             self._row_blocks[row] = []
             self._tables_host[row, :] = paged_lib.TRASH_BLOCK
             self._tables_dirty = True
+
+    # -- prefix cache (content-addressed full prompt blocks, vLLM-style) --
+
+    def _prefix_hashes(self, prompt: list[int], max_blocks: int,
+                       adapter: str | None) -> list[bytes]:
+        """Chained SHA-256 digests of the first ``max_blocks`` full prompt
+        blocks.  The chain is seeded with the LoRA adapter identity — KV
+        depends on the adapter's wk/wv deltas, so the same tokens under a
+        different adapter are DIFFERENT content.  Cryptographic hashing,
+        not ``hash()``: Python's tuple hash is adversarially collidable,
+        and a collision here maps another prompt's KV into this request
+        (the vLLM CVE-2025-25183 failure mode)."""
+        import hashlib
+
+        bs = self._block
+        h = hashlib.sha256(repr(adapter).encode()).digest()
+        out = []
+        for i in range(max_blocks):
+            h = hashlib.sha256(
+                h + np.asarray(prompt[i * bs:(i + 1) * bs],
+                               np.int64).tobytes()
+            ).digest()
+            out.append(h)
+        return out
+
+    def _prefix_match_len(self, prompt: list[int],
+                          adapter: str | None) -> int:
+        """Dry-run of the hash walk: how many BLOCKS would map (no incref)."""
+        if not self._prefix_enabled:
+            return 0
+        n = 0
+        for h in self._prefix_hashes(
+                prompt, (len(prompt) - 1) // self._block, adapter):
+            if h not in self._prefix_table:
+                break
+            n += 1
+        return n
+
+    def _prefix_match_and_map(self, row: int, prompt: list[int],
+                              adapter: str | None) -> int:
+        """Map the longest cached prefix into ``row``'s table (increfs).
+        Returns the number of reused TOKENS (multiple of the block size).
+        At least the prompt's last token always recomputes, so the request
+        still produces fresh logits."""
+        if not self._prefix_enabled:
+            return 0
+        max_blocks = (len(prompt) - 1) // self._block
+        blocks = self._row_blocks[row]
+        assert not blocks, "prefix map must precede suffix allocation"
+        for h in self._prefix_hashes(prompt, max_blocks, adapter):
+            blk = self._prefix_table.get(h)
+            if blk is None:
+                break
+            self._block_refs[blk] += 1
+            self._evictable.pop(blk, None)  # in use again
+            blocks.append(blk)
+            self._tables_host[row, len(blocks) - 1] = blk
+            self._tables_dirty = True
+        reused = len(blocks) * self._block
+        self.prefix_reused_tokens += reused
+        return reused
+
+    def _prefix_register_row(self, row: int, prompt: list[int],
+                             adapter: str | None) -> None:
+        """After a prompt is fully in the row's blocks, publish its full
+        blocks to the prefix table so later prompts can share them."""
+        if not self._prefix_enabled:
+            return
+        max_blocks = (len(prompt) - 1) // self._block
+        blocks = self._row_blocks[row]
+        for i, h in enumerate(
+                self._prefix_hashes(prompt, max_blocks, adapter)):
+            blk = blocks[i]
+            if self._block_hash.get(blk) is not None:
+                continue  # already a cached block (mapped via reuse)
+            if h in self._prefix_table:
+                continue  # another live block already serves this content
+            self._block_hash[blk] = h
+            self._prefix_table[h] = blk
+            self._block_refs[blk] = 1
 
     def _sync_tables(self) -> None:
         """Push host-side table changes to the device copy in the cache."""
@@ -667,7 +801,8 @@ class Engine:
                     # backpressure): strict FIFO — don't let a newer request
                     # steal the blocks it is waiting for.
                     break
-                if not self._paged_can_admit(len(req.prompt_tokens)):
+                if not self._paged_can_admit(len(req.prompt_tokens),
+                                              req.prompt_tokens, req.adapter):
                     break  # pool backpressure: wait for block frees
                 if (len(req.prompt_tokens) > self._max_bucket()
                         and not self._ring_usable(len(req.prompt_tokens))):
@@ -907,6 +1042,7 @@ class Engine:
             self._finish(req, "error")
             return True
         self._reserved_slots.add(slot_idx)
+        reused = 0
         if self.paged:
             # Allocate the WHOLE prompt's blocks now, atomically with the
             # _paged_can_admit gate the caller just passed (same engine
@@ -914,6 +1050,10 @@ class Engine:
             # and decode growth between chunks can no longer drain the pool
             # out from under a stream mid-flight.
             try:
+                # Cached-prefix blocks map in first (refcounted, zero
+                # compute); only the suffix gets fresh blocks and chunks.
+                reused = self._prefix_match_and_map(
+                    slot_idx, req.prompt_tokens, req.adapter)
                 self._paged_ensure(slot_idx, len(req.prompt_tokens))
                 self._sync_tables()
             except PagedPoolExhausted:
@@ -924,7 +1064,7 @@ class Engine:
                 self._pending = req
                 return False
         self._stream = _ChunkStream(request=req, slot_idx=slot_idx,
-                                    lora_slot=lora_slot)
+                                    lora_slot=lora_slot, next_start=reused)
         return True
 
     def _abort_stream(self, reason: str) -> None:
@@ -971,7 +1111,10 @@ class Engine:
         st.next_start = start + c
         if st.next_start < n:
             return  # more chunks; the loop decodes before the next one
-        # Final chunk: first token, then slot activation.
+        # Final chunk: publish the prompt's full blocks for prefix reuse,
+        # sample the first token, then activate the lane as a live slot.
+        if self.paged:
+            self._prefix_register_row(st.slot_idx, prompt, req.adapter)
         self._stream = None
         self._reserved_slots.discard(st.slot_idx)
         slot_idx = st.slot_idx
