@@ -11,6 +11,8 @@ Routing policies:
 - ``random``      uniform over replicas (reference loadbalancer 'random')
 - ``least_queue`` min prefill backlog    (reference 'leastPseudo')
 - ``least_kv``    min KV utilization     (reference 'least')
+- ``least_latency`` min estimated latency/token from live queue state and
+  the calibrated latency model (reference 'leastlatency')
 - ``production``  the REAL filter tree (gateway.scheduling.Scheduler) over
   live simulated metrics — criticality tiers, LoRA affinity, shedding; what
   the deployed gateway actually does (reference 'smart', minus drift).
@@ -109,6 +111,26 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0,
         return lambda req: min(servers, key=lambda s: len(s.prefill_queue) + len(s.active))
     if policy == "least_kv":
         return lambda req: min(servers, key=lambda s: -s.kv_free())
+    if policy == "least_latency":
+        # Reference 'leastlatency' (loadbalancer.py:34-85): estimate each
+        # pod's expected latency/token for a NEW request from its live queue
+        # state and the calibrated latency model, route to the minimum.
+        def est(s: SimServer, prompt_tokens: int) -> float:
+            lm = s.latency
+            batch = len(s.active) + 1
+            kv = sum(a.kv_tokens for a in s.active) + prompt_tokens
+            step = (lm.decode_base_s + lm.decode_per_kv_token_s * kv
+                    + lm.decode_per_seq_s * batch)
+            # Prefill backlog ahead of this request delays its first token
+            # and steals decode cycles from the batch it joins.
+            backlog = sum(
+                max(lm.prefill_min_s,
+                    lm.prefill_base_s + lm.prefill_per_token_s * r.prompt_tokens)
+                for r in s.prefill_queue)
+            return step + backlog / max(batch, 1)
+
+        return lambda req: min(
+            servers, key=lambda s: est(s, req.prompt_tokens))
     if policy == "production":
         kwargs = {} if scheduler_cfg is None else {"cfg": scheduler_cfg}
         scheduler = Scheduler(_SimProvider(servers),

@@ -95,3 +95,21 @@ class TestSimulate:
         rand = simulate("random", cfg, n_servers=3, decode_slots=8)
         prod = simulate("production", cfg, n_servers=3, decode_slots=8)
         assert prod.summary()["ttft_p99_s"] <= rand.summary()["ttft_p99_s"] * 1.05
+
+
+def test_least_latency_policy_prefers_idle_server():
+    from llm_instance_gateway_tpu.sim.core import SimServer, V5E_DEFAULT
+    from llm_instance_gateway_tpu.sim.run import make_router
+    from llm_instance_gateway_tpu.sim.core import SimRequest
+
+    busy = SimServer("busy", V5E_DEFAULT)
+    idle = SimServer("idle", V5E_DEFAULT)
+    # Load the busy server with queued prefills and active sequences.
+    for i in range(4):
+        busy.prefill_queue.append(
+            SimRequest(rid=i, arrival_s=0.0, prompt_tokens=400,
+                       output_tokens=100, model="base"))
+    router = make_router("least_latency", [busy, idle])
+    req = SimRequest(rid=99, arrival_s=0.0, prompt_tokens=200,
+                     output_tokens=50, model="base")
+    assert router(req) is idle
