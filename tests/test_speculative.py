@@ -1,0 +1,168 @@
+"""Speculative decoding: extend_step parity + engine greedy equivalence."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import TINY_TEST
+
+CFG = TINY_TEST
+
+
+def test_extend_step_matches_sequential_decode_steps():
+    params = transformer.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    b, s_max, c = 3, 32, 4
+    rng = np.random.RandomState(0)
+
+    # Prime each lane with a short prompt via prefill+insert.
+    cache = transformer.init_decode_cache(CFG, b, s_max, dtype=jnp.float32)
+    starts = [5, 3, 7]
+    for row, n in enumerate(starts):
+        prompt = jnp.asarray([rng.randint(1, 250, size=n)], jnp.int32)
+        pos = jnp.arange(n)[None]
+        _, k, v = transformer.prefill(CFG, params, prompt, pos)
+        cache = transformer.insert_prefill(cache, k, v, row, n)
+
+    tokens = jnp.asarray(rng.randint(1, 250, size=(b, c)), jnp.int32)
+    positions = jnp.asarray([[st + i for i in range(c)] for st in starts],
+                            jnp.int32)
+
+    # Reference: c sequential single-token decode steps.
+    ref_cache = jax.tree.map(lambda x: x, cache)
+    ref_logits = []
+    for i in range(c):
+        lg, ref_cache = transformer.decode_step(
+            CFG, params, ref_cache, tokens[:, i], positions[:, i])
+        ref_logits.append(lg)
+    ref_logits = jnp.stack(ref_logits, axis=1)  # [B, C, V]
+
+    got_logits, got_cache = transformer.extend_step(
+        CFG, params, cache, tokens, positions)
+
+    np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_cache["k"]),
+                               np.asarray(ref_cache["k"]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_cache["v"]),
+                               np.asarray(ref_cache["v"]), rtol=2e-4, atol=2e-4)
+
+
+def _tiny_draft():
+    # A smaller model sharing the token space (vocab) with TINY_TEST.
+    return dataclasses.replace(
+        CFG, name="tiny-draft", d_model=32, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=64, head_dim=16,
+    )
+
+
+def make_engines(spec_k, draft_like_target=False, slots=3):
+    from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+    params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    dcfg = CFG if draft_like_target else _tiny_draft()
+    dparams = (params if draft_like_target
+               else transformer.init_params(dcfg, jax.random.PRNGKey(7),
+                                            dtype=jnp.float32))
+    ecfg = dict(decode_slots=slots, max_seq_len=96, prefill_buckets=(8, 16))
+    plain = Engine(CFG, params, EngineConfig(**ecfg), eos_id=None,
+                   dtype=jnp.float32)
+    spec = Engine(CFG, params, EngineConfig(**ecfg, speculative_k=spec_k),
+                  eos_id=None, dtype=jnp.float32,
+                  draft_params=dparams, draft_cfg=dcfg)
+    return plain, spec
+
+
+def run_reqs(engine, prompts, max_new=12, temps=None):
+    from llm_instance_gateway_tpu.server.engine import Request, SamplingParams
+
+    reqs = []
+    engine.start()
+    try:
+        for i, p in enumerate(prompts):
+            t = 0.0 if temps is None else temps[i]
+            r = Request(prompt_tokens=list(p), max_new_tokens=max_new,
+                        sampling=SamplingParams(temperature=t))
+            reqs.append(r)
+            engine.submit(r)
+        for r in reqs:
+            assert r.done.wait(180)
+            assert r.error is None, r.error
+    finally:
+        engine.stop()
+    return reqs
+
+
+class TestSpeculativeEngine:
+    def test_greedy_parity_with_small_draft(self):
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9, 14)]
+        plain, spec = make_engines(spec_k=3)
+        want = [r.output_tokens for r in run_reqs(plain, prompts)]
+        got_reqs = run_reqs(spec, prompts)
+        got = [r.output_tokens for r in got_reqs]
+        assert got == want
+        assert spec.spec_cycles > 0
+
+    def test_perfect_draft_accepts_full_blocks(self):
+        """Draft == target: every proposal accepted, so emitted tokens per
+        cycle approach K+1."""
+        rng = np.random.RandomState(1)
+        prompts = [list(rng.randint(1, 250, size=6))]
+        plain, spec = make_engines(spec_k=3, draft_like_target=True, slots=1)
+        want = [r.output_tokens for r in run_reqs(plain, prompts, max_new=16)]
+        got = [r.output_tokens for r in run_reqs(spec, prompts, max_new=16)]
+        assert got == want
+        # Prefill emits token 1; the remaining 15 arrive in
+        # ~ceil(15/(K+1)) = 4 speculative cycles (+ slack for scheduling).
+        assert spec.spec_cycles <= 6, spec.spec_cycles
+        assert spec.spec_emitted == 15
+
+    def test_mixed_temperature_batch(self):
+        """Sampled rows coexist with greedy rows: greedy rows keep exact
+        parity; sampled rows complete with the requested token count."""
+        rng = np.random.RandomState(2)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (6, 7, 8)]
+        plain, spec = make_engines(spec_k=3)
+        want = [r.output_tokens for r in
+                run_reqs(plain, prompts, temps=[0.0, 0.0, 0.0])]
+        got_reqs = run_reqs(spec, prompts, temps=[0.0, 0.9, 0.0])
+        assert got_reqs[0].output_tokens == want[0]
+        assert got_reqs[2].output_tokens == want[2]
+        assert len(got_reqs[1].output_tokens) == 12
+
+    def test_logprobs_recorded_through_spec_path(self):
+        from llm_instance_gateway_tpu.server.engine import Request, SamplingParams
+
+        rng = np.random.RandomState(3)
+        _, spec = make_engines(spec_k=2, slots=1)
+        spec.start()
+        try:
+            r = Request(prompt_tokens=list(rng.randint(1, 250, size=6)),
+                        max_new_tokens=8,
+                        sampling=SamplingParams(temperature=0.0), logprobs=2)
+            spec.submit(r)
+            assert r.done.wait(180) and r.error is None
+        finally:
+            spec.stop()
+        assert len(r.output_logprobs) == 8
+        assert len(r.output_top_logprobs) == 8
+        assert all(len(d) == 2 for d in r.output_top_logprobs)
+
+    def test_config_validation(self):
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        with pytest.raises(ValueError, match="draft_params"):
+            Engine(CFG, params, EngineConfig(speculative_k=2),
+                   eos_id=None, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="sync loop"):
+            Engine(CFG, params,
+                   EngineConfig(speculative_k=2, pipeline_decode=True),
+                   eos_id=None, dtype=jnp.float32,
+                   draft_params=params, draft_cfg=CFG)
