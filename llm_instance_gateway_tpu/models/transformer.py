@@ -350,6 +350,80 @@ def decode_step(
     return logits, new_cache
 
 
+def extend_step(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,           # init_decode_cache layout
+    tokens: jax.Array,       # [B, C] int32 — C new tokens per slot
+    positions: jax.Array,    # [B, C] int32 — absolute positions of each
+    lora_bufs: Params | None = None,
+    slot_ids: jax.Array | None = None,
+):
+    """Multi-token cached decode: process C new tokens per slot in ONE
+    forward (the speculative-decoding verify/catch-up primitive — decode is
+    HBM-weight-bound, so scoring C tokens costs barely more than one).
+
+    Each row's tokens scatter into its own cache lane at ``positions`` and
+    attend to every cached position <= their own — causal within the new
+    tokens and over the lane's history.  Rows are independent; garbage rows
+    (frozen slots) decode garbage into their own lane exactly like
+    ``decode_step``.  Returns (logits [B, C, V] f32, new cache) — logits[i]
+    is the next-token distribution AFTER tokens[:, i].
+    """
+    b, c = tokens.shape
+    hd = cfg.resolved_head_dim
+    s_max = cache["k"].shape[2]
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    h = params["embed"][tokens]  # [B, C, D]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    batch_idx = jnp.arange(b)[:, None]  # [B, 1] broadcast over C
+
+    def layer_fn(h, xs):
+        lp, ll, k_cache, v_cache = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
+            b, c, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(
+            b, c, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(
+            b, c, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        k_cache = k_cache.at[batch_idx, positions].set(k)
+        v_cache = v_cache.at[batch_idx, positions].set(v)
+        # [B,C,K,G,hd] x [B,S,K,hd] -> [B,K,G,C,S]; mask j <= position_i.
+        qg = q.reshape(b, c, cfg.n_kv_heads, cfg.q_per_kv, hd)
+        logits = jnp.einsum(
+            "bikgh,bjkh->bkgij", qg, k_cache,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = jnp.arange(s_max)[None, None, :] <= positions[:, :, None]
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("bkgij,bjkh->bikgh", probs, v_cache).reshape(b, c, -1)
+        h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_cache, v_cache)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = q_matmul(h, head).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new,
+                 "length": positions[:, -1] + 1}
+    return logits, new_cache
+
+
 def prefill_with_cache(
     cfg: ModelConfig,
     params: Params,

@@ -699,6 +699,22 @@ def main(argv=None) -> None:
              "oversubscribes HBM for short-sequence traffic",
     )
     parser.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="speculative decoding: a draft model proposes K tokens per "
+             "cycle, the target verifies them in one multi-token forward; "
+             "greedy requests keep exact parity.  Requires --draft-model",
+    )
+    parser.add_argument(
+        "--draft-model", default=None, choices=sorted(all_configs),
+        help="model preset for the speculative draft (must share the "
+             "target's tokenizer/vocab), e.g. gemma-2b under gemma-7b",
+    )
+    parser.add_argument(
+        "--draft-checkpoint", default=None,
+        help="Orbax params dir for the draft model (random weights "
+             "otherwise — dev mode)",
+    )
+    parser.add_argument(
         "--prefix-cache", action="store_true",
         help="retain finished prompts' full KV blocks (content-addressed, "
              "refcounted) so prompts sharing a prefix skip recomputing it; "
@@ -717,6 +733,8 @@ def main(argv=None) -> None:
         parser.error("--paged-kv-blocks requires --paged-kv-block")
     if args.prefix_cache and args.paged_kv_block is None:
         parser.error("--prefix-cache requires --paged-kv-block")
+    if args.speculative > 0 and args.draft_model is None:
+        parser.error("--speculative requires --draft-model")
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -768,6 +786,25 @@ def main(argv=None) -> None:
         mesh = make_mesh(MeshConfig(**axes))
         logger.info("serving sharded over mesh %s", dict(mesh.shape))
 
+    draft_params = draft_cfg = None
+    if args.speculative > 0:
+        draft_cfg = all_configs[args.draft_model]
+        if args.draft_checkpoint:
+            from llm_instance_gateway_tpu.models.convert import (
+                load_serving_checkpoint,
+            )
+
+            dc, draft_params = load_serving_checkpoint(args.draft_checkpoint)
+            if dc is not None:
+                draft_cfg = dc
+        else:
+            logger.warning("no --draft-checkpoint: draft uses RANDOM "
+                           "weights (dev mode — proposals rarely accepted)")
+            draft_params = transformer.init_params(
+                draft_cfg, jax.random.PRNGKey(1), dtype=dtype)
+        if args.quantize == "int8":
+            draft_params = quantize_params(draft_params)
+
     lora_manager = LoRAManager(cfg, dtype=dtype, mesh=mesh)
     engine = Engine(
         cfg, params,
@@ -778,11 +815,14 @@ def main(argv=None) -> None:
             paged_kv_block=args.paged_kv_block,
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,
+            speculative_k=args.speculative,
         ),
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,
         dtype=dtype,
         mesh=mesh,
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
     )
     engine.start()
     server = ModelServer(engine, tokenizer, served_name, lora_manager,

@@ -102,6 +102,16 @@ class EngineConfig:
     # KV threshold at/below 0.8 to stay clear of it).
     paged_kv_block: int | None = None
     paged_kv_blocks: int | None = None
+    # Speculative decoding: a small DRAFT model proposes this many tokens
+    # per cycle; the target model verifies them in ONE multi-token forward
+    # (extend_step) — decode is HBM-weight-bound, so scoring K+1 tokens
+    # costs barely more than one step, and accepted prefixes multiply
+    # tokens/step.  Greedy rows (temperature 0) accept the longest matching
+    # prefix — EXACT greedy parity with non-speculative decoding; sampled
+    # rows fall back to one verified token per cycle.  Requires
+    # ``draft_params``/``draft_cfg`` at Engine construction; v1 supports the
+    # sync loop with the contiguous-lane cache (no paged/pipelined/mesh).
+    speculative_k: int = 0
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -208,6 +218,8 @@ class Engine:
         dtype=jnp.bfloat16,
         seed: int = 0,
         mesh=None,
+        draft_params=None,
+        draft_cfg: ModelConfig | None = None,
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
@@ -215,6 +227,23 @@ class Engine:
         self.lora = lora_manager
         self.eos_id = eos_id
         self._rng = jax.random.PRNGKey(seed)
+
+        self._spec = self.cfg.speculative_k > 0
+        if self._spec:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "speculative_k > 0 requires draft_params and draft_cfg")
+            if (self.cfg.paged_kv_block is not None
+                    or self.cfg.pipeline_decode or mesh is not None):
+                raise ValueError(
+                    "speculative decoding v1 supports the sync loop with "
+                    "the contiguous-lane cache (no paged/pipelined/mesh)")
+            if draft_cfg.vocab_size != model_cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share the token space "
+                    f"({draft_cfg.vocab_size} != {model_cfg.vocab_size})")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
 
         b = self.cfg.decode_slots
         self.paged = self.cfg.paged_kv_block is not None
@@ -363,6 +392,31 @@ class Engine:
             return tok[0], (lp[0], top_v[0], top_i[0])
 
         self._jit_sample_one = jax.jit(_sample_one)
+
+        if self._spec:
+            self.draft_cache = transformer.init_decode_cache(
+                draft_cfg, b, self.cfg.max_seq_len, dtype=dtype)
+            self._spec_ok = np.zeros((b,), bool)
+            # (token, position) the draft hasn't ingested yet — only set
+            # after a FULLY-accepted cycle (d_K's kv is missing then).
+            self._spec_extra: list[tuple[int, int] | None] = [None] * b
+            self.spec_cycles = 0
+            self.spec_emitted = 0
+
+            def _draft_prefill(params, tokens, positions):
+                _, k, v = transformer.prefill(draft_cfg, params, tokens,
+                                              positions)
+                return k, v
+
+            self._jit_draft_prefill = jax.jit(_draft_prefill)
+            self._jit_draft_insert = jax.jit(
+                transformer.insert_prefill, donate_argnames=("cache",))
+            self._jit_draft_propose = jax.jit(
+                functools.partial(self._draft_propose_impl, draft_cfg),
+                donate_argnames=("cache",), static_argnames=("k_steps",))
+            self._jit_verify = jax.jit(
+                functools.partial(self._verify_impl, model_cfg),
+                donate_argnames=("cache",))
 
     # ------------------------------------------------------------------
     # jitted compute
@@ -552,6 +606,14 @@ class Engine:
             "decode_tokens_per_sec": tps,
             "running_lora_adapters": running_adapters,
             "max_lora": max_lora,
+            **({
+                "spec_cycles": self.spec_cycles,
+                # Accepted tokens per verify cycle vs the K+1 ceiling: THE
+                # health signal for draft quality.
+                "spec_tokens_per_cycle": round(
+                    self.spec_emitted / self.spec_cycles, 3)
+                if self.spec_cycles else 0.0,
+            } if self._spec else {}),
         }
 
     # ------------------------------------------------------------------
@@ -567,6 +629,9 @@ class Engine:
     def _clear_slot(self, i: int) -> None:
         """Release a decode slot row (and, when paged, its pool blocks)."""
         self.slots[i] = None
+        if self._spec:
+            self._spec_ok[i] = False
+            self._spec_extra[i] = None
         self._slot_lora[i] = -1
         self._slot_remaining[i] = 0
         if self.paged:
@@ -760,7 +825,17 @@ class Engine:
             # 2) One fused decode block for all active slots.
             if any(s is not None for s in self.slots):
                 try:
-                    self._do_decode_step()
+                    if self._spec and any(
+                        s is not None and self._spec_ok[i]
+                        and self._slot_temp[i] <= 0.0
+                        for i, s in enumerate(self.slots)
+                    ):
+                        self._do_spec_step()
+                    else:
+                        # No row can accept proposals (all sampled or
+                        # stream-admitted): speculation would only add the
+                        # draft+verify overhead per token.
+                        self._do_decode_step()
                 except Exception as e:  # engine must survive; fail the batch
                     logger.exception("decode step failed")
                     self._fail_all_slots(e)
@@ -916,10 +991,205 @@ class Engine:
                     request=req, lora_slot=w.lora_slot, position=w.n))
                 self._slot_tokens[slot_idx] = w.first_token_host
                 self._slot_positions[slot_idx] = w.n
+                self._draft_admit(slot_idx, req.prompt_tokens)
         except Exception as e:
             logger.exception("decode-wait insert failed for %s", req.request_id)
             req.error = str(e)
             self._finish(req, "error")
+
+    # ------------------------------------------------------------------
+    # speculative decoding (draft proposes, target verifies in one pass)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _draft_propose_impl(cfg, params, cache, ctx_tokens, ctx_positions,
+                            ctx_len, k_steps: int):
+        """Ingest <=2 context tokens the draft hasn't seen, then propose
+        ``k_steps`` greedy tokens autoregressively.  Returns
+        (draft [B, k_steps] int32, new cache)."""
+        b = ctx_tokens.shape[0]
+
+        def greedy_pick(lg):
+            # Mask the zero-logit vocab-PADDING columns (lm_head pads to a
+            # multiple of 128) or argmax can emit ids the tokenizer lacks.
+            masked = jnp.where(
+                jnp.arange(lg.shape[-1]) < cfg.vocab_size, lg, -jnp.inf)
+            return jnp.argmax(masked, axis=-1).astype(jnp.int32)
+
+        logits2, cache = transformer.extend_step(
+            cfg, params, cache, ctx_tokens, ctx_positions)
+        idx = ctx_len - 1  # last REAL ctx index per row
+        last = logits2[jnp.arange(b), idx]  # [B, V]
+        cur_pos = ctx_positions[jnp.arange(b), idx]
+        d1 = greedy_pick(last)
+
+        def body(carry, _):
+            tok, pos, cache = carry
+            lg, cache = transformer.decode_step(cfg, params, cache, tok, pos)
+            nxt = greedy_pick(lg)
+            return (nxt, pos + 1, cache), nxt
+
+        if k_steps > 1:
+            (_, _, cache), rest = jax.lax.scan(
+                body, (d1, cur_pos + 1, cache), None, length=k_steps - 1)
+            draft = jnp.concatenate([d1[None], rest], axis=0).T  # [B, K]
+        else:
+            draft = d1[:, None]
+        return draft, cache
+
+    @staticmethod
+    def _verify_impl(cfg, params, lora_bufs, cache, cur_tokens, draft,
+                     positions, spec_ok, temp, topk, topp, key, slot_ids):
+        """Score [cur, d_1..d_K] in one multi-token forward; greedy rows
+        accept the longest matching prefix plus the target's bonus token,
+        sampled rows emit one token from the first position's logits.
+        Returns (emitted [B,K+1], count [B], lp, top_v, top_i, cache)."""
+        b = cur_tokens.shape[0]
+        k = draft.shape[1]
+        s_max = cache["k"].shape[2]
+        tokens = jnp.concatenate([cur_tokens[:, None], draft], axis=1)
+        pos = positions[:, None] + jnp.arange(k + 1)[None]
+        # Clamp like decode: overflow rows finish on the host's max_seq
+        # check; the clamped scatter writes garbage the mask hides.
+        pos = jnp.minimum(pos, s_max - 1)
+        logits, cache = transformer.extend_step(
+            cfg, params, cache, tokens, pos,
+            lora_bufs=lora_bufs, slot_ids=slot_ids)
+        masked = jnp.where(
+            jnp.arange(logits.shape[-1]) < cfg.vocab_size, logits, -jnp.inf)
+        greedy = jnp.argmax(masked, axis=-1).astype(jnp.int32)  # [B, K+1]
+        first_sampled = sample(
+            logits[:, 0], key, temp, topk, topp,
+            valid_vocab=cfg.vocab_size)
+        greedy_row = spec_ok & (temp <= 0.0)
+        e0 = jnp.where(greedy_row, greedy[:, 0], first_sampled)
+        # d_{i+1} must equal the target's greedy continuation g_i.
+        match = (draft == greedy[:, :-1]) & greedy_row[:, None]
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        count = jnp.where(greedy_row, m + 1, 1)
+        emitted = greedy.at[:, 0].set(e0)
+        lp, top_v, top_i = _logprob_info(logits, emitted, cfg.vocab_size)
+        return emitted, count, lp, top_v, top_i, cache
+
+    def _draft_admit(self, slot_idx: int, prompt_tokens: list[int]) -> None:
+        """Mirror a freshly admitted prompt into the draft model's lane so
+        the slot can speculate.  Rows admitted through paths the draft
+        can't mirror (chunk stream, ring) simply don't speculate."""
+        if not self._spec:
+            return
+        n = len(prompt_tokens)
+        if n > self._max_bucket() or self._slot_temp[slot_idx] > 0.0:
+            # Sampled rows never accept proposals — mirroring their prompt
+            # into the draft would be a wasted prefill per admission.
+            self._spec_ok[slot_idx] = False
+            return
+        try:
+            bucket = self._bucket(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = prompt_tokens
+            positions = np.zeros((1, bucket), np.int32)
+            positions[0, :n] = np.arange(n)
+            k, v = self._jit_draft_prefill(
+                self.draft_params, jnp.asarray(tokens), jnp.asarray(positions))
+            self.draft_cache = self._jit_draft_insert(
+                self.draft_cache, k, v, jnp.int32(slot_idx), jnp.int32(n))
+            self._spec_ok[slot_idx] = True
+            self._spec_extra[slot_idx] = None
+        except Exception:
+            logger.exception("draft admit failed; slot %d decodes "
+                             "non-speculatively", slot_idx)
+            self._spec_ok[slot_idx] = False
+
+    def _do_spec_step(self) -> None:
+        """One speculative cycle: draft proposes K, target verifies K+1."""
+        b = self.cfg.decode_slots
+        k = self.cfg.speculative_k
+        ctx_tokens = np.zeros((b, 2), np.int32)
+        ctx_positions = np.zeros((b, 2), np.int32)
+        ctx_len = np.ones((b,), np.int32)
+        s_max = self.cfg.max_seq_len
+        for i in range(b):
+            tok = int(self._slot_tokens[i])
+            pos = int(self._slot_positions[i])
+            extra = self._spec_extra[i] if self._spec_ok[i] else None
+            if extra is not None:
+                ctx_tokens[i] = (extra[0], tok)
+                ctx_positions[i] = (min(extra[1], s_max - 1),
+                                    min(pos, s_max - 1))
+                ctx_len[i] = 2
+            else:
+                ctx_tokens[i, 0] = tok
+                ctx_positions[i] = (min(pos, s_max - 1),
+                                    min(pos + 1, s_max - 1))
+        t0 = time.perf_counter()
+        draft, self.draft_cache = self._jit_draft_propose(
+            self.draft_params, self.draft_cache,
+            jnp.asarray(ctx_tokens), jnp.asarray(ctx_positions),
+            jnp.asarray(ctx_len), k_steps=k)
+        (emitted, count, lps, top_v, top_i, self.cache) = self._jit_verify(
+            self.params, self._lora_buffers(), self.cache,
+            jnp.asarray(self._slot_tokens), draft,
+            jnp.asarray(self._slot_positions),
+            jnp.asarray(self._spec_ok),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp), self._next_key(),
+            jnp.asarray(self._slot_lora),
+        )
+        emitted_np = np.asarray(emitted)
+        count_np = np.asarray(count)
+        draft_np = np.asarray(draft)
+        lps_np = np.asarray(lps)
+        top_v_np = np.asarray(top_v)
+        top_i_np = np.asarray(top_i)
+        step_s = time.perf_counter() - t0
+        n_tokens = 0
+        self.spec_cycles += 1
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            req = slot.request
+            if req.cancelled.is_set():
+                self._finish(req, "cancelled")
+                self._clear_slot(i)
+                continue
+            cnt = int(count_np[i])
+            start_pos = int(self._slot_positions[i])
+            finished = False
+            used = 0
+            for j in range(cnt):
+                tok = int(emitted_np[i, j])
+                req.output_tokens.append(tok)
+                self._store_logprobs(req, lps_np[i, j], top_v_np[i, j],
+                                     top_i_np[i, j])
+                n_tokens += 1
+                used += 1
+                slot.position += 1
+                self._slot_tokens[i] = tok
+                self._slot_remaining[i] = max(0, self._slot_remaining[i] - 1)
+                if (self._is_finished(req, tok)
+                        or slot.position >= self.cfg.max_seq_len - 1):
+                    self._finish(req, "stop" if self._is_stop(req, tok)
+                                 else "length")
+                    self._clear_slot(i)
+                    finished = True
+                    break
+            req.stream_event.set()
+            if finished:
+                continue
+            self._slot_positions[i] = slot.position
+            # Draft bookkeeping: its own accepted proposals' KV are already
+            # in its lane; only a FULLY accepted cycle leaves d_K missing.
+            if self._spec_ok[i] and used == cnt and cnt == k + 1:
+                self._spec_extra[i] = (int(draft_np[i, k - 1]),
+                                       start_pos + k)
+            else:
+                self._spec_extra[i] = None
+        self.spec_emitted += n_tokens
+        with self._lock:
+            self.total_generated += n_tokens
+            inst = n_tokens / step_s if step_s > 0 else 0.0
+            a = self.cfg.tps_ema_alpha
+            self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
 
     def _prefill_common(self, req: Request):
         """Shared admission path: bucketed (or ring sequence-parallel)
@@ -1211,6 +1481,7 @@ class Engine:
             registered = True
             self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
             self._slot_positions[slot_idx] = n
+            self._draft_admit(slot_idx, req.prompt_tokens)
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill failed for %s", req.request_id)
             req.error = str(e)
