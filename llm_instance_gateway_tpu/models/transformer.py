@@ -224,6 +224,47 @@ def _moe_grouped(cfg: ModelConfig, lp: Params, x):
 # ---------------------------------------------------------------------------
 
 
+def prefill_layer(
+    cfg: ModelConfig,
+    lp: Params,              # one layer's params (leaves without the L dim)
+    h: jax.Array,            # [B, S, D]
+    positions: jax.Array,    # [B, S] int32
+    layer_lora: Params | None = None,
+    slot_ids: jax.Array | None = None,  # [B] int32, -1 = base model
+    attention_fn=None,
+):
+    """One decoder block over a full sequence.  Returns (h, (k, v)).
+
+    The single source of truth for the prefill block: ``prefill`` scans it
+    over the stacked layer params, and ``parallel.pipeline`` scans each
+    stage's slice of the stack inside the pipelined schedule.
+    """
+    b, s, _ = h.shape
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    hd = cfg.resolved_head_dim
+    q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, s, cfg.n_heads, hd)
+    k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+    v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+    if attention_fn is not None:
+        attn = attention_fn(q, k, v, positions)
+    elif cfg.use_flash_attention:
+        # Right-padded batches: causal tiling alone keeps real positions
+        # exact (pallas_attention.flash_attention docstring).
+        from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention
+
+        attn = flash_attention(q, k, v)
+    else:
+        attn = prefill_attention(q, k, v, positions)
+    h = h + _project(attn.reshape(b, s, -1), lp["wo"], layer_lora, "o", slot_ids)
+    hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+    return h, (k, v)
+
+
 def prefill(
     cfg: ModelConfig,
     params: Params,
@@ -253,27 +294,10 @@ def prefill(
     def layer_fn(h, xs):
         lp, ll = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
-        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        hd = cfg.resolved_head_dim
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, s, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        if attention_fn is not None:
-            attn = attention_fn(q, k, v, positions)
-        elif cfg.use_flash_attention:
-            # Right-padded batches: causal tiling alone keeps real positions
-            # exact (pallas_attention.flash_attention docstring).
-            from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention
-
-            attn = flash_attention(q, k, v)
-        else:
-            attn = prefill_attention(q, k, v, positions)
-        h = h + _project(attn.reshape(b, s, -1), lp["wo"], layer_lora, "o", slot_ids)
-        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k, v)
+        return prefill_layer(
+            cfg, lp, h, positions, layer_lora=layer_lora, slot_ids=slot_ids,
+            attention_fn=attention_fn,
+        )
 
     xs = (params["layers"], per_layer_lora)
     h, (k_all, v_all) = jax.lax.scan(layer_fn, h, xs)

@@ -4,13 +4,15 @@ Axis vocabulary (the scaling-book convention, sized per pool topology):
 
 - ``data``     request/batch data parallelism (maps across slices/DCN)
 - ``fsdp``     parameter sharding for training / large models (ICI)
+- ``pipe``     pipeline parallelism over layer-stack stages (DCN-tolerant:
+               one activation transfer per microbatch per step)
 - ``tensor``   tensor parallelism inside a layer: heads / ffn columns (ICI)
 - ``expert``   MoE expert parallelism (Mixtral pools)
 - ``sequence`` context parallelism for long sequences (ring attention, ICI)
 
 Axes of size 1 cost nothing — every jitted function is written against the
-full five-axis mesh, and a v5e-8 pool simply instantiates e.g.
-``{"data": 1, "fsdp": 1, "tensor": 8, "expert": 1, "sequence": 1}``.
+full six-axis mesh, and a v5e-8 pool simply instantiates e.g.
+``{"data": 1, "fsdp": 1, "pipe": 1, "tensor": 8, "expert": 1, "sequence": 1}``.
 
 Multi-host: ``initialize_distributed()`` wires ``jax.distributed`` from env
 vars (GKE TPU pod env or explicit addresses), after which ``make_mesh`` sees
@@ -32,20 +34,22 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger(__name__)
 
-AXES = ("data", "fsdp", "tensor", "expert", "sequence")
+AXES = ("data", "fsdp", "pipe", "tensor", "expert", "sequence")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     data: int = 1
     fsdp: int = 1
+    pipe: int = 1
     tensor: int = 1
     expert: int = 1
     sequence: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.tensor, self.expert, self.sequence)
+        return (self.data, self.fsdp, self.pipe, self.tensor, self.expert,
+                self.sequence)
 
     @property
     def total(self) -> int:

@@ -1,0 +1,186 @@
+"""SPMD pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference scales out by adding vLLM replicas behind the gateway; models
+that outgrow one replica's memory are out of scope there.  Here the model
+server owns the chips, so when a model outgrows ``tensor``+``fsdp`` on one
+ICI domain the layer stack itself must span domains — pipeline parallelism
+(SURVEY.md §2.5 maps this to the pp axis of the serving mesh).
+
+TPU-first formulation — a *collective* pipeline, not a multi-controller one:
+
+- The stacked layer params ``[L, ...]`` are reshaped to ``[pp, L/pp, ...]``
+  and sharded ``P("pipe", ...)``: stage ``i``'s slice lives on the devices
+  whose ``pipe`` coordinate is ``i``.
+- The batch is split into M microbatches.  A rotation buffer of shape
+  ``[pp, mb, S, D]`` (axis 0 sharded over ``pipe``) holds the activation
+  each stage is working on.  One ``lax.scan`` step = every stage applies
+  its L/pp layers to its slot (a ``vmap`` over the stage axis that XLA
+  partitions across ``pipe``), then the buffer rotates one stage forward —
+  ``jnp.roll`` on a pipe-sharded axis lowers to a single
+  ``collective-permute`` riding ICI/DCN.
+- GPipe schedule: microbatch j enters at step j, exits at step j + pp - 1;
+  total steps M + pp - 1, bubble fraction (pp-1)/(M+pp-1).
+
+Everything is one jitted program: XLA sees the whole schedule, overlaps the
+permute with the next stage's compute, and the backward pass falls out of
+differentiating the scan — no hand-written send/recv state machine, which
+is how a CUDA framework would build this.
+
+The per-layer math is ``transformer.prefill_layer`` — the same block the
+non-pipelined forward scans, so parity is structural, not re-implemented.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+from llm_instance_gateway_tpu.ops.layers import rms_norm
+from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
+
+Params = dict[str, Any]
+
+
+def stage_params(cfg: ModelConfig, params: Params, pipe: int) -> Params:
+    """Reshape stacked layer leaves [L, ...] -> [pp, L/pp, ...].
+
+    Stage i holds layers [i*L/pp, (i+1)*L/pp) — contiguous assignment, the
+    standard pipeline layout.  Non-layer params (embed, final_norm, lm_head)
+    pass through; they run outside the pipelined region.
+    """
+    if cfg.n_layers % pipe != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={pipe}")
+    per = cfg.n_layers // pipe
+    staged = jax.tree.map(
+        lambda x: x.reshape((pipe, per) + x.shape[1:]), params["layers"])
+    return {**params, "layers": staged}
+
+
+def stage_param_specs(cfg: ModelConfig, base_specs: dict) -> dict:
+    """PartitionSpecs for the staged layout: prepend ``pipe`` on the stage
+    axis of every layer leaf (the L axis of ``sharding.param_specs`` is
+    unsharded, so the staged spec is P("pipe", None, *rest))."""
+    staged = jax.tree.map(
+        lambda s: P("pipe", *s), base_specs["layers"],
+        is_leaf=lambda s: isinstance(s, P))
+    return {**base_specs, "layers": staged}
+
+
+def pipeline_forward(
+    cfg: ModelConfig,
+    params: Params,          # staged: layers leaves [pp, L/pp, ...]
+    tokens: jax.Array,       # [B, S] int32
+    positions: jax.Array,    # [B, S] int32
+    pipe: int,
+    n_microbatches: int,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Pipelined full-prompt forward.  Returns logits [B, S, V] f32.
+
+    B must divide into ``n_microbatches`` equal microbatches; with
+    ``pipe == 1`` this degenerates to the plain layer scan (one stage, no
+    rotation) and matches ``transformer.prefill`` logits exactly.
+    """
+    b, s = tokens.shape
+    m = n_microbatches
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible by n_microbatches={m}")
+    mb = b // m
+
+    h = params["embed"][tokens]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+    d = h.shape[-1]
+
+    # Microbatch stream, padded with pp-1 drain steps.
+    h_mb = h.reshape(m, mb, s, d)
+    pos_mb = positions.reshape(m, mb, s)
+    pad_h = jnp.zeros((pipe - 1, mb, s, d), h.dtype)
+    pad_pos = jnp.zeros((pipe - 1, mb, s), positions.dtype)
+    xs_h = jnp.concatenate([h_mb, pad_h], axis=0)
+    xs_pos = jnp.concatenate([pos_mb, pad_pos], axis=0)
+
+    def stage_apply(stage_layers, h_one, pos_one):
+        def body(h_c, lp):
+            h_c, _ = transformer.prefill_layer(cfg, lp, h_c, pos_one)
+            return h_c, None
+
+        out, _ = jax.lax.scan(body, h_one, stage_layers)
+        return out
+
+    if mesh is None:
+        pin = lambda x: x
+    else:
+        pin = lambda x: jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(
+                mesh, P("pipe", "data", *([None] * (x.ndim - 2)))))
+
+    def step(carry, xs):
+        buf_h, buf_pos = carry
+        in_h, in_pos = xs
+        # Fresh microbatch enters stage 0; stages 1..pp-1 keep what the
+        # rotation delivered last step.
+        buf_h = pin(buf_h.at[0].set(in_h))
+        buf_pos = buf_pos.at[0].set(in_pos)
+        out = pin(jax.vmap(stage_apply)(params["layers"], buf_h, buf_pos))
+        # Microbatch finishing the last stage exits this step.
+        y = (out[pipe - 1], buf_pos[pipe - 1])
+        # Rotate stage i -> i+1 (a collective-permute over ``pipe``); the
+        # wrapped-around slot 0 is dead and overwritten next step.
+        buf_h = pin(jnp.roll(out, 1, axis=0))
+        buf_pos = jnp.roll(buf_pos, 1, axis=0)
+        return (buf_h, buf_pos), y
+
+    buf0 = (
+        pin(jnp.zeros((pipe, mb, s, d), h.dtype)),
+        jnp.zeros((pipe, mb, s), positions.dtype),
+    )
+    _, (ys_h, _) = jax.lax.scan(step, buf0, (xs_h, xs_pos))
+
+    # Microbatch j exits at step j + pp - 1: drop the pp-1 warm-up outputs.
+    h_out = ys_h[pipe - 1:].reshape(b, s, d)
+
+    h_out = rms_norm(h_out, params["final_norm"], cfg.norm_eps,
+                     plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return q_matmul(h_out, head).astype(jnp.float32)
+
+
+def pipeline_lm_loss(cfg: ModelConfig, params: Params, tokens, positions,
+                     pipe: int, n_microbatches: int,
+                     mesh: Mesh | None = None) -> jax.Array:
+    """``train.causal_lm_loss`` with the pipelined forward plugged in."""
+    from llm_instance_gateway_tpu.training import train
+
+    return train.causal_lm_loss(
+        cfg, params, tokens, positions,
+        logits_fn=lambda p, t, pos: pipeline_forward(
+            cfg, p, t, pos, pipe, n_microbatches, mesh=mesh))
+
+
+def make_pipeline_train_step(cfg: ModelConfig, optimizer, pipe: int,
+                             n_microbatches: int, mesh: Mesh | None = None):
+    """Full-parameter train step over staged params (caller jits + shards).
+
+    Gradients flow through the scanned schedule — XLA derives the 1F1B-ish
+    interleaving from the scan transpose; optimizer state mirrors the staged
+    param tree.
+    """
+
+    def step(params, opt_state, tokens, positions):
+        loss, grads = jax.value_and_grad(
+            lambda p: pipeline_lm_loss(
+                cfg, p, tokens, positions, pipe, n_microbatches, mesh=mesh)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

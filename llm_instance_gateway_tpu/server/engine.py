@@ -302,6 +302,14 @@ class Engine:
         if mesh is not None:
             from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
 
+            if mesh.shape.get("pipe", 1) > 1:
+                # Serving decodes layer-by-layer through one cache; a pipe
+                # axis would only replicate (parallel.pipeline covers the
+                # training/prefill side).  Refuse rather than silently waste
+                # 1/pipe of the pool.
+                raise ValueError(
+                    "serving meshes must have pipe=1; fold those devices "
+                    "into tensor/data instead")
             self.params = sharding_lib.shard_pytree(
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(

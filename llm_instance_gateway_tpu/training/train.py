@@ -26,16 +26,21 @@ from llm_instance_gateway_tpu.models.configs import ModelConfig
 
 
 def causal_lm_loss(cfg: ModelConfig, params, tokens, positions, lora_bufs=None,
-                   slot_ids=None) -> jax.Array:
+                   slot_ids=None, logits_fn=None) -> jax.Array:
     """Next-token cross-entropy, masked to real (non-pad) positions.
 
     Position 0 repeated marks padding (matching the serving convention);
-    the mask keeps pad targets out of the mean.
+    the mask keeps pad targets out of the mean.  ``logits_fn(params,
+    tokens, positions) -> [B, S, V]`` swaps the forward — the pipelined
+    trainer routes through ``parallel.pipeline.pipeline_forward`` while the
+    shift/mask convention stays defined in exactly one place.
     """
-    logits, _, _ = transformer.prefill(
-        cfg, params, tokens[:, :-1], positions[:, :-1],
-        lora_bufs=lora_bufs, slot_ids=slot_ids,
-    )
+    if logits_fn is None:
+        def logits_fn(p, t, pos):
+            return transformer.prefill(
+                cfg, p, t, pos, lora_bufs=lora_bufs, slot_ids=slot_ids)[0]
+
+    logits = logits_fn(params, tokens[:, :-1], positions[:, :-1])
     targets = tokens[:, 1:]
     mask = (positions[:, 1:] > 0).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -23,7 +23,8 @@ def test_virtual_devices_present():
 class TestMesh:
     def test_make_mesh_shapes(self):
         mesh = make_mesh(MeshConfig(tensor=4, data=2))
-        assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 4, "expert": 1, "sequence": 1}
+        assert mesh.shape == {"data": 2, "fsdp": 1, "pipe": 1, "tensor": 4,
+                              "expert": 1, "sequence": 1}
 
     def test_for_devices_default(self):
         cfg = MeshConfig.for_devices(8)
