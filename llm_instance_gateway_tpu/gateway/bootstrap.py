@@ -18,6 +18,10 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from llm_instance_gateway_tpu.gateway.multipool import MultiPoolComponents
 
 import yaml
 
@@ -52,6 +56,7 @@ class GatewayComponents:
     handler_server: Server
     watchers: list = field(default_factory=list)
     pool_reconciler: InferencePoolReconciler | None = None
+    model_reconciler: InferenceModelReconciler | None = None
 
     def start_provider(self, pods_interval_s: float = 10.0,
                        metrics_interval_s: float = 0.05) -> None:
@@ -66,10 +71,33 @@ class GatewayComponents:
             w.stop()
 
 
+def _scope_by_pool(entries: list[str], pool_names: list[str]) -> dict[str, list[str]]:
+    """Split ``pool/value`` entries per pool; unprefixed values go to the
+    first (default) pool — single-pool invocations never need prefixes.
+
+    A prefix that names no pool is a hard error: pod names, DNS hostnames,
+    and service names never legitimately contain ``/``, so a slash always
+    signals scoping intent and a typo'd pool would otherwise bind a
+    foreign backend to the default pool silently.
+    """
+    out: dict[str, list[str]] = {n: [] for n in pool_names}
+    for e in entries:
+        head, sep, rest = e.partition("/")
+        if sep:
+            if head not in out:
+                raise ValueError(
+                    f"membership entry {e!r} scopes to unknown pool "
+                    f"{head!r} (pools: {pool_names})")
+            out[head].append(rest)
+        else:
+            out[pool_names[0]].append(e)
+    return out
+
+
 def build_gateway(
     config_path: str,
     static_pods: list[str] | None = None,
-    discover_dns: str | None = None,
+    discover_dns: str | list[str] | None = None,
     watch_config: bool = False,
     probe_endpoints: bool = False,
     probe_interval_s: float = 5.0,
@@ -80,13 +108,33 @@ def build_gateway(
     kube_service: str = "",
     kube_token_file: str = "",
     kube_ca_file: str = "",
-) -> GatewayComponents:
+) -> "GatewayComponents | MultiPoolComponents":
+    """Build the gateway from a pool/model YAML.
+
+    One InferencePool document -> ``GatewayComponents`` (the reference
+    topology).  Several pools -> ``multipool.MultiPoolComponents``: one
+    process, N independent pool stacks, requests routed per model (membership
+    flags scope per pool with a ``pool/`` prefix; unprefixed entries bind to
+    the first pool).
+    """
     with open(config_path) as f:
         docs = list(yaml.safe_load_all(f))
     pools, models = v1alpha1.from_documents(docs)
     if not pools:
         raise ValueError(f"no InferencePool document in {config_path}")
-    pool_name = pools[0].name
+    pool_names = [p.name for p in pools]
+    if len(pool_names) != len(set(pool_names)):
+        raise ValueError(f"duplicate InferencePool names in {config_path}")
+    # A modelName bound to two pools would route first-wins by iteration
+    # order — reject the ambiguity up front.
+    model_pool: dict[str, str] = {}
+    for m in models:
+        ref = m.spec.pool_ref.name if m.spec.pool_ref else pool_names[0]
+        prev = model_pool.setdefault(m.spec.model_name, ref)
+        if prev != ref:
+            raise ValueError(
+                f"model {m.spec.model_name!r} is bound to two pools "
+                f"({prev!r} and {ref!r}) in {config_path}")
 
     # Resolve the watch namespace FIRST: the reconcilers must be pinned to
     # the namespace the informers actually watch, or every apiserver event
@@ -113,6 +161,99 @@ def build_gateway(
                 kcfg.namespace = kube_namespace
     namespace = kcfg.namespace if kcfg else "default"
 
+    if isinstance(discover_dns, str):
+        discover_dns = [discover_dns] if discover_dns else []
+    scoped_pods = _scope_by_pool(static_pods or [], pool_names)
+    scoped_dns = _scope_by_pool(discover_dns or [], pool_names)
+    scoped_svc = _scope_by_pool(
+        [s for s in kube_service.split(",") if s] if kube_service else [],
+        pool_names)
+
+    multi = len(pool_names) > 1
+    built: dict[str, GatewayComponents] = {}
+    try:
+        for name in pool_names:
+            svc = scoped_svc[name][0] if scoped_svc[name] else ""
+            # An unscoped slice informer would watch EVERY EndpointSlice in
+            # the namespace — in a multi-pool process that cross-pollutes
+            # pool membership with other pools' pods.  Slice membership is
+            # therefore opt-in per pool via a scoped service name.
+            watch_slices = not multi or bool(svc)
+            if multi and kcfg is not None and not svc:
+                logger.warning(
+                    "pool %s: no %s/<service> entry in --kube-service; "
+                    "EndpointSlice membership disabled for this pool "
+                    "(CRD watches stay on)", name, name)
+            built[name] = _build_for_pool(
+                name, pools, models,
+                namespace=namespace,
+                static_pods=scoped_pods[name],
+                discover_dns=scoped_dns[name],
+                probe_endpoints=probe_endpoints,
+                probe_interval_s=probe_interval_s,
+                zone=zone,
+                kcfg=kcfg,
+                kube_service=svc,
+                watch_slices=watch_slices,
+            )
+    except Exception:
+        # A half-built gateway must not leak running refresh loops, probers,
+        # or watch streams from the pools that DID build.
+        for comps in built.values():
+            comps.stop()
+        raise
+
+    if watch_config:
+        # ONE file poller feeds every pool's reconcilers (they self-filter
+        # by pool name) instead of N pollers re-parsing the same file.
+        watcher = ConfigWatcher(
+            config_path,
+            _FanoutReconcilers([c.pool_reconciler for c in built.values()]),
+            _FanoutReconcilers([c.model_reconciler for c in built.values()]),
+        )
+        watcher.start()
+        built[pool_names[0]].watchers.append(watcher)
+
+    if not multi:
+        return built[pool_names[0]]
+    from llm_instance_gateway_tpu.gateway.multipool import MultiPoolComponents
+
+    logger.info("multi-pool gateway: %s (default %s)",
+                pool_names, pool_names[0])
+    return MultiPoolComponents(built, default=pool_names[0])
+
+
+class _FanoutReconcilers:
+    """Broadcast reconcile/resync to per-pool reconcilers (each self-filters
+    by pool name / poolRef, so every pool sees only its own objects)."""
+
+    def __init__(self, reconcilers: list):
+        self._reconcilers = reconcilers
+
+    def reconcile(self, obj, **kwargs):
+        for r in self._reconcilers:
+            r.reconcile(obj, **kwargs)
+
+    def resync(self, objs):
+        for r in self._reconcilers:
+            r.resync(objs)
+
+
+def _build_for_pool(
+    pool_name: str,
+    pools: list,
+    models: list,
+    *,
+    namespace: str,
+    static_pods: list[str],
+    discover_dns: list[str],
+    probe_endpoints: bool,
+    probe_interval_s: float,
+    zone: str,
+    kcfg,
+    kube_service: str,
+    watch_slices: bool = True,
+) -> GatewayComponents:
     datastore = Datastore()
     watchers: list = []
     scheduler_holder: list = []  # filled below; hook needs a forward ref
@@ -150,11 +291,6 @@ def build_gateway(
     ])
     target_port = datastore.get_pool().spec.target_port_number
 
-    if watch_config:
-        watcher = ConfigWatcher(config_path, pool_rec, model_rec)
-        watcher.start()
-        watchers.append(watcher)
-
     endpoints: list[StaticEndpoint] = []
     for spec in static_pods or []:
         name, _, rest = spec.partition("=")
@@ -172,11 +308,11 @@ def build_gateway(
     # be silently skipped.
     endpoints_rec = EndpointsReconciler(datastore, zone=zone)
     aggregator = MembershipAggregator(endpoints_rec)
-    if discover_dns:
+    for i, hostname in enumerate(discover_dns):
         discoverer = DNSDiscoverer(
-            discover_dns, target_port,
+            hostname, target_port,
             probe=probe_endpoints, interval_s=probe_interval_s,
-            publish=aggregator.sink("dns"),
+            publish=aggregator.sink(f"dns{i or ''}"),
         )
         discoverer.start()
         watchers.append(discoverer)
@@ -194,13 +330,13 @@ def build_gateway(
                 [Endpoint(name=ep.name, address=ep.address, ready=True,
                           zone=ep.zone) for ep in endpoints],
             )
-    elif probe_endpoints and not discover_dns and not kube_watch:
+    elif probe_endpoints and not discover_dns and kcfg is None:
         logger.warning(
             "--probe-endpoints set but no --pod/--discover-dns/--kube-watch "
-            "source: membership will stay empty"
+            "source: membership will stay empty (pool %s)", pool_name
         )
 
-    if kube_watch:
+    if kcfg is not None:
         # Apiserver watches on the two CRDs + EndpointSlices — the reference
         # manager's watch set (main.go:81-129).  The YAML config still
         # bootstraps pool identity/thresholds; watch events take over from
@@ -212,7 +348,7 @@ def build_gateway(
 
         source = KubeSource(
             kcfg, pool_rec, model_rec, aggregator.sink("k8s"),
-            service_name=kube_service,
+            service_name=kube_service, watch_slices=watch_slices,
         )
         source.start()
         watchers.append(source)
@@ -245,16 +381,20 @@ def build_gateway(
     return GatewayComponents(
         datastore=datastore, provider=provider, scheduler=scheduler,
         handler_server=handler_server, watchers=watchers,
-        pool_reconciler=pool_rec,
+        pool_reconciler=pool_rec, model_reconciler=model_rec,
     )
 
 
 def add_common_args(parser) -> None:
     parser.add_argument("--config", required=True, help="pool/model YAML")
     parser.add_argument("--pod", action="append", default=[],
-                        help="pod membership name=host[:port][,zone] (repeatable)")
-    parser.add_argument("--discover-dns", default=None, metavar="HOSTNAME",
-                        help="discover pods by resolving a headless Service DNS name")
+                        help="pod membership [pool/]name=host[:port][,zone] "
+                             "(repeatable; pool/ prefix scopes to one pool of "
+                             "a multi-pool config)")
+    parser.add_argument("--discover-dns", action="append", default=[],
+                        metavar="[POOL/]HOSTNAME",
+                        help="discover pods by resolving a headless Service "
+                             "DNS name (repeatable)")
     parser.add_argument("--watch-config", action="store_true",
                         help="hot-reload pool/model config on file change")
     parser.add_argument("--probe-endpoints", action="store_true",
@@ -272,7 +412,8 @@ def add_common_args(parser) -> None:
                              "'default')")
     parser.add_argument("--kube-service", default="",
                         help="kubernetes.io/service-name label for "
-                             "EndpointSlice membership")
+                             "EndpointSlice membership (comma-separated "
+                             "[pool/]svc entries for multi-pool configs)")
     parser.add_argument("--kube-token-file", default="",
                         help="bearer-token file for --kube-api (in-cluster "
                              "config reads the service-account mount)")
@@ -284,7 +425,7 @@ def add_common_args(parser) -> None:
     parser.add_argument("-v", "--verbose", action="count", default=0)
 
 
-def components_from_args(args) -> GatewayComponents:
+def components_from_args(args) -> "GatewayComponents | MultiPoolComponents":
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",

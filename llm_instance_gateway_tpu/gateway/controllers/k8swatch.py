@@ -281,6 +281,7 @@ class KubeSource:
         endpoints_sink,
         service_name: str = "",
         client: KubeClient | None = None,
+        watch_slices: bool = True,
     ):
         self.client = client or KubeClient(config)
         ns = config.namespace
@@ -352,17 +353,20 @@ class KubeSource:
             self.client, f"{GROUP_PATH}/namespaces/{ns}/inferencemodels",
             model_sync, model_event,
         )
-        slice_query = {}
-        if service_name:
-            slice_query["labelSelector"] = (
-                f"kubernetes.io/service-name={service_name}")
-        self.slice_informer = Informer(
-            self.client,
-            f"/apis/discovery.k8s.io/v1/namespaces/{ns}/endpointslices",
-            slices_sync, slice_event, query=slice_query,
-        )
-        self._informers = (
-            self.pool_informer, self.model_informer, self.slice_informer)
+        self.slice_informer = None
+        if watch_slices:
+            slice_query = {}
+            if service_name:
+                slice_query["labelSelector"] = (
+                    f"kubernetes.io/service-name={service_name}")
+            self.slice_informer = Informer(
+                self.client,
+                f"/apis/discovery.k8s.io/v1/namespaces/{ns}/endpointslices",
+                slices_sync, slice_event, query=slice_query,
+            )
+        self._informers = tuple(
+            inf for inf in (self.pool_informer, self.model_informer,
+                            self.slice_informer) if inf is not None)
 
     def _publish(self) -> None:
         with self._slices_lock:

@@ -1,0 +1,174 @@
+"""One EPP process serving several InferencePools.
+
+The reference pins one EPP deployment per InferencePool — ``main.go``'s
+``-serverPoolName`` flag names exactly one pool and every reconciler filters
+to it (``/root/reference/pkg/ext-proc/main.go:33-45``), so an operator with
+many small pools pays a gateway deployment per pool.  Here one process hosts
+N fully independent pool stacks — each pool keeps its own datastore,
+provider refresh loops, scheduler thresholds, admission queues, and
+membership sources, i.e. exactly the single-pool components, unchanged —
+and requests route to a pool by the InferenceModel named in the body
+(InferenceModel.poolRef already binds every model to one pool, so the model
+name is an unambiguous pool selector).
+
+Both transports consume the same duck-typed surface as single-pool
+``GatewayComponents``: ``handler_server`` (phase dispatch), ``datastore`` /
+``provider`` / ``scheduler`` (read-mostly views that fan out or delegate to
+the default pool).  ROADMAP item 14.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    ProcessingMessage,
+    RequestBody,
+)
+from llm_instance_gateway_tpu.gateway.handlers.server import RequestContext
+
+logger = logging.getLogger(__name__)
+
+
+class MultiPoolServer:
+    """Phase dispatcher that pins each request to one pool's handler core.
+
+    The pool is chosen at the RequestBody phase (the first phase that names
+    a model); earlier phases are pool-agnostic and later phases (response
+    headers/body/trailers) replay to the pool the body phase picked, so
+    usage accounting lands in the right pool's context.  Unroutable models
+    fall through to the default pool's handler, which raises the same
+    model-not-found error a single-pool gateway would (handlers/request.py
+    no-passthrough parity).
+    """
+
+    def __init__(self, servers: dict[str, object], datastores: dict[str, object],
+                 default: str):
+        self._servers = servers
+        self._datastores = datastores
+        self._default = default
+
+    @property
+    def target_pod_header(self) -> str:
+        return self._servers[self._default].target_pod_header
+
+    def _route(self, body: bytes):
+        try:
+            model = json.loads(body or b"{}").get("model")
+        except (ValueError, AttributeError):
+            return None  # malformed body: default pool produces the 400
+        if not isinstance(model, str) or not model:
+            return None
+        for name, ds in self._datastores.items():
+            if ds.fetch_model(model) is not None:
+                return name
+        return None
+
+    def process(self, req_ctx: RequestContext, msg: ProcessingMessage):
+        if isinstance(msg, RequestBody):
+            pool = self._route(msg.body)
+            if pool is None:
+                pool = self._default
+            else:
+                logger.debug("request routed to pool %s", pool)
+            req_ctx._pool = pool  # later phases replay to the same pool
+        pool = getattr(req_ctx, "_pool", self._default)
+        return self._servers[pool].process(req_ctx, msg)
+
+
+class _DatastoreView:
+    """Union view over per-pool datastores (transport health/introspection)."""
+
+    def __init__(self, datastores: dict[str, object], default: str):
+        self._datastores = datastores
+        self._default = default
+
+    def has_synced_pool(self) -> bool:
+        return all(ds.has_synced_pool() for ds in self._datastores.values())
+
+    def get_pool(self):
+        return self._datastores[self._default].get_pool()
+
+    def fetch_model(self, model_name: str):
+        for ds in self._datastores.values():
+            m = ds.fetch_model(model_name)
+            if m is not None:
+                return m
+        return None
+
+    def all_models(self) -> list:
+        return [m for ds in self._datastores.values() for m in ds.all_models()]
+
+    def all_pods(self) -> list:
+        return [p for ds in self._datastores.values() for p in ds.all_pods()]
+
+
+class _ProviderView:
+    def __init__(self, providers: dict[str, object]):
+        self._providers = providers
+
+    def get_pod_metrics(self, pod_name: str):
+        for p in self._providers.values():
+            if hasattr(p, "get_pod_metrics"):
+                pm = p.get_pod_metrics(pod_name)
+                if pm is not None:
+                    return pm
+        return None
+
+    def all_pod_metrics(self) -> list:
+        return [pm for p in self._providers.values()
+                for pm in p.all_pod_metrics()]
+
+
+class _SchedulerView:
+    """Fan-out for process-wide knobs; reads delegate to the default pool
+    (per-pool tuning flows through each pool's own document hot-reload)."""
+
+    def __init__(self, schedulers: dict[str, object], default: str):
+        self._schedulers = schedulers
+        self._default = default
+
+    @property
+    def cfg(self):
+        return self._schedulers[self._default].cfg
+
+    def set_park_budget(self, budget: int) -> None:
+        for s in self._schedulers.values():
+            if hasattr(s, "set_park_budget"):
+                s.set_park_budget(budget)
+
+
+class MultiPoolComponents:
+    """Drop-in aggregate of per-pool ``GatewayComponents``."""
+
+    def __init__(self, pools: dict[str, object], default: str):
+        if default not in pools:
+            raise ValueError(f"default pool {default!r} not in {list(pools)}")
+        self.pools = pools
+        self.default_name = default
+        self.handler_server = MultiPoolServer(
+            {n: c.handler_server for n, c in pools.items()},
+            {n: c.datastore for n, c in pools.items()},
+            default,
+        )
+        self.datastore = _DatastoreView(
+            {n: c.datastore for n, c in pools.items()}, default)
+        self.provider = _ProviderView(
+            {n: c.provider for n, c in pools.items()})
+        self.scheduler = _SchedulerView(
+            {n: c.scheduler for n, c in pools.items()}, default)
+
+    @property
+    def pool_reconciler(self):
+        return self.pools[self.default_name].pool_reconciler
+
+    def start_provider(self, pods_interval_s: float = 10.0,
+                       metrics_interval_s: float = 0.05) -> None:
+        for c in self.pools.values():
+            c.start_provider(pods_interval_s=pods_interval_s,
+                             metrics_interval_s=metrics_interval_s)
+
+    def stop(self) -> None:
+        for c in self.pools.values():
+            c.stop()

@@ -1,0 +1,112 @@
+"""Multi-pool end-to-end: ONE gateway process fronting two real pools.
+
+Two model servers (different families — llama3-tiny and gemma-tiny) play two
+InferencePools; the proxy loads a two-pool document with pool-scoped ``--pod``
+membership.  A completion for each model must come back from the pool that
+owns it — the wrong pool's server would 404 the model name, so a 200 with
+generated tokens is proof of routing, not just of liveness.
+"""
+
+import pytest
+
+from tests.test_e2e_local import (
+    _launch_module,
+    _post,
+    _teardown_procs,
+    _wait_http,
+)
+
+pytestmark = pytest.mark.e2e
+
+POOL_A_PORT = 18821
+POOL_B_PORT = 18822
+GATEWAY_PORT = 18830
+
+
+@pytest.fixture(scope="module")
+def multipool_stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e_multipool")
+    config = tmp / "pools.yaml"
+    config.write_text(f"""\
+kind: InferencePool
+metadata: {{name: llama-pool, resourceVersion: "1"}}
+spec: {{selector: {{app: llama}}, targetPortNumber: {POOL_A_PORT}}}
+---
+kind: InferencePool
+metadata: {{name: gemma-pool, resourceVersion: "1"}}
+spec: {{selector: {{app: gemma}}, targetPortNumber: {POOL_B_PORT}}}
+---
+kind: InferenceModel
+metadata: {{name: llama3-tiny}}
+spec: {{modelName: llama3-tiny, criticality: Critical, poolRef: {{name: llama-pool}}}}
+---
+kind: InferenceModel
+metadata: {{name: gemma-tiny}}
+spec: {{modelName: gemma-tiny, criticality: Default, poolRef: {{name: gemma-pool}}}}
+""")
+    procs = []
+
+    def launch(args, log_name):
+        entry = _launch_module(args, tmp / log_name, cwd=str(tmp))
+        procs.append(entry)
+        return entry[0]
+
+    try:
+        for model, port, log in (
+            ("llama3-tiny", POOL_A_PORT, "llama.log"),
+            ("gemma-tiny", POOL_B_PORT, "gemma.log"),
+        ):
+            launch(
+                ["llm_instance_gateway_tpu.server.api_http", "--model", model,
+                 "--platform", "cpu", "--port", str(port), "--decode-slots", "2",
+                 "--max-seq-len", "128", "--dtype", "float32"],
+                log,
+            )
+        for port in (POOL_A_PORT, POOL_B_PORT):
+            _wait_http(f"http://127.0.0.1:{port}/health")
+        launch(
+            ["llm_instance_gateway_tpu.gateway.proxy", "--config", str(config),
+             "--port", str(GATEWAY_PORT),
+             "--pod", f"llama-pool/l1=127.0.0.1:{POOL_A_PORT}",
+             "--pod", f"gemma-pool/g1=127.0.0.1:{POOL_B_PORT}"],
+            "gateway.log",
+        )
+        _wait_http(f"http://127.0.0.1:{GATEWAY_PORT}/healthz")
+        import time
+
+        time.sleep(2.0)  # one provider pod-refresh cycle per pool
+    except Exception:
+        _teardown_procs(procs)
+        raise
+    yield {"tmp": tmp}
+    _teardown_procs(procs)
+
+
+def test_each_model_routes_to_its_pool(multipool_stack):
+    for model in ("llama3-tiny", "gemma-tiny"):
+        status, body = _post(
+            f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions",
+            {"model": model, "prompt": "multi pool", "max_tokens": 4},
+        )
+        assert status == 200, (model, body)
+        assert body["usage"]["completion_tokens"] > 0
+        assert body["model"] == model
+
+
+def test_unknown_model_rejected(multipool_stack):
+    status, _ = _post(
+        f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions",
+        {"model": "no-such-model", "prompt": "x", "max_tokens": 2},
+    )
+    assert status == 400
+
+
+def test_models_endpoint_lists_both_pools(multipool_stack):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{GATEWAY_PORT}/v1/models", timeout=10) as resp:
+        data = json.loads(resp.read())
+    names = {m["id"] for m in data["data"]}
+    assert {"llama3-tiny", "gemma-tiny"} <= names

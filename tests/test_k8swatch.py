@@ -330,3 +330,19 @@ class TestSliceParsing:
         assert eps[0].ready is True  # nil condition = ready (k8s semantics)
         assert eps[0].zone == "us-west4-a"
         assert eps[0].name == "pod-1.2.3.4"
+
+
+class TestWatchSlicesToggle:
+    def test_slice_informer_skipped(self):
+        """watch_slices=False (multi-pool pools without a scoped service)
+        must not open an unscoped EndpointSlice watch."""
+        ds = Datastore()
+        source = KubeSource(
+            KubeConfig(base_url="http://127.0.0.1:1", namespace=NS),
+            InferencePoolReconciler(ds, "tpu-pool", NS),
+            InferenceModelReconciler(ds, "tpu-pool", NS),
+            EndpointsReconciler(ds),
+            watch_slices=False,
+        )
+        assert source.slice_informer is None
+        assert len(source._informers) == 2

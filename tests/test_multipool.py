@@ -1,0 +1,269 @@
+"""Multi-pool gateway: one EPP process, several InferencePools.
+
+The reference runs one EPP per pool (main.go -serverPoolName); multipool.py
+hosts N independent pool stacks and routes requests to a pool by the model
+the body names (InferenceModel.poolRef binds each model to one pool).
+"""
+
+import json
+
+import pytest
+import yaml
+
+from llm_instance_gateway_tpu.gateway import bootstrap
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.messages import (
+    RequestBody,
+    RequestHeaders,
+    ResponseBody,
+)
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    ProcessingError,
+    RequestContext,
+    Server,
+)
+from llm_instance_gateway_tpu.gateway.multipool import (
+    MultiPoolComponents,
+    MultiPoolServer,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.testing import (
+    fake_metrics,
+    generate_request,
+    make_model,
+    static_provider,
+)
+from llm_instance_gateway_tpu.gateway.types import Pod
+
+
+def _pool_stack(pool_tag: str, models: list, n_pods: int = 2):
+    """A minimal single-pool handler stack over static pods/metrics."""
+    pods = {
+        Pod(name=f"{pool_tag}-pod-{i}", address=f"10.0.{ord(pool_tag[-1])}.{i}:8000"):
+            fake_metrics(queue=0, kv=0.1)
+        for i in range(n_pods)
+    }
+    ds = Datastore(pods=list(pods))
+    for m in models:
+        ds.store_model(m)
+    provider = static_provider(pods)
+    server = Server(
+        Scheduler(provider, token_aware=False, prefill_aware=False), ds)
+    return ds, server, set(p.address for p in pods)
+
+
+class TestMultiPoolServer:
+    def setup_method(self):
+        self.ds_a, self.srv_a, self.addrs_a = _pool_stack(
+            "a", [make_model("model-a")])
+        self.ds_b, self.srv_b, self.addrs_b = _pool_stack(
+            "b", [make_model("model-b")])
+        self.mps = MultiPoolServer(
+            {"pool-a": self.srv_a, "pool-b": self.srv_b},
+            {"pool-a": self.ds_a, "pool-b": self.ds_b},
+            default="pool-a",
+        )
+
+    def _body_phase(self, model: str):
+        ctx = RequestContext()
+        self.mps.process(ctx, RequestHeaders())
+        result = self.mps.process(ctx, RequestBody(generate_request(model)))
+        return ctx, result
+
+    def test_routes_to_owning_pool(self):
+        ctx, result = self._body_phase("model-b")
+        assert ctx.target_pod.address in self.addrs_b
+        assert result.set_headers["target-pod"] in self.addrs_b
+
+    def test_default_pool_serves_its_models(self):
+        ctx, _ = self._body_phase("model-a")
+        assert ctx.target_pod.address in self.addrs_a
+
+    def test_unknown_model_maps_to_400(self):
+        with pytest.raises(ProcessingError) as ei:
+            self._body_phase("no-such-model")
+        assert ei.value.status == 400
+
+    def test_malformed_body_maps_to_400(self):
+        ctx = RequestContext()
+        with pytest.raises(ProcessingError) as ei:
+            self.mps.process(ctx, RequestBody(b"{not json"))
+        assert ei.value.status == 400
+
+    def test_response_phases_replay_to_same_pool(self):
+        ctx, _ = self._body_phase("model-b")
+        usage = {"usage": {"prompt_tokens": 7, "completion_tokens": 3,
+                           "total_tokens": 10}}
+        self.mps.process(ctx, ResponseBody(json.dumps(usage).encode()))
+        assert ctx.usage.prompt_tokens == 7
+        assert ctx._pool == "pool-b"
+
+
+TWO_POOL_DOCS = [
+    {
+        "apiVersion": "inference.tpu.x-k8s.io/v1alpha1",
+        "kind": "InferencePool",
+        "metadata": {"name": "pool-a"},
+        "spec": {"selector": {"app": "a"}, "targetPortNumber": 8000},
+    },
+    {
+        "apiVersion": "inference.tpu.x-k8s.io/v1alpha1",
+        "kind": "InferencePool",
+        "metadata": {"name": "pool-b"},
+        "spec": {"selector": {"app": "b"}, "targetPortNumber": 9000,
+                 "schedulerConfig": {"queueThresholdCritical": 11}},
+    },
+    {
+        "apiVersion": "inference.tpu.x-k8s.io/v1alpha1",
+        "kind": "InferenceModel",
+        "metadata": {"name": "model-a"},
+        "spec": {"modelName": "model-a", "criticality": "Critical",
+                 "poolRef": {"name": "pool-a"}},
+    },
+    {
+        "apiVersion": "inference.tpu.x-k8s.io/v1alpha1",
+        "kind": "InferenceModel",
+        "metadata": {"name": "model-b"},
+        "spec": {"modelName": "model-b", "criticality": "Sheddable",
+                 "poolRef": {"name": "pool-b"}},
+    },
+]
+
+
+class TestBuildMultiPool:
+    def build(self, tmp_path, **kwargs):
+        path = tmp_path / "pools.yaml"
+        path.write_text(yaml.safe_dump_all(TWO_POOL_DOCS))
+        return bootstrap.build_gateway(str(path), **kwargs)
+
+    def test_two_pools_build_multipool_components(self, tmp_path):
+        comps = self.build(tmp_path)
+        try:
+            assert isinstance(comps, MultiPoolComponents)
+            assert set(comps.pools) == {"pool-a", "pool-b"}
+            # Models partitioned by poolRef — the per-pool reconciler filter.
+            a_models = {m.spec.model_name
+                        for m in comps.pools["pool-a"].datastore.all_models()}
+            b_models = {m.spec.model_name
+                        for m in comps.pools["pool-b"].datastore.all_models()}
+            assert a_models == {"model-a"} and b_models == {"model-b"}
+            # Per-pool scheduler thresholds from each pool's own document.
+            assert comps.pools["pool-b"].scheduler.cfg.queue_threshold_critical == 11
+            assert comps.pools["pool-a"].scheduler.cfg.queue_threshold_critical == 5
+            # Aggregate views.
+            assert comps.datastore.has_synced_pool()
+            assert {m.spec.model_name for m in comps.datastore.all_models()} == {
+                "model-a", "model-b"}
+            assert comps.datastore.get_pool().name == "pool-a"
+        finally:
+            comps.stop()
+
+    def test_scoped_static_pods(self, tmp_path):
+        comps = self.build(tmp_path, static_pods=[
+            "a0=10.1.0.1", "pool-b/b0=10.2.0.1", "pool-b/b1=10.2.0.2:9999",
+        ])
+        try:
+            a_pods = {p.address for p in comps.pools["pool-a"].datastore.all_pods()}
+            b_pods = {p.address for p in comps.pools["pool-b"].datastore.all_pods()}
+            # Unprefixed binds to the first pool; ports default per-pool.
+            assert a_pods == {"10.1.0.1:8000"}
+            assert b_pods == {"10.2.0.1:9000", "10.2.0.2:9999"}
+        finally:
+            comps.stop()
+
+    def test_single_pool_unchanged(self, tmp_path):
+        path = tmp_path / "one.yaml"
+        path.write_text(yaml.safe_dump_all(TWO_POOL_DOCS[:1]))
+        comps = bootstrap.build_gateway(str(path))
+        try:
+            assert not isinstance(comps, MultiPoolComponents)
+            assert comps.datastore.get_pool().name == "pool-a"
+        finally:
+            comps.stop()
+
+    def test_duplicate_pool_names_rejected(self, tmp_path):
+        path = tmp_path / "dup.yaml"
+        path.write_text(yaml.safe_dump_all([TWO_POOL_DOCS[0], TWO_POOL_DOCS[0]]))
+        with pytest.raises(ValueError, match="duplicate"):
+            bootstrap.build_gateway(str(path))
+
+    def test_park_budget_fans_out(self, tmp_path):
+        comps = self.build(tmp_path)
+        try:
+            comps.scheduler.set_park_budget(3)
+            for c in comps.pools.values():
+                assert c.scheduler._park_budget == 3
+        finally:
+            comps.stop()
+
+    def test_unknown_pool_prefix_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown pool"):
+            self.build(tmp_path, static_pods=["gemm-pool/p0=10.0.0.1"])
+
+    def test_model_bound_to_two_pools_rejected(self, tmp_path):
+        docs = TWO_POOL_DOCS + [{
+            "kind": "InferenceModel",
+            "metadata": {"name": "model-a-again"},
+            "spec": {"modelName": "model-a", "criticality": "Default",
+                     "poolRef": {"name": "pool-b"}},
+        }]
+        path = tmp_path / "dupmodel.yaml"
+        path.write_text(yaml.safe_dump_all(docs))
+        with pytest.raises(ValueError, match="two pools"):
+            bootstrap.build_gateway(str(path))
+
+    def test_single_config_watcher_feeds_all_pools(self, tmp_path):
+        """One file poller; a reloaded doc reaches the RIGHT pool's stack."""
+        path = tmp_path / "pools.yaml"
+        path.write_text(yaml.safe_dump_all(TWO_POOL_DOCS))
+        comps = bootstrap.build_gateway(str(path), watch_config=True)
+        try:
+            watchers = [w for c in comps.pools.values() for w in c.watchers]
+            from llm_instance_gateway_tpu.gateway.controllers.filewatch import (
+                ConfigWatcher,
+            )
+
+            config_watchers = [w for w in watchers
+                               if isinstance(w, ConfigWatcher)]
+            assert len(config_watchers) == 1  # shared, not one per pool
+            updated = [dict(d) for d in TWO_POOL_DOCS]
+            updated[1] = {
+                **updated[1],
+                "metadata": {"name": "pool-b", "resourceVersion": "2"},
+                "spec": {**updated[1]["spec"],
+                         "schedulerConfig": {"queueThresholdCritical": 2}},
+            }
+            path.write_text(yaml.safe_dump_all(updated))
+            import os
+            import time
+
+            os.utime(path, (time.time() + 5, time.time() + 5))
+            assert config_watchers[0].sync_once()
+            assert (comps.pools["pool-b"].scheduler.cfg
+                    .queue_threshold_critical == 2)
+            assert (comps.pools["pool-a"].scheduler.cfg
+                    .queue_threshold_critical == 5)
+        finally:
+            comps.stop()
+
+    def test_partial_build_failure_stops_built_pools(self, tmp_path, monkeypatch):
+        """Pool 2 failing to build must stop pool 1's components."""
+        stopped = []
+        orig_stop = bootstrap.GatewayComponents.stop
+
+        def tracking_stop(self):
+            stopped.append(self)
+            return orig_stop(self)
+
+        monkeypatch.setattr(bootstrap.GatewayComponents, "stop", tracking_stop)
+        bad = [dict(d) for d in TWO_POOL_DOCS]
+        bad[1] = {
+            **bad[1],
+            "spec": {**bad[1]["spec"],
+                     "schedulerConfig": {"queueThresoldCritical": 9}},  # typo
+        }
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump_all(bad))
+        with pytest.raises(ValueError):
+            bootstrap.build_gateway(str(path))
+        assert len(stopped) == 1  # pool-a was built, then cleaned up
