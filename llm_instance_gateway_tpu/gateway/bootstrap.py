@@ -71,6 +71,19 @@ class GatewayComponents:
             w.stop()
 
 
+def _check_models_unambiguous(models: list, default_pool: str) -> None:
+    """A modelName bound to two pools would route first-wins by iteration
+    order — reject the ambiguity (at build time AND on hot reload)."""
+    model_pool: dict[str, str] = {}
+    for m in models:
+        ref = m.spec.pool_ref.name if m.spec.pool_ref else default_pool
+        prev = model_pool.setdefault(m.spec.model_name, ref)
+        if prev != ref:
+            raise ValueError(
+                f"model {m.spec.model_name!r} is bound to two pools "
+                f"({prev!r} and {ref!r})")
+
+
 def _scope_by_pool(entries: list[str], pool_names: list[str]) -> dict[str, list[str]]:
     """Split ``pool/value`` entries per pool; unprefixed values go to the
     first (default) pool — single-pool invocations never need prefixes.
@@ -125,16 +138,7 @@ def build_gateway(
     pool_names = [p.name for p in pools]
     if len(pool_names) != len(set(pool_names)):
         raise ValueError(f"duplicate InferencePool names in {config_path}")
-    # A modelName bound to two pools would route first-wins by iteration
-    # order — reject the ambiguity up front.
-    model_pool: dict[str, str] = {}
-    for m in models:
-        ref = m.spec.pool_ref.name if m.spec.pool_ref else pool_names[0]
-        prev = model_pool.setdefault(m.spec.model_name, ref)
-        if prev != ref:
-            raise ValueError(
-                f"model {m.spec.model_name!r} is bound to two pools "
-                f"({prev!r} and {ref!r}) in {config_path}")
+    _check_models_unambiguous(models, pool_names[0])
 
     # Resolve the watch namespace FIRST: the reconcilers must be pinned to
     # the namespace the informers actually watch, or every apiserver event
@@ -173,6 +177,13 @@ def build_gateway(
     built: dict[str, GatewayComponents] = {}
     try:
         for name in pool_names:
+            if len(scoped_svc[name]) > 1:
+                # Silently taking [0] would drop svc2's pods from membership
+                # with nothing to see — same class of misbinding the
+                # unknown-prefix check rejects.
+                raise ValueError(
+                    f"pool {name}: multiple --kube-service entries "
+                    f"{scoped_svc[name]} (one service per pool)")
             svc = scoped_svc[name][0] if scoped_svc[name] else ""
             # An unscoped slice informer would watch EVERY EndpointSlice in
             # the namespace — in a multi-pool process that cross-pollutes
@@ -209,7 +220,11 @@ def build_gateway(
         watcher = ConfigWatcher(
             config_path,
             _FanoutReconcilers([c.pool_reconciler for c in built.values()]),
-            _FanoutReconcilers([c.model_reconciler for c in built.values()]),
+            _FanoutReconcilers(
+                [c.model_reconciler for c in built.values()],
+                validate=lambda ms: _check_models_unambiguous(
+                    ms, pool_names[0]),
+            ),
         )
         watcher.start()
         built[pool_names[0]].watchers.append(watcher)
@@ -225,16 +240,28 @@ def build_gateway(
 
 class _FanoutReconcilers:
     """Broadcast reconcile/resync to per-pool reconcilers (each self-filters
-    by pool name / poolRef, so every pool sees only its own objects)."""
+    by pool name / poolRef, so every pool sees only its own objects).
 
-    def __init__(self, reconcilers: list):
+    ``validate`` vets a full resync before any pool applies it; a rejected
+    document set keeps the last good state (loudly) — the same posture as
+    the scheduler-config hot-reload hook."""
+
+    def __init__(self, reconcilers: list, validate=None):
         self._reconcilers = reconcilers
+        self._validate = validate
 
     def reconcile(self, obj, **kwargs):
         for r in self._reconcilers:
             r.reconcile(obj, **kwargs)
 
     def resync(self, objs):
+        if self._validate is not None:
+            try:
+                self._validate(objs)
+            except ValueError as e:
+                logger.error("rejected reloaded documents (keeping last "
+                             "good state): %s", e)
+                return
         for r in self._reconcilers:
             r.resync(objs)
 
@@ -290,6 +317,49 @@ def _build_for_pool(
         for m in models
     ])
     target_port = datastore.get_pool().spec.target_port_number
+
+    try:
+        return _start_pool_sources(
+            pool_name=pool_name, datastore=datastore, watchers=watchers,
+            scheduler_holder=scheduler_holder, pool_rec=pool_rec,
+            model_rec=model_rec, target_port=target_port,
+            static_pods=static_pods, discover_dns=discover_dns,
+            probe_endpoints=probe_endpoints,
+            probe_interval_s=probe_interval_s, zone=zone, kcfg=kcfg,
+            kube_service=kube_service, watch_slices=watch_slices,
+        )
+    except Exception:
+        # This pool's own partially-started sources (probers, DNS loops,
+        # watch streams, the admission drain thread) must not outlive the
+        # failed build — the caller only sees fully-built pools.
+        for w in watchers:
+            w.stop()
+        raise
+
+
+def _start_pool_sources(
+    *,
+    pool_name: str,
+    datastore: Datastore,
+    watchers: list,
+    scheduler_holder: list,
+    pool_rec,
+    model_rec,
+    target_port: int,
+    static_pods: list[str],
+    discover_dns: list[str],
+    probe_endpoints: bool,
+    probe_interval_s: float,
+    zone: str,
+    kcfg,
+    kube_service: str,
+    watch_slices: bool,
+) -> GatewayComponents:
+    # Parse the scheduler config FIRST: it is the most likely document error
+    # and failing here keeps the window with live threads minimal.
+    from llm_instance_gateway_tpu.gateway.scheduling.config import from_pool_spec
+
+    scheduler_cfg = from_pool_spec(datastore.get_pool().spec.scheduler)
 
     endpoints: list[StaticEndpoint] = []
     for spec in static_pods or []:
@@ -354,11 +424,8 @@ def _build_for_pool(
         watchers.append(source)
 
     provider = Provider(PodMetricsClient(), datastore)
-    # Thresholds come from the pool document (schedulerConfig section) —
-    # the resolution of the reference's config TODO, end to end.
-    from llm_instance_gateway_tpu.gateway.scheduling.config import from_pool_spec
-
-    scheduler_cfg = from_pool_spec(datastore.get_pool().spec.scheduler)
+    # Thresholds come from the pool document (schedulerConfig section,
+    # parsed up front) — the resolution of the reference's config TODO.
     # C++ hot path when buildable, Python tree otherwise (identical
     # semantics, fuzz-verified in tests/test_native_scheduler.py) — wrapped
     # by the admission controller so the pool's admissionQueue section can

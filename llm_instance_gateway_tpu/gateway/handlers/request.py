@@ -61,10 +61,14 @@ def handle_request_headers(req_ctx, msg: RequestHeaders) -> ProcessingResult:
 
 def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
     """request.go:19-120.  ``server`` provides datastore/scheduler/header name."""
-    try:
-        body = json.loads(msg.body)
-    except (json.JSONDecodeError, UnicodeDecodeError) as e:
-        raise RequestError(f"error unmarshaling request body: {e}") from e
+    # A multi-pool front (multipool.MultiPoolServer) already parsed the body
+    # to pick the pool; reuse its parse instead of decoding large prompts twice.
+    body = getattr(req_ctx, "_parsed_body", None)
+    if not isinstance(body, dict):
+        try:
+            body = json.loads(msg.body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise RequestError(f"error unmarshaling request body: {e}") from e
     model = body.get("model")
     if not isinstance(model, str):
         raise RequestError("model not found in request")

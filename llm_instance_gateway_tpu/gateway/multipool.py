@@ -54,25 +54,33 @@ class MultiPoolServer:
         return self._servers[self._default].target_pod_header
 
     def _route(self, body: bytes):
+        """Returns (pool_name | None, parsed_body | None)."""
         try:
-            model = json.loads(body or b"{}").get("model")
+            parsed = json.loads(body or b"{}")
         except (ValueError, AttributeError):
-            return None  # malformed body: default pool produces the 400
+            return None, None  # malformed body: default pool produces the 400
+        if not isinstance(parsed, dict):
+            return None, None
+        model = parsed.get("model")
         if not isinstance(model, str) or not model:
-            return None
+            return None, parsed
         for name, ds in self._datastores.items():
             if ds.fetch_model(model) is not None:
-                return name
-        return None
+                return name, parsed
+        return None, parsed
 
     def process(self, req_ctx: RequestContext, msg: ProcessingMessage):
         if isinstance(msg, RequestBody):
-            pool = self._route(msg.body)
+            pool, parsed = self._route(msg.body)
             if pool is None:
                 pool = self._default
             else:
                 logger.debug("request routed to pool %s", pool)
             req_ctx._pool = pool  # later phases replay to the same pool
+            if parsed is not None:
+                # The routed handler reuses this parse (handlers/request.py)
+                # instead of decoding the body a second time.
+                req_ctx._parsed_body = parsed
         pool = getattr(req_ctx, "_pool", self._default)
         return self._servers[pool].process(req_ctx, msg)
 

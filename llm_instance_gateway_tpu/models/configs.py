@@ -113,6 +113,20 @@ class ModelConfig:
         )
 
 
+# The reference PoC's model (vllm-lora-deployment.yaml:33-39 serves
+# meta-llama/Llama-2-7b-hf): MHA (no GQA), theta 1e4, 4k context.
+LLAMA2_7B = ModelConfig(
+    name="llama2-7b",
+    vocab_size=32_000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=32,
+    d_ff=11_008,
+    rope_theta=10_000.0,
+    max_seq_len=4096,
+)
+
 LLAMA3_8B = ModelConfig(
     name="llama3-8b",
     vocab_size=128_256,

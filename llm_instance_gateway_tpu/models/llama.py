@@ -13,9 +13,19 @@ import functools
 import jax
 
 from llm_instance_gateway_tpu.models import transformer
-from llm_instance_gateway_tpu.models.configs import LLAMA3_8B, TINY_TEST, ModelConfig
+from llm_instance_gateway_tpu.models.configs import (
+    LLAMA2_7B,
+    LLAMA3_8B,
+    TINY_TEST,
+    ModelConfig,
+)
 
-CONFIGS = {"llama3-8b": LLAMA3_8B, "llama3-tiny": TINY_TEST}
+CONFIGS = {
+    "llama2-7b": LLAMA2_7B,
+    "llama2-tiny": LLAMA2_7B.tiny(),
+    "llama3-8b": LLAMA3_8B,
+    "llama3-tiny": TINY_TEST,
+}
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=None):

@@ -277,3 +277,20 @@ def test_adapter_name_colliding_with_alias_rejected():
             await client.close()
 
     asyncio.run(run())
+
+
+def test_llama2_mha_logits_match_hf():
+    """Llama-2 shape (MHA: kv_heads == heads, theta 1e4) — the reference
+    PoC's model family (vllm-lora-deployment.yaml:33-39) certified like the
+    GQA case."""
+    model = build_hf_llama(heads=4, kv_heads=4)
+    cfg, params = from_hf_llama(model, dtype=jnp.float32)
+    assert cfg.n_kv_heads == cfg.n_heads == 4
+    ids = np.array([[5, 9, 101, 33, 64, 2, 77, 18]], np.int64)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+    tokens = jnp.asarray(ids, jnp.int32)
+    positions = jnp.arange(ids.shape[1])[None]
+    ours, *_ = transformer.prefill(cfg, params, tokens, positions)
+    ours = np.asarray(ours)[:, :, : model.config.vocab_size]
+    np.testing.assert_allclose(hf_logits, ours, rtol=2e-4, atol=2e-4)

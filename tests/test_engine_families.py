@@ -11,11 +11,16 @@ import numpy as np
 import pytest
 
 from llm_instance_gateway_tpu.models import transformer
-from llm_instance_gateway_tpu.models.configs import GEMMA_2B, MIXTRAL_8X7B
+from llm_instance_gateway_tpu.models.configs import (
+    GEMMA_2B,
+    LLAMA2_7B,
+    MIXTRAL_8X7B,
+)
 from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig, Request
 from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 
 FAMILIES = {
+    "llama2-tiny": LLAMA2_7B.tiny(),  # the reference PoC's model family
     "gemma-tiny": GEMMA_2B.tiny(),
     "mixtral-tiny": MIXTRAL_8X7B.tiny(),
 }

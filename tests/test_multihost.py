@@ -1,0 +1,122 @@
+"""Multi-HOST validation without hardware: two OS processes, one mesh.
+
+ROADMAP item 7 — the single-process virtual mesh (conftest's 8 CPU devices)
+exercises sharding semantics but not the multi-controller path: process-local
+device sets, `jax.distributed` coordination, and collectives that cross a
+process boundary (the DCN hop on a real multi-slice pool).  Here each of two
+subprocesses owns 4 virtual CPU devices, `parallel.mesh.initialize_distributed`
+wires them through the env contract the GKE manifests set
+(TPU_GATEWAY_COORDINATOR/_PROCESS_ID/_NUM_PROCESSES), and the shared
+data-parallel train step runs over a mesh whose ``data`` axis spans the two
+processes — data-parallel gradient psums ride the inter-process link exactly
+as they would ride DCN.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["GRAFT_REPO"])
+
+from llm_instance_gateway_tpu.parallel.mesh import (
+    MeshConfig, initialize_distributed, make_mesh,
+)
+
+initialize_distributed()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert len(jax.local_devices()) == 4
+
+import dataclasses
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
+from llm_instance_gateway_tpu.parallel import sharding
+from llm_instance_gateway_tpu.training import train
+
+cfg = dataclasses.replace(
+    LLAMA3_8B, name="multihost-dryrun", vocab_size=512, d_model=64,
+    n_layers=2, n_heads=4, n_kv_heads=4, d_ff=128, head_dim=16,
+    max_seq_len=64,
+)
+# data axis (2) spans the two processes -- the DCN hop; tensor (4) stays
+# inside each process's local devices -- the ICI domain.
+mesh = make_mesh(MeshConfig(data=2, tensor=4))
+
+params = transformer.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+params = sharding.shard_pytree(params, sharding.param_specs(cfg), mesh)
+optimizer = train.make_optimizer(1e-3)
+opt_state = jax.tree.map(
+    lambda x: jax.device_put(x, NamedSharding(mesh, P())), optimizer.init(params))
+
+import numpy as np
+rng = np.random.RandomState(0)  # same stream on both processes
+tokens_np = rng.randint(1, cfg.vocab_size, size=(4, 32)).astype(np.int32)
+pos_np = np.broadcast_to(np.arange(32), (4, 32)).astype(np.int32)
+tok_sharding = NamedSharding(mesh, P("data", None))
+tokens = jax.make_array_from_callback((4, 32), tok_sharding,
+                                      lambda idx: tokens_np[idx])
+positions = jax.make_array_from_callback((4, 32), tok_sharding,
+                                         lambda idx: pos_np[idx])
+
+step = jax.jit(train.make_full_train_step(cfg, optimizer))
+params, opt_state, loss = step(params, opt_state, tokens, positions)
+jax.block_until_ready(loss)
+print(f"MULTIHOST OK pid={jax.process_index()} loss={float(loss):.6f}",
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_trains():
+    port = _free_port()
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["GRAFT_REPO"] = REPO
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["TPU_GATEWAY_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["TPU_GATEWAY_PROCESS_ID"] = str(pid)
+        env["TPU_GATEWAY_NUM_PROCESSES"] = "2"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    losses = set()
+    for out in outs:
+        ok_lines = [l for l in out.splitlines() if l.startswith("MULTIHOST OK")]
+        assert ok_lines, out[-3000:]
+        losses.add(ok_lines[0].rsplit("loss=", 1)[1])
+    # Both controllers must agree on the global loss (one SPMD program).
+    assert len(losses) == 1, losses

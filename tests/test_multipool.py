@@ -246,6 +246,80 @@ class TestBuildMultiPool:
         finally:
             comps.stop()
 
+    def test_multiple_kube_services_per_pool_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="multiple --kube-service"):
+            self.build(tmp_path,
+                       kube_service="pool-a/svc1,pool-a/svc2")
+
+    def test_hot_reload_rejects_ambiguous_models(self, tmp_path):
+        """A reload binding one modelName to two pools keeps last good state."""
+        path = tmp_path / "pools.yaml"
+        path.write_text(yaml.safe_dump_all(TWO_POOL_DOCS))
+        comps = bootstrap.build_gateway(str(path), watch_config=True)
+        try:
+            from llm_instance_gateway_tpu.gateway.controllers.filewatch import (
+                ConfigWatcher,
+            )
+
+            watcher = next(
+                w for c in comps.pools.values() for w in c.watchers
+                if isinstance(w, ConfigWatcher))
+            ambiguous = TWO_POOL_DOCS + [{
+                "kind": "InferenceModel",
+                "metadata": {"name": "model-a-rebind"},
+                "spec": {"modelName": "model-a", "criticality": "Default",
+                         "poolRef": {"name": "pool-b"}},
+            }]
+            path.write_text(yaml.safe_dump_all(ambiguous))
+            import os
+            import time
+
+            os.utime(path, (time.time() + 5, time.time() + 5))
+            watcher.sync_once()
+            # model-a must still live ONLY in pool-a.
+            assert comps.pools["pool-a"].datastore.fetch_model("model-a")
+            assert not comps.pools["pool-b"].datastore.fetch_model("model-a")
+        finally:
+            comps.stop()
+
+    def test_failing_pool_stops_its_own_sources(self, tmp_path, monkeypatch):
+        """A pool that starts membership sources and THEN fails to build
+        must stop those sources, not just the previously built pools."""
+        from llm_instance_gateway_tpu.gateway.controllers import filewatch
+
+        started, stopped = [], []
+        orig_start = filewatch.EndpointProber.start
+        orig_stop = filewatch.EndpointProber.stop
+        monkeypatch.setattr(
+            filewatch.EndpointProber, "start",
+            lambda self: (started.append(self), orig_start(self)))
+        monkeypatch.setattr(
+            filewatch.EndpointProber, "stop",
+            lambda self: (stopped.append(self), orig_stop(self)))
+        # Fail pool-b AFTER its prober started: Provider construction is the
+        # first post-source step.
+        orig_provider = bootstrap.Provider
+
+        calls = []
+
+        def failing_provider(*args, **kwargs):
+            calls.append(1)
+            if len(calls) == 2:  # second pool
+                raise RuntimeError("injected post-source failure")
+            return orig_provider(*args, **kwargs)
+
+        monkeypatch.setattr(bootstrap, "Provider", failing_provider)
+        path = tmp_path / "pools.yaml"
+        path.write_text(yaml.safe_dump_all(TWO_POOL_DOCS))
+        with pytest.raises(RuntimeError, match="injected"):
+            bootstrap.build_gateway(
+                str(path), probe_endpoints=True,
+                static_pods=["a0=10.1.0.1", "pool-b/b0=10.2.0.1"])
+        assert len(started) == 2
+        # Every started prober was stopped — pool-a's by the built-pool
+        # cleanup, pool-b's by its own in-build cleanup.
+        assert set(stopped) >= set(started)
+
     def test_partial_build_failure_stops_built_pools(self, tmp_path, monkeypatch):
         """Pool 2 failing to build must stop pool 1's components."""
         stopped = []
