@@ -104,7 +104,7 @@ def _bench_error(msg: str) -> None:
     }), flush=True)
 
 
-def _claim_device_with_retry(attempts: int = 3,
+def _claim_device_with_retry(attempts: int = 5,
                              probe_timeout_s: float = 120.0) -> None:
     """Bounded retry-with-backoff on the device grant, BEFORE backend init.
 
@@ -132,7 +132,11 @@ def _claim_device_with_retry(attempts: int = 3,
         "print('CLAIM_OK', jax.default_backend(), flush=True)\n"
         "os._exit(0)\n"
     )
-    backoff = 30.0
+    # Observed: a stale grant (killed TPU process) can take 10+ minutes to
+    # clear; 5 x ~125s probes with 60/120/240/240s backoffs ride that out
+    # (~21 min worst case) while still failing structured rather than
+    # hanging.
+    backoff = 60.0
     for i in range(attempts):
         try:
             r = subprocess.run(
@@ -149,7 +153,7 @@ def _claim_device_with_retry(attempts: int = 3,
             pass
         if i < attempts - 1:
             time.sleep(backoff)
-            backoff *= 2
+            backoff = min(backoff * 2, 240.0)
     _bench_error(
         f"device unavailable after {attempts} probe attempts x "
         f"{probe_timeout_s:.0f}s (wedged relay grant?)")

@@ -674,6 +674,9 @@ def main(argv=None) -> None:
     parser.add_argument("--max-loras", type=int, default=4)
     parser.add_argument("--decode-steps", type=int, default=8,
                         help="fused decode steps per host sync (K)")
+    parser.add_argument("--prefill-batch", type=int, default=1,
+                        help="group up to P same-bucket queued prompts into "
+                             "one prefill program (contiguous-lane cache)")
     parser.add_argument("--pipeline-decode", action="store_true",
                         help="overlap token readback with the next decode "
                              "block (finish detection lags one block)")
@@ -812,6 +815,7 @@ def main(argv=None) -> None:
             decode_slots=args.decode_slots, max_seq_len=args.max_seq_len,
             decode_steps_per_sync=args.decode_steps,
             pipeline_decode=args.pipeline_decode,
+            prefill_batch=args.prefill_batch,
             paged_kv_block=args.paged_kv_block,
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,

@@ -92,6 +92,16 @@ class EngineConfig:
     # queue length is the true ``tpu:decode_queue_size`` the gateway's
     # prefill-aware scheduler routes on.  None = decode_slots.
     decode_wait_cap: int | None = None
+    # Grouped prefill admission: when several queued prompts land in the
+    # SAME bucket and free slots exist, up to this many prefill as ONE
+    # [P, bucket] program instead of P dispatches — small-batch prefill
+    # underfills the MXU and each dispatch pays the host round-trip, so
+    # bursts admit near-P-times faster.  Compiled-shape set stays bounded:
+    # buckets x group sizes (2..prefill_batch).  1 = off (existing path).
+    # Applies to the direct-admission path of the contiguous-lane cache;
+    # paged admissions stay per-request (block allocation is per-row
+    # backpressure).
+    prefill_batch: int = 1
     # Paged KV cache (models/paged.py): block size in tokens; None = the
     # default contiguous-lane cache.  With paging, the kv metrics report
     # allocated/total blocks — vLLM's gpu_cache_usage_perc semantics, which
@@ -367,6 +377,8 @@ class Engine:
         step_fn = (paged_lib.decode_step_paged if self.paged
                    else transformer.decode_step)
         self._jit_prefill = jax.jit(functools.partial(self._prefill_impl, model_cfg))
+        self._jit_prefill_many = jax.jit(
+            functools.partial(self._prefill_many_impl, model_cfg))
         self._jit_decode = jax.jit(
             functools.partial(self._decode_impl, model_cfg, step_fn),
             donate_argnames=("cache",),
@@ -451,6 +463,25 @@ class Engine:
         )
         lp, top_v, top_i = _logprob_info(last, first_token, model_cfg.vocab_size)
         return first_token[0], k, v, (lp[0], top_v[0], top_i[0])
+
+    @staticmethod
+    def _prefill_many_impl(
+        model_cfg, params, lora_bufs, tokens, positions, true_lens,
+        lora_slots, temps, topks, topps, key,
+    ):
+        """Prefill P padded same-bucket prompts as one program; sample each
+        row's first token (the [P, bucket] generalization of
+        ``_prefill_impl`` — per-row lengths, adapters, sampling params)."""
+        logits, k, v = transformer.prefill(
+            model_cfg, params, tokens, positions, lora_bufs=lora_bufs,
+            slot_ids=lora_slots,
+        )
+        last = jnp.take_along_axis(
+            logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [P, V]
+        first_tokens = sample(
+            last, key, temps, topks, topps, valid_vocab=model_cfg.vocab_size)
+        lp, top_v, top_i = _logprob_info(last, first_tokens, model_cfg.vocab_size)
+        return first_tokens, k, v, (lp, top_v, top_i)
 
     @staticmethod
     def _decode_impl(
@@ -897,7 +928,11 @@ class Engine:
                     did = True
                     continue
                 self._pending = None
-                if pipelined:
+                if (self.cfg.prefill_batch > 1 and not self.paged
+                        and len(req.prompt_tokens) <= self._max_bucket()):
+                    self._do_prefill_group(
+                        self._collect_prefill_group(req), pipelined)
+                elif pipelined:
                     self._do_prefill_pipelined(req)
                 else:
                     self._do_prefill(req)
@@ -1269,6 +1304,144 @@ class Engine:
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p), self._next_key(),
         )
+
+    def _bucket_prefill_many(self, reqs, ns, lora_slots):
+        """One [P, bucket] prefill over same-bucket prompts.
+        Returns (first_tokens [P] device, k [L,P,S,...], v, lp_infos)."""
+        bucket = self._bucket(max(ns))
+        p = len(reqs)
+        tokens = np.zeros((p, bucket), np.int32)
+        positions = np.zeros((p, bucket), np.int32)
+        for i, (req, n) in enumerate(zip(reqs, ns)):
+            tokens[i, :n] = req.prompt_tokens
+            positions[i, :n] = np.arange(n)
+        sps = [r.sampling for r in reqs]
+        return self._jit_prefill_many(
+            self.params, self._lora_buffers(),
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(ns, jnp.int32), jnp.asarray(lora_slots, jnp.int32),
+            jnp.asarray([sp.temperature for sp in sps], jnp.float32),
+            jnp.asarray([sp.top_k for sp in sps], jnp.int32),
+            jnp.asarray([sp.top_p for sp in sps], jnp.float32),
+            self._next_key(),
+        )
+
+    def _collect_prefill_group(self, first_req) -> list:
+        """Pull same-bucket followers of ``first_req`` for one batched
+        prefill, bounded by ``prefill_batch`` and the free-slot count.
+
+        Only the direct-admission branch calls this (decode_wait empty, a
+        free slot for the head), so every grouped request admits under
+        exactly the checks the one-at-a-time path applied.  The first
+        non-groupable pull parks as ``_pending`` — FIFO order holds.
+        """
+        group = [first_req]
+        limit = min(self.cfg.prefill_batch,
+                    sum(1 for i, s in enumerate(self.slots)
+                        if s is None and i not in self._reserved_slots))
+        bucket = self._bucket(len(first_req.prompt_tokens))
+        while len(group) < limit and self._pending is None:
+            try:
+                nxt = self.prefill_queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if nxt.cancelled.is_set():
+                self._finish(nxt, "cancelled")
+                continue
+            n = len(nxt.prompt_tokens)
+            if n <= self._max_bucket() and self._bucket(n) == bucket:
+                group.append(nxt)
+            else:
+                self._pending = nxt  # different bucket/long: next cycle
+        return group
+
+    def _do_prefill_group(self, reqs, pipelined: bool) -> None:
+        """Batched admission: one prefill program fills len(reqs) slots.
+
+        Per-row post-processing mirrors ``_do_prefill`` /
+        ``_do_prefill_pipelined``; a row that fails after the batched call
+        fails alone, a failure OF the batched call fails the whole group
+        (same engine-survives posture as the single path).
+        """
+        live, ns, lora_slots = [], [], []
+        for req in reqs:
+            if req.cancelled.is_set():
+                self._finish(req, "cancelled")
+                continue
+            try:
+                lora_slots.append(
+                    self.lora.slot_for(req.adapter)
+                    if self.lora is not None else -1)
+            except Exception as e:  # unknown adapter fails only this row
+                req.error = str(e)
+                self._finish(req, "error")
+                continue
+            live.append(req)
+            ns.append(len(req.prompt_tokens))
+        if not live:
+            return
+        if len(live) == 1:
+            if pipelined:
+                self._do_prefill_pipelined(live[0])
+            else:
+                self._do_prefill(live[0])
+            return
+        try:
+            first_tokens, k, v, (lps, top_vs, top_is) = (
+                self._bucket_prefill_many(live, ns, lora_slots))
+            if pipelined:
+                try:
+                    first_tokens.copy_to_host_async()
+                except AttributeError:
+                    pass
+        except Exception as e:
+            logger.exception("grouped prefill failed (%d reqs)", len(live))
+            for req in live:
+                req.error = str(e)
+                self._finish(req, "error")
+            return
+        for i, req in enumerate(live):
+            try:
+                slot_idx = self._free_slot_index()
+                if slot_idx is None:
+                    # Defensive: the free-slot count is taken at collection
+                    # and the engine loop is single-threaded, so this should
+                    # not happen — but a computed prefill must never be
+                    # dropped.  Park it exactly like a prefill-ahead.
+                    w = _WaitingPrefill(
+                        request=req, first_token=first_tokens[i],
+                        lp_info=(lps[i], top_vs[i], top_is[i]),
+                        k=k[:, i:i + 1], v=v[:, i:i + 1],
+                        n=ns[i], lora_slot=lora_slots[i])
+                    if not pipelined:
+                        tok = int(first_tokens[i])
+                        w.first_token_host = tok
+                        if self._emit_first_token(req, tok, w.lp_info):
+                            continue  # finished at prefill
+                    self.decode_wait.append(w)
+                    continue
+                self._insert_prompt_kv(
+                    k[:, i:i + 1], v[:, i:i + 1], slot_idx, ns[i])
+                lp_info = (lps[i], top_vs[i], top_is[i])
+                if pipelined:
+                    self._activate_slot_pipelined(
+                        slot_idx, req, lora_slots[i], ns[i],
+                        first_tokens[i], lp_info)
+                else:
+                    if self._emit_first_token(req, int(first_tokens[i]),
+                                              lp_info):
+                        continue  # finished at prefill
+                    self._register_slot(slot_idx, _Slot(
+                        request=req, lora_slot=lora_slots[i],
+                        position=ns[i]))
+                    self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
+                    self._slot_positions[slot_idx] = ns[i]
+                    self._draft_admit(slot_idx, req.prompt_tokens)
+            except Exception as e:
+                logger.exception("grouped admission failed for %s",
+                                 req.request_id)
+                req.error = str(e)
+                self._finish(req, "error")
 
     def _insert_prompt_kv(self, k, v, slot_idx: int, n: int) -> None:
         """Write a bucketed prefill's KV into the cache (lane or paged)."""

@@ -29,8 +29,10 @@ from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 CFG = TINY_TEST
 
 
-@pytest.mark.parametrize("pipeline", [False, True], ids=["sync", "pipelined"])
-def test_request_storm_terminates(pipeline):
+@pytest.mark.parametrize("pipeline,prefill_batch", [
+    (False, 1), (True, 1), (False, 3), (True, 3),
+], ids=["sync", "pipelined", "sync-grouped", "pipelined-grouped"])
+def test_request_storm_terminates(pipeline, prefill_batch):
     rng = random.Random(0)
     params = transformer.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
     lora = LoRAManager(CFG, dtype=jnp.float32)
@@ -45,7 +47,8 @@ def test_request_storm_terminates(pipeline):
     engine = Engine(
         CFG, params,
         EngineConfig(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
-                     decode_steps_per_sync=3, pipeline_decode=pipeline),
+                     decode_steps_per_sync=3, pipeline_decode=pipeline,
+                     prefill_batch=prefill_batch),
         lora_manager=lora, eos_id=7, dtype=jnp.float32,
     )
     engine.start()
