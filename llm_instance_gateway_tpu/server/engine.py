@@ -941,7 +941,11 @@ class Engine:
             if (len(req.prompt_tokens) <= self._max_bucket()
                     and len(self.decode_wait) < cap):
                 self._pending = None
-                self._do_prefill_ahead(req, pipelined)
+                if self.cfg.prefill_batch > 1 and not self.paged:
+                    self._do_prefill_ahead_group(
+                        self._collect_ahead_group(req, cap), pipelined)
+                else:
+                    self._do_prefill_ahead(req, pipelined)
                 did = True
                 continue
             break
@@ -981,21 +985,13 @@ class Engine:
                          if self.lora is not None else -1)
             first_token, k, v, lp_info = self._bucket_prefill(
                 req, n, lora_slot)
-            w = _WaitingPrefill(request=req, first_token=first_token,
-                                lp_info=lp_info,
-                                k=k, v=v, n=n, lora_slot=lora_slot)
             if pipelined:
                 try:
                     first_token.copy_to_host_async()
                 except AttributeError:
                     pass
-            else:
-                tok = int(first_token)
-                w.first_token_host = tok
-                if self._emit_first_token(req, tok, w.lp_info):
-                    w.lp_info = None
-                    return  # done at prefill; never needed a slot or blocks
-            self.decode_wait.append(w)
+            self._park_waiting(req, first_token, lp_info, k, v, n, lora_slot,
+                               pipelined)
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill-ahead failed for %s", req.request_id)
             req.error = str(e)
@@ -1326,19 +1322,11 @@ class Engine:
             self._next_key(),
         )
 
-    def _collect_prefill_group(self, first_req) -> list:
+    def _collect_followers(self, first_req, limit: int) -> list:
         """Pull same-bucket followers of ``first_req`` for one batched
-        prefill, bounded by ``prefill_batch`` and the free-slot count.
-
-        Only the direct-admission branch calls this (decode_wait empty, a
-        free slot for the head), so every grouped request admits under
-        exactly the checks the one-at-a-time path applied.  The first
-        non-groupable pull parks as ``_pending`` — FIFO order holds.
-        """
+        prefill, up to ``limit`` total.  The first non-groupable pull parks
+        as ``_pending`` — FIFO order holds."""
         group = [first_req]
-        limit = min(self.cfg.prefill_batch,
-                    sum(1 for i, s in enumerate(self.slots)
-                        if s is None and i not in self._reserved_slots))
         bucket = self._bucket(len(first_req.prompt_tokens))
         while len(group) < limit and self._pending is None:
             try:
@@ -1355,13 +1343,72 @@ class Engine:
                 self._pending = nxt  # different bucket/long: next cycle
         return group
 
-    def _do_prefill_group(self, reqs, pipelined: bool) -> None:
-        """Batched admission: one prefill program fills len(reqs) slots.
+    def _collect_prefill_group(self, first_req) -> list:
+        """Direct-admission grouping: bounded by free unreserved slots.
 
-        Per-row post-processing mirrors ``_do_prefill`` /
-        ``_do_prefill_pipelined``; a row that fails after the batched call
-        fails alone, a failure OF the batched call fails the whole group
-        (same engine-survives posture as the single path).
+        Only the direct branch calls this (decode_wait empty, a free slot
+        for the head), so every grouped request admits under exactly the
+        checks the one-at-a-time path applied."""
+        return self._collect_followers(first_req, min(
+            self.cfg.prefill_batch,
+            sum(1 for i, s in enumerate(self.slots)
+                if s is None and i not in self._reserved_slots)))
+
+    def _collect_ahead_group(self, first_req, cap: int) -> list:
+        """Prefill-ahead grouping: bounded by decode_wait headroom."""
+        return self._collect_followers(first_req, min(
+            self.cfg.prefill_batch, max(1, cap - len(self.decode_wait))))
+
+    def _park_waiting(self, req, first_token, lp_info, k, v, n: int,
+                      lora_slot: int, pipelined: bool) -> None:
+        """Park one prefilled row in decode_wait (the prefill-ahead
+        contract: sync mode emits the first token NOW — TTFT is
+        prefill-bound, not slot-bound; pipelined keeps it device-side)."""
+        w = _WaitingPrefill(request=req, first_token=first_token,
+                            lp_info=lp_info, k=k, v=v, n=n,
+                            lora_slot=lora_slot)
+        if not pipelined:
+            tok = int(first_token)
+            w.first_token_host = tok
+            if self._emit_first_token(req, tok, w.lp_info):
+                w.lp_info = None
+                return  # done at prefill; never needed a slot
+        self.decode_wait.append(w)
+
+    def _do_prefill_ahead_group(self, reqs, pipelined: bool) -> None:
+        """Batched prefill-ahead: one program, every row parks in
+        decode_wait (mirrors ``_do_prefill_ahead`` per row)."""
+        batch = self._grouped_batch(
+            reqs, pipelined,
+            lambda req: self._do_prefill_ahead(req, pipelined))
+        if batch is None:
+            return
+        live, ns, lora_slots, k, v, tok_rows, lp_rows = batch
+        for i, req in enumerate(live):
+            try:
+                self._park_waiting(
+                    req, tok_rows[i], lp_rows[i],
+                    k[:, i:i + 1], v[:, i:i + 1], ns[i], lora_slots[i],
+                    pipelined)
+            except Exception as e:
+                logger.exception("grouped parking failed for %s",
+                                 req.request_id)
+                req.error = str(e)
+                self._finish(req, "error")
+
+    def _grouped_batch(self, reqs, pipelined: bool, single_fn):
+        """Shared grouped-prefill preamble: filter cancelled/bad-adapter
+        rows (each fails alone), fall back to ``single_fn`` for a group of
+        one, run ONE batched prefill (a failure there fails the whole
+        group — the engine-survives posture of the single path).
+
+        Returns None when the caller has nothing left to do, else
+        ``(live, ns, lora_slots, k, v, tok_rows, lp_rows)`` where the
+        per-row token/logprob views are already in the right place for the
+        mode: sync mode fetched them host-side in ONE transfer each
+        (P scalar syncs would re-pay the round-trips batching removed);
+        pipelined mode holds device scalars with the async copy issued on
+        the exact slices later materialized.
         """
         live, ns, lora_slots = [], [], []
         for req in reqs:
@@ -1379,27 +1426,48 @@ class Engine:
             live.append(req)
             ns.append(len(req.prompt_tokens))
         if not live:
-            return
+            return None
         if len(live) == 1:
-            if pipelined:
-                self._do_prefill_pipelined(live[0])
-            else:
-                self._do_prefill(live[0])
-            return
+            single_fn(live[0])
+            return None
         try:
             first_tokens, k, v, (lps, top_vs, top_is) = (
                 self._bucket_prefill_many(live, ns, lora_slots))
             if pipelined:
-                try:
-                    first_tokens.copy_to_host_async()
-                except AttributeError:
-                    pass
+                tok_rows = [first_tokens[i] for i in range(len(live))]
+                for t in tok_rows:
+                    try:
+                        t.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                lp_rows = [(lps[i], top_vs[i], top_is[i])
+                           for i in range(len(live))]
+            else:
+                toks = np.asarray(first_tokens)
+                lps_h, top_vs_h, top_is_h = (
+                    np.asarray(lps), np.asarray(top_vs), np.asarray(top_is))
+                tok_rows = [int(t) for t in toks]
+                lp_rows = [(lps_h[i], top_vs_h[i], top_is_h[i])
+                           for i in range(len(live))]
         except Exception as e:
             logger.exception("grouped prefill failed (%d reqs)", len(live))
             for req in live:
                 req.error = str(e)
                 self._finish(req, "error")
+            return None
+        return live, ns, lora_slots, k, v, tok_rows, lp_rows
+
+    def _do_prefill_group(self, reqs, pipelined: bool) -> None:
+        """Batched admission: one prefill program fills len(reqs) slots.
+        Per-row post-processing mirrors ``_do_prefill`` /
+        ``_do_prefill_pipelined``; a row that fails after the batched call
+        fails alone."""
+        batch = self._grouped_batch(
+            reqs, pipelined,
+            self._do_prefill_pipelined if pipelined else self._do_prefill)
+        if batch is None:
             return
+        live, ns, lora_slots, k, v, tok_rows, lp_rows = batch
         for i, req in enumerate(live):
             try:
                 slot_idx = self._free_slot_index()
@@ -1408,28 +1476,19 @@ class Engine:
                     # and the engine loop is single-threaded, so this should
                     # not happen — but a computed prefill must never be
                     # dropped.  Park it exactly like a prefill-ahead.
-                    w = _WaitingPrefill(
-                        request=req, first_token=first_tokens[i],
-                        lp_info=(lps[i], top_vs[i], top_is[i]),
-                        k=k[:, i:i + 1], v=v[:, i:i + 1],
-                        n=ns[i], lora_slot=lora_slots[i])
-                    if not pipelined:
-                        tok = int(first_tokens[i])
-                        w.first_token_host = tok
-                        if self._emit_first_token(req, tok, w.lp_info):
-                            continue  # finished at prefill
-                    self.decode_wait.append(w)
+                    self._park_waiting(
+                        req, tok_rows[i], lp_rows[i],
+                        k[:, i:i + 1], v[:, i:i + 1], ns[i], lora_slots[i],
+                        pipelined)
                     continue
                 self._insert_prompt_kv(
                     k[:, i:i + 1], v[:, i:i + 1], slot_idx, ns[i])
-                lp_info = (lps[i], top_vs[i], top_is[i])
                 if pipelined:
                     self._activate_slot_pipelined(
                         slot_idx, req, lora_slots[i], ns[i],
-                        first_tokens[i], lp_info)
+                        tok_rows[i], lp_rows[i])
                 else:
-                    if self._emit_first_token(req, int(first_tokens[i]),
-                                              lp_info):
+                    if self._emit_first_token(req, tok_rows[i], lp_rows[i]):
                         continue  # finished at prefill
                     self._register_slot(slot_idx, _Slot(
                         request=req, lora_slot=lora_slots[i],

@@ -120,6 +120,39 @@ def test_unknown_adapter_rejected_at_submit_not_in_group():
         engine.stop()
 
 
+@pytest.mark.parametrize("pipeline", [False, True], ids=["sync", "pipelined"])
+def test_saturated_slots_group_through_decode_wait(pipeline):
+    """More requests than slots: the overflow admits through GROUPED
+    prefill-ahead and still matches the one-at-a-time engine exactly."""
+    def run(prefill_batch):
+        engine = Engine(
+            CFG, PARAMS,
+            EngineConfig(decode_slots=2, max_seq_len=128,
+                         prefill_buckets=(16, 32),
+                         decode_steps_per_sync=4, pipeline_decode=pipeline,
+                         prefill_batch=prefill_batch, decode_wait_cap=8),
+            eos_id=None, dtype=jnp.float32,
+        )
+        engine.start()
+        try:
+            reqs = [
+                Request(prompt_tokens=[i + 1, i + 2, i + 3],
+                        max_new_tokens=8,
+                        sampling=SamplingParams(temperature=0.0))
+                for i in range(8)
+            ]
+            for r in reqs:
+                engine.submit(r)
+            for r in reqs:
+                assert r.done.wait(180), "request timed out"
+                assert r.error is None, r.error
+            return [list(r.output_tokens) for r in reqs]
+        finally:
+            engine.stop()
+
+    assert run(4) == run(1)
+
+
 class TestCollection:
     def _engine(self, prefill_batch=4, slots=8):
         return Engine(
