@@ -320,11 +320,16 @@ def decode_step(
     positions: jax.Array,    # [B] int32 — position of ``tokens``
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,
+    attention_fn=None,       # override: (q, k_cache, v_cache, lengths) -> attn
 ):
     """One decode step for every slot.  Returns (logits [B,V] f32, new cache).
 
     Inactive slots simply decode garbage into their own lane (masked out by
     the engine); lockstep batching keeps the step shape-static.
+
+    ``attention_fn`` swaps the cached-attention implementation — used by
+    ``ops.sharded_attention`` to run the Pallas decode kernel shard-local
+    under a GSPMD mesh.
     """
     b = tokens.shape[0]
     if slot_ids is None:
@@ -352,7 +357,9 @@ def decode_step(
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k_cache = k_cache.at[batch_idx, positions].set(k)
         v_cache = v_cache.at[batch_idx, positions].set(v)
-        if cfg.use_pallas_decode:
+        if attention_fn is not None:
+            attn = attention_fn(q, k_cache, v_cache, lengths)
+        elif cfg.use_pallas_decode:
             from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
                 decode_attention as pallas_decode,
             )

@@ -1,0 +1,142 @@
+"""Pallas attention kernels under GSPMD meshes, via ``jax.shard_map``.
+
+GSPMD cannot partition an opaque ``pallas_call`` — before this module, any
+serving mesh with size > 1 silently dropped the flash-prefill and
+cached-decode kernels and fell back to XLA attention, exactly where the big
+models run (tensor-parallel v5e-8+).  ``shard_map`` is the standard
+composition fix: it splits the arrays along the mesh axes OUTSIDE the kernel,
+runs the unmodified single-device kernel on each shard's local block, and
+lets the surrounding jitted program keep its GSPMD shardings.
+
+Both attention ops are embarrassingly parallel over (batch, kv-head-group):
+
+- flash prefill   [B, S, H, hd] x [B, S, K, hd]: every (batch row, head)
+  pair is independent — shard batch over ``data`` and heads over ``tensor``.
+- cached decode   [B, H, hd] x [B, S_max, K, hd]: same, with the cache's
+  kv-head axis sharded to match (the engine already lays the cache out this
+  way, ``parallel/sharding.cache_specs``).
+
+so the shard-local call IS the global computation restricted to the local
+heads/rows: no collectives are needed inside the kernel, and the psum that
+tensor parallelism requires stays where it always was — in the ``wo``
+projection AFTER attention (Megatron pattern, ``parallel/sharding.py``).
+
+GQA head split: shard_map splits the head axis into equal contiguous blocks,
+so sharding is group-aligned iff ``tensor`` divides ``n_kv_heads`` (each
+device gets whole KV groups) — or ``n_kv_heads == 1`` (MQA: the single KV
+head is replicated, every device's local query heads all map to it).
+``mesh_supports`` gates on exactly that; unsupported layouts keep the XLA
+fallback.
+
+Reference parity note: the reference (kubernetes-sigs/llm-instance-gateway)
+delegates all accelerator work to vLLM (SURVEY.md §2); this module is part
+of the model-server half this repo owns.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_instance_gateway_tpu.models.configs import ModelConfig
+
+# Test hook: force the Pallas kernels in interpret mode even off-TPU, so the
+# virtual-CPU-mesh suite certifies the KERNEL path (not the XLA fallback the
+# auto-dispatch would pick on CPU).
+FORCE_INTERPRET = False
+
+
+def mesh_supports(cfg: ModelConfig, mesh: Mesh) -> bool:
+    """True iff the Pallas kernels can run shard-local on this mesh.
+
+    Requirements (see module docstring): the ``tensor`` split must be
+    group-aligned for GQA, and query heads must split evenly.  ``data``
+    needs no gate — batch axes that don't divide simply replicate (specs
+    are chosen per call-site shape).
+    """
+    t = mesh.shape.get("tensor", 1)
+    if t == 1:
+        return True
+    if cfg.n_heads % t != 0:
+        return False
+    return cfg.n_kv_heads == 1 or cfg.n_kv_heads % t == 0
+
+
+def _batch_axis(b: int, mesh: Mesh) -> str | None:
+    """Shard batch over ``data`` when it divides; replicate otherwise
+    (single-prompt prefill has B=1 — correct either way, GSPMD-free)."""
+    dp = mesh.shape.get("data", 1)
+    return "data" if dp > 1 and b % dp == 0 else None
+
+
+def _head_axes(n_kv: int, mesh: Mesh) -> tuple[str | None, str | None]:
+    """(q-head axis, kv-head axis) specs for the tensor split."""
+    t = mesh.shape.get("tensor", 1)
+    if t == 1:
+        return None, None
+    # MQA: replicate the single KV head; query heads still split — each
+    # device's local heads all belong to group 0.
+    return "tensor", ("tensor" if n_kv % t == 0 else None)
+
+
+def make_flash_prefill(cfg: ModelConfig, mesh: Mesh):
+    """Returns ``attention_fn(q, k, v, positions)`` for ``transformer.prefill``.
+
+    Same contract as ``pallas_attention.flash_attention``: causal,
+    right-padded batches only (positions are ignored — causality alone keeps
+    real positions exact; pad rows are garbage the caller masks).  Inside
+    each shard the auto-dispatching entry still falls back to XLA for
+    shapes that miss the tiling constraints, so tiny test models remain
+    correct under the same code path.
+    """
+    from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention
+
+    def attention_fn(q, k, v, positions):
+        del positions
+        db = _batch_axis(q.shape[0], mesh)
+        qh, kh = _head_axes(k.shape[2], mesh)
+        q_spec = P(db, None, qh, None)     # [B, S, H, hd]
+        kv_spec = P(db, None, kh, None)    # [B, S, K, hd]
+
+        def local(q, k, v):
+            return flash_attention(q, k, v, interpret=FORCE_INTERPRET)
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+            out_specs=q_spec, check_vma=False,
+        )(q, k, v)
+
+    return attention_fn
+
+
+def make_cached_decode(cfg: ModelConfig, mesh: Mesh):
+    """Returns ``attention_fn(q, k_cache, v_cache, lengths)`` for
+    ``transformer.decode_step``.
+
+    The per-layer cache inside the scan is [B, S_max, K, hd] with kv heads
+    sharded over ``tensor`` and batch over ``data`` — the same layout
+    ``cache_specs`` commits, so shard_map's split is a no-op reshard on the
+    hot loop.
+    """
+    from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+        decode_attention,
+    )
+
+    def attention_fn(q, k_cache, v_cache, lengths):
+        db = _batch_axis(q.shape[0], mesh)
+        qh, kh = _head_axes(k_cache.shape[2], mesh)
+        q_spec = P(db, qh, None)             # [B, H, hd]
+        kv_spec = P(db, None, kh, None)      # [B, S_max, K, hd]
+        len_spec = P(db)                     # [B]
+
+        def local(q, kc, vc, lens):
+            return decode_attention(q, kc, vc, lens,
+                                    interpret=FORCE_INTERPRET)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, len_spec),
+            out_specs=q_spec, check_vma=False,
+        )(q, k_cache, v_cache, lengths)
+
+    return attention_fn

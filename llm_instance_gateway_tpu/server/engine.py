@@ -296,19 +296,51 @@ class Engine:
         # collectives (one psum per layer on attn/MLP outputs for Megatron
         # tensor parallelism), nothing in the loop code changes.
         self.mesh = mesh
+        # Pallas kernels under the mesh: GSPMD can't partition an opaque
+        # pallas_call, so under a >1 mesh the in-model auto-dispatch is
+        # always turned OFF (it would replicate full arrays to every
+        # device) — and the kernels come back as shard_map wrappers that
+        # run them shard-local over the data/tensor axes
+        # (ops/sharded_attention.py).  Head layouts the wrappers can't
+        # split group-aligned keep the XLA fallback.
+        self._prefill_attn_fn = None
+        self._decode_attn_fn = None
         if mesh is not None and mesh.size > 1 and (
             model_cfg.use_flash_attention or model_cfg.use_pallas_decode
         ):
-            # GSPMD can't partition an opaque pallas_call across the mesh;
-            # the XLA attention path shards cleanly.  Single-device meshes
-            # keep the kernels.
-            logger.info(
-                "mesh size %d > 1: disabling Pallas attention kernels "
-                "(GSPMD cannot partition pallas_call); using XLA attention",
-                mesh.size)
+            from llm_instance_gateway_tpu.ops import sharded_attention
+
+            wants_flash = model_cfg.use_flash_attention
+            wants_decode = model_cfg.use_pallas_decode
             model_cfg = dataclasses.replace(
                 model_cfg, use_flash_attention=False, use_pallas_decode=False)
             self.model_cfg = model_cfg
+            if sharded_attention.mesh_supports(model_cfg, mesh):
+                if wants_flash and mesh.shape.get("sequence", 1) == 1:
+                    # sequence>1 prefill belongs to the ring path; bucketed
+                    # prefill there stays XLA rather than paying redundant
+                    # per-shard compute.
+                    self._prefill_attn_fn = (
+                        sharded_attention.make_flash_prefill(model_cfg, mesh))
+                if (wants_decode and not self.paged
+                        and b % mesh.shape.get("data", 1) == 0):
+                    # The batch gate is load-bearing: a non-divisible B
+                    # would force shard_map to replicate the data-sharded
+                    # KV cache (a full-cache all-gather per layer per
+                    # step) — worse than the XLA fallback.
+                    self._decode_attn_fn = (
+                        sharded_attention.make_cached_decode(model_cfg, mesh))
+                logger.info(
+                    "mesh size %d: Pallas kernels via shard_map "
+                    "(flash_prefill=%s, cached_decode=%s)", mesh.size,
+                    self._prefill_attn_fn is not None,
+                    self._decode_attn_fn is not None)
+            else:
+                logger.info(
+                    "mesh size %d: head layout (%d q heads, %d kv heads) "
+                    "does not split group-aligned over tensor=%d; using "
+                    "XLA attention", mesh.size, model_cfg.n_heads,
+                    model_cfg.n_kv_heads, mesh.shape.get("tensor", 1))
         if mesh is not None:
             from llm_instance_gateway_tpu.parallel import sharding as sharding_lib
 
@@ -374,11 +406,18 @@ class Engine:
         self.decode_tps_ema = 0.0
         self.ttft_history: list[float] = []
 
-        step_fn = (paged_lib.decode_step_paged if self.paged
-                   else transformer.decode_step)
-        self._jit_prefill = jax.jit(functools.partial(self._prefill_impl, model_cfg))
+        if self.paged:
+            step_fn = paged_lib.decode_step_paged
+        elif self._decode_attn_fn is not None:
+            step_fn = functools.partial(
+                transformer.decode_step, attention_fn=self._decode_attn_fn)
+        else:
+            step_fn = transformer.decode_step
+        self._jit_prefill = jax.jit(functools.partial(
+            self._prefill_impl, model_cfg, self._prefill_attn_fn))
         self._jit_prefill_many = jax.jit(
-            functools.partial(self._prefill_many_impl, model_cfg))
+            functools.partial(self._prefill_many_impl, model_cfg,
+                              self._prefill_attn_fn))
         self._jit_decode = jax.jit(
             functools.partial(self._decode_impl, model_cfg, step_fn),
             donate_argnames=("cache",),
@@ -444,14 +483,14 @@ class Engine:
 
     @staticmethod
     def _prefill_impl(
-        model_cfg, params, lora_bufs, tokens, positions, true_len,
+        model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_len,
         lora_slot, temp, topk, topp, key,
     ):
         """Prefill one padded prompt; sample the first new token."""
         slot_ids = jnp.full((1,), lora_slot, jnp.int32)
         logits, k, v = transformer.prefill(
             model_cfg, params, tokens, positions, lora_bufs=lora_bufs,
-            slot_ids=slot_ids,
+            slot_ids=slot_ids, attention_fn=attn_fn,
         )
         last = logits[:, true_len - 1]  # [1, V]
         first_token = sample(
@@ -466,7 +505,7 @@ class Engine:
 
     @staticmethod
     def _prefill_many_impl(
-        model_cfg, params, lora_bufs, tokens, positions, true_lens,
+        model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_lens,
         lora_slots, temps, topks, topps, key,
     ):
         """Prefill P padded same-bucket prompts as one program; sample each
@@ -474,7 +513,7 @@ class Engine:
         ``_prefill_impl`` — per-row lengths, adapters, sampling params)."""
         logits, k, v = transformer.prefill(
             model_cfg, params, tokens, positions, lora_bufs=lora_bufs,
-            slot_ids=lora_slots,
+            slot_ids=lora_slots, attention_fn=attn_fn,
         )
         last = jnp.take_along_axis(
             logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [P, V]

@@ -100,6 +100,134 @@ class TestShardedForward:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(got), rtol=5e-4, atol=5e-4)
 
 
+class TestShardedPallasKernels:
+    """Pallas kernels under the mesh via shard_map (VERDICT r2 #3).
+
+    ``FORCE_INTERPRET`` runs the actual Mosaic kernels in interpret mode on
+    the virtual CPU mesh — these tests certify the KERNEL path shard-local,
+    not the XLA fallback the auto-dispatch would pick off-TPU.
+    """
+
+    def _kernel_cfg(self, n_heads=8, n_kv_heads=4):
+        import dataclasses
+
+        from llm_instance_gateway_tpu.models.configs import TINY_TEST as T
+
+        return dataclasses.replace(
+            T, n_heads=n_heads, n_kv_heads=n_kv_heads, head_dim=128,
+            d_model=128, max_seq_len=512)
+
+    def test_mesh_supports_gating(self):
+        from llm_instance_gateway_tpu.ops import sharded_attention as sa
+
+        mesh = make_mesh(MeshConfig(tensor=8))
+        assert sa.mesh_supports(self._kernel_cfg(8, 8), mesh)
+        assert sa.mesh_supports(self._kernel_cfg(8, 1), mesh)  # MQA
+        # 4 query heads can't split 8 ways; 3 kv heads aren't group-aligned.
+        assert not sa.mesh_supports(self._kernel_cfg(4, 1), mesh)
+        mesh4 = make_mesh(MeshConfig(data=2, tensor=4))
+        assert not sa.mesh_supports(self._kernel_cfg(8, 3), mesh4)
+
+    def test_sharded_flash_parity_interpret(self, monkeypatch):
+        from llm_instance_gateway_tpu.ops import sharded_attention as sa
+
+        monkeypatch.setattr(sa, "FORCE_INTERPRET", True)
+        cfg = self._kernel_cfg(8, 4)
+        mesh = make_mesh(MeshConfig(data=2, tensor=4))
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        b, s, hd = 2, 256, 128
+        q = jax.random.normal(keys[0], (b, s, 8, hd), jnp.float32)
+        k = jax.random.normal(keys[1], (b, s, 4, hd), jnp.float32)
+        v = jax.random.normal(keys[2], (b, s, 4, hd), jnp.float32)
+        ref = prefill_attention(q, k, v)
+        fn = sa.make_flash_prefill(cfg, mesh)
+        got = jax.jit(lambda q, k, v: fn(q, k, v, None))(q, k, v)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_sharded_decode_parity_interpret(self, monkeypatch):
+        from llm_instance_gateway_tpu.ops import sharded_attention as sa
+        from llm_instance_gateway_tpu.ops.attention import decode_attention
+
+        monkeypatch.setattr(sa, "FORCE_INTERPRET", True)
+        cfg = self._kernel_cfg(8, 4)
+        mesh = make_mesh(MeshConfig(data=2, tensor=4))
+        keys = jax.random.split(jax.random.PRNGKey(1), 3)
+        b, s_max, hd = 4, 512, 128
+        q = jax.random.normal(keys[0], (b, 8, hd), jnp.float32)
+        kc = jax.random.normal(keys[1], (b, s_max, 4, hd), jnp.float32)
+        vc = jax.random.normal(keys[2], (b, s_max, 4, hd), jnp.float32)
+        lengths = jnp.array([1, 100, 512, 7], jnp.int32)
+        ref = decode_attention(q, kc, vc, lengths)
+        fn = sa.make_cached_decode(cfg, mesh)
+        got = jax.jit(fn)(q, kc, vc, lengths)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_engine_kernels_active_under_tensor8(self, monkeypatch):
+        """The engine installs BOTH shard_map kernel wrappers under
+        MeshConfig(tensor=8) and serves greedy-identical tokens through the
+        interpreted kernels — the kernels are ACTIVE, not silently dropped.
+        """
+        from llm_instance_gateway_tpu.models import transformer
+        from llm_instance_gateway_tpu.ops import sharded_attention as sa
+        from llm_instance_gateway_tpu.server.engine import (
+            Engine, EngineConfig, Request, SamplingParams)
+
+        monkeypatch.setattr(sa, "FORCE_INTERPRET", True)
+        cfg = self._kernel_cfg(8, 8)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        ecfg = EngineConfig(decode_slots=2, max_seq_len=512,
+                            prefill_buckets=(128,))
+
+        def req():
+            return Request(prompt_tokens=[5, 6, 7],
+                           max_new_tokens=4,
+                           sampling=SamplingParams(temperature=0.0))
+
+        ref_engine = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32)
+        ref_engine.start()
+        try:
+            want = ref_engine.generate(req(), timeout_s=300).output_tokens
+        finally:
+            ref_engine.stop()
+
+        mesh = make_mesh(MeshConfig(tensor=8))
+        engine = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32,
+                        mesh=mesh)
+        assert engine._prefill_attn_fn is not None
+        assert engine._decode_attn_fn is not None
+        # The GSPMD auto-dispatch stays off (it can't partition pallas_call);
+        # the kernels run via the wrappers instead.
+        assert not engine.model_cfg.use_flash_attention
+        assert not engine.model_cfg.use_pallas_decode
+        engine.start()
+        try:
+            got = engine.generate(req(), timeout_s=300)
+            assert got.error is None
+            assert got.output_tokens == want
+        finally:
+            engine.stop()
+
+    def test_engine_falls_back_on_unsupported_heads(self):
+        """TINY_TEST (4 heads) can't split 8 ways: wrappers stay None and
+        the XLA path serves (the pre-existing sharded-engine tests cover
+        numerics)."""
+        from llm_instance_gateway_tpu.models import transformer
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        params = transformer.init_params(TINY_TEST, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        mesh = make_mesh(MeshConfig(tensor=8))
+        engine = Engine(
+            TINY_TEST, params,
+            EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(16,)),
+            eos_id=None, dtype=jnp.float32, mesh=mesh)
+        assert engine._prefill_attn_fn is None
+        assert engine._decode_attn_fn is None
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("seq_shards", [2, 4, 8])
     def test_matches_reference(self, seq_shards):
