@@ -302,7 +302,11 @@ def _build_for_pool(
     pool_rec = InferencePoolReconciler(
         datastore, pool_name, namespace=namespace, on_update=on_pool_update)
     model_rec = InferenceModelReconciler(
-        datastore, pool_name, namespace=namespace)
+        datastore, pool_name, namespace=namespace,
+        # poolRef-less models bind to the deployment's default (first)
+        # pool — matching _check_models_unambiguous's build-time semantics
+        # on every path (seed, file resync, k8s watch events).
+        default_pool=pools[0].name)
     # YAML-seeded documents adopt the watch namespace: the file is local
     # bootstrap state, not an apiserver object — its metadata.namespace
     # (usually "default") must not fight the reconciler pinning.

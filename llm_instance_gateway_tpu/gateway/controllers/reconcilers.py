@@ -54,19 +54,26 @@ class InferenceModelReconciler:
     """inferencemodel_reconciler.go:23-55: store models whose PoolRef targets
     our pool, delete those that stop targeting it (keyed by ModelName)."""
 
-    def __init__(self, datastore: Datastore, pool_name: str, namespace: str = "default"):
+    def __init__(self, datastore: Datastore, pool_name: str,
+                 namespace: str = "default", default_pool: str | None = None):
         self.datastore = datastore
         self.pool_name = pool_name
         self.namespace = namespace
+        # A model WITHOUT a poolRef binds to the deployment's default
+        # (first) pool — the same semantics ``_check_models_unambiguous``
+        # assumes at build time.  Single-pool gateways pass their own name,
+        # so poolRef-less models serve instead of silently 404ing.
+        self.default_pool = default_pool if default_pool is not None else pool_name
+
+    def _targets_us(self, model: InferenceModel) -> bool:
+        ref = (model.spec.pool_ref.name if model.spec.pool_ref is not None
+               else self.default_pool)
+        return ref == self.pool_name
 
     def reconcile(self, model: InferenceModel, deleted: bool = False) -> None:
         if model.namespace != self.namespace:
             return
-        targets_us = (
-            model.spec.pool_ref is not None
-            and model.spec.pool_ref.name == self.pool_name
-        )
-        if deleted or not targets_us:
+        if deleted or not self._targets_us(model):
             # updateDatastore deletes when PoolRef moved away (:45-55).
             self.datastore.delete_model(model.spec.model_name)
             return
@@ -78,9 +85,7 @@ class InferenceModelReconciler:
         desired = {
             m.spec.model_name: m
             for m in models
-            if m.namespace == self.namespace
-            and m.spec.pool_ref is not None
-            and m.spec.pool_ref.name == self.pool_name
+            if m.namespace == self.namespace and self._targets_us(m)
         }
         existing = {m.spec.model_name for m in self.datastore.all_models()}
         for name in existing - set(desired):

@@ -47,6 +47,7 @@ WAITING_METRIC = "tpu:num_requests_waiting"
 KV_USAGE_METRIC = "tpu:kv_cache_usage_perc"
 KV_CAPACITY_METRIC = "tpu:kv_tokens_capacity"
 KV_FREE_METRIC = "tpu:kv_tokens_free"
+KV_PARKED_METRIC = "tpu:kv_parked_tokens"
 DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
 
 
@@ -90,6 +91,7 @@ def families_to_metrics(
         (DECODE_QUEUE_METRIC, lambda m, x: setattr(m, "decode_queue_size", int(x))),
         (KV_CAPACITY_METRIC, lambda m, x: setattr(m, "kv_tokens_capacity", int(x))),
         (KV_FREE_METRIC, lambda m, x: setattr(m, "kv_tokens_free", int(x))),
+        (KV_PARKED_METRIC, lambda m, x: setattr(m, "kv_parked_tokens", int(x))),
         (DECODE_TPS_METRIC, lambda m, x: setattr(m, "decode_tokens_per_sec", float(x))),
     ):
         s = prom_parse.latest_sample(families.get(name, []))

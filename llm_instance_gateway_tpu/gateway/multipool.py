@@ -64,9 +64,20 @@ class MultiPoolServer:
         model = parsed.get("model")
         if not isinstance(model, str) or not model:
             return None, parsed
-        for name, ds in self._datastores.items():
-            if ds.fetch_model(model) is not None:
-                return name, parsed
+        matches = [name for name, ds in self._datastores.items()
+                   if ds.fetch_model(model) is not None]
+        if len(matches) > 1:
+            # Build/resync validation rejects cross-pool modelName
+            # ambiguity, but per-object k8s watch events bypass it (each
+            # pool's informer feeds its own reconciler) — surface the
+            # conflict loudly instead of silently picking by iteration
+            # order.
+            logger.error(
+                "model %r is bound in multiple pools %s (cross-pool "
+                "modelName ambiguity slipped past validation); routing "
+                "to %s", model, matches, matches[0])
+        if matches:
+            return matches[0], parsed
         return None, parsed
 
     def process(self, req_ctx: RequestContext, msg: ProcessingMessage):

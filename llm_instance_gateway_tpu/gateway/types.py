@@ -55,10 +55,13 @@ class Metrics:
     waiting_queue_size: int = 0
     prefill_queue_size: int = 0
     decode_queue_size: int = 0
-    # KV / HBM headroom.
+    # KV / HBM headroom.  ``kv_tokens_free`` already accounts for parked
+    # (prefilled-but-unslotted) KV on the server side; ``kv_parked_tokens``
+    # is exported separately for observability.
     kv_cache_usage_percent: float = 0.0
     kv_tokens_capacity: int = 0
     kv_tokens_free: int = 0
+    kv_parked_tokens: int = 0
     # Serving rates (optional, for latency-aware policies and the simulator).
     decode_tokens_per_sec: float = 0.0
 

@@ -186,6 +186,56 @@ class _Slot:
     pending_first: object = None
 
 
+class _HostBatch:
+    """One device->host transfer for a grouped-prefill output set.
+
+    The pipelined grouped path previously async-copied P separate 0-d
+    device scalars (and later sync-transferred each at materialization),
+    re-paying per-row dispatch round-trips the batched prefill was meant to
+    amortize.  This starts ONE async copy per array and materializes all
+    rows with one ``np.asarray`` per array on first access — mirroring the
+    sync path's single bulk transfer.
+    """
+
+    __slots__ = ("arrays", "_host")
+
+    def __init__(self, *arrays):
+        self.arrays = arrays
+        for a in arrays:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+        self._host = None
+
+    def host(self):
+        if self._host is None:
+            self._host = tuple(np.asarray(a) for a in self.arrays)
+        return self._host
+
+
+class _Row:
+    """Row ``i`` of array ``a`` in a ``_HostBatch``: numpy-protocol view
+    whose first host access materializes the whole batch.  ``dev`` carries
+    the device slice for carry scatters that must stay device-resident
+    (the pipelined loop's no-host-round-trip contract)."""
+
+    __slots__ = ("_batch", "_a", "_i", "dev")
+
+    def __init__(self, batch: _HostBatch, a: int, i: int, dev=None):
+        self._batch, self._a, self._i, self.dev = batch, a, i, dev
+
+    def _value(self):
+        return self._batch.host()[self._a][self._i]
+
+    def __array__(self, dtype=None, copy=None):
+        v = np.asarray(self._value())
+        return v.astype(dtype) if dtype is not None else v
+
+    def __int__(self):
+        return int(self._value())
+
+
 @dataclass
 class _WaitingPrefill:
     """A prefilled request parked in ``decode_wait``: prompt KV held
@@ -390,6 +440,9 @@ class Engine:
         # ``decode_wait``); plus the head-of-line request pulled off the
         # queue but not yet admissible (e.g. a chunked prompt with no lane).
         self.decode_wait: "collections.deque[_WaitingPrefill]" = collections.deque()
+        # Padded KV tokens pinned by decode_wait entries (engine-thread
+        # mutated; read by the scrape thread — int updates are atomic).
+        self._parked_kv_tokens = 0
         self._pending: Request | None = None
         # One long prompt at a time streams chunk-by-chunk into a reserved
         # lane, interleaved with decode blocks (_stream_step).
@@ -663,6 +716,15 @@ class Engine:
                 (s.position if s is not None else 0) for s in self.slots
             ) + (self._stream.next_start if self._stream is not None else 0)
             capacity = self.cfg.decode_slots * self.cfg.max_seq_len
+        # decode_wait KV is real allocated HBM held OUTSIDE the cache/pool;
+        # vLLM's counter (the semantics the 0.8 threshold was tuned against,
+        # backend/vllm/metrics.go:30) reflects ALL allocated blocks, so fold
+        # the parked rows into usage/headroom.  The percent can transiently
+        # exceed 1.0 when every slot is full AND prefill-ahead is parked —
+        # that is honest extra pressure, and the scheduler's `<= threshold`
+        # comparisons only get more conservative.
+        parked = self._parked_kv_tokens
+        used_tokens += parked
         with self._lock:
             tps = self.decode_tps_ema
         running_adapters = self.lora.running_adapters() if self.lora else []
@@ -680,7 +742,8 @@ class Engine:
             "num_requests_waiting": prefill_depth + decode_depth,
             "kv_cache_usage_perc": used_tokens / capacity if capacity else 0.0,
             "kv_tokens_capacity": capacity,
-            "kv_tokens_free": capacity - used_tokens,
+            "kv_tokens_free": max(0, capacity - used_tokens),
+            "kv_parked_tokens": parked,
             "decode_tokens_per_sec": tps,
             "running_lora_adapters": running_adapters,
             "max_lora": max_lora,
@@ -996,6 +1059,7 @@ class Engine:
             w = self.decode_wait[0]
             if w.request.cancelled.is_set():
                 self.decode_wait.popleft()
+                self._parked_kv_tokens -= w.k.shape[2]
                 self._finish(w.request, "cancelled")
                 did = True
                 continue
@@ -1005,6 +1069,7 @@ class Engine:
             if not self._paged_can_admit(w.n):
                 break  # pool backpressure: KV stays parked off-cache
             self.decode_wait.popleft()
+            self._parked_kv_tokens -= w.k.shape[2]
             self._insert_waiting(slot_idx, w, pipelined)
             did = True
         return did
@@ -1047,7 +1112,11 @@ class Engine:
         self._pending_budget_zero = [
             i for i in self._pending_budget_zero if i != slot_idx
         ]
-        self._dev_tokens = self._dev_tokens.at[slot_idx].set(first_token)
+        # Grouped-prefill rows carry their device slice so this scatter
+        # never forces a host sync mid-pipeline.
+        tok_dev = (first_token.dev if isinstance(first_token, _Row)
+                   and first_token.dev is not None else first_token)
+        self._dev_tokens = self._dev_tokens.at[slot_idx].set(tok_dev)
         self._dev_positions = self._dev_positions.at[slot_idx].set(n)
         self._dev_remaining = self._dev_remaining.at[slot_idx].set(
             max(0, req.max_new_tokens - 1))
@@ -1413,6 +1482,10 @@ class Engine:
                 w.lp_info = None
                 return  # done at prefill; never needed a slot
         self.decode_wait.append(w)
+        # Parked prompt KV pins real HBM ([L, 1, bucket, Kh, hd] per entry)
+        # outside the decode cache — count the padded rows so the routing
+        # signal sees the pressure (metrics_snapshot).
+        self._parked_kv_tokens += w.k.shape[2]
 
     def _do_prefill_ahead_group(self, reqs, pipelined: bool) -> None:
         """Batched prefill-ahead: one program, every row parks in
@@ -1473,13 +1546,13 @@ class Engine:
             first_tokens, k, v, (lps, top_vs, top_is) = (
                 self._bucket_prefill_many(live, ns, lora_slots))
             if pipelined:
-                tok_rows = [first_tokens[i] for i in range(len(live))]
-                for t in tok_rows:
-                    try:
-                        t.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                lp_rows = [(lps[i], top_vs[i], top_is[i])
+                # One async DMA per ARRAY (not per row); rows keep their
+                # device slice for the carry scatter and materialize
+                # host-side from the shared bulk transfer.
+                hb = _HostBatch(first_tokens, lps, top_vs, top_is)
+                tok_rows = [_Row(hb, 0, i, dev=first_tokens[i])
+                            for i in range(len(live))]
+                lp_rows = [(_Row(hb, 1, i), _Row(hb, 2, i), _Row(hb, 3, i))
                            for i in range(len(live))]
             else:
                 toks = np.asarray(first_tokens)

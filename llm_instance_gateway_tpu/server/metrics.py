@@ -37,6 +37,8 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         f"tpu:kv_tokens_capacity {snapshot['kv_tokens_capacity']}",
         "# TYPE tpu:kv_tokens_free gauge",
         f"tpu:kv_tokens_free {snapshot['kv_tokens_free']}",
+        "# TYPE tpu:kv_parked_tokens gauge",
+        f"tpu:kv_parked_tokens {snapshot.get('kv_parked_tokens', 0)}",
         "# TYPE tpu:decode_tokens_per_sec gauge",
         f"tpu:decode_tokens_per_sec {snapshot['decode_tokens_per_sec']:.3f}",
         "# TYPE tpu:lora_requests_info gauge",

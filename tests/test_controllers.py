@@ -94,6 +94,33 @@ class TestModelReconciler:
         r.reconcile(model("m1"), deleted=True)
         assert ds.fetch_model("m1") is None
 
+    def test_poolless_model_binds_to_default_pool(self):
+        """A model WITHOUT a poolRef binds to the deployment's default
+        (first) pool on every path — previously the build-time ambiguity
+        check assumed default binding while the reconcilers dropped the
+        model entirely, so its requests 404'd (ADVICE r2)."""
+        poolless = InferenceModel(
+            name="m0", namespace="default", resource_version="1",
+            spec=InferenceModelSpec(model_name="m0", pool_ref=None),
+        )
+        ds = Datastore()
+        # Single-pool: default_pool defaults to the pool's own name.
+        r = InferenceModelReconciler(ds, "my-pool")
+        r.reconcile(poolless)
+        assert ds.fetch_model("m0") is not None
+        # Multi-pool: only the DEFAULT pool's reconciler adopts it.
+        ds2 = Datastore()
+        r2 = InferenceModelReconciler(ds2, "second-pool",
+                                      default_pool="my-pool")
+        r2.reconcile(poolless)
+        assert ds2.fetch_model("m0") is None
+        # resync path agrees with the event path.
+        ds3 = Datastore()
+        r3 = InferenceModelReconciler(ds3, "my-pool",
+                                      default_pool="my-pool")
+        r3.resync([poolless])
+        assert ds3.fetch_model("m0") is not None
+
     def test_resync_diffs_deletions(self):
         ds = Datastore()
         r = InferenceModelReconciler(ds, "my-pool")

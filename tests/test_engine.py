@@ -312,6 +312,52 @@ class TestDecodeWait:
         finally:
             engine.stop()
 
+    def test_parked_kv_counts_in_memory_signal(self):
+        """decode_wait KV pins HBM outside the cache: while rows are parked,
+        ``kv_parked_tokens`` reports the padded rows and both
+        ``kv_cache_usage_perc`` and ``kv_tokens_free`` reflect them (VERDICT
+        r2 #7 — vLLM's counter covers ALL allocated blocks,
+        backend/vllm/metrics.go:30).  After everything drains, parked
+        returns to zero."""
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        engine = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=2, max_seq_len=64,
+                         prefill_buckets=(8, 16)),
+            lora_manager=None, eos_id=None, dtype=jnp.float32,
+        )
+        engine.start()
+        try:
+            hogs = [make_req((1 + i, 2), max_new=40) for i in range(2)]
+            waiters = [make_req((7 + i, 3), max_new=30) for i in range(2)]
+            for r in hogs + waiters:
+                engine.submit(r)
+            deadline = time.monotonic() + 60
+            parked_seen = 0
+            free_with_parked = None
+            while time.monotonic() < deadline:
+                snap = engine.metrics_snapshot()
+                if snap["kv_parked_tokens"] > parked_seen:
+                    parked_seen = snap["kv_parked_tokens"]
+                    free_with_parked = snap["kv_tokens_free"]
+                    # Folded into usage: used (incl. parked) + free == cap.
+                    assert (snap["kv_tokens_free"]
+                            <= snap["kv_tokens_capacity"]
+                            - snap["kv_parked_tokens"])
+                if all(r.done.is_set() for r in hogs + waiters):
+                    break
+                time.sleep(0.005)
+            # Each waiter parks one padded bucket-8 row.
+            assert parked_seen >= 8
+            assert free_with_parked is not None
+            for r in hogs + waiters:
+                assert r.done.wait(60) and r.error is None
+            snap = engine.metrics_snapshot()
+            assert snap["kv_parked_tokens"] == 0
+        finally:
+            engine.stop()
+
     def test_waiting_results_match_unsaturated_results(self, engine_env):
         """A request that waited in decode_wait produces the same greedy
         tokens as the same request run alone (batch-consistency extends to

@@ -79,6 +79,16 @@ class TestMultiPoolServer:
         ctx, _ = self._body_phase("model-a")
         assert ctx.target_pod.address in self.addrs_a
 
+    def test_cross_pool_ambiguity_logged_and_first_wins(self, caplog):
+        """Per-object k8s watch events bypass build/resync validation, so a
+        modelName landing in two pools must be surfaced loudly (ADVICE r2)
+        — routing still picks the first pool deterministically."""
+        self.ds_b.store_model(make_model("model-a"))  # now in both pools
+        with caplog.at_level("ERROR"):
+            ctx, _ = self._body_phase("model-a")
+        assert ctx.target_pod.address in self.addrs_a  # first pool wins
+        assert any("multiple pools" in r.message for r in caplog.records)
+
     def test_unknown_model_maps_to_400(self):
         with pytest.raises(ProcessingError) as ei:
             self._body_phase("no-such-model")

@@ -43,6 +43,7 @@ def main() -> None:
     ap.add_argument("--prompt-len", type=int, default=100)
     args = ap.parse_args()
 
+    bench.install_sigterm_cleanup()
     bench._claim_device_with_retry()
     bench._device_watchdog()
     cfg = bench.bench_model_cfg()
