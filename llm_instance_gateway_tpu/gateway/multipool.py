@@ -48,6 +48,9 @@ class MultiPoolServer:
         self._servers = servers
         self._datastores = datastores
         self._default = default
+        # Conflicts already surfaced: a persistent cross-pool ambiguity sits
+        # on the hot request path, so it logs ONCE per model, not per request.
+        self._warned_conflicts: set[str] = set()
 
     @property
     def target_pod_header(self) -> str:
@@ -66,12 +69,13 @@ class MultiPoolServer:
             return None, parsed
         matches = [name for name, ds in self._datastores.items()
                    if ds.fetch_model(model) is not None]
-        if len(matches) > 1:
+        if len(matches) > 1 and model not in self._warned_conflicts:
             # Build/resync validation rejects cross-pool modelName
             # ambiguity, but per-object k8s watch events bypass it (each
             # pool's informer feeds its own reconciler) — surface the
             # conflict loudly instead of silently picking by iteration
             # order.
+            self._warned_conflicts.add(model)
             logger.error(
                 "model %r is bound in multiple pools %s (cross-pool "
                 "modelName ambiguity slipped past validation); routing "

@@ -91,6 +91,13 @@ def make_flash_prefill(cfg: ModelConfig, mesh: Mesh):
     """
     from llm_instance_gateway_tpu.ops.pallas_attention import flash_attention
 
+    if not mesh_supports(cfg, mesh):
+        raise ValueError(
+            "mesh tensor split is not group-aligned for "
+            f"H={cfg.n_heads}/K={cfg.n_kv_heads} (mesh {dict(mesh.shape)}); "
+            "a shard-local kernel would mis-map query heads to KV groups — "
+            "gate call sites on mesh_supports()")
+
     def attention_fn(q, k, v, positions):
         del positions
         db = _batch_axis(q.shape[0], mesh)
@@ -121,6 +128,13 @@ def make_cached_decode(cfg: ModelConfig, mesh: Mesh):
     from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
         decode_attention,
     )
+
+    if not mesh_supports(cfg, mesh):
+        raise ValueError(
+            "mesh tensor split is not group-aligned for "
+            f"H={cfg.n_heads}/K={cfg.n_kv_heads} (mesh {dict(mesh.shape)}); "
+            "a shard-local kernel would mis-map query heads to KV groups — "
+            "gate call sites on mesh_supports()")
 
     def attention_fn(q, k_cache, v_cache, lengths):
         db = _batch_axis(q.shape[0], mesh)
