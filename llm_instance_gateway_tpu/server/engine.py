@@ -119,8 +119,11 @@ class EngineConfig:
     # tokens/step.  Greedy rows (temperature 0) accept the longest matching
     # prefix — EXACT greedy parity with non-speculative decoding; sampled
     # rows fall back to one verified token per cycle.  Requires
-    # ``draft_params``/``draft_cfg`` at Engine construction; v1 supports the
-    # sync loop with the contiguous-lane cache (no paged/pipelined/mesh).
+    # ``draft_params``/``draft_cfg`` at Engine construction.  Composes with
+    # BOTH engine loops and ``decode_steps_per_sync`` (cycles are fused into
+    # one device-side scan of ceil(steps/(K+1)) cycles per dispatch — the
+    # bench's pipelined fast path included); the contiguous-lane cache
+    # without a mesh is still required (paged/mesh compositions TBD).
     speculative_k: int = 0
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
@@ -293,11 +296,12 @@ class Engine:
             if draft_params is None or draft_cfg is None:
                 raise ValueError(
                     "speculative_k > 0 requires draft_params and draft_cfg")
-            if (self.cfg.paged_kv_block is not None
-                    or self.cfg.pipeline_decode or mesh is not None):
+            if self.cfg.paged_kv_block is not None or mesh is not None:
                 raise ValueError(
-                    "speculative decoding v1 supports the sync loop with "
-                    "the contiguous-lane cache (no paged/pipelined/mesh)")
+                    "speculative decoding supports the contiguous-lane "
+                    "cache without a mesh (paged/mesh compositions TBD); "
+                    "both engine loops and decode_steps_per_sync > 1 are "
+                    "supported")
             if draft_cfg.vocab_size != model_cfg.vocab_size:
                 raise ValueError(
                     "draft and target models must share the token space "
@@ -509,9 +513,13 @@ class Engine:
             self.draft_cache = transformer.init_decode_cache(
                 draft_cfg, b, self.cfg.max_seq_len, dtype=dtype)
             self._spec_ok = np.zeros((b,), bool)
-            # (token, position) the draft hasn't ingested yet — only set
-            # after a FULLY-accepted cycle (d_K's kv is missing then).
-            self._spec_extra: list[tuple[int, int] | None] = [None] * b
+            # The (token, position) the draft hasn't ingested yet — only set
+            # after a FULLY-accepted cycle (d_K's kv is missing then).  Host
+            # mirrors for the sync loop; the pipelined loop keeps the same
+            # triple device-resident in its dispatch carry.
+            self._spec_extra_tok = np.zeros((b,), np.int32)
+            self._spec_extra_pos = np.zeros((b,), np.int32)
+            self._spec_has_extra = np.zeros((b,), bool)
             self.spec_cycles = 0
             self.spec_emitted = 0
 
@@ -523,12 +531,10 @@ class Engine:
             self._jit_draft_prefill = jax.jit(_draft_prefill)
             self._jit_draft_insert = jax.jit(
                 transformer.insert_prefill, donate_argnames=("cache",))
-            self._jit_draft_propose = jax.jit(
-                functools.partial(self._draft_propose_impl, draft_cfg),
-                donate_argnames=("cache",), static_argnames=("k_steps",))
-            self._jit_verify = jax.jit(
-                functools.partial(self._verify_impl, model_cfg),
-                donate_argnames=("cache",))
+            self._jit_spec_block = jax.jit(
+                functools.partial(self._spec_block_impl, model_cfg, draft_cfg),
+                donate_argnames=("cache", "draft_cache"),
+                static_argnames=("n_cycles", "k_steps"))
 
     # ------------------------------------------------------------------
     # jitted compute
@@ -772,7 +778,7 @@ class Engine:
         self.slots[i] = None
         if self._spec:
             self._spec_ok[i] = False
-            self._spec_extra[i] = None
+            self._spec_has_extra[i] = False
         self._slot_lora[i] = -1
         self._slot_remaining[i] = 0
         if self.paged:
@@ -1123,6 +1129,11 @@ class Engine:
         slot = _Slot(request=req, lora_slot=lora_slot, position=n)
         slot.pending_first = (first_token, lp_info)
         self._register_slot(slot_idx, slot)
+        if self._spec:
+            # _register_slot set the row's sampling params _draft_admit
+            # gates on; the device extra flag resets for the new occupant.
+            self._dev_has_extra = self._dev_has_extra.at[slot_idx].set(False)
+            self._draft_admit(slot_idx, req.prompt_tokens)
 
     def _insert_waiting(self, slot_idx: int, w: _WaitingPrefill,
                         pipelined: bool) -> None:
@@ -1149,74 +1160,145 @@ class Engine:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _draft_propose_impl(cfg, params, cache, ctx_tokens, ctx_positions,
-                            ctx_len, k_steps: int):
-        """Ingest <=2 context tokens the draft hasn't seen, then propose
-        ``k_steps`` greedy tokens autoregressively.  Returns
-        (draft [B, k_steps] int32, new cache)."""
-        b = ctx_tokens.shape[0]
+    def _spec_block_impl(model_cfg, draft_cfg, params, draft_params,
+                         lora_bufs, cache, draft_cache, tokens, positions,
+                         remaining, extra_tok, extra_pos, has_extra, spec_ok,
+                         temp, topk, topp, key, slot_ids, eos_id,
+                         n_cycles: int, k_steps: int):
+        """``n_cycles`` fused speculative cycles, entirely device-side.
 
-        def greedy_pick(lg):
+        Each cycle: the draft ingests the <=2 context tokens it hasn't seen,
+        proposes ``k_steps`` greedy tokens autoregressively, and the target
+        scores [cur, d_1..d_K] in ONE multi-token forward (extend_step).
+        Greedy rows accept the longest matching prefix plus the target's
+        bonus token — EXACT greedy parity; sampled / non-speculating rows
+        emit one token from the first position's logits.  Acceptance,
+        budget, and EOS truncation are all mask arithmetic, so the whole
+        block is one jitted program: the same dispatch/readback shape as
+        ``_decode_impl``, which is what lets speculation compose with the
+        pipelined loop and ``decode_steps_per_sync > 1``.
+
+        Stale-KV safety: cycle writes at positions p..p+K may leave garbage
+        beyond the accepted prefix, but the NEXT cycle's K+1 writes start at
+        the corrected position and always cover the stale range — the same
+        invariant the single-cycle version relied on.
+
+        Returns flattened [T=n_cycles*(K+1), B] token/valid/logprob arrays —
+        the exact layout ``_decode_impl`` produces — plus the device carries
+        (next token/position/budget, draft-extra triple) and both caches.
+        """
+        b = tokens.shape[0]
+        s_max = cache["k"].shape[2]
+        kp1 = k_steps + 1
+
+        def greedy_pick(lg, vocab):
             # Mask the zero-logit vocab-PADDING columns (lm_head pads to a
             # multiple of 128) or argmax can emit ids the tokenizer lacks.
-            masked = jnp.where(
-                jnp.arange(lg.shape[-1]) < cfg.vocab_size, lg, -jnp.inf)
+            masked = jnp.where(jnp.arange(lg.shape[-1]) < vocab, lg, -jnp.inf)
             return jnp.argmax(masked, axis=-1).astype(jnp.int32)
 
-        logits2, cache = transformer.extend_step(
-            cfg, params, cache, ctx_tokens, ctx_positions)
-        idx = ctx_len - 1  # last REAL ctx index per row
-        last = logits2[jnp.arange(b), idx]  # [B, V]
-        cur_pos = ctx_positions[jnp.arange(b), idx]
-        d1 = greedy_pick(last)
+        def one_cycle(carry, cycle_key):
+            (cache, draft_cache, tokens, positions, remaining,
+             extra_tok, extra_pos, has_extra) = carry
+            active = remaining > 0
+            safe_pos = jnp.minimum(positions, s_max - 1)
+            # --- draft catch-up + propose ---
+            ctx_tokens = jnp.stack(
+                [jnp.where(has_extra, extra_tok, tokens), tokens], axis=1)
+            ctx_positions = jnp.stack(
+                [jnp.where(has_extra, jnp.minimum(extra_pos, s_max - 1),
+                           safe_pos),
+                 jnp.where(has_extra, safe_pos,
+                           jnp.minimum(positions + 1, s_max - 1))], axis=1)
+            idx = jnp.where(has_extra, 1, 0)  # last REAL ctx index per row
+            logits2, draft_cache = transformer.extend_step(
+                draft_cfg, draft_params, draft_cache, ctx_tokens,
+                ctx_positions)
+            last = logits2[jnp.arange(b), idx]  # [B, V]
+            cur_pos = ctx_positions[jnp.arange(b), idx]
+            d1 = greedy_pick(last, draft_cfg.vocab_size)
 
-        def body(carry, _):
-            tok, pos, cache = carry
-            lg, cache = transformer.decode_step(cfg, params, cache, tok, pos)
-            nxt = greedy_pick(lg)
-            return (nxt, pos + 1, cache), nxt
+            def body(c, _):
+                tok, pos, dcache = c
+                lg, dcache = transformer.decode_step(
+                    draft_cfg, draft_params, dcache, tok, pos)
+                nxt = greedy_pick(lg, draft_cfg.vocab_size)
+                return (nxt, jnp.minimum(pos + 1, s_max - 1), dcache), nxt
 
-        if k_steps > 1:
-            (_, _, cache), rest = jax.lax.scan(
-                body, (d1, cur_pos + 1, cache), None, length=k_steps - 1)
-            draft = jnp.concatenate([d1[None], rest], axis=0).T  # [B, K]
-        else:
-            draft = d1[:, None]
-        return draft, cache
+            if k_steps > 1:
+                (_, _, draft_cache), rest = jax.lax.scan(
+                    body,
+                    (d1, jnp.minimum(cur_pos + 1, s_max - 1), draft_cache),
+                    None, length=k_steps - 1)
+                draft = jnp.concatenate([d1[None], rest], axis=0).T  # [B, K]
+            else:
+                draft = d1[:, None]
 
-    @staticmethod
-    def _verify_impl(cfg, params, lora_bufs, cache, cur_tokens, draft,
-                     positions, spec_ok, temp, topk, topp, key, slot_ids):
-        """Score [cur, d_1..d_K] in one multi-token forward; greedy rows
-        accept the longest matching prefix plus the target's bonus token,
-        sampled rows emit one token from the first position's logits.
-        Returns (emitted [B,K+1], count [B], lp, top_v, top_i, cache)."""
-        b = cur_tokens.shape[0]
-        k = draft.shape[1]
-        s_max = cache["k"].shape[2]
-        tokens = jnp.concatenate([cur_tokens[:, None], draft], axis=1)
-        pos = positions[:, None] + jnp.arange(k + 1)[None]
-        # Clamp like decode: overflow rows finish on the host's max_seq
-        # check; the clamped scatter writes garbage the mask hides.
-        pos = jnp.minimum(pos, s_max - 1)
-        logits, cache = transformer.extend_step(
-            cfg, params, cache, tokens, pos,
-            lora_bufs=lora_bufs, slot_ids=slot_ids)
-        masked = jnp.where(
-            jnp.arange(logits.shape[-1]) < cfg.vocab_size, logits, -jnp.inf)
-        greedy = jnp.argmax(masked, axis=-1).astype(jnp.int32)  # [B, K+1]
-        first_sampled = sample(
-            logits[:, 0], key, temp, topk, topp,
-            valid_vocab=cfg.vocab_size)
-        greedy_row = spec_ok & (temp <= 0.0)
-        e0 = jnp.where(greedy_row, greedy[:, 0], first_sampled)
-        # d_{i+1} must equal the target's greedy continuation g_i.
-        match = (draft == greedy[:, :-1]) & greedy_row[:, None]
-        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        count = jnp.where(greedy_row, m + 1, 1)
-        emitted = greedy.at[:, 0].set(e0)
-        lp, top_v, top_i = _logprob_info(logits, emitted, cfg.vocab_size)
-        return emitted, count, lp, top_v, top_i, cache
+            # --- target verify: [cur, d_1..d_K] in one forward ---
+            vtokens = jnp.concatenate([tokens[:, None], draft], axis=1)
+            # Clamp like decode: overflow rows finish on the host's max_seq
+            # check; the clamped scatter writes garbage the mask hides.
+            vpos = jnp.minimum(
+                positions[:, None] + jnp.arange(kp1)[None], s_max - 1)
+            logits, cache = transformer.extend_step(
+                model_cfg, params, cache, vtokens, vpos,
+                lora_bufs=lora_bufs, slot_ids=slot_ids)
+            greedy = greedy_pick(logits, model_cfg.vocab_size)  # [B, K+1]
+            first_sampled = sample(
+                logits[:, 0], cycle_key, temp, topk, topp,
+                valid_vocab=model_cfg.vocab_size)
+            greedy_row = spec_ok & (temp <= 0.0)
+            e0 = jnp.where(greedy_row, greedy[:, 0], first_sampled)
+            # d_{i+1} must equal the target's greedy continuation g_i.
+            match = (draft == greedy[:, :-1]) & greedy_row[:, None]
+            m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            count = jnp.where(greedy_row, m + 1, 1)
+            emitted = greedy.at[:, 0].set(e0)
+            lp, top_v, top_i = _logprob_info(
+                logits, emitted, model_cfg.vocab_size)
+
+            # --- device-side truncation: frozen rows, budget, EOS ---
+            count = jnp.where(active, jnp.minimum(count, remaining), 0)
+            in_count = jnp.arange(kp1)[None] < count[:, None]
+            iseos = emitted == eos_id
+            ex_eos = (jnp.cumsum(iseos.astype(jnp.int32), axis=1)
+                      - iseos.astype(jnp.int32))  # EOS strictly before j
+            valid = in_count & (ex_eos == 0)
+            eff = jnp.sum(valid.astype(jnp.int32), axis=1)
+            hit_eos = jnp.any(valid & iseos, axis=1)
+
+            # --- carry updates ---
+            new_tok = jnp.where(
+                eff > 0, emitted[jnp.arange(b), jnp.maximum(eff - 1, 0)],
+                tokens)
+            new_positions = positions + eff
+            new_remaining = jnp.where(hit_eos, 0, remaining - eff)
+            # Fully-accepted cycle: d_K's kv is missing from the draft lane;
+            # hand it to the next cycle's catch-up.
+            full = greedy_row & active & (eff == kp1) & ~hit_eos
+            new_extra_tok = jnp.where(full, draft[:, k_steps - 1], extra_tok)
+            new_extra_pos = jnp.where(full, positions + k_steps, extra_pos)
+            return ((cache, draft_cache, new_tok, new_positions,
+                     new_remaining, new_extra_tok, new_extra_pos, full),
+                    (emitted, valid, lp, top_v, top_i))
+
+        keys = jax.random.split(key, n_cycles)
+        carry, (emitted, valid, lps, top_v, top_i) = jax.lax.scan(
+            one_cycle,
+            (cache, draft_cache, tokens, positions, remaining,
+             extra_tok, extra_pos, has_extra), keys)
+        (cache, draft_cache, next_tokens, next_positions, next_remaining,
+         next_extra_tok, next_extra_pos, next_has_extra) = carry
+        # Flatten [C, B, K+1] -> [C*(K+1), B]: cycle-major, within-cycle
+        # order preserved — the host walks it exactly like decode steps.
+        t = n_cycles * kp1
+        flat = lambda a: jnp.swapaxes(a, 1, 2).reshape((t,) + a.shape[1:2])
+        top_flat = lambda a: jnp.swapaxes(a, 1, 2).reshape(
+            (t, b) + a.shape[3:])
+        return (flat(emitted), flat(valid), flat(lps), top_flat(top_v),
+                top_flat(top_i), next_tokens, next_positions, next_remaining,
+                next_extra_tok, next_extra_pos, next_has_extra,
+                cache, draft_cache)
 
     def _draft_admit(self, slot_idx: int, prompt_tokens: list[int]) -> None:
         """Mirror a freshly admitted prompt into the draft model's lane so
@@ -1241,56 +1323,69 @@ class Engine:
             self.draft_cache = self._jit_draft_insert(
                 self.draft_cache, k, v, jnp.int32(slot_idx), jnp.int32(n))
             self._spec_ok[slot_idx] = True
-            self._spec_extra[slot_idx] = None
+            self._spec_has_extra[slot_idx] = False
+            if self.cfg.pipeline_decode and hasattr(self, "_dev_has_extra"):
+                self._dev_has_extra = self._dev_has_extra.at[slot_idx].set(
+                    False)
         except Exception:
             logger.exception("draft admit failed; slot %d decodes "
                              "non-speculatively", slot_idx)
             self._spec_ok[slot_idx] = False
 
+    def _spec_cycles_per_sync(self) -> int:
+        """Speculative cycles per dispatch.
+
+        All-greedy batches: ceil(steps/(K+1)) cycles keeps the per-dispatch
+        token cadence comparable to ``decode_steps_per_sync`` plain steps
+        (each cycle emits up to K+1 tokens).  Mixed batches: sampled rows
+        advance only ONE token per cycle, so the short schedule would
+        throttle them (K+1)x per dispatch — run a full ``steps`` cycles
+        instead, which restores sampled-row cadence and lets greedy rows
+        run ahead (budget masks cap them).  Two schedules = two compiled
+        block variants, both cached after first use."""
+        steps = max(1, self.cfg.decode_steps_per_sync)
+        mixed = any(
+            s is not None
+            and not (self._spec_ok[i] and self._slot_temp[i] <= 0.0)
+            for i, s in enumerate(self.slots))
+        if mixed:
+            return steps
+        k1 = self.cfg.speculative_k + 1
+        return max(1, -(-steps // k1))
+
     def _do_spec_step(self) -> None:
-        """One speculative cycle: draft proposes K, target verifies K+1."""
-        b = self.cfg.decode_slots
+        """Sync-loop speculative dispatch: one fused block of cycles."""
         k = self.cfg.speculative_k
-        ctx_tokens = np.zeros((b, 2), np.int32)
-        ctx_positions = np.zeros((b, 2), np.int32)
-        ctx_len = np.ones((b,), np.int32)
-        s_max = self.cfg.max_seq_len
-        for i in range(b):
-            tok = int(self._slot_tokens[i])
-            pos = int(self._slot_positions[i])
-            extra = self._spec_extra[i] if self._spec_ok[i] else None
-            if extra is not None:
-                ctx_tokens[i] = (extra[0], tok)
-                ctx_positions[i] = (min(extra[1], s_max - 1),
-                                    min(pos, s_max - 1))
-                ctx_len[i] = 2
-            else:
-                ctx_tokens[i, 0] = tok
-                ctx_positions[i] = (min(pos, s_max - 1),
-                                    min(pos + 1, s_max - 1))
+        n_cycles = self._spec_cycles_per_sync()
         t0 = time.perf_counter()
-        draft, self.draft_cache = self._jit_draft_propose(
-            self.draft_params, self.draft_cache,
-            jnp.asarray(ctx_tokens), jnp.asarray(ctx_positions),
-            jnp.asarray(ctx_len), k_steps=k)
-        (emitted, count, lps, top_v, top_i, self.cache) = self._jit_verify(
-            self.params, self._lora_buffers(), self.cache,
-            jnp.asarray(self._slot_tokens), draft,
-            jnp.asarray(self._slot_positions),
-            jnp.asarray(self._spec_ok),
-            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
-            jnp.asarray(self._slot_topp), self._next_key(),
-            jnp.asarray(self._slot_lora),
-        )
-        emitted_np = np.asarray(emitted)
-        count_np = np.asarray(count)
-        draft_np = np.asarray(draft)
+        (toks, valid, lps, top_v, top_i, _next_tok, _next_pos, _next_rem,
+         next_etok, next_epos, next_has, self.cache, self.draft_cache) = (
+            self._jit_spec_block(
+                self.params, self.draft_params, self._lora_buffers(),
+                self.cache, self.draft_cache,
+                jnp.asarray(self._slot_tokens),
+                jnp.asarray(self._slot_positions),
+                jnp.asarray(self._slot_remaining),
+                jnp.asarray(self._spec_extra_tok),
+                jnp.asarray(self._spec_extra_pos),
+                jnp.asarray(self._spec_has_extra),
+                jnp.asarray(self._spec_ok),
+                jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+                jnp.asarray(self._slot_topp), self._next_key(),
+                jnp.asarray(self._slot_lora), self._eos_for_device,
+                n_cycles=n_cycles, k_steps=k))
+        toks_np = np.asarray(toks)  # [T, B]
+        valid_np = np.asarray(valid)
         lps_np = np.asarray(lps)
         top_v_np = np.asarray(top_v)
         top_i_np = np.asarray(top_i)
+        etok_np = np.asarray(next_etok)
+        epos_np = np.asarray(next_epos)
+        ehas_np = np.asarray(next_has)
         step_s = time.perf_counter() - t0
         n_tokens = 0
-        self.spec_cycles += 1
+        self.spec_cycles += n_cycles
+        t_steps = toks_np.shape[0]
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -1299,17 +1394,15 @@ class Engine:
                 self._finish(req, "cancelled")
                 self._clear_slot(i)
                 continue
-            cnt = int(count_np[i])
-            start_pos = int(self._slot_positions[i])
             finished = False
-            used = 0
-            for j in range(cnt):
-                tok = int(emitted_np[i, j])
+            for j in range(t_steps):
+                if not valid_np[j, i]:
+                    continue  # rejected / frozen / past-EOS entry
+                tok = int(toks_np[j, i])
                 req.output_tokens.append(tok)
-                self._store_logprobs(req, lps_np[i, j], top_v_np[i, j],
-                                     top_i_np[i, j])
+                self._store_logprobs(req, lps_np[j, i], top_v_np[j, i],
+                                     top_i_np[j, i])
                 n_tokens += 1
-                used += 1
                 slot.position += 1
                 self._slot_tokens[i] = tok
                 self._slot_remaining[i] = max(0, self._slot_remaining[i] - 1)
@@ -1324,13 +1417,12 @@ class Engine:
             if finished:
                 continue
             self._slot_positions[i] = slot.position
-            # Draft bookkeeping: its own accepted proposals' KV are already
-            # in its lane; only a FULLY accepted cycle leaves d_K missing.
-            if self._spec_ok[i] and used == cnt and cnt == k + 1:
-                self._spec_extra[i] = (int(draft_np[i, k - 1]),
-                                       start_pos + k)
-            else:
-                self._spec_extra[i] = None
+            # Draft catch-up state from the device carry.  A host-only stop
+            # (custom ids) above cleared the slot instead; its lane resets
+            # on reuse via _draft_admit.
+            self._spec_extra_tok[i] = etok_np[i]
+            self._spec_extra_pos[i] = epos_np[i]
+            self._spec_has_extra[i] = bool(ehas_np[i])
         self.spec_emitted += n_tokens
         with self._lock:
             self.total_generated += n_tokens
@@ -1948,6 +2040,12 @@ class Engine:
         self._dev_tokens = jnp.zeros((b,), jnp.int32)
         self._dev_positions = jnp.zeros((b,), jnp.int32)
         self._dev_remaining = jnp.zeros((b,), jnp.int32)
+        if self._spec:
+            # Draft catch-up triple lives on device: spec blocks update it
+            # in their carry, no host round-trip.
+            self._dev_extra_tok = jnp.zeros((b,), jnp.int32)
+            self._dev_extra_pos = jnp.zeros((b,), jnp.int32)
+            self._dev_has_extra = jnp.zeros((b,), bool)
         self._pending_budget_zero: list[int] = []
         inflight: dict | None = None
         while self._running:
@@ -2023,6 +2121,11 @@ class Engine:
                 self._paged_free_row(slot_idx)  # don't strand a slot-less row
 
     def _dispatch_block(self) -> dict:
+        if self._spec and any(
+            s is not None and self._spec_ok[i] and self._slot_temp[i] <= 0.0
+            for i, s in enumerate(self.slots)
+        ):
+            return self._dispatch_spec_block()
         n_steps = max(1, self.cfg.decode_steps_per_sync)
         self._paged_ensure_decode(n_steps, pipelined=True)
         if self._pending_budget_zero:
@@ -2060,6 +2163,56 @@ class Engine:
             "t0": time.perf_counter(),
         }
 
+    def _dispatch_spec_block(self) -> dict:
+        """Pipelined speculative dispatch: same block contract as the plain
+        path — flattened [T, B] outputs plus device carries — so
+        ``_process_block`` consumes it unchanged.  The draft-extra triple
+        rides the device carry; between spec and plain blocks (e.g. the
+        only greedy row finished) extras may go stale, which degrades
+        proposal quality for a cycle but never correctness: the target's
+        verify is exact regardless of what the draft proposes."""
+        k = self.cfg.speculative_k
+        n_cycles = self._spec_cycles_per_sync()
+        if self._pending_budget_zero:
+            idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
+            self._dev_remaining = self._dev_remaining.at[idxs].set(0)
+            self._pending_budget_zero.clear()
+        (toks, valid, lps, top_v, top_i, next_tokens, next_positions,
+         next_remaining, next_etok, next_epos, next_has,
+         self.cache, self.draft_cache) = self._jit_spec_block(
+            self.params, self.draft_params, self._lora_buffers(),
+            self.cache, self.draft_cache,
+            self._dev_tokens, self._dev_positions, self._dev_remaining,
+            self._dev_extra_tok, self._dev_extra_pos, self._dev_has_extra,
+            jnp.asarray(self._spec_ok),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp), self._next_key(),
+            jnp.asarray(self._slot_lora), self._eos_for_device,
+            n_cycles=n_cycles, k_steps=k)
+        self._dev_tokens = next_tokens
+        self._dev_positions = next_positions
+        self._dev_remaining = next_remaining
+        self._dev_extra_tok = next_etok
+        self._dev_extra_pos = next_epos
+        self._dev_has_extra = next_has
+        self.spec_cycles += n_cycles
+        for arr in (toks, valid, lps, top_v, top_i):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        return {
+            "toks": toks,
+            "valid": valid,
+            "lps": lps,
+            "top_v": top_v,
+            "top_i": top_i,
+            "rows": list(self.slots),
+            "n_steps": n_cycles * (k + 1),
+            "t0": time.perf_counter(),
+            "spec": True,
+        }
+
     def _process_block(self, blk: dict, current: dict | None) -> None:
         toks_np = np.asarray(blk["toks"])  # overlaps with `current` computing
         valid_np = np.asarray(blk["valid"])
@@ -2067,6 +2220,7 @@ class Engine:
         top_v_np = np.asarray(blk["top_v"])
         top_i_np = np.asarray(blk["top_i"])
         n_tokens = 0
+        n_pending = 0  # prefill first-tokens materialized in this block
         for i, slot in enumerate(blk["rows"]):
             if slot is None:
                 continue
@@ -2094,6 +2248,7 @@ class Engine:
                     self._store_logprobs(req, np.asarray(lp0),
                                          np.asarray(tv0), np.asarray(ti0))
                 n_tokens += 1
+                n_pending += 1
                 self._record_ttft(req)
                 if self._is_finished(req, tok0):
                     finished = True
@@ -2125,6 +2280,9 @@ class Engine:
                 if current is not None and current["rows"][i] is slot:
                     current["rows"][i] = None  # its lane in-flight is garbage
         step_s = time.perf_counter() - blk["t0"]
+        if blk.get("spec"):
+            # First tokens come from prefill, not speculation.
+            self.spec_emitted += n_tokens - n_pending
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0

@@ -29,12 +29,25 @@ from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 CFG = TINY_TEST
 
 
-@pytest.mark.parametrize("pipeline,prefill_batch", [
-    (False, 1), (True, 1), (False, 3), (True, 3),
-], ids=["sync", "pipelined", "sync-grouped", "pipelined-grouped"])
-def test_request_storm_terminates(pipeline, prefill_batch):
+@pytest.mark.parametrize("pipeline,prefill_batch,spec_k", [
+    (False, 1, 0), (True, 1, 0), (False, 3, 0), (True, 3, 0),
+    (False, 1, 2), (True, 1, 2),
+], ids=["sync", "pipelined", "sync-grouped", "pipelined-grouped",
+        "sync-spec", "pipelined-spec"])
+def test_request_storm_terminates(pipeline, prefill_batch, spec_k):
+    import dataclasses
+
     rng = random.Random(0)
     params = transformer.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    draft_kw = {}
+    if spec_k:
+        dcfg = dataclasses.replace(
+            CFG, name="chaos-draft", d_model=32, n_layers=1, n_heads=2,
+            n_kv_heads=1, d_ff=64, head_dim=16)
+        draft_kw = dict(
+            draft_cfg=dcfg,
+            draft_params=transformer.init_params(
+                dcfg, jax.random.PRNGKey(5), dtype=jnp.float32))
     lora = LoRAManager(CFG, dtype=jnp.float32)
     dims = target_dims(CFG)
     np_rng = np.random.RandomState(0)
@@ -48,8 +61,8 @@ def test_request_storm_terminates(pipeline, prefill_batch):
         CFG, params,
         EngineConfig(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
                      decode_steps_per_sync=3, pipeline_decode=pipeline,
-                     prefill_batch=prefill_batch),
-        lora_manager=lora, eos_id=7, dtype=jnp.float32,
+                     prefill_batch=prefill_batch, speculative_k=spec_k),
+        lora_manager=lora, eos_id=7, dtype=jnp.float32, **draft_kw,
     )
     engine.start()
     try:
