@@ -49,6 +49,11 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             time.time(),
         ),
     ]
+    if "prefix_reused_tokens" in snapshot:
+        lines += [
+            "# TYPE tpu:prefix_reused_tokens counter",
+            f"tpu:prefix_reused_tokens {snapshot['prefix_reused_tokens']}",
+        ]
     for name, value in (extra or {}).items():
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")
