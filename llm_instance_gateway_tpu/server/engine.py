@@ -907,11 +907,6 @@ class Engine:
             out.append(blk)
         return out
 
-    def _prefix_match_len(self, prompt: list[int],
-                          adapter: str | None) -> int:
-        """How many BLOCKS would map (no incref)."""
-        return len(self._prefix_match_blocks(prompt, adapter))
-
     def _prefix_match_and_map(self, row: int, prompt: list[int],
                               adapter: str | None) -> int:
         """Map the longest cached prefix into ``row``'s table (increfs).
@@ -1510,6 +1505,7 @@ class Engine:
             # map — the blocks return to the evictable LRU — and fall back
             # to the full-prompt program, which can evict them.
             self._paged_free_row(slot_idx)
+            self.prefix_reused_tokens -= reused  # nothing was reused
             return None
         try:
             self._sync_tables()
@@ -1538,6 +1534,7 @@ class Engine:
             # must not strand the mapped prefix refs or fresh suffix blocks
             # (the caller's cleanup only fires once it knows slot_idx).
             self._paged_free_row(slot_idx)
+            self.prefix_reused_tokens -= reused  # nothing was reused
             raise
         return slot_idx, first_token, n, lora_slot, lp_info
 

@@ -13,15 +13,10 @@ as they would ride DCN.
 """
 
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
 pytestmark = pytest.mark.e2e
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
 import os, sys
@@ -80,39 +75,12 @@ print(f"MULTIHOST OK pid={jax.process_index()} loss={float(loss):.6f}",
 """
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def test_two_process_mesh_trains():
-    port = _free_port()
-    procs = []
-    for pid in (0, 1):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["GRAFT_REPO"] = REPO
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-        env["TPU_GATEWAY_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["TPU_GATEWAY_PROCESS_ID"] = str(pid)
-        env["TPU_GATEWAY_NUM_PROCESSES"] = "2"
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        ))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=300)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-3000:]
+    from llm_instance_gateway_tpu.parallel.multihost_check import (
+        run_two_process,
+    )
+
+    outs = run_two_process(WORKER)
     losses = set()
     for out in outs:
         ok_lines = [l for l in out.splitlines() if l.startswith("MULTIHOST OK")]
@@ -120,3 +88,19 @@ def test_two_process_mesh_trains():
         losses.add(ok_lines[0].rsplit("loss=", 1)[1])
     # Both controllers must agree on the global loss (one SPMD program).
     assert len(losses) == 1, losses
+
+
+def test_two_process_mesh_serves():
+    """Multi-host SERVING (VERDICT r2 #4): the real Engine decodes over a
+    tensor=8 mesh spanning two processes — per-layer psums cross the
+    process boundary exactly where DCN sits on a multi-host slice — and
+    both processes emit identical tokens for identical requests."""
+    from llm_instance_gateway_tpu.parallel.multihost_check import (
+        run_two_process_serve,
+    )
+
+    tokens = run_two_process_serve()
+    assert len(tokens) == 2
+    assert tokens[0] == tokens[1]
+    outs = [t.split(",") for t in tokens[0].split(";")]
+    assert all(len(o) == 6 for o in outs), tokens[0]
