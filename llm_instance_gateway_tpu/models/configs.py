@@ -46,13 +46,18 @@ class ModelConfig:
     # MoE (Mixtral): 0 experts = dense.
     n_experts: int = 0
     n_experts_per_token: int = 2
-    # Grouped MoE dispatch (GShard-style capacity einsum) kicks in for
-    # prefill-sized token counts; expert capacity = tokens*k/E * this factor
-    # (rounded to a TPU-friendly multiple of 8).  With ``moe_exact_fallback``
-    # a batch whose routing overflows any expert's capacity recomputes via
-    # the dense all-experts path inside a lax.cond — bit-exact results
-    # always, at dense cost only for pathologically imbalanced batches.
-    moe_capacity_factor: float = 2.0
+    # Grouped MoE dispatch (GShard-style capacity scatter) runs whenever it
+    # beats dense all-experts on expert-rows — prefill AND batched decode;
+    # expert capacity = tokens*k/E * this factor (large tiles round to a
+    # multiple of 8; decode-sized tiles keep the exact ceiling, so a
+    # 16-slot Mixtral decode computes ~1.25x the dropless-ideal t*k
+    # expert-rows instead of the dense path's E/k=4x).  With
+    # ``moe_exact_fallback`` a batch whose routing overflows any expert's
+    # capacity recomputes via the dense all-experts path inside a lax.cond
+    # — bit-exact results always, at dense cost for imbalanced batches;
+    # set it False for GShard token-dropping (overflowed assignments
+    # contribute zero), the standard serving trade at factor ~1.25.
+    moe_capacity_factor: float = 1.25
     moe_exact_fallback: bool = True
     # LoRA serving slots (compile-time constants: resizing reshapes buffers
     # and recompiles, so they mirror vLLM's --max-loras / max rank flags).

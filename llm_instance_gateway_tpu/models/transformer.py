@@ -121,13 +121,14 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x):
 
     Two shape-static strategies, chosen at TRACE time by token count:
 
-    - decode-sized batches (a handful of tokens): dense all-experts mix —
-      at tiny T the dispatch bookkeeping costs more than the E/k FLOP
-      inflation saves, and weights (not FLOPs) bound decode anyway;
-    - prefill-sized batches: GShard-style grouped capacity dispatch
-      (``_moe_grouped``) — per-token FLOPs drop from E to ~k*capacity_factor
-      expert-MLPs, with a dense lax.cond fallback keeping results bit-exact
-      when routing overflows capacity.
+    - a batch too small for the capacity tile to beat dense (cap >= T —
+      single-token decode): dense all-experts mix, where the dispatch
+      bookkeeping would be pure overhead and weights (not FLOPs) bound
+      the step anyway;
+    - everything else — batched decode included: GShard-style grouped
+      capacity dispatch (``_moe_grouped``) — per-token FLOPs drop from E
+      to ~k*capacity_factor expert-MLPs, with a dense lax.cond fallback
+      keeping results bit-exact when routing overflows capacity.
 
     LoRA is not applied to expert weights (matching vLLM, which targets
     attention + dense MLP only).
@@ -135,9 +136,37 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x):
     t = 1
     for dim in x.shape[:-1]:
         t *= dim
-    if t < 4 * cfg.n_experts:
+    if _moe_capacity(cfg, t) >= t:
+        # Dense all-experts costs t*E expert-rows; grouped costs E*cap.
+        # cap >= t means no FLOP win — and at these token counts decode is
+        # weight-bound anyway (each expert's weights stream from HBM once
+        # either way), so the dispatch bookkeeping would be pure overhead.
         return _moe_dense(cfg, lp, x)
     return _moe_grouped(cfg, lp, x)
+
+
+def _moe_capacity(cfg: ModelConfig, t: int) -> int:
+    """Per-expert capacity tile for ``t`` tokens.
+
+    cap ≈ t*k/E * capacity_factor.  Small (decode-sized) tiles keep the
+    exact ceiling — rounding 5 up to 8 would re-inflate a 16-token Mixtral
+    decode from 1.25x to 2x the dropless-ideal t*k expert-rows.  Large
+    (prefill-sized) tiles round up to a multiple of 8 (MXU sublane
+    alignment) and, in EXACT mode, take at least 2.0x headroom: the
+    overflow fallback pays grouped PLUS dense for the batch, so it must
+    stay pathological-only — a tight 1.25 tile overflows on routine router
+    imbalance (>1.25x mean load on any expert).  Dropping mode
+    (``moe_exact_fallback=False``) uses the configured factor as-is — the
+    standard GShard serving trade.
+    """
+    e, k = cfg.n_experts, cfg.n_experts_per_token
+    f = cfg.moe_capacity_factor
+    cap = int(-(-t * k * f // e))
+    if cap >= 16:
+        if cfg.moe_exact_fallback:
+            cap = int(-(-t * k * max(f, 2.0) // e))
+        cap = (cap + 7) // 8 * 8
+    return min(t, cap)
 
 
 def _moe_dense(cfg: ModelConfig, lp: Params, x):
@@ -166,8 +195,10 @@ def _moe_grouped(cfg: ModelConfig, lp: Params, x):
     whose T*k*E*C*D cost would swamp the savings); three batched einsums
     run each expert's MLP over its C-row tile (MXU-shaped, shardable over
     the ``expert`` mesh axis); a gather + gate-weighted sum combines
-    results.  Expert capacity C ≈ T*k/E * capacity_factor (multiple of 8):
-    expert FLOPs scale with assignments actually made, not experts*tokens —
+    results.  Expert capacity C ≈ T*k/E * capacity_factor (``_moe_capacity``
+    — exact ceiling for small tiles, multiple of 8 with exact-mode headroom
+    for large ones): expert FLOPs scale with assignments made, not
+    experts*tokens —
     the E/k inflation of the dense path is gone.  If any expert overflows
     C, ``moe_exact_fallback`` recomputes the batch densely inside lax.cond
     (exactness over speed for that batch).
@@ -182,8 +213,7 @@ def _moe_grouped(cfg: ModelConfig, lp: Params, x):
     topv, topi = jax.lax.top_k(router_logits, k)
     gates = jax.nn.softmax(topv, axis=-1)  # [T, k]
 
-    cap = int(-(-t * k * cfg.moe_capacity_factor // e))
-    cap = min(t, (cap + 7) // 8 * 8)  # MXU-friendly, never beyond T
+    cap = _moe_capacity(cfg, t)
 
     flat_expert = topi.reshape(-1)  # [T*k]
     flat_assign = jax.nn.one_hot(flat_expert, e, dtype=jnp.int32)  # [T*k, E]

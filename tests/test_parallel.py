@@ -253,3 +253,47 @@ class TestRingAttention:
         ref = jnp.einsum("bkgij,bjkh->bikgh", probs, v).reshape(2, 8, 4, 8)
         got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=False))(q, k, v)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5)
+
+
+class TestExpertParallelServing:
+    """VERDICT r2 #10: expert > 1 in a serve mesh — Mixtral serving
+    exercises the EP axis, with greedy parity against a single-device
+    engine."""
+
+    def test_moe_engine_serves_on_expert_mesh(self):
+        from llm_instance_gateway_tpu.models import transformer
+        from llm_instance_gateway_tpu.models.configs import TINY_MOE_TEST
+        from llm_instance_gateway_tpu.server.engine import (
+            Engine, EngineConfig, Request, SamplingParams)
+
+        cfg = TINY_MOE_TEST
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        ecfg = EngineConfig(decode_slots=2, max_seq_len=64,
+                            prefill_buckets=(16,))
+
+        def req(p):
+            return Request(prompt_tokens=p, max_new_tokens=5,
+                           sampling=SamplingParams(temperature=0.0))
+
+        ref = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32)
+        ref.start()
+        try:
+            want = [ref.generate(req([5, 6, 7]), timeout_s=300).output_tokens,
+                    ref.generate(req([9, 10, 11]), timeout_s=300).output_tokens]
+        finally:
+            ref.stop()
+
+        # expert=2 spans the MoE weight/dispatch tiles; tensor=2 splits the
+        # 4 query heads (the single kv head doesn't divide and is
+        # replicated by cache_specs' fallback); data=2 the batch.
+        mesh = make_mesh(MeshConfig(data=2, tensor=2, expert=2))
+        engine = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32,
+                        mesh=mesh)
+        engine.start()
+        try:
+            got = [engine.generate(req([5, 6, 7]), timeout_s=300).output_tokens,
+                   engine.generate(req([9, 10, 11]), timeout_s=300).output_tokens]
+        finally:
+            engine.stop()
+        assert got == want
