@@ -58,10 +58,24 @@ def run_load(
     num_models_per_pod: int = 5,
     port: int = 19102,
     streams: int = 8,
+    use_native: bool = False,
 ) -> dict:
-    """Fire ``requests`` Process calls; return a ghz-style summary dict."""
+    """Fire ``requests`` Process calls; return a ghz-style summary dict.
+
+    ``use_native`` swaps the Python filter tree for the C++ scheduler hot
+    path (``scheduling/native.py``) — the A/B the recorded results compare.
+    """
     pods, models = build_fixture(num_fake_pods, num_models_per_pod)
-    server = start_ext_proc(pods, models, port=port)
+    factory = None
+    if use_native:
+        from llm_instance_gateway_tpu.gateway.scheduling.native import (
+            available, make_scheduler)
+
+        if not available():
+            raise RuntimeError("native scheduler library unavailable")
+        factory = make_scheduler
+    server = start_ext_proc(pods, models, port=port,
+                            scheduler_factory=factory)
     total_models = num_fake_pods * num_models_per_pod
     latencies: list[float] = []
     try:
@@ -114,8 +128,13 @@ def main(argv=None):
     parser.add_argument("--requests", type=int, default=10000)
     parser.add_argument("--fake-pods", type=int, default=200)
     parser.add_argument("--models-per-pod", type=int, default=5)
+    parser.add_argument("--native", action="store_true",
+                        help="C++ scheduler hot path instead of the Python "
+                             "filter tree")
     args = parser.parse_args(argv)
-    summary = run_load(args.requests, args.fake_pods, args.models_per_pod)
+    summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
+                       use_native=args.native)
+    summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 
 

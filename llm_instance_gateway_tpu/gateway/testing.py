@@ -75,10 +75,14 @@ def start_ext_proc(
     pod_metrics: dict[Pod, Metrics],
     models: list[InferenceModel],
     port: int = 9002,
+    scheduler_factory=None,
     **scheduler_kwargs,
 ):
     """StartExtProc (test/utils.go:21-51): real gRPC server, fake metrics.
 
+    ``scheduler_factory(provider)`` overrides the default Python
+    ``Scheduler`` (e.g. ``scheduling.native.make_scheduler`` for the C++
+    hot path — the loadgen's A/B axis).
     Returns the started grpc server; caller must ``server.stop(None)``.
     """
     datastore = Datastore(pods=list(pod_metrics))
@@ -93,7 +97,12 @@ def start_ext_proc(
     provider = Provider(client, datastore)
     provider.refresh_pods_once()
     provider.refresh_metrics_once()
-    scheduler = Scheduler(provider, **scheduler_kwargs)
+    if scheduler_factory is not None and scheduler_kwargs:
+        raise TypeError(
+            "scheduler_factory and scheduler kwargs are mutually exclusive "
+            f"(kwargs {sorted(scheduler_kwargs)} would be silently dropped)")
+    scheduler = (scheduler_factory(provider) if scheduler_factory is not None
+                 else Scheduler(provider, **scheduler_kwargs))
     handler_server = Server(scheduler, datastore)
     grpc_server = build_grpc_server(handler_server, datastore, port=port)
     grpc_server.start()

@@ -49,14 +49,16 @@ class ModelConfig:
     # Grouped MoE dispatch (GShard-style capacity scatter) runs whenever it
     # beats dense all-experts on expert-rows — prefill AND batched decode;
     # expert capacity = tokens*k/E * this factor (large tiles round to a
-    # multiple of 8; decode-sized tiles keep the exact ceiling, so a
-    # 16-slot Mixtral decode computes ~1.25x the dropless-ideal t*k
-    # expert-rows instead of the dense path's E/k=4x).  With
+    # multiple of 8; decode-sized tiles keep the exact ceiling).  With
     # ``moe_exact_fallback`` a batch whose routing overflows any expert's
     # capacity recomputes via the dense all-experts path inside a lax.cond
-    # — bit-exact results always, at dense cost for imbalanced batches;
-    # set it False for GShard token-dropping (overflowed assignments
-    # contribute zero), the standard serving trade at factor ~1.25.
+    # — bit-exact results always, at grouped+dense cost for that batch, so
+    # exact mode enforces >= 2.0x headroom at every tile size to keep the
+    # double-pay rare (a 16-slot Mixtral decode then computes ~2x the
+    # dropless-ideal t*k expert-rows — still half the dense path's E/k=4x).
+    # Set False for GShard token-dropping (overflowed assignments
+    # contribute zero): the standard serving trade, where this factor
+    # applies as-is and the same decode computes ~1.25x dropless-ideal.
     moe_capacity_factor: float = 1.25
     moe_exact_fallback: bool = True
     # LoRA serving slots (compile-time constants: resizing reshapes buffers

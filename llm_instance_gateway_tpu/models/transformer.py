@@ -148,23 +148,27 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x):
 def _moe_capacity(cfg: ModelConfig, t: int) -> int:
     """Per-expert capacity tile for ``t`` tokens.
 
-    cap ≈ t*k/E * capacity_factor.  Small (decode-sized) tiles keep the
-    exact ceiling — rounding 5 up to 8 would re-inflate a 16-token Mixtral
-    decode from 1.25x to 2x the dropless-ideal t*k expert-rows.  Large
-    (prefill-sized) tiles round up to a multiple of 8 (MXU sublane
-    alignment) and, in EXACT mode, take at least 2.0x headroom: the
-    overflow fallback pays grouped PLUS dense for the batch, so it must
-    stay pathological-only — a tight 1.25 tile overflows on routine router
-    imbalance (>1.25x mean load on any expert).  Dropping mode
-    (``moe_exact_fallback=False``) uses the configured factor as-is — the
-    standard GShard serving trade.
+    cap ≈ t*k/E * factor.  Dropping mode uses the configured factor as-is
+    (1.25 default — the standard GShard serving trade: a 16-slot Mixtral
+    decode computes ~1.25x the dropless-ideal t*k expert-rows); EXACT mode
+    takes at least 2.0x at every size, because its overflow fallback pays
+    grouped PLUS dense for the batch and a tight tile overflows on routine
+    router imbalance (still ~2x better than the dense path it falls back
+    to).  Small tiles keep the exact ceiling — rounding 5 up to 8 would
+    re-inflate the small-batch win; large tiles round up to a multiple of
+    8 (MXU sublane alignment).
     """
     e, k = cfg.n_experts, cfg.n_experts_per_token
     f = cfg.moe_capacity_factor
+    if cfg.moe_exact_fallback:
+        # The overflow fallback pays grouped PLUS dense (expert weights
+        # streamed twice), so it must stay rare at EVERY tile size — a
+        # 16-slot decode tile at 1.25x mean load would overflow on most
+        # batches.  2.0x puts overflow ~2.7 sigma out under uniform
+        # routing; dropping mode uses the configured factor as-is.
+        f = max(f, 2.0)
     cap = int(-(-t * k * f // e))
     if cap >= 16:
-        if cfg.moe_exact_fallback:
-            cap = int(-(-t * k * max(f, 2.0) // e))
         cap = (cap + 7) // 8 * 8
     return min(t, cap)
 

@@ -138,6 +138,18 @@ class TestDecodeFlops:
             f"decode MoE flops {flops:.3g} vs dropless ideal "
             f"{ideal_mlp:.3g}")
 
+    def test_exact_mode_keeps_headroom_at_decode_size(self):
+        """Exact mode enforces >= 2.0x capacity at EVERY tile size: its
+        overflow fallback pays grouped + dense, so a tight 1.25x decode
+        tile (which overflows on most batches) must not be allowed."""
+        exact = CFG  # moe_exact_fallback defaults True
+        drop = dataclasses.replace(CFG, moe_exact_fallback=False)
+        t, e, k = 16, CFG.n_experts, CFG.n_experts_per_token
+        assert transformer._moe_capacity(drop, t) == -(-t * k * 125 // (e * 100))
+        assert transformer._moe_capacity(exact, t) == -(-t * k * 2 // e)
+        # Both still beat dense (cap < t -> grouped path chosen).
+        assert transformer._moe_capacity(exact, t) < t
+
     def test_single_token_decode_still_dense(self, params, monkeypatch):
         """A single-token decode has no grouped win (cap >= t): the dense
         path serves it; a 16-slot batch routes grouped (cap < t).  Each

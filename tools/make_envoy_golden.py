@@ -1,0 +1,144 @@
+"""Generate golden Envoy ext_proc wire transcripts for replay testing.
+
+A stock Envoy configured with ``deploy/gateway/envoy.yaml``'s
+``EnvoyExtensionPolicy`` (processingMode: request {body: Buffered},
+response {body: Buffered} — reference parity:
+``/root/reference/pkg/manifests/ext_proc.yaml:84-111``) drives the EPP
+with this message sequence per HTTP request:
+
+    1. ProcessingRequest{request_headers}   (full request header map)
+    2. ProcessingRequest{request_body}      (whole body, end_of_stream=true)
+    3. ProcessingRequest{response_headers}  (upstream's response headers)
+    4. ProcessingRequest{response_body}     (whole body, end_of_stream=true)
+
+This tool serializes that exact sequence — realistic Envoy header sets
+(pseudo-headers, x-request-id, x-forwarded-proto, content-length) included —
+into length-prefixed binary transcripts under ``tests/golden/``.  The
+replay suite (``tests/test_envoy_golden_replay.py``) streams the COMMITTED
+BYTES through a real gRPC channel to the real EPP, so any drift in the
+vendored proto subset or the server's phase handling breaks loudly against
+bytes fixed in git.
+
+Why transcripts instead of a live Envoy: this build image has no Envoy
+binary, no container runtime, and no network egress to fetch either, so
+the reference's kind-based e2e (``test/e2e/e2e_test.go:32-122``) cannot
+run here.  The protocol surface is pinned three ways instead: upstream
+field numbers (test_extproc_hermetic.py::TestWireCompat), live-stub
+integration (the rest of that suite), and these byte-frozen transcripts.
+
+Frame format: repeated [u32 big-endian length][ProcessingRequest bytes].
+
+Usage: python tools/make_envoy_golden.py  (regenerates tests/golden/*.bin)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_instance_gateway_tpu.gateway.extproc import envoy_base_pb2 as corepb
+from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "golden",
+)
+
+
+def _headers(pairs: list[tuple[str, bytes]]) -> pb.HttpHeaders:
+    # Envoy >= 1.27 populates raw_value (bytes), not value — the replay
+    # covers the modern encoding; the hermetic suite covers value=.
+    return pb.HttpHeaders(
+        headers=corepb.HeaderMap(headers=[
+            corepb.HeaderValue(key=k, raw_value=v) for k, v in pairs
+        ])
+    )
+
+
+def _request_headers(body: bytes, authority: str, req_id: str) -> pb.ProcessingRequest:
+    return pb.ProcessingRequest(request_headers=_headers([
+        (":authority", authority.encode()),
+        (":method", b"POST"),
+        (":path", b"/v1/completions"),
+        (":scheme", b"http"),
+        ("content-type", b"application/json"),
+        ("content-length", str(len(body)).encode()),
+        ("user-agent", b"envoy-golden-replay/1"),
+        ("x-forwarded-proto", b"http"),
+        ("x-request-id", req_id.encode()),
+    ]))
+
+
+def _response_headers(body: bytes) -> pb.ProcessingRequest:
+    return pb.ProcessingRequest(response_headers=_headers([
+        (":status", b"200"),
+        ("content-type", b"application/json"),
+        ("content-length", str(len(body)).encode()),
+    ]))
+
+
+def completion_transcript() -> list[pb.ProcessingRequest]:
+    """One full /v1/completions round-trip for the hermetic fixture's
+    ``sql-lora`` model (traffic-split target sql-lora-v1, pod affinity)."""
+    req_body = json.dumps({
+        "model": "sql-lora",
+        "prompt": "golden replay prompt",
+        "max_tokens": 100,
+        "temperature": 0,
+    }).encode()
+    resp_body = json.dumps({
+        "id": "cmpl-golden", "object": "text_completion",
+        "choices": [{"index": 0, "text": " ok", "finish_reason": "length"}],
+        "usage": {"prompt_tokens": 5, "completion_tokens": 10,
+                  "total_tokens": 15},
+    }).encode()
+    return [
+        _request_headers(req_body, "tpu-inference-gateway", "golden-req-1"),
+        pb.ProcessingRequest(
+            request_body=pb.HttpBody(body=req_body, end_of_stream=True)),
+        _response_headers(resp_body),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=resp_body, end_of_stream=True)),
+    ]
+
+
+def shed_transcript() -> list[pb.ProcessingRequest]:
+    """A sheddable-model request against a saturated pool: the EPP must
+    answer the body phase with an immediate 429 (no upstream phases —
+    Envoy short-circuits on immediate_response)."""
+    req_body = json.dumps({
+        "model": "batch",
+        "prompt": "golden shed prompt",
+        "max_tokens": 100,
+        "temperature": 0,
+    }).encode()
+    return [
+        _request_headers(req_body, "tpu-inference-gateway", "golden-req-2"),
+        pb.ProcessingRequest(
+            request_body=pb.HttpBody(body=req_body, end_of_stream=True)),
+    ]
+
+
+def write(path: str, msgs: list[pb.ProcessingRequest]) -> None:
+    with open(path, "wb") as f:
+        for m in msgs:
+            blob = m.SerializeToString()
+            f.write(struct.pack(">I", len(blob)))
+            f.write(blob)
+    print(f"wrote {path} ({len(msgs)} frames)")
+
+
+def main() -> None:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    write(os.path.join(GOLDEN_DIR, "envoy_extproc_completion.bin"),
+          completion_transcript())
+    write(os.path.join(GOLDEN_DIR, "envoy_extproc_shed429.bin"),
+          shed_transcript())
+
+
+if __name__ == "__main__":
+    main()
