@@ -144,6 +144,89 @@ def decode_step_paged(
     return logits, new_cache
 
 
+def extend_step_paged(
+    cfg: ModelConfig,
+    params: Params,
+    cache: Params,           # init_paged_cache layout
+    tokens: jax.Array,       # [B, C] int32 — C new tokens per slot
+    positions: jax.Array,    # [B, C] int32 — absolute positions of each
+    lora_bufs: Params | None = None,
+    slot_ids: jax.Array | None = None,
+):
+    """Multi-token cached decode over the paged pool — the speculative
+    verify/catch-up primitive (parity contract: ``transformer.extend_step``,
+    tested token-for-token).  Each row's C tokens scatter through its block
+    table and attend to the row's gathered view, causal within the new
+    tokens and over the lane's history.  Positions past the table span
+    route to the trash block (same rule as ``prefill_with_cache_paged``).
+    Returns (logits [B, C, V] f32, new cache).
+    """
+    b, c = tokens.shape
+    hd = cfg.resolved_head_dim
+    if slot_ids is None:
+        slot_ids = jnp.full((b,), -1, jnp.int32)
+    block = cache["k"].shape[2]
+    tables = cache["tables"]
+    max_blocks = tables.shape[1]
+    s_max = max_blocks * block
+    batch_idx = jnp.arange(b)[:, None]  # [B, 1] broadcast over C
+
+    in_bounds = positions < s_max
+    phys_block = jnp.where(
+        in_bounds,
+        tables[batch_idx, jnp.clip(positions // block, 0, max_blocks - 1)],
+        TRASH_BLOCK,
+    )  # [B, C]
+    offset = positions % block
+
+    h = params["embed"][tokens]  # [B, C, D]
+    if cfg.embedding_scale:
+        h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
+
+    per_layer_lora = None
+    if lora_bufs is not None:
+        per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
+
+    def layer_fn(h, xs):
+        lp, ll, k_pool, v_pool = xs
+        layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
+        hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
+            b, c, cfg.n_heads, hd)
+        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(
+            b, c, cfg.n_kv_heads, hd)
+        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(
+            b, c, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        k_pool = k_pool.at[phys_block, offset].set(k)
+        v_pool = v_pool.at[phys_block, offset].set(v)
+        k_rows = _gather_rows(k_pool, tables)  # [B, S_max, Kh, hd]
+        v_rows = _gather_rows(v_pool, tables)
+        qg = q.reshape(b, c, cfg.n_kv_heads, cfg.q_per_kv, hd)
+        logits = jnp.einsum(
+            "bikgh,bjkh->bkgij", qg, k_rows,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = jnp.arange(s_max)[None, None, :] <= positions[:, :, None]
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("bkgij,bjkh->bikgh", probs, v_rows).reshape(b, c, -1)
+        h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
+        hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+        h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
+        return h, (k_pool, v_pool)
+
+    xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
+    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = q_matmul(h, head).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "tables": tables,
+                 "length": positions[:, -1] + 1}
+    return logits, new_cache
+
+
 def insert_prefill_paged(
     cache: Params,
     k_prompt: jax.Array,   # [L, 1, S_bucket, Kh, hd] from prefill

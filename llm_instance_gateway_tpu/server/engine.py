@@ -300,12 +300,11 @@ class Engine:
             if draft_params is None or draft_cfg is None:
                 raise ValueError(
                     "speculative_k > 0 requires draft_params and draft_cfg")
-            if self.cfg.paged_kv_block is not None or mesh is not None:
+            if mesh is not None:
                 raise ValueError(
-                    "speculative decoding supports the contiguous-lane "
-                    "cache without a mesh (paged/mesh compositions TBD); "
-                    "both engine loops and decode_steps_per_sync > 1 are "
-                    "supported")
+                    "speculative decoding without a mesh (mesh composition "
+                    "TBD); both engine loops, decode_steps_per_sync > 1, "
+                    "and the paged cache are supported")
             if draft_cfg.vocab_size != model_cfg.vocab_size:
                 raise ValueError(
                     "draft and target models must share the token space "
@@ -1207,14 +1206,25 @@ class Engine:
         Stale-KV safety: cycle writes at positions p..p+K may leave garbage
         beyond the accepted prefix, but the NEXT cycle's K+1 writes start at
         the corrected position and always cover the stale range — the same
-        invariant the single-cycle version relied on.
+        invariant the single-cycle version relied on.  It holds for the
+        paged target too: the physical address of a logical position is
+        stable within a block's lifetime, and the engine pre-allocates the
+        whole block span a dispatch can write (``_paged_ensure_decode``).
 
         Returns flattened [T=n_cycles*(K+1), B] token/valid/logprob arrays —
         the exact layout ``_decode_impl`` produces — plus the device carries
         (next token/position/budget, draft-extra triple) and both caches.
         """
         b = tokens.shape[0]
-        s_max = cache["k"].shape[2]
+        paged = "tables" in cache
+        if paged:
+            s_max = cache["tables"].shape[1] * cache["k"].shape[2]
+            target_extend = functools.partial(paged_lib.extend_step_paged,
+                                              model_cfg, params)
+        else:
+            s_max = cache["k"].shape[2]
+            target_extend = functools.partial(transformer.extend_step,
+                                              model_cfg, params)
         kp1 = k_steps + 1
 
         def greedy_pick(lg, vocab):
@@ -1266,9 +1276,8 @@ class Engine:
             # check; the clamped scatter writes garbage the mask hides.
             vpos = jnp.minimum(
                 positions[:, None] + jnp.arange(kp1)[None], s_max - 1)
-            logits, cache = transformer.extend_step(
-                model_cfg, params, cache, vtokens, vpos,
-                lora_bufs=lora_bufs, slot_ids=slot_ids)
+            logits, cache = target_extend(
+                cache, vtokens, vpos, lora_bufs=lora_bufs, slot_ids=slot_ids)
             greedy = greedy_pick(logits, model_cfg.vocab_size)  # [B, K+1]
             first_sampled = sample(
                 logits[:, 0], cycle_key, temp, topk, topp,
@@ -1383,6 +1392,9 @@ class Engine:
         """Sync-loop speculative dispatch: one fused block of cycles."""
         k = self.cfg.speculative_k
         n_cycles = self._spec_cycles_per_sync()
+        # Paged: every position a cycle can write (accepted or rejected)
+        # must have a real block before dispatch.
+        self._paged_ensure_decode(n_cycles * (k + 1), pipelined=False)
         t0 = time.perf_counter()
         (toks, valid, lps, top_v, top_i, _next_tok, _next_pos, _next_rem,
          next_etok, next_epos, next_has, self.cache, self.draft_cache) = (
@@ -2031,14 +2043,21 @@ class Engine:
     def _paged_ensure_decode(self, n_steps: int, pipelined: bool) -> None:
         """Pre-dispatch block growth for every active row.
 
-        Pipelined mode's host position lags a block behind the device, so it
-        reserves 2*K ahead; over-reservation is returned at free.  A row the
-        exhausted pool cannot grow fails with "kv pool exhausted" (the
-        documented oversubscription tradeoff) without touching the batch.
+        Pipelined mode's host position lags the device by the IN-FLIGHT
+        dispatch, so the reservation is previous-dispatch-steps + this
+        dispatch's steps — dispatch sizes vary when speculative blocks
+        (cycles x (K+1) writes, including rejected tails) interleave with
+        plain blocks, so a flat 2*n_steps would under-reserve after a
+        larger block and route in-flight KV writes to the trash block.
+        Over-reservation is returned at free.  A row the exhausted pool
+        cannot grow fails with "kv pool exhausted" (the documented
+        oversubscription tradeoff) without touching the batch.
         """
         if not self.paged:
             return
-        lag = n_steps * (2 if pipelined else 1)
+        lag = n_steps + (self._prev_dispatch_steps if pipelined else 0)
+        if pipelined:
+            self._prev_dispatch_steps = n_steps
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -2138,6 +2157,8 @@ class Engine:
             self._dev_extra_pos = jnp.zeros((b,), jnp.int32)
             self._dev_has_extra = jnp.zeros((b,), bool)
         self._pending_budget_zero: list[int] = []
+        # Write span of the dispatch currently in flight (paged reservation).
+        self._prev_dispatch_steps = 0
         inflight: dict | None = None
         while self._running:
             did_work = self._admit_and_insert(pipelined=True)
@@ -2264,6 +2285,7 @@ class Engine:
         verify is exact regardless of what the draft proposes."""
         k = self.cfg.speculative_k
         n_cycles = self._spec_cycles_per_sync()
+        self._paged_ensure_decode(n_cycles * (k + 1), pipelined=True)
         if self._pending_budget_zero:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
             self._dev_remaining = self._dev_remaining.at[idxs].set(0)

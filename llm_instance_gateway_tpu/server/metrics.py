@@ -54,6 +54,13 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             "# TYPE tpu:prefix_reused_tokens counter",
             f"tpu:prefix_reused_tokens {snapshot['prefix_reused_tokens']}",
         ]
+    if "spec_cycles" in snapshot:
+        lines += [
+            "# TYPE tpu:spec_cycles counter",
+            f"tpu:spec_cycles {snapshot['spec_cycles']}",
+            "# TYPE tpu:spec_tokens_per_cycle gauge",
+            f"tpu:spec_tokens_per_cycle {snapshot['spec_tokens_per_cycle']}",
+        ]
     for name, value in (extra or {}).items():
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")

@@ -166,11 +166,17 @@ class TestSpeculativeEngine:
         with pytest.raises(ValueError, match="draft_params"):
             Engine(CFG, params, EngineConfig(speculative_k=2),
                    eos_id=None, dtype=jnp.float32)
-        with pytest.raises(ValueError, match="contiguous-lane"):
+        with pytest.raises(ValueError, match="mesh"):
+            import jax as _jax
+            from llm_instance_gateway_tpu.parallel.mesh import (
+                MeshConfig, make_mesh)
+
             Engine(CFG, params,
-                   EngineConfig(speculative_k=2, paged_kv_block=8),
+                   EngineConfig(speculative_k=2),
                    eos_id=None, dtype=jnp.float32,
-                   draft_params=params, draft_cfg=CFG)
+                   draft_params=params, draft_cfg=CFG,
+                   mesh=make_mesh(MeshConfig(
+                       data=len(_jax.devices("cpu")))))
 
 
 class TestSpeculativeLoopComposition:
@@ -246,3 +252,111 @@ class TestSpeculativeLoopComposition:
             got = run_reqs(spec2, [prompt], max_new=16)[0]
             assert got.output_tokens == want.output_tokens
             assert got.finish_reason == want.finish_reason == "stop"
+
+
+class TestSpeculativePaged:
+    """Speculation over the paged KV cache (extend_step_paged): exact
+    greedy parity with the non-speculative paged engine, in both loops."""
+
+    def _engines(self, spec_k, pipelined, slots=3):
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        dcfg = _tiny_draft()
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(7),
+                                          dtype=jnp.float32)
+        ecfg = dict(decode_slots=slots, max_seq_len=96, prefill_buckets=(8, 16),
+                    paged_kv_block=8, pipeline_decode=pipelined,
+                    decode_steps_per_sync=4 if pipelined else 1)
+        plain = Engine(CFG, params, EngineConfig(**ecfg), eos_id=None,
+                       dtype=jnp.float32)
+        spec = Engine(CFG, params, EngineConfig(**ecfg, speculative_k=spec_k),
+                      eos_id=None, dtype=jnp.float32,
+                      draft_params=dparams, draft_cfg=dcfg)
+        return plain, spec
+
+    @pytest.mark.parametrize("pipelined", [False, True],
+                             ids=["sync", "pipelined"])
+    def test_greedy_parity_paged(self, pipelined):
+        rng = np.random.RandomState(20)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9, 14)]
+        plain, spec = self._engines(spec_k=3, pipelined=pipelined)
+        want = [r.output_tokens for r in run_reqs(plain, prompts)]
+        got = [r.output_tokens for r in run_reqs(spec, prompts)]
+        assert got == want
+        assert spec.spec_cycles > 0
+
+    def test_paged_extend_matches_contiguous(self):
+        """extend_step_paged vs transformer.extend_step, same rows/tokens:
+        logits parity through block-table indirection."""
+        from llm_instance_gateway_tpu.models import paged as paged_lib
+
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        b, s_max, block, c = 2, 32, 8, 3
+        rng = np.random.RandomState(1)
+        lane = transformer.init_decode_cache(CFG, b, s_max, dtype=jnp.float32)
+        pagedc = paged_lib.init_paged_cache(CFG, b, s_max, 8, block,
+                                            dtype=jnp.float32)
+        tables = np.array(pagedc["tables"])  # writable host copy
+        starts = [5, 7]
+        next_free = 1
+        for row, n in enumerate(starts):
+            prompt = jnp.asarray([rng.randint(1, 250, size=n)], jnp.int32)
+            pos = jnp.arange(n)[None]
+            _, k, v = transformer.prefill(CFG, params, prompt, pos)
+            lane = transformer.insert_prefill(lane, k, v, row, n)
+            nb = -(-(n + c) // block)
+            phys = list(range(next_free, next_free + nb))
+            next_free += nb
+            tables[row, :nb] = phys
+            pagedc = paged_lib.insert_prefill_paged(
+                dict(pagedc, tables=jnp.asarray(tables)), k, v, row,
+                jnp.asarray(phys[: -(-n // block)], jnp.int32),
+                jnp.asarray(tables[row], jnp.int32), n)
+        tokens = jnp.asarray(rng.randint(1, 250, size=(b, c)), jnp.int32)
+        positions = jnp.asarray([[s + i for i in range(c)] for s in starts],
+                                jnp.int32)
+        want, _ = transformer.extend_step(CFG, params, lane, tokens, positions)
+        got, _ = paged_lib.extend_step_paged(CFG, params, pagedc, tokens,
+                                             positions)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_mixed_batch_schedule_shrink_keeps_parity(self):
+        """Regression: pipelined+paged with VARIABLE dispatch sizes — a
+        mixed batch (sampled row present) dispatches steps*(K+1) writes,
+        then the sampled row finishes and the schedule shrinks.  The paged
+        reservation must cover the in-flight larger dispatch or accepted
+        KV lands in the trash block and later tokens silently corrupt."""
+        from llm_instance_gateway_tpu.server.engine import (
+            Request, SamplingParams)
+
+        rng = np.random.RandomState(21)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (6, 9)]
+        plain, spec = self._engines(spec_k=3, pipelined=True, slots=3)
+
+        def run(engine, with_sampled):
+            reqs = [Request(prompt_tokens=list(p), max_new_tokens=40,
+                            sampling=SamplingParams(temperature=0.0))
+                    for p in prompts]
+            engine.start()
+            try:
+                for r in reqs:
+                    engine.submit(r)
+                if with_sampled:
+                    # A short sampled request rides along, finishes early,
+                    # and flips the spec schedule from mixed to all-greedy.
+                    s = Request(prompt_tokens=[3, 4, 5], max_new_tokens=4,
+                                sampling=SamplingParams(temperature=0.9))
+                    engine.submit(s)
+                for r in reqs:
+                    assert r.done.wait(240) and r.error is None, r.error
+            finally:
+                engine.stop()
+            return [r.output_tokens for r in reqs]
+
+        want = run(plain, with_sampled=False)
+        got = run(spec, with_sampled=True)
+        assert got == want
