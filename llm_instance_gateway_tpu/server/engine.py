@@ -121,10 +121,12 @@ class EngineConfig:
     # prefix — EXACT greedy parity with non-speculative decoding; sampled
     # rows fall back to one verified token per cycle.  Requires
     # ``draft_params``/``draft_cfg`` at Engine construction.  Composes with
-    # BOTH engine loops and ``decode_steps_per_sync`` (cycles are fused into
+    # BOTH engine loops, ``decode_steps_per_sync`` (cycles are fused into
     # one device-side scan of ceil(steps/(K+1)) cycles per dispatch — the
-    # bench's pipelined fast path included); the contiguous-lane cache
-    # without a mesh is still required (paged/mesh compositions TBD).
+    # bench's pipelined fast path included), the paged cache
+    # (extend_step_paged verify), and GSPMD serve meshes (draft replicated).
+    # paged + mesh remains excluded — but by the engine's own paged/mesh
+    # rule, independent of speculation.
     speculative_k: int = 0
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
@@ -300,11 +302,6 @@ class Engine:
             if draft_params is None or draft_cfg is None:
                 raise ValueError(
                     "speculative_k > 0 requires draft_params and draft_cfg")
-            if mesh is not None:
-                raise ValueError(
-                    "speculative decoding without a mesh (mesh composition "
-                    "TBD); both engine loops, decode_steps_per_sync > 1, "
-                    "and the paged cache are supported")
             if draft_cfg.vocab_size != model_cfg.vocab_size:
                 raise ValueError(
                     "draft and target models must share the token space "
@@ -409,6 +406,15 @@ class Engine:
                 raise ValueError(
                     "serving meshes must have pipe=1; fold those devices "
                     "into tensor/data instead")
+            if self.paged:
+                # cache_specs has no layout for the shared block pool (its
+                # n_blocks dim belongs to no mesh axis; rows of one pool
+                # serve different data shards).  Refuse with a clear message
+                # instead of the tree-mismatch shard_pytree would raise.
+                raise ValueError(
+                    "paged KV with a mesh is not yet supported: mesh "
+                    "serving uses the contiguous-lane cache (sharded via "
+                    "cache_specs); drop paged_kv_block or the mesh")
             self.params = sharding_lib.shard_pytree(
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(
@@ -515,6 +521,15 @@ class Engine:
         if self._spec:
             self.draft_cache = transformer.init_decode_cache(
                 draft_cfg, b, self.cfg.max_seq_len, dtype=dtype)
+            if mesh is not None:
+                # The draft is small: replicate it on the mesh (its whole
+                # point is being cheap) — the target keeps its GSPMD
+                # shardings and XLA partitions the fused verify normally.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(mesh, PartitionSpec())
+                self.draft_params = jax.device_put(draft_params, rep)
+                self.draft_cache = jax.device_put(self.draft_cache, rep)
             self._spec_ok = np.zeros((b,), bool)
             # The (token, position) the draft hasn't ingested yet — only set
             # after a FULLY-accepted cycle (d_K's kv is missing then).  Host

@@ -166,17 +166,43 @@ class TestSpeculativeEngine:
         with pytest.raises(ValueError, match="draft_params"):
             Engine(CFG, params, EngineConfig(speculative_k=2),
                    eos_id=None, dtype=jnp.float32)
-        with pytest.raises(ValueError, match="mesh"):
-            import jax as _jax
-            from llm_instance_gateway_tpu.parallel.mesh import (
-                MeshConfig, make_mesh)
-
+        with pytest.raises(ValueError, match="token space"):
             Engine(CFG, params,
                    EngineConfig(speculative_k=2),
                    eos_id=None, dtype=jnp.float32,
-                   draft_params=params, draft_cfg=CFG,
-                   mesh=make_mesh(MeshConfig(
-                       data=len(_jax.devices("cpu")))))
+                   draft_params=params,
+                   draft_cfg=dataclasses.replace(CFG, vocab_size=640))
+
+
+class TestSpeculativeMesh:
+    """Speculation under a GSPMD serve mesh: the target keeps its shardings,
+    the draft replicates, and greedy parity holds against the unsharded
+    speculative engine."""
+
+    def test_greedy_parity_on_mesh(self):
+        from llm_instance_gateway_tpu.parallel.mesh import MeshConfig, make_mesh
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        dcfg = _tiny_draft()
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(7),
+                                          dtype=jnp.float32)
+        ecfg = EngineConfig(decode_slots=4, max_seq_len=96,
+                            prefill_buckets=(8, 16), speculative_k=3)
+        rng = np.random.RandomState(22)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9, 14)]
+
+        ref = Engine(CFG, params, ecfg, eos_id=None, dtype=jnp.float32,
+                     draft_params=dparams, draft_cfg=dcfg)
+        want = [r.output_tokens for r in run_reqs(ref, prompts)]
+
+        mesh = make_mesh(MeshConfig(data=4, tensor=2))
+        engine = Engine(CFG, params, ecfg, eos_id=None, dtype=jnp.float32,
+                        draft_params=dparams, draft_cfg=dcfg, mesh=mesh)
+        got = [r.output_tokens for r in run_reqs(engine, prompts)]
+        assert got == want
+        assert engine.spec_cycles > 0
 
 
 class TestSpeculativeLoopComposition:
@@ -360,3 +386,25 @@ class TestSpeculativePaged:
         want = run(plain, with_sampled=False)
         got = run(spec, with_sampled=True)
         assert got == want
+
+    def test_paged_plus_mesh_rejected_clearly(self):
+        """paged + mesh is unsupported at the ENGINE level (the block pool
+        has no mesh layout); the rejection must be a clear ValueError, not
+        a shard_pytree tree mismatch — speculative or not."""
+        from llm_instance_gateway_tpu.parallel.mesh import MeshConfig, make_mesh
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        mesh = make_mesh(MeshConfig(data=len(jax.devices("cpu"))))
+        with pytest.raises(ValueError, match="paged KV with a mesh"):
+            Engine(CFG, params, EngineConfig(paged_kv_block=8),
+                   eos_id=None, dtype=jnp.float32, mesh=mesh)
+        dcfg = _tiny_draft()
+        with pytest.raises(ValueError, match="paged KV with a mesh"):
+            Engine(CFG, params,
+                   EngineConfig(paged_kv_block=8, speculative_k=2),
+                   eos_id=None, dtype=jnp.float32, mesh=mesh,
+                   draft_params=transformer.init_params(
+                       dcfg, jax.random.PRNGKey(7), dtype=jnp.float32),
+                   draft_cfg=dcfg)
