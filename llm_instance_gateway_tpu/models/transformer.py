@@ -78,15 +78,39 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def init_decode_cache(
-    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
+    quantized: bool = False,
 ) -> Params:
+    """Contiguous-lane decode cache.  ``quantized`` stores K/V as int8 with
+    per-(position, kv-head) f32 scales — decode at long context is bound by
+    streaming the KV from HBM, and int8 halves that traffic vs bf16 (the
+    JetStream serving trade); the dequantize multiply fuses into the
+    attention reads, so HBM sees int8 while the MXU computes in ``dtype``.
+    Scale overhead is 1/(2*head_dim) of the bf16 cache."""
     hd = cfg.resolved_head_dim
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, hd)
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if quantized else dtype),
+        "v": jnp.zeros(shape, jnp.int8 if quantized else dtype),
         "length": jnp.zeros((batch,), jnp.int32),
     }
+    if quantized:
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
+
+
+def _kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., hd] -> (int8 [..., hd], f32 scale [...]): symmetric per-vector
+    max-abs quantization (one scale per position per kv-head)."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _kv_dequantize(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    return q.astype(dtype) * s[..., None].astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -378,9 +402,14 @@ def decode_step(
 
     lengths = positions + 1
     batch_idx = jnp.arange(b)
+    quant = "k_scale" in cache
 
     def layer_fn(h, xs):
-        lp, ll, k_cache, v_cache = xs
+        if quant:
+            lp, ll, k_cache, v_cache, k_scale, v_scale = xs
+        else:
+            lp, ll, k_cache, v_cache = xs
+            k_scale = v_scale = None
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         hd = cfg.resolved_head_dim
@@ -389,29 +418,54 @@ def decode_step(
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
         q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
-        k_cache = k_cache.at[batch_idx, positions].set(k)
-        v_cache = v_cache.at[batch_idx, positions].set(v)
-        if attention_fn is not None:
-            attn = attention_fn(q, k_cache, v_cache, lengths)
-        elif cfg.use_pallas_decode:
-            from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
-                decode_attention as pallas_decode,
-            )
-
-            attn = pallas_decode(q, k_cache, v_cache, lengths)
+        if quant:
+            kq, ks = _kv_quantize(k)
+            vq, vs = _kv_quantize(v)
+            k_cache = k_cache.at[batch_idx, positions].set(kq)
+            v_cache = v_cache.at[batch_idx, positions].set(vq)
+            k_scale = k_scale.at[batch_idx, positions].set(ks)
+            v_scale = v_scale.at[batch_idx, positions].set(vs)
+            # Dequant fuses into the attention reads; the dequantized
+            # arrays are valid inputs for an explicit attention_fn
+            # override, while the in-model Pallas auto-dispatch stays off
+            # (the kernel takes bf16 caches; the engine gates
+            # use_pallas_decode off for quantized lanes).
+            k_read = _kv_dequantize(k_cache, k_scale, h.dtype)
+            v_read = _kv_dequantize(v_cache, v_scale, h.dtype)
+            if attention_fn is not None:
+                attn = attention_fn(q, k_read, v_read, lengths)
+            else:
+                attn = decode_attention(q, k_read, v_read, lengths)
+            carry_out = (k_cache, v_cache, k_scale, v_scale)
         else:
-            attn = decode_attention(q, k_cache, v_cache, lengths)
+            k_cache = k_cache.at[batch_idx, positions].set(k)
+            v_cache = v_cache.at[batch_idx, positions].set(v)
+            if attention_fn is not None:
+                attn = attention_fn(q, k_cache, v_cache, lengths)
+            elif cfg.use_pallas_decode:
+                from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+                    decode_attention as pallas_decode,
+                )
+
+                attn = pallas_decode(q, k_cache, v_cache, lengths)
+            else:
+                attn = decode_attention(q, k_cache, v_cache, lengths)
+            carry_out = (k_cache, v_cache)
         h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_cache, v_cache)
+        return h, carry_out
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = q_matmul(h, head).astype(jnp.float32)
-    new_cache = {"k": k_new, "v": v_new, "length": lengths}
+    new_cache = {"k": carry[0], "v": carry[1], "length": lengths}
+    if quant:
+        new_cache["k_scale"], new_cache["v_scale"] = carry[2], carry[3]
     return logits, new_cache
 
 
@@ -449,9 +503,14 @@ def extend_step(
         per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
 
     batch_idx = jnp.arange(b)[:, None]  # [B, 1] broadcast over C
+    quant = "k_scale" in cache
 
     def layer_fn(h, xs):
-        lp, ll, k_cache, v_cache = xs
+        if quant:
+            lp, ll, k_cache, v_cache, k_scale, v_scale = xs
+        else:
+            lp, ll, k_cache, v_cache = xs
+            k_scale = v_scale = None
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
@@ -462,30 +521,47 @@ def extend_step(
             b, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_cache = k_cache.at[batch_idx, positions].set(k)
-        v_cache = v_cache.at[batch_idx, positions].set(v)
+        if quant:
+            kq, ks = _kv_quantize(k)
+            vq, vs = _kv_quantize(v)
+            k_cache = k_cache.at[batch_idx, positions].set(kq)
+            v_cache = v_cache.at[batch_idx, positions].set(vq)
+            k_scale = k_scale.at[batch_idx, positions].set(ks)
+            v_scale = v_scale.at[batch_idx, positions].set(vs)
+            k_read = _kv_dequantize(k_cache, k_scale, h.dtype)
+            v_read = _kv_dequantize(v_cache, v_scale, h.dtype)
+            carry_out = (k_cache, v_cache, k_scale, v_scale)
+        else:
+            k_cache = k_cache.at[batch_idx, positions].set(k)
+            v_cache = v_cache.at[batch_idx, positions].set(v)
+            k_read, v_read = k_cache, v_cache
+            carry_out = (k_cache, v_cache)
         # [B,C,K,G,hd] x [B,S,K,hd] -> [B,K,G,C,S]; mask j <= position_i.
         qg = q.reshape(b, c, cfg.n_kv_heads, cfg.q_per_kv, hd)
         logits = jnp.einsum(
-            "bikgh,bjkh->bkgij", qg, k_cache,
+            "bikgh,bjkh->bkgij", qg, k_read,
             preferred_element_type=jnp.float32,
         ) / jnp.sqrt(hd).astype(jnp.float32)
         mask = jnp.arange(s_max)[None, None, :] <= positions[:, :, None]
         logits = jnp.where(mask[:, None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-        attn = jnp.einsum("bkgij,bjkh->bikgh", probs, v_cache).reshape(b, c, -1)
+        attn = jnp.einsum("bkgij,bjkh->bikgh", probs, v_read).reshape(b, c, -1)
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_cache, v_cache)
+        return h, carry_out
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = q_matmul(h, head).astype(jnp.float32)
-    new_cache = {"k": k_new, "v": v_new,
+    new_cache = {"k": carry[0], "v": carry[1],
                  "length": positions[:, -1] + 1}
+    if quant:
+        new_cache["k_scale"], new_cache["v_scale"] = carry[2], carry[3]
     return logits, new_cache
 
 
@@ -530,9 +606,14 @@ def prefill_with_cache(
     if cfg.embedding_scale:
         h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
     pos2d = positions[None]  # [1, C]
+    quant = "k_scale" in cache
 
     def layer_fn(h, xs):
-        lp, ll, k_cache, v_cache = xs  # caches: [B, S, K, hd] (this layer)
+        if quant:
+            lp, ll, k_cache, v_cache, k_scale, v_scale = xs
+        else:
+            lp, ll, k_cache, v_cache = xs  # caches: [B, S, K, hd] (layer)
+            k_scale = v_scale = None
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
@@ -541,11 +622,29 @@ def prefill_with_cache(
         q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
         # Scatter the chunk's K/V into the slot's lane at absolute positions.
-        k_cache = k_cache.at[slot, positions].set(k[0])
-        v_cache = v_cache.at[slot, positions].set(v[0])
-        # Chunk queries vs the whole lane, masked to cache index <= q position.
-        lane_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, keepdims=False)
-        lane_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, keepdims=False)
+        if quant:
+            kq, ks = _kv_quantize(k[0])
+            vq, vs = _kv_quantize(v[0])
+            k_cache = k_cache.at[slot, positions].set(kq)
+            v_cache = v_cache.at[slot, positions].set(vq)
+            k_scale = k_scale.at[slot, positions].set(ks)
+            v_scale = v_scale.at[slot, positions].set(vs)
+            lane_k = _kv_dequantize(
+                jax.lax.dynamic_index_in_dim(k_cache, slot, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(k_scale, slot, 0, keepdims=False),
+                h.dtype)
+            lane_v = _kv_dequantize(
+                jax.lax.dynamic_index_in_dim(v_cache, slot, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(v_scale, slot, 0, keepdims=False),
+                h.dtype)
+            carry_out = (k_cache, v_cache, k_scale, v_scale)
+        else:
+            k_cache = k_cache.at[slot, positions].set(k[0])
+            v_cache = v_cache.at[slot, positions].set(v[0])
+            # Chunk queries vs the whole lane, masked to index <= q position.
+            lane_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, keepdims=False)
+            lane_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, keepdims=False)
+            carry_out = (k_cache, v_cache)
         qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
         logits = jnp.einsum(
             "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
@@ -557,16 +656,21 @@ def prefill_with_cache(
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_cache, v_cache)
+        return h, carry_out
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     last_h = jax.lax.dynamic_index_in_dim(h[0], last_index, 0, keepdims=False)
     last_logits = q_matmul(last_h, head).astype(jnp.float32)
     length_vec = cache["length"].at[slot].set(lane_end)
-    return last_logits, {"k": k_new, "v": v_new, "length": length_vec}
+    out_cache = {"k": carry[0], "v": carry[1], "length": length_vec}
+    if quant:
+        out_cache["k_scale"], out_cache["v_scale"] = carry[2], carry[3]
+    return last_logits, out_cache
 
 
 def insert_prefill(
@@ -580,9 +684,20 @@ def insert_prefill(
     prefill->insert->generate).  ``length`` is the true prompt length; the
     padded tail beyond it is garbage but masked by ``cache['length']``.
     """
-    s = k_prompt.shape[2]
     k = cache["k"]
     v = cache["v"]
+    if "k_scale" in cache:
+        kq, ks = _kv_quantize(k_prompt)  # [L,1,S,K,hd] -> scales [L,1,S,K]
+        vq, vs = _kv_quantize(v_prompt)
+        k = jax.lax.dynamic_update_slice(k, kq, (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, vq, (0, slot, 0, 0, 0))
+        k_scale = jax.lax.dynamic_update_slice(
+            cache["k_scale"], ks, (0, slot, 0, 0))
+        v_scale = jax.lax.dynamic_update_slice(
+            cache["v_scale"], vs, (0, slot, 0, 0))
+        length_vec = cache["length"].at[slot].set(length)
+        return {"k": k, "v": v, "k_scale": k_scale, "v_scale": v_scale,
+                "length": length_vec}
     k = jax.lax.dynamic_update_slice(k, k_prompt.astype(k.dtype), (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(v, v_prompt.astype(v.dtype), (0, slot, 0, 0, 0))
     length_vec = cache["length"].at[slot].set(length)

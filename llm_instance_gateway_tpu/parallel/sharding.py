@@ -67,20 +67,25 @@ def param_specs(cfg: ModelConfig) -> dict[str, Any]:
     return specs
 
 
-def cache_specs(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> dict[str, Any]:
+def cache_specs(cfg: ModelConfig | None = None, mesh: Mesh | None = None,
+                quantized: bool = False) -> dict[str, Any]:
     """Decode cache [L, B, S, K, hd]: batch over data, KV heads over tensor.
 
     MQA/GQA caches whose kv-head count doesn't divide the tensor axis (e.g.
     Gemma-2B's single KV head on a tensor=4 mesh) replicate the head dim —
     the attention einsums then read the replicated cache and XLA partitions
-    on the query heads instead.
+    on the query heads instead.  ``quantized`` adds the int8 cache's
+    per-(position, kv-head) scale arrays, sharded like K/V minus head_dim.
     """
     head_axis: str | None = "tensor"
     if cfg is not None and mesh is not None:
         if cfg.n_kv_heads % mesh.shape["tensor"] != 0:
             head_axis = None
     kv = P(None, "data", None, head_axis, None)
-    return {"k": kv, "v": kv, "length": P("data")}
+    specs = {"k": kv, "v": kv, "length": P("data")}
+    if quantized:
+        specs["k_scale"] = specs["v_scale"] = P(None, "data", None, head_axis)
+    return specs
 
 
 def lora_specs(cfg: ModelConfig) -> dict[str, Any]:

@@ -697,6 +697,12 @@ def main(argv=None) -> None:
              "gpu_cache_usage_perc semantics)",
     )
     parser.add_argument(
+        "--kv-quantize", choices=("none", "int8"), default="none",
+        help="store the KV cache int8 with per-position/head scales — "
+             "halves the HBM traffic long-context decode is bound by "
+             "(contiguous-lane cache only)",
+    )
+    parser.add_argument(
         "--paged-kv-blocks", type=int, default=None, metavar="N",
         help="paged pool size in blocks; below slots*ceil(max_seq/block) "
              "oversubscribes HBM for short-sequence traffic",
@@ -820,6 +826,8 @@ def main(argv=None) -> None:
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,
             speculative_k=args.speculative,
+            kv_cache_quant=(None if args.kv_quantize == "none"
+                            else args.kv_quantize),
         ),
         lora_manager=lora_manager,
         eos_id=tokenizer.eos_id,

@@ -128,6 +128,15 @@ class EngineConfig:
     # paged + mesh remains excluded — but by the engine's own paged/mesh
     # rule, independent of speculation.
     speculative_k: int = 0
+    # KV-cache quantization ("int8" or None): K/V stored int8 with
+    # per-(position, kv-head) f32 scales, dequantized inside the fused
+    # attention reads — long-context decode is KV-bandwidth-bound and int8
+    # halves that HBM traffic (the JetStream serving trade; scale overhead
+    # 1/(2*head_dim)).  Contiguous-lane cache only (the paged pool keeps
+    # bf16 for now); the Pallas decode kernel takes bf16 caches, so
+    # quantized engines use the XLA attention path — at long context the
+    # bandwidth win dominates the kernel win this trades away.
+    kv_cache_quant: str | None = None
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -311,6 +320,20 @@ class Engine:
 
         b = self.cfg.decode_slots
         self.paged = self.cfg.paged_kv_block is not None
+        self._kv_quant = self.cfg.kv_cache_quant is not None
+        if self.cfg.kv_cache_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_quant={self.cfg.kv_cache_quant!r}: only 'int8' "
+                "(or None) is supported")
+        if self._kv_quant and self.paged:
+            raise ValueError(
+                "kv_cache_quant requires the contiguous-lane cache "
+                "(the paged pool keeps bf16 for now)")
+        if self._kv_quant and model_cfg.use_pallas_decode:
+            # The Pallas decode kernel takes bf16 caches; quantized lanes
+            # dequantize inside the XLA attention reads instead.
+            model_cfg = dataclasses.replace(model_cfg, use_pallas_decode=False)
+            self.model_cfg = model_cfg
         if self.paged:
             self._block = self.cfg.paged_kv_block
             self._max_blocks_per_seq = -(-self.cfg.max_seq_len // self._block)
@@ -342,7 +365,8 @@ class Engine:
         else:
             self._prefix_enabled = False
             self.cache = transformer.init_decode_cache(
-                model_cfg, b, self.cfg.max_seq_len, dtype=dtype
+                model_cfg, b, self.cfg.max_seq_len, dtype=dtype,
+                quantized=self._kv_quant,
             )
         # Sharded serving (SURVEY §2.5/§7 ICI domain): pin params and the
         # decode cache to the mesh via GSPMD specs; every jitted step then
@@ -418,7 +442,10 @@ class Engine:
             self.params = sharding_lib.shard_pytree(
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(
-                self.cache, sharding_lib.cache_specs(model_cfg, mesh), mesh)
+                self.cache,
+                sharding_lib.cache_specs(model_cfg, mesh,
+                                         quantized=self._kv_quant),
+                mesh)
         # Ring-attention prefill (parallel/long_context.py): with a
         # sequence axis in the mesh, prompts beyond the largest bucket run
         # as ONE sequence-parallel program over the ring instead of
@@ -519,6 +546,17 @@ class Engine:
         self._jit_sample_one = jax.jit(_sample_one)
 
         if self._spec:
+            if mesh is not None and mesh.size > 1 and (
+                draft_cfg.use_flash_attention or draft_cfg.use_pallas_decode
+            ):
+                # Same invariant as the target: GSPMD can't partition an
+                # opaque pallas_call, and the draft's ops run inside the
+                # sharded spec block — its in-model auto-dispatch must be
+                # off too (XLA attention; the draft is small).
+                draft_cfg = dataclasses.replace(
+                    draft_cfg, use_flash_attention=False,
+                    use_pallas_decode=False)
+                self.draft_cfg = draft_cfg
             self.draft_cache = transformer.init_decode_cache(
                 draft_cfg, b, self.cfg.max_seq_len, dtype=dtype)
             if mesh is not None:

@@ -1,0 +1,178 @@
+"""int8 KV-cache quantization: numerics, engine parity, composition.
+
+Long-context decode streams the KV cache from HBM every step; int8 storage
+with per-(position, kv-head) scales halves that traffic (the JetStream
+serving trade).  Contracts:
+
+- per-vector symmetric quantization keeps relative error ~<1%;
+- a quantized engine's greedy outputs agree with the bf16 engine on a
+  tiny model (logit gaps >> quantization noise at these scales);
+- the quantized cache composes with chunked prefill, multi-step +
+  pipelined decode, speculative decoding (extend_step), and GSPMD meshes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import TINY_TEST
+from llm_instance_gateway_tpu.server.engine import (
+    Engine,
+    EngineConfig,
+    Request,
+    SamplingParams,
+)
+
+CFG = TINY_TEST
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                   dtype=jnp.float32)
+
+
+def test_kv_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64, 2, 32), jnp.float32)
+    q, s = transformer._kv_quantize(x)
+    back = transformer._kv_dequantize(q, s, jnp.float32)
+    err = jnp.max(jnp.abs(back - x)) / jnp.max(jnp.abs(x))
+    assert q.dtype == jnp.int8
+    assert float(err) < 0.01
+
+
+def test_quantized_cache_layout():
+    cache = transformer.init_decode_cache(CFG, 3, 32, quantized=True)
+    assert cache["k"].dtype == jnp.int8
+    assert cache["k_scale"].shape == cache["k"].shape[:-1]
+    assert cache["k_scale"].dtype == jnp.float32
+
+
+def make_engine(params, quant, **extra):
+    return Engine(
+        CFG, params,
+        EngineConfig(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
+                     kv_cache_quant="int8" if quant else None, **extra),
+        eos_id=None, dtype=jnp.float32,
+    )
+
+
+def gen_all(engine, prompts, max_new=10):
+    reqs = [Request(prompt_tokens=list(p), max_new_tokens=max_new,
+                    sampling=SamplingParams(temperature=0.0))
+            for p in prompts]
+    engine.start()
+    try:
+        for r in reqs:
+            engine.submit(r)
+        for r in reqs:
+            assert r.done.wait(180) and r.error is None, r.error
+    finally:
+        engine.stop()
+    return [r.output_tokens for r in reqs]
+
+
+class TestQuantizedNumerics:
+    def test_decode_logits_close_to_bf16(self, params):
+        """Teacher-forced decode over a quantized cache stays within ~1%
+        of the dense-cache logits (full-trajectory token equality is NOT
+        asserted against bf16: random tiny models have near-tied logits
+        that quantization noise can legitimately flip)."""
+        rng = np.random.RandomState(30)
+        prompt = list(rng.randint(1, 250, size=9))
+        n = len(prompt)
+        tok = jnp.asarray([prompt], jnp.int32)
+        pos = jnp.arange(n)[None]
+        _, k, v = transformer.prefill(CFG, params, tok, pos)
+        logits = {}
+        for quant in (False, True):
+            cache = transformer.init_decode_cache(
+                CFG, 1, 32, dtype=jnp.float32, quantized=quant)
+            cache = transformer.insert_prefill(cache, k, v, 0, n)
+            out = []
+            cur, p = 256, n
+            for _ in range(4):  # teacher-forced: same inputs both caches
+                lg, cache = transformer.decode_step(
+                    CFG, params, cache, jnp.asarray([cur]), jnp.asarray([p]))
+                out.append(np.asarray(lg[0]))
+                cur, p = 250, p + 1
+            logits[quant] = np.stack(out)
+        scale = np.max(np.abs(logits[False]))
+        err = np.max(np.abs(logits[True] - logits[False])) / scale
+        assert err < 0.02, err
+
+
+class TestQuantizedEngine:
+    """Same-representation comparisons are EXACT (both sides quantize
+    identically), so loop/feature compositions assert token equality
+    against the quantized baseline engine."""
+
+    def test_pipelined_multistep_matches_sync(self, params):
+        rng = np.random.RandomState(32)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (6, 11)]
+        want = gen_all(make_engine(params, quant=True), prompts)
+        got = gen_all(make_engine(params, quant=True, pipeline_decode=True,
+                                  decode_steps_per_sync=4), prompts)
+        assert got == want
+
+    def test_chunked_prefill_through_quantized_lane(self, params):
+        """A prompt beyond the largest bucket streams chunk-wise into the
+        quantized lane; pipelined and sync agree exactly."""
+        rng = np.random.RandomState(31)
+        prompts = [list(rng.randint(1, 250, size=40))]
+        want = gen_all(make_engine(params, quant=True), prompts, max_new=6)
+        got = gen_all(make_engine(params, quant=True, pipeline_decode=True),
+                      prompts, max_new=6)
+        assert got == want
+        assert len(want[0]) == 6
+
+    def test_speculative_on_quantized_cache(self, params):
+        """The fused speculative block verifies through the quantized
+        extend_step; greedy parity vs the PLAIN quantized engine is exact
+        (same cache representation on both sides)."""
+        dcfg = dataclasses.replace(
+            CFG, name="kvq-draft", d_model=32, n_layers=1, n_heads=2,
+            n_kv_heads=1, d_ff=64, head_dim=16)
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(7),
+                                          dtype=jnp.float32)
+        rng = np.random.RandomState(33)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9)]
+        want = gen_all(make_engine(params, quant=True), prompts)
+        spec = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=3, max_seq_len=96,
+                         prefill_buckets=(8, 16), kv_cache_quant="int8",
+                         speculative_k=3),
+            eos_id=None, dtype=jnp.float32,
+            draft_params=dparams, draft_cfg=dcfg)
+        got = gen_all(spec, prompts)
+        assert got == want
+        assert spec.spec_cycles > 0
+
+    def test_quantized_on_mesh(self, params):
+        """int8 lanes shard like bf16 ones (scale arrays carry matching
+        specs): greedy agreement with the unsharded quantized engine."""
+        from llm_instance_gateway_tpu.parallel.mesh import (
+            MeshConfig, make_mesh)
+
+        rng = np.random.RandomState(34)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9)]
+        want = gen_all(make_engine(params, quant=True), prompts)
+        mesh = make_mesh(MeshConfig(data=len(jax.devices("cpu"))))
+        engine = Engine(
+            CFG, params,
+            EngineConfig(decode_slots=8, max_seq_len=96,
+                         prefill_buckets=(8, 16), kv_cache_quant="int8"),
+            eos_id=None, dtype=jnp.float32, mesh=mesh)
+        got = gen_all(engine, prompts)
+        assert got == want
+
+    def test_paged_plus_quant_rejected(self, params):
+        with pytest.raises(ValueError, match="contiguous-lane"):
+            Engine(CFG, params,
+                   EngineConfig(kv_cache_quant="int8", paged_kv_block=8),
+                   eos_id=None, dtype=jnp.float32)
