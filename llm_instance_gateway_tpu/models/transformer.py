@@ -425,17 +425,29 @@ def decode_step(
             v_cache = v_cache.at[batch_idx, positions].set(vq)
             k_scale = k_scale.at[batch_idx, positions].set(ks)
             v_scale = v_scale.at[batch_idx, positions].set(vs)
-            # Dequant fuses into the attention reads; the dequantized
-            # arrays are valid inputs for an explicit attention_fn
-            # override, while the in-model Pallas auto-dispatch stays off
-            # (the kernel takes bf16 caches; the engine gates
-            # use_pallas_decode off for quantized lanes).
-            k_read = _kv_dequantize(k_cache, k_scale, h.dtype)
-            v_read = _kv_dequantize(v_cache, v_scale, h.dtype)
             if attention_fn is not None:
-                attn = attention_fn(q, k_read, v_read, lengths)
+                # Explicit override: hand it the dequantized view.  NOTE —
+                # an opaque (pallas_call) override cannot fuse the dequant
+                # into its reads and would materialize a full bf16 cache;
+                # the engine deliberately never installs its shard_map
+                # wrapper for quantized lanes for exactly that reason.
+                attn = attention_fn(
+                    q, _kv_dequantize(k_cache, k_scale, h.dtype),
+                    _kv_dequantize(v_cache, v_scale, h.dtype), lengths)
+            elif cfg.use_pallas_decode:
+                from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+                    decode_attention_quant,
+                )
+
+                # int8-aware kernel: dequantizes in VMEM at the MXU feed,
+                # so HBM streams half the bytes of the bf16 kernel (auto
+                # XLA fallback off-TPU / unsupported shapes).
+                attn = decode_attention_quant(
+                    q, k_cache, v_cache, k_scale, v_scale, lengths)
             else:
-                attn = decode_attention(q, k_read, v_read, lengths)
+                attn = decode_attention(
+                    q, _kv_dequantize(k_cache, k_scale, h.dtype),
+                    _kv_dequantize(v_cache, v_scale, h.dtype), lengths)
             carry_out = (k_cache, v_cache, k_scale, v_scale)
         else:
             k_cache = k_cache.at[batch_idx, positions].set(k)

@@ -30,14 +30,22 @@ NEG_INF = -1e30
 TPU_BACKENDS = ("tpu", "axon")
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, block_s: int, scale: float):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
+                   block_s: int, scale: float, quant: bool):
     # q_ref: [1, K, G, hd]; k_ref/v_ref: [1, block_s, K*hd] — ALL heads of
     # one S-tile per grid step (head fusion keeps the grid small: per-step
     # overhead, not bandwidth, dominated the per-head variant on chip);
     # len_ref: [B] (SMEM, scalar-prefetched).  The S-block axis is the
     # innermost grid dim with "arbitrary" semantics: online-softmax state
     # rides f32 VMEM scratch across the sweep, like the prefill flash kernel.
+    # ``quant``: K/V tiles arrive int8 with per-(position, kv-head) f32
+    # scale columns (ks_ref/vs_ref: [1, block_s, n_kv]); dequantization
+    # happens in VMEM right before the MXU feed, so HBM streams half the
+    # bytes of the bf16 variant — decode's actual bound.
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     n_kv, g, hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     bi = pl.program_id(0)
     sb = pl.program_id(1)
@@ -58,12 +66,15 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         pos = start + jax.lax.broadcasted_iota(jnp.int32, (g, block_s), 1)
         live = pos < length
         for kh in range(n_kv):  # unrolled: static head offsets into the tile
-            # K/V stay in their storage dtype: the MXU consumes bf16 directly
-            # with f32 accumulation — an explicit astype of every tile was
-            # pure VPU overhead (measured on chip).
             q = q_ref[0, kh]  # [G, hd]
             k = k_ref[0, :, kh * hd:(kh + 1) * hd]
             v = v_ref[0, :, kh * hd:(kh + 1) * hd]
+            if quant:
+                k = (k.astype(jnp.float32) * ks_ref[0, :, kh:kh + 1]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs_ref[0, :, kh:kh + 1]).astype(q.dtype)
+            # else: K/V stay in their storage dtype — the MXU consumes bf16
+            # directly with f32 accumulation; an explicit astype of every
+            # tile was pure VPU overhead (measured on chip).
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -99,14 +110,10 @@ def _pick_block(s_max: int) -> int:
     return 0
 
 
-def decode_attention_pallas(
-    q: jax.Array,        # [B, n_heads, hd]
-    k_cache: jax.Array,  # [B, S, n_kv, hd]
-    v_cache: jax.Array,
-    lengths: jax.Array,  # [B] int32
-    block_s: int | None = None,
-    interpret: bool = False,
-) -> jax.Array:
+def _pallas_decode_call(q, k_cache, v_cache, scales, lengths,
+                        block_s: int | None, interpret: bool) -> jax.Array:
+    """Shared pallas_call builder for the bf16 and int8 variants —
+    ``scales`` is None (bf16) or (k_scale, v_scale) [B, S, n_kv] f32."""
     b, n_heads, hd = q.shape
     s_max, n_kv = k_cache.shape[1], k_cache.shape[2]
     g = n_heads // n_kv
@@ -128,18 +135,25 @@ def decode_attention_pallas(
         last = jnp.maximum(lens[bi] - 1, 0) // block_s
         return (bi, jnp.minimum(sb, last), 0)
 
-    kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
+    quant = scales is not None
+    in_specs = [
+        pl.BlockSpec((1, n_kv, g, hd), lambda bi, sb, lens: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
+        pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
+    ]
+    operands = [lengths, qg, k2, v2]
+    if quant:
+        in_specs += [pl.BlockSpec((1, block_s, n_kv), kv_index)] * 2
+        operands += list(scales)
+    kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale,
+                               quant=quant)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,  # lengths: drives masking + DMA clamping
             grid=(b, s_max // block_s),
-            in_specs=[
-                pl.BlockSpec((1, n_kv, g, hd), lambda bi, sb, lens: (bi, 0, 0, 0)),
-                pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
-                pl.BlockSpec((1, block_s, n_kv * hd), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, n_kv, g, hd),
                                    lambda bi, sb, lens: (bi, 0, 0, 0)),
             scratch_shapes=[
@@ -152,8 +166,34 @@ def decode_attention_pallas(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(lengths, qg, k2, v2)
+    )(*operands)
     return out.reshape(b, n_heads, hd)
+
+
+def decode_attention_pallas(
+    q: jax.Array,        # [B, n_heads, hd]
+    k_cache: jax.Array,  # [B, S, n_kv, hd]
+    v_cache: jax.Array,
+    lengths: jax.Array,  # [B] int32
+    block_s: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    return _pallas_decode_call(q, k_cache, v_cache, None, lengths,
+                               block_s, interpret)
+
+
+def decode_attention_quant_pallas(
+    q: jax.Array,        # [B, n_heads, hd]
+    k_cache: jax.Array,  # [B, S, n_kv, hd] int8
+    v_cache: jax.Array,
+    k_scale: jax.Array,  # [B, S, n_kv] f32
+    v_scale: jax.Array,
+    lengths: jax.Array,  # [B] int32
+    block_s: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    return _pallas_decode_call(q, k_cache, v_cache, (k_scale, v_scale),
+                               lengths, block_s, interpret)
 
 
 def supports(s_max: int, hd: int) -> bool:
@@ -171,3 +211,22 @@ def decode_attention(
     ):
         return xla_decode(q, k_cache, v_cache, lengths)
     return decode_attention_pallas(q, k_cache, v_cache, lengths, interpret=interpret)
+
+
+def decode_attention_quant(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+    k_scale: jax.Array, v_scale: jax.Array, lengths: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """int8-KV auto-dispatch: the quantized kernel streams half the HBM
+    bytes AND skips the logits materialization; unsupported shapes / CPU
+    dequantize and take the XLA reference."""
+    s_max, hd = k_cache.shape[1], k_cache.shape[3]
+    if not supports(s_max, hd) or (
+        not interpret and jax.default_backend() not in TPU_BACKENDS
+    ):
+        deq = k_cache.astype(q.dtype) * k_scale[..., None].astype(q.dtype)
+        dev = v_cache.astype(q.dtype) * v_scale[..., None].astype(q.dtype)
+        return xla_decode(q, deq, dev, lengths)
+    return decode_attention_quant_pallas(
+        q, k_cache, v_cache, k_scale, v_scale, lengths, interpret=interpret)

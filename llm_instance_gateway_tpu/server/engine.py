@@ -133,9 +133,9 @@ class EngineConfig:
     # attention reads — long-context decode is KV-bandwidth-bound and int8
     # halves that HBM traffic (the JetStream serving trade; scale overhead
     # 1/(2*head_dim)).  Contiguous-lane cache only (the paged pool keeps
-    # bf16 for now); the Pallas decode kernel takes bf16 caches, so
-    # quantized engines use the XLA attention path — at long context the
-    # bandwidth win dominates the kernel win this trades away.
+    # bf16 for now).  Decode attention runs the int8-aware Pallas kernel
+    # (ops/pallas_decode_attention.decode_attention_quant — dequantizes in
+    # VMEM at the MXU feed), so the bandwidth win and the kernel win stack.
     kv_cache_quant: str | None = None
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
@@ -329,11 +329,6 @@ class Engine:
             raise ValueError(
                 "kv_cache_quant requires the contiguous-lane cache "
                 "(the paged pool keeps bf16 for now)")
-        if self._kv_quant and model_cfg.use_pallas_decode:
-            # The Pallas decode kernel takes bf16 caches; quantized lanes
-            # dequantize inside the XLA attention reads instead.
-            model_cfg = dataclasses.replace(model_cfg, use_pallas_decode=False)
-            self.model_cfg = model_cfg
         if self.paged:
             self._block = self.cfg.paged_kv_block
             self._max_blocks_per_seq = -(-self.cfg.max_seq_len // self._block)
@@ -400,12 +395,17 @@ class Engine:
                     # per-shard compute.
                     self._prefill_attn_fn = (
                         sharded_attention.make_flash_prefill(model_cfg, mesh))
-                if (wants_decode and not self.paged
+                if (wants_decode and not self.paged and not self._kv_quant
                         and b % mesh.shape.get("data", 1) == 0):
                     # The batch gate is load-bearing: a non-divisible B
                     # would force shard_map to replicate the data-sharded
                     # KV cache (a full-cache all-gather per layer per
-                    # step) — worse than the XLA fallback.
+                    # step) — worse than the XLA fallback.  The quant gate
+                    # too: a shard_map pallas_call is opaque to XLA, so the
+                    # dequant multiply could NOT fuse into its reads — the
+                    # engine would materialize a full bf16 cache per layer
+                    # per step, spending the bandwidth int8 exists to save;
+                    # quantized mesh engines keep the fused XLA path.
                     self._decode_attn_fn = (
                         sharded_attention.make_cached_decode(model_cfg, mesh))
                 logger.info(

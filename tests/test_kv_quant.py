@@ -176,3 +176,33 @@ class TestQuantizedEngine:
             Engine(CFG, params,
                    EngineConfig(kv_cache_quant="int8", paged_kv_block=8),
                    eos_id=None, dtype=jnp.float32)
+
+
+class TestQuantPallasKernel:
+    def test_interpret_parity_with_dequant_xla(self):
+        """The int8-aware decode kernel (interpret mode) matches the
+        dequantize-then-XLA reference at f32 tolerance."""
+        from llm_instance_gateway_tpu.ops import pallas_decode_attention as pda
+        from llm_instance_gateway_tpu.ops.attention import (
+            decode_attention as xla_decode)
+
+        b, heads, kv, hd, s = 3, 4, 2, 128, 512
+        keys = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(keys[0], (b, heads, hd), jnp.float32)
+        kf = jax.random.normal(keys[1], (b, s, kv, hd), jnp.float32)
+        vf = jax.random.normal(keys[2], (b, s, kv, hd), jnp.float32)
+        kq, ks = transformer._kv_quantize(kf)
+        vq, vs = transformer._kv_quantize(vf)
+        # block_s=128 against s=512 -> a 4-block sweep: the online-softmax
+        # carry (corr/m/l rescale across blocks) and the dead-block DMA
+        # clamp (length 5 << one block; 300 straddles block 3) are BOTH
+        # exercised, not just the single-tile case.
+        lengths = jnp.asarray([s, 5, 300], jnp.int32)
+
+        want = xla_decode(q, transformer._kv_dequantize(kq, ks, jnp.float32),
+                          transformer._kv_dequantize(vq, vs, jnp.float32),
+                          lengths)
+        got = pda.decode_attention_quant_pallas(
+            q, kq, vq, ks, vs, lengths, block_s=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
