@@ -124,9 +124,10 @@ class EngineConfig:
     # BOTH engine loops, ``decode_steps_per_sync`` (cycles are fused into
     # one device-side scan of ceil(steps/(K+1)) cycles per dispatch — the
     # bench's pipelined fast path included), the paged cache
-    # (extend_step_paged verify), and GSPMD serve meshes (draft replicated).
-    # paged + mesh remains excluded — but by the engine's own paged/mesh
-    # rule, independent of speculation.
+    # (extend_step_paged verify), and GSPMD serve meshes (draft replicated)
+    # — including all three together on a tensor/expert mesh
+    # (parity-tested).  paged + a data/sequence mesh is excluded by the
+    # engine's own paged/mesh rule, independent of speculation.
     speculative_k: int = 0
     # KV-cache quantization ("int8" or None): K/V stored int8 with
     # per-(position, kv-head) f32 scales, dequantized inside the fused
@@ -430,21 +431,26 @@ class Engine:
                 raise ValueError(
                     "serving meshes must have pipe=1; fold those devices "
                     "into tensor/data instead")
-            if self.paged:
-                # cache_specs has no layout for the shared block pool (its
-                # n_blocks dim belongs to no mesh axis; rows of one pool
-                # serve different data shards).  Refuse with a clear message
-                # instead of the tree-mismatch shard_pytree would raise.
+            if self.paged and (mesh.shape.get("data", 1) > 1
+                               or mesh.shape.get("sequence", 1) > 1):
+                # The block pool belongs to no mesh axis (rows serve
+                # whichever requests the host allocator assigns), so the
+                # batch can't shard over data; ring/sequence prefill is a
+                # lane-cache path.  Tensor/expert-parallel paged serving —
+                # the big-model case — IS supported (paged_cache_specs).
                 raise ValueError(
-                    "paged KV with a mesh is not yet supported: mesh "
-                    "serving uses the contiguous-lane cache (sharded via "
-                    "cache_specs); drop paged_kv_block or the mesh")
+                    "paged KV on a mesh requires data=1 and sequence=1 "
+                    "(the pool replicates over fsdp and shards kv-heads "
+                    "over tensor): scale data-parallel replicas as "
+                    "separate engine processes behind the gateway")
             self.params = sharding_lib.shard_pytree(
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(
                 self.cache,
-                sharding_lib.cache_specs(model_cfg, mesh,
-                                         quantized=self._kv_quant),
+                (sharding_lib.paged_cache_specs(model_cfg, mesh)
+                 if self.paged else
+                 sharding_lib.cache_specs(model_cfg, mesh,
+                                          quantized=self._kv_quant)),
                 mesh)
         # Ring-attention prefill (parallel/long_context.py): with a
         # sequence axis in the mesh, prompts beyond the largest bucket run

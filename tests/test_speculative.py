@@ -387,24 +387,58 @@ class TestSpeculativePaged:
         got = run(spec, with_sampled=True)
         assert got == want
 
-    def test_paged_plus_mesh_rejected_clearly(self):
-        """paged + mesh is unsupported at the ENGINE level (the block pool
-        has no mesh layout); the rejection must be a clear ValueError, not
-        a shard_pytree tree mismatch — speculative or not."""
+    def test_paged_plus_data_mesh_rejected_clearly(self):
+        """paged + a data-axis mesh is unsupported (the block pool has no
+        batch sharding); the rejection must be a clear ValueError, not a
+        shard_pytree tree mismatch — speculative or not."""
         from llm_instance_gateway_tpu.parallel.mesh import MeshConfig, make_mesh
         from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
 
         params = transformer.init_params(CFG, jax.random.PRNGKey(0),
                                          dtype=jnp.float32)
         mesh = make_mesh(MeshConfig(data=len(jax.devices("cpu"))))
-        with pytest.raises(ValueError, match="paged KV with a mesh"):
+        with pytest.raises(ValueError, match="data=1"):
             Engine(CFG, params, EngineConfig(paged_kv_block=8),
                    eos_id=None, dtype=jnp.float32, mesh=mesh)
         dcfg = _tiny_draft()
-        with pytest.raises(ValueError, match="paged KV with a mesh"):
+        with pytest.raises(ValueError, match="data=1"):
             Engine(CFG, params,
                    EngineConfig(paged_kv_block=8, speculative_k=2),
                    eos_id=None, dtype=jnp.float32, mesh=mesh,
                    draft_params=transformer.init_params(
                        dcfg, jax.random.PRNGKey(7), dtype=jnp.float32),
                    draft_cfg=dcfg)
+
+    def test_spec_paged_tensor_mesh_parity(self):
+        """The FULL composition — speculation + paged pool + tensor mesh —
+        keeps exact greedy parity with the unsharded spec+paged engine
+        (the verify primitive is plain einsums over a kv-head-sharded
+        pool)."""
+        from llm_instance_gateway_tpu.parallel.mesh import MeshConfig, make_mesh
+        from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig
+
+        cfg = dataclasses.replace(
+            CFG, name="spm", d_model=64, n_heads=4, n_kv_heads=2,
+            head_dim=16, d_ff=128)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        dcfg = dataclasses.replace(
+            cfg, name="spm-draft", d_model=32, n_layers=1, n_heads=2,
+            n_kv_heads=1, d_ff=64, head_dim=16)
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(7),
+                                          dtype=jnp.float32)
+        ecfg = EngineConfig(decode_slots=2, max_seq_len=64,
+                            prefill_buckets=(8, 16), paged_kv_block=8,
+                            speculative_k=2)
+        rng = np.random.RandomState(23)
+        prompts = [list(rng.randint(1, 250, size=n)) for n in (5, 9)]
+
+        ref = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32,
+                     draft_params=dparams, draft_cfg=dcfg)
+        want = [r.output_tokens for r in run_reqs(ref, prompts)]
+        mesh = make_mesh(MeshConfig(tensor=2, fsdp=4))
+        engine = Engine(cfg, params, ecfg, eos_id=None, dtype=jnp.float32,
+                        draft_params=dparams, draft_cfg=dcfg, mesh=mesh)
+        got = [r.output_tokens for r in run_reqs(engine, prompts)]
+        assert got == want
+        assert engine.spec_cycles > 0
