@@ -27,4 +27,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Persistent XLA compile cache for the suite: single-core XLA:CPU compiles
+# dominate the ~25-min wall time, and most programs recur run over run.
+# The directory is GITIGNORED, so cache entries never leave the host that
+# wrote them (XLA:CPU AOT artifacts are machine-feature-pinned; same-host
+# reuse is the only reuse that can happen).
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache_tests"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass  # cache is an optimization, never a requirement
+
+sys.path.insert(0, _REPO)
