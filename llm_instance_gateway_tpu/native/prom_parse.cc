@@ -1,0 +1,160 @@
+// Prometheus text-exposition scanner: the metrics plane's hot loop.
+//
+// The provider scrapes every pod every 50ms (reference provider.go:14); at
+// the 200-pod loadgen scale the pure-Python parser costs ~33% of the tick
+// budget on one core (measured; see tests/test_native_prom.py).  This
+// scanner does the per-line work — tokenizing, label-block bracketing,
+// value/timestamp parsing — in one pass over the buffer and returns OFFSETS
+// into the caller's text plus parsed numbers, so Python touches only real
+// samples (and unescapes labels only for the rare labeled family it reads).
+//
+// Semantics mirror utils/prom_parse.py EXACTLY (fuzz-pinned by
+// tests/test_native_prom.py), including its quirks: the label block spans
+// the first '{' to the LAST '}' on the line; a line whose value token fails
+// to parse is skipped; extra tokens after the timestamp are ignored.
+//
+// Build: make -C llm_instance_gateway_tpu/native (auto-run on staleness by
+// utils/prom_parse._load_native).
+
+// Known divergence from Python float(), documented and fuzz-excluded:
+// PEP-515 underscore literals ("1_0") parse in Python but are rejected here
+// (they never occur in exposition text).  Unicode line separators
+// (U+0085/U+2028/U+2029) are honored in their UTF-8 encodings.
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+struct LigPromSample {
+  int32_t name_off;
+  int32_t name_len;
+  int32_t labels_off;   // raw inner label block (no braces); -1 if none
+  int32_t labels_len;
+  double value;
+  int64_t ts_ms;        // INT64_MIN = absent
+};
+
+static const int64_t TS_NONE = INT64_MIN;
+
+static inline bool is_ws(char c) {
+  // Within-line whitespace (str.split()): space and tab.  \r/\v/\f are
+  // LINE BREAKS in Python's splitlines() and are handled by the line
+  // scanner, so they never appear inside a line here.
+  return c == ' ' || c == '\t';
+}
+
+// Bytes after text[p] that terminate a line, matching str.splitlines() on
+// the UTF-8 encoding: \n \r \v \f \x1c \x1d \x1e, NEL (C2 85), and LS/PS
+// (E2 80 A8/A9).  Returns the terminator's byte length (0 = not a break).
+static inline int32_t line_break_len(const char* t, int32_t p, int32_t len) {
+  unsigned char c = (unsigned char)t[p];
+  if (c == '\n' || c == '\v' || c == '\f'
+      || c == 0x1c || c == 0x1d || c == 0x1e) return 1;
+  if (c == '\r') {
+    return (p + 1 < len && t[p + 1] == '\n') ? 2 : 1;  // \r\n is ONE break
+  }
+  if (c == 0xC2 && p + 1 < len && (unsigned char)t[p + 1] == 0x85) return 2;
+  if (c == 0xE2 && p + 2 < len && (unsigned char)t[p + 1] == 0x80
+      && ((unsigned char)t[p + 2] == 0xA8 || (unsigned char)t[p + 2] == 0xA9))
+    return 3;
+  return 0;
+}
+
+// Parse one whitespace-delimited token as a double the way Python float()
+// does: the WHOLE token must be consumed.  std::from_chars is locale-free
+// and rejects the C99 hex floats / nan(seq) forms strtod would accept;
+// Python's single leading '+' (which from_chars rejects) is skipped by hand.
+static bool parse_token_double(const char* s, int32_t len, double* out) {
+  if (len <= 0) return false;
+  const char* p = s;
+  const char* end = s + len;
+  if (*p == '+') {
+    p++;
+    if (p == end || *p == '+' || *p == '-') return false;
+  }
+  auto res = std::from_chars(p, end, *out, std::chars_format::general);
+  return res.ec == std::errc() && res.ptr == end;
+}
+
+int32_t lig_prom_parse(const char* text, int32_t len,
+                       LigPromSample* out, int32_t cap) {
+  int32_t n_out = 0;
+  int32_t i = 0;
+  while (i < len && n_out < cap) {
+    // One line: [i, eol), terminated per str.splitlines().
+    int32_t eol = i;
+    int32_t brk = 0;
+    while (eol < len && (brk = line_break_len(text, eol, len)) == 0) eol++;
+    int32_t a = i, b = eol;
+    i = eol + (brk > 0 ? brk : 1);
+    while (a < b && is_ws(text[a])) a++;
+    while (b > a && is_ws(text[b - 1])) b--;
+    if (a == b || text[a] == '#') continue;
+
+    int32_t name_off, name_len, labels_off = -1, labels_len = 0;
+    int32_t rest;  // first index of the value/timestamp region
+    // First '{' within the trimmed line?
+    int32_t brace = -1;
+    for (int32_t p = a; p < b; p++) {
+      if (text[p] == '{') { brace = p; break; }
+    }
+    if (brace >= 0) {
+      // Label block: first '{' .. LAST '}' (parity with the Python
+      // parser's line.rfind("}")); unbalanced braces skip the line.
+      int32_t close = -1;
+      for (int32_t p = b - 1; p > brace; p--) {
+        if (text[p] == '}') { close = p; break; }
+      }
+      if (close < 0) continue;
+      name_off = a;
+      name_len = brace - a;
+      while (name_len > 0 && is_ws(text[name_off + name_len - 1])) name_len--;
+      labels_off = brace + 1;
+      labels_len = close - labels_off;
+      rest = close + 1;
+    } else {
+      name_off = a;
+      int32_t p = a;
+      while (p < b && !is_ws(text[p])) p++;
+      name_len = p - a;
+      rest = p;
+    }
+
+    // Tokenize the rest: value [timestamp] [ignored...]
+    int32_t p = rest;
+    while (p < b && is_ws(text[p])) p++;
+    if (p >= b) continue;  // no value token
+    int32_t v0 = p;
+    while (p < b && !is_ws(text[p])) p++;
+    double value;
+    if (!parse_token_double(text + v0, p - v0, &value)) continue;
+
+    int64_t ts = TS_NONE;
+    while (p < b && is_ws(text[p])) p++;
+    if (p < b) {
+      int32_t t0 = p;
+      while (p < b && !is_ws(text[p])) p++;
+      double tv;
+      if (parse_token_double(text + t0, p - t0, &tv)
+          && std::isfinite(tv)
+          && tv >= -9223372036854775808.0   // int64 range: the cast of a
+          && tv < 9223372036854775808.0) {  // NaN/Inf/overflow double is UB
+        ts = (int64_t)tv;  // Python int(float(x)): truncate toward zero
+      }
+    }
+
+    LigPromSample* s = &out[n_out++];
+    s->name_off = name_off;
+    s->name_len = name_len;
+    s->labels_off = labels_off;
+    s->labels_len = labels_len;
+    s->value = value;
+    s->ts_ms = ts;
+  }
+  return n_out;
+}
+
+}  // extern "C"

@@ -1,0 +1,165 @@
+"""Native prom-text scanner: exact parity with the pure-Python parser.
+
+The C scanner (native/prom_parse.cc) serves the provider's 50ms scrape loop;
+its contract is producing EXACTLY what utils/prom_parse.parse_text produces
+— including the Python parser's quirks (label block spans first '{' to LAST
+'}', bad value tokens skip the line, timestamps truncate toward zero).
+Pinned here by edge cases plus a randomized fuzz corpus.
+"""
+
+import random
+
+import pytest
+
+from llm_instance_gateway_tpu.utils import prom_parse
+
+
+native = prom_parse._load_native()
+pytestmark = pytest.mark.skipif(native is None,
+                                reason="native prom parser unavailable")
+
+
+def assert_parity(text: str):
+    want = prom_parse.parse_text(text)
+    got = prom_parse.parse_text_native(text)
+    assert got == want, text
+
+
+class TestEdgeCases:
+    def test_contract_scrape(self):
+        assert_parity(
+            "# TYPE tpu:prefill_queue_size gauge\n"
+            "tpu:prefill_queue_size 3\n"
+            "tpu:kv_cache_usage_perc 0.431234\n"
+            "tpu:decode_tokens_per_sec 811.221\n"
+            'tpu:lora_requests_info{running_lora_adapters="a,b",max_lora="4"}'
+            " 1.7e9\n")
+
+    def test_labels_escapes_and_timestamps(self):
+        assert_parity(
+            'm{k="v with \\"quotes\\" and \\\\ and \\n"} 1 1785350000000\n'
+            'm{k="second"} 2 1785350000001\n'
+            "plain 3.5 123\n")
+
+    def test_malformed_lines_skipped(self):
+        assert_parity(
+            "no_value\n"
+            "bad_value abc\n"
+            "unbalanced{a=\"b\" 1\n"
+            "   \n"
+            "# comment\n"
+            "ok 1\n")
+
+    def test_inf_nan_and_sign(self):
+        # NaN != NaN breaks dict equality; compare structure fields instead.
+        text = "a +Inf\nb -Inf\nc 1e-9\nd -42 -7\n"
+        want = prom_parse.parse_text(text)
+        got = prom_parse.parse_text_native(text)
+        assert set(got) == set(want)
+        for k in want:
+            assert [s.value for s in got[k]] == [s.value for s in want[k]]
+            assert [s.timestamp_ms for s in got[k]] == [
+                s.timestamp_ms for s in want[k]]
+
+    def test_brace_inside_label_value_matches_python_quirk(self):
+        # Python takes the LAST '}' on the line; the C scanner must too.
+        assert_parity('m{k="has } brace"} 5\n')
+
+    def test_whitespace_and_crlf(self):
+        assert_parity("  m  1  \r\n\tn{a=\"b\"}\t2\t99\r\n")
+
+    def test_float_timestamp_truncates(self):
+        assert_parity("m 1 123.9\nn 2 -7.9\n")
+
+    def test_cr_only_line_endings(self):
+        # str.splitlines() treats \r, \v, \f (and \x1c-\x1e, NEL, LS/PS)
+        # as line breaks; series must not vanish on exotic endings.
+        assert_parity("a 1\rb 2\rc 3")
+        assert_parity("a 1\x0bb 2\x0cc 3\x1cd 4")
+        assert_parity("a 1b 2 c 3 d 4")
+
+    def test_inf_and_huge_timestamps_dropped(self):
+        # +-Inf / beyond-int64 timestamps are garbage, not data — both
+        # parsers drop them (the int64 wire type can't hold them).
+        assert_parity("m 1 +Inf\nn 2 -inf\no 3 1e20\np 4 -9e19\nq 5 nan\n")
+
+    def test_hex_token_rejected_like_python(self):
+        # float('0x1F') raises in Python; strtod would have accepted it.
+        assert_parity("m 0x1F\nn 0x10 7\no 1 0x10\n")
+
+
+def test_fuzz_parity():
+    rng = random.Random(42)
+    names = ["tpu:a", "vllm:b_total", "x", "m:loaded"]
+    label_vals = ["v", "a,b,c", 'q"uote', "back\\slash", "new\nline",
+                  "brace}y", ""]
+    values = ["0", "1.5", "-3", "2e9", "+Inf", "nan", "abc", "1e", "",
+              "0x1F", "+-1", "INFINITY"]
+    tss = ["", " 123", " 1785350000000", " -5", " 12.7", " junk", " 1 extra",
+           " +Inf", " 1e20", " nan"]
+    for _ in range(300):
+        lines = []
+        for _ in range(rng.randint(1, 12)):
+            kind = rng.random()
+            if kind < 0.15:
+                lines.append(rng.choice(["# HELP x y", "", "   ", "# junk"]))
+                continue
+            name = rng.choice(names)
+            labels = ""
+            if rng.random() < 0.5:
+                pairs = ",".join(
+                    f'{k}="{v}"' for k, v in
+                    [(f"k{j}", rng.choice(label_vals).replace("\\", "\\\\")
+                      .replace('"', '\\"').replace("\n", "\\n"))
+                     for j in range(rng.randint(1, 3))])
+                labels = "{" + pairs + "}"
+            lines.append(
+                f"{name}{labels} {rng.choice(values)}{rng.choice(tss)}")
+        text = "\n".join(lines) + rng.choice(["", "\n"])
+        want = prom_parse.parse_text(text)
+        got = prom_parse.parse_text_native(text)
+        # NaN-safe comparison.
+        assert set(got) == set(want), text
+        for k in want:
+            assert len(got[k]) == len(want[k]), text
+            for a, b in zip(got[k], want[k]):
+                assert a.name == b.name and a.labels == b.labels, text
+                assert a.timestamp_ms == b.timestamp_ms, text
+                assert (a.value == b.value
+                        or (a.value != a.value and b.value != b.value)), text
+
+
+def test_speedup_on_production_sized_scrape():
+    """The native scanner must beat pure Python on a vLLM-style page
+    (hundreds of series, label-heavy histograms) — the size class
+    parse_text_fast routes to it (sanity, not a strict perf bound)."""
+    import time
+
+    lines = []
+    for i in range(40):
+        for b in ("0.01", "0.1", "1", "10", "+Inf"):
+            lines.append(f'fam{i}_bucket{{le="{b}"}} {i * 7}')
+        lines.append(f"fam{i}_sum {i * 1.5}")
+        lines.append(f"fam{i}_count {i * 7}")
+    text = "\n".join(lines) + "\n"
+    assert len(text) >= prom_parse._NATIVE_MIN_BYTES
+
+    def timeit(fn, n=200):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn(text)
+        return (time.perf_counter() - t0) / n
+
+    # Median-of-3 and a 1.2x allowance: a canary against the fast path
+    # regressing to slower-than-Python, tolerant of shared-runner noise.
+    t_py = sorted(timeit(prom_parse.parse_text) for _ in range(3))[1]
+    t_c = sorted(timeit(prom_parse.parse_text_native) for _ in range(3))[1]
+    assert t_c < 1.2 * t_py, (t_c, t_py)
+
+
+def test_fast_dispatch_thresholds():
+    small = "tpu:prefill_queue_size 3\n"
+    assert prom_parse.parse_text_fast(small) == prom_parse.parse_text(small)
+    big = "\n".join(f"m{i} {i}" for i in range(600)) + "\n"
+    assert len(big) >= prom_parse._NATIVE_MIN_BYTES
+    assert prom_parse.parse_text_fast(big) == prom_parse.parse_text(big)
