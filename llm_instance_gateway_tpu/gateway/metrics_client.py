@@ -130,6 +130,11 @@ class PodMetricsClient:
     def __init__(self, timeout_s: float = 5.0, scheme: str = "http"):
         self.timeout_s = timeout_s
         self.scheme = scheme
+        # Build/load the native scanner NOW (seconds of g++ on first build):
+        # lazily it would fire on the first production-sized scrape and
+        # stall the 50ms loop with the loader lock held, going stale on
+        # every pod exactly at startup.
+        prom_parse._load_native()
 
     def fetch_metrics(self, pod: Pod, existing: Metrics) -> Metrics:
         url = f"{self.scheme}://{pod.address}/metrics"

@@ -16,10 +16,13 @@
 // Build: make -C llm_instance_gateway_tpu/native (auto-run on staleness by
 // utils/prom_parse._load_native).
 
-// Known divergence from Python float(), documented and fuzz-excluded:
-// PEP-515 underscore literals ("1_0") parse in Python but are rejected here
-// (they never occur in exposition text).  Unicode line separators
-// (U+0085/U+2028/U+2029) are honored in their UTF-8 encodings.
+// Known divergences from the Python parser, documented and fuzz-excluded
+// (neither occurs in exposition text, which is ASCII by format):
+// - PEP-515 underscore literals ("1_0") parse in Python, rejected here;
+// - non-ASCII whitespace (e.g. NBSP U+00A0) separates tokens for Python's
+//   str.split() but is treated as ordinary bytes here.
+// Unicode LINE separators (U+0085/U+2028/U+2029) ARE honored in their
+// UTF-8 encodings.
 
 #include <charconv>
 #include <cmath>
@@ -71,6 +74,9 @@ static bool parse_token_double(const char* s, int32_t len, double* out) {
   if (len <= 0) return false;
   const char* p = s;
   const char* end = s + len;
+  for (const char* q = p; q != end; q++) {
+    if (*q == '(') return false;  // from_chars accepts nan(seq); Python doesn't
+  }
   if (*p == '+') {
     p++;
     if (p == end || *p == '+' || *p == '-') return false;

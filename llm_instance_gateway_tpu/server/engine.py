@@ -1112,8 +1112,12 @@ class Engine:
                     did = True
                     continue
                 self._pending = None
-                if (self.cfg.prefill_batch > 1 and not self.paged
-                        and len(req.prompt_tokens) <= self._max_bucket()):
+                if (self.cfg.prefill_batch > 1
+                        and len(req.prompt_tokens) <= self._max_bucket()
+                        and not (self.paged and self._prefix_enabled)):
+                    # Prefix-cache engines stay per-request: the grouped
+                    # program computes full-prompt KV, so a cached-prefix
+                    # row would pay the compute reuse exists to skip.
                     self._do_prefill_group(
                         self._collect_prefill_group(req), pipelined)
                 elif pipelined:
@@ -1125,7 +1129,10 @@ class Engine:
             if (len(req.prompt_tokens) <= self._max_bucket()
                     and len(self.decode_wait) < cap):
                 self._pending = None
-                if self.cfg.prefill_batch > 1 and not self.paged:
+                if self.cfg.prefill_batch > 1:
+                    # Paged included: prefill-ahead KV parks OFF-cache, so
+                    # no pool blocks are touched until the drain, which
+                    # gates per row on _paged_can_admit.
                     self._do_prefill_ahead_group(
                         self._collect_ahead_group(req, cap), pipelined)
                 else:
@@ -1834,9 +1841,12 @@ class Engine:
         if batch is None:
             return
         live, ns, lora_slots, k, v, tok_rows, lp_rows = batch
+        pool_starved = False  # once a row parks on exhaustion, FIFO holds
         for i, req in enumerate(live):
             try:
                 slot_idx = self._free_slot_index()
+                if pool_starved:
+                    slot_idx = None  # later rows must not overtake the parked one
                 if slot_idx is None:
                     # Defensive: the free-slot count is taken at collection
                     # and the engine loop is single-threaded, so this should
@@ -1847,8 +1857,21 @@ class Engine:
                         k[:, i:i + 1], v[:, i:i + 1], ns[i], lora_slots[i],
                         pipelined)
                     continue
-                self._insert_prompt_kv(
-                    k[:, i:i + 1], v[:, i:i + 1], slot_idx, ns[i])
+                try:
+                    self._insert_prompt_kv(
+                        k[:, i:i + 1], v[:, i:i + 1], slot_idx, ns[i])
+                except PagedPoolExhausted:
+                    # The group outran the pool: this row (and, for FIFO,
+                    # every later row) parks off-cache like a prefill-ahead
+                    # and inserts when blocks free (_drain_decode_wait
+                    # gates on _paged_can_admit).  _insert_prompt_kv
+                    # already freed the partial row.
+                    pool_starved = True
+                    self._park_waiting(
+                        req, tok_rows[i], lp_rows[i],
+                        k[:, i:i + 1], v[:, i:i + 1], ns[i], lora_slots[i],
+                        pipelined)
+                    continue
                 if pipelined:
                     self._activate_slot_pipelined(
                         slot_idx, req, lora_slots[i], ns[i],

@@ -121,7 +121,9 @@ def parse_text(text: str) -> dict[str, list[Sample]]:
                 # Timestamps are int64 epoch-millis on the wire; values a
                 # 64-bit consumer can't hold are garbage, not data (and the
                 # native scanner's int64 field could not represent them).
-                if ts is not None and not (-(2 ** 63) <= ts < 2 ** 63):
+                # Exclusive lower bound: INT64_MIN is the scanner's
+                # absent-timestamp sentinel, so it can't be a value either.
+                if ts is not None and not (-(2 ** 63) < ts < 2 ** 63):
                     ts = None
         families.setdefault(name, []).append(
             Sample(name=name, labels=labels, value=value, timestamp_ms=ts)

@@ -29,12 +29,14 @@ from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 CFG = TINY_TEST
 
 
-@pytest.mark.parametrize("pipeline,prefill_batch,spec_k", [
-    (False, 1, 0), (True, 1, 0), (False, 3, 0), (True, 3, 0),
-    (False, 1, 2), (True, 1, 2),
+@pytest.mark.parametrize("pipeline,prefill_batch,spec_k,paged", [
+    (False, 1, 0, False), (True, 1, 0, False),
+    (False, 3, 0, False), (True, 3, 0, False),
+    (False, 1, 2, False), (True, 1, 2, False),
+    (True, 3, 0, True),
 ], ids=["sync", "pipelined", "sync-grouped", "pipelined-grouped",
-        "sync-spec", "pipelined-spec"])
-def test_request_storm_terminates(pipeline, prefill_batch, spec_k):
+        "sync-spec", "pipelined-spec", "pipelined-grouped-paged"])
+def test_request_storm_terminates(pipeline, prefill_batch, spec_k, paged):
     import dataclasses
 
     rng = random.Random(0)
@@ -61,7 +63,11 @@ def test_request_storm_terminates(pipeline, prefill_batch, spec_k):
         CFG, params,
         EngineConfig(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
                      decode_steps_per_sync=3, pipeline_decode=pipeline,
-                     prefill_batch=prefill_batch, speculative_k=spec_k),
+                     prefill_batch=prefill_batch, speculative_k=spec_k,
+                     paged_kv_block=8 if paged else None,
+                     # Undersized pool: the storm must survive grouped
+                     # admission hitting exhaustion-parking backpressure.
+                     paged_kv_blocks=24 if paged else None),
         lora_manager=lora, eos_id=7, dtype=jnp.float32, **draft_kw,
     )
     engine.start()

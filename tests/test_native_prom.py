@@ -5,6 +5,10 @@ its contract is producing EXACTLY what utils/prom_parse.parse_text produces
 — including the Python parser's quirks (label block spans first '{' to LAST
 '}', bad value tokens skip the line, timestamps truncate toward zero).
 Pinned here by edge cases plus a randomized fuzz corpus.
+
+Documented divergences (excluded from the corpus; neither occurs in the
+ASCII exposition format): PEP-515 underscore numerals ("1_0") and
+non-ASCII whitespace separators (e.g. NBSP) — see the prom_parse.cc header.
 """
 
 import random
@@ -163,3 +167,11 @@ def test_fast_dispatch_thresholds():
     big = "\n".join(f"m{i} {i}" for i in range(600)) + "\n"
     assert len(big) >= prom_parse._NATIVE_MIN_BYTES
     assert prom_parse.parse_text_fast(big) == prom_parse.parse_text(big)
+
+
+def test_nan_seq_rejected_and_int64_min_sentinel():
+    # float('nan(x)') raises in Python; from_chars would accept it.
+    assert_parity("m nan(x)\nn nan(x) 5\n")
+    # INT64_MIN is the scanner's absent sentinel; both parsers treat the
+    # boundary value as absent (exclusive lower bound).
+    assert_parity("m 1 -9223372036854775808\nn 2 -9223372036854775807\n")

@@ -200,3 +200,53 @@ class TestCollection:
         group = engine._collect_prefill_group(head)
         assert group == [head, live]
         assert dead.finish_reason == "cancelled"
+
+
+class TestPagedGroupedAdmission:
+    """Grouped prefill now admits into the PAGED pool too: same-bucket
+    bursts prefill as one program, rows allocate their blocks at insert,
+    and pool exhaustion parks rows (FIFO) instead of erroring them."""
+
+    def _serve_paged(self, prefill_batch, pipeline, n_blocks=None, slots=8,
+                     max_new=6):
+        engine = Engine(
+            CFG, PARAMS,
+            EngineConfig(decode_slots=slots, max_seq_len=128,
+                         prefill_buckets=(16, 32, 64),
+                         decode_steps_per_sync=4, pipeline_decode=pipeline,
+                         prefill_batch=prefill_batch,
+                         paged_kv_block=16, paged_kv_blocks=n_blocks),
+            lora_manager=None, eos_id=None, dtype=jnp.float32,
+        )
+        engine.start()
+        try:
+            reqs = [
+                Request(prompt_tokens=list(p), max_new_tokens=max_new,
+                        sampling=SamplingParams(temperature=0.0))
+                for p in PROMPTS
+            ]
+            for r in reqs:
+                engine.submit(r)
+            for r in reqs:
+                assert r.done.wait(120), "request timed out"
+                assert r.error is None, r.error
+            return [list(r.output_tokens) for r in reqs]
+        finally:
+            engine.stop()
+
+    @pytest.mark.parametrize("pipeline", [False, True],
+                             ids=["sync", "pipelined"])
+    def test_paged_grouped_matches_single(self, pipeline):
+        want = self._serve_paged(1, pipeline)
+        got = self._serve_paged(4, pipeline)
+        assert got == want
+
+    @pytest.mark.parametrize("pipeline", [False, True],
+                             ids=["sync", "pipelined"])
+    def test_tight_pool_parks_not_errors(self, pipeline):
+        """A pool too small for the whole burst at once: grouped admission
+        must backpressure rows through decode_wait and still produce the
+        unconstrained outputs."""
+        want = self._serve_paged(1, pipeline)
+        got = self._serve_paged(4, pipeline, n_blocks=10, slots=4)
+        assert got == want
