@@ -72,21 +72,31 @@ def _deadline() -> float:
     return _T0 + TOTAL_BUDGET_S
 
 
-def _emit(result: dict) -> bool:
-    """Print the one JSON result line exactly once, process-wide."""
+def _emit(result: dict, blocking: bool = True) -> bool:
+    """Print the one JSON result line exactly once, process-wide.
+
+    ``blocking=False`` is for the SIGTERM handler: it runs ON the main
+    thread, so blocking on a lock the interrupted frame holds (mid-print
+    inside _emit) would deadlock — if the lock is busy, an emit is already
+    in flight and the handler can simply proceed to exit.
+    """
     global _emitted
-    with _emit_lock:
+    if not _emit_lock.acquire(blocking=blocking):
+        return False
+    try:
         if _emitted:
             return False
         _emitted = True
         print(json.dumps(result), flush=True)
         return True
+    finally:
+        _emit_lock.release()
 
 
-def _emit_best_effort(note: str) -> None:
+def _emit_best_effort(note: str, blocking: bool = True) -> None:
     """Watchdog/SIGTERM path: emit whatever partial result exists."""
     if _partial.get("value"):
-        _emit({**_partial, "truncated": note})
+        _emit({**_partial, "truncated": note}, blocking=blocking)
     else:
         _emit({
             "metric": "multiplexed_lora_tokens_per_sec",
@@ -94,7 +104,7 @@ def _emit_best_effort(note: str) -> None:
             "unit": "tok/s",
             "vs_baseline": 0.0,
             "error": note,
-        })
+        }, blocking=blocking)
 
 
 def _install_governor() -> None:
@@ -191,7 +201,9 @@ def install_sigterm_cleanup() -> None:
     import signal
 
     def _term(signum, frame):
-        _emit_best_effort("SIGTERM")
+        # Non-blocking: the handler runs on the main thread and must not
+        # wait on a lock an interrupted _emit frame is holding.
+        _emit_best_effort("SIGTERM", blocking=False)
         raise SystemExit(143)
 
     try:

@@ -54,19 +54,26 @@ def _load_library():
         if _lib is not None:
             return _lib
         src = os.path.join(_NATIVE_DIR, "scheduler.cc")
-        stale = (
-            not os.path.exists(_LIB_PATH)
-            or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-        )
-        if stale:  # never serve semantics older than the source
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR, "-s", "-B"],
-                    check=True, capture_output=True, timeout=60,
+        try:
+            # Cross-process flock: see utils/prom_parse._load_native_locked
+            # — concurrent `make` runs can hand a sibling process a torn .so.
+            import fcntl
+
+            with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                stale = (
+                    not os.path.exists(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
                 )
-            except (subprocess.SubprocessError, OSError) as e:
-                logger.warning("native scheduler build failed: %s", e)
-                return None
+                if stale:  # never serve semantics older than the source
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR, "-s", "libligsched.so",
+                         "-B"],
+                        check=True, capture_output=True, timeout=60,
+                    )
+        except (subprocess.SubprocessError, OSError) as e:
+            logger.warning("native scheduler build failed: %s", e)
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError as e:

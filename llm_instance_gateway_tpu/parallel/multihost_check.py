@@ -107,30 +107,60 @@ def run_two_process(worker_src: str, n_local: int = 4,
     processes' combined stdout/stderr; raises RuntimeError on a non-zero
     exit.  The single launch scaffold for every two-process check (train
     and serve) — the coordination contract lives here only."""
+    import tempfile
+    import time
+
     port = free_port()
     procs = []
-    for pid in (0, 1):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["GRAFT_REPO"] = REPO
-        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local}"
-        env["TPU_GATEWAY_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["TPU_GATEWAY_PROCESS_ID"] = str(pid)
-        env["TPU_GATEWAY_NUM_PROCESSES"] = "2"
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", worker_src], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        ))
-    outs = []
+    files = []
+    timed_out = False
     try:
+        for pid in (0, 1):
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["GRAFT_REPO"] = REPO
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={n_local}")
+            env["TPU_GATEWAY_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["TPU_GATEWAY_PROCESS_ID"] = str(pid)
+            env["TPU_GATEWAY_NUM_PROCESSES"] = "2"
+            # Temp FILES, not pipes: a worker blocked writing a full 64KiB
+            # pipe while its peer waits in a cross-process collective would
+            # deadlock the pair (nobody drains until communicate()).
+            f = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
+                                       errors="replace")
+            files.append(f)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", worker_src], env=env,
+                stdout=f, stderr=subprocess.STDOUT, text=True,
+            ))
+        deadline = time.monotonic() + timeout_s
         for p in procs:
-            out, _ = p.communicate(timeout=timeout_s)
-            outs.append(out)
+            remaining = deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(0.1, remaining))
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        outs = []
+        for f in files:
+            f.seek(0)
+            outs.append(f.read())
+            f.close()
+    if timed_out:
+        raise RuntimeError(
+            "two-process worker timed out:\n"
+            + "\n---\n".join(o[-2000:] for o in outs))
     for p, out in zip(procs, outs):
         if p.returncode != 0:
             raise RuntimeError(f"two-process worker failed:\n{out[-3000:]}")

@@ -855,7 +855,8 @@ class Engine:
 
     def _paged_can_admit(self, n_prompt: int,
                          prompt: list[int] | None = None,
-                         adapter: str | None = None) -> bool:
+                         adapter: str | None = None,
+                         hashes: list[bytes] | None = None) -> bool:
         """Capacity gate.  With ``prompt`` given, cached-prefix blocks that
         would map at zero cost are subtracted from the need — otherwise a
         shared system prompt held by a live request would spuriously
@@ -876,7 +877,7 @@ class Engine:
         # _paged_ensure found the pool dry and errored the request instead
         # of backpressuring it.  Live-held matched blocks (refs > 0, not in
         # either pool) are the zero-cost case this clause exists for.
-        matched = self._prefix_match_blocks(prompt, adapter)
+        matched = self._prefix_match_blocks(prompt, adapter, hashes)
         reuse_needed = needed - min(len(matched), needed)
         reuse_avail = avail - sum(1 for b in matched if b in self._evictable)
         return reuse_needed <= reuse_avail
@@ -950,15 +951,31 @@ class Engine:
             out.append(h)
         return out
 
-    def _prefix_match_blocks(self, prompt: list[int],
-                             adapter: str | None) -> list[int]:
+    def _prefix_hashes_for(self, req: Request) -> list[bytes]:
+        """Memoized hash chain for a request's prompt: the digests depend
+        only on (prompt, adapter), but backpressured admission re-checks
+        the SAME pending request every engine cycle (~20Hz) — without the
+        memo a long prompt recomputes hundreds of SHA-256 calls per spin
+        on the thread that also drives decode dispatch."""
+        memo = getattr(req, "_prefix_hash_memo", None)
+        if memo is None:
+            memo = self._prefix_hashes(
+                req.prompt_tokens,
+                (len(req.prompt_tokens) - 1) // self._block, req.adapter)
+            req._prefix_hash_memo = memo
+        return memo
+
+    def _prefix_match_blocks(self, prompt: list[int], adapter: str | None,
+                             hashes: list[bytes] | None = None) -> list[int]:
         """Dry-run of the hash walk: the physical blocks that would map
         (no incref)."""
         if not self._prefix_enabled:
             return []
+        if hashes is None:
+            hashes = self._prefix_hashes(
+                prompt, (len(prompt) - 1) // self._block, adapter)
         out = []
-        for h in self._prefix_hashes(
-                prompt, (len(prompt) - 1) // self._block, adapter):
+        for h in hashes:
             blk = self._prefix_table.get(h)
             if blk is None:
                 break
@@ -966,7 +983,8 @@ class Engine:
         return out
 
     def _prefix_match_and_map(self, row: int, prompt: list[int],
-                              adapter: str | None) -> int:
+                              adapter: str | None,
+                              hashes: list[bytes] | None = None) -> int:
         """Map the longest cached prefix into ``row``'s table (increfs).
         Returns the number of reused TOKENS (multiple of the block size).
         At least the prompt's last token always recomputes, so the request
@@ -976,7 +994,9 @@ class Engine:
         max_blocks = (len(prompt) - 1) // self._block
         blocks = self._row_blocks[row]
         assert not blocks, "prefix map must precede suffix allocation"
-        for h in self._prefix_hashes(prompt, max_blocks, adapter):
+        if hashes is None:
+            hashes = self._prefix_hashes(prompt, max_blocks, adapter)
+        for h in hashes:
             blk = self._prefix_table.get(h)
             if blk is None:
                 break
@@ -990,15 +1010,17 @@ class Engine:
         return reused
 
     def _prefix_register_row(self, row: int, prompt: list[int],
-                             adapter: str | None) -> None:
+                             adapter: str | None,
+                             hashes: list[bytes] | None = None) -> None:
         """After a prompt is fully in the row's blocks, publish its full
         blocks to the prefix table so later prompts can share them."""
         if not self._prefix_enabled:
             return
         max_blocks = (len(prompt) - 1) // self._block
         blocks = self._row_blocks[row]
-        for i, h in enumerate(
-                self._prefix_hashes(prompt, max_blocks, adapter)):
+        if hashes is None:
+            hashes = self._prefix_hashes(prompt, max_blocks, adapter)
+        for i, h in enumerate(hashes):
             blk = blocks[i]
             if self._block_hash.get(blk) is not None:
                 continue  # already a cached block (mapped via reuse)
@@ -1099,8 +1121,11 @@ class Engine:
                     # backpressure): strict FIFO — don't let a newer request
                     # steal the blocks it is waiting for.
                     break
-                if not self._paged_can_admit(len(req.prompt_tokens),
-                                              req.prompt_tokens, req.adapter):
+                if not self._paged_can_admit(
+                        len(req.prompt_tokens), req.prompt_tokens,
+                        req.adapter,
+                        hashes=(self._prefix_hashes_for(req)
+                                if self._prefix_enabled else None)):
                     break  # pool backpressure: wait for block frees
                 if (len(req.prompt_tokens) > self._max_bucket()
                         and not self._ring_usable(len(req.prompt_tokens))):
@@ -1454,13 +1479,26 @@ class Engine:
         k1 = self.cfg.speculative_k + 1
         return max(1, -(-steps // k1))
 
+    def _spec_row_steps(self, n_cycles: int, k: int) -> list[int]:
+        """Per-row paged write-frontier for one spec block: a speculating
+        row can advance K+1 per cycle; a sampled/non-spec row advances one
+        per cycle but each verify still writes a K-token rejected tail."""
+        return [
+            n_cycles * (k + 1)
+            if self._spec_ok[i] and self._slot_temp[i] <= 0.0
+            else n_cycles + k + 1
+            for i in range(self.cfg.decode_slots)
+        ]
+
     def _do_spec_step(self) -> None:
         """Sync-loop speculative dispatch: one fused block of cycles."""
         k = self.cfg.speculative_k
         n_cycles = self._spec_cycles_per_sync()
         # Paged: every position a cycle can write (accepted or rejected)
         # must have a real block before dispatch.
-        self._paged_ensure_decode(n_cycles * (k + 1), pipelined=False)
+        self._paged_ensure_decode(
+            n_cycles * (k + 1), pipelined=False,
+            per_row_steps=self._spec_row_steps(n_cycles, k))
         t0 = time.perf_counter()
         (toks, valid, lps, top_v, top_i, _next_tok, _next_pos, _next_rem,
          next_etok, next_epos, next_has, self.cache, self.draft_cache) = (
@@ -1572,7 +1610,8 @@ class Engine:
         Returns the ``_prefill_common`` tuple, or None when nothing cached
         matched (caller falls through to the plain bucketed program)."""
         reused = self._prefix_match_and_map(
-            slot_idx, req.prompt_tokens, req.adapter)
+            slot_idx, req.prompt_tokens, req.adapter,
+            hashes=self._prefix_hashes_for(req))
         if reused == 0:
             return None
         try:
@@ -1600,7 +1639,8 @@ class Engine:
                 lora_slot=jnp.int32(lora_slot),
             )
             self._prefix_register_row(slot_idx, req.prompt_tokens,
-                                      req.adapter)
+                                      req.adapter,
+                                      hashes=self._prefix_hashes_for(req))
             sp = req.sampling
             first_token, lp_info = self._jit_sample_one(
                 last_logits, self._next_key(), jnp.float32(sp.temperature),
@@ -2122,7 +2162,8 @@ class Engine:
                 # will ever run for it).
                 self._paged_free_row(slot_idx)
 
-    def _paged_ensure_decode(self, n_steps: int, pipelined: bool) -> None:
+    def _paged_ensure_decode(self, n_steps: int, pipelined: bool,
+                             per_row_steps: list[int] | None = None) -> None:
         """Pre-dispatch block growth for every active row.
 
         Pipelined mode's host position lags the device by the IN-FLIGHT
@@ -2131,19 +2172,26 @@ class Engine:
         (cycles x (K+1) writes, including rejected tails) interleave with
         plain blocks, so a flat 2*n_steps would under-reserve after a
         larger block and route in-flight KV writes to the trash block.
-        Over-reservation is returned at free.  A row the exhausted pool
-        cannot grow fails with "kv pool exhausted" (the documented
-        oversubscription tradeoff) without touching the batch.
+        ``per_row_steps`` narrows the reservation per row (a sampled row
+        in a speculative block advances one token per cycle, so its write
+        frontier is cycles+K, not cycles*(K+1) — reserving the worst case
+        for every row would make tight pools fail requests speculation-off
+        would serve).  Over-reservation is returned at free.  A row the
+        exhausted pool cannot grow fails with "kv pool exhausted" (the
+        documented oversubscription tradeoff) without touching the batch.
         """
         if not self.paged:
             return
-        lag = n_steps + (self._prev_dispatch_steps if pipelined else 0)
+        prev = self._prev_dispatch_steps if pipelined else 0
         if pipelined:
             self._prev_dispatch_steps = n_steps
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
-            target = min(slot.position + lag + 1, self.cfg.max_seq_len)
+            row_steps = (per_row_steps[i] if per_row_steps is not None
+                         else n_steps)
+            target = min(slot.position + row_steps + prev + 1,
+                         self.cfg.max_seq_len)
             try:
                 self._paged_ensure(i, target)
             except PagedPoolExhausted as e:
@@ -2367,7 +2415,9 @@ class Engine:
         verify is exact regardless of what the draft proposes."""
         k = self.cfg.speculative_k
         n_cycles = self._spec_cycles_per_sync()
-        self._paged_ensure_decode(n_cycles * (k + 1), pipelined=True)
+        self._paged_ensure_decode(
+            n_cycles * (k + 1), pipelined=True,
+            per_row_steps=self._spec_row_steps(n_cycles, k))
         if self._pending_budget_zero:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
             self._dev_remaining = self._dev_remaining.at[idxs].set(0)
