@@ -16,7 +16,6 @@ import ctypes
 import logging
 import os
 import random
-import subprocess
 import threading
 
 import numpy as np
@@ -53,26 +52,12 @@ def _load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_NATIVE_DIR, "scheduler.cc")
-        try:
-            # Cross-process flock: see utils/prom_parse._load_native_locked
-            # — concurrent `make` runs can hand a sibling process a torn .so.
-            import fcntl
+        from llm_instance_gateway_tpu.utils.native_build import (
+            ensure_native_lib,
+        )
 
-            with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
-                fcntl.flock(lockf, fcntl.LOCK_EX)
-                stale = (
-                    not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-                )
-                if stale:  # never serve semantics older than the source
-                    subprocess.run(
-                        ["make", "-C", _NATIVE_DIR, "-s", "libligsched.so",
-                         "-B"],
-                        check=True, capture_output=True, timeout=60,
-                    )
-        except (subprocess.SubprocessError, OSError) as e:
-            logger.warning("native scheduler build failed: %s", e)
+        if ensure_native_lib(_NATIVE_DIR, "libligsched.so",
+                             "scheduler.cc") is None:
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)

@@ -268,6 +268,8 @@ class ModelServer:
         """
         try:
             self.engine.submit(req)
+        except RuntimeError as e:
+            return _err(503, str(e))  # draining: replica is leaving the set
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
@@ -467,6 +469,8 @@ class ModelServer:
         ]
         try:
             reqs = await self._run_many(reqs, stops)
+        except RuntimeError as e:
+            return _err(503, str(e))  # draining
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
@@ -549,6 +553,8 @@ class ModelServer:
                 for _ in range(n)]
         try:
             reqs = await self._run_many(reqs, stops)
+        except RuntimeError as e:
+            return _err(503, str(e))  # draining
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
@@ -643,6 +649,15 @@ class ModelServer:
         )
 
     async def handle_health(self, request: web.Request) -> web.Response:
+        if self.engine.draining:
+            # Readiness flip: the EPP's health-probed membership (and a k8s
+            # readinessProbe) drops a draining replica from the routable
+            # set while in-flight requests finish.  (On the SIGTERM path
+            # aiohttp closes the listener before on_shutdown, so probes see
+            # connection-refused instead — the same unready outcome; this
+            # branch serves keep-alive connections and any future admin-
+            # initiated drain.)
+            return web.Response(status=503, text="draining")
         return web.Response(text="ok")
 
 
@@ -695,6 +710,12 @@ def main(argv=None) -> None:
         help="enable the paged KV cache with this block size (e.g. 64); "
              "kv metrics then report allocated/total blocks (vLLM "
              "gpu_cache_usage_perc semantics)",
+    )
+    parser.add_argument(
+        "--drain-grace", type=float, default=30.0, metavar="S",
+        help="graceful termination: on SIGTERM, flip /health to 503 (the "
+             "EPP drops the replica), refuse new requests, and give "
+             "in-flight ones this many seconds to finish",
     )
     parser.add_argument(
         "--kv-quantize", choices=("none", "int8"), default="none",
@@ -839,8 +860,23 @@ def main(argv=None) -> None:
     engine.start()
     server = ModelServer(engine, tokenizer, served_name, lora_manager,
                          aliases={args.model})
+    app = server.build_app()
+
+    async def _graceful_drain(app_):
+        # SIGTERM path (aiohttp stops accepting, then runs on_shutdown while
+        # in-flight handlers get shutdown_timeout to finish): flip /health
+        # to 503, refuse new submits, and let the engine decode the
+        # in-flight work to completion before the loop stops.
+        logger.info("draining engine (grace %.0fs)", args.drain_grace)
+        loop = asyncio.get_running_loop()
+        drained = await loop.run_in_executor(
+            None, lambda: engine.drain(args.drain_grace))
+        logger.info("drain %s", "complete" if drained else "timed out")
+
+    app.on_shutdown.append(_graceful_drain)
     try:
-        web.run_app(server.build_app(), port=args.port)
+        web.run_app(app, port=args.port,
+                    shutdown_timeout=args.drain_grace + 30)
     finally:
         engine.stop()
 

@@ -496,6 +496,11 @@ class Engine:
         self._reserved_slots: set[int] = set()
         self._work = threading.Condition()
         self._running = False
+        self._draining = False
+        # Requests mid-admission (popped from the queue, slot not yet
+        # registered): counted into num_requests_waiting so drain() and the
+        # routing signal never see a phantom-quiescent engine.
+        self._admitting = 0
         self._thread: threading.Thread | None = None
 
         # Telemetry (exported by server.metrics in the gateway contract).
@@ -714,9 +719,58 @@ class Engine:
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # Anything still queued/parked/active when the loop exits would
+        # leave its done Event unset forever (handlers block until their
+        # own timeout).  Fail stragglers explicitly — after a drain this
+        # set is empty; after an abrupt stop it is the honest outcome.
+        stragglers: list[Request] = []
+        if self._pending is not None:
+            stragglers.append(self._pending)
+            self._pending = None
+        while True:
+            try:
+                stragglers.append(self.prefill_queue.get_nowait())
+            except queue_mod.Empty:
+                break
+        while self.decode_wait:
+            w = self.decode_wait.popleft()
+            self._parked_kv_tokens -= w.k.shape[2]
+            stragglers.append(w.request)
+        if self._stream is not None:
+            stragglers.append(self._stream.request)
+            self._stream = None
+        stragglers += [s.request for s in self.slots if s is not None]
+        for req in stragglers:
+            if not req.done.is_set():
+                req.error = req.error or "engine stopped"
+                self._finish(req, "error")
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful-termination half of the pod lifecycle: stop ADMITTING
+        (submit raises; the /health flip pulls the replica out of the
+        EPP's routable set) while the loop keeps decoding until every
+        queued/parked/running request reaches a terminal state.  Returns
+        True when fully drained, False on timeout — either way the caller
+        then calls ``stop()`` (stragglers fail as the loop exits; k8s
+        would be at the end of terminationGracePeriod anyway)."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            snap = self.metrics_snapshot()
+            if (snap["num_requests_running"] == 0
+                    and snap["num_requests_waiting"] == 0):
+                return True
+            time.sleep(0.02)
+        return False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def submit(self, request: Request) -> Request:
         """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
+        if self._draining:
+            raise RuntimeError("engine is draining (graceful termination)")
         if len(request.prompt_tokens) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
@@ -801,7 +855,7 @@ class Engine:
         # gateway would route MORE traffic to the replica busiest streaming.
         prefill_depth = self.prefill_queue.qsize() + (
             1 if self._pending is not None else 0) + (
-            1 if self._stream is not None else 0)
+            1 if self._stream is not None else 0) + self._admitting
         decode_depth = len(self.decode_wait)
         return {
             "prefill_queue_size": prefill_depth,
@@ -1132,36 +1186,48 @@ class Engine:
                     if self._stream is not None:
                         break  # one stream at a time; FIFO head waits
                     self._pending = None
-                    if not self._start_stream(req):
-                        break  # reparked for backpressure; stop this cycle
+                    self._admitting += 1
+                    try:
+                        if not self._start_stream(req):
+                            break  # reparked for backpressure; stop cycle
+                    finally:
+                        self._admitting -= 1
                     did = True
                     continue
                 self._pending = None
-                if (self.cfg.prefill_batch > 1
-                        and len(req.prompt_tokens) <= self._max_bucket()
-                        and not (self.paged and self._prefix_enabled)):
-                    # Prefix-cache engines stay per-request: the grouped
-                    # program computes full-prompt KV, so a cached-prefix
-                    # row would pay the compute reuse exists to skip.
-                    self._do_prefill_group(
-                        self._collect_prefill_group(req), pipelined)
-                elif pipelined:
-                    self._do_prefill_pipelined(req)
-                else:
-                    self._do_prefill(req)
+                self._admitting += 1
+                try:
+                    if (self.cfg.prefill_batch > 1
+                            and len(req.prompt_tokens) <= self._max_bucket()
+                            and not (self.paged and self._prefix_enabled)):
+                        # Prefix-cache engines stay per-request: the grouped
+                        # program computes full-prompt KV, so a cached-prefix
+                        # row would pay the compute reuse exists to skip.
+                        self._do_prefill_group(
+                            self._collect_prefill_group(req), pipelined)
+                    elif pipelined:
+                        self._do_prefill_pipelined(req)
+                    else:
+                        self._do_prefill(req)
+                finally:
+                    self._admitting -= 1
                 did = True
                 continue
             if (len(req.prompt_tokens) <= self._max_bucket()
                     and len(self.decode_wait) < cap):
                 self._pending = None
-                if self.cfg.prefill_batch > 1:
-                    # Paged included: prefill-ahead KV parks OFF-cache, so
-                    # no pool blocks are touched until the drain, which
-                    # gates per row on _paged_can_admit.
-                    self._do_prefill_ahead_group(
-                        self._collect_ahead_group(req, cap), pipelined)
-                else:
-                    self._do_prefill_ahead(req, pipelined)
+                self._admitting += 1
+                try:
+                    if self.cfg.prefill_batch > 1:
+                        # Paged included: prefill-ahead KV parks OFF-cache,
+                        # so no pool blocks are touched until the drain,
+                        # which gates per row on _paged_can_admit.
+                        self._do_prefill_ahead_group(
+                            self._collect_ahead_group(req, cap), pipelined)
+                    else:
+                        self._do_prefill_ahead(req, pipelined)
+                finally:
+                    self._admitting -= 1
                 did = True
                 continue
             break

@@ -1,0 +1,51 @@
+"""Shared build-and-load protocol for the C++ hot-path libraries.
+
+One implementation of the stale-check / cross-process-lock / make dance
+used by the scheduler (`gateway/scheduling/native.py`) and the prom
+scanner (`utils/prom_parse.py`) — the protocol must not drift between
+them.
+
+Ordering matters: the stale check is READ-ONLY and happens first, so a
+read-only install with a fresh prebuilt .so never needs the lock file and
+loads fine; the flock is taken only when a build is actually required
+(two processes racing `make` could hand one of them a torn .so), and the
+staleness is re-checked under the lock so the loser of the race skips
+straight past the winner's fresh build.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+
+def _stale(lib_path: str, src_path: str) -> bool:
+    return (not os.path.exists(lib_path)
+            or os.path.getmtime(lib_path) < os.path.getmtime(src_path))
+
+
+def ensure_native_lib(native_dir: str, target: str, src: str,
+                      timeout_s: float = 60.0) -> str | None:
+    """Return the path of an up-to-date ``target`` .so in ``native_dir``,
+    building it (serialized across processes) if stale.  None = can't be
+    made current (caller falls back to its pure-Python path)."""
+    lib_path = os.path.join(native_dir, target)
+    src_path = os.path.join(native_dir, src)
+    try:
+        if not _stale(lib_path, src_path):
+            return lib_path  # fresh prebuilt: no lock, no writes
+        import fcntl
+
+        with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if _stale(lib_path, src_path):  # loser of the race skips this
+                subprocess.run(
+                    ["make", "-C", native_dir, "-s", target, "-B"],
+                    check=True, capture_output=True, timeout=timeout_s)
+        return lib_path
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build of %s failed: %s", target, e)
+        return None

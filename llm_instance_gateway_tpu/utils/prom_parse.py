@@ -180,26 +180,16 @@ def _load_native_locked():
     import os
     import subprocess
 
+    from llm_instance_gateway_tpu.utils.native_build import ensure_native_lib
+
     native_dir = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
-    lib_path = os.path.join(native_dir, "libligprom.so")
-    src = os.path.join(native_dir, "prom_parse.cc")
     try:
-        # Cross-PROCESS flock (the threading lock covers only this process):
-        # two gateways on one host both seeing a stale .so would race `make`
-        # and one could dlopen a torn file — permanently pinning it to the
-        # slow path.  The stale check re-runs under the lock: the loser
-        # finds the winner's fresh build and skips straight to CDLL.
-        import fcntl
-
-        with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            stale = (not os.path.exists(lib_path)
-                     or os.path.getmtime(lib_path) < os.path.getmtime(src))
-            if stale:  # never serve semantics older than the source
-                subprocess.run(
-                    ["make", "-C", native_dir, "-s", "libligprom.so"],
-                    check=True, capture_output=True, timeout=60)
+        lib_path = ensure_native_lib(native_dir, "libligprom.so",
+                                     "prom_parse.cc")
+        if lib_path is None:
+            _native_lib = None
+            return None
         lib = ctypes.CDLL(lib_path)
         lib.lig_prom_parse.restype = ctypes.c_int32
         lib.lig_prom_parse.argtypes = [

@@ -472,3 +472,50 @@ class TestMetricsSnapshot:
             assert metrics.max_active_adapters == CFG.max_lora_slots
         finally:
             lora.unload("scrape-adapter")
+
+
+class TestGracefulDrain:
+    """Pod-lifecycle drain (SIGTERM half): admitting stops, in-flight work
+    finishes, and the readiness signal flips so the EPP routes away."""
+
+    def _engine(self):
+        params = transformer.init_params(CFG, jax.random.PRNGKey(0),
+                                         dtype=jnp.float32)
+        return Engine(
+            CFG, params,
+            EngineConfig(decode_slots=2, max_seq_len=64,
+                         prefill_buckets=(8, 16)),
+            lora_manager=None, eos_id=None, dtype=jnp.float32)
+
+    def test_drain_finishes_inflight_and_refuses_new(self):
+        engine = self._engine()
+        engine.start()
+        try:
+            inflight = [Request(prompt_tokens=[3 + i, 9], max_new_tokens=12,
+                                sampling=SamplingParams(temperature=0.0))
+                        for i in range(3)]  # 3 reqs > 2 slots: one queues
+            for r in inflight:
+                engine.submit(r)
+            drained = engine.drain(timeout_s=120)
+            assert drained is True
+            assert engine.draining is True
+            for r in inflight:  # everything admitted before drain finished
+                assert r.done.is_set() and r.error is None
+                assert len(r.output_tokens) == 12
+            with pytest.raises(RuntimeError, match="draining"):
+                engine.submit(Request(prompt_tokens=[5], max_new_tokens=2,
+                                      sampling=SamplingParams()))
+        finally:
+            engine.stop()
+
+    def test_drain_timeout_reports_false(self):
+        engine = self._engine()
+        engine.start()
+        try:
+            r = Request(prompt_tokens=[3, 9], max_new_tokens=40,
+                        sampling=SamplingParams(temperature=0.0))
+            engine.submit(r)
+            assert engine.drain(timeout_s=0.01) is False  # too short
+            assert r.done.wait(120)  # loop still finishes the request
+        finally:
+            engine.stop()
