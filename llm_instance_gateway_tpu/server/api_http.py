@@ -84,7 +84,15 @@ class ModelServer:
         return self.tokenizer.encode(str(prompt))
 
     def _make_request(self, body: dict, prompt_tokens: list[int], adapter,
-                      logprobs: int | None = None) -> Request:
+                      logprobs: int | None = None,
+                      candidate: int = 0) -> Request:
+        seed = body.get("seed")
+        if seed is not None:
+            # n/best_of fan-out with one seed would produce identical
+            # candidates (the draw depends only on seed+position); folding
+            # the candidate index keeps each choice distinct yet the whole
+            # response reproducible (vLLM does the same).
+            seed = int(seed) + candidate
         return Request(
             prompt_tokens=prompt_tokens,
             max_new_tokens=int(body.get("max_tokens", 64)),
@@ -92,6 +100,7 @@ class ModelServer:
                 temperature=float(body.get("temperature", 0.0)),
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
+                seed=seed,
             ),
             adapter=adapter,
             logprobs=logprobs,
@@ -464,8 +473,8 @@ class ModelServer:
             0 if best_of > n else None)
         reqs = [
             self._make_request(body, list(prompt_tokens), adapter,
-                               logprobs=record)
-            for _ in range(best_of)
+                               logprobs=record, candidate=i)
+            for i in range(best_of)
         ]
         try:
             reqs = await self._run_many(reqs, stops)
@@ -549,8 +558,9 @@ class ModelServer:
                 },
                 stops=stops,
             )
-        reqs = [self._make_request(body, list(prompt_tokens), adapter)
-                for _ in range(n)]
+        reqs = [self._make_request(body, list(prompt_tokens), adapter,
+                                   candidate=i)
+                for i in range(n)]
         try:
             reqs = await self._run_many(reqs, stops)
         except RuntimeError as e:

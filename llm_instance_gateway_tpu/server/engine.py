@@ -157,6 +157,17 @@ class SamplingParams:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # Reproducible sampling (OpenAI `seed`): tokens depend only on
+    # (seed, position, distribution) — batch composition, restarts, and
+    # the engine RNG stop mattering.  None = engine RNG.
+    seed: int | None = None
+
+
+def _seed_i32(seed: int | None) -> int:
+    """Map a user seed onto the device-side int32 lane: None -> -1 (engine
+    RNG); any int masks to non-negative 31-bit (PRNGKey folds it, so only
+    equality of masked values matters for reproducibility)."""
+    return -1 if seed is None else (int(seed) & 0x7FFFFFFF)
 
 
 @dataclass
@@ -475,6 +486,7 @@ class Engine:
         self._slot_temp = np.zeros((b,), np.float32)
         self._slot_topk = np.zeros((b,), np.int32)
         self._slot_topp = np.ones((b,), np.float32)
+        self._slot_seed = np.full((b,), -1, np.int32)
         # Per-row token budget for device-side stop (0 = frozen row).
         self._slot_remaining = np.zeros((b,), np.int32)
         self._eos_for_device = jnp.int32(-1 if eos_id is None else eos_id)
@@ -544,11 +556,13 @@ class Engine:
             ),
             donate_argnames=("cache",),
         )
-        def _sample_one(logits, key, t, k, p):
+        def _sample_one(logits, key, t, k, p, seed, pos):
             tok = sample(
                 logits[None], key, jnp.full((1,), t, jnp.float32),
                 jnp.full((1,), k, jnp.int32), jnp.full((1,), p, jnp.float32),
                 valid_vocab=model_cfg.vocab_size,
+                seeds=jnp.full((1,), seed, jnp.int32),
+                positions=jnp.full((1,), pos, jnp.int32),
             )
             lp, top_v, top_i = _logprob_info(
                 logits[None], tok, model_cfg.vocab_size)
@@ -610,7 +624,7 @@ class Engine:
     @staticmethod
     def _prefill_impl(
         model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_len,
-        lora_slot, temp, topk, topp, key,
+        lora_slot, temp, topk, topp, key, seed,
     ):
         """Prefill one padded prompt; sample the first new token."""
         slot_ids = jnp.full((1,), lora_slot, jnp.int32)
@@ -625,6 +639,8 @@ class Engine:
             top_k=jnp.full((1,), topk, jnp.int32),
             top_p=jnp.full((1,), topp, jnp.float32),
             valid_vocab=model_cfg.vocab_size,
+            seeds=jnp.full((1,), seed, jnp.int32),
+            positions=jnp.full((1,), true_len - 1, jnp.int32),
         )
         lp, top_v, top_i = _logprob_info(last, first_token, model_cfg.vocab_size)
         return first_token[0], k, v, (lp[0], top_v[0], top_i[0])
@@ -632,7 +648,7 @@ class Engine:
     @staticmethod
     def _prefill_many_impl(
         model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_lens,
-        lora_slots, temps, topks, topps, key,
+        lora_slots, temps, topks, topps, key, seeds,
     ):
         """Prefill P padded same-bucket prompts as one program; sample each
         row's first token (the [P, bucket] generalization of
@@ -644,14 +660,16 @@ class Engine:
         last = jnp.take_along_axis(
             logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [P, V]
         first_tokens = sample(
-            last, key, temps, topks, topps, valid_vocab=model_cfg.vocab_size)
+            last, key, temps, topks, topps, valid_vocab=model_cfg.vocab_size,
+            seeds=seeds, positions=true_lens - 1)
         lp, top_v, top_i = _logprob_info(last, first_tokens, model_cfg.vocab_size)
         return first_tokens, k, v, (lp, top_v, top_i)
 
     @staticmethod
     def _decode_impl(
         model_cfg, step_fn, params, lora_bufs, cache, tokens, positions,
-        slot_ids, temp, topk, topp, key, remaining, eos_id, n_steps: int,
+        slot_ids, temp, topk, topp, key, remaining, eos_id, seeds,
+        n_steps: int,
     ):
         """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
 
@@ -680,7 +698,8 @@ class Engine:
                 lora_bufs=lora_bufs, slot_ids=slot_ids,
             )
             sampled = sample(logits, step_key, temp, topk, topp,
-                             valid_vocab=model_cfg.vocab_size)
+                             valid_vocab=model_cfg.vocab_size,
+                             seeds=seeds, positions=safe_pos)
             lp, top_v, top_i = _logprob_info(
                 logits, sampled, model_cfg.vocab_size)
             valid = active
@@ -899,6 +918,7 @@ class Engine:
             self._spec_has_extra[i] = False
         self._slot_lora[i] = -1
         self._slot_remaining[i] = 0
+        self._slot_seed[i] = -1
         if self.paged:
             self._paged_free_row(i)
 
@@ -1345,7 +1365,7 @@ class Engine:
     def _spec_block_impl(model_cfg, draft_cfg, params, draft_params,
                          lora_bufs, cache, draft_cache, tokens, positions,
                          remaining, extra_tok, extra_pos, has_extra, spec_ok,
-                         temp, topk, topp, key, slot_ids, eos_id,
+                         temp, topk, topp, key, slot_ids, eos_id, seeds,
                          n_cycles: int, k_steps: int):
         """``n_cycles`` fused speculative cycles, entirely device-side.
 
@@ -1438,7 +1458,8 @@ class Engine:
             greedy = greedy_pick(logits, model_cfg.vocab_size)  # [B, K+1]
             first_sampled = sample(
                 logits[:, 0], cycle_key, temp, topk, topp,
-                valid_vocab=model_cfg.vocab_size)
+                valid_vocab=model_cfg.vocab_size,
+                seeds=seeds, positions=safe_pos)
             greedy_row = spec_ok & (temp <= 0.0)
             e0 = jnp.where(greedy_row, greedy[:, 0], first_sampled)
             # d_{i+1} must equal the target's greedy continuation g_i.
@@ -1581,6 +1602,7 @@ class Engine:
                 jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
                 jnp.asarray(self._slot_topp), self._next_key(),
                 jnp.asarray(self._slot_lora), self._eos_for_device,
+                jnp.asarray(self._slot_seed),
                 n_cycles=n_cycles, k_steps=k))
         toks_np = np.asarray(toks)  # [T, B]
         valid_np = np.asarray(valid)
@@ -1710,7 +1732,9 @@ class Engine:
             sp = req.sampling
             first_token, lp_info = self._jit_sample_one(
                 last_logits, self._next_key(), jnp.float32(sp.temperature),
-                jnp.int32(sp.top_k), jnp.float32(sp.top_p))
+                jnp.int32(sp.top_k), jnp.float32(sp.top_p),
+                jnp.int32(_seed_i32(sp.seed)),
+                jnp.int32(n - 1))
         except BaseException:
             # Defensive: _paged_can_admit gated this admission (matched
             # blocks excluded from avail when pinned out of the evictable
@@ -1756,6 +1780,8 @@ class Engine:
             logits[0, n - 1], self._next_key(),
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p),
+            jnp.int32(_seed_i32(sp.seed)),
+            jnp.int32(n - 1),
         )
         return first_token, k, v, lp_info
 
@@ -1774,6 +1800,7 @@ class Engine:
             jnp.int32(n), jnp.int32(lora_slot),
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p), self._next_key(),
+            jnp.int32(_seed_i32(sp.seed)),
         )
 
     def _bucket_prefill_many(self, reqs, ns, lora_slots):
@@ -1795,6 +1822,8 @@ class Engine:
             jnp.asarray([sp.top_k for sp in sps], jnp.int32),
             jnp.asarray([sp.top_p for sp in sps], jnp.float32),
             self._next_key(),
+            jnp.asarray([_seed_i32(sp.seed) for sp in sps],
+                        jnp.int32),
         )
 
     def _collect_followers(self, first_req, limit: int) -> list:
@@ -2129,6 +2158,8 @@ class Engine:
                 st.last_logits, self._next_key(),
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p),
+                jnp.int32(_seed_i32(sp.seed)),
+                jnp.int32(n - 1),
             )
             if pipelined:
                 try:
@@ -2161,6 +2192,7 @@ class Engine:
         self._slot_temp[slot_idx] = sp.temperature
         self._slot_topk[slot_idx] = sp.top_k
         self._slot_topp[slot_idx] = sp.top_p
+        self._slot_seed[slot_idx] = _seed_i32(sp.seed)
         # Budget for device-side stop: the prefill already produced token 1.
         self._slot_remaining[slot_idx] = max(0, slot.request.max_new_tokens - 1)
 
@@ -2282,6 +2314,7 @@ class Engine:
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp), self._next_key(),
             jnp.asarray(self._slot_remaining), self._eos_for_device,
+            jnp.asarray(self._slot_seed),
             n_steps=n_steps,
         )
         toks_np = np.asarray(step_tokens)  # [n_steps, B]
@@ -2449,6 +2482,7 @@ class Engine:
                 jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
                 jnp.asarray(self._slot_topp), self._next_key(),
                 self._dev_remaining, self._eos_for_device,
+                jnp.asarray(self._slot_seed),
                 n_steps=n_steps,
             )
         )
@@ -2499,6 +2533,7 @@ class Engine:
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp), self._next_key(),
             jnp.asarray(self._slot_lora), self._eos_for_device,
+            jnp.asarray(self._slot_seed),
             n_cycles=n_cycles, k_steps=k)
         self._dev_tokens = next_tokens
         self._dev_positions = next_positions

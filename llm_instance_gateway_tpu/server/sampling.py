@@ -21,6 +21,8 @@ def sample(
     top_k: jax.Array,         # [B] int32; 0 = disabled
     top_p: jax.Array,         # [B] f32; 1.0 = disabled
     valid_vocab: int | None = None,  # static: ids >= this are MXU padding
+    seeds: jax.Array | None = None,      # [B] int32; -1 = engine RNG
+    positions: jax.Array | None = None,  # [B] int32 — current input position
 ) -> jax.Array:
     """Returns sampled token ids [B].
 
@@ -28,6 +30,13 @@ def sample(
     a multiple of 128 for MXU tiling with zero — hence logit 0.0 — columns);
     without the mask, temperature sampling could emit ids the tokenizer has
     never heard of.
+
+    ``seeds``/``positions``: per-request reproducible sampling (the OpenAI
+    ``seed`` param).  A row with seed >= 0 draws from
+    fold_in(PRNGKey(seed), position) instead of the shared engine key, so
+    its tokens depend only on (seed, position, distribution) — identical
+    across runs, restarts, and whatever else shares its batch.  Rows at -1
+    keep the engine-RNG draw bit-for-bit.
     """
     b, v = logits.shape
     if valid_vocab is not None and valid_vocab < v:
@@ -59,4 +68,19 @@ def sample(
     masked = jnp.where(masked >= threshold[:, None], masked, NEG_INF)
 
     sampled = jax.random.categorical(key, masked, axis=-1)
+    if seeds is not None:
+        def seeded_draws(_):
+            def row_draw(seed, pos, row_logits):
+                k = jax.random.fold_in(
+                    jax.random.PRNGKey(jnp.maximum(seed, 0)), pos)
+                return jax.random.categorical(k, row_logits)
+
+            seeded = jax.vmap(row_draw)(
+                seeds, positions.astype(jnp.int32), masked)
+            return jnp.where(seeds >= 0, seeded, sampled)
+
+        # lax.cond: the common all-unseeded batch skips the B key setups
+        # and the second full-vocab draw at runtime.
+        sampled = jax.lax.cond(
+            jnp.any(seeds >= 0), seeded_draws, lambda _: sampled, None)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
