@@ -93,6 +93,8 @@ class ModelServer:
             # the candidate index keeps each choice distinct yet the whole
             # response reproducible (vLLM does the same).
             seed = int(seed) + candidate
+        presence = float(body.get("presence_penalty") or 0.0)
+        frequency = float(body.get("frequency_penalty") or 0.0)
         return Request(
             prompt_tokens=prompt_tokens,
             max_new_tokens=int(body.get("max_tokens", 64)),
@@ -101,6 +103,8 @@ class ModelServer:
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
                 seed=seed,
+                presence_penalty=presence,
+                frequency_penalty=frequency,
             ),
             adapter=adapter,
             logprobs=logprobs,
@@ -132,6 +136,10 @@ class ModelServer:
             raise ValueError("stop must be a string or a list of strings")
         if len(stops) > 4:
             raise ValueError("at most 4 stop sequences are supported")
+        for name in ("presence_penalty", "frequency_penalty"):
+            val = float(body.get(name) or 0.0)  # null == unset
+            if not -2.0 <= val <= 2.0:
+                raise ValueError(f"{name} must be in [-2, 2]")
         return n, best_of, logprobs, [s for s in stops if s]
 
     def _wait_with_stops(self, req: Request, stops: list[str],

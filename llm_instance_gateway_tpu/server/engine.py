@@ -161,6 +161,11 @@ class SamplingParams:
     # (seed, position, distribution) — batch composition, restarts, and
     # the engine RNG stop mattering.  None = engine RNG.
     seed: int | None = None
+    # OpenAI penalties over GENERATED tokens (vLLM semantics — the prompt
+    # does not count): presence subtracts a flat amount from every
+    # already-emitted token's logit, frequency subtracts per occurrence.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 def _seed_i32(seed: int | None) -> int:
@@ -487,6 +492,12 @@ class Engine:
         self._slot_topk = np.zeros((b,), np.int32)
         self._slot_topp = np.ones((b,), np.float32)
         self._slot_seed = np.full((b,), -1, np.int32)
+        self._slot_presence = np.zeros((b,), np.float32)
+        self._slot_frequency = np.zeros((b,), np.float32)
+        # Generated-token occurrence counts, device-resident (transferring
+        # [B, V] per dispatch would swamp the sync loop): rows zero at
+        # registration, the decode scan updates them in its carry.
+        self._dev_counts = None  # lazy: [B, V_padded] int32 on first use
         # Per-row token budget for device-side stop (0 = frozen row).
         self._slot_remaining = np.zeros((b,), np.int32)
         self._eos_for_device = jnp.int32(-1 if eos_id is None else eos_id)
@@ -536,8 +547,8 @@ class Engine:
                               self._prefill_attn_fn))
         self._jit_decode = jax.jit(
             functools.partial(self._decode_impl, model_cfg, step_fn),
-            donate_argnames=("cache",),
-            static_argnames=("n_steps",),
+            donate_argnames=("cache", "counts"),
+            static_argnames=("n_steps", "penalized"),
         )
         # Insert donates the cache too: without donation every admission would
         # copy the full multi-GB decode cache.
@@ -669,7 +680,8 @@ class Engine:
     def _decode_impl(
         model_cfg, step_fn, params, lora_bufs, cache, tokens, positions,
         slot_ids, temp, topk, topp, key, remaining, eos_id, seeds,
-        n_steps: int,
+        presence, frequency, counts,
+        n_steps: int, penalized: bool = False,
     ):
         """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
 
@@ -689,14 +701,23 @@ class Engine:
         else:
             max_len = cache["k"].shape[2]
 
+        c0 = tokens.shape[0]
+
         def one_step(carry, step_key):
-            cache, tokens, positions, remaining = carry
+            cache, tokens, positions, remaining, counts = carry
             active = remaining > 0
             safe_pos = jnp.minimum(positions, max_len - 1)
             logits, cache = step_fn(
                 model_cfg, params, cache, tokens, safe_pos,
                 lora_bufs=lora_bufs, slot_ids=slot_ids,
             )
+            if penalized:
+                # OpenAI penalties over generated tokens: subtract BEFORE
+                # both the greedy argmax and the draw.  ``penalized`` is a
+                # STATIC flag — penalty-free dispatches compile without the
+                # [B, V] pass (and take a [B, 1] dummy counts arg).
+                logits = logits - (presence[:, None] * (counts > 0)
+                                   + frequency[:, None] * counts)
             sampled = sample(logits, step_key, temp, topk, topp,
                              valid_vocab=model_cfg.vocab_size,
                              seeds=seeds, positions=safe_pos)
@@ -709,18 +730,22 @@ class Engine:
             remaining = jnp.where(hit_eos, 0, remaining)
             next_tokens = jnp.where(active, sampled, tokens)
             next_positions = positions + active.astype(positions.dtype)
-            return (cache, next_tokens, next_positions, remaining), (
+            if penalized:
+                counts = counts.at[jnp.arange(c0), sampled].add(
+                    valid.astype(jnp.int32))
+            return (cache, next_tokens, next_positions, remaining, counts), (
                 sampled, valid, lp, top_v, top_i)
 
         keys = jax.random.split(key, n_steps)
         carry, (toks, valid, lps, top_v, top_i) = (
-            jax.lax.scan(one_step, (cache, tokens, positions, remaining), keys)
+            jax.lax.scan(one_step,
+                         (cache, tokens, positions, remaining, counts), keys)
         )
-        cache, next_tokens, next_positions, next_remaining = carry
+        cache, next_tokens, next_positions, next_remaining, counts = carry
         # The token/position/budget carries live on device for pipelined
         # dispatch of the following block (no host round-trip needed).
         return (toks, valid, lps, top_v, top_i,
-                next_tokens, next_positions, next_remaining, cache)
+                next_tokens, next_positions, next_remaining, counts, cache)
 
     # ------------------------------------------------------------------
     # public API
@@ -764,6 +789,39 @@ class Engine:
                 req.error = req.error or "engine stopped"
                 self._finish(req, "error")
 
+    def _penalty_dispatch_args(self):
+        """(counts, penalized) for a decode dispatch: the real buffer only
+        when some active row carries a penalty (static flag -> two compiled
+        variants); otherwise a [B, 1] dummy so penalty-free serving never
+        allocates or streams the [B, V] counts."""
+        penalized = bool(self._slot_presence.any()
+                         or self._slot_frequency.any())
+        if penalized:
+            return self._counts(), True
+        return jnp.zeros((self.cfg.decode_slots, 1), jnp.int32), False
+
+    def _count_first_token(self, slot_idx: int, tok) -> None:
+        """Penalty rows count their prefill-sampled first token too (vLLM
+        generated-token semantics: penalties apply from the first decode
+        step).  ``tok`` may be a host int or a device scalar."""
+        if self._slot_presence[slot_idx] or self._slot_frequency[slot_idx]:
+            self._dev_counts = self._counts().at[slot_idx, tok].add(1)
+
+    def _counts(self):
+        """[B, V_padded] generated-token counts, created on first need
+        (penalty-free serving never pays the HBM)."""
+        if self._dev_counts is None:
+            if self.model_cfg.tie_embeddings:
+                v = self.params["embed"].shape[0]
+            else:
+                head = self.params["lm_head"]
+                if isinstance(head, dict):  # weight-only int8 leaf
+                    head = head["q"]
+                v = head.shape[-1]
+            self._dev_counts = jnp.zeros(
+                (self.cfg.decode_slots, int(v)), jnp.int32)
+        return self._dev_counts
+
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful-termination half of the pod lifecycle: stop ADMITTING
         (submit raises; the /health flip pulls the replica out of the
@@ -790,6 +848,12 @@ class Engine:
         """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
         if self._draining:
             raise RuntimeError("engine is draining (graceful termination)")
+        sp = request.sampling
+        if self._spec and (sp.presence_penalty or sp.frequency_penalty):
+            raise ValueError(
+                "presence/frequency penalties are not supported on a "
+                "speculative engine (the verify block carries no "
+                "occurrence counts); disable speculative_k or the penalty")
         if len(request.prompt_tokens) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
@@ -919,6 +983,8 @@ class Engine:
         self._slot_lora[i] = -1
         self._slot_remaining[i] = 0
         self._slot_seed[i] = -1
+        self._slot_presence[i] = 0.0
+        self._slot_frequency[i] = 0.0
         if self.paged:
             self._paged_free_row(i)
 
@@ -1323,6 +1389,7 @@ class Engine:
         slot = _Slot(request=req, lora_slot=lora_slot, position=n)
         slot.pending_first = (first_token, lp_info)
         self._register_slot(slot_idx, slot)
+        self._count_first_token(slot_idx, tok_dev)
         if self._spec:
             # _register_slot set the row's sampling params _draft_admit
             # gates on; the device extra flag resets for the new occupant.
@@ -1345,6 +1412,7 @@ class Engine:
                     request=req, lora_slot=w.lora_slot, position=w.n))
                 self._slot_tokens[slot_idx] = w.first_token_host
                 self._slot_positions[slot_idx] = w.n
+                self._count_first_token(slot_idx, w.first_token_host)
                 self._draft_admit(slot_idx, req.prompt_tokens)
         except Exception as e:
             logger.exception("decode-wait insert failed for %s", req.request_id)
@@ -2019,6 +2087,8 @@ class Engine:
                         position=ns[i]))
                     self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
                     self._slot_positions[slot_idx] = ns[i]
+                    self._count_first_token(
+                        slot_idx, int(req.output_tokens[-1]))
                     self._draft_admit(slot_idx, req.prompt_tokens)
             except Exception as e:
                 logger.exception("grouped admission failed for %s",
@@ -2178,6 +2248,7 @@ class Engine:
                                 position=n))
             self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
             self._slot_positions[slot_idx] = n
+            self._count_first_token(slot_idx, int(req.output_tokens[-1]))
         except Exception as e:
             logger.exception("stream activation failed for %s", req.request_id)
             req.error = str(e)
@@ -2193,6 +2264,12 @@ class Engine:
         self._slot_topk[slot_idx] = sp.top_k
         self._slot_topp[slot_idx] = sp.top_p
         self._slot_seed[slot_idx] = _seed_i32(sp.seed)
+        self._slot_presence[slot_idx] = sp.presence_penalty
+        self._slot_frequency[slot_idx] = sp.frequency_penalty
+        if sp.presence_penalty or sp.frequency_penalty:
+            # Materialize + zero the row; the first-token count follows via
+            # _count_first_token once the prefill's token is known.
+            self._dev_counts = self._counts().at[slot_idx].set(0)
         # Budget for device-side stop: the prefill already produced token 1.
         self._slot_remaining[slot_idx] = max(0, slot.request.max_new_tokens - 1)
 
@@ -2248,6 +2325,7 @@ class Engine:
             registered = True
             self._slot_tokens[slot_idx] = int(req.output_tokens[-1])
             self._slot_positions[slot_idx] = n
+            self._count_first_token(slot_idx, int(req.output_tokens[-1]))
             self._draft_admit(slot_idx, req.prompt_tokens)
         except Exception as e:  # engine must survive a poison request
             logger.exception("prefill failed for %s", req.request_id)
@@ -2306,8 +2384,9 @@ class Engine:
         n_steps = max(1, self.cfg.decode_steps_per_sync)
         self._paged_ensure_decode(n_steps, pipelined=False)
         t0 = time.perf_counter()
+        counts_arg, penalized = self._penalty_dispatch_args()
         (step_tokens, step_valid, step_lps, step_top_v, step_top_i,
-         _, _, _, self.cache) = self._jit_decode(
+         _, _, _, counts_out, self.cache) = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
             jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
             jnp.asarray(self._slot_lora),
@@ -2315,8 +2394,12 @@ class Engine:
             jnp.asarray(self._slot_topp), self._next_key(),
             jnp.asarray(self._slot_remaining), self._eos_for_device,
             jnp.asarray(self._slot_seed),
-            n_steps=n_steps,
+            jnp.asarray(self._slot_presence),
+            jnp.asarray(self._slot_frequency), counts_arg,
+            n_steps=n_steps, penalized=penalized,
         )
+        if penalized:
+            self._dev_counts = counts_out
         toks_np = np.asarray(step_tokens)  # [n_steps, B]
         valid_np = np.asarray(step_valid)
         lps_np = np.asarray(step_lps)
@@ -2473,8 +2556,9 @@ class Engine:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
             self._dev_remaining = self._dev_remaining.at[idxs].set(0)
             self._pending_budget_zero.clear()
+        counts_arg, penalized = self._penalty_dispatch_args()
         (toks, valid, lps, top_v, top_i, next_tokens, next_positions,
-         next_remaining, self.cache) = (
+         next_remaining, counts_out, self.cache) = (
             self._jit_decode(
                 self.params, self._lora_buffers(), self.cache,
                 self._dev_tokens, self._dev_positions,
@@ -2483,9 +2567,13 @@ class Engine:
                 jnp.asarray(self._slot_topp), self._next_key(),
                 self._dev_remaining, self._eos_for_device,
                 jnp.asarray(self._slot_seed),
-                n_steps=n_steps,
+                jnp.asarray(self._slot_presence),
+                jnp.asarray(self._slot_frequency), counts_arg,
+                n_steps=n_steps, penalized=penalized,
             )
         )
+        if penalized:
+            self._dev_counts = counts_out
         self._dev_tokens = next_tokens
         self._dev_positions = next_positions
         self._dev_remaining = next_remaining
