@@ -26,7 +26,12 @@ import time
 from aiohttp import web
 
 from llm_instance_gateway_tpu.server import metrics as metrics_mod
-from llm_instance_gateway_tpu.server.engine import Engine, Request, SamplingParams
+from llm_instance_gateway_tpu.server.engine import (
+    Engine,
+    MAX_LOGIT_BIAS,
+    Request,
+    SamplingParams,
+)
 from llm_instance_gateway_tpu.server.lora_manager import (
     AdapterBusyError,
     AdapterError,
@@ -95,6 +100,9 @@ class ModelServer:
             seed = int(seed) + candidate
         presence = float(body.get("presence_penalty") or 0.0)
         frequency = float(body.get("frequency_penalty") or 0.0)
+        raw_bias = body.get("logit_bias") or None
+        logit_bias = ({int(k): float(v) for k, v in raw_bias.items()}
+                      if raw_bias else None)
         return Request(
             prompt_tokens=prompt_tokens,
             max_new_tokens=int(body.get("max_tokens", 64)),
@@ -105,6 +113,7 @@ class ModelServer:
                 seed=seed,
                 presence_penalty=presence,
                 frequency_penalty=frequency,
+                logit_bias=logit_bias,
             ),
             adapter=adapter,
             logprobs=logprobs,
@@ -140,6 +149,18 @@ class ModelServer:
             val = float(body.get(name) or 0.0)  # null == unset
             if not -2.0 <= val <= 2.0:
                 raise ValueError(f"{name} must be in [-2, 2]")
+        bias = body.get("logit_bias")
+        if bias is not None:
+            if not isinstance(bias, dict):
+                raise ValueError("logit_bias must be an object")
+            if len(bias) > MAX_LOGIT_BIAS:
+                raise ValueError("logit_bias supports at most "
+                                 f"{MAX_LOGIT_BIAS} entries")
+            for k, v in bias.items():
+                if not -100.0 <= float(v) <= 100.0:
+                    raise ValueError("logit_bias values must be in "
+                                     "[-100, 100]")
+                int(k)  # token ids must be integral (ValueError otherwise)
         return n, best_of, logprobs, [s for s in stops if s]
 
     def _wait_with_stops(self, req: Request, stops: list[str],

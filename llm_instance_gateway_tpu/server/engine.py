@@ -51,6 +51,7 @@ logger = logging.getLogger(__name__)
 # and a [B, K] top_k is noise next to the layer matmuls; the host stores
 # values only for requests that asked.
 LOGPROB_TOPK = 5
+MAX_LOGIT_BIAS = 32  # per-request logit_bias entries (static lanes)
 
 
 def _logprob_info(logits, sampled, valid_vocab: int):
@@ -166,6 +167,22 @@ class SamplingParams:
     # already-emitted token's logit, frequency subtracts per occurrence.
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # OpenAI logit_bias: {token_id: bias} added to the logits before every
+    # pick (greedy included).  At most MAX_LOGIT_BIAS entries (static
+    # device lanes).
+    logit_bias: dict[int, float] | None = None
+
+
+def _bias_arrays(sp: "SamplingParams"):
+    """(ids [MAX_LOGIT_BIAS] int32, vals f32) device lanes for a request's
+    logit_bias dict (-1 = unused entry)."""
+    ids = np.full((MAX_LOGIT_BIAS,), -1, np.int32)
+    vals = np.zeros((MAX_LOGIT_BIAS,), np.float32)
+    if sp.logit_bias:
+        for j, (tid, bv) in enumerate(sorted(sp.logit_bias.items())):
+            ids[j] = tid
+            vals[j] = bv
+    return ids, vals
 
 
 def _seed_i32(seed: int | None) -> int:
@@ -494,6 +511,8 @@ class Engine:
         self._slot_seed = np.full((b,), -1, np.int32)
         self._slot_presence = np.zeros((b,), np.float32)
         self._slot_frequency = np.zeros((b,), np.float32)
+        self._slot_bias_ids = np.full((b, MAX_LOGIT_BIAS), -1, np.int32)
+        self._slot_bias_vals = np.zeros((b, MAX_LOGIT_BIAS), np.float32)
         # Generated-token occurrence counts, device-resident (transferring
         # [B, V] per dispatch would swamp the sync loop): rows zero at
         # registration, the decode scan updates them in its carry.
@@ -567,13 +586,15 @@ class Engine:
             ),
             donate_argnames=("cache",),
         )
-        def _sample_one(logits, key, t, k, p, seed, pos):
+        def _sample_one(logits, key, t, k, p, seed, pos, bias_ids,
+                        bias_vals):
             tok = sample(
                 logits[None], key, jnp.full((1,), t, jnp.float32),
                 jnp.full((1,), k, jnp.int32), jnp.full((1,), p, jnp.float32),
                 valid_vocab=model_cfg.vocab_size,
                 seeds=jnp.full((1,), seed, jnp.int32),
                 positions=jnp.full((1,), pos, jnp.int32),
+                bias_ids=bias_ids[None], bias_vals=bias_vals[None],
             )
             lp, top_v, top_i = _logprob_info(
                 logits[None], tok, model_cfg.vocab_size)
@@ -635,7 +656,7 @@ class Engine:
     @staticmethod
     def _prefill_impl(
         model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_len,
-        lora_slot, temp, topk, topp, key, seed,
+        lora_slot, temp, topk, topp, key, seed, bias_ids, bias_vals,
     ):
         """Prefill one padded prompt; sample the first new token."""
         slot_ids = jnp.full((1,), lora_slot, jnp.int32)
@@ -652,6 +673,7 @@ class Engine:
             valid_vocab=model_cfg.vocab_size,
             seeds=jnp.full((1,), seed, jnp.int32),
             positions=jnp.full((1,), true_len - 1, jnp.int32),
+            bias_ids=bias_ids[None], bias_vals=bias_vals[None],
         )
         lp, top_v, top_i = _logprob_info(last, first_token, model_cfg.vocab_size)
         return first_token[0], k, v, (lp[0], top_v[0], top_i[0])
@@ -659,7 +681,7 @@ class Engine:
     @staticmethod
     def _prefill_many_impl(
         model_cfg, attn_fn, params, lora_bufs, tokens, positions, true_lens,
-        lora_slots, temps, topks, topps, key, seeds,
+        lora_slots, temps, topks, topps, key, seeds, bias_ids, bias_vals,
     ):
         """Prefill P padded same-bucket prompts as one program; sample each
         row's first token (the [P, bucket] generalization of
@@ -672,7 +694,8 @@ class Engine:
             logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [P, V]
         first_tokens = sample(
             last, key, temps, topks, topps, valid_vocab=model_cfg.vocab_size,
-            seeds=seeds, positions=true_lens - 1)
+            seeds=seeds, positions=true_lens - 1,
+            bias_ids=bias_ids, bias_vals=bias_vals)
         lp, top_v, top_i = _logprob_info(last, first_tokens, model_cfg.vocab_size)
         return first_tokens, k, v, (lp, top_v, top_i)
 
@@ -680,7 +703,7 @@ class Engine:
     def _decode_impl(
         model_cfg, step_fn, params, lora_bufs, cache, tokens, positions,
         slot_ids, temp, topk, topp, key, remaining, eos_id, seeds,
-        presence, frequency, counts,
+        presence, frequency, counts, bias_ids, bias_vals,
         n_steps: int, penalized: bool = False,
     ):
         """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
@@ -720,7 +743,8 @@ class Engine:
                                    + frequency[:, None] * counts)
             sampled = sample(logits, step_key, temp, topk, topp,
                              valid_vocab=model_cfg.vocab_size,
-                             seeds=seeds, positions=safe_pos)
+                             seeds=seeds, positions=safe_pos,
+                             bias_ids=bias_ids, bias_vals=bias_vals)
             lp, top_v, top_i = _logprob_info(
                 logits, sampled, model_cfg.vocab_size)
             valid = active
@@ -849,11 +873,24 @@ class Engine:
         if self._draining:
             raise RuntimeError("engine is draining (graceful termination)")
         sp = request.sampling
-        if self._spec and (sp.presence_penalty or sp.frequency_penalty):
+        if self._spec and (sp.presence_penalty or sp.frequency_penalty
+                           or sp.logit_bias):
             raise ValueError(
-                "presence/frequency penalties are not supported on a "
-                "speculative engine (the verify block carries no "
-                "occurrence counts); disable speculative_k or the penalty")
+                "presence/frequency penalties and logit_bias are not "
+                "supported on a speculative engine (the verify block's "
+                "greedy pick bypasses the sampling seam); disable "
+                "speculative_k or the parameter")
+        if sp.logit_bias:
+            if len(sp.logit_bias) > MAX_LOGIT_BIAS:
+                raise ValueError(
+                    f"logit_bias supports at most {MAX_LOGIT_BIAS} entries")
+            for tid in sp.logit_bias:
+                if not 0 <= tid < self.model_cfg.vocab_size:
+                    # Out-of-vocab ids would clip onto token V-1 in the
+                    # device scatter and mis-bias a real token.
+                    raise ValueError(
+                        f"logit_bias token id {tid} is outside the "
+                        f"vocabulary [0, {self.model_cfg.vocab_size})")
         if len(request.prompt_tokens) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
@@ -985,6 +1022,8 @@ class Engine:
         self._slot_seed[i] = -1
         self._slot_presence[i] = 0.0
         self._slot_frequency[i] = 0.0
+        self._slot_bias_ids[i] = -1
+        self._slot_bias_vals[i] = 0.0
         if self.paged:
             self._paged_free_row(i)
 
@@ -1802,7 +1841,7 @@ class Engine:
                 last_logits, self._next_key(), jnp.float32(sp.temperature),
                 jnp.int32(sp.top_k), jnp.float32(sp.top_p),
                 jnp.int32(_seed_i32(sp.seed)),
-                jnp.int32(n - 1))
+                jnp.int32(n - 1), *map(jnp.asarray, _bias_arrays(sp)))
         except BaseException:
             # Defensive: _paged_can_admit gated this admission (matched
             # blocks excluded from avail when pinned out of the evictable
@@ -1849,7 +1888,7 @@ class Engine:
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p),
             jnp.int32(_seed_i32(sp.seed)),
-            jnp.int32(n - 1),
+            jnp.int32(n - 1), *map(jnp.asarray, _bias_arrays(sp)),
         )
         return first_token, k, v, lp_info
 
@@ -1869,6 +1908,7 @@ class Engine:
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p), self._next_key(),
             jnp.int32(_seed_i32(sp.seed)),
+            *map(jnp.asarray, _bias_arrays(sp)),
         )
 
     def _bucket_prefill_many(self, reqs, ns, lora_slots):
@@ -1892,6 +1932,8 @@ class Engine:
             self._next_key(),
             jnp.asarray([_seed_i32(sp.seed) for sp in sps],
                         jnp.int32),
+            *(jnp.asarray(np.stack(arrs))
+              for arrs in zip(*(_bias_arrays(sp) for sp in sps))),
         )
 
     def _collect_followers(self, first_req, limit: int) -> list:
@@ -2229,7 +2271,7 @@ class Engine:
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p),
                 jnp.int32(_seed_i32(sp.seed)),
-                jnp.int32(n - 1),
+                jnp.int32(n - 1), *map(jnp.asarray, _bias_arrays(sp)),
             )
             if pipelined:
                 try:
@@ -2266,6 +2308,8 @@ class Engine:
         self._slot_seed[slot_idx] = _seed_i32(sp.seed)
         self._slot_presence[slot_idx] = sp.presence_penalty
         self._slot_frequency[slot_idx] = sp.frequency_penalty
+        (self._slot_bias_ids[slot_idx],
+         self._slot_bias_vals[slot_idx]) = _bias_arrays(sp)
         if sp.presence_penalty or sp.frequency_penalty:
             # Materialize + zero the row; the first-token count follows via
             # _count_first_token once the prefill's token is known.
@@ -2396,6 +2440,8 @@ class Engine:
             jnp.asarray(self._slot_seed),
             jnp.asarray(self._slot_presence),
             jnp.asarray(self._slot_frequency), counts_arg,
+            jnp.asarray(self._slot_bias_ids),
+            jnp.asarray(self._slot_bias_vals),
             n_steps=n_steps, penalized=penalized,
         )
         if penalized:
@@ -2569,6 +2615,8 @@ class Engine:
                 jnp.asarray(self._slot_seed),
                 jnp.asarray(self._slot_presence),
                 jnp.asarray(self._slot_frequency), counts_arg,
+                jnp.asarray(self._slot_bias_ids),
+                jnp.asarray(self._slot_bias_vals),
                 n_steps=n_steps, penalized=penalized,
             )
         )

@@ -23,6 +23,8 @@ def sample(
     valid_vocab: int | None = None,  # static: ids >= this are MXU padding
     seeds: jax.Array | None = None,      # [B] int32; -1 = engine RNG
     positions: jax.Array | None = None,  # [B] int32 — current input position
+    bias_ids: jax.Array | None = None,   # [B, K] int32; -1 = unused entry
+    bias_vals: jax.Array | None = None,  # [B, K] f32 — OpenAI logit_bias
 ) -> jax.Array:
     """Returns sampled token ids [B].
 
@@ -42,6 +44,12 @@ def sample(
     if valid_vocab is not None and valid_vocab < v:
         pad_mask = jnp.arange(v) < valid_vocab
         logits = jnp.where(pad_mask[None, :], logits, NEG_INF)
+    if bias_ids is not None:
+        # OpenAI logit_bias: applied before EVERYTHING (greedy argmax
+        # included).  Pad entries (-1) scatter zero onto a clipped index.
+        rows = jnp.arange(b)[:, None]
+        logits = logits.at[rows, jnp.clip(bias_ids, 0, v - 1)].add(
+            jnp.where(bias_ids >= 0, bias_vals, 0.0))
     greedy = jnp.argmax(logits, axis=-1)
 
     # Temperature scaling (guard zero; greedy rows are selected at the end).

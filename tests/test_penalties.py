@@ -129,3 +129,44 @@ class TestPenalties:
             spec.submit(Request(
                 prompt_tokens=[5, 6], max_new_tokens=4,
                 sampling=SamplingParams(presence_penalty=1.0)))
+
+
+class TestLogitBias:
+    def test_forced_and_banned_tokens(self, params):
+        """A +100 bias forces a token at every pick (greedy included, first
+        token included); banning the natural greedy choice changes the
+        walk."""
+        engine = _engine(params)
+        engine.start()
+        try:
+            forced = Request(prompt_tokens=[5, 6, 7], max_new_tokens=6,
+                             sampling=SamplingParams(
+                                 temperature=0.0, logit_bias={99: 100.0}))
+            engine.generate(forced, timeout_s=120)
+            assert forced.error is None
+            assert forced.output_tokens == [99] * 6
+
+            plain = Request(prompt_tokens=[5, 6, 7], max_new_tokens=6,
+                            sampling=SamplingParams(temperature=0.0))
+            engine.generate(plain, timeout_s=120)
+            banned_id = plain.output_tokens[0]
+            banned = Request(prompt_tokens=[5, 6, 7], max_new_tokens=6,
+                             sampling=SamplingParams(
+                                 temperature=0.0,
+                                 logit_bias={banned_id: -100.0}))
+            engine.generate(banned, timeout_s=120)
+            assert banned.error is None
+            assert banned.output_tokens[0] != banned_id
+        finally:
+            engine.stop()
+
+    def test_bias_cap_rejected_at_submit(self, params):
+        from llm_instance_gateway_tpu.server.engine import MAX_LOGIT_BIAS
+
+        engine = _engine(params)
+        with pytest.raises(ValueError, match="at most"):
+            engine.submit(Request(
+                prompt_tokens=[5], max_new_tokens=2,
+                sampling=SamplingParams(
+                    logit_bias={i: 1.0
+                                for i in range(MAX_LOGIT_BIAS + 1)})))
