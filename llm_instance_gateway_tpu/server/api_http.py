@@ -293,7 +293,8 @@ class ModelServer:
     async def _stream_sse(self, http_request: web.Request, req, model: str,
                           object_name: str, make_delta,
                           timeout_s: float = 600.0,
-                          stops: list[str] | None = None):
+                          stops: list[str] | None = None,
+                          echo_prefix: str | None = None):
         """Server-sent-events generation stream (OpenAI stream=true shape).
 
         Tokens appear in ``req.output_tokens`` as the engine decodes (in
@@ -333,6 +334,15 @@ class ModelServer:
             async def emit(payload: dict) -> None:
                 await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
+            if echo_prefix:
+                # OpenAI echo under streaming: the prompt text leads the
+                # stream as its own chunk.
+                await emit({
+                    "id": f"cmpl-{req.request_id}",
+                    "object": object_name,
+                    "model": model,
+                    "choices": [make_delta(echo_prefix, None)],
+                })
             if stops:
                 return await self._stream_sse_loop_stops(
                     req, model, object_name, make_delta, resp, loop,
@@ -481,6 +491,21 @@ class ModelServer:
         except (ValueError, TypeError) as e:
             return _err(400, str(e))
         prompt_tokens = self._encode_prompt(body)
+        echo = bool(body.get("echo"))
+
+        def echo_text() -> str:
+            # The client's own string round-trips exactly; only
+            # pre-tokenized (list-of-int) prompts need a decode, where
+            # tokenizer normalization is inherent to the request form.
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, str):
+                return prompt
+            return self.tokenizer.decode(prompt_tokens)
+
+        if echo and logprobs is not None:
+            # OpenAI echo+logprobs returns PROMPT logprobs, which the
+            # engine does not record; reject rather than mislabel.
+            return _err(400, "echo is not supported together with logprobs")
         if body.get("stream"):
             if n > 1 or best_of > 1:
                 return _err(400, "streaming supports n=1 / best_of=1")
@@ -489,11 +514,12 @@ class ModelServer:
                 # carry no logprobs object.
                 return _err(400, "logprobs is not supported with streaming")
             req = self._make_request(body, prompt_tokens, adapter)
+            prefix = echo_text() if echo else None
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
                 "text_completion",
                 lambda delta, fin: {"index": 0, "text": delta, "finish_reason": fin},
-                stops=stops,
+                stops=stops, echo_prefix=prefix,
             )
         # best_of candidates decode concurrently (the engine batches them);
         # ranking needs per-token logprobs, so candidates record at least the
@@ -531,11 +557,12 @@ class ModelServer:
 
             reqs.sort(key=mean_lp, reverse=True)
             reqs = reqs[:n]
+        echo_prefix = echo_text() if echo else ""
         choices = []
         for i, r in enumerate(reqs):
             choice = {
                 "index": i,
-                "text": texts[id(r)],
+                "text": echo_prefix + texts[id(r)],
                 "finish_reason": r.finish_reason,
             }
             if logprobs is not None:

@@ -222,3 +222,22 @@ class TestStopStrings:
             "stop": ["a", "b", "c", "d", "e"],
         })
         assert status == 400
+
+
+class TestEcho:
+    def test_echo_prefixes_prompt_text(self, model_server):
+        status, body = post(model_server, "/v1/completions", {
+            "model": "llama3-tiny", "prompt": "abc",
+            "max_tokens": 4, "temperature": 0, "echo": True})
+        assert status == 200
+        _, plain = post(model_server, "/v1/completions", {
+            "model": "llama3-tiny", "prompt": "abc",
+            "max_tokens": 4, "temperature": 0})
+        assert body["choices"][0]["text"] == (
+            "abc" + plain["choices"][0]["text"])
+
+    def test_echo_with_logprobs_rejected(self, model_server):
+        status, _ = post(model_server, "/v1/completions", {
+            "model": "llama3-tiny", "prompt": "a", "max_tokens": 2,
+            "echo": True, "logprobs": 2})
+        assert status == 400
