@@ -53,12 +53,11 @@ def test_quantized_cache_layout():
 
 
 def make_engine(params, quant, **extra):
-    return Engine(
-        CFG, params,
-        EngineConfig(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
-                     kv_cache_quant="int8" if quant else None, **extra),
-        eos_id=None, dtype=jnp.float32,
-    )
+    cfg = dict(decode_slots=3, max_seq_len=96, prefill_buckets=(8, 16),
+               kv_cache_quant="int8" if quant else None)
+    cfg.update(extra)
+    return Engine(CFG, params, EngineConfig(**cfg),
+                  eos_id=None, dtype=jnp.float32)
 
 
 def gen_all(engine, prompts, max_new=10):
@@ -206,3 +205,33 @@ class TestQuantPallasKernel:
             q, kq, vq, ks, vs, lengths, block_s=128, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestQuantComposition:
+    def test_grouped_admission_on_quantized_lanes(self, params):
+        """A same-bucket burst admits through the grouped prefill program
+        into int8 lanes; tokens match per-request admission exactly."""
+        prompts = [[5, 6, 7], [8, 9, 10], [11, 12]]
+
+        def run(batch):
+            return gen_all(
+                make_engine(params, quant=True, decode_slots=4,
+                            prefill_batch=batch),
+                prompts, max_new=8)
+
+        assert run(3) == run(1)
+
+    def test_decode_wait_parks_through_quantized_insert(self, params):
+        """Prefill-ahead parking + drain insert into int8 lanes (the parked
+        KV is bf16 off-cache; quantization happens at insert).  3 requests
+        on 1 slot: two park in decode_wait; results match solo runs."""
+        prompts = [[5, 6, 7], [8, 9], [3, 4, 5]]
+        want = [gen_all(make_engine(params, quant=True, decode_slots=1,
+                                    prefill_buckets=(8,)),
+                        [p], max_new=6)[0]
+                for p in prompts]
+        got = gen_all(
+            make_engine(params, quant=True, decode_slots=1,
+                        prefill_buckets=(8,), decode_wait_cap=2),
+            prompts, max_new=6)
+        assert got == want
