@@ -478,14 +478,13 @@ class TestGracefulDrain:
     """Pod-lifecycle drain (SIGTERM half): admitting stops, in-flight work
     finishes, and the readiness signal flips so the EPP routes away."""
 
-    def _engine(self):
+    def _engine(self, **overrides):
         params = transformer.init_params(CFG, jax.random.PRNGKey(0),
                                          dtype=jnp.float32)
-        return Engine(
-            CFG, params,
-            EngineConfig(decode_slots=2, max_seq_len=64,
-                         prefill_buckets=(8, 16)),
-            lora_manager=None, eos_id=None, dtype=jnp.float32)
+        cfg = dict(decode_slots=2, max_seq_len=64, prefill_buckets=(8, 16))
+        cfg.update(overrides)
+        return Engine(CFG, params, EngineConfig(**cfg),
+                      lora_manager=None, eos_id=None, dtype=jnp.float32)
 
     def test_drain_finishes_inflight_and_refuses_new(self):
         engine = self._engine()
@@ -517,6 +516,29 @@ class TestGracefulDrain:
             engine.submit(r)
             assert engine.drain(timeout_s=0.01) is False  # too short
             assert r.done.wait(120)  # loop still finishes the request
+        finally:
+            engine.stop()
+
+    def test_drain_on_paged_pipelined_engine(self):
+        """Drain under the production shape (paged + pipelined + grouped):
+        everything in flight — including decode_wait parkers — finishes."""
+        engine = self._engine(paged_kv_block=8, pipeline_decode=True,
+                              decode_steps_per_sync=4, prefill_batch=2,
+                              decode_wait_cap=2)
+        engine.start()
+        try:
+            reqs = [Request(prompt_tokens=[3 + i, 9, 4], max_new_tokens=10,
+                            sampling=SamplingParams(temperature=0.0))
+                    for i in range(4)]  # 4 reqs > 2 slots: parking happens
+            for r in reqs:
+                engine.submit(r)
+            assert engine.drain(timeout_s=180) is True
+            for r in reqs:
+                assert r.done.is_set() and r.error is None, r.error
+                assert len(r.output_tokens) == 10
+            snap = engine.metrics_snapshot()
+            assert snap["num_requests_running"] == 0
+            assert snap["num_requests_waiting"] == 0
         finally:
             engine.stop()
 
