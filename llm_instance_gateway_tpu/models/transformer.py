@@ -160,11 +160,18 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x):
     t = 1
     for dim in x.shape[:-1]:
         t *= dim
-    if _moe_capacity(cfg, t) >= t:
+    cap = _moe_capacity(cfg, t)
+    if cap >= t or (cfg.moe_exact_fallback and cap < 8):
         # Dense all-experts costs t*E expert-rows; grouped costs E*cap.
         # cap >= t means no FLOP win — and at these token counts decode is
         # weight-bound anyway (each expert's weights stream from HBM once
         # either way), so the dispatch bookkeeping would be pure overhead.
+        # Exact mode additionally floors at cap >= 8: a 1-4 row tile is
+        # discreteness-dominated (routine routing collisions overflow it —
+        # the 2x headroom's overflow-rarity argument needs a few rows of
+        # mean load), and every exact-mode overflow pays grouped PLUS
+        # dense, costlier than just staying dense.  Dropping mode keeps
+        # grouped at any tile (overflow drops, the standard serving trade).
         return _moe_dense(cfg, lp, x)
     return _moe_grouped(cfg, lp, x)
 

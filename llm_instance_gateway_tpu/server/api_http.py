@@ -28,6 +28,7 @@ from aiohttp import web
 from llm_instance_gateway_tpu.server import metrics as metrics_mod
 from llm_instance_gateway_tpu.server.engine import (
     Engine,
+    EngineDraining,
     MAX_LOGIT_BIAS,
     Request,
     SamplingParams,
@@ -307,8 +308,8 @@ class ModelServer:
         """
         try:
             self.engine.submit(req)
-        except RuntimeError as e:
-            return _err(503, str(e))  # draining: replica is leaving the set
+        except EngineDraining as e:
+            return _err(503, str(e))  # replica is leaving the routable set
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
@@ -533,8 +534,8 @@ class ModelServer:
         ]
         try:
             reqs = await self._run_many(reqs, stops)
-        except RuntimeError as e:
-            return _err(503, str(e))  # draining
+        except EngineDraining as e:
+            return _err(503, str(e))  # replica is leaving the routable set
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:
@@ -619,8 +620,8 @@ class ModelServer:
                 for i in range(n)]
         try:
             reqs = await self._run_many(reqs, stops)
-        except RuntimeError as e:
-            return _err(503, str(e))  # draining
+        except EngineDraining as e:
+            return _err(503, str(e))  # replica is leaving the routable set
         except ValueError as e:
             return _err(400, str(e))
         except queue_mod.Full:

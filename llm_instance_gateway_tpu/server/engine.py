@@ -54,6 +54,16 @@ LOGPROB_TOPK = 5
 MAX_LOGIT_BIAS = 32  # per-request logit_bias entries (static lanes)
 
 
+class EngineDraining(RuntimeError):
+    """submit() refused because the engine is in graceful termination.
+
+    Distinct type so the HTTP layer maps exactly this condition to a 503
+    the gateway retries elsewhere; any other RuntimeError stays a 500
+    (reference parity: only a draining replica reports itself unroutable;
+    pkg/ext-proc/handlers/server.go's ResourceExhausted mapping is the
+    analogous single-condition translation)."""
+
+
 def _logprob_info(logits, sampled, valid_vocab: int):
     """(sampled-token logprob, top-K logprobs, top-K ids) from raw logits.
 
@@ -787,6 +797,16 @@ class Engine:
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # The loop thread is wedged (documented multi-hour failure
+                # mode: a device call through the relay never returns).  It
+                # still owns _pending/decode_wait/slots — sweeping them here
+                # would race a live mutator and risk double-finish.  Leave
+                # the state to the wedged thread; handlers hit their own
+                # timeouts.
+                logger.error("engine loop thread still alive after 10s join;"
+                             " skipping straggler sweep (wedged device call?)")
+                return
         # Anything still queued/parked/active when the loop exits would
         # leave its done Event unset forever (handlers block until their
         # own timeout).  Fail stragglers explicitly — after a drain this
@@ -871,7 +891,7 @@ class Engine:
     def submit(self, request: Request) -> Request:
         """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
         if self._draining:
-            raise RuntimeError("engine is draining (graceful termination)")
+            raise EngineDraining("engine is draining (graceful termination)")
         sp = request.sampling
         if self._spec and (sp.presence_penalty or sp.frequency_penalty
                            or sp.logit_bias):

@@ -236,12 +236,16 @@ def parse_text_native(text: str) -> dict[str, list[Sample]]:
     families: dict[str, list[Sample]] = {}
     names: dict[bytes, str] = {}  # series names repeat heavily
     for name_off, name_len, labels_off, labels_len, value, ts in rows:
+        # surrogatepass both ways: the body was ENCODED with surrogatepass,
+        # so decoding the same bytes with it round-trips the original text
+        # exactly — 'replace' here would diverge from parse_text on input
+        # containing lone surrogates (an undocumented parity gap otherwise).
         nb = data[name_off:name_off + name_len]
         name = names.get(nb)
         if name is None:
-            name = names.setdefault(nb, nb.decode("utf-8", "replace"))
+            name = names.setdefault(nb, nb.decode("utf-8", "surrogatepass"))
         raw = (data[labels_off:labels_off + labels_len].decode(
-                   "utf-8", "replace") if labels_len > 0 else None)
+                   "utf-8", "surrogatepass") if labels_len > 0 else None)
         families.setdefault(name, []).append(Sample(
             name=name, labels=None if raw else {}, raw_labels=raw,
             value=value, timestamp_ms=None if ts == _TS_NONE else ts))

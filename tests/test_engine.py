@@ -501,7 +501,10 @@ class TestGracefulDrain:
             for r in inflight:  # everything admitted before drain finished
                 assert r.done.is_set() and r.error is None
                 assert len(r.output_tokens) == 12
-            with pytest.raises(RuntimeError, match="draining"):
+            # The refusal is the DEDICATED type (the HTTP layer maps exactly
+            # it to 503; a generic RuntimeError must surface as a 500).
+            from llm_instance_gateway_tpu.server.engine import EngineDraining
+            with pytest.raises(EngineDraining, match="draining"):
                 engine.submit(Request(prompt_tokens=[5], max_new_tokens=2,
                                       sampling=SamplingParams()))
         finally:

@@ -168,3 +168,26 @@ class TestDecodeFlops:
         x16 = jax.random.normal(jax.random.PRNGKey(0), (16, CFG.d_model))
         monkeypatch.setattr(transformer, "_moe_dense", boom)
         transformer._moe_mlp(cfg, lp, x16)  # grouped: must not touch dense
+
+    def test_exact_mode_tiny_tiles_stay_dense(self, params, monkeypatch):
+        """ADVICE r4: exact mode floors grouped at cap >= 8 — a 1-4 row
+        capacity tile overflows on routine routing collisions and every
+        exact-mode overflow pays grouped PLUS dense, costlier than dense
+        alone.  t=2 (cap=1) and t=8 (cap=4) must stay dense; dropping mode
+        keeps grouped at the same sizes (overflow drops instead)."""
+        lp = moe_layer_params(params)
+        exact = CFG  # moe_exact_fallback defaults True
+        assert transformer._moe_capacity(exact, 8) == 4  # < 8-row floor
+
+        def boom(*a, **k):
+            raise AssertionError("wrong MoE path taken")
+
+        monkeypatch.setattr(transformer, "_moe_grouped", boom)
+        for t in (2, 8):
+            x = jax.random.normal(jax.random.PRNGKey(t), (t, CFG.d_model))
+            transformer._moe_mlp(exact, lp, x)  # dense: never grouped
+        monkeypatch.undo()
+        drop = dataclasses.replace(CFG, moe_exact_fallback=False)
+        monkeypatch.setattr(transformer, "_moe_dense", boom)
+        x16 = jax.random.normal(jax.random.PRNGKey(1), (16, CFG.d_model))
+        transformer._moe_mlp(drop, lp, x16)  # dropping t=16: still grouped

@@ -91,6 +91,15 @@ class TestEdgeCases:
         # float('0x1F') raises in Python; strtod would have accepted it.
         assert_parity("m 0x1F\nn 0x10 7\no 1 0x10\n")
 
+    def test_lone_surrogates_round_trip(self):
+        # The body encodes with surrogatepass, so names/labels must DECODE
+        # with surrogatepass too: input containing lone surrogates (possible
+        # from a buggy exporter surfaced via errors='surrogateescape' reads)
+        # round-trips identically through both parsers.
+        assert_parity('m\ud800{k="\udfff v"} 1\nn\ud800e 2\n')
+        assert_parity('ok{a="\ud83d"} 3\nok2{b="\ude00"} 4\n'
+                      'ok3{c="😀"} 5\n')  # unpaired halves, then a real pair
+
 
 def test_fuzz_parity():
     rng = random.Random(42)

@@ -11,9 +11,16 @@ with this message sequence per HTTP request:
     3. ProcessingRequest{response_headers}  (upstream's response headers)
     4. ProcessingRequest{response_body}     (whole body, end_of_stream=true)
 
-This tool serializes that exact sequence — realistic Envoy header sets
+This tool serializes that sequence — realistic Envoy header sets
 (pseudo-headers, x-request-id, x-forwarded-proto, content-length) included —
-into length-prefixed binary transcripts under ``tests/golden/``.  The
+into length-prefixed binary transcripts under ``tests/golden/``.
+
+PROVENANCE CAVEAT: the transcripts are SYNTHESIZED from the ext_proc spec
+and this repo's own vendored pb2 modules.  They encode the author's belief
+about Envoy's phase sequence; no real Envoy has produced or validated
+these bytes.  They pin byte stability against regression — they do not
+certify Envoy conformance.  The first time a real Envoy is available,
+regenerate them from a packet capture of the live stream.  The
 replay suite (``tests/test_envoy_golden_replay.py``) streams the COMMITTED
 BYTES through a real gRPC channel to the real EPP, so any drift in the
 vendored proto subset or the server's phase handling breaks loudly against
