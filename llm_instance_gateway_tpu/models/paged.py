@@ -35,7 +35,12 @@ import jax.numpy as jnp
 
 from llm_instance_gateway_tpu.models import lora as lora_lib
 from llm_instance_gateway_tpu.models.configs import ModelConfig
-from llm_instance_gateway_tpu.models.transformer import _mlp, _project
+from llm_instance_gateway_tpu.models.transformer import (
+    _kv_dequantize,
+    _kv_quantize,
+    _mlp,
+    _project,
+)
 from llm_instance_gateway_tpu.ops.attention import decode_attention
 from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm
 from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
@@ -52,24 +57,70 @@ def init_paged_cache(
     n_blocks: int,
     block: int,
     dtype=jnp.bfloat16,
+    quantized: bool = False,
 ) -> Params:
-    """Block pool + tables.  ``n_blocks`` EXCLUDES the trash block."""
+    """Block pool + tables.  ``n_blocks`` EXCLUDES the trash block.
+
+    ``quantized`` stores the pools int8 with per-(position, kv-head) f32
+    scale pools (vLLM's quantized-paged-KV composition: the HBM halving
+    and the admission-by-actual-usage win stack).  Scales index by the
+    same physical block as the data, so prefix-cache block reuse — a table
+    repoint, never a copy — carries them for free."""
     hd = cfg.resolved_head_dim
     max_blocks_per_seq = -(-max_len // block)
     shape = (cfg.n_layers, n_blocks + 1, block, cfg.n_kv_heads, hd)
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if quantized else dtype),
+        "v": jnp.zeros(shape, jnp.int8 if quantized else dtype),
         "tables": jnp.full((batch, max_blocks_per_seq), TRASH_BLOCK, jnp.int32),
         "length": jnp.zeros((batch,), jnp.int32),
     }
+    if quantized:
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
 
 def _gather_rows(pool: jax.Array, tables: jax.Array) -> jax.Array:
-    """[n_blocks+1, P, Kh, hd] x [B, M] -> contiguous [B, M*P, Kh, hd]."""
+    """[n_blocks+1, P, Kh, hd] x [B, M] -> contiguous [B, M*P, Kh, hd].
+    Rank-generic: scale pools [n_blocks+1, P, Kh] gather the same way."""
     g = pool[tables]  # [B, M, P, Kh, hd]
     b, m, p = g.shape[0], g.shape[1], g.shape[2]
     return g.reshape(b, m * p, *g.shape[3:])
+
+
+def _pool_update(pools: tuple, k: jax.Array, v: jax.Array,
+                 phys_block: jax.Array, offset: jax.Array) -> tuple:
+    """Scatter freshly-computed bf16 K/V into the layer's pool tuple at
+    (phys_block, offset) — the ONE write seam every paged step shares.
+    A 4-tuple (k, v, k_scale, v_scale) is a quantized pool: values are
+    int8-quantized per (position, kv-head) on the way in."""
+    if len(pools) == 4:
+        k_pool, v_pool, ks_pool, vs_pool = pools
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        return (k_pool.at[phys_block, offset].set(kq),
+                v_pool.at[phys_block, offset].set(vq),
+                ks_pool.at[phys_block, offset].set(ks),
+                vs_pool.at[phys_block, offset].set(vs))
+    k_pool, v_pool = pools
+    return (k_pool.at[phys_block, offset].set(k),
+            v_pool.at[phys_block, offset].set(v))
+
+
+def _pool_rows(pools: tuple, tables: jax.Array, dtype=None) -> tuple:
+    """Gather each table row's blocks into the contiguous lane view — the
+    ONE read seam.  Quantized pools return (k, v) dequantized when ``dtype``
+    is given (XLA fuses the multiply into the attention reads, so HBM still
+    streams int8), or raw (k, v, k_scale, v_scale) for the int8-aware
+    kernel when it is None."""
+    if len(pools) == 4:
+        rows = tuple(_gather_rows(p, tables) for p in pools)
+        if dtype is None:
+            return rows
+        return (_kv_dequantize(rows[0], rows[2], dtype),
+                _kv_dequantize(rows[1], rows[3], dtype))
+    return _gather_rows(pools[0], tables), _gather_rows(pools[1], tables)
 
 
 def decode_step_paged(
@@ -107,9 +158,10 @@ def decode_step_paged(
     # table entry is unallocated write the trash block.
     phys_block = tables[batch_idx, positions // block]  # [B]
     offset = positions % block
+    quant = "k_scale" in cache
 
     def layer_fn(h, xs):
-        lp, ll, k_pool, v_pool = xs
+        lp, ll, *pools = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         hd = cfg.resolved_head_dim
@@ -118,29 +170,42 @@ def decode_step_paged(
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
         q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
-        k_pool = k_pool.at[phys_block, offset].set(k)
-        v_pool = v_pool.at[phys_block, offset].set(v)
-        k_rows = _gather_rows(k_pool, tables)
-        v_rows = _gather_rows(v_pool, tables)
-        if cfg.use_pallas_decode:
+        pools = _pool_update(tuple(pools), k, v, phys_block, offset)
+        if quant and cfg.use_pallas_decode:
+            from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
+                decode_attention_quant,
+            )
+
+            # The gathered view has the lane layout, so the int8-aware
+            # kernel serves paged rows too: the gather moves half the
+            # bytes of bf16 AND the kernel's reads stay int8 to VMEM.
+            attn = decode_attention_quant(
+                q, *_pool_rows(pools, tables), lengths)
+        elif cfg.use_pallas_decode:
             from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
                 decode_attention as pallas_decode,
             )
 
-            attn = pallas_decode(q, k_rows, v_rows, lengths)
+            attn = pallas_decode(q, *_pool_rows(pools, tables), lengths)
         else:
-            attn = decode_attention(q, k_rows, v_rows, lengths)
+            attn = decode_attention(
+                q, *_pool_rows(pools, tables, h.dtype), lengths)
         h = h + _project(attn.reshape(b, -1), lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_pool, v_pool)
+        return h, pools
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = q_matmul(h, head).astype(jnp.float32)
-    new_cache = {"k": k_new, "v": v_new, "tables": tables, "length": lengths}
+    new_cache = {"k": carry[0], "v": carry[1], "tables": tables,
+                 "length": lengths}
+    if quant:
+        new_cache["k_scale"], new_cache["v_scale"] = carry[2], carry[3]
     return logits, new_cache
 
 
@@ -187,8 +252,10 @@ def extend_step_paged(
     if lora_bufs is not None:
         per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
 
+    quant = "k_scale" in cache
+
     def layer_fn(h, xs):
-        lp, ll, k_pool, v_pool = xs
+        lp, ll, *pools = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
@@ -199,10 +266,10 @@ def extend_step_paged(
             b, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_pool = k_pool.at[phys_block, offset].set(k)
-        v_pool = v_pool.at[phys_block, offset].set(v)
-        k_rows = _gather_rows(k_pool, tables)  # [B, S_max, Kh, hd]
-        v_rows = _gather_rows(v_pool, tables)
+        pools = _pool_update(tuple(pools), k, v, phys_block, offset)
+        # Quantized pools dequant at the gathered view: XLA fuses the
+        # multiply into the attention reads, so HBM still streams int8.
+        k_rows, v_rows = _pool_rows(pools, tables, h.dtype)
         qg = q.reshape(b, c, cfg.n_kv_heads, cfg.q_per_kv, hd)
         logits = jnp.einsum(
             "bikgh,bjkh->bkgij", qg, k_rows,
@@ -215,15 +282,19 @@ def extend_step_paged(
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_pool, v_pool)
+        return h, pools
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = q_matmul(h, head).astype(jnp.float32)
-    new_cache = {"k": k_new, "v": v_new, "tables": tables,
+    new_cache = {"k": carry[0], "v": carry[1], "tables": tables,
                  "length": positions[:, -1] + 1}
+    if quant:
+        new_cache["k_scale"], new_cache["v_scale"] = carry[2], carry[3]
     return logits, new_cache
 
 
@@ -253,6 +324,24 @@ def insert_prefill_paged(
         padding = [(0, 0), (0, 0), (0, pad), (0, 0), (0, 0)]
         k_prompt = jnp.pad(k_prompt, padding)
         v_prompt = jnp.pad(v_prompt, padding)
+    if "k_scale" in cache:
+        # Quantize at the insert seam (the prefill computes bf16 KV): one
+        # scale per (layer, position, kv-head), scattered into the scale
+        # pools at the same physical blocks as the data.
+        kq, ks = _kv_quantize(k_prompt)  # [L,1,S',Kh,hd] -> [L,1,S',Kh]
+        vq, vs = _kv_quantize(v_prompt)
+        kb = kq.reshape(lyr, n_b, block, kh, hd)
+        vb = vq.reshape(lyr, n_b, block, kh, hd)
+        k = cache["k"].at[:, phys_blocks].set(kb)
+        v = cache["v"].at[:, phys_blocks].set(vb)
+        k_scale = cache["k_scale"].at[:, phys_blocks].set(
+            ks.reshape(lyr, n_b, block, kh))
+        v_scale = cache["v_scale"].at[:, phys_blocks].set(
+            vs.reshape(lyr, n_b, block, kh))
+        tables = cache["tables"].at[row].set(table_row)
+        length_vec = cache["length"].at[row].set(length)
+        return {"k": k, "v": v, "k_scale": k_scale, "v_scale": v_scale,
+                "tables": tables, "length": length_vec}
     kb = k_prompt.reshape(lyr, n_b, block, kh, hd)
     vb = v_prompt.reshape(lyr, n_b, block, kh, hd)
     k = cache["k"].at[:, phys_blocks].set(kb.astype(cache["k"].dtype))
@@ -306,8 +395,10 @@ def prefill_with_cache_paged(
         h = h * jnp.sqrt(cfg.d_model).astype(h.dtype)
     pos2d = positions[None]
 
+    quant = "k_scale" in cache
+
     def layer_fn(h, xs):
-        lp, ll, k_pool, v_pool = xs
+        lp, ll, *pools = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
@@ -315,10 +406,9 @@ def prefill_with_cache_paged(
         v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
-        k_pool = k_pool.at[phys_block, offset].set(k[0])
-        v_pool = v_pool.at[phys_block, offset].set(v[0])
-        lane_k = _gather_rows(k_pool, table_row[None])[0]  # [S_max, Kh, hd]
-        lane_v = _gather_rows(v_pool, table_row[None])[0]
+        pools = _pool_update(tuple(pools), k[0], v[0], phys_block, offset)
+        lane_k, lane_v = (r[0] for r in
+                          _pool_rows(pools, table_row[None], h.dtype))
         qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
         logits = jnp.einsum(
             "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
@@ -330,14 +420,19 @@ def prefill_with_cache_paged(
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)
-        return h, (k_pool, v_pool)
+        return h, pools
 
     xs = (params["layers"], per_layer_lora, cache["k"], cache["v"])
-    h, (k_new, v_new) = jax.lax.scan(layer_fn, h, xs)
+    if quant:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
+    h, carry = jax.lax.scan(layer_fn, h, xs)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     last_h = jax.lax.dynamic_index_in_dim(h[0], last_index, 0, keepdims=False)
     last_logits = q_matmul(last_h, head).astype(jnp.float32)
     length_vec = cache["length"].at[row].set(lane_end)
-    return last_logits, {"k": k_new, "v": v_new, "tables": tables,
-                         "length": length_vec}
+    new_cache = {"k": carry[0], "v": carry[1], "tables": tables,
+                 "length": length_vec}
+    if quant:
+        new_cache["k_scale"], new_cache["v_scale"] = carry[2], carry[3]
+    return last_logits, new_cache

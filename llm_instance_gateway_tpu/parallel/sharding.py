@@ -89,20 +89,26 @@ def cache_specs(cfg: ModelConfig | None = None, mesh: Mesh | None = None,
 
 
 def paged_cache_specs(cfg: ModelConfig | None = None,
-                      mesh: Mesh | None = None) -> dict[str, Any]:
+                      mesh: Mesh | None = None,
+                      quantized: bool = False) -> dict[str, Any]:
     """Paged pool [L, n_blocks, block, Kh, hd]: KV heads over tensor (the
     Megatron split — attention reads stay shard-local, the psum lives in
     wo), everything else replicated.  The block-pool dim belongs to no mesh
     axis: rows of one pool serve whichever requests the host allocator
     assigns, so the batch/data axis must be 1 (tensor-parallel paged
     serving — the big-model case; data-parallel replicas are separate
-    engine processes, which is how the gateway scales them anyway)."""
+    engine processes, which is how the gateway scales them anyway).
+    ``quantized`` adds the int8 pool's scale arrays, sharded like K/V
+    minus head_dim."""
     head_axis: str | None = "tensor"
     if cfg is not None and mesh is not None:
         if cfg.n_kv_heads % mesh.shape["tensor"] != 0:
             head_axis = None
     kv = P(None, None, None, head_axis, None)
-    return {"k": kv, "v": kv, "tables": P(), "length": P()}
+    specs = {"k": kv, "v": kv, "tables": P(), "length": P()}
+    if quantized:
+        specs["k_scale"] = specs["v_scale"] = P(None, None, None, head_axis)
+    return specs
 
 
 def lora_specs(cfg: ModelConfig) -> dict[str, Any]:

@@ -788,7 +788,8 @@ def main(argv=None) -> None:
         "--kv-quantize", choices=("none", "int8"), default="none",
         help="store the KV cache int8 with per-position/head scales — "
              "halves the HBM traffic long-context decode is bound by "
-             "(contiguous-lane cache only)",
+             "(composes with both the contiguous-lane and paged caches, "
+             "prefix caching included)",
     )
     parser.add_argument(
         "--paged-kv-blocks", type=int, default=None, metavar="N",

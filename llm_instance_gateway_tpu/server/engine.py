@@ -144,10 +144,15 @@ class EngineConfig:
     # per-(position, kv-head) f32 scales, dequantized inside the fused
     # attention reads — long-context decode is KV-bandwidth-bound and int8
     # halves that HBM traffic (the JetStream serving trade; scale overhead
-    # 1/(2*head_dim)).  Contiguous-lane cache only (the paged pool keeps
-    # bf16 for now).  Decode attention runs the int8-aware Pallas kernel
+    # 1/(2*head_dim)).  Composes with BOTH cache layouts: contiguous lanes
+    # and the paged pool (vLLM's quantized-paged-KV composition — scale
+    # pools index by physical block, so prefix-cache reuse carries them
+    # for free), and with prefix caching, grouped admission, chunked
+    # prefill, speculative decoding, and GSPMD meshes.  Decode attention
+    # runs the int8-aware Pallas kernel
     # (ops/pallas_decode_attention.decode_attention_quant — dequantizes in
-    # VMEM at the MXU feed), so the bandwidth win and the kernel win stack.
+    # VMEM at the MXU feed), so the bandwidth win and the kernel win stack
+    # on the lane path AND on the paged gathered view.
     kv_cache_quant: str | None = None
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
@@ -369,10 +374,6 @@ class Engine:
             raise ValueError(
                 f"kv_cache_quant={self.cfg.kv_cache_quant!r}: only 'int8' "
                 "(or None) is supported")
-        if self._kv_quant and self.paged:
-            raise ValueError(
-                "kv_cache_quant requires the contiguous-lane cache "
-                "(the paged pool keeps bf16 for now)")
         if self.paged:
             self._block = self.cfg.paged_kv_block
             self._max_blocks_per_seq = -(-self.cfg.max_seq_len // self._block)
@@ -384,6 +385,7 @@ class Engine:
             self.cache = paged_lib.init_paged_cache(
                 model_cfg, b, self.cfg.max_seq_len,
                 self._n_blocks, self._block, dtype=dtype,
+                quantized=self._kv_quant,
             )
             # Host-side allocator: physical block 1..n are allocatable;
             # block 0 is the trash block (paged_lib.TRASH_BLOCK).
@@ -490,7 +492,8 @@ class Engine:
                 self.params, sharding_lib.param_specs(model_cfg), mesh)
             self.cache = sharding_lib.shard_pytree(
                 self.cache,
-                (sharding_lib.paged_cache_specs(model_cfg, mesh)
+                (sharding_lib.paged_cache_specs(model_cfg, mesh,
+                                                quantized=self._kv_quant)
                  if self.paged else
                  sharding_lib.cache_specs(model_cfg, mesh,
                                           quantized=self._kv_quant)),
