@@ -20,6 +20,8 @@ TPU model server (JetStream-style) that wants to join a pool:
 ``tpu:kv_tokens_capacity``             total KV token capacity (gauge)
 ``tpu:kv_tokens_free``                 free KV token headroom (gauge)
 ``tpu:decode_tokens_per_sec``          recent decode throughput (gauge)
+``tpu:prefix_reused_tokens``           cumulative prompt tokens served from
+                                       the prefix cache (counter, optional)
 ``tpu:lora_requests_info``             labels ``running_lora_adapters`` (CSV),
                                        ``max_lora``; gauge value = unix ts of
                                        the snapshot (latest series wins)
@@ -49,6 +51,7 @@ KV_CAPACITY_METRIC = "tpu:kv_tokens_capacity"
 KV_FREE_METRIC = "tpu:kv_tokens_free"
 KV_PARKED_METRIC = "tpu:kv_parked_tokens"
 DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
+PREFIX_REUSED_METRIC = "tpu:prefix_reused_tokens"
 
 
 class FetchError(Exception):
@@ -93,6 +96,7 @@ def families_to_metrics(
         (KV_FREE_METRIC, lambda m, x: setattr(m, "kv_tokens_free", int(x))),
         (KV_PARKED_METRIC, lambda m, x: setattr(m, "kv_parked_tokens", int(x))),
         (DECODE_TPS_METRIC, lambda m, x: setattr(m, "decode_tokens_per_sec", float(x))),
+        (PREFIX_REUSED_METRIC, lambda m, x: setattr(m, "prefix_reused_tokens", int(x))),
     ):
         s = prom_parse.latest_sample(families.get(name, []))
         if s is not None:

@@ -58,6 +58,9 @@ class GatewayProxy:
         self.provider = provider
         self.datastore = datastore
         self.metrics = GatewayMetrics()
+        # Re-export per-replica prefix-cache reuse at the gateway /metrics
+        # (the KV-affinity observable; see GatewayMetrics.pool_signals_fn).
+        self.metrics.pool_signals_fn = provider.all_pod_metrics
         self.request_timeout_s = request_timeout_s
         self._session: aiohttp.ClientSession | None = None
 

@@ -59,6 +59,12 @@ class GatewayMetrics:
         self.tokens_completion_total: dict[str, int] = {}
         self.pick_latency = Histogram()
         self.lora_affinity_hits = 0  # picked pod already had the adapter
+        # Optional pool-signal source (set by the proxy): a callable
+        # returning the provider's PodMetrics snapshot, re-exported at
+        # render time so operators see per-replica prefix-cache hit volume
+        # at the gateway — the observable a KV-affinity routing policy
+        # would rank replicas by.
+        self.pool_signals_fn = None
 
     # -- recording ---------------------------------------------------------
     def record_request(self, model: str) -> None:
@@ -120,7 +126,24 @@ class GatewayMetrics:
                 lines.append(f"# TYPE {fam} counter")
                 for model, n in sorted(table.items()):
                     lines.append(f'{fam}{{model="{model}"}} {n}')
-            return "\n".join(lines) + "\n"
+            pool_signals = self.pool_signals_fn
+        if pool_signals is not None:
+            # Outside the lock: the provider snapshot is its own O(pods)
+            # copy, and render must not hold our lock across foreign code.
+            rows = []
+            total = 0
+            for pm in pool_signals():
+                n = getattr(pm.metrics, "prefix_reused_tokens", 0)
+                total += n
+                rows.append(
+                    f'gateway_pool_prefix_reused_tokens{{pod="{pm.pod.name}"}}'
+                    f" {n}")
+            lines.append("# TYPE gateway_pool_prefix_reused_tokens gauge")
+            lines += rows
+            lines.append(
+                "# TYPE gateway_pool_prefix_reused_tokens_sum gauge")
+            lines.append(f"gateway_pool_prefix_reused_tokens_sum {total}")
+        return "\n".join(lines) + "\n"
 
 
 class Timer:

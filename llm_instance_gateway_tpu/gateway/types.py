@@ -64,6 +64,11 @@ class Metrics:
     kv_parked_tokens: int = 0
     # Serving rates (optional, for latency-aware policies and the simulator).
     decode_tokens_per_sec: float = 0.0
+    # Cumulative prompt tokens served from the replica's prefix cache
+    # (``tpu:prefix_reused_tokens``): the observable a future KV-affinity
+    # routing policy needs — a replica already holding a shared prefix is
+    # cheaper to prefill on (SURVEY §5 observability note).
+    prefix_reused_tokens: int = 0
 
     def clone(self) -> "Metrics":
         m = dataclasses.replace(self)

@@ -29,6 +29,7 @@ tpu:kv_tokens_capacity 44448
 tpu:kv_tokens_free 28891
 tpu:kv_parked_tokens 512
 tpu:decode_tokens_per_sec 1234.5
+tpu:prefix_reused_tokens 640
 tpu:lora_requests_info{running_lora_adapters="sql-lora,tweet-lora",max_lora="4"} 100.0
 tpu:lora_requests_info{running_lora_adapters="old-lora",max_lora="4"} 90.0
 """
@@ -65,6 +66,7 @@ class TestFamiliesToMetrics:
         assert m.kv_tokens_capacity == 44448
         assert m.kv_tokens_free == 28891
         assert m.kv_parked_tokens == 512
+        assert m.prefix_reused_tokens == 640
         # Latest LoRA series wins (gauge value = snapshot ts, metrics.go:135-150).
         assert set(m.active_adapters) == {"sql-lora", "tweet-lora"}
         assert m.max_active_adapters == 4

@@ -33,6 +33,26 @@ class TestRender:
         assert families["gateway_completion_tokens_total"][0].value == 20
         assert families["gateway_pick_latency_seconds_count"][0].value == 1
 
+    def test_pool_prefix_signals_reexported(self):
+        """VERDICT r4 #10: per-replica prefix-cache reuse surfaces at the
+        gateway /metrics via the provider snapshot (the KV-affinity
+        observable)."""
+        from llm_instance_gateway_tpu.gateway.types import (
+            Metrics, Pod, PodMetrics)
+
+        gm = GatewayMetrics()
+        pods = [
+            PodMetrics(pod=Pod(name="pod-a", address="10.0.0.1"),
+                       metrics=Metrics(prefix_reused_tokens=128)),
+            PodMetrics(pod=Pod(name="pod-b", address="10.0.0.2"),
+                       metrics=Metrics(prefix_reused_tokens=64)),
+        ]
+        gm.pool_signals_fn = lambda: pods
+        text = gm.render()
+        assert 'gateway_pool_prefix_reused_tokens{pod="pod-a"} 128' in text
+        assert 'gateway_pool_prefix_reused_tokens{pod="pod-b"} 64' in text
+        assert "gateway_pool_prefix_reused_tokens_sum 192" in text
+
     def test_render_under_concurrent_mutation(self):
         """render() must stay well-formed while another thread records."""
         import threading
