@@ -432,12 +432,19 @@ def decode_step(
             v_cache = v_cache.at[batch_idx, positions].set(vq)
             k_scale = k_scale.at[batch_idx, positions].set(ks)
             v_scale = v_scale.at[batch_idx, positions].set(vs)
-            if attention_fn is not None:
-                # Explicit override: hand it the dequantized view.  NOTE —
-                # an opaque (pallas_call) override cannot fuse the dequant
-                # into its reads and would materialize a full bf16 cache;
-                # the engine deliberately never installs its shard_map
-                # wrapper for quantized lanes for exactly that reason.
+            if getattr(attention_fn, "quant_aware", False):
+                # Quant-aware override (sharded_attention.make_cached_
+                # decode_quant): raw int8 + scales go in; each shard's
+                # kernel dequantizes in VMEM, so HBM streams int8 even
+                # under the mesh — kernel win and bandwidth win together.
+                attn = attention_fn(
+                    q, k_cache, v_cache, k_scale, v_scale, lengths)
+            elif attention_fn is not None:
+                # Opaque override without quant awareness: hand it the
+                # dequantized view.  NOTE — such an override cannot fuse
+                # the dequant into its reads and materializes a full bf16
+                # cache; the engine only installs quant_aware wrappers on
+                # quantized lanes for exactly that reason.
                 attn = attention_fn(
                     q, _kv_dequantize(k_cache, k_scale, h.dtype),
                     _kv_dequantize(v_cache, v_scale, h.dtype), lengths)

@@ -441,18 +441,20 @@ class Engine:
                     # per-shard compute.
                     self._prefill_attn_fn = (
                         sharded_attention.make_flash_prefill(model_cfg, mesh))
-                if (wants_decode and not self.paged and not self._kv_quant
+                if (wants_decode and not self.paged
                         and b % mesh.shape.get("data", 1) == 0):
                     # The batch gate is load-bearing: a non-divisible B
                     # would force shard_map to replicate the data-sharded
                     # KV cache (a full-cache all-gather per layer per
-                    # step) — worse than the XLA fallback.  The quant gate
-                    # too: a shard_map pallas_call is opaque to XLA, so the
-                    # dequant multiply could NOT fuse into its reads — the
-                    # engine would materialize a full bf16 cache per layer
-                    # per step, spending the bandwidth int8 exists to save;
-                    # quantized mesh engines keep the fused XLA path.
+                    # step) — worse than the XLA fallback.  Quantized
+                    # lanes get the QUANT-AWARE wrapper (raw int8 + scales
+                    # shard-local into the int8 kernel, dequant in VMEM):
+                    # the bandwidth win and the kernel win stack under the
+                    # mesh too — a plain wrapper here would materialize a
+                    # full bf16 cache per layer per step.
                     self._decode_attn_fn = (
+                        sharded_attention.make_cached_decode_quant(
+                            model_cfg, mesh) if self._kv_quant else
                         sharded_attention.make_cached_decode(model_cfg, mesh))
                 logger.info(
                     "mesh size %d: Pallas kernels via shard_map "
