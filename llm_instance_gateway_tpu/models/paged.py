@@ -171,22 +171,19 @@ def decode_step_paged(
         q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         pools = _pool_update(tuple(pools), k, v, phys_block, offset)
-        if quant and cfg.use_pallas_decode:
+        if cfg.use_pallas_decode:
             from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
-                decode_attention_quant,
+                paged_decode_attention,
             )
 
-            # The gathered view has the lane layout, so the int8-aware
-            # kernel serves paged rows too: the gather moves half the
-            # bytes of bf16 AND the kernel's reads stay int8 to VMEM.
-            attn = decode_attention_quant(
-                q, *_pool_rows(pools, tables), lengths)
-        elif cfg.use_pallas_decode:
-            from llm_instance_gateway_tpu.ops.pallas_decode_attention import (
-                decode_attention as pallas_decode,
-            )
-
-            attn = pallas_decode(q, *_pool_rows(pools, tables), lengths)
+            # DIRECT paged kernel: the block table rides the scalar
+            # prefetch and each tile DMAs straight from the pool — no
+            # gathered copy of the live cache materializes in HBM (the
+            # old read paid gather write + kernel read).  int8 pools
+            # stream half the bytes again, scales on the same
+            # indirection; its auto-dispatch gathers + falls back off-TPU.
+            attn = paged_decode_attention(
+                q, pools[0], pools[1], tables, lengths, *pools[2:])
         else:
             attn = decode_attention(
                 q, *_pool_rows(pools, tables, h.dtype), lengths)

@@ -200,6 +200,129 @@ def supports(s_max: int, hd: int) -> bool:
     return _pick_block(s_max) != 0 and hd % 128 == 0
 
 
+# ---------------------------------------------------------------------------
+# Direct paged variant: block-table indirection in the index map
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(len_ref, tab_ref, *rest, block_s, scale, quant):
+    # Same body as the lane kernel — the logical S-block index (grid dim 1)
+    # drives masking exactly as before; only the DMA source moved.  The
+    # table ref is consumed by the index maps, not the body.
+    del tab_ref
+    _decode_kernel(len_ref, *rest, block_s=block_s, scale=scale, quant=quant)
+
+
+def supports_paged(block: int, hd: int, quant: bool) -> bool:
+    """The pool tile is one physical block: [1, block, K*hd].  Sublane dim
+    = block, so bf16 needs block % 8 == 0 (int8 tiling wants % 32)."""
+    return hd % 128 == 0 and block % (32 if quant else 8) == 0
+
+
+def paged_decode_attention_pallas(
+    q: jax.Array,        # [B, n_heads, hd]
+    k_pool: jax.Array,   # [n_blocks+1, P, n_kv, hd] (bf16 or int8)
+    v_pool: jax.Array,
+    tables: jax.Array,   # [B, M] int32 — physical block per logical block
+    lengths: jax.Array,  # [B] int32
+    k_scale: jax.Array | None = None,  # [n_blocks+1, P, n_kv] f32 (int8 mode)
+    v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention DIRECTLY over the paged pool.
+
+    The engine's original paged read gathered each row's blocks into a
+    contiguous [B, S_max, K, hd] array first — materializing a second copy
+    of the live cache in HBM every step (gather write + kernel read: ~2x
+    the bytes decode is bound by).  Here the BLOCK TABLE rides the scalar
+    prefetch (vLLM-PagedAttention's indirection, Pallas-style): the index
+    map of each (row, logical-block) grid cell looks up the physical block
+    and the DMA streams it straight from the pool, once.  Dead blocks
+    (start >= length) clamp to the row's last live LOGICAL block — whose
+    physical index the revisited map returns again, so Mosaic elides their
+    copies exactly like the lane kernel.  Composes with int8 pools: scale
+    columns ride the same indirection.
+    """
+    b, n_heads, hd = q.shape
+    n_kv = k_pool.shape[2]
+    block = k_pool.shape[1]
+    m = tables.shape[1]
+    g = n_heads // n_kv
+    scale = float(1.0 / (hd ** 0.5))
+    qg = q.reshape(b, n_kv, g, hd)
+    k2 = k_pool.reshape(k_pool.shape[0], block, n_kv * hd)
+    v2 = v_pool.reshape(v_pool.shape[0], block, n_kv * hd)
+
+    def kv_index(bi, sb, lens, tabs, block=block):
+        last = jnp.maximum(lens[bi] - 1, 0) // block
+        return (tabs[bi, jnp.minimum(sb, last)], 0, 0)
+
+    quant = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, n_kv, g, hd), lambda bi, sb, lens, tabs: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, block, n_kv * hd), kv_index),
+        pl.BlockSpec((1, block, n_kv * hd), kv_index),
+    ]
+    operands = [lengths, tables, qg, k2, v2]
+    if quant:
+        in_specs += [pl.BlockSpec((1, block, n_kv), kv_index)] * 2
+        operands += [k_scale, v_scale]
+    kernel = functools.partial(_paged_kernel, block_s=block, scale=scale,
+                               quant=quant)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # lengths (masking) + tables (DMA routing)
+            grid=(b, m),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, n_kv, g, hd),
+                                   lambda bi, sb, lens, tabs: (bi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_kv, g, 128), jnp.float32),  # m (lane-padded)
+                pltpu.VMEM((n_kv, g, 128), jnp.float32),  # l
+                pltpu.VMEM((n_kv, g, hd), jnp.float32),   # o accumulator
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(b, n_heads, hd)
+
+
+def paged_decode_attention(
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+    tables: jax.Array, lengths: jax.Array,
+    k_scale: jax.Array | None = None, v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Auto-dispatch for the direct paged kernel: unsupported block/head
+    shapes and non-TPU backends gather the row view (the pre-existing
+    read) and take the lane-path dispatchers."""
+    block, hd = k_pool.shape[1], k_pool.shape[3]
+    quant = k_scale is not None
+    if supports_paged(block, hd, quant) and (
+        interpret or jax.default_backend() in TPU_BACKENDS
+    ):
+        return paged_decode_attention_pallas(
+            q, k_pool, v_pool, tables, lengths, k_scale, v_scale,
+            interpret=interpret)
+
+    def rows(pool):
+        gth = pool[tables]  # [B, M, P, ...]
+        return gth.reshape(gth.shape[0], gth.shape[1] * gth.shape[2],
+                           *gth.shape[3:])
+
+    if quant:
+        return decode_attention_quant(q, rows(k_pool), rows(v_pool),
+                                      rows(k_scale), rows(v_scale), lengths,
+                                      interpret=interpret)
+    return decode_attention(q, rows(k_pool), rows(v_pool), lengths,
+                            interpret=interpret)
+
+
 def decode_attention(
     q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, lengths: jax.Array,
     interpret: bool = False,

@@ -3,6 +3,8 @@ xla_force_host_platform_device_count=8) — the reference's fake-the-fleet
 strategy applied to sharding (SURVEY.md §4).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -297,3 +299,27 @@ class TestExpertParallelServing:
         finally:
             engine.stop()
         assert got == want
+
+
+@pytest.mark.skipif(not os.environ.get("LIG_MODEL_SIZED"),
+                    reason="opt-in: 1B-param init+compile takes minutes "
+                           "(LIG_MODEL_SIZED=1)")
+class TestModelSizedMesh:
+    """VERDICT r4 #9: shape/memory plumbing at model scale — a ~1.14B-param
+    real-Llama-3-head-layout config serves greedy tokens over tensor=8
+    virtual devices (tools/model_sized_check.py; recorded run in
+    ARCHITECTURE.md §4)."""
+
+    def test_model_sized_tensor8_decode(self):
+        from tools.model_sized_check import run
+
+        result = run(int8=False)
+        assert result["params"] > 1_000_000_000
+        assert result["served_tokens"] == [4, 4]
+
+    def test_model_sized_tensor8_decode_int8(self):
+        from tools.model_sized_check import run
+
+        result = run(int8=True)
+        assert result["quant_kernel_wrapper"] is True
+        assert result["served_tokens"] == [4, 4]
