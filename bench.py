@@ -158,10 +158,10 @@ def make_adapter_weights(cfg, rank, seed):
     }
 
 
-def run_phase(engine, n_requests, prompt_len, max_new, adapters):
+def run_phase(engine, n_requests, prompt_len, max_new, adapters, seed=0):
     from llm_instance_gateway_tpu.server.engine import Request, SamplingParams
 
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(seed)
     reqs = []
     for i in range(n_requests):
         adapter = adapters[i % len(adapters)] if adapters else None

@@ -41,7 +41,10 @@ from llm_instance_gateway_tpu.models.transformer import (
     _mlp,
     _project,
 )
-from llm_instance_gateway_tpu.ops.attention import decode_attention
+from llm_instance_gateway_tpu.ops.attention import (
+    decode_attention,
+    gather_pool_rows,
+)
 from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm
 from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
 
@@ -81,12 +84,8 @@ def init_paged_cache(
     return cache
 
 
-def _gather_rows(pool: jax.Array, tables: jax.Array) -> jax.Array:
-    """[n_blocks+1, P, Kh, hd] x [B, M] -> contiguous [B, M*P, Kh, hd].
-    Rank-generic: scale pools [n_blocks+1, P, Kh] gather the same way."""
-    g = pool[tables]  # [B, M, P, Kh, hd]
-    b, m, p = g.shape[0], g.shape[1], g.shape[2]
-    return g.reshape(b, m * p, *g.shape[3:])
+_gather_rows = gather_pool_rows  # canonical def: ops.attention (shared with
+                                 # the paged kernel's fallback and tooling)
 
 
 def _pool_update(pools: tuple, k: jax.Array, v: jax.Array,

@@ -19,6 +19,16 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
 
+def gather_pool_rows(pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """Paged-pool gather: ``[n_blocks+1, P, ...] x [B, M] -> [B, M*P, ...]``
+    — each table row's physical blocks concatenated into the contiguous
+    lane view.  Rank-generic (scale pools ``[n_blocks+1, P, K]`` included).
+    The ONE definition of the pool->lane read; models/paged.py, the paged
+    kernel's fallback, the on-chip check, and the tests all share it."""
+    g = pool[tables]  # [B, M, P, ...]
+    return g.reshape(g.shape[0], g.shape[1] * g.shape[2], *g.shape[3:])
+
+
 def _grouped(q: jax.Array, n_kv_heads: int) -> jax.Array:
     """[.., n_heads, hd] -> [.., n_kv, q_per_kv, hd]."""
     *lead, n_heads, hd = q.shape

@@ -21,7 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from llm_instance_gateway_tpu.ops.attention import decode_attention as xla_decode
+from llm_instance_gateway_tpu.ops.attention import (
+    decode_attention as xla_decode,
+    gather_pool_rows,
+)
 
 NEG_INF = -1e30
 
@@ -213,10 +216,12 @@ def _paged_kernel(len_ref, tab_ref, *rest, block_s, scale, quant):
     _decode_kernel(len_ref, *rest, block_s=block_s, scale=scale, quant=quant)
 
 
-def supports_paged(block: int, hd: int, quant: bool) -> bool:
+def supports_paged(block: int, hd: int, dtype) -> bool:
     """The pool tile is one physical block: [1, block, K*hd].  Sublane dim
-    = block, so bf16 needs block % 8 == 0 (int8 tiling wants % 32)."""
-    return hd % 128 == 0 and block % (32 if quant else 8) == 0
+    = block, so it must divide the dtype's packed tiling: (8, 128) f32,
+    (16, 128) bf16, (32, 128) int8."""
+    sublane = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 32)
+    return hd % 128 == 0 and block % sublane == 0
 
 
 def paged_decode_attention_pallas(
@@ -303,18 +308,13 @@ def paged_decode_attention(
     read) and take the lane-path dispatchers."""
     block, hd = k_pool.shape[1], k_pool.shape[3]
     quant = k_scale is not None
-    if supports_paged(block, hd, quant) and (
+    if supports_paged(block, hd, k_pool.dtype) and (
         interpret or jax.default_backend() in TPU_BACKENDS
     ):
         return paged_decode_attention_pallas(
             q, k_pool, v_pool, tables, lengths, k_scale, v_scale,
             interpret=interpret)
-
-    def rows(pool):
-        gth = pool[tables]  # [B, M, P, ...]
-        return gth.reshape(gth.shape[0], gth.shape[1] * gth.shape[2],
-                           *gth.shape[3:])
-
+    rows = functools.partial(gather_pool_rows, tables=tables)
     if quant:
         return decode_attention_quant(q, rows(k_pool), rows(v_pool),
                                       rows(k_scale), rows(v_scale), lengths,

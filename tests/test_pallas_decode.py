@@ -94,8 +94,9 @@ class TestPagedDecodeKernel:
         return q, k_pool, v_pool, tables, lengths
 
     def gathered(self, pool, tables):
-        g = pool[tables]
-        return g.reshape(g.shape[0], g.shape[1] * g.shape[2], *g.shape[3:])
+        from llm_instance_gateway_tpu.ops.attention import gather_pool_rows
+
+        return gather_pool_rows(pool, tables)
 
     def test_matches_gathered_reference(self):
         q, k_pool, v_pool, tables, lengths = self.make_paged()
@@ -147,7 +148,10 @@ class TestPagedDecodeKernel:
         q, k_pool, v_pool, tables, lengths = self.make_paged(block=8, m=8)
         kq, ks_ = _kv_quantize(k_pool)
         vq, vs_ = _kv_quantize(v_pool)
-        assert not pda.supports_paged(8, 128, quant=True)
+        assert not pda.supports_paged(8, 128, jnp.int8)
+        assert not pda.supports_paged(8, 128, jnp.bfloat16)  # bf16 floor 16
+        assert pda.supports_paged(16, 128, jnp.bfloat16)
+        assert pda.supports_paged(8, 128, jnp.float32)
         got = pda.paged_decode_attention(
             q, kq, vq, tables, lengths, ks_, vs_, interpret=False)
         assert got.shape == q.shape

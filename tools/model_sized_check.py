@@ -53,6 +53,16 @@ def _ensure_cpu_mesh() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         return
+    # Cheap path: if this interpreter can already see enough CPU devices
+    # (e.g. XLA_FLAGS was set by the caller / conftest), skip the re-exec.
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if jax.device_count() >= N_DEVICES:
+            return
+    except RuntimeError:
+        pass  # backend already initialized differently: re-exec below
     inherited = re.sub(
         r"--xla_force_host_platform_device_count=\d+", "",
         os.environ.get("XLA_FLAGS", ""),

@@ -154,10 +154,10 @@ def check_paged_decode(b=8, h=32, n_kv=8, hd=128, block=64, m=32,
         scales = ()
 
     def gather_path(q, kp, vp, tabs, lens, *sc):
+        from llm_instance_gateway_tpu.ops.attention import gather_pool_rows
+
         def rows(pool):
-            g = pool[tabs]
-            return g.reshape(g.shape[0], g.shape[1] * g.shape[2],
-                             *g.shape[3:])
+            return gather_pool_rows(pool, tabs)
         if sc:
             return pdec.decode_attention_quant(
                 q, rows(kp), rows(vp), rows(sc[0]), rows(sc[1]), lens)
