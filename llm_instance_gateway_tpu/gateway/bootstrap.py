@@ -438,12 +438,23 @@ def _start_pool_sources(
         AdmissionController,
     )
 
+    # ONE prefix-affinity index for every scheduler instance routing this
+    # pool (direct path AND the admission drain path) — split indexes
+    # would learn conflicting prefix holders and flap between them.
+    from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+        PrefixIndex,
+    )
+
+    shared_prefix_index = PrefixIndex()
     scheduler = AdmissionController(
-        make_scheduler(provider, scheduler_cfg), scheduler_cfg.admission,
+        make_scheduler(provider, scheduler_cfg,
+                       prefix_index=shared_prefix_index),
+        scheduler_cfg.admission,
         # The hysteresis drain scheduler is built lazily on first enable —
         # the default (disabled) path pays for nothing.
         drain_scheduler_factory=lambda cfg: make_scheduler(
-            provider, cfg if cfg is not None else scheduler_cfg),
+            provider, cfg if cfg is not None else scheduler_cfg,
+            prefix_index=shared_prefix_index),
     )
     scheduler.start()
     watchers.append(scheduler)  # stop() joins the drain thread

@@ -28,11 +28,28 @@ from llm_instance_gateway_tpu.gateway.handlers.messages import (
     RequestBody,
     RequestHeaders,
 )
+from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+    prefix_hashes,
+)
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
 
 
 class RequestError(Exception):
     """Malformed or unroutable request (transport maps to 4xx/5xx)."""
+
+
+def prompt_text(body: dict) -> str:
+    """The request's prompt as one string (completions or chat shapes)."""
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    if isinstance(prompt, list):
+        return " ".join(p for p in prompt if isinstance(p, str))
+    if isinstance(body.get("messages"), list):
+        return " ".join(
+            str(m.get("content", "")) for m in body["messages"] if isinstance(m, dict)
+        )
+    return ""
 
 
 def estimate_prompt_tokens(body: dict) -> int:
@@ -41,17 +58,7 @@ def estimate_prompt_tokens(body: dict) -> int:
     ~4 chars/token is the standard rough estimate; precision doesn't matter —
     the headroom filter is advisory and only needs order-of-magnitude.
     """
-    text = ""
-    prompt = body.get("prompt")
-    if isinstance(prompt, str):
-        text = prompt
-    elif isinstance(prompt, list):
-        text = " ".join(p for p in prompt if isinstance(p, str))
-    elif isinstance(body.get("messages"), list):
-        text = " ".join(
-            str(m.get("content", "")) for m in body["messages"] if isinstance(m, dict)
-        )
-    return len(text) // 4
+    return len(prompt_text(body)) // 4
 
 
 def handle_request_headers(req_ctx, msg: RequestHeaders) -> ProcessingResult:
@@ -87,13 +94,17 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
                 f"error getting target model name for model {model_obj.name}"
             )
 
+    text = prompt_text(body)
     llm_req = LLMRequest(
         model=model,
         resolved_target_model=model_name,
         critical=is_critical(model_obj),
-        prompt_tokens=estimate_prompt_tokens(body),
+        prompt_tokens=len(text) // 4,
         criticality=(model_obj.spec.criticality.value
                      if model_obj.spec.criticality else "Default"),
+        # Model-seeded: identical boilerplate under different models must
+        # not alias (their KV blocks can't be shared).
+        prefix_hashes=prefix_hashes(text, model=model_name),
     )
 
     request_body = msg.body

@@ -107,6 +107,8 @@ class NativeScheduler:
         cfg: SchedulerConfig = DEFAULT_CONFIG,
         token_aware: bool = True,
         prefill_aware: bool = True,
+        prefix_aware: bool = True,
+        prefix_index=None,
         rng: random.Random | None = None,
     ):
         lib = _load_library()
@@ -117,6 +119,18 @@ class NativeScheduler:
         self.cfg = cfg
         self.token_aware = token_aware
         self.prefill_aware = prefill_aware
+        # Same post-tree prefix-affinity tie-break as the Python Scheduler
+        # (scheduling/prefix_affinity.py): applied over the C++ candidate
+        # set, so the fuzz-pinned candidate parity is untouched.
+        # ``prefix_index`` shares one index across scheduler instances
+        # routing the same pool (see Scheduler.__init__).
+        self.prefix_index = prefix_index
+        if prefix_aware and self.prefix_index is None:
+            from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+                PrefixIndex,
+            )
+
+            self.prefix_index = PrefixIndex()
         self._rng = rng or random.Random()
         self._snapshot: dict | None = None
         # The gRPC transport calls schedule() from a thread pool; the cached
@@ -229,7 +243,16 @@ class NativeScheduler:
         else:
             version, pods = None, self._provider.all_pod_metrics()
         idxs = self.candidates(req, pods, version)
-        return pods[idxs[self._rng.randrange(len(idxs))]].pod
+        pick = None
+        if self.prefix_index is not None and req.prefix_hashes:
+            held = self.prefix_index.prefer(req, [pods[i] for i in idxs])
+            if held is not None:
+                pick = held.pod
+        if pick is None:
+            pick = pods[idxs[self._rng.randrange(len(idxs))]].pod
+        if self.prefix_index is not None and req.prefix_hashes:
+            self.prefix_index.record(req.prefix_hashes, pick.name)
+        return pick
 
 
 def make_scheduler(provider, cfg: SchedulerConfig = DEFAULT_CONFIG,

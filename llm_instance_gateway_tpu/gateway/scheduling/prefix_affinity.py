@@ -1,0 +1,137 @@
+"""Approximate prefix-cache-aware routing (gateway side).
+
+A replica that already holds a prompt's leading KV blocks serves it with
+near-zero prefill for the shared part (the engine's content-addressed
+prefix cache, ``models/paged.py``; hit volume is visible per replica as
+``tpu:prefix_reused_tokens``).  The gateway cannot see replica block
+tables, so it keeps the standard approximation used by prefix-aware LLM
+routers: hash the prompt's leading text in fixed CHARACTER blocks
+(tokenizer-free — the gateway has no tokenizer; ~4 chars/token makes a
+256-char block ≈ the engine's default 64-token KV block), chain the
+hashes exactly like the engine chains block content hashes, remember
+which pod each chain hash was last routed to, and prefer the pod holding
+the LONGEST matching chain.
+
+Self-correcting by construction: the index is an LRU of recent routing
+decisions, so a replica that restarts (losing its cache) is re-learned
+within one window, and a wrong preference costs only a missed reuse.
+The preference is a POST-TREE TIE-BREAK (``PrefixIndex.prefer``): both
+schedulers (Python tree and C++ candidate path) run their full decision
+tree first and the holder is preferred only among the tree's survivors —
+it can never resurrect a replica the queue/KV/shed stages excluded, and
+the fuzz-pinned Python/native candidate parity is untouched.
+
+Interplay with relative bucketing (observed live, 2-replica rig): the
+tree's queue/KV stages bucket RELATIVE to the pool minimum, so near
+zero load a transient usage blip on the holder (it just served the
+previous request; the 50ms scrape caught it mid-decode) can bucket it
+out and the pick lands elsewhere — serialized one-at-a-time probes
+therefore alternate rather than stick.  Two consequences, both fine:
+hot SHARED prefixes replicate to every healthy replica within a few
+requests (each then serves them as cache hits —
+``gateway_pool_prefix_reused_tokens`` climbs pool-wide, the desirable
+steady state for system prompts); and affinity binds strongest exactly
+where it matters — steady concurrent load, where every replica carries
+nonzero usage and small deltas stay inside the bucket, and long
+session-unique prefixes (multi-turn continuations) whose holder the
+tree has no reason to exclude.
+
+Reference note: the reference tree routes on queue/LoRA/KV signals only
+(``pkg/ext-proc/scheduling/scheduler.go:26-91``); prefix affinity is a
+TPU-serving extension in the same spirit as the token-headroom and
+prefill-queue stages, OFF under ``prefix_aware=False`` and a no-op
+until a request actually repeats a prefix.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Sequence
+
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+# 256 chars ≈ 64 tokens — the engine's default --paged-kv-block, so one
+# gateway block ≈ one replica KV block.  Whole blocks only (engine parity:
+# a partial trailing block is never content-addressed).
+PREFIX_BLOCK_CHARS = 256
+# Hash at most this many leading blocks (~8 KB / ~2k tokens): system
+# prompts and few-shot preambles — the traffic prefix caching exists for —
+# fit comfortably; hashing cost stays trivially bounded per request.
+MAX_BLOCKS = 32
+
+
+def prefix_hashes(text: str, model: str = "") -> tuple[int, ...]:
+    """Chained per-block hashes of the prompt's leading whole blocks.
+
+    Chaining (each hash covers all preceding blocks) mirrors the engine's
+    chain-hash keys: matching hash i implies blocks 0..i all match, so the
+    longest matching hash IS the longest shared prefix.  The chain is
+    SEEDED with the resolved target model: KV blocks are model-specific,
+    so identical boilerplate under two models/adapters must not alias (a
+    cross-model "hit" would concentrate load with zero actual reuse).
+    blake2b keeps the chain stable across processes (``hash()`` is salted
+    per process and the index may one day be shared between gateway
+    replicas).
+    """
+    out: list[int] = []
+    h = hashlib.blake2b(model.encode("utf-8", "surrogatepass"),
+                        digest_size=8).digest() if model else b""
+    limit = min(len(text) // PREFIX_BLOCK_CHARS, MAX_BLOCKS)
+    for i in range(limit):
+        block = text[i * PREFIX_BLOCK_CHARS:(i + 1) * PREFIX_BLOCK_CHARS]
+        h = hashlib.blake2b(h + block.encode("utf-8", "surrogatepass"),
+                            digest_size=8).digest()
+        out.append(int.from_bytes(h, "big"))
+    return tuple(out)
+
+
+class PrefixIndex:
+    """LRU map: chain hash -> pod name that last served that prefix."""
+
+    def __init__(self, capacity: int = 16384):
+        self.capacity = capacity
+        self._map: "OrderedDict[int, str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def record(self, hashes: Sequence[int], pod_name: str) -> None:
+        if not hashes:
+            return
+        with self._lock:
+            for h in hashes:
+                self._map[h] = pod_name
+                self._map.move_to_end(h)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def lookup(self, hashes: Sequence[int]) -> tuple[str | None, int]:
+        """(pod name holding the longest matching chain, depth in blocks)."""
+        with self._lock:
+            for depth in range(len(hashes), 0, -1):
+                pod = self._map.get(hashes[depth - 1])
+                if pod is not None:
+                    return pod, depth
+        return None, 0
+
+    def prefer(self, req: LLMRequest,
+               survivors: Sequence[PodMetrics]) -> PodMetrics | None:
+        """The SURVIVOR holding the request's longest prefix, or None.
+
+        Applied AFTER the full decision tree (Python and native schedulers
+        identically): among pods the tree judged equally good, prefer the
+        one whose KV cache already holds the deepest prompt prefix.
+        Scans depths longest-first and skips holders the tree excluded —
+        a shallower prefix on a HEALTHY replica beats a deeper one on an
+        excluded replica (which is never resurrected).  A restarted
+        replica's stale entries cost only missed-reuse picks until LRU
+        turnover re-learns them."""
+        names = {pm.pod.name: pm for pm in survivors}
+        hashes = req.prefix_hashes
+        with self._lock:
+            for depth in range(len(hashes), 0, -1):
+                pod = self._map.get(hashes[depth - 1])
+                if pod is not None and pod in names:
+                    return names[pod]
+        return None

@@ -168,6 +168,8 @@ class Scheduler:
         cfg: SchedulerConfig = DEFAULT_CONFIG,
         token_aware: bool = True,
         prefill_aware: bool = True,
+        prefix_aware: bool = True,
+        prefix_index=None,
         rng: random.Random | None = None,
         tree: Filter | None = None,
     ):
@@ -175,6 +177,21 @@ class Scheduler:
         self.cfg = cfg
         self._token_aware = token_aware
         self._prefill_aware = prefill_aware
+        # Prefix-cache-aware tie-break (scheduling/prefix_affinity.py),
+        # applied AFTER the tree over its survivor set — identical seam in
+        # the native scheduler, so the two implementations stay
+        # parity-comparable.  Inert until requests carry prefix_hashes AND
+        # a prefix repeats.  ``prefix_index`` injects a SHARED index when
+        # several scheduler instances route one pool (e.g. the admission
+        # controller's drain scheduler) — split indexes would learn
+        # conflicting holders and flap.
+        self.prefix_index = prefix_index
+        if prefix_aware and self.prefix_index is None:
+            from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+                PrefixIndex,
+            )
+
+            self.prefix_index = PrefixIndex()
         self._custom_tree = tree is not None
         self._tree = tree or build_default_tree(
             cfg, token_aware=token_aware, prefill_aware=prefill_aware
@@ -197,7 +214,8 @@ class Scheduler:
             )
             return
         self._tree = build_default_tree(
-            cfg, token_aware=self._token_aware, prefill_aware=self._prefill_aware
+            cfg, token_aware=self._token_aware,
+            prefill_aware=self._prefill_aware,
         )
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -210,4 +228,15 @@ class Scheduler:
             ) from e
         if not survivors:
             raise SchedulingError("failed to apply filter, resulted 0 pods")
-        return survivors[self._rng.randrange(len(survivors))].pod
+        pick = None
+        if self.prefix_index is not None and req.prefix_hashes:
+            held = self.prefix_index.prefer(req, survivors)
+            if held is not None:
+                pick = held.pod
+        if pick is None:
+            pick = survivors[self._rng.randrange(len(survivors))].pod
+        if self.prefix_index is not None and req.prefix_hashes:
+            # The pick is about to prefill (and, with the engine's prefix
+            # cache on, retain) this prefix: future lookups route here.
+            self.prefix_index.record(req.prefix_hashes, pick.name)
+        return pick

@@ -25,3 +25,7 @@ class LLMRequest:
     # admission queue drains tiers at different weights; ``critical`` stays
     # the filter tree's binary signal (reference types.go parity).
     criticality: str = "Default"
+    # TPU addition: chained block hashes of the prompt's leading text
+    # (scheduling/prefix_affinity.py) — lets the scheduler prefer the
+    # replica already holding this prefix's KV blocks.  Empty = no hint.
+    prefix_hashes: tuple = ()

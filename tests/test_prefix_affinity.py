@@ -1,0 +1,188 @@
+"""Prefix-cache-aware routing: chained hashes, LRU index, tree stage.
+
+The gateway-side approximation of replica KV-prefix reuse
+(scheduling/prefix_affinity.py): requests repeating a prompt prefix
+route to the replica that last served it — advisory (queue health wins),
+inert for requests without hashes (reference-parity construction).
+"""
+
+import random
+
+from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+    MAX_BLOCKS,
+    PREFIX_BLOCK_CHARS,
+    PrefixIndex,
+    prefix_hashes,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+
+def pm(name, queue=0, kv=0.0):
+    return PodMetrics(
+        pod=Pod(name=name, address=f"{name}:8000"),
+        metrics=Metrics(waiting_queue_size=queue, kv_cache_usage_percent=kv),
+    )
+
+
+class FakeProvider:
+    def __init__(self, pods):
+        self.pods = pods
+
+    def all_pod_metrics(self):
+        return list(self.pods)
+
+
+class TestPrefixHashes:
+    def test_whole_blocks_only(self):
+        assert prefix_hashes("x" * (PREFIX_BLOCK_CHARS - 1)) == ()
+        assert len(prefix_hashes("x" * PREFIX_BLOCK_CHARS)) == 1
+        assert len(prefix_hashes("x" * (3 * PREFIX_BLOCK_CHARS + 5))) == 3
+
+    def test_chaining_detects_divergence_depth(self):
+        shared = "s" * (2 * PREFIX_BLOCK_CHARS)
+        a = prefix_hashes(shared + "a" * PREFIX_BLOCK_CHARS)
+        b = prefix_hashes(shared + "b" * PREFIX_BLOCK_CHARS)
+        assert a[:2] == b[:2] and a[2] != b[2]
+
+    def test_block_cap(self):
+        h = prefix_hashes("y" * (PREFIX_BLOCK_CHARS * (MAX_BLOCKS + 10)))
+        assert len(h) == MAX_BLOCKS
+
+    def test_stable_across_calls(self):
+        t = "q" * PREFIX_BLOCK_CHARS
+        assert prefix_hashes(t) == prefix_hashes(t)  # blake2b, not hash()
+
+    def test_model_seeding_prevents_cross_model_aliasing(self):
+        t = "boilerplate " * 64
+        assert prefix_hashes(t, model="m-a") != prefix_hashes(t, model="m-b")
+        assert prefix_hashes(t, model="m-a") == prefix_hashes(t, model="m-a")
+
+
+class TestPrefixIndex:
+    def test_longest_match_wins(self):
+        idx = PrefixIndex()
+        deep = prefix_hashes("s" * (3 * PREFIX_BLOCK_CHARS))
+        idx.record(deep[:1], "pod-shallow")
+        idx.record(deep, "pod-deep")
+        assert idx.lookup(deep) == ("pod-deep", 3)
+        assert idx.lookup(deep[:1]) == ("pod-deep", 1)  # overwritten at d1
+
+    def test_lru_eviction(self):
+        idx = PrefixIndex(capacity=2)
+        idx.record([1], "a")
+        idx.record([2], "b")
+        idx.record([3], "c")  # evicts hash 1
+        assert idx.lookup([1]) == (None, 0)
+        assert idx.lookup([2]) == ("b", 1)
+
+    def test_prefer_falls_back_to_shallower_surviving_holder(self):
+        """The deepest holder being tree-excluded must not erase affinity:
+        the next-longest holder that IS a survivor wins."""
+        idx = PrefixIndex()
+        deep = prefix_hashes("s" * (3 * PREFIX_BLOCK_CHARS))
+        idx.record(deep[:1], "pod-shallow")
+        idx.record(deep[1:], "pod-deep")  # depths 2,3 -> pod-deep
+        survivors = [pm("pod-shallow"), pm("other")]  # pod-deep excluded
+        req = LLMRequest(model="m", resolved_target_model="m",
+                         prefix_hashes=deep)
+        held = idx.prefer(req, survivors)
+        assert held is not None and held.pod.name == "pod-shallow"
+        # And the deepest holder wins when it IS a survivor.
+        held = idx.prefer(req, survivors + [pm("pod-deep")])
+        assert held.pod.name == "pod-deep"
+
+
+class TestSchedulerPrefixAffinity:
+    def _req(self, text=""):
+        return LLMRequest(model="m", resolved_target_model="m",
+                          critical=True,
+                          prefix_hashes=prefix_hashes(text))
+
+    def test_repeat_prefix_sticks_to_first_pick(self):
+        pods = [pm("p0"), pm("p1"), pm("p2")]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(0))
+        text = "SYSTEM PROMPT " * 64  # several whole blocks
+        first = sched.schedule(self._req(text)).name
+        for _ in range(10):
+            assert sched.schedule(self._req(text)).name == first
+
+    def test_different_prefixes_spread(self):
+        pods = [pm("p0"), pm("p1"), pm("p2")]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(1))
+        picks = {sched.schedule(self._req(f"prompt {i} " * 64)).name
+                 for i in range(30)}
+        assert len(picks) > 1  # no accidental global stickiness
+
+    def test_queue_health_beats_affinity(self):
+        """A saturated holder is excluded by the queue stage BEFORE the
+        affinity stage sees it — affinity can't route onto a hot replica."""
+        provider = FakeProvider([pm("p0"), pm("p1")])
+        sched = Scheduler(provider, rng=random.Random(2))
+        text = "shared " * 128
+        holder = sched.schedule(self._req(text)).name
+        other = "p1" if holder == "p0" else "p0"
+        # Saturate the holder far beyond the others: range-bucketing keeps
+        # only the low-queue pod.
+        provider.pods = [pm(holder, queue=500), pm(other, queue=0)]
+        assert sched.schedule(self._req(text)).name == other
+
+    def test_requests_without_hashes_unaffected(self):
+        pods = [pm("p0"), pm("p1")]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(3))
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True)
+        picks = {sched.schedule(req).name for _ in range(20)}
+        assert picks == {"p0", "p1"}  # uniform spread, index never consulted
+
+    def test_parity_construction_has_no_index(self):
+        sched = Scheduler(FakeProvider([pm("p0")]), token_aware=False,
+                          prefill_aware=False, prefix_aware=False)
+        assert sched.prefix_index is None
+
+
+class TestNativeSchedulerPrefixAffinity:
+    """The C++ candidate path gets the SAME post-tree tie-break."""
+
+    def _native(self, pods, seed=0):
+        import pytest as _pytest
+
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            _pytest.skip("native scheduler unavailable")
+        return native.NativeScheduler(FakeProvider(pods),
+                                      rng=random.Random(seed))
+
+    def test_repeat_prefix_sticks_on_native(self):
+        sched = self._native([pm("p0"), pm("p1"), pm("p2")])
+        text = "NATIVE SYSTEM PROMPT " * 64
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                         prefix_hashes=prefix_hashes(text))
+        first = sched.schedule(req).name
+        for _ in range(10):
+            assert sched.schedule(req).name == first
+
+    def test_native_queue_health_beats_affinity(self):
+        provider = FakeProvider([pm("p0"), pm("p1")])
+        sched = self._native([])
+        sched._provider = provider
+        text = "native shared " * 128
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                         prefix_hashes=prefix_hashes(text))
+        holder = sched.schedule(req).name
+        other = "p1" if holder == "p0" else "p0"
+        provider.pods = [pm(holder, queue=500), pm(other, queue=0)]
+        assert sched.schedule(req).name == other
+
+
+class TestHandlerPlumbs:
+    def test_request_handler_attaches_hashes(self):
+        from llm_instance_gateway_tpu.gateway.handlers.request import (
+            prompt_text,
+        )
+
+        body = {"prompt": "p" * 600}
+        assert len(prefix_hashes(prompt_text(body))) == 2
+        chat = {"messages": [{"role": "user", "content": "c" * 600}]}
+        assert prefix_hashes(prompt_text(chat))
