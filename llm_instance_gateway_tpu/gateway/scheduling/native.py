@@ -123,8 +123,9 @@ class NativeScheduler:
         # (scheduling/prefix_affinity.py): applied over the C++ candidate
         # set, so the fuzz-pinned candidate parity is untouched.
         # ``prefix_index`` shares one index across scheduler instances
-        # routing the same pool (see Scheduler.__init__).
-        self.prefix_index = prefix_index
+        # routing the same pool; prefix_aware=False disables the tie-break
+        # even with an injected index (see Scheduler.__init__).
+        self.prefix_index = prefix_index if prefix_aware else None
         if prefix_aware and self.prefix_index is None:
             from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
                 PrefixIndex,

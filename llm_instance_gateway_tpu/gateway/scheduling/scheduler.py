@@ -184,8 +184,10 @@ class Scheduler:
         # a prefix repeats.  ``prefix_index`` injects a SHARED index when
         # several scheduler instances route one pool (e.g. the admission
         # controller's drain scheduler) — split indexes would learn
-        # conflicting holders and flap.
-        self.prefix_index = prefix_index
+        # conflicting holders and flap.  prefix_aware=False disables the
+        # tie-break even with an injected index (the flag is the OFF
+        # switch; the index argument only chooses whose state to share).
+        self.prefix_index = prefix_index if prefix_aware else None
         if prefix_aware and self.prefix_index is None:
             from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
                 PrefixIndex,

@@ -230,36 +230,95 @@ class ModelServer:
         req.finish_reason = "stop"
         return full[:idx], True
 
+    def _per_token_records(self, req: Request, k: int):
+        """Per-generated-token ``(piece, logprob, deduped_tops)`` rows — the
+        ONE walk both logprobs envelopes (completions and chat) build from.
+
+        Piece attribution holds back trailing replacement chars while more
+        tokens remain: a UTF-8 character split across byte-fallback tokens
+        is attributed whole to its COMPLETING token (predecessors emit "")
+        — so the pieces' concatenation equals the full decode exactly,
+        instead of leaking U+FFFD for characters that decode fine in
+        ``message.content``/``text``.  ``deduped_tops`` keeps the most
+        probable id per surface string (byte-fallback ids can collide)."""
+        rows = []
+        committed = ""
+        n = len(req.output_tokens)
+        for i in range(n):
+            cur = self.tokenizer.decode(req.output_tokens[: i + 1])
+            if i + 1 < n:
+                # Trailing replacement chars may be a partial multi-byte
+                # sequence the next token completes: hold them back.
+                cur = cur.rstrip("�")
+            piece = cur[len(committed):]
+            committed += piece
+            lp = (req.output_logprobs[i]
+                  if i < len(req.output_logprobs) else None)
+            tops: dict[str, float] = {}
+            if k > 0 and i < len(req.output_top_logprobs):
+                for tok, v in req.output_top_logprobs[i].items():
+                    key = self.tokenizer.decode([tok])
+                    v = max(v, -1e9)
+                    if key not in tops or v > tops[key]:
+                        tops[key] = v
+            rows.append((piece, None if lp is None else max(lp, -1e9), tops))
+        return rows
+
     def _logprobs_json(self, req: Request, k: int) -> dict:
         """OpenAI completions ``logprobs`` object (tokens / token_logprobs /
         top_logprobs / text_offset)."""
         tokens, token_lps, tops, offsets = [], [], [], []
-        prev = ""
-        for i in range(len(req.output_tokens)):
-            cur = self.tokenizer.decode(req.output_tokens[: i + 1])
-            offsets.append(len(prev))
-            tokens.append(cur[len(prev):])
-            prev = cur
-            lp = (req.output_logprobs[i]
-                  if i < len(req.output_logprobs) else None)
-            token_lps.append(None if lp is None else max(lp, -1e9))
-            if k > 0 and i < len(req.output_top_logprobs):
-                # Distinct token ids can decode to the same surface string
-                # (byte-fallback, special tokens): keep the most probable
-                # id's value for a collided key rather than last-write-wins.
-                entry: dict[str, float] = {}
-                for tok, v in req.output_top_logprobs[i].items():
-                    key = self.tokenizer.decode([tok])
-                    v = max(v, -1e9)
-                    if key not in entry or v > entry[key]:
-                        entry[key] = v
-                tops.append(entry)
+        offset = 0
+        for piece, lp, top in self._per_token_records(req, k):
+            offsets.append(offset)
+            offset += len(piece)
+            tokens.append(piece)
+            token_lps.append(lp)
+            if k > 0:
+                tops.append(top)
         return {
             "tokens": tokens,
             "token_logprobs": token_lps,
             "top_logprobs": tops if k > 0 else None,
             "text_offset": offsets,
         }
+
+    def _chat_logprobs_json(self, req: Request, top_n: int) -> dict:
+        """OpenAI CHAT ``logprobs`` object — ``choices[].logprobs.content[]``
+        entries with token / logprob / bytes / top_logprobs (the chat form:
+        per-token objects with UTF-8 byte arrays, no text_offset — distinct
+        envelope over the same ``_per_token_records`` walk the completions
+        form uses).  ``bytes`` carries the attributed piece's UTF-8, so the
+        concatenation of all bytes arrays equals the content's encoding."""
+        content = []
+        for piece, lp, top in self._per_token_records(req, top_n):
+            content.append({
+                "token": piece,
+                "logprob": lp,
+                "bytes": list(piece.encode("utf-8", "surrogatepass")),
+                "top_logprobs": [
+                    {"token": k, "logprob": v,
+                     "bytes": list(k.encode("utf-8", "surrogatepass"))}
+                    for k, v in sorted(top.items(), key=lambda kv: -kv[1])
+                ][:top_n],
+            })
+        return {"content": content}
+
+    @staticmethod
+    def _parse_chat_logprobs(body: dict) -> tuple[bool, int]:
+        """(logprobs flag, top_logprobs N) with OpenAI chat validation."""
+        lp_flag = bool(body.get("logprobs"))
+        top_n = body.get("top_logprobs")
+        if top_n is None:
+            return lp_flag, 0
+        if not lp_flag:
+            raise ValueError("top_logprobs requires logprobs: true")
+        top_n = int(top_n)
+        if not 0 <= top_n <= MAX_LOGPROBS:
+            # OpenAI allows up to 20; the engine computes top-5 device-side
+            # (LOGPROB_TOPK) — state the real ceiling.
+            raise ValueError(f"top_logprobs must be in [0, {MAX_LOGPROBS}]")
+        return lp_flag, top_n
 
     async def _run(self, req: Request, stops: list[str] | None = None) -> Request:
         loop = asyncio.get_running_loop()
@@ -598,12 +657,16 @@ class ModelServer:
             return _err(404, str(e))
         try:
             n, best_of, _, stops = self._parse_choice_params(body)
+            lp_flag, top_n = self._parse_chat_logprobs(body)
         except (ValueError, TypeError) as e:
             return _err(400, str(e))
         prompt_tokens = self.tokenizer.encode(prompt)
         if body.get("stream"):
             if n > 1 or best_of > 1:
                 return _err(400, "streaming supports n=1 / best_of=1")
+            if lp_flag:
+                return _err(400, "logprobs are not supported with "
+                                 "streaming chat completions")
             req = self._make_request(body, prompt_tokens, adapter)
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
@@ -616,6 +679,7 @@ class ModelServer:
                 stops=stops,
             )
         reqs = [self._make_request(body, list(prompt_tokens), adapter,
+                                   logprobs=top_n if lp_flag else None,
                                    candidate=i)
                 for i in range(n)]
         try:
@@ -632,11 +696,14 @@ class ModelServer:
         choices = []
         for i, r in enumerate(reqs):
             text, _ = self._truncate_at_stop(r, stops)
-            choices.append({
+            choice = {
                 "index": i,
                 "message": {"role": "assistant", "content": text},
                 "finish_reason": r.finish_reason,
-            })
+            }
+            if lp_flag:
+                choice["logprobs"] = self._chat_logprobs_json(r, top_n)
+            choices.append(choice)
         completion_tokens = sum(len(r.output_tokens) for r in reqs)
         return web.json_response({
             "id": f"chatcmpl-{reqs[0].request_id}",

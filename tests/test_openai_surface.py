@@ -241,3 +241,83 @@ class TestEcho:
             "model": "llama3-tiny", "prompt": "a", "max_tokens": 2,
             "echo": True, "logprobs": 2})
         assert status == 400
+
+
+class TestChatLogprobs:
+    """OpenAI CHAT logprobs form: choices[].logprobs.content[] entries with
+    token/logprob/bytes/top_logprobs — distinct from the completions form."""
+
+    def _chat(self, model_server, extra):
+        body = {"model": "llama3-tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, **extra}
+        return post(model_server, "/v1/chat/completions", body)
+
+    def test_content_entries_shape(self, model_server):
+        status, data = self._chat(model_server,
+                                  {"logprobs": True, "top_logprobs": 3})
+        assert status == 200
+        content = data["choices"][0]["logprobs"]["content"]
+        assert len(content) == 4
+        text = "".join(e["token"] for e in content)
+        assert text == data["choices"][0]["message"]["content"]
+        for e in content:
+            assert e["logprob"] <= 0.0
+            assert bytes(e["bytes"]).decode("utf-8") == e["token"]
+            assert 1 <= len(e["top_logprobs"]) <= 3
+            lps = [t["logprob"] for t in e["top_logprobs"]]
+            assert lps == sorted(lps, reverse=True)
+            # greedy pick: the sampled token is the argmax, so the rank-0
+            # top entry's logprob equals the sampled logprob (the SURFACE
+            # string may differ: a partial-byte token's attributed piece
+            # can be "" while its top entry shows the standalone decode).
+            assert e["top_logprobs"][0]["logprob"] == pytest.approx(
+                e["logprob"])
+
+    def test_logprobs_true_without_top_n(self, model_server):
+        status, data = self._chat(model_server, {"logprobs": True})
+        assert status == 200
+        content = data["choices"][0]["logprobs"]["content"]
+        assert all(e["top_logprobs"] == [] for e in content)
+        assert all(e["logprob"] <= 0.0 for e in content)
+
+    def test_no_logprobs_field_when_not_requested(self, model_server):
+        status, data = self._chat(model_server, {})
+        assert status == 200
+        assert "logprobs" not in data["choices"][0]
+
+    def test_top_logprobs_requires_flag(self, model_server):
+        status, data = self._chat(model_server, {"top_logprobs": 2})
+        assert status == 400
+        assert "requires logprobs" in data["error"]["message"]
+
+    def test_top_logprobs_out_of_range(self, model_server):
+        status, data = self._chat(model_server,
+                                  {"logprobs": True, "top_logprobs": 9})
+        assert status == 400
+
+    def test_streaming_chat_logprobs_rejected(self, model_server):
+        status, data = self._chat(model_server,
+                                  {"logprobs": True, "stream": True})
+        assert status == 400
+
+    def test_multibyte_char_attributed_whole(self, model_server):
+        """A UTF-8 character split across byte-fallback tokens must be
+        attributed WHOLE to its completing token (predecessors emit ""),
+        never leak U+FFFD into token/bytes — for BOTH logprobs forms."""
+        from llm_instance_gateway_tpu.server.engine import Request
+
+        req = Request(prompt_tokens=[1], max_new_tokens=8, sampling=None)
+        # 'a' + emoji (4 bytes split over 4 byte tokens) + 'b'
+        req.output_tokens = [ord("a"), 0xF0, 0x9F, 0x98, 0x80, ord("b")]
+        req.output_logprobs = [-0.5] * 6
+        req.output_top_logprobs = [{t: -0.5} for t in req.output_tokens]
+        chat = model_server._chat_logprobs_json(req, top_n=1)["content"]
+        pieces = [e["token"] for e in chat]
+        assert "".join(pieces) == "a😀b"
+        assert pieces == ["a", "", "", "", "😀", "b"]
+        all_bytes = [b for e in chat for b in e["bytes"]]
+        assert bytes(all_bytes).decode("utf-8") == "a😀b"
+        comp = model_server._logprobs_json(req, k=1)
+        assert "".join(comp["tokens"]) == "a😀b"
+        assert comp["text_offset"] == [0, 1, 1, 1, 1, 2]
