@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import heapq
 import random
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
@@ -87,6 +88,12 @@ class SimRequest:
     critical: bool = False
     tier: str = "Default"  # Critical / Default / Sheddable
     slo_s_per_token: float = 0.025
+    # Shared-prefix modeling (session templates / multi-turn context): the
+    # leading ``prefix_tokens`` of the prompt are identical across every
+    # request with the same ``prefix_id`` — a replica holding it in its
+    # prefix cache prefills only the suffix (models/paged.py semantics).
+    prefix_id: int | None = None
+    prefix_tokens: int = 0
     # lifecycle
     t_first_token: float = -1.0
     t_done: float = -1.0
@@ -120,6 +127,7 @@ class SimServer:
         decode_slots: int = 16,
         kv_capacity_tokens: int = 44_448,
         max_adapters: int = 4,
+        prefix_cache_size: int = 32,
     ):
         self.name = name
         self.pod = Pod(name=name, address=f"{name}:8000")
@@ -132,6 +140,14 @@ class SimServer:
         self.resident_adapters: dict[str, int] = {}
         self.busy_until = 0.0
         self.tokens_generated = 0
+        # Prefix cache: retained prefix_ids, LRU-capped (the engine's
+        # zero-ref cached blocks, abstracted to whole prefixes; their pool
+        # occupancy is evict-on-demand and not charged against kv_free).
+        self.prefix_cache_size = prefix_cache_size
+        self.cached_prefixes: "OrderedDict[int, int]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_reused_tokens = 0
 
     # -- metrics the production scheduler consumes -------------------------
     def metrics(self) -> PodMetrics:
@@ -171,7 +187,22 @@ class SimServer:
             and self._admit_would_fit(self.prefill_queue[0])
         ):
             req = self.prefill_queue.pop(0)
-            duration = self.latency.prefill_s(req.prompt_tokens)
+            prefill_tokens = req.prompt_tokens
+            if req.prefix_id is not None:
+                if req.prefix_id in self.cached_prefixes:
+                    # Cache hit: only the suffix prefills (the prefix's KV
+                    # blocks map into the row's table, zero compute).
+                    prefill_tokens = max(
+                        0, req.prompt_tokens - req.prefix_tokens)
+                    self.prefix_hits += 1
+                    self.prefix_reused_tokens += req.prefix_tokens
+                else:
+                    self.prefix_misses += 1
+                self.cached_prefixes[req.prefix_id] = req.prefix_tokens
+                self.cached_prefixes.move_to_end(req.prefix_id)
+                while len(self.cached_prefixes) > self.prefix_cache_size:
+                    self.cached_prefixes.popitem(last=False)
+            duration = self.latency.prefill_s(prefill_tokens)
             if req.adapter and req.adapter not in self.resident_adapters:
                 self.resident_adapters[req.adapter] = 0
                 duration += self.latency.adapter_load_s

@@ -56,6 +56,14 @@ class WorkloadConfig:
     adapter_fraction: float = 0.8
     slo_critical_s: float = 0.025   # notebook cell 18 tiers
     slo_default_s: float = 0.5
+    # Session-prefix traffic (multi-turn chat / per-tenant templates): this
+    # fraction of requests carries one of ``n_sessions`` shared prefixes of
+    # ``session_prefix_tokens`` — a replica holding the prefix in its cache
+    # prefills only the suffix, and prefix-affinity routing tries to land
+    # repeats on that replica.
+    session_fraction: float = 0.0
+    n_sessions: int = 64
+    session_prefix_tokens: int = 1024
     seed: int = 0
 
 
@@ -75,11 +83,18 @@ def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
             else None
         )
         is_critical = critical and not sheddable
+        prompt = max(8, int(rng.gauss(cfg.prompt_mean, cfg.prompt_std)))
+        prefix_id = None
+        prefix_tokens = 0
+        if cfg.session_fraction and rng.random() < cfg.session_fraction:
+            prefix_id = rng.randrange(cfg.n_sessions)
+            prefix_tokens = cfg.session_prefix_tokens
+            prompt += prefix_tokens  # suffix stays the base distribution
         reqs.append(
             SimRequest(
                 rid=rid,
                 arrival_s=t,
-                prompt_tokens=max(8, int(rng.gauss(cfg.prompt_mean, cfg.prompt_std))),
+                prompt_tokens=prompt,
                 output_tokens=max(4, int(rng.gauss(cfg.output_mean, cfg.output_std))),
                 model=adapter or "base",
                 adapter=adapter,
@@ -87,6 +102,8 @@ def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
                 tier=("Critical" if is_critical
                       else "Sheddable" if sheddable else "Default"),
                 slo_s_per_token=cfg.slo_critical_s if critical else cfg.slo_default_s,
+                prefix_id=prefix_id,
+                prefix_tokens=prefix_tokens,
             )
         )
         rid += 1
@@ -102,7 +119,7 @@ class _SimProvider:
 
 
 def make_router(policy: str, servers: list[SimServer], seed: int = 0,
-                scheduler_cfg=None):
+                scheduler_cfg=None, prefix_index=None):
     rng = pyrandom.Random(seed)
     by_name = {s.pod.name: s for s in servers}
     if policy == "random":
@@ -131,10 +148,16 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0,
 
         return lambda req: min(
             servers, key=lambda s: est(s, req.prompt_tokens))
-    if policy == "production":
+    if policy in ("production", "production_affinity"):
         kwargs = {} if scheduler_cfg is None else {"cfg": scheduler_cfg}
+        # ``production`` is the no-affinity baseline; ``_affinity`` adds the
+        # prefix-cache-aware tie-break (scheduling/prefix_affinity.py) —
+        # the session prefix_id stands in for the chained prompt hashes.
         scheduler = Scheduler(_SimProvider(servers),
-                              rng=pyrandom.Random(seed), **kwargs)
+                              rng=pyrandom.Random(seed),
+                              prefix_aware=(policy == "production_affinity"),
+                              prefix_index=prefix_index,
+                              **kwargs)
 
         def route(req: SimRequest):
             llm_req = LLMRequest(
@@ -142,6 +165,8 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0,
                 resolved_target_model=req.adapter or req.model,
                 critical=req.critical,
                 prompt_tokens=req.prompt_tokens,
+                prefix_hashes=((req.prefix_id + 1,)
+                               if req.prefix_id is not None else ()),
             )
             pod = scheduler.schedule(llm_req)  # may raise SchedulingError
             return by_name[pod.name]
@@ -168,6 +193,10 @@ class SimResult:
     # fewer, faster" must be weighed on one scale.
     tier_hits: dict = field(default_factory=dict)
     tier_totals: dict = field(default_factory=dict)
+    # Prefix-cache outcome (session traffic): replica-side hit counts.
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefix_reused_tokens: int = 0
 
     def goodput(self, tier: str) -> float:
         total = self.tier_totals.get(tier, 0)
@@ -194,6 +223,11 @@ class SimResult:
             "slo_goodput_by_tier": {
                 t: round(self.goodput(t), 4) for t in sorted(self.tier_totals)
             },
+            **({"prefix_hit_rate": round(
+                    self.prefix_hits
+                    / max(1, self.prefix_hits + self.prefix_misses), 4),
+                "prefix_reused_tokens": self.prefix_reused_tokens}
+               if self.prefix_hits + self.prefix_misses else {}),
         }
 
 
@@ -225,7 +259,18 @@ def simulate(
         for i in range(n_servers)
     ]
     loop = EventLoop(servers)
-    router = make_router(base_policy, servers, seed=workload.seed)
+    # One shared affinity index for arrival AND drain routers — the live
+    # wiring (bootstrap injects one shared_prefix_index into both); split
+    # indexes would learn conflicting holders.
+    prefix_index = None
+    if base_policy == "production_affinity":
+        from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+            PrefixIndex,
+        )
+
+        prefix_index = PrefixIndex()
+    router = make_router(base_policy, servers, seed=workload.seed,
+                         prefix_index=prefix_index)
     requests = generate_workload(workload)
     result = SimResult(policy=policy, qps=workload.qps)
 
@@ -235,11 +280,12 @@ def simulate(
     # The drain re-admits against hysteresis-scaled thresholds, exactly as
     # the live AdmissionController does (config.drain_scaled).
     drain_router = router
-    if queued and base_policy == "production":
+    if queued and base_policy in ("production", "production_affinity"):
         drain_router = make_router(
             base_policy, servers, seed=workload.seed,
             scheduler_cfg=drain_scaled(dataclasses.replace(
                 SchedulerConfig(), admission=acfg)),
+            prefix_index=prefix_index,
         )
     parked_at: dict[int, float] = {}
 
@@ -316,6 +362,10 @@ def simulate(
         result.slo_total += 1
         if lpt <= req.slo_s_per_token:
             result.slo_hits += 1
+    for s in servers:
+        result.prefix_hits += s.prefix_hits
+        result.prefix_misses += s.prefix_misses
+        result.prefix_reused_tokens += s.prefix_reused_tokens
     return result
 
 
@@ -328,6 +378,11 @@ def main(argv=None) -> None:
     parser.add_argument("--servers", type=int, default=6)
     parser.add_argument("--duration", type=float, default=120.0)
     parser.add_argument("--latency-model", choices=["v5e", "a100"], default="v5e")
+    parser.add_argument("--session-fraction", type=float, default=0.0,
+                        help="fraction of requests carrying a shared session "
+                             "prefix (enables the prefix-affinity A/B)")
+    parser.add_argument("--sessions", type=int, default=64)
+    parser.add_argument("--prefix-tokens", type=int, default=1024)
     parser.add_argument("--csv", default=None, metavar="PATH",
                         help="also write results as CSV (reference main.py parity)")
     args = parser.parse_args(argv)
@@ -335,7 +390,10 @@ def main(argv=None) -> None:
     rows = []
     for qps in args.qps:
         for policy in args.policies:
-            cfg = WorkloadConfig(qps=qps, duration_s=args.duration)
+            cfg = WorkloadConfig(qps=qps, duration_s=args.duration,
+                                 session_fraction=args.session_fraction,
+                                 n_sessions=args.sessions,
+                                 session_prefix_tokens=args.prefix_tokens)
             result = simulate(policy, cfg, n_servers=args.servers, latency=latency)
             summary = result.summary()
             rows.append(summary)

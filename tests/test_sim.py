@@ -113,3 +113,40 @@ def test_least_latency_policy_prefers_idle_server():
     req = SimRequest(rid=99, arrival_s=0.0, prompt_tokens=200,
                      output_tokens=50, model="base")
     assert router(req) is idle
+
+
+def test_prefix_affinity_raises_hit_rate_without_hurting_slo():
+    """Session workload A/B (sim/ANALYSIS.md round-5 section): the
+    prefix-affinity tie-break must raise the replica-side prefix hit rate
+    over the no-affinity production tree while leaving SLO attainment and
+    completion counts intact (it is a tie-break: balance is untouched)."""
+    from llm_instance_gateway_tpu.sim.run import WorkloadConfig, simulate
+
+    cfg = WorkloadConfig(qps=20.0, duration_s=60.0, session_fraction=0.6,
+                         n_sessions=96, session_prefix_tokens=2048, seed=3)
+    base = simulate("production", cfg, n_servers=3, decode_slots=8)
+    aff = simulate("production_affinity", cfg, n_servers=3, decode_slots=8)
+    assert aff.prefix_hits > base.prefix_hits
+    assert aff.completed == base.completed
+    assert aff.summary()["slo_attainment"] >= (
+        base.summary()["slo_attainment"] - 0.02)
+
+
+def test_prefix_cache_hit_shortens_prefill():
+    from llm_instance_gateway_tpu.sim.core import (
+        SimRequest, SimServer, V5E_DEFAULT)
+
+    s = SimServer("s", V5E_DEFAULT)
+
+    def req(rid):
+        return SimRequest(rid=rid, arrival_s=0.0, prompt_tokens=4096,
+                          output_tokens=1, model="base", prefix_id=7,
+                          prefix_tokens=4000)
+
+    s.prefill_queue.append(req(0))
+    miss = s.step(0.0)  # first visit: full prompt prefills
+    s.prefill_queue.append(req(1))
+    hit = s.step(1.0)   # cached: only the 96-token suffix
+    assert s.prefix_hits == 1 and s.prefix_misses == 1
+    assert hit < miss
+    assert s.prefix_reused_tokens == 4000
