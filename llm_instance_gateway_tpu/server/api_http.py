@@ -821,6 +821,16 @@ def main(argv=None) -> None:
     parser.add_argument("--decode-slots", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=1024)
     parser.add_argument("--max-loras", type=int, default=4)
+    parser.add_argument(
+        "--prefill-buckets", type=int, nargs="+", default=None,
+        metavar="N",
+        help="prefill bucket sizes (compiled-shape set). Default: powers "
+             "of two up to min(--max-seq-len, 1024) — the 1024 cap keeps "
+             "the long-prompt window open on big-context servers, where "
+             "prompts above the largest bucket chunk-stream interleaved "
+             "with decode (one monolithic prefill would freeze every "
+             "active slot's TPOT) or run ONE ring-attention program when "
+             "--mesh has a sequence axis")
     parser.add_argument("--decode-steps", type=int, default=8,
                         help="fused decode steps per host sync (K)")
     parser.add_argument("--prefill-batch", type=int, default=1,
@@ -896,6 +906,12 @@ def main(argv=None) -> None:
 
     if args.paged_kv_blocks is not None and args.paged_kv_block is None:
         parser.error("--paged-kv-blocks requires --paged-kv-block")
+    if args.prefill_buckets and max(args.prefill_buckets) > args.max_seq_len:
+        # A silently-ignored oversized bucket would also inflate _ring_pad
+        # and close the ring window with no diagnostic.
+        parser.error(
+            f"--prefill-buckets {max(args.prefill_buckets)} exceeds "
+            f"--max-seq-len {args.max_seq_len}")
     if args.prefix_cache and args.paged_kv_block is None:
         parser.error("--prefix-cache requires --paged-kv-block")
     if args.speculative > 0 and args.draft_model is None:
@@ -975,6 +991,12 @@ def main(argv=None) -> None:
         cfg, params,
         EngineConfig(
             decode_slots=args.decode_slots, max_seq_len=args.max_seq_len,
+            prefill_buckets=(
+                tuple(sorted(args.prefill_buckets))
+                if args.prefill_buckets else
+                tuple(b for b in (16, 32, 64, 128, 256, 512, 1024)
+                      if b <= args.max_seq_len)
+                or (min(args.max_seq_len, 1024),)),
             decode_steps_per_sync=args.decode_steps,
             pipeline_decode=args.pipeline_decode,
             prefill_batch=args.prefill_batch,

@@ -137,8 +137,10 @@ class EngineConfig:
     # bench's pipelined fast path included), the paged cache
     # (extend_step_paged verify), and GSPMD serve meshes (draft replicated)
     # — including all three together on a tensor/expert mesh
-    # (parity-tested).  paged + a data/sequence mesh is excluded by the
-    # engine's own paged/mesh rule, independent of speculation.
+    # (parity-tested).  paged + a data mesh is excluded by the engine's
+    # own paged/mesh rule, independent of speculation; paged + a sequence
+    # mesh (ring prefill) constructs, with speculation verifying through
+    # extend_step_paged as usual.
     speculative_k: int = 0
     # KV-cache quantization ("int8" or None): K/V stored int8 with
     # per-(position, kv-head) f32 scales, dequantized inside the fused
@@ -478,16 +480,16 @@ class Engine:
                 raise ValueError(
                     "serving meshes must have pipe=1; fold those devices "
                     "into tensor/data instead")
-            if self.paged and (mesh.shape.get("data", 1) > 1
-                               or mesh.shape.get("sequence", 1) > 1):
+            if self.paged and mesh.shape.get("data", 1) > 1:
                 # The block pool belongs to no mesh axis (rows serve
                 # whichever requests the host allocator assigns), so the
-                # batch can't shard over data; ring/sequence prefill is a
-                # lane-cache path.  Tensor/expert-parallel paged serving —
-                # the big-model case — IS supported (paged_cache_specs).
+                # batch can't shard over data.  Tensor/expert-parallel
+                # paged serving — the big-model case — IS supported
+                # (paged_cache_specs), and a sequence axis serves RING
+                # PREFILL (the pool replicates over it; decode ignores it).
                 raise ValueError(
-                    "paged KV on a mesh requires data=1 and sequence=1 "
-                    "(the pool replicates over fsdp and shards kv-heads "
+                    "paged KV on a mesh requires data=1 (the pool "
+                    "replicates over fsdp/sequence and shards kv-heads "
                     "over tensor): scale data-parallel replicas as "
                     "separate engine processes behind the gateway")
             self.params = sharding_lib.shard_pytree(
@@ -506,8 +508,10 @@ class Engine:
         # chunk-streaming through the cache lane — each device holds S/n of
         # the activations, so the prompt budget scales with the mesh.
         self._ring = None
-        if (mesh is not None and mesh.shape.get("sequence", 1) > 1
-                and not self.paged):
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            # Lane AND paged engines: the ring computes sequence-sharded
+            # prompt KV; the insert (lane dynamic-slice or paged block
+            # scatter) reshards it to the cache's own spec under GSPMD.
             from llm_instance_gateway_tpu.parallel import long_context
 
             self._ring = long_context.make_sharded_prefill(model_cfg, mesh)
@@ -1325,11 +1329,20 @@ class Engine:
                     # backpressure): strict FIFO — don't let a newer request
                     # steal the blocks it is waiting for.
                     break
+                n_req = len(req.prompt_tokens)
+                # Ring-path prompts (sequence-parallel prefill) never map
+                # cached prefix blocks — the ring computes the whole prompt
+                # and inserts into FRESH blocks — so their admission gate
+                # must not assume hash-based reuse, or _paged_ensure would
+                # exhaust the pool the gate said was sufficient.
+                takes_ring = (n_req > self._max_bucket()
+                              and self._ring_usable(n_req))
                 if not self._paged_can_admit(
-                        len(req.prompt_tokens), req.prompt_tokens,
+                        n_req, req.prompt_tokens,
                         req.adapter,
                         hashes=(self._prefix_hashes_for(req)
-                                if self._prefix_enabled else None)):
+                                if self._prefix_enabled and not takes_ring
+                                else None)):
                     break  # pool backpressure: wait for block frees
                 if (len(req.prompt_tokens) > self._max_bucket()
                         and not self._ring_usable(len(req.prompt_tokens))):

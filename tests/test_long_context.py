@@ -93,3 +93,59 @@ def test_engine_ring_prefill_serving_path():
         ring.stop()
     assert got.error is None, got.error
     assert got.output_tokens == want
+
+
+def test_engine_ring_prefill_into_paged_pool():
+    """ROADMAP 8 closed: PAGED engines with a sequence mesh axis serve long
+    prompts through ONE ring-attention prefill program too — the
+    sequence-sharded prompt KV scatters into the (sequence-replicated)
+    block pool at insert.  Greedy parity vs the chunk-streaming paged
+    engine; int8 pool composes (the insert quantizes)."""
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request,
+    )
+
+    cfg = TINY_TEST
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    prompt = list(np.random.RandomState(5).randint(1, 250, size=40))
+
+    for quant in (None, "int8"):
+        # Baselines with IDENTICAL numerics to the ring path: bf16 (f32
+        # here) chunk-streaming equals full prefill exactly, but int8
+        # chunk-streaming quantizes chunk-by-chunk (error feeds forward
+        # through later chunks' attention) while ring quantizes ONCE at
+        # insert — so the int8 baseline is a covering-bucket engine, which
+        # shares the full-prefill + quantize-at-insert semantics.
+        buckets = (16,) if quant is None else (64,)
+        chunked = Engine(
+            cfg, params,
+            EngineConfig(decode_slots=2, max_seq_len=64,
+                         prefill_buckets=buckets,
+                         paged_kv_block=8, kv_cache_quant=quant),
+            eos_id=None, dtype=jnp.float32,
+        )
+        chunked.start()
+        try:
+            want = chunked.generate(
+                Request(prompt_tokens=prompt, max_new_tokens=6),
+                timeout_s=240).output_tokens
+        finally:
+            chunked.stop()
+
+        mesh = make_mesh(MeshConfig(data=1, tensor=4, sequence=2))
+        ring = Engine(
+            cfg, params,
+            EngineConfig(decode_slots=2, max_seq_len=64, prefill_buckets=(8, 16),
+                         paged_kv_block=8, kv_cache_quant=quant),
+            eos_id=None, dtype=jnp.float32, mesh=mesh,
+        )
+        assert ring._ring is not None and ring._ring_usable(len(prompt))
+        ring.start()
+        try:
+            got = ring.generate(Request(prompt_tokens=prompt, max_new_tokens=6),
+                                timeout_s=240)
+        finally:
+            ring.stop()
+        assert got.error is None, got.error
+        assert got.output_tokens == want, f"quant={quant}"
