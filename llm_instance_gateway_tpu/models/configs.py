@@ -43,6 +43,9 @@ class ModelConfig:
     embedding_scale: bool = False  # Gemma multiplies embeddings by sqrt(d_model)
     norm_plus_one: bool = False  # Gemma RMSNorm uses (1 + w) weighting
     gelu_mlp: bool = False  # Gemma uses GeLU gating; Llama uses SiLU
+    # Qwen2-family difference: learned biases on the Q/K/V projections
+    # (attention only — o and the MLP stay bias-free).
+    attention_bias: bool = False
     # MoE (Mixtral): 0 experts = dense.
     n_experts: int = 0
     n_experts_per_token: int = 2
@@ -194,5 +197,21 @@ MIXTRAL_8X7B = ModelConfig(
     max_seq_len=32_768,
 )
 
+QWEN2_5_7B = ModelConfig(
+    name="qwen2.5-7b",
+    vocab_size=152_064,
+    d_model=3584,
+    n_layers=28,
+    n_heads=28,
+    n_kv_heads=4,
+    d_ff=18_944,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    norm_eps=1e-6,
+    attention_bias=True,
+    max_seq_len=32_768,
+)
+
 TINY_TEST = LLAMA3_8B.tiny()
 TINY_MOE_TEST = MIXTRAL_8X7B.tiny()
+TINY_QWEN_TEST = QWEN2_5_7B.tiny()

@@ -35,10 +35,10 @@ def config_from_hf(hf_config) -> ModelConfig:
     (our decoder attends the full causal context).
     """
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "gemma", "mixtral"):
+    if model_type not in ("llama", "gemma", "mixtral", "qwen2"):
         raise NotImplementedError(
             f"HF model_type {model_type!r} not supported by the converter "
-            "(llama, gemma, mixtral are)"
+            "(llama, gemma, mixtral, qwen2 are)"
         )
     scaling_kwargs = {}
     rope_scaling = getattr(hf_config, "rope_scaling", None)
@@ -59,11 +59,23 @@ def config_from_hf(hf_config) -> ModelConfig:
         }
     sliding = getattr(hf_config, "sliding_window", None)
     max_pos = getattr(hf_config, "max_position_embeddings", 8192)
-    if sliding and sliding < max_pos:
+    # Qwen2-style configs carry sliding_window but gate it behind
+    # use_sliding_window (default False = full causal attention, which our
+    # decoder matches exactly); only an ACTIVE window is a real divergence.
+    sliding_active = bool(getattr(hf_config, "use_sliding_window", True))
+    if sliding and sliding_active and sliding < max_pos:
         raise NotImplementedError(
             f"sliding_window={sliding} < max_position_embeddings={max_pos}: "
             "our decoder attends the full causal context; converting would "
             "produce divergent long-context logits"
+        )
+    if model_type != "qwen2" and getattr(hf_config, "attention_bias", False):
+        # HF llama-style attention_bias puts biases on q/k/v AND o_proj;
+        # our bias support covers the Qwen2 layout (q/k/v only).  Loud
+        # rejection beats silently dropping the o bias.
+        raise NotImplementedError(
+            f"attention_bias on model_type {model_type!r} is not supported "
+            "(q/k/v/o biases; only the qwen2 q/k/v layout is implemented)"
         )
     gemma = model_type == "gemma"
     return dataclasses.replace(
@@ -92,6 +104,9 @@ def config_from_hf(hf_config) -> ModelConfig:
         n_experts=getattr(hf_config, "num_local_experts", 0)
         if model_type == "mixtral" else 0,
         n_experts_per_token=getattr(hf_config, "num_experts_per_tok", 2),
+        # Qwen2-family: learned Q/K/V biases (parity-tested against
+        # Qwen2ForCausalLM; Qwen2 puts NO bias on o_proj).
+        attention_bias=(model_type == "qwen2"),
         **scaling_kwargs,
     )
 
@@ -129,6 +144,11 @@ def params_from_hf_state_dict(cfg: ModelConfig, state_dict, dtype=jnp.bfloat16):
         "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
     }
+    if cfg.attention_bias:
+        # Qwen2-family Q/K/V biases (1-D, no transpose).
+        layers["wq_b"] = stack_raw("model.layers.{}.self_attn.q_proj.bias")
+        layers["wk_b"] = stack_raw("model.layers.{}.self_attn.k_proj.bias")
+        layers["wv_b"] = stack_raw("model.layers.{}.self_attn.v_proj.bias")
     if cfg.n_experts:
         # Mixtral expert naming: w1=gate, w3=up, w2=down (each [f, d] or
         # [d, f] in HF's [out, in]); stacked here as [L, E, in, out].

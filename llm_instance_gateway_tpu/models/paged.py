@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from llm_instance_gateway_tpu.models import lora as lora_lib
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.models.transformer import (
+    _attn_proj,
     _kv_dequantize,
     _kv_quantize,
     _mlp,
@@ -164,9 +165,9 @@ def decode_step_paged(
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         hd = cfg.resolved_head_dim
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(b, cfg.n_heads, hd)
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(b, cfg.n_kv_heads, hd)
         q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         pools = _pool_update(tuple(pools), k, v, phys_block, offset)
@@ -254,11 +255,11 @@ def extend_step_paged(
         lp, ll, *pools = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
@@ -397,9 +398,9 @@ def prefill_with_cache_paged(
         lp, ll, *pools = xs
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_heads, hd)
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
         pools = _pool_update(tuple(pools), k[0], v[0], phys_block, offset)

@@ -1,0 +1,19 @@
+"""Qwen2-family entry points (Qwen2 / Qwen2.5): Llama-style decoder with
+learned Q/K/V biases (``ModelConfig.attention_bias`` — the one
+architectural delta; RoPE/GQA/RMSNorm/SwiGLU are shared with Llama-3).
+A common switcher family: the reference fronts vLLM, which serves Qwen
+checkpoints; ``models.convert`` imports the HF layout (q_proj.bias etc.).
+"""
+
+from __future__ import annotations
+
+from llm_instance_gateway_tpu.models import transformer
+from llm_instance_gateway_tpu.models.configs import QWEN2_5_7B, TINY_QWEN_TEST
+
+CONFIGS = {"qwen2.5-7b": QWEN2_5_7B, "qwen-tiny": TINY_QWEN_TEST}
+
+init_params = transformer.init_params
+init_decode_cache = transformer.init_decode_cache
+insert_prefill = transformer.insert_prefill
+prefill = transformer.prefill
+decode_step = transformer.decode_step

@@ -56,6 +56,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wv": dense(next(keys), (n_l, d, cfg.n_kv_heads * hd), d),
         "wo": dense(next(keys), (n_l, cfg.n_heads * hd, d), cfg.n_heads * hd),
     }
+    if cfg.attention_bias:
+        # Qwen2-family Q/K/V biases (zero init; checkpoints overwrite).
+        layers["wq_b"] = jnp.zeros((n_l, cfg.n_heads * hd), dtype)
+        layers["wk_b"] = jnp.zeros((n_l, cfg.n_kv_heads * hd), dtype)
+        layers["wv_b"] = jnp.zeros((n_l, cfg.n_kv_heads * hd), dtype)
     if cfg.n_experts:
         e = cfg.n_experts
         layers["router"] = dense(next(keys), (n_l, d, e), d)
@@ -130,6 +135,16 @@ def _project(x, w, layer_lora, target, slot_ids):
             slot_ids,
         )
     return out
+
+
+def _attn_proj(lp, target, x, layer_lora, slot_ids):
+    """Q/K/V projection with the optional attention bias (Qwen2-family:
+    ``attention_bias`` adds learned biases to q/k/v only).  The bias keys
+    exist in the layer params iff the config declares them, so bias-free
+    models trace exactly the code they always did."""
+    out = _project(x, lp[f"w{target}"], layer_lora, target, slot_ids)
+    b = lp.get(f"w{target}_b")
+    return out if b is None else out + b
 
 
 def _mlp(cfg: ModelConfig, lp: Params, x, layer_lora, slot_ids):
@@ -309,9 +324,9 @@ def prefill_layer(
         slot_ids = jnp.full((b,), -1, jnp.int32)
     hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
     hd = cfg.resolved_head_dim
-    q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, s, cfg.n_heads, hd)
-    k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
-    v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+    q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(b, s, cfg.n_heads, hd)
+    k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
+    v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(b, s, cfg.n_kv_heads, hd)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     if attention_fn is not None:
@@ -420,9 +435,9 @@ def decode_step(
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         hd = cfg.resolved_head_dim
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(b, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(b, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(b, cfg.n_heads, hd)
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(b, cfg.n_kv_heads, hd)
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(b, cfg.n_kv_heads, hd)
         q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta, cfg.rope_scaling)[:, 0]
         if quant:
@@ -539,11 +554,11 @@ def extend_step(
             k_scale = v_scale = None
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(
             b, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
@@ -642,9 +657,9 @@ def prefill_with_cache(
             k_scale = v_scale = None
         layer_lora = None if ll is None else {**ll, "scale": lora_bufs["scale"]}
         hn = rms_norm(h, lp["attn_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
-        q = _project(hn, lp["wq"], layer_lora, "q", slot_ids).reshape(1, c, cfg.n_heads, hd)
-        k = _project(hn, lp["wk"], layer_lora, "k", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
-        v = _project(hn, lp["wv"], layer_lora, "v", slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        q = _attn_proj(lp, "q", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_heads, hd)
+        k = _attn_proj(lp, "k", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
+        v = _attn_proj(lp, "v", hn, layer_lora, slot_ids).reshape(1, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_scaling)
         # Scatter the chunk's K/V into the slot's lane at absolute positions.

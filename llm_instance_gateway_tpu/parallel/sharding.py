@@ -37,6 +37,11 @@ def param_specs(cfg: ModelConfig) -> dict[str, Any]:
         # [L, H*hd, D]: row-shard (same tensor axis contracts away).
         "wo": P(None, "tensor", "fsdp"),
     }
+    if cfg.attention_bias:
+        # [L, H*hd] biases shard with their projection's output columns.
+        layers["wq_b"] = P(None, "tensor")
+        layers["wk_b"] = P(None, "tensor")
+        layers["wv_b"] = P(None, "tensor")
     if cfg.n_experts:
         layers.update(
             {

@@ -808,11 +808,11 @@ def main(argv=None) -> None:
 
     from llm_instance_gateway_tpu.models import transformer
     from llm_instance_gateway_tpu.models.configs import ModelConfig
-    from llm_instance_gateway_tpu.models import llama, gemma, mixtral
+    from llm_instance_gateway_tpu.models import llama, gemma, mixtral, qwen
     from llm_instance_gateway_tpu.server.engine import EngineConfig
 
     all_configs: dict[str, ModelConfig] = {}
-    for mod in (llama, gemma, mixtral):
+    for mod in (llama, gemma, mixtral, qwen):
         all_configs.update(mod.CONFIGS)
 
     parser = argparse.ArgumentParser(description="TPU model server")

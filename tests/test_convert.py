@@ -294,3 +294,104 @@ def test_llama2_mha_logits_match_hf():
     ours, *_ = transformer.prefill(cfg, params, tokens, positions)
     ours = np.asarray(ours)[:, :, : model.config.vocab_size]
     np.testing.assert_allclose(hf_logits, ours, rtol=2e-4, atol=2e-4)
+
+
+class TestQwen2Parity:
+    """Qwen2-family: the one architectural delta is learned Q/K/V biases
+    (attention_bias) — numerics certified against Qwen2ForCausalLM."""
+
+    @pytest.fixture(scope="class")
+    def qwen_and_ours(self):
+        cfg = transformers.Qwen2Config(
+            vocab_size=144, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=96, max_position_embeddings=128,
+            rms_norm_eps=1e-6, rope_theta=1_000_000.0,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(6)
+        model = transformers.Qwen2ForCausalLM(cfg)
+        # transformers zero-inits Linear biases: randomize q/k/v biases so
+        # the parity tests actually EXERCISE the bias path (zero biases
+        # would pass even if _attn_proj dropped or sign-flipped them).
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                             layer.self_attn.v_proj):
+                    torch.nn.init.normal_(proj.bias, std=0.5)
+        model.eval()
+        our_cfg, params = from_hf_llama(model, dtype=jnp.float32)
+        return model, our_cfg, params
+
+    def test_bias_config_and_shapes(self, qwen_and_ours):
+        model, cfg, params = qwen_and_ours
+        assert cfg.attention_bias is True
+        assert params["layers"]["wq_b"].shape == (2, 4 * 16)
+        assert params["layers"]["wk_b"].shape == (2, 2 * 16)
+        # The randomized biases actually came through the conversion.
+        assert float(np.abs(np.asarray(params["layers"]["wq_b"])).max()) > 0.01
+
+    def test_logits_match_hf(self, qwen_and_ours):
+        model, cfg, params = qwen_and_ours
+        ids = np.array([[3, 17, 54, 9, 88, 120, 7, 42]], np.int64)
+        with torch.no_grad():
+            hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+        tokens = jnp.asarray(ids, jnp.int32)
+        positions = jnp.arange(ids.shape[1])[None]
+        ours, *_ = transformer.prefill(cfg, params, tokens, positions)
+        ours = np.asarray(ours)[:, :, : model.config.vocab_size]
+        np.testing.assert_allclose(hf_logits, ours, rtol=3e-4, atol=3e-4)
+
+    def test_greedy_continuation_matches_hf(self, qwen_and_ours):
+        model, cfg, params = qwen_and_ours
+        ids = [5, 9, 31]
+        with torch.no_grad():
+            hf_out = model.generate(
+                torch.tensor([ids]), max_new_tokens=6, do_sample=False,
+            )[0, len(ids):].tolist()
+        cache = transformer.init_decode_cache(cfg, 1, 32, dtype=jnp.float32)
+        tokens = jnp.asarray([ids], jnp.int32)
+        positions = jnp.arange(len(ids))[None]
+        logits, k, v = transformer.prefill(cfg, params, tokens, positions)
+        cache = transformer.insert_prefill(cache, k, v, 0, len(ids))
+        cur = int(np.argmax(np.asarray(
+            logits[0, len(ids) - 1, : model.config.vocab_size])))
+        ours = [cur]
+        pos = len(ids)
+        for _ in range(5):
+            lg, cache = transformer.decode_step(
+                cfg, params, cache, jnp.asarray([cur]), jnp.asarray([pos]))
+            cur = int(np.argmax(np.asarray(
+                lg[0, : model.config.vocab_size])))
+            ours.append(cur)
+            pos += 1
+        assert ours == hf_out
+
+
+def test_qwen2_default_config_converts_despite_inactive_sliding_window():
+    """Qwen2Config ships sliding_window=4096 < max_position_embeddings but
+    use_sliding_window=False (full causal attention): must convert."""
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+        max_position_embeddings=32_768, sliding_window=4096,
+        use_sliding_window=False,
+    )
+    ours = config_from_hf(cfg)
+    assert ours.attention_bias is True
+
+
+def test_llama_attention_bias_rejected():
+    """HF llama attention_bias adds an o_proj bias our layout lacks:
+    loud rejection, not silently-dropped bias math."""
+    from llm_instance_gateway_tpu.models.convert import config_from_hf
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, intermediate_size=64,
+        attention_bias=True,
+    )
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        config_from_hf(cfg)

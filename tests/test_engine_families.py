@@ -15,6 +15,7 @@ from llm_instance_gateway_tpu.models.configs import (
     GEMMA_2B,
     LLAMA2_7B,
     MIXTRAL_8X7B,
+    QWEN2_5_7B,
 )
 from llm_instance_gateway_tpu.server.engine import Engine, EngineConfig, Request
 from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
@@ -23,6 +24,7 @@ FAMILIES = {
     "llama2-tiny": LLAMA2_7B.tiny(),  # the reference PoC's model family
     "gemma-tiny": GEMMA_2B.tiny(),
     "mixtral-tiny": MIXTRAL_8X7B.tiny(),
+    "qwen-tiny": QWEN2_5_7B.tiny(),   # attention_bias (Q/K/V biases)
 }
 
 
