@@ -37,6 +37,7 @@ from llm_instance_gateway_tpu.models import lora as lora_lib
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.models.transformer import (
     _attn_proj,
+    _chunk_attend,
     _kv_dequantize,
     _kv_quantize,
     _mlp,
@@ -406,14 +407,9 @@ def prefill_with_cache_paged(
         pools = _pool_update(tuple(pools), k[0], v[0], phys_block, offset)
         lane_k, lane_v = (r[0] for r in
                           _pool_rows(pools, table_row[None], h.dtype))
-        qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
-        logits = jnp.einsum(
-            "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(hd).astype(jnp.float32)
-        mask = jnp.arange(s_max)[None, :] <= positions[:, None]
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-        attn = jnp.einsum("kgij,jkh->ikgh", probs, lane_v).reshape(1, c, -1)
+        # Flash-style chunk attend over the gathered lane view (shared
+        # dispatch incl. the quant gate: transformer._chunk_attend).
+        attn = _chunk_attend(cfg, quant, q, lane_k, lane_v, positions[0])
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)

@@ -27,7 +27,11 @@ import jax.numpy as jnp
 
 from llm_instance_gateway_tpu.models import lora as lora_lib
 from llm_instance_gateway_tpu.models.configs import ModelConfig
-from llm_instance_gateway_tpu.ops.attention import decode_attention, prefill_attention
+from llm_instance_gateway_tpu.ops.attention import (
+    decode_attention,
+    prefill_attention,
+    xla_chunk_attention,
+)
 from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm, swiglu
 from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
 
@@ -145,6 +149,25 @@ def _attn_proj(lp, target, x, layer_lora, slot_ids):
     out = _project(x, lp[f"w{target}"], layer_lora, target, slot_ids)
     b = lp.get(f"w{target}_b")
     return out if b is None else out + b
+
+
+def _chunk_attend(cfg: ModelConfig, quant: bool, q, lane_k, lane_v, start):
+    """Chunk-vs-lane attention dispatch, shared by the lane and paged
+    chunk-stream paths.  Flash-style kernel (auto XLA fallback off-TPU/odd
+    shapes) unless the lane was dequantized from an int8 cache — an opaque
+    kernel can't fuse the dequant into its reads and would materialize a
+    bf16 copy, so quantized lanes keep the fused XLA path (same reasoning
+    as the decode-path quant gate).  Returns [1, C, H*hd]."""
+    c = q.shape[1]
+    if cfg.use_flash_attention and not quant:
+        from llm_instance_gateway_tpu.ops.pallas_attention import (
+            chunk_attention,
+        )
+
+        return chunk_attention(q, lane_k[None], lane_v[None],
+                               start).reshape(1, c, -1)
+    return xla_chunk_attention(q, lane_k[None], lane_v[None],
+                               start).reshape(1, c, -1)
 
 
 def _mlp(cfg: ModelConfig, lp: Params, x, layer_lora, slot_ids):
@@ -686,14 +709,10 @@ def prefill_with_cache(
             lane_k = jax.lax.dynamic_index_in_dim(k_cache, slot, 0, keepdims=False)
             lane_v = jax.lax.dynamic_index_in_dim(v_cache, slot, 0, keepdims=False)
             carry_out = (k_cache, v_cache)
-        qg = q[0].reshape(c, cfg.n_kv_heads, cfg.q_per_kv, hd)
-        logits = jnp.einsum(
-            "ikgh,jkh->kgij", qg, lane_k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(hd).astype(jnp.float32)
-        mask = jnp.arange(s_max)[None, :] <= positions[:, None]  # [C, S]
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-        attn = jnp.einsum("kgij,jkh->ikgh", probs, lane_v).reshape(1, c, -1)
+        # Flash-style chunk attend: no [C, S_max] logits materialize, and
+        # K blocks past the chunk's reach elide their DMAs — bandwidth
+        # tracks the prompt's progress, not S_max (_chunk_attend).
+        attn = _chunk_attend(cfg, quant, q, lane_k, lane_v, positions[0])
         h = h + _project(attn, lp["wo"], layer_lora, "o", slot_ids)
         hn2 = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, plus_one=cfg.norm_plus_one)
         h = h + _mlp(cfg, lp, hn2, layer_lora, slot_ids)

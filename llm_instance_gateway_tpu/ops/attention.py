@@ -19,6 +19,31 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
 
+def xla_chunk_attention(
+    q: jax.Array,        # [B, C, n_heads, hd] — chunk at contiguous positions
+    k_cache: jax.Array,  # [B, S_max, n_kv, hd] — incl. the chunk's own KV
+    v_cache: jax.Array,
+    start,               # scalar int32: global position of chunk token 0
+) -> jax.Array:
+    """Chunk-vs-cache attention reference: chunk token i (global position
+    start+i) attends cache positions <= start+i.  The chunk-stream prefill
+    hot op; ``pallas_attention.chunk_attention`` auto-dispatches between
+    this and the flash-style kernel.  Returns [B, C, n_heads, hd]."""
+    b, c, n_heads, hd = q.shape
+    s_max, n_kv = k_cache.shape[1], k_cache.shape[2]
+    g = n_heads // n_kv
+    qg = q.reshape(b, c, n_kv, g, hd)
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    logits = jnp.einsum("bikgh,bjkh->bkgij", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.asarray(start, jnp.int32) + jnp.arange(c)
+    mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]  # [C, S]
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgij,bjkh->bikgh", probs, v_cache)
+    return out.reshape(b, c, n_heads, hd)
+
+
 def gather_pool_rows(pool: jax.Array, tables: jax.Array) -> jax.Array:
     """Paged-pool gather: ``[n_blocks+1, P, ...] x [B, M] -> [B, M*P, ...]``
     — each table row's physical blocks concatenated into the contiguous

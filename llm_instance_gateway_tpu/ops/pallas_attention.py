@@ -40,6 +40,42 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
+def _softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                   q_start, k_start, masked: bool, scale: float):
+    """One K/V tile of the online-softmax recurrence — the numerically
+    sensitive core shared by the self-attention flash kernel (static
+    q_start) and the chunk-attend kernel (dynamic, offset q_start)."""
+    def go():
+        bq = q_ref.shape[2]
+        block_k = k_ref.shape[2]
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if masked:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * corr + p.sum(axis=-1, keepdims=True), l_scr.shape)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    return go
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                   causal: bool, scale: float):
     # Blocks keep their leading (batch, head) unit dims:
@@ -60,33 +96,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute(masked: bool):
-        def go():
-            q = q_ref[0, 0].astype(jnp.float32) * scale
-            k = k_ref[0, 0].astype(jnp.float32)
-            v = v_ref[0, 0].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [BQ, BK]
-            if masked:
-                q_pos = q_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, block_k), 0)
-                k_pos = k_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-            m_prev = m_scr[:, :1]
-            l_prev = l_scr[:, :1]
-            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            corr = jnp.exp(m_prev - m_new)
-            l_scr[...] = jnp.broadcast_to(
-                l_prev * corr + p.sum(axis=-1, keepdims=True), l_scr.shape)
-            acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        return go
+        return _softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                              q_start, k_start, masked, scale)
 
     if causal:
         # Exactly one branch runs per step: the diagonal-straddling block
@@ -168,6 +179,128 @@ def flash_attention_bhsd(
 def supports(s: int, hd: int, block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> bool:
     """Shape gate for the kernel path (pad upstream or fall back)."""
     return s % block_q == 0 and s % block_k == 0 and hd % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# Chunk attend: a query chunk at a dynamic position offset vs the KV cache
+# ---------------------------------------------------------------------------
+
+
+def _chunk_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float):
+    # Same online-softmax core as _flash_kernel (shared _softmax_block)
+    # with ONE difference: query positions are offset by the chunk's
+    # dynamic start (off_ref, SMEM) — chunk token i sits at global
+    # position off + q_start + i and attends cache positions <= it.
+    # K blocks wholly above the chunk's last position skip compute
+    # (their DMA is elided by the index-map clamp).
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    n_kblocks = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    q_start = off_ref[0] + qi * bq
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute(masked: bool):
+        return _softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                              q_start, k_start, masked, scale)
+
+    # Dynamic diagonal (off is a runtime value): exactly one branch fires.
+    on_diagonal = (k_start + block_k > q_start) & (k_start < q_start + bq)
+    pl.when(on_diagonal)(_compute(masked=True))
+    pl.when(k_start + block_k <= q_start)(_compute(masked=False))
+
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def chunk_attention_pallas(
+    q: jax.Array,        # [B, C, H, hd] — chunk queries (contiguous positions)
+    k_cache: jax.Array,  # [B, S, K, hd] — lane view incl. the chunk's KV
+    v_cache: jax.Array,
+    start: jax.Array,    # scalar int32: global position of chunk token 0
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-style chunk attend: chunk token i (global position start+i)
+    attends cache positions <= start+i.  Replaces the XLA einsum's [C, S]
+    logits materialization on the chunk-stream path — the long-context
+    TTFT hot loop — with O(block) VMEM tiles; K blocks past each query
+    tile's reach are clamped to the last contributing tile so their HBM
+    copies are elided (bandwidth tracks the chunk's position, not S_max)."""
+    b, c, h, hd = q.shape
+    s_max = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    g = h // n_kv
+    scale = float(1.0 / (hd ** 0.5))
+    qt = q.transpose(0, 2, 1, 3)          # [B, H, C, hd]
+    kt = k_cache.transpose(0, 2, 1, 3)    # [B, K, S, hd]
+    vt = v_cache.transpose(0, 2, 1, 3)
+    off = jnp.asarray(start, jnp.int32).reshape(1)
+
+    def kv_index(bi, hi, qi, kb, off, g=g):
+        last = (off[0] + qi * block_q + block_q - 1) // block_k
+        return (bi, hi // g, jnp.minimum(kb, last), 0)
+
+    out = pl.pallas_call(
+        functools.partial(_chunk_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,  # chunk start: masking + DMA clamping
+            grid=(b, h, c // block_q, s_max // block_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, hd),
+                             lambda bi, hi, qi, kb, off: (bi, hi, qi, 0)),
+                pl.BlockSpec((1, 1, block_k, hd), kv_index),
+                pl.BlockSpec((1, 1, block_k, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                                   lambda bi, hi, qi, kb, off: (bi, hi, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-padded)
+                pltpu.VMEM((block_q, 128), jnp.float32),  # l
+                pltpu.VMEM((block_q, hd), jnp.float32),   # o accumulator
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(off, qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def supports_chunk(c: int, s_max: int, hd: int) -> bool:
+    return c % BLOCK_Q == 0 and s_max % BLOCK_K == 0 and hd % 128 == 0
+
+
+def chunk_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, start,
+    interpret: bool = False,
+) -> jax.Array:
+    """Auto-dispatch for the chunk attend; XLA reference otherwise."""
+    from llm_instance_gateway_tpu.ops.attention import xla_chunk_attention
+
+    b, c, h, hd = q.shape
+    if not supports_chunk(c, k_cache.shape[1], hd) or (
+        not interpret
+        and jax.default_backend() not in pallas_decode_attention.TPU_BACKENDS
+    ):
+        return xla_chunk_attention(q, k_cache, v_cache, start)
+    return chunk_attention_pallas(q, k_cache, v_cache, start,
+                                  interpret=interpret)
 
 
 def flash_attention(

@@ -177,6 +177,31 @@ def check_paged_decode(b=8, h=32, n_kv=8, hd=128, block=64, m=32,
     return err, t_ref, t_ker
 
 
+def check_chunk(c=512, s_max=8192, h=32, n_kv=8, hd=128, start=4096,
+                dtype=jnp.bfloat16):
+    """Chunk-stream attend: flash-style kernel vs the XLA reference's
+    [C, S_max] logits materialization — the long-context TTFT hot op."""
+    from llm_instance_gateway_tpu.ops.attention import xla_chunk_attention
+    from llm_instance_gateway_tpu.ops.pallas_attention import (
+        chunk_attention_pallas,
+    )
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (1, c, h, hd), dtype)
+    kc = jax.random.normal(ks[1], (1, s_max, n_kv, hd), dtype)
+    vc = jax.random.normal(ks[2], (1, s_max, n_kv, hd), dtype)
+    off = jnp.int32(start)
+    ref_fn = jax.jit(xla_chunk_attention)
+    ker_fn = jax.jit(chunk_attention_pallas)
+    ref, t_ref = _time(ref_fn, q, kc, vc, off, iters=20)
+    out, t_ker = _time(ker_fn, q, kc, vc, off, iters=20)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"chunk-attend c={c} smax={s_max} start={start} h={h}: "
+          f"max_err={err:.4f} xla={t_ref:.3f}ms pallas={t_ker:.3f}ms "
+          f"speedup={t_ref / t_ker:.2f}x")
+    return err, t_ref, t_ker
+
+
 if __name__ == "__main__":
     print("devices:", jax.devices())
     for s in (512, 2048, 8192):
@@ -188,3 +213,5 @@ if __name__ == "__main__":
     for quant in (False, True):
         for m in (16, 64):
             check_paged_decode(m=m, quant=quant)
+    for start in (0, 4096):
+        check_chunk(start=start)
