@@ -20,6 +20,12 @@ import grpc
 from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
 from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
 from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    DEFAULT_TARGET_POD_HEADER,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+    PREFIX_BLOCK_CHARS,
+)
 from llm_instance_gateway_tpu.gateway.testing import (
     fake_metrics,
     fake_pod,
@@ -33,7 +39,8 @@ def model_name(i: int) -> str:  # benchmark.go:71-73
     return f"adapter-{i}"
 
 
-def build_fixture(num_fake_pods: int, num_models_per_pod: int):
+def build_fixture(num_fake_pods: int, num_models_per_pod: int,
+                  with_base_model: bool = False):
     """benchmark.go:75-106: pod i serves adapters i*M..i*M+M-1."""
     pods = {}
     models = []
@@ -49,7 +56,20 @@ def build_fixture(num_fake_pods: int, num_models_per_pod: int):
         )
     for i in range(total):
         models.append(make_model(model_name(i), Criticality.CRITICAL))
+    if with_base_model:
+        # A shared base model with NO adapter: session-prefix traffic
+        # routes through it so the prefix tie-break is the only stickiness
+        # source (adapter traffic is already pod-pinned by LoRA affinity).
+        # Session mode only — the recorded baseline fixture stays 1000.
+        models.append(make_model("shared-base", Criticality.CRITICAL))
     return pods, models
+
+
+def session_prompt(sid: int, k: int, prefix_chars: int) -> str:
+    """A prompt whose leading ``prefix_chars`` are identical for every
+    request of session ``sid`` (multi-turn / per-tenant template traffic),
+    followed by a per-request suffix."""
+    return (f"{sid:04d}" * (prefix_chars // 4 + 1))[:prefix_chars] + f" q{k}"
 
 
 def run_load(
@@ -59,13 +79,25 @@ def run_load(
     port: int = 19102,
     streams: int = 8,
     use_native: bool = False,
+    session_prefix_chars: int = 0,
+    session_count: int = 64,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
     ``use_native`` swaps the Python filter tree for the C++ scheduler hot
     path (``scheduling/native.py``) — the A/B the recorded results compare.
-    """
-    pods, models = build_fixture(num_fake_pods, num_models_per_pod)
+    ``session_prefix_chars`` > 0 switches to session traffic: every request
+    carries one of ``session_count`` shared prompt prefixes, measuring the
+    prefix-affinity path's hot-loop cost (hashing rides the pick) and its
+    stickiness (distinct pods per session; 1.0 = every repeat landed on
+    the session's replica)."""
+    if session_prefix_chars and session_prefix_chars < PREFIX_BLOCK_CHARS:
+        raise ValueError(
+            f"session_prefix_chars must be >= {PREFIX_BLOCK_CHARS} (the "
+            "affinity hash covers whole blocks only; a shorter prefix "
+            "would measure a no-op)")
+    pods, models = build_fixture(num_fake_pods, num_models_per_pod,
+                                 with_base_model=bool(session_prefix_chars))
     factory = None
     if use_native:
         from llm_instance_gateway_tpu.gateway.scheduling.native import (
@@ -84,23 +116,37 @@ def run_load(
         t_start = time.perf_counter()
         # Round-robin model names (benchmark.go:64-69), batched into streams.
         sent = 0
+        session_pods: dict[int, set[str]] = {}
+
+        def body_for(i: int) -> tuple[bytes, int | None]:
+            if session_prefix_chars:
+                sid = i % session_count
+                return generate_request(
+                    "shared-base",
+                    prompt=session_prompt(sid, i, session_prefix_chars)), sid
+            return generate_request(model_name(i % total_models)), None
+
         while sent < requests:
             batch = min(requests - sent, max(1, requests // streams))
+            bodies = [body_for(sent + k) for k in range(batch)]
             msgs = [
-                pb.ProcessingRequest(
-                    request_body=pb.HttpBody(
-                        body=generate_request(model_name((sent + k) % total_models))
-                    )
-                )
-                for k in range(batch)
+                pb.ProcessingRequest(request_body=pb.HttpBody(body=body))
+                for body, _ in bodies
             ]
             t0 = time.perf_counter()
             # One stream per batch: measures per-message processing inline.
-            for resp in stub(iter(msgs)):
+            for k, resp in enumerate(stub(iter(msgs))):
                 t1 = time.perf_counter()
                 latencies.append(t1 - t0)
                 t0 = t1
                 assert resp.WhichOneof("response") == "request_body"
+                sid = bodies[k][1]
+                if sid is not None:
+                    for h in (resp.request_body.response
+                              .header_mutation.set_headers):
+                        if h.header.key == DEFAULT_TARGET_POD_HEADER:
+                            session_pods.setdefault(sid, set()).add(
+                                h.header.raw_value or h.header.value)
             sent += batch
         wall = time.perf_counter() - t_start
         channel.close()
@@ -112,7 +158,7 @@ def run_load(
     def pct(p: float) -> float:
         return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
 
-    return {
+    out = {
         "requests": requests,
         "num_fake_pods": num_fake_pods,
         "num_models": total_models,
@@ -121,6 +167,17 @@ def run_load(
         "p50_us": round(pct(0.5) * 1e6, 1),
         "p99_us": round(pct(0.99) * 1e6, 1),
     }
+    if session_prefix_chars:
+        if not session_pods:
+            raise RuntimeError(
+                "session mode matched no target-pod headers — the "
+                "measurement is broken, not perfectly sticky")
+        per = [len(p) for p in session_pods.values()]
+        out["sessions"] = len(per)
+        out["session_prefix_chars"] = session_prefix_chars
+        # 1.0 = perfect stickiness; N = the session sprayed over N pods.
+        out["distinct_pods_per_session_avg"] = round(sum(per) / len(per), 2)
+    return out
 
 
 def main(argv=None):
@@ -131,9 +188,16 @@ def main(argv=None):
     parser.add_argument("--native", action="store_true",
                         help="C++ scheduler hot path instead of the Python "
                              "filter tree")
+    parser.add_argument("--session-prefix-chars", type=int, default=0,
+                        help="session traffic: shared prompt prefixes of "
+                             "this many chars (measures prefix-affinity "
+                             "cost + stickiness)")
+    parser.add_argument("--sessions", type=int, default=64)
     args = parser.parse_args(argv)
     summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
-                       use_native=args.native)
+                       use_native=args.native,
+                       session_prefix_chars=args.session_prefix_chars,
+                       session_count=args.sessions)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 
