@@ -4,7 +4,9 @@ The surface the gateway (and the LoRA sidecar) expects from a pool replica —
 the union of what vLLM exposed to the reference:
 
 - ``POST /v1/completions``        OpenAI completions (prompt string or token ids)
-- ``POST /v1/chat/completions``   chat shim (concatenates message contents)
+- ``POST /v1/chat/completions``   chat (checkpoint tokenizer's own chat
+                                  template when it ships one, else a
+                                  role-prefix transcript)
 - ``GET  /v1/models``             base model + resident adapters (sidecar diff
                                   source, ``sidecar.py:140-155``)
 - ``POST /v1/load_lora_adapter``  ``{"lora_name": ..., "lora_path": ...}``
@@ -303,6 +305,21 @@ class ModelServer:
                 ][:top_n],
             })
         return {"content": content}
+
+    def _chat_prompt(self, messages: list) -> str:
+        """Chat messages -> prompt text.  A checkpoint tokenizer's own chat
+        template wins (HFTokenizer.apply_chat_template — the format the
+        model was TRAINED on); tokenizers without one get the plain
+        role-prefix transcript."""
+        apply = getattr(self.tokenizer, "apply_chat_template", None)
+        if apply is not None:
+            templated = apply(messages)
+            if templated is not None:
+                return templated
+        return "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in messages
+        ) + "\nassistant:"
 
     @staticmethod
     def _parse_chat_logprobs(body: dict) -> tuple[bool, int]:
@@ -648,9 +665,7 @@ class ModelServer:
         except json.JSONDecodeError:
             return _err(400, "invalid JSON body")
         messages = body.get("messages", [])
-        prompt = "\n".join(
-            f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
-        ) + "\nassistant:"
+        prompt = self._chat_prompt(messages)
         try:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:

@@ -60,6 +60,18 @@ class HFTokenizer:
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def apply_chat_template(self, messages: list[dict]) -> str | None:
+        """The model's own chat template rendered over OpenAI-shaped
+        messages (with the generation prompt appended), or None when the
+        checkpoint's tokenizer ships no template — the server then falls
+        back to the plain role-prefix transcript.  Matches vLLM's
+        behavior: serving a chat model with its trained template is a
+        correctness issue, not cosmetics."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True)
+
 
 def load_tokenizer(path: str | None = None):
     return HFTokenizer(path) if path else ByteTokenizer()

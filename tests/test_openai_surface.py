@@ -321,3 +321,65 @@ class TestChatLogprobs:
         comp = model_server._logprobs_json(req, k=1)
         assert "".join(comp["tokens"]) == "a😀b"
         assert comp["text_offset"] == [0, 1, 1, 1, 1, 2]
+
+
+class TestChatTemplate:
+    """Chat prompts render through the checkpoint tokenizer's OWN chat
+    template when it ships one (the format the model was trained on);
+    template-less tokenizers keep the role-prefix transcript."""
+
+    def _hf_tokenizer_dir(self, tmp_path, template):
+        transformers = pytest.importorskip("transformers")
+        from tokenizers import Tokenizer, models
+
+        vocab = {chr(i): i - 32 for i in range(32, 127)}
+        vocab |= {"<s>": 95, "</s>": 96, "<unk>": 97}
+        tok = Tokenizer(models.BPE(vocab=vocab, merges=[],
+                                   unk_token="<unk>"))
+        fast = transformers.PreTrainedTokenizerFast(
+            tokenizer_object=tok, bos_token="<s>", eos_token="</s>",
+            unk_token="<unk>")
+        if template:
+            fast.chat_template = template
+        d = str(tmp_path / ("tmpl" if template else "plain"))
+        fast.save_pretrained(d)
+        return d
+
+    def test_template_applied(self, tmp_path):
+        from llm_instance_gateway_tpu.server.tokenizer import HFTokenizer
+
+        d = self._hf_tokenizer_dir(
+            tmp_path,
+            "{% for m in messages %}<{{ m.role }}>{{ m.content }}"
+            "{% endfor %}{% if add_generation_prompt %}<assistant>"
+            "{% endif %}")
+        tok = HFTokenizer(d)
+        msgs = [{"role": "system", "content": "be terse"},
+                {"role": "user", "content": "hi"}]
+        assert tok.apply_chat_template(msgs) == (
+            "<system>be terse<user>hi<assistant>")
+
+    def test_no_template_falls_back(self, tmp_path, model_server):
+        from llm_instance_gateway_tpu.server.tokenizer import HFTokenizer
+
+        d = self._hf_tokenizer_dir(tmp_path, None)
+        tok = HFTokenizer(d)
+        assert tok.apply_chat_template([{"role": "user", "content": "x"}]) \
+            is None
+        # ByteTokenizer (the running server's) has no method at all:
+        # _chat_prompt falls back to the role-prefix transcript.
+        prompt = model_server._chat_prompt(
+            [{"role": "user", "content": "hello"}])
+        assert prompt == "user: hello\nassistant:"
+
+    def test_server_uses_template(self, tmp_path):
+        from llm_instance_gateway_tpu.server.api_http import ModelServer
+        from llm_instance_gateway_tpu.server.tokenizer import HFTokenizer
+
+        d = self._hf_tokenizer_dir(
+            tmp_path, "{% for m in messages %}[{{ m.content }}]"
+                      "{% endfor %}")
+        server = ModelServer(engine=None, tokenizer=HFTokenizer(d),
+                             model_name="m")
+        assert server._chat_prompt(
+            [{"role": "user", "content": "q"}]) == "[q]"
