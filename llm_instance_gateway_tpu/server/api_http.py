@@ -306,20 +306,31 @@ class ModelServer:
             })
         return {"content": content}
 
-    def _chat_prompt(self, messages: list) -> str:
-        """Chat messages -> prompt text.  A checkpoint tokenizer's own chat
-        template wins (HFTokenizer.apply_chat_template — the format the
-        model was TRAINED on); tokenizers without one get the plain
-        role-prefix transcript."""
+    def _chat_prompt(self, messages: list) -> tuple[str, bool]:
+        """Chat messages -> (prompt text, add_bos).  A checkpoint
+        tokenizer's own chat template wins (HFTokenizer.apply_chat_template
+        — the format the model was TRAINED on); tokenizers without one get
+        the plain role-prefix transcript.  Templated prompts encode with
+        add_bos=False: most real templates render the BOS token as text,
+        and prepending another would feed [BOS, BOS, ...] — a stream the
+        model never trained on.  Template failures (role restrictions,
+        strict alternation, non-string content) raise ValueError so the
+        handler returns a 400, not a 500 — the request was valid OpenAI but
+        invalid for THIS model's template, a client-fixable condition."""
         apply = getattr(self.tokenizer, "apply_chat_template", None)
         if apply is not None:
-            templated = apply(messages)
+            try:
+                templated = apply(messages)
+            except Exception as e:  # jinja TemplateError, TypeError, ...
+                raise ValueError(
+                    f"messages are not renderable by this model's chat "
+                    f"template: {e}") from e
             if templated is not None:
-                return templated
+                return templated, False
         return "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}"
             for m in messages
-        ) + "\nassistant:"
+        ) + "\nassistant:", True
 
     @staticmethod
     def _parse_chat_logprobs(body: dict) -> tuple[bool, int]:
@@ -665,17 +676,17 @@ class ModelServer:
         except json.JSONDecodeError:
             return _err(400, "invalid JSON body")
         messages = body.get("messages", [])
-        prompt = self._chat_prompt(messages)
         try:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
             return _err(404, str(e))
         try:
+            prompt, add_bos = self._chat_prompt(messages)
             n, best_of, _, stops = self._parse_choice_params(body)
             lp_flag, top_n = self._parse_chat_logprobs(body)
         except (ValueError, TypeError) as e:
             return _err(400, str(e))
-        prompt_tokens = self.tokenizer.encode(prompt)
+        prompt_tokens = self.tokenizer.encode(prompt, add_bos=add_bos)
         if body.get("stream"):
             if n > 1 or best_of > 1:
                 return _err(400, "streaming supports n=1 / best_of=1")

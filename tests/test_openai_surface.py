@@ -368,9 +368,9 @@ class TestChatTemplate:
             is None
         # ByteTokenizer (the running server's) has no method at all:
         # _chat_prompt falls back to the role-prefix transcript.
-        prompt = model_server._chat_prompt(
+        prompt, add_bos = model_server._chat_prompt(
             [{"role": "user", "content": "hello"}])
-        assert prompt == "user: hello\nassistant:"
+        assert prompt == "user: hello\nassistant:" and add_bos is True
 
     def test_server_uses_template(self, tmp_path):
         from llm_instance_gateway_tpu.server.api_http import ModelServer
@@ -382,4 +382,18 @@ class TestChatTemplate:
         server = ModelServer(engine=None, tokenizer=HFTokenizer(d),
                              model_name="m")
         assert server._chat_prompt(
-            [{"role": "user", "content": "q"}]) == "[q]"
+            [{"role": "user", "content": "q"}]) == ("[q]", False)
+
+    def test_template_error_maps_to_400(self, tmp_path):
+        from llm_instance_gateway_tpu.server.api_http import ModelServer
+        from llm_instance_gateway_tpu.server.tokenizer import HFTokenizer
+
+        d = self._hf_tokenizer_dir(
+            tmp_path, "{% for m in messages %}"
+                      "{% if m.role == 'system' %}"
+                      "{{ raise_exception('no system role') }}{% endif %}"
+                      "{{ m.content }}{% endfor %}")
+        server = ModelServer(engine=None, tokenizer=HFTokenizer(d),
+                             model_name="m")
+        with pytest.raises(ValueError, match="chat template"):
+            server._chat_prompt([{"role": "system", "content": "x"}])
