@@ -52,13 +52,6 @@ def prompt_text(body: dict) -> str:
     return ""
 
 
-def estimate_prompt_tokens(body: dict) -> int:
-    """Cheap prompt-size hint for token-aware routing.
-
-    ~4 chars/token is the standard rough estimate; precision doesn't matter —
-    the headroom filter is advisory and only needs order-of-magnitude.
-    """
-    return len(prompt_text(body)) // 4
 
 
 def handle_request_headers(req_ctx, msg: RequestHeaders) -> ProcessingResult:

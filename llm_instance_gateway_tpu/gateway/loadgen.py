@@ -161,7 +161,7 @@ def run_load(
     out = {
         "requests": requests,
         "num_fake_pods": num_fake_pods,
-        "num_models": total_models,
+        "num_models": len(models),
         "wall_s": round(wall, 3),
         "rps": round(requests / wall, 1),
         "p50_us": round(pct(0.5) * 1e6, 1),

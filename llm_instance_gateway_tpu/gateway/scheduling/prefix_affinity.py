@@ -29,7 +29,7 @@ out and the pick lands elsewhere — serialized one-at-a-time probes
 therefore alternate rather than stick.  Two consequences, both fine:
 hot SHARED prefixes replicate to every healthy replica within a few
 requests (each then serves them as cache hits —
-``gateway_pool_prefix_reused_tokens`` climbs pool-wide, the desirable
+``gateway_pool_prefix_reused_tokens_total`` climbs pool-wide, the desirable
 steady state for system prompts); and affinity binds strongest exactly
 where it matters — steady concurrent load, where every replica carries
 nonzero usage and small deltas stay inside the bucket, and long

@@ -130,19 +130,18 @@ class GatewayMetrics:
         if pool_signals is not None:
             # Outside the lock: the provider snapshot is its own O(pods)
             # copy, and render must not hold our lock across foreign code.
+            # Counter TYPE + _total name: the source (tpu:prefix_reused_
+            # tokens) is cumulative, so rate()/increase() must see counter
+            # semantics; aggregate with sum() over the pod label.
             rows = []
-            total = 0
             for pm in pool_signals():
                 n = getattr(pm.metrics, "prefix_reused_tokens", 0)
-                total += n
                 rows.append(
-                    f'gateway_pool_prefix_reused_tokens{{pod="{pm.pod.name}"}}'
-                    f" {n}")
-            lines.append("# TYPE gateway_pool_prefix_reused_tokens gauge")
-            lines += rows
+                    "gateway_pool_prefix_reused_tokens_total"
+                    f'{{pod="{pm.pod.name}"}} {n}')
             lines.append(
-                "# TYPE gateway_pool_prefix_reused_tokens_sum gauge")
-            lines.append(f"gateway_pool_prefix_reused_tokens_sum {total}")
+                "# TYPE gateway_pool_prefix_reused_tokens_total counter")
+            lines += rows
         return "\n".join(lines) + "\n"
 
 

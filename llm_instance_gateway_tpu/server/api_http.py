@@ -232,7 +232,8 @@ class ModelServer:
         req.finish_reason = "stop"
         return full[:idx], True
 
-    def _per_token_records(self, req: Request, k: int):
+    def _per_token_records(self, req: Request, k: int,
+                           text_limit: int | None = None):
         """Per-generated-token ``(piece, logprob, deduped_tops)`` rows — the
         ONE walk both logprobs envelopes (completions and chat) build from.
 
@@ -242,17 +243,26 @@ class ModelServer:
         — so the pieces' concatenation equals the full decode exactly,
         instead of leaking U+FFFD for characters that decode fine in
         ``message.content``/``text``.  ``deduped_tops`` keeps the most
-        probable id per surface string (byte-fallback ids can collide)."""
+        probable id per surface string (byte-fallback ids can collide).
+
+        ``text_limit`` clips the walk to the RETURNED text (stop-sequence
+        truncation is character-granular while the token records are
+        token-granular: the kept token completing a stop would otherwise
+        leak the stop's tail into the envelope, OpenAI trims it)."""
         rows = []
         committed = ""
         n = len(req.output_tokens)
         for i in range(n):
+            if text_limit is not None and len(committed) >= text_limit:
+                break
             cur = self.tokenizer.decode(req.output_tokens[: i + 1])
             if i + 1 < n:
                 # Trailing replacement chars may be a partial multi-byte
                 # sequence the next token completes: hold them back.
                 cur = cur.rstrip("�")
             piece = cur[len(committed):]
+            if text_limit is not None:
+                piece = piece[: max(0, text_limit - len(committed))]
             committed += piece
             lp = (req.output_logprobs[i]
                   if i < len(req.output_logprobs) else None)
@@ -266,12 +276,13 @@ class ModelServer:
             rows.append((piece, None if lp is None else max(lp, -1e9), tops))
         return rows
 
-    def _logprobs_json(self, req: Request, k: int) -> dict:
+    def _logprobs_json(self, req: Request, k: int,
+                       text_limit: int | None = None) -> dict:
         """OpenAI completions ``logprobs`` object (tokens / token_logprobs /
         top_logprobs / text_offset)."""
         tokens, token_lps, tops, offsets = [], [], [], []
         offset = 0
-        for piece, lp, top in self._per_token_records(req, k):
+        for piece, lp, top in self._per_token_records(req, k, text_limit):
             offsets.append(offset)
             offset += len(piece)
             tokens.append(piece)
@@ -285,7 +296,8 @@ class ModelServer:
             "text_offset": offsets,
         }
 
-    def _chat_logprobs_json(self, req: Request, top_n: int) -> dict:
+    def _chat_logprobs_json(self, req: Request, top_n: int,
+                            text_limit: int | None = None) -> dict:
         """OpenAI CHAT ``logprobs`` object — ``choices[].logprobs.content[]``
         entries with token / logprob / bytes / top_logprobs (the chat form:
         per-token objects with UTF-8 byte arrays, no text_offset — distinct
@@ -293,7 +305,7 @@ class ModelServer:
         form uses).  ``bytes`` carries the attributed piece's UTF-8, so the
         concatenation of all bytes arrays equals the content's encoding."""
         content = []
-        for piece, lp, top in self._per_token_records(req, top_n):
+        for piece, lp, top in self._per_token_records(req, top_n, text_limit):
             content.append({
                 "token": piece,
                 "logprob": lp,
@@ -654,7 +666,8 @@ class ModelServer:
                 "finish_reason": r.finish_reason,
             }
             if logprobs is not None:
-                choice["logprobs"] = self._logprobs_json(r, logprobs)
+                choice["logprobs"] = self._logprobs_json(
+                    r, logprobs, text_limit=len(texts[id(r)]))
             choices.append(choice)
         return web.json_response({
             "id": f"cmpl-{reqs[0].request_id}",
@@ -728,7 +741,8 @@ class ModelServer:
                 "finish_reason": r.finish_reason,
             }
             if lp_flag:
-                choice["logprobs"] = self._chat_logprobs_json(r, top_n)
+                choice["logprobs"] = self._chat_logprobs_json(
+                    r, top_n, text_limit=len(text))
             choices.append(choice)
         completion_tokens = sum(len(r.output_tokens) for r in reqs)
         return web.json_response({

@@ -397,3 +397,21 @@ class TestChatTemplate:
                              model_name="m")
         with pytest.raises(ValueError, match="chat template"):
             server._chat_prompt([{"role": "system", "content": "x"}])
+
+
+def test_chat_logprobs_truncate_at_stop(model_server):
+    """Stop truncation is character-granular; the logprobs envelope must
+    clip to the RETURNED text, not leak the stop's tail from the kept
+    token that completed it (OpenAI trims at the stop)."""
+    _, d0 = post(model_server, "/v1/chat/completions", {
+        "model": "llama3-tiny", "max_tokens": 16,
+        "messages": [{"role": "user", "content": "hi"}]})
+    full = d0["choices"][0]["message"]["content"]
+    stop = full[len(full) // 2:len(full) // 2 + 2]
+    _, d = post(model_server, "/v1/chat/completions", {
+        "model": "llama3-tiny", "max_tokens": 16, "logprobs": True,
+        "stop": [stop], "messages": [{"role": "user", "content": "hi"}]})
+    content = d["choices"][0]["message"]["content"]
+    pieces = "".join(e["token"] for e in d["choices"][0]["logprobs"]["content"])
+    assert pieces == content
+    assert stop not in pieces

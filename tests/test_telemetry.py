@@ -49,9 +49,12 @@ class TestRender:
         ]
         gm.pool_signals_fn = lambda: pods
         text = gm.render()
-        assert 'gateway_pool_prefix_reused_tokens{pod="pod-a"} 128' in text
-        assert 'gateway_pool_prefix_reused_tokens{pod="pod-b"} 64' in text
-        assert "gateway_pool_prefix_reused_tokens_sum 192" in text
+        assert ('gateway_pool_prefix_reused_tokens_total{pod="pod-a"} 128'
+                in text)
+        assert ('gateway_pool_prefix_reused_tokens_total{pod="pod-b"} 64'
+                in text)
+        assert ("# TYPE gateway_pool_prefix_reused_tokens_total counter"
+                in text)
 
     def test_render_under_concurrent_mutation(self):
         """render() must stay well-formed while another thread records."""
