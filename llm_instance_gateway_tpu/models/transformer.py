@@ -33,7 +33,12 @@ from llm_instance_gateway_tpu.ops.attention import (
     xla_chunk_attention,
 )
 from llm_instance_gateway_tpu.ops.layers import apply_rope, rms_norm, swiglu
-from llm_instance_gateway_tpu.ops.quant import matmul as q_matmul
+from llm_instance_gateway_tpu.ops.quant import (
+    expert_matmul,
+    expert_mix,
+    expert_mix_down,
+    matmul as q_matmul,
+)
 
 Params = dict[str, Any]
 
@@ -252,10 +257,10 @@ def _moe_dense(cfg: ModelConfig, lp: Params, x):
     dense_gates = jnp.sum(
         jax.nn.one_hot(topi, e, dtype=jnp.float32) * gates[..., None], axis=-2
     )  # [..., E]
-    hidden = jnp.einsum("...d,edf->...ef", x, lp["w_gate"])
-    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
+    hidden = expert_mix(x, lp["w_gate"])
+    up = expert_mix(x, lp["w_up"])
     act = swiglu(hidden, up, cfg.gelu_mlp)
-    per_expert = jnp.einsum("...ef,efd->...ed", act, lp["w_down"])
+    per_expert = expert_mix_down(act, lp["w_down"])
     return jnp.einsum("...ed,...e->...d", per_expert, dense_gates.astype(x.dtype))
 
 
@@ -305,10 +310,10 @@ def _moe_grouped(cfg: ModelConfig, lp: Params, x):
         .at[flat_idx].add(xk * keep_col)
         .reshape(e, cap, d)
     )
-    hidden = jnp.einsum("ecd,edf->ecf", x_e, lp["w_gate"])
-    up = jnp.einsum("ecd,edf->ecf", x_e, lp["w_up"])
+    hidden = expert_matmul(x_e, lp["w_gate"])
+    up = expert_matmul(x_e, lp["w_up"])
     act = swiglu(hidden, up, cfg.gelu_mlp)
-    out_e = jnp.einsum("ecf,efd->ecd", act, lp["w_down"])
+    out_e = expert_matmul(act, lp["w_down"])
     gathered = out_e.reshape(e * cap, d)[flat_idx] * keep_col  # [T*k, D]
     y = jnp.sum(
         gathered.reshape(t, k, d) * gates.astype(xf.dtype)[..., None], axis=1

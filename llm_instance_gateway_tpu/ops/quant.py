@@ -13,8 +13,9 @@ in place of the dense array; ``models.transformer`` dispatches through
 Symmetric per-channel quantization of ~normal weights keeps relative error
 around 0.4% per matmul (validated in tests/test_quant.py).
 
-Scope (v1): the seven per-layer projections + lm_head.  Embeddings (gather,
-not matmul), norms, MoE expert stacks, and LoRA buffers stay bf16.
+Scope: the seven per-layer projections (dense [L, in, out] AND MoE expert
+stacks [L, E, in, out]) + lm_head.  Embeddings (gather, not matmul), norms,
+the MoE router (tiny, drives f32 top-k), and LoRA buffers stay bf16.
 """
 
 from __future__ import annotations
@@ -48,6 +49,39 @@ def matmul(x: jax.Array, w: Any) -> jax.Array:
     return x @ w
 
 
+def _expert_einsum(spec: str, scale_expand: int | None, x, w):
+    """One dequant-einsum for every expert-weight contraction: the
+    per-output-channel scale applies after the contraction (exact — the
+    scaled axis is never contracted), broadcast to the output rank by
+    expanding at ``scale_expand`` when the output carries a capacity axis
+    between the expert and channel axes."""
+    if is_quantized(w):
+        y = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        s = w["s"].astype(x.dtype)
+        if scale_expand is not None:
+            s = jnp.expand_dims(s, scale_expand)
+        return y * s
+    return jnp.einsum(spec, x, w)
+
+
+def expert_matmul(x: jax.Array, w: Any) -> jax.Array:
+    """Per-expert tile matmul: [E, C, din] x [E, din, dout] -> [E, C, dout]
+    (both grouped-dispatch einsums are this shape, up and down)."""
+    return _expert_einsum("ecd,edf->ecf", -2, x, w)  # s [E, out] -> [E,1,out]
+
+
+def expert_mix(x: jax.Array, w: Any) -> jax.Array:
+    """Dense all-experts up-projection: [..., din] x [E, din, f] ->
+    [..., E, f]."""
+    return _expert_einsum("...d,edf->...ef", None, x, w)
+
+
+def expert_mix_down(x: jax.Array, w: Any) -> jax.Array:
+    """Dense all-experts down-projection: [..., E, f] x [E, f, d] ->
+    [..., E, d] (the e axes align)."""
+    return _expert_einsum("...ef,efd->...ed", None, x, w)
+
+
 def quantize_params(params: dict, quantize_lm_head: bool = True) -> dict:
     """Return a params tree with the big projections int8-quantized."""
     out = dict(params)
@@ -56,8 +90,11 @@ def quantize_params(params: dict, quantize_lm_head: bool = True) -> dict:
         w = layers.get(name)
         if w is None or is_quantized(w):
             continue
-        if w.ndim == 4:  # MoE expert stacks: keep dense in v1
-            continue
+        # Dense projections [L, in, out] AND MoE expert stacks
+        # [L, E, in, out] quantize the same way (per-output-channel over
+        # the last axis) — expert weights are exactly where Mixtral's
+        # HBM-bound decode spends its weight bandwidth.  The router stays
+        # dense (a tiny [d, E] matmul whose f32 logits drive top-k).
         layers[name] = quantize_weight(w)
     out["layers"] = layers
     if quantize_lm_head and "lm_head" in params and not is_quantized(params["lm_head"]):

@@ -139,10 +139,27 @@ def activation_specs() -> dict[str, Any]:
 
 
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
-    """device_put a pytree with NamedShardings from a matching spec pytree."""
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
-    )
+    """device_put a pytree with NamedShardings from a matching spec pytree.
+
+    Weight-only-int8 leaves (``ops.quant`` ``{"q", "s"}`` dicts) carry ONE
+    spec for the original dense array: ``q`` takes it verbatim and the
+    per-output-channel scale takes the spec minus its contracted
+    (second-to-last) axis — so ``--quantize int8`` composes with serve
+    meshes for dense AND expert-stack weights."""
+    from llm_instance_gateway_tpu.ops.quant import is_quantized
+
+    def place(x, s):
+        if is_quantized(x):
+            axes = tuple(s)
+            scale_spec = P(*(axes[:-2] + axes[-1:])) if len(axes) >= 2 else s
+            return {
+                "q": jax.device_put(x["q"], NamedSharding(mesh, s)),
+                "s": jax.device_put(x["s"],
+                                    NamedSharding(mesh, scale_spec)),
+            }
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(place, tree, specs, is_leaf=is_quantized)
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:
