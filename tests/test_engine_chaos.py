@@ -29,14 +29,19 @@ from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
 CFG = TINY_TEST
 
 
-@pytest.mark.parametrize("pipeline,prefill_batch,spec_k,paged", [
-    (False, 1, 0, False), (True, 1, 0, False),
-    (False, 3, 0, False), (True, 3, 0, False),
-    (False, 1, 2, False), (True, 1, 2, False),
-    (True, 3, 0, True),
+@pytest.mark.parametrize("pipeline,prefill_batch,spec_k,paged,quant,prefix", [
+    (False, 1, 0, False, False, False), (True, 1, 0, False, False, False),
+    (False, 3, 0, False, False, False), (True, 3, 0, False, False, False),
+    (False, 1, 2, False, False, False), (True, 1, 2, False, False, False),
+    (True, 3, 0, True, False, False),
+    # Round-5 production shape: paged + int8 KV + prefix cache + pipelined
+    # (grouped stays off with prefix, per the engine's own reuse gate).
+    (True, 1, 0, True, True, True),
 ], ids=["sync", "pipelined", "sync-grouped", "pipelined-grouped",
-        "sync-spec", "pipelined-spec", "pipelined-grouped-paged"])
-def test_request_storm_terminates(pipeline, prefill_batch, spec_k, paged):
+        "sync-spec", "pipelined-spec", "pipelined-grouped-paged",
+        "pipelined-paged-int8-prefix"])
+def test_request_storm_terminates(pipeline, prefill_batch, spec_k, paged,
+                                  quant, prefix):
     import dataclasses
 
     rng = random.Random(0)
@@ -67,7 +72,9 @@ def test_request_storm_terminates(pipeline, prefill_batch, spec_k, paged):
                      paged_kv_block=8 if paged else None,
                      # Undersized pool: the storm must survive grouped
                      # admission hitting exhaustion-parking backpressure.
-                     paged_kv_blocks=24 if paged else None),
+                     paged_kv_blocks=24 if paged else None,
+                     kv_cache_quant="int8" if quant else None,
+                     prefix_cache=prefix),
         lora_manager=lora, eos_id=7, dtype=jnp.float32, **draft_kw,
     )
     engine.start()
