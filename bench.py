@@ -66,6 +66,10 @@ RUN_ESTIMATE_S = float(os.environ.get("BENCH_RUN_ESTIMATE_S", "420"))
 _emit_lock = threading.Lock()
 _emitted = False
 _partial: dict = {}
+# Device-independent metrics (the handoff/disaggregation phase) merged into
+# EVERY emission path — sentinel errors included — so they land in the BENCH
+# trajectory even when the TPU probe never succeeds.
+_EXTRA: dict = {}
 
 
 def _deadline() -> float:
@@ -87,7 +91,10 @@ def _emit(result: dict, blocking: bool = True) -> bool:
         if _emitted:
             return False
         _emitted = True
-        print(json.dumps(result), flush=True)
+        merged = dict(result)
+        for k, v in _EXTRA.items():
+            merged.setdefault(k, v)
+        print(json.dumps(merged), flush=True)
         return True
     finally:
         _emit_lock.release()
@@ -210,6 +217,159 @@ def install_sigterm_cleanup() -> None:
         signal.signal(signal.SIGTERM, _term)
     except ValueError:
         pass  # not the main thread: caller manages its own lifecycle
+
+
+def run_handoff_microbench() -> dict:
+    """Disaggregation phase: device-independent (CPU backend, tiny model).
+
+    Two measurements:
+
+    - **Handoff plane throughput**: N requests through the full
+      cross-engine path — ``prefill_only`` on a prefill-role engine,
+      serialize, deserialize, ``attach_prefilled`` on a decode-role engine
+      (paged pool) — reported as KV blocks/s exported+attached and wire
+      MB/s.  This is the metric the acceptance bar pins to the BENCH
+      trajectory even when the TPU relay is wedged.
+
+    - **Decode interference A/B (TTFT/TPOT split)**: short decode-heavy
+      requests measured once on a COLLOCATED engine that is concurrently
+      admitting long prefills (the interference disaggregation removes),
+      and once on a decode-role engine fed attaches while the long
+      prefills run on the SEPARATE prefill engine.  TPOT = per-request
+      (t_done - t_first_token)/(tokens-1).
+    """
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request, SamplingParams,
+    )
+    from llm_instance_gateway_tpu.server.kv_transfer import PrefillHandoff
+
+    cfg = dataclasses.replace(
+        LLAMA3_8B, name="handoff-cpu", vocab_size=512, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, head_dim=32,
+        max_seq_len=256,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    block = 16
+    ecfg = dict(decode_slots=4, max_seq_len=256,
+                prefill_buckets=(32, 64, 128))
+
+    def engine(**kw):
+        e = Engine(cfg, params, EngineConfig(**ecfg, **kw), eos_id=None,
+                   dtype=jnp.float32)
+        e.start()
+        return e
+
+    rng = np.random.RandomState(0)
+
+    def req(prompt_len, max_new):
+        return Request(
+            prompt_tokens=list(rng.randint(1, 500, size=prompt_len)),
+            max_new_tokens=max_new, sampling=SamplingParams(temperature=0.0))
+
+    pre = engine(role="prefill")
+    dec = engine(role="decode", paged_kv_block=block)
+    coll = engine(paged_kv_block=block)
+    out: dict = {}
+    try:
+        # Warm the compiled-shape set out of the measurement.
+        warm = dec.attach_prefilled(PrefillHandoff.from_bytes(
+            pre.prefill_only(req(64, 2), timeout_s=300).to_bytes()))
+        warm.done.wait(300)
+        coll.generate(req(64, 2), timeout_s=300)
+        coll.generate(req(120, 2), timeout_s=300)
+
+        # --- handoff plane throughput ---
+        n_req, prompt_len = 8, 64
+        wire_bytes = 0
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            h = pre.prefill_only(req(prompt_len, 2), timeout_s=300)
+            wire = h.to_bytes()
+            wire_bytes += len(wire)
+            ar = dec.attach_prefilled(PrefillHandoff.from_bytes(wire))
+            if not ar.done.wait(300):
+                raise RuntimeError("attach timed out")
+        wall = time.perf_counter() - t0
+        blocks = n_req * (-(-prompt_len // block))
+        out["handoff_blocks_per_s"] = round(blocks / wall, 1)
+        out["handoff_wire_mb_s"] = round(wire_bytes / wall / 1e6, 2)
+
+        # --- decode interference A/B ---
+        def tpot_ms(r):
+            steps = max(1, len(r.output_tokens) - 1)
+            return (r.t_done - r.t_first_token) * 1e3 / steps
+
+        # Collocated: decode-heavy requests share the engine with long
+        # prefill admissions — each prefill program stalls every active
+        # decode slot for its duration (the interference under test).
+        decoders = [req(16, 24) for _ in range(4)]
+        for r in decoders:
+            coll.submit(r)
+        longs = [coll.submit(req(120, 2)) for _ in range(4)]
+        for r in decoders + longs:
+            if not r.done.wait(300):
+                raise RuntimeError("collocated request timed out")
+        vals = sorted(tpot_ms(r) for r in decoders)
+        out["colloc_decode_tpot_p50_ms"] = round(vals[len(vals) // 2], 2)
+        out["colloc_decode_tpot_max_ms"] = round(vals[-1], 2)
+
+        # Disaggregated: decoders attach on dec; long prefills hand off on
+        # pre (their KV never enters dec's decode loop as prefill work).
+        decoders = []
+        for _ in range(4):
+            decoders.append(dec.attach_prefilled(PrefillHandoff.from_bytes(
+                pre.prefill_only(req(16, 24), timeout_s=300).to_bytes())))
+        longs = [pre.submit(Request(
+            prompt_tokens=list(rng.randint(1, 500, size=120)),
+            max_new_tokens=2, sampling=SamplingParams(temperature=0.0)))
+            for _ in range(4)]
+        for r in decoders:
+            if not r.done.wait(300):
+                raise RuntimeError("disagg decode request timed out")
+        for r in longs:
+            r.done.wait(300)
+        vals = sorted(tpot_ms(r) for r in decoders)
+        out["disagg_decode_tpot_p50_ms"] = round(vals[len(vals) // 2], 2)
+        out["disagg_decode_tpot_max_ms"] = round(vals[-1], 2)
+        out["disagg_decode_ttft_p50_ms"] = round(sorted(
+            r.ttft_s for r in decoders)[len(decoders) // 2] * 1e3, 2)
+        if jax.default_backend() == "cpu":
+            # Both engines share this host's cores, so cross-engine CPU
+            # contention inflates the disagg numbers; on separate TPU
+            # replicas the interference signal is the COLLOCATED max/p50
+            # spread (decode stalls during co-resident prefill programs).
+            out["handoff_note"] = "cpu-backend: engines share host cores"
+    finally:
+        pre.stop()
+        dec.stop()
+        coll.stop()
+    return out
+
+
+def _collect_handoff_metrics(timeout_s: float = 300.0) -> None:
+    """Run the disaggregation phase in a CPU subprocess BEFORE the device
+    claim (it must not touch — or wait for — the TPU relay) and merge its
+    metrics into every emission path, sentinels included."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--handoff-microbench"],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        lines = [ln for ln in (r.stdout or "").splitlines()
+                 if ln.startswith("{")]
+        if lines:
+            _EXTRA.update(json.loads(lines[-1]))
+        else:
+            _EXTRA["handoff_error"] = (
+                f"no output (rc={r.returncode}): {(r.stderr or '')[-200:]}")
+    except Exception as e:  # the phase is additive; never block the ratio
+        _EXTRA["handoff_error"] = str(e)[:200]
 
 
 # v5e (per chip): 819 GB/s HBM bandwidth, 197 TFLOP/s bf16 on the MXU.
@@ -382,6 +542,9 @@ def main() -> None:
 
     install_sigterm_cleanup()
     _install_governor()
+    # Disaggregation phase FIRST (CPU subprocess): its metrics merge into
+    # every later emission, so they survive a wedged TPU relay.
+    _collect_handoff_metrics()
     _claim_device_with_retry()
     _device_watchdog()
     cfg = bench_model_cfg()
@@ -506,4 +669,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--handoff-microbench" in sys.argv:
+        print(json.dumps(run_handoff_microbench()), flush=True)
+    else:
+        main()

@@ -368,13 +368,29 @@ def _start_pool_sources(
     endpoints: list[StaticEndpoint] = []
     for spec in static_pods or []:
         name, _, rest = spec.partition("=")
-        addr, _, ep_zone = rest.partition(",")
+        addr, *opts = rest.split(",")
         addr = addr or name
         if ":" not in addr:
             # Fill the pool port BEFORE any probing so /health hits the
             # serving port, not :80.
             addr = f"{addr}:{target_port}"
-        endpoints.append(StaticEndpoint(name=name, address=addr, zone=ep_zone))
+        # Options after the address: a bare token is the zone (legacy
+        # position), ``role=prefill|decode`` marks disaggregation roles.
+        ep_zone, ep_role = "", "collocated"
+        for opt in opts:
+            key, sep, val = opt.partition("=")
+            if sep and key == "role":
+                from llm_instance_gateway_tpu.gateway.types import POOL_ROLES
+
+                if val not in POOL_ROLES:
+                    raise ValueError(
+                        f"--pod {spec!r}: unknown role {val!r} "
+                        f"(expected one of {POOL_ROLES})")
+                ep_role = val
+            else:
+                ep_zone = opt
+        endpoints.append(StaticEndpoint(name=name, address=addr,
+                                        zone=ep_zone, role=ep_role))
 
     # All membership flows through one aggregator: the reconciler is
     # full-state, so independent sources must publish a merged view, and the
@@ -402,7 +418,7 @@ def _start_pool_sources(
             aggregator.publish(
                 "static",
                 [Endpoint(name=ep.name, address=ep.address, ready=True,
-                          zone=ep.zone) for ep in endpoints],
+                          zone=ep.zone, role=ep.role) for ep in endpoints],
             )
     elif probe_endpoints and not discover_dns and kcfg is None:
         logger.warning(
@@ -470,9 +486,11 @@ def _start_pool_sources(
 def add_common_args(parser) -> None:
     parser.add_argument("--config", required=True, help="pool/model YAML")
     parser.add_argument("--pod", action="append", default=[],
-                        help="pod membership [pool/]name=host[:port][,zone] "
-                             "(repeatable; pool/ prefix scopes to one pool of "
-                             "a multi-pool config)")
+                        help="pod membership [pool/]name=host[:port]"
+                             "[,zone][,role=prefill|decode] (repeatable; "
+                             "pool/ prefix scopes to one pool of a "
+                             "multi-pool config; role marks prefill/decode "
+                             "disaggregation replicas)")
     parser.add_argument("--discover-dns", action="append", default=[],
                         metavar="[POOL/]HOSTNAME",
                         help="discover pods by resolving a headless Service "

@@ -96,6 +96,7 @@ class StaticEndpoint:
     name: str
     address: str  # host:port of the serving endpoint
     zone: str = ""
+    role: str = "collocated"  # disaggregation role (gateway/types.py)
 
 
 def probe_health(address: str, timeout_s: float = 2.0,
@@ -260,7 +261,8 @@ class EndpointProber:
         )
         results = [
             Endpoint(name=ep.name, address=ep.address,
-                     ready=health.get(ep.address, False), zone=ep.zone)
+                     ready=health.get(ep.address, False), zone=ep.zone,
+                     role=getattr(ep, "role", "collocated"))
             for ep in self.endpoints
         ]
         self._publish(results)

@@ -102,6 +102,7 @@ class Endpoint:
     address: str  # host only or host:port; port filled from pool if absent
     ready: bool = True
     zone: str = ""
+    role: str = "collocated"  # disaggregation role (gateway/types.py)
 
 
 class EndpointsReconciler:
@@ -126,7 +127,8 @@ class EndpointsReconciler:
             if not self._valid(ep):
                 continue
             address = ep.address if ":" in ep.address else f"{ep.address}:{port}"
-            desired[ep.name] = Pod(name=ep.name, address=address)
+            desired[ep.name] = Pod(name=ep.name, address=address,
+                                   role=getattr(ep, "role", "collocated"))
         for name in self.datastore.pod_names() - set(desired):
             self.datastore.delete_pod(name)  # remove stale (:64-79)
         for pod in desired.values():

@@ -88,6 +88,14 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
             )
 
     text = prompt_text(body)
+    # The hash chain (up to 32 chained blake2b calls over 8 KB of prompt)
+    # runs on EVERY request body in the ext-proc hot path — skip it when
+    # the scheduler was built prefix-unaware: dead weight otherwise.
+    # Skipping requires an EXPLICIT prefix_index=None (the prefix_aware=
+    # False build); a custom drop-in scheduler without the attribute still
+    # gets hashes — it may consume req.prefix_hashes without exposing the
+    # index.
+    prefix_aware = getattr(server.scheduler, "prefix_index", True) is not None
     llm_req = LLMRequest(
         model=model,
         resolved_target_model=model_name,
@@ -97,7 +105,8 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
                      if model_obj.spec.criticality else "Default"),
         # Model-seeded: identical boilerplate under different models must
         # not alias (their KV blocks can't be shared).
-        prefix_hashes=prefix_hashes(text, model=model_name),
+        prefix_hashes=(prefix_hashes(text, model=model_name)
+                       if prefix_aware else ()),
     )
 
     request_body = msg.body
@@ -105,19 +114,36 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
         body["model"] = llm_req.resolved_target_model
         request_body = json.dumps(body).encode()
 
-    target_pod = server.scheduler.schedule(llm_req)  # raises SchedulingError
+    # Disaggregated pools get a two-stage pick (prefill replica + decode
+    # replica); schedulers without the seam (custom drop-ins) stay
+    # single-hop.  Both raise SchedulingError.
+    disagg = getattr(server.scheduler, "schedule_disaggregated", None)
+    if disagg is not None:
+        target_pod, decode_pod = disagg(llm_req)
+    else:
+        target_pod, decode_pod = server.scheduler.schedule(llm_req), None
 
     req_ctx.model = llm_req.model
     req_ctx.resolved_target_model = llm_req.resolved_target_model
     req_ctx.target_pod = target_pod
+    req_ctx.decode_pod = decode_pod
+
+    set_headers = {
+        server.target_pod_header: target_pod.address,
+        # Body was (possibly) mutated: Content-Length must follow
+        # (request.go:89-96).
+        "Content-Length": str(len(request_body)),
+    }
+    if decode_pod is not None:
+        from llm_instance_gateway_tpu.gateway.handlers.server import (
+            DEFAULT_DECODE_POD_HEADER,
+        )
+
+        set_headers[getattr(server, "decode_pod_header",
+                            DEFAULT_DECODE_POD_HEADER)] = decode_pod.address
 
     return ProcessingResult(
         phase="request_body",
-        set_headers={
-            server.target_pod_header: target_pod.address,
-            # Body was (possibly) mutated: Content-Length must follow
-            # (request.go:89-96).
-            "Content-Length": str(len(request_body)),
-        },
+        set_headers=set_headers,
         body=request_body,
     )

@@ -31,6 +31,10 @@ from llm_instance_gateway_tpu.gateway.types import Pod
 logger = logging.getLogger(__name__)
 
 DEFAULT_TARGET_POD_HEADER = "target-pod"  # main.go:34 flag default
+# Second hop of a disaggregated pick: the decode replica's address.  The
+# standalone proxy relays the handoff between the two hops itself; the
+# ext-proc transport surfaces the header for an Envoy-side implementation.
+DEFAULT_DECODE_POD_HEADER = "x-decode-pod"
 
 
 @dataclass
@@ -38,6 +42,9 @@ class RequestContext:
     """Per-HTTP-request state shared across phases (server.go:124-128)."""
 
     target_pod: Pod | None = None
+    # Disaggregated pools: the decode-role replica of a two-stage pick
+    # (None = single-hop).  target_pod is then the prefill hop.
+    decode_pod: Pod | None = None
     model: str = ""
     resolved_target_model: str = ""
     usage: Usage = field(default_factory=Usage)
@@ -63,10 +70,12 @@ class Server:
         scheduler,
         datastore: Datastore,
         target_pod_header: str = DEFAULT_TARGET_POD_HEADER,
+        decode_pod_header: str = DEFAULT_DECODE_POD_HEADER,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
         self.target_pod_header = target_pod_header
+        self.decode_pod_header = decode_pod_header
 
     def process(
         self, req_ctx: RequestContext, msg: ProcessingMessage

@@ -21,6 +21,7 @@ from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
 from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
 from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub
 from llm_instance_gateway_tpu.gateway.handlers.server import (
+    DEFAULT_DECODE_POD_HEADER,
     DEFAULT_TARGET_POD_HEADER,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
@@ -40,8 +41,12 @@ def model_name(i: int) -> str:  # benchmark.go:71-73
 
 
 def build_fixture(num_fake_pods: int, num_models_per_pod: int,
-                  with_base_model: bool = False):
-    """benchmark.go:75-106: pod i serves adapters i*M..i*M+M-1."""
+                  with_base_model: bool = False, role_split: bool = False):
+    """benchmark.go:75-106: pod i serves adapters i*M..i*M+M-1.
+
+    ``role_split`` alternates prefill/decode roles across the fleet
+    (disaggregated-pool rig): the scheduler then runs TWO-stage picks and
+    every response must carry both target headers."""
     pods = {}
     models = []
     total = num_fake_pods * num_models_per_pod
@@ -50,7 +55,9 @@ def build_fixture(num_fake_pods: int, num_models_per_pod: int,
             model_name(i * num_models_per_pod + j): 0
             for j in range(num_models_per_pod)
         }
-        pods[fake_pod(i)] = fake_metrics(
+        role = ("prefill" if i % 2 == 0 else "decode") if role_split \
+            else "collocated"
+        pods[fake_pod(i, role=role)] = fake_metrics(
             queue=i % 5, kv=(i % 10) / 10.0, adapters=adapters,
             max_adapters=num_models_per_pod + 1,
         )
@@ -81,6 +88,7 @@ def run_load(
     use_native: bool = False,
     session_prefix_chars: int = 0,
     session_count: int = 64,
+    role_split: bool = False,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
@@ -90,14 +98,18 @@ def run_load(
     carries one of ``session_count`` shared prompt prefixes, measuring the
     prefix-affinity path's hot-loop cost (hashing rides the pick) and its
     stickiness (distinct pods per session; 1.0 = every repeat landed on
-    the session's replica)."""
+    the session's replica).  ``role_split`` makes the fleet half
+    prefill-role / half decode-role: every pick becomes TWO-stage
+    (prefill replica by the full tree, decode replica by KV headroom) and
+    the summary reports the two-stage rate + per-hop header coverage."""
     if session_prefix_chars and session_prefix_chars < PREFIX_BLOCK_CHARS:
         raise ValueError(
             f"session_prefix_chars must be >= {PREFIX_BLOCK_CHARS} (the "
             "affinity hash covers whole blocks only; a shorter prefix "
             "would measure a no-op)")
     pods, models = build_fixture(num_fake_pods, num_models_per_pod,
-                                 with_base_model=bool(session_prefix_chars))
+                                 with_base_model=bool(session_prefix_chars),
+                                 role_split=role_split)
     factory = None
     if use_native:
         from llm_instance_gateway_tpu.gateway.scheduling.native import (
@@ -117,6 +129,7 @@ def run_load(
         # Round-robin model names (benchmark.go:64-69), batched into streams.
         sent = 0
         session_pods: dict[int, set[str]] = {}
+        two_stage_hits = 0
 
         def body_for(i: int) -> tuple[bytes, int | None]:
             if session_prefix_chars:
@@ -140,6 +153,13 @@ def run_load(
                 latencies.append(t1 - t0)
                 t0 = t1
                 assert resp.WhichOneof("response") == "request_body"
+                if role_split:
+                    keys = {h.header.key for h in (resp.request_body.response
+                                                   .header_mutation
+                                                   .set_headers)}
+                    if (DEFAULT_TARGET_POD_HEADER in keys
+                            and DEFAULT_DECODE_POD_HEADER in keys):
+                        two_stage_hits += 1
                 sid = bodies[k][1]
                 if sid is not None:
                     for h in (resp.request_body.response
@@ -167,6 +187,10 @@ def run_load(
         "p50_us": round(pct(0.5) * 1e6, 1),
         "p99_us": round(pct(0.99) * 1e6, 1),
     }
+    if role_split:
+        # 1.0 = every response carried BOTH hop headers (prefill target +
+        # x-decode-pod) — the two-stage pick ran on every request.
+        out["two_stage_rate"] = round(two_stage_hits / requests, 4)
     if session_prefix_chars:
         if not session_pods:
             raise RuntimeError(
@@ -193,11 +217,16 @@ def main(argv=None):
                              "this many chars (measures prefix-affinity "
                              "cost + stickiness)")
     parser.add_argument("--sessions", type=int, default=64)
+    parser.add_argument("--role-split", action="store_true",
+                        help="disaggregated-pool rig: half the fake fleet "
+                             "prefill-role, half decode-role; measures the "
+                             "two-stage pick rate and cost")
     args = parser.parse_args(argv)
     summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
                        use_native=args.native,
                        session_prefix_chars=args.session_prefix_chars,
-                       session_count=args.sessions)
+                       session_count=args.sessions,
+                       role_split=args.role_split)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 

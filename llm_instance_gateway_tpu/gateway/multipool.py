@@ -56,6 +56,15 @@ class MultiPoolServer:
     def target_pod_header(self) -> str:
         return self._servers[self._default].target_pod_header
 
+    @property
+    def decode_pod_header(self) -> str:
+        from llm_instance_gateway_tpu.gateway.handlers.server import (
+            DEFAULT_DECODE_POD_HEADER,
+        )
+
+        return getattr(self._servers[self._default], "decode_pod_header",
+                       DEFAULT_DECODE_POD_HEADER)
+
     def _route(self, body: bytes):
         """Returns (pool_name | None, parsed_body | None)."""
         try:

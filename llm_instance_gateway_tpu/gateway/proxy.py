@@ -131,6 +131,18 @@ class GatewayProxy:
 
         # Forward to the picked replica (Envoy's ORIGINAL_DST role).
         out_body = result.body if result.body is not None else body
+        decode_pod = getattr(req_ctx, "decode_pod", None)
+        if decode_pod is not None:
+            # Disaggregated pick: relay prefill-hop -> handoff -> decode-hop.
+            resp = await self._disagg_forward(
+                request, pod, decode_pod, out_body, request_id, req_ctx)
+            if resp is not None:
+                return resp
+            # Either hop refused (draining, long prompt, unsupported
+            # params): serve single-hop on the prefill replica — every
+            # engine is complete regardless of role.
+            logger.info("request=%s disaggregated path unavailable; "
+                        "single-hop on %s", request_id, pod.name)
         url = f"http://{pod.address}{request.path}"
         try:
             async with self._session.post(
@@ -178,6 +190,83 @@ class GatewayProxy:
         )
         headers = {"x-served-by": pod.name, "x-request-id": request_id,
                    **hdr_result.set_headers}
+        return web.Response(body=resp_body, status=status, headers=headers,
+                            content_type="application/json")
+
+    async def _disagg_forward(self, request: web.Request, prefill_pod,
+                              decode_pod, out_body: bytes, request_id: str,
+                              req_ctx) -> web.StreamResponse | None:
+        """Two-hop data path for a disaggregated pick.
+
+        Hop 1 posts the (possibly rewritten) body to the prefill replica's
+        ``/v1/prefill`` and receives the serialized ``PrefillHandoff``;
+        hop 2 posts it to the decode replica's ``/v1/attach``, which decodes
+        to completion and answers in the normal OpenAI envelope (SSE
+        included).  Returns None to signal single-hop fallback — any 4xx/5xx
+        from either hop (draining replica, prompt beyond the prefill bucket,
+        params the handoff path doesn't carry) degrades gracefully rather
+        than failing the request.
+        """
+        try:
+            async with self._session.post(
+                f"http://{prefill_pod.address}/v1/prefill",
+                data=out_body,
+                headers={"Content-Type": "application/json",
+                         "x-request-id": request_id},
+            ) as pre:
+                if pre.status != 200:
+                    logger.warning(
+                        "prefill hop %s returned %d; falling back",
+                        prefill_pod.address, pre.status)
+                    return None
+                handoff = await pre.read()
+            async with self._session.post(
+                f"http://{decode_pod.address}/v1/attach",
+                data=handoff,
+                headers={"Content-Type": "application/octet-stream",
+                         "x-request-id": request_id},
+            ) as upstream:
+                status = upstream.status
+                if status != 200:
+                    logger.warning(
+                        "attach hop %s returned %d; falling back",
+                        decode_pod.address, status)
+                    return None
+                if "text/event-stream" in upstream.headers.get(
+                        "Content-Type", ""):
+                    return await self._relay_stream(
+                        request, upstream, decode_pod, req_ctx)
+                resp_body = await upstream.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # No record_error here: the caller serves the request single-hop
+            # next, and THAT path records the request's actual outcome — a
+            # recovered hop must not inflate the error rate (non-200 hop
+            # statuses above are treated identically).
+            logger.warning("disaggregated path %s->%s failed: %s",
+                           prefill_pod.address, decode_pod.address, e)
+            return None
+        hdr_result = self.server.process(req_ctx, ResponseHeaders())
+        try:
+            self.server.process(req_ctx, ResponseBody(body=resp_body))
+            self.metrics.record_usage(
+                req_ctx.model,
+                req_ctx.usage.prompt_tokens,
+                req_ctx.usage.completion_tokens,
+            )
+        except ProcessingError:
+            pass
+        logger.info(
+            "request=%s model=%s disaggregated prefill=%s decode=%s "
+            "status=%d prompt_tokens=%d completion_tokens=%d",
+            request_id, req_ctx.model, prefill_pod.name, decode_pod.name,
+            status, req_ctx.usage.prompt_tokens,
+            req_ctx.usage.completion_tokens,
+        )
+        headers = {
+            "x-served-by": f"{prefill_pod.name}+{decode_pod.name}",
+            "x-request-id": request_id,
+            **hdr_result.set_headers,
+        }
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
 

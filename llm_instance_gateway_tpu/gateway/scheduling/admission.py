@@ -150,6 +150,30 @@ class AdmissionController:
         """The wrapped scheduler's live SchedulerConfig (drop-in surface)."""
         return self._scheduler.cfg
 
+    @property
+    def prefix_index(self):
+        """Wrapped scheduler's prefix-affinity index (drop-in surface: the
+        request handler gates hash computation on its presence)."""
+        return getattr(self._scheduler, "prefix_index", None)
+
+    def schedule_disaggregated(self, llm_req):
+        """Two-stage routing pass-through (disaggregated pools).
+
+        A shed here degrades to the single-hop admission path: the request
+        parks in the tier queues and re-admits collocated on whichever
+        replica the drain tree clears first — bounded wait beats a 429 for
+        disaggregated traffic exactly as for plain traffic.
+        """
+        inner = getattr(self._scheduler, "schedule_disaggregated", None)
+        if inner is None:
+            return self.schedule(llm_req), None
+        try:
+            return inner(llm_req)
+        except SchedulingError as e:
+            if not e.shed or not self._cfg.enabled:
+                raise
+        return self.schedule(llm_req), None
+
     def schedule(self, llm_req):
         try:
             return self._scheduler.schedule(llm_req)

@@ -24,13 +24,21 @@ from llm_instance_gateway_tpu.gateway.scheduling.config import (
     DEFAULT_CONFIG,
     SchedulerConfig,
 )
+from llm_instance_gateway_tpu.gateway.scheduling.filter import FilterError
 from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
     PodMetricsProvider,
     Scheduler,
     SchedulingError,
+    build_decode_tree,
+    split_pool_roles,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
-from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+from llm_instance_gateway_tpu.gateway.types import (
+    ROLE_COLLOCATED,
+    Pod,
+    PodMetrics,
+    pod_role,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -133,6 +141,11 @@ class NativeScheduler:
 
             self.prefix_index = PrefixIndex()
         self._rng = rng or random.Random()
+        # Decode-hop stage for disaggregated pools: the tiny Python tree
+        # (2-3 filters over the decode-role subset) — not worth an FFI
+        # seam, and it keeps the fuzz-pinned C++ candidate parity for the
+        # main tree untouched.
+        self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
         self._snapshot: dict | None = None
         # The gRPC transport calls schedule() from a thread pool; the cached
         # arrays (including the C++ output buffer) are shared state.
@@ -236,14 +249,17 @@ class NativeScheduler:
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime — cfg fields cross the FFI per call."""
         self.cfg = cfg
+        self._decode_tree = build_decode_tree(
+            cfg, token_aware=self.token_aware)
 
-    def schedule(self, req: LLMRequest) -> Pod:
+    def _snapshot_pods(self):
         snapshot = getattr(self._provider, "snapshot", None)
         if snapshot is not None:
-            version, pods = snapshot()  # atomic (version, pods) pair
-        else:
-            version, pods = None, self._provider.all_pod_metrics()
-        idxs = self.candidates(req, pods, version)
+            return snapshot()  # atomic (version, pods) pair
+        return None, self._provider.all_pod_metrics()
+
+    def _pick(self, req: LLMRequest, pods: list[PodMetrics],
+              idxs: list[int]) -> Pod:
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, [pods[i] for i in idxs])
@@ -254,6 +270,41 @@ class NativeScheduler:
         if self.prefix_index is not None and req.prefix_hashes:
             self.prefix_index.record(req.prefix_hashes, pick.name)
         return pick
+
+    def schedule(self, req: LLMRequest) -> Pod:
+        version, pods = self._snapshot_pods()
+        # Same role policy as the Python Scheduler: single-hop traffic
+        # prefers collocated replicas; a role-filtered SUBSET bypasses the
+        # snapshot-version array cache (it keys on (version, n) and a
+        # subset would poison it).
+        collocated = [pm for pm in pods
+                      if pod_role(pm.pod) == ROLE_COLLOCATED]
+        if collocated and len(collocated) != len(pods):
+            pods, version = collocated, None
+        idxs = self.candidates(req, pods, version)
+        return self._pick(req, pods, idxs)
+
+    def schedule_disaggregated(
+        self, req: LLMRequest
+    ) -> tuple[Pod, Pod | None]:
+        """Two-stage routing (see ``Scheduler.schedule_disaggregated``):
+        C++ candidates over the prefill-role subset, then the decode tree
+        over the decode-role subset."""
+        version, pods = self._snapshot_pods()
+        prefills, decodes = split_pool_roles(pods)
+        if not prefills or not decodes:
+            return self.schedule(req), None
+        idxs = self.candidates(req, prefills, None)  # subset: no cache
+        prefill_pod = self._pick(req, prefills, idxs)
+        try:
+            decode_survivors = self._decode_tree.filter(req, decodes)
+        except FilterError as e:
+            raise SchedulingError(
+                f"no decode replica for disaggregated request: {e}",
+                shed=e.shed) from e
+        decode_pod = decode_survivors[
+            self._rng.randrange(len(decode_survivors))].pod
+        return prefill_pod, decode_pod
 
 
 def make_scheduler(provider, cfg: SchedulerConfig = DEFAULT_CONFIG,

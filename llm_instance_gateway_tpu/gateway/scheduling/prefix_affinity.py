@@ -61,6 +61,18 @@ PREFIX_BLOCK_CHARS = 256
 # prompts and few-shot preambles — the traffic prefix caching exists for —
 # fit comfortably; hashing cost stays trivially bounded per request.
 MAX_BLOCKS = 32
+# Load-aware cap on the prefer() tie-break: skip a holder whose waiting
+# queue (absolute) or KV usage (fraction) exceeds the survivor median by
+# these margins — a hot shared prefix must not pin ALL its traffic to one
+# replica indefinitely (the overflow replicates the prefix, which then
+# serves it as cache hits; the desirable steady state for system prompts).
+HOLDER_QUEUE_SLACK = 4
+HOLDER_KV_SLACK = 0.2
+# Hysteresis on record(): a still-warm holder is replaced only after this
+# many CONSECUTIVE picks of the same other pod for a hash — one transient
+# off-holder pick (a scrape blip bucketed the holder out for 50 ms) must
+# not erase affinity the holder's KV cache still backs.
+DIVERGENT_PICKS_TO_STEAL = 2
 
 
 def prefix_hashes(text: str, model: str = "") -> tuple[int, ...]:
@@ -94,17 +106,45 @@ class PrefixIndex:
     def __init__(self, capacity: int = 16384):
         self.capacity = capacity
         self._map: "OrderedDict[int, str]" = OrderedDict()
+        # Divergence counters: hash -> (candidate pod, consecutive picks).
+        # Bounded by _map pruning (entries die with their hash).
+        self._pending: dict[int, tuple[str, int]] = {}
         self._lock = threading.Lock()
 
     def record(self, hashes: Sequence[int], pod_name: str) -> None:
+        """Learn ``pod_name`` as the holder of ``hashes``.
+
+        Fresh hashes bind immediately.  A hash with a DIFFERENT current
+        holder updates only after ``DIVERGENT_PICKS_TO_STEAL`` consecutive
+        picks of the same new pod: a single off-holder pick (relative
+        bucketing catching the holder mid-decode in one 50 ms scrape) used
+        to overwrite a still-warm holder and flap affinity between
+        replicas; now it takes a sustained divergence — i.e. the tree
+        genuinely stopped admitting the holder — to re-learn.
+        """
         if not hashes:
             return
         with self._lock:
             for h in hashes:
-                self._map[h] = pod_name
-                self._map.move_to_end(h)
+                cur = self._map.get(h)
+                if cur is None or cur == pod_name:
+                    self._map[h] = pod_name
+                    self._map.move_to_end(h)
+                    self._pending.pop(h, None)
+                    continue
+                cand, count = self._pending.get(h, (pod_name, 0))
+                if cand != pod_name:
+                    cand, count = pod_name, 0
+                count += 1
+                if count >= DIVERGENT_PICKS_TO_STEAL:
+                    self._map[h] = pod_name
+                    self._pending.pop(h, None)
+                else:
+                    self._pending[h] = (cand, count)
+                self._map.move_to_end(h)  # the hash itself is hot either way
             while len(self._map) > self.capacity:
-                self._map.popitem(last=False)
+                evicted, _ = self._map.popitem(last=False)
+                self._pending.pop(evicted, None)
 
     def lookup(self, hashes: Sequence[int]) -> tuple[str | None, int]:
         """(pod name holding the longest matching chain, depth in blocks)."""
@@ -126,12 +166,35 @@ class PrefixIndex:
         a shallower prefix on a HEALTHY replica beats a deeper one on an
         excluded replica (which is never resurrected).  A restarted
         replica's stale entries cost only missed-reuse picks until LRU
-        turnover re-learns them."""
+        turnover re-learns them.
+
+        Load-aware cap: a holder whose waiting queue or KV usage exceeds
+        the SURVIVOR MEDIAN by more than the slack constants is skipped
+        even though the tree kept it — relative bucketing admits "the
+        whole pool is busy" states where affinity would otherwise pin a
+        hot shared prefix to one replica indefinitely; spilling to a
+        random survivor replicates the prefix, and subsequent requests
+        find it cached on both."""
         names = {pm.pod.name: pm for pm in survivors}
         hashes = req.prefix_hashes
+        if not names or not hashes:
+            return None
+        queues = sorted(pm.metrics.total_queue_size for pm in survivors)
+        kvs = sorted(pm.metrics.kv_cache_usage_percent for pm in survivors)
+        # LOWER median: with an even survivor count the upper median can be
+        # the holder's own load, which would make the cap unreachable on
+        # the common 2-replica pool.
+        mid = (len(survivors) - 1) // 2
+        queue_cap = queues[mid] + HOLDER_QUEUE_SLACK
+        kv_cap = kvs[mid] + HOLDER_KV_SLACK
         with self._lock:
             for depth in range(len(hashes), 0, -1):
                 pod = self._map.get(hashes[depth - 1])
-                if pod is not None and pod in names:
-                    return names[pod]
+                if pod is None or pod not in names:
+                    continue
+                pm = names[pod]
+                if (pm.metrics.total_queue_size > queue_cap
+                        or pm.metrics.kv_cache_usage_percent > kv_cap):
+                    continue  # overloaded holder: shallower/other holders
+                return pm
         return None

@@ -41,7 +41,14 @@ from llm_instance_gateway_tpu.gateway.scheduling.filter import (
     to_filter_func,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
-from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+from llm_instance_gateway_tpu.gateway.types import (
+    ROLE_COLLOCATED,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    Pod,
+    PodMetrics,
+    pod_role,
+)
 
 
 class SchedulingError(Exception):
@@ -159,6 +166,42 @@ def build_default_tree(
     )
 
 
+def build_decode_tree(
+    cfg: SchedulerConfig = DEFAULT_CONFIG,
+    token_aware: bool = True,
+) -> Filter:
+    """Decode-hop stage for disaggregated pools: KV headroom first (the
+    decode replica holds this request's KV for its WHOLE lifetime — the
+    signal that gates TPOT stability), then total queue depth.  Prefill
+    signals are irrelevant here: a decode-role replica admits handoffs
+    straight into decode slots and its prefill queue stays empty."""
+    preds = make_predicates(cfg)
+    kv_then_queue = Filter(
+        name="least KV cache percent",
+        func=least_kv_cache_filter,
+        next_on_success_or_failure=Filter(
+            name="least queuing", func=least_queuing_filter),
+    )
+    if not token_aware:
+        return kv_then_queue
+    return Filter(
+        name="token headroom",
+        func=to_filter_func(preds["token_headroom"], "token_headroom"),
+        next_on_success=kv_then_queue,
+        next_on_failure=kv_then_queue,  # advisory: fall back, don't fail
+    )
+
+
+def split_pool_roles(
+    pods: Sequence[PodMetrics],
+) -> tuple[list[PodMetrics], list[PodMetrics]]:
+    """(prefill-role, decode-role) partitions; collocated pods are in
+    neither (they serve single-hop traffic)."""
+    prefills = [pm for pm in pods if pod_role(pm.pod) == ROLE_PREFILL]
+    decodes = [pm for pm in pods if pod_role(pm.pod) == ROLE_DECODE]
+    return prefills, decodes
+
+
 class Scheduler:
     """scheduler.go:93-122, with configurable thresholds and TPU options."""
 
@@ -198,6 +241,9 @@ class Scheduler:
         self._tree = tree or build_default_tree(
             cfg, token_aware=token_aware, prefill_aware=prefill_aware
         )
+        # Decode-hop stage for disaggregated pools (role-split replicas);
+        # inert while every pod is collocated.
+        self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
         self._rng = rng or random.Random()
 
     def update_config(self, cfg: SchedulerConfig) -> None:
@@ -219,9 +265,11 @@ class Scheduler:
             cfg, token_aware=self._token_aware,
             prefill_aware=self._prefill_aware,
         )
+        self._decode_tree = build_decode_tree(
+            cfg, token_aware=self._token_aware)
 
-    def schedule(self, req: LLMRequest) -> Pod:
-        pods = self._provider.all_pod_metrics()
+    def _survivors(self, req: LLMRequest,
+                   pods: Sequence[PodMetrics]) -> list[PodMetrics]:
         try:
             survivors = self._tree.filter(req, pods)
         except FilterError as e:
@@ -230,6 +278,9 @@ class Scheduler:
             ) from e
         if not survivors:
             raise SchedulingError("failed to apply filter, resulted 0 pods")
+        return survivors
+
+    def _pick(self, req: LLMRequest, survivors: Sequence[PodMetrics]) -> Pod:
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, survivors)
@@ -242,3 +293,42 @@ class Scheduler:
             # cache on, retain) this prefix: future lookups route here.
             self.prefix_index.record(req.prefix_hashes, pick.name)
         return pick
+
+    def schedule(self, req: LLMRequest) -> Pod:
+        pods = self._provider.all_pod_metrics()
+        # Role-split pools: single-hop traffic stays off the specialized
+        # replicas when collocated ones exist (a decode replica serving a
+        # full request would prefill on its decode-critical MXU); in a
+        # FULLY split pool single-hop is the degraded fallback and any
+        # replica can take it (roles are advisory, engines are complete).
+        collocated = [pm for pm in pods
+                      if pod_role(pm.pod) == ROLE_COLLOCATED]
+        return self._pick(req, self._survivors(req, collocated or list(pods)))
+
+    def schedule_disaggregated(
+        self, req: LLMRequest
+    ) -> tuple[Pod, Pod | None]:
+        """Two-stage routing for disaggregated pools.
+
+        Returns ``(prefill_pod, decode_pod)``: the prefill replica is
+        picked by the FULL decision tree over the prefill-role set (its
+        prefill-queue/TTFT stages are exactly the signals that matter for
+        hop 1, and prefix affinity applies here — that is where prefill
+        reuse lives), then the decode replica by KV-headroom/queue signals
+        over the decode-role set (``build_decode_tree``).  Pools without
+        both roles fall back to single-hop: ``(schedule(req), None)``.
+        """
+        pods = self._provider.all_pod_metrics()
+        prefills, decodes = split_pool_roles(pods)
+        if not prefills or not decodes:
+            return self.schedule(req), None
+        prefill_pod = self._pick(req, self._survivors(req, prefills))
+        try:
+            decode_survivors = self._decode_tree.filter(req, decodes)
+        except FilterError as e:
+            raise SchedulingError(
+                f"no decode replica for disaggregated request: {e}",
+                shed=e.shed) from e
+        decode_pod = decode_survivors[
+            self._rng.randrange(len(decode_survivors))].pod
+        return prefill_pod, decode_pod

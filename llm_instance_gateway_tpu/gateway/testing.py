@@ -28,9 +28,10 @@ from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
 from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
 
 
-def fake_pod(index: int) -> Pod:
-    """test/utils.go:74-80."""
-    return Pod(name=f"pod-{index}", address=f"192.168.1.{index + 1}:8000")
+def fake_pod(index: int, role: str = "collocated") -> Pod:
+    """test/utils.go:74-80 (+ disaggregation role for role-split rigs)."""
+    return Pod(name=f"pod-{index}", address=f"192.168.1.{index + 1}:8000",
+               role=role)
 
 
 def fake_metrics(

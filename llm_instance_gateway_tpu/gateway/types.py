@@ -21,6 +21,20 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass, field
 
+# Pool roles under cross-engine prefill/decode disaggregation
+# (server/kv_transfer.py).  A pool mixing "prefill" and "decode" replicas
+# gets two-stage routing (scheduler.schedule_disaggregated); "collocated"
+# replicas serve whole requests single-hop (the reference topology).
+ROLE_COLLOCATED = "collocated"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+POOL_ROLES = (ROLE_COLLOCATED, ROLE_PREFILL, ROLE_DECODE)
+
+
+def pod_role(pod) -> str:
+    """A pod's disaggregation role, defaulting legacy objects to collocated."""
+    return getattr(pod, "role", ROLE_COLLOCATED) or ROLE_COLLOCATED
+
 
 @dataclass(frozen=True)
 class Pod:
@@ -28,11 +42,14 @@ class Pod:
 
     ``address`` is ``host:port`` of the replica's serving endpoint.  For a
     multi-host slice this is the slice leader (SURVEY.md §7: "the pod is
-    actually the slice's leader host").
+    actually the slice's leader host").  ``role`` marks prefill/decode
+    specialization for disaggregated pools (collocated = serves both
+    phases, the default and the reference behavior).
     """
 
     name: str
     address: str
+    role: str = ROLE_COLLOCATED
 
     def __str__(self) -> str:  # parity: types.go Pod.String()
         return f"{self.name}({self.address})"

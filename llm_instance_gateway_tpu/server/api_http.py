@@ -65,6 +65,9 @@ class ModelServer:
         app = web.Application()
         app.router.add_post("/v1/completions", self.handle_completions)
         app.router.add_post("/v1/chat/completions", self.handle_chat)
+        # Cross-engine disaggregation hops (gateway/proxy.py two-hop relay).
+        app.router.add_post("/v1/prefill", self.handle_prefill)
+        app.router.add_post("/v1/attach", self.handle_attach)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_post("/v1/load_lora_adapter", self.handle_load_adapter)
         app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
@@ -167,15 +170,19 @@ class ModelServer:
         return n, best_of, logprobs, [s for s in stops if s]
 
     def _wait_with_stops(self, req: Request, stops: list[str],
-                         timeout_s: float = 600.0) -> Request:
+                         timeout_s: float = 600.0,
+                         submit: bool = True) -> Request:
         """generate(), plus early cancellation the moment a stop string
         appears in the decoded text (the exact cut happens afterwards in
         _truncate_at_stop — generation must not keep burning the slot).
 
         Decoding is incremental (only unconsumed tokens) and the stop search
         only rescans a window the new piece could have completed, so a long
-        generation stays O(n), not O(n^2), on the executor thread."""
-        self.engine.submit(req)
+        generation stays O(n), not O(n^2), on the executor thread.
+        ``submit=False`` waits on an ALREADY-submitted request (the attach
+        hop admits through ``engine.attach_prefilled``)."""
+        if submit:
+            self.engine.submit(req)
         deadline = time.monotonic() + timeout_s
         max_stop = max((len(s) for s in stops), default=0)
         text = ""
@@ -394,7 +401,8 @@ class ModelServer:
                           object_name: str, make_delta,
                           timeout_s: float = 600.0,
                           stops: list[str] | None = None,
-                          echo_prefix: str | None = None):
+                          echo_prefix: str | None = None,
+                          submit: bool = True):
         """Server-sent-events generation stream (OpenAI stream=true shape).
 
         Tokens appear in ``req.output_tokens`` as the engine decodes (in
@@ -405,14 +413,15 @@ class ModelServer:
         429 (the gateway's backpressure contract), and the done flag is read
         BEFORE the token count so the final re-diff can't drop a tail.
         """
-        try:
-            self.engine.submit(req)
-        except EngineDraining as e:
-            return _err(503, str(e))  # replica is leaving the routable set
-        except ValueError as e:
-            return _err(400, str(e))
-        except queue_mod.Full:
-            return _err(429, "prefill queue is full")
+        if submit:
+            try:
+                self.engine.submit(req)
+            except EngineDraining as e:
+                return _err(503, str(e))  # replica leaving the routable set
+            except ValueError as e:
+                return _err(400, str(e))
+            except queue_mod.Full:
+                return _err(429, "prefill queue is full")
 
         # From here the request occupies engine capacity: ANY exit before
         # completion (disconnect during prepare, write failure, handler
@@ -758,6 +767,161 @@ class ModelServer:
             },
         })
 
+    # -- disaggregation hops (server/kv_transfer.py) -------------------------
+    async def handle_prefill(self, request: web.Request) -> web.Response:
+        """Hop 1: prefill only, return the serialized ``PrefillHandoff``.
+
+        Accepts the standard completions/chat body.  Shapes the handoff
+        path can't carry (candidate fan-out, echo) and prompts beyond the
+        prefill bucket answer 422 — the gateway treats any non-200 as
+        "serve this single-hop instead", so unsupported requests degrade,
+        never fail.
+        """
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        try:
+            adapter = self._resolve_model(body.get("model", self.model_name))
+        except AdapterError as e:
+            return _err(404, str(e))
+        try:
+            n, best_of, logprobs, _stops = self._parse_choice_params(body)
+            if isinstance(body.get("messages"), list):
+                prompt, add_bos = self._chat_prompt(body["messages"])
+                prompt_tokens = self.tokenizer.encode(prompt, add_bos=add_bos)
+                lp_flag, top_n = self._parse_chat_logprobs(body)
+                logprobs = top_n if lp_flag else None
+            else:
+                prompt_tokens = self._encode_prompt(body)
+        except (ValueError, TypeError) as e:
+            return _err(400, str(e))
+        if n > 1 or best_of > 1 or body.get("echo"):
+            return _err(422, "prefill hop supports single-candidate, "
+                             "non-echo requests")
+        req = self._make_request(body, prompt_tokens, adapter,
+                                 logprobs=logprobs)
+        loop = asyncio.get_running_loop()
+        try:
+            handoff = await loop.run_in_executor(
+                None, lambda: self.engine.prefill_only(req))
+        except EngineDraining as e:
+            return _err(503, str(e))
+        except queue_mod.Full:
+            return _err(429, "prefill queue is full")
+        except ValueError as e:
+            return _err(422, str(e))  # e.g. prompt beyond the bucket set
+        except RuntimeError as e:
+            return _err(500, str(e))
+        handoff.body = body  # envelope params ride to the decode hop
+        return web.Response(
+            body=handoff.to_bytes(),
+            content_type="application/octet-stream",
+            headers={"x-request-id": req.request_id,
+                     "x-prefill-ttft-ms": f"{req.ttft_s * 1000:.2f}"},
+        )
+
+    async def handle_attach(self, request: web.Request) -> web.Response:
+        """Hop 2: admit a ``PrefillHandoff`` straight into decode and answer
+        in the normal OpenAI envelope (streaming included) — the client
+        response is indistinguishable from collocated serving."""
+        from llm_instance_gateway_tpu.server.kv_transfer import PrefillHandoff
+
+        raw = await request.read()
+        try:
+            handoff = PrefillHandoff.from_bytes(raw)
+        except Exception as e:
+            return _err(400, f"malformed handoff: {e}")
+        body = handoff.body or {}
+        chat = isinstance(body.get("messages"), list)
+        try:
+            _, _, _, stops = self._parse_choice_params(body)
+        except (ValueError, TypeError) as e:
+            return _err(400, str(e))
+        try:
+            req = self.engine.attach_prefilled(handoff)
+        except EngineDraining as e:
+            return _err(503, str(e))
+        except queue_mod.Full:
+            return _err(429, "attach admission queue is full")
+        except AdapterError as e:
+            return _err(404, str(e))
+        except ValueError as e:
+            return _err(422, str(e))
+        model = body.get("model", self.model_name)
+        if body.get("stream"):
+            if chat:
+                return await self._stream_sse(
+                    request, req, model, "chat.completion.chunk",
+                    lambda delta, fin: {
+                        "index": 0,
+                        "delta": ({"content": delta} if delta else {}),
+                        "finish_reason": fin,
+                    },
+                    stops=stops, submit=False)
+            return await self._stream_sse(
+                request, req, model, "text_completion",
+                lambda delta, fin: {"index": 0, "text": delta,
+                                    "finish_reason": fin},
+                stops=stops, submit=False)
+        loop = asyncio.get_running_loop()
+        try:
+            if stops:
+                await loop.run_in_executor(
+                    None, lambda: self._wait_with_stops(
+                        req, stops, submit=False))
+            else:
+                await loop.run_in_executor(None, req.done.wait, 600.0)
+        except asyncio.CancelledError:
+            req.cancelled.set()
+            raise
+        if not req.done.is_set():
+            req.error = "generation timed out"
+            req.cancelled.set()
+        if req.error:
+            return _err(500, req.error)
+        text, _ = self._truncate_at_stop(req, stops)
+        completion_tokens = len(req.output_tokens)
+        usage = {
+            "prompt_tokens": len(req.prompt_tokens),
+            "completion_tokens": completion_tokens,
+            "total_tokens": len(req.prompt_tokens) + completion_tokens,
+        }
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": req.finish_reason,
+            }
+            if req.logprobs is not None:
+                choice["logprobs"] = self._chat_logprobs_json(
+                    req, req.logprobs, text_limit=len(text))
+            return web.json_response({
+                "id": f"chatcmpl-{req.request_id}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": model,
+                "choices": [choice],
+                "usage": usage,
+            })
+        choice = {
+            "index": 0,
+            "text": text,
+            "finish_reason": req.finish_reason,
+        }
+        if req.logprobs is not None:
+            choice["logprobs"] = self._logprobs_json(
+                req, req.logprobs, text_limit=len(text))
+        return web.json_response({
+            "id": f"cmpl-{req.request_id}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [choice],
+            "usage": usage,
+            "ttft_ms": round(req.ttft_s * 1000, 2),
+        })
+
     # -- admin -------------------------------------------------------------
     async def handle_models(self, request: web.Request) -> web.Response:
         data = [{"id": self.model_name, "object": "model", "root": self.model_name}]
@@ -930,6 +1094,14 @@ def main(argv=None) -> None:
              "otherwise — dev mode)",
     )
     parser.add_argument(
+        "--role", choices=("collocated", "prefill", "decode"),
+        default="collocated",
+        help="disaggregation role: 'prefill' replicas serve /v1/prefill "
+             "handoffs, 'decode' replicas admit them via /v1/attach, "
+             "'collocated' (default) serves whole requests; the role is "
+             "advisory — every server keeps the full API",
+    )
+    parser.add_argument(
         "--prefix-cache", action="store_true",
         help="retain finished prompts' full KV blocks (content-addressed, "
              "refcounted) so prompts sharing a prefix skip recomputing it; "
@@ -1043,6 +1215,7 @@ def main(argv=None) -> None:
             paged_kv_block=args.paged_kv_block,
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,
+            role=args.role,
             speculative_k=args.speculative,
             kv_cache_quant=(None if args.kv_quantize == "none"
                             else args.kv_quantize),

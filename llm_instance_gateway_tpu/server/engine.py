@@ -156,6 +156,14 @@ class EngineConfig:
     # VMEM at the MXU feed), so the bandwidth win and the kernel win stack
     # on the lane path AND on the paged gathered view.
     kv_cache_quant: str | None = None
+    # Pool role under cross-engine prefill/decode disaggregation
+    # (server/kv_transfer.py): "prefill" replicas serve prefill_only()
+    # handoffs, "decode" replicas serve attach_prefilled() imports,
+    # "collocated" runs both phases locally (the default).  The role is
+    # ADVISORY — every engine keeps the full API in every role, so a
+    # gateway can always fall back to single-hop serving — but it is
+    # exported via /metrics and drives the gateway's two-stage routing.
+    role: str = "collocated"
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -221,6 +229,9 @@ class Request:
     # best_of ranking); 1..LOGPROB_TOPK = also that many top alternatives.
     logprobs: int | None = None
     # Lifecycle (filled by the engine).
+    # Cross-engine disaggregation: prefill_only() deposits the request's
+    # PrefillHandoff here (finish_reason "handoff").
+    handoff: object = None
     output_tokens: list[int] = field(default_factory=list)
     output_logprobs: list[float] = field(default_factory=list)
     output_top_logprobs: list[dict[int, float]] = field(default_factory=list)
@@ -320,6 +331,13 @@ class _WaitingPrefill:
     first_token_host: int | None = None  # sync mode: already-emitted token
     # First-token (lp, top_v, top_i) device tuple; None once recorded.
     lp_info: object = None
+    # Cross-engine attach: the first token was already emitted (on THIS
+    # engine, at attach admission) — the pipelined insert must not schedule
+    # a second pending-first materialization.
+    first_emitted: bool = False
+    # Imported via attach_prefilled: the insert may map already-cached
+    # prefix blocks instead of re-writing identical content.
+    from_handoff: bool = False
 
 
 @dataclass
@@ -897,10 +915,10 @@ class Engine:
     def draining(self) -> bool:
         return self._draining
 
-    def submit(self, request: Request) -> Request:
-        """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
-        if self._draining:
-            raise EngineDraining("engine is draining (graceful termination)")
+    def _validate_sampling(self, request: Request) -> None:
+        """Sampling-parameter gates shared by submit() and
+        attach_prefilled() — a handoff's sampling carry crosses a trust
+        boundary and must clear the same bars as a direct submission."""
         sp = request.sampling
         if self._spec and (sp.presence_penalty or sp.frequency_penalty
                            or sp.logit_bias):
@@ -920,6 +938,12 @@ class Engine:
                     raise ValueError(
                         f"logit_bias token id {tid} is outside the "
                         f"vocabulary [0, {self.model_cfg.vocab_size})")
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue; raises queue.Full when saturated (gateway sees the depth)."""
+        if self._draining:
+            raise EngineDraining("engine is draining (graceful termination)")
+        self._validate_sampling(request)
         if len(request.prompt_tokens) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {len(request.prompt_tokens)} exceeds max_seq_len "
@@ -970,6 +994,94 @@ class Engine:
         return request
 
     # ------------------------------------------------------------------
+    # cross-engine prefill/decode disaggregation (server/kv_transfer.py)
+    # ------------------------------------------------------------------
+
+    def prefill_only(self, request: Request, timeout_s: float = 600.0,
+                     quantize: str | None = None):
+        """Run ONLY the prefill and return a ``PrefillHandoff`` (hop 1 of
+        disaggregated serving).  No decode slot, no cache lane, no pool
+        block is touched — a prefill-role replica serves these regardless
+        of decode occupancy.
+
+        The wire lane defaults to int8 on an int8-KV engine (the decode
+        side re-quantizes to the identical values — see kv_transfer's
+        parity note) and the raw compute dtype otherwise.  Prompts beyond
+        the largest bucket are refused: the chunk-stream and ring paths
+        write into THIS engine's cache, which is exactly what a handoff
+        exists to avoid.
+        """
+        n = len(request.prompt_tokens)
+        if self._max_bucket() <= 0 or n > self._max_bucket():
+            raise ValueError(
+                f"prefill_only supports prompts within the largest bucket "
+                f"({self._max_bucket()}); got {n} tokens")
+        request._handoff_only = True
+        request._handoff_quantize = (
+            quantize if quantize is not None
+            else ("int8" if self._kv_quant else None))
+        self.submit(request)
+        if not request.done.wait(timeout_s):
+            request.error = "prefill timed out"
+            request.cancelled.set()
+        if request.error:
+            raise RuntimeError(request.error)
+        return request.handoff
+
+    def attach_prefilled(self, handoff) -> Request:
+        """Admit a ``PrefillHandoff`` straight into decode (hop 2): the KV
+        imports into this engine's cache and the request decodes from its
+        carried first token — prefill is skipped entirely.  Returns the
+        live ``Request`` (already submitted); callers wait on ``done`` /
+        ``stream_event`` exactly like after ``submit``.
+        """
+        from llm_instance_gateway_tpu.server import kv_transfer
+
+        request = kv_transfer.make_request(handoff)
+        if self._draining:
+            raise EngineDraining("engine is draining (graceful termination)")
+        if handoff.n != len(request.prompt_tokens) or handoff.n <= 0:
+            raise ValueError("handoff length/prompt mismatch")
+        self._validate_sampling(request)
+        expect = (self.model_cfg.n_layers, handoff.n,
+                  self.model_cfg.n_kv_heads,
+                  self.model_cfg.resolved_head_dim)
+        if (tuple(handoff.k.shape) != expect
+                or tuple(handoff.v.shape) != expect):
+            # Fail at admission (caller-visible), not as a 500 inside the
+            # engine loop: the handoff was produced for a DIFFERENT model.
+            raise ValueError(
+                f"handoff KV shape {tuple(handoff.k.shape)} does not match "
+                f"this engine's cache layout {expect}")
+        if len(request.prompt_tokens) >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(request.prompt_tokens)} exceeds "
+                f"max_seq_len {self.cfg.max_seq_len}")
+        if self.paged and self._paged_needed(
+                len(request.prompt_tokens) + 1) > self._n_blocks:
+            raise ValueError(
+                f"prompt needs "
+                f"{self._paged_needed(len(request.prompt_tokens) + 1)} KV "
+                f"blocks but the pool has {self._n_blocks}")
+        request.t_submit = time.time()
+        if request.adapter is not None and self.lora is not None:
+            # Same pin discipline as submit(): unknown adapters fail fast,
+            # resident ones can't swap out mid-generation.
+            self.lora.acquire(request.adapter)
+        request._attach_handoff = handoff
+        try:
+            self.prefill_queue.put_nowait(request)
+        except queue_mod.Full:
+            if request.adapter is not None and self.lora is not None:
+                self.lora.release(request.adapter)
+            raise
+        with self._lock:
+            self.total_requests += 1
+        with self._work:
+            self._work.notify()
+        return request
+
+    # ------------------------------------------------------------------
     # metrics snapshot (the scrape contract, gateway/metrics_client.py)
     # ------------------------------------------------------------------
 
@@ -1007,6 +1119,7 @@ class Engine:
             1 if self._stream is not None else 0) + self._admitting
         decode_depth = len(self.decode_wait)
         return {
+            "pool_role": self.cfg.role,
             "prefill_queue_size": prefill_depth,
             "decode_queue_size": decode_depth,  # prefilled, awaiting a slot
             "num_requests_running": active,
@@ -1323,6 +1436,34 @@ class Engine:
                 self._finish(req, "cancelled")
                 did = True
                 continue
+            if getattr(req, "_handoff_only", False):
+                # Disaggregation hop 1: prefill with NO slot and NO cache
+                # write — the KV leaves as a handoff, so this admits even
+                # when every slot is busy and the pool is dry.
+                self._pending = None
+                self._admitting += 1
+                try:
+                    self._do_prefill_handoff(req)
+                finally:
+                    self._admitting -= 1
+                did = True
+                continue
+            if getattr(req, "_attach_handoff", None) is not None:
+                # Disaggregation hop 2: the KV is already computed; park it
+                # in decode_wait (the same seam prefill-ahead uses) and let
+                # the normal drain admit it into a slot.  The cap bounds
+                # imported-but-unslotted KV exactly like prefill-ahead KV.
+                if len(self.decode_wait) >= cap:
+                    break
+                self._pending = None
+                self._admitting += 1
+                try:
+                    self._do_attach(req, pipelined)
+                finally:
+                    self._admitting -= 1
+                self._drain_decode_wait(pipelined)
+                did = True
+                continue
             if self._free_slot_index() is not None:
                 if self.decode_wait:
                     # The parked head couldn't take this slot (pool
@@ -1444,6 +1585,85 @@ class Engine:
             req.error = str(e)
             self._finish(req, "error")
 
+    def _do_prefill_handoff(self, req: Request) -> None:
+        """Prefill with NO slot and NO cache write: the prompt KV leaves
+        the engine as a serializable ``PrefillHandoff`` (hop 1 of
+        cross-engine disaggregation).  TTFT here measures pure prefill
+        latency — the token itself is emitted by the decode engine."""
+        from llm_instance_gateway_tpu.server import kv_transfer
+
+        if req.cancelled.is_set():
+            self._finish(req, "cancelled")
+            return
+        try:
+            n = len(req.prompt_tokens)
+            lora_slot = (self.lora.slot_for(req.adapter)
+                         if self.lora is not None else -1)
+            first_token, k, v, lp_info = self._bucket_prefill(
+                req, n, lora_slot)
+            req.handoff = kv_transfer.export_handoff(
+                req, k, v, n, int(first_token),
+                lp_info=tuple(np.asarray(a) for a in lp_info),
+                quantize=getattr(req, "_handoff_quantize", None))
+            req.t_first_token = time.time()
+            self._record_ttft(req)
+            self._finish(req, "handoff")
+        except Exception as e:  # engine must survive a poison request
+            logger.exception("handoff prefill failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
+    def _handoff_device_kv(self, handoff):
+        """Handoff KV -> device arrays shaped like a bucketed prefill's
+        output (``[L, 1, pad_to, Kh, hd]``), so the existing insert seams
+        (lane dynamic-slice / paged block scatter, quantizing variants
+        included) consume it unchanged.  Padding to the engine's own bucket
+        set keeps the insert's compiled-shape set bounded."""
+        k_np, v_np = handoff.kv_arrays()
+        n = handoff.n
+        if n <= self._max_bucket():
+            pad_to = self._bucket(n)
+        else:
+            step = max(self._max_bucket(), 1)
+            pad_to = min(-(-n // step) * step, self.cfg.max_seq_len)
+        lyr, _, kh, hd = k_np.shape
+        kp = np.zeros((lyr, 1, pad_to, kh, hd), k_np.dtype)
+        vp = np.zeros((lyr, 1, pad_to, kh, hd), v_np.dtype)
+        kp[:, 0, :n] = k_np
+        vp[:, 0, :n] = v_np
+        return jnp.asarray(kp), jnp.asarray(vp)
+
+    def _do_attach(self, req: Request, pipelined: bool) -> None:
+        """Import a handoff's KV and park it in ``decode_wait`` — from
+        there the normal drain inserts it into a freed slot (allocating
+        pool blocks, registering the prefix-cache chain) and decode starts
+        at the carried position.  The first token is emitted HERE, like a
+        sync prefill-ahead park: TTFT on this engine is attach latency,
+        and a one-token request finishes without ever taking a slot."""
+        handoff = req._attach_handoff
+        if req.cancelled.is_set():
+            self._finish(req, "cancelled")
+            return
+        try:
+            lora_slot = (self.lora.slot_for(req.adapter)
+                         if self.lora is not None else -1)
+            k, v = self._handoff_device_kv(handoff)
+            if self._emit_first_token(req, handoff.first_token,
+                                      handoff.first_lp_info()):
+                return  # finished at attach; never needed a slot
+            w = _WaitingPrefill(
+                request=req,
+                first_token=jnp.asarray(handoff.first_token, jnp.int32),
+                k=k, v=v, n=handoff.n, lora_slot=lora_slot,
+                first_token_host=handoff.first_token,
+                lp_info=None, first_emitted=True, from_handoff=True)
+            self.decode_wait.append(w)
+            self._parked_kv_tokens += w.k.shape[2]
+        except Exception as e:  # engine must survive a poison handoff
+            logger.exception("attach failed for %s", req.request_id)
+            req.error = str(e)
+            self._finish(req, "error")
+
     def _activate_slot_pipelined(self, slot_idx: int, req: Request,
                                  lora_slot: int, n: int, first_token,
                                  lp_info) -> None:
@@ -1478,12 +1698,31 @@ class Engine:
         """Insert a parked prefill's KV into a freed cache lane."""
         req = w.request
         try:
-            self._insert_prompt_kv(w.k, w.v, slot_idx, w.n)
+            skip_blocks = 0
+            if w.from_handoff and self._prefix_enabled:
+                # Handoff composing with prefix reuse: whole blocks this
+                # engine already caches for the prompt's prefix MAP into
+                # the row (refcounted table repoint, zero writes) and the
+                # insert scatter routes their positions to the trash block
+                # — a repeated attach re-writes only the suffix, and a
+                # shared live block is never re-scattered (identical
+                # content in theory, but another row may be mid-read).
+                reused = self._prefix_match_and_map(
+                    slot_idx, req.prompt_tokens, req.adapter)
+                skip_blocks = reused // self._block
+            self._insert_prompt_kv(w.k, w.v, slot_idx, w.n,
+                                   skip_leading_blocks=skip_blocks)
             self._prefix_register_row(slot_idx, req.prompt_tokens,
                                       req.adapter)
             if pipelined:
                 self._activate_slot_pipelined(
                     slot_idx, req, w.lora_slot, w.n, w.first_token, w.lp_info)
+                if w.first_emitted:
+                    # Attach path: the first token already reached the
+                    # request at admission — materializing pending_first
+                    # would emit it twice.  The device carry scatter above
+                    # still used it (correct: decode continues from it).
+                    self.slots[slot_idx].pending_first = None
             else:
                 self._register_slot(slot_idx, _Slot(
                     request=req, lora_slot=w.lora_slot, position=w.n))
@@ -2176,8 +2415,14 @@ class Engine:
                 req.error = str(e)
                 self._finish(req, "error")
 
-    def _insert_prompt_kv(self, k, v, slot_idx: int, n: int) -> None:
-        """Write a bucketed prefill's KV into the cache (lane or paged)."""
+    def _insert_prompt_kv(self, k, v, slot_idx: int, n: int,
+                          skip_leading_blocks: int = 0) -> None:
+        """Write a bucketed prefill's KV into the cache (lane or paged).
+
+        ``skip_leading_blocks`` (paged only) diverts that many leading
+        blocks' positions to the trash block — the attach path's
+        prefix-reuse composition, where those blocks are already mapped
+        from the cache and must not be re-scattered."""
         if not self.paged:
             self.cache = self._jit_insert(
                 self.cache, k, v, jnp.int32(slot_idx), jnp.int32(n)
@@ -2193,6 +2438,9 @@ class Engine:
         row_bl = self._row_blocks[slot_idx]
         # Wholly-padding bucket blocks scatter into the trash block.
         phys = row_bl + [paged_lib.TRASH_BLOCK] * (nb_bucket - len(row_bl))
+        if skip_leading_blocks:
+            phys = ([paged_lib.TRASH_BLOCK] * skip_leading_blocks
+                    + phys[skip_leading_blocks:])
         self._sync_tables()
         self.cache = self._jit_insert(
             self.cache, k, v, jnp.int32(slot_idx),

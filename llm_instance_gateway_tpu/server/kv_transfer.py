@@ -1,0 +1,307 @@
+"""Cross-engine prefill/decode disaggregation: the KV-block handoff plane.
+
+Disaggregated serving (InfiniLoRA, CaraServe; PAPERS.md) runs prefill and
+decode on SEPARATE replicas so a long prefill never steals MXU time from
+co-resident decode slots.  The engine already disaggregates the two phases
+*inside* one process (``decode_wait`` parks prefilled KV off-cache until a
+slot frees); this module is the missing cross-process seam:
+
+- ``PrefillHandoff``: a prefilled request's paged KV blocks (raw engine-dtype
+  or int8-quantized lanes, per layer) plus the sampling carry — the first
+  sampled token, its logprob info, position, and everything needed to rebuild
+  the ``Request`` on the decode side (sampling params, stop ids, the original
+  OpenAI body for envelope shaping).
+- A compact self-describing wire format (``to_bytes``/``from_bytes``): one
+  JSON header + raw little-endian array payloads.  No pickle — handoffs cross
+  trust boundaries between replicas.
+
+The engine half lives in ``server/engine.py``: ``Engine.prefill_only()``
+produces a handoff on a prefill-role replica (no decode slot touched);
+``Engine.attach_prefilled()`` admits it straight into a decode slot on a
+decode-role replica, skipping prefill entirely.  The gateway half
+(``gateway/scheduling/scheduler.py`` pool roles + ``gateway/proxy.py``
+two-hop relay) routes one request across both.
+
+Quantization note (token parity): the int8 wire lane quantizes with the
+exact math of the engine's KV-cache quantizer (``transformer._kv_quantize``
+— symmetric per-(position, kv-head) max-abs, f32 scales).  Dequantize →
+re-quantize is value-stable (the max element maps to exactly ±127, so the
+scale round-trips), which is what keeps a quantized decode engine's cache
+bit-identical to collocated serving when fed either wire lane.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_MAGIC = b"LIGKVH1\n"
+
+# Wire dtypes are whitelisted: the header is attacker-influencable text and
+# np.dtype() resolves arbitrary strings (object dtypes included).
+_WIRE_DTYPES = ("float32", "float16", "bfloat16", "int8")
+
+
+def _np_dtype(name: str):
+    if name not in _WIRE_DTYPES:
+        raise ValueError(f"unsupported handoff dtype {name!r}")
+    if name == "bfloat16":
+        import ml_dtypes  # jax dependency; always importable next to it
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _quantize_host(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host mirror of ``transformer._kv_quantize``: [..., hd] -> (int8,
+    f32 scale [...]) — same f32 math and half-to-even rounding, so a wire
+    round-trip re-quantizes to identical values."""
+    xf = np.asarray(x, np.float32)
+    s = np.maximum(np.max(np.abs(xf), axis=-1) / 127.0, 1e-8)
+    q = np.clip(np.round(xf / s[..., None]), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+@dataclass
+class PrefillHandoff:
+    """One prefilled request, serializable across an engine boundary.
+
+    KV layout: ``k``/``v`` are ``[L, n, Kh, hd]`` trimmed to the true prompt
+    length (no bucket padding crosses the wire).  ``kv_format`` is ``"raw"``
+    (engine compute dtype, recorded in ``kv_dtype``) or ``"int8"``
+    (``k``/``v`` int8 + ``k_scale``/``v_scale`` f32 ``[L, n, Kh]``).
+    """
+
+    request_id: str
+    prompt_tokens: list[int]
+    n: int
+    adapter: str | None
+    max_new_tokens: int
+    sampling: dict
+    stop_token_ids: list[int]
+    logprobs: int | None
+    # Sampling carry: the prefill's sampled first token and its logprob info.
+    first_token: int
+    first_lp: float | None
+    first_top_vals: list[float] | None
+    first_top_ids: list[int] | None
+    t_submit: float
+    kv_format: str
+    kv_dtype: str
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: np.ndarray | None = None
+    v_scale: np.ndarray | None = None
+    # Original OpenAI request body (dict) — the decode hop shapes the client
+    # envelope (stream/stop/logprobs/model name) from it.
+    body: dict | None = None
+    _extra: dict = field(default_factory=dict)
+
+    # -- KV access ----------------------------------------------------------
+
+    @property
+    def n_layers(self) -> int:
+        return int(self.k.shape[0])
+
+    @property
+    def kv_tokens(self) -> int:
+        return int(self.k.shape[1])
+
+    def kv_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(k, v) as float arrays ``[L, n, Kh, hd]`` ready for cache insert
+        (int8 wire lanes dequantize here; the decode engine's insert seam
+        re-quantizes if ITS cache is int8)."""
+        if self.kv_format == "int8":
+            k = self.k.astype(np.float32) * self.k_scale[..., None]
+            v = self.v.astype(np.float32) * self.v_scale[..., None]
+            return k, v
+        return self.k, self.v
+
+    def first_lp_info(self):
+        """(lp, top_vals, top_ids) numpy tuple or None — the shape
+        ``Engine._store_logprobs`` consumes."""
+        if self.first_lp is None:
+            return None
+        return (np.float32(self.first_lp),
+                np.asarray(self.first_top_vals, np.float32),
+                np.asarray(self.first_top_ids, np.int32))
+
+    # -- wire format --------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        arrays = [("k", self.k), ("v", self.v)]
+        if self.kv_format == "int8":
+            arrays += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
+        meta = {
+            "request_id": self.request_id,
+            "prompt_tokens": list(map(int, self.prompt_tokens)),
+            "n": self.n,
+            "adapter": self.adapter,
+            "max_new_tokens": self.max_new_tokens,
+            "sampling": self.sampling,
+            "stop_token_ids": list(map(int, self.stop_token_ids)),
+            "logprobs": self.logprobs,
+            "first_token": self.first_token,
+            "first_lp": self.first_lp,
+            "first_top_vals": self.first_top_vals,
+            "first_top_ids": self.first_top_ids,
+            "t_submit": self.t_submit,
+            "kv_format": self.kv_format,
+            "kv_dtype": self.kv_dtype,
+            "body": self.body,
+            "arrays": [
+                {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
+                for name, a in arrays
+            ],
+        }
+        head = json.dumps(meta).encode()
+        out = [_MAGIC, struct.pack("<I", len(head)), head]
+        out += [np.ascontiguousarray(a).tobytes() for _, a in arrays]
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PrefillHandoff":
+        if data[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("not a PrefillHandoff payload (bad magic)")
+        off = len(_MAGIC)
+        (head_len,) = struct.unpack_from("<I", data, off)
+        off += 4
+        meta = json.loads(data[off:off + head_len].decode())
+        off += head_len
+        parsed: dict[str, np.ndarray] = {}
+        for spec in meta.pop("arrays"):
+            dt = _np_dtype(spec["dtype"])
+            shape = [int(d) for d in spec["shape"]]
+            if any(d < 0 for d in shape):
+                # A negative dim makes nbytes negative: the truncation
+                # check below would pass and the cursor would move
+                # BACKWARDS, aliasing array payloads.  Reject at the parse
+                # boundary (header text is attacker-influencable).
+                raise ValueError(f"negative dimension in handoff shape "
+                                 f"{shape}")
+            count = int(np.prod(shape, dtype=np.int64))
+            nbytes = count * dt.itemsize
+            if off + nbytes > len(data):
+                raise ValueError("truncated handoff payload")
+            parsed[spec["name"]] = np.frombuffer(
+                data, dtype=dt, count=count, offset=off
+            ).reshape(shape)
+            off += nbytes
+        samp = meta.get("sampling") or {}
+        if samp.get("logit_bias"):
+            # JSON stringifies int keys; restore them.
+            samp["logit_bias"] = {
+                int(k): float(v) for k, v in samp["logit_bias"].items()}
+        return cls(
+            request_id=meta["request_id"],
+            prompt_tokens=[int(t) for t in meta["prompt_tokens"]],
+            n=int(meta["n"]),
+            adapter=meta["adapter"],
+            max_new_tokens=int(meta["max_new_tokens"]),
+            sampling=samp,
+            stop_token_ids=[int(t) for t in meta["stop_token_ids"]],
+            logprobs=meta["logprobs"],
+            first_token=int(meta["first_token"]),
+            first_lp=meta["first_lp"],
+            first_top_vals=meta["first_top_vals"],
+            first_top_ids=meta["first_top_ids"],
+            t_submit=float(meta.get("t_submit") or 0.0),
+            kv_format=meta["kv_format"],
+            kv_dtype=meta["kv_dtype"],
+            k=parsed["k"],
+            v=parsed["v"],
+            k_scale=parsed.get("k_scale"),
+            v_scale=parsed.get("v_scale"),
+            body=meta.get("body"),
+        )
+
+
+def export_handoff(request, k, v, n: int, first_token: int, lp_info=None,
+                   quantize: str | None = None) -> PrefillHandoff:
+    """Build a handoff from a prefill's outputs.
+
+    ``k``/``v`` are the prefill programs' ``[L, 1, S_bucket, Kh, hd]`` device
+    (or host) arrays; only the first ``n`` positions cross the wire.
+    ``quantize="int8"`` halves the wire size (per-(position, kv-head) f32
+    scales, same math as the engine's int8 KV cache).
+    """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize={quantize!r}: only 'int8' (or None)")
+    k_np = np.asarray(k)[:, 0, :n]
+    v_np = np.asarray(v)[:, 0, :n]
+    sp = request.sampling
+    samp = {
+        "temperature": sp.temperature,
+        "top_k": sp.top_k,
+        "top_p": sp.top_p,
+        "seed": sp.seed,
+        "presence_penalty": sp.presence_penalty,
+        "frequency_penalty": sp.frequency_penalty,
+        "logit_bias": ({str(t): float(b) for t, b in sp.logit_bias.items()}
+                       if sp.logit_bias else None),
+    }
+    first_lp = first_top_vals = first_top_ids = None
+    if lp_info is not None and request.logprobs is not None:
+        lp, top_v, top_i = lp_info
+        first_lp = float(np.asarray(lp))
+        first_top_vals = np.asarray(top_v, np.float32).tolist()
+        first_top_ids = np.asarray(top_i, np.int32).tolist()
+    if quantize == "int8":
+        kq, ks = _quantize_host(k_np)
+        vq, vs = _quantize_host(v_np)
+        return PrefillHandoff(
+            request_id=request.request_id,
+            prompt_tokens=list(request.prompt_tokens), n=n,
+            adapter=request.adapter,
+            max_new_tokens=request.max_new_tokens,
+            sampling=samp, stop_token_ids=list(request.stop_token_ids),
+            logprobs=request.logprobs, first_token=int(first_token),
+            first_lp=first_lp, first_top_vals=first_top_vals,
+            first_top_ids=first_top_ids, t_submit=request.t_submit,
+            kv_format="int8", kv_dtype=str(k_np.dtype),
+            k=kq, v=vq, k_scale=ks, v_scale=vs,
+        )
+    return PrefillHandoff(
+        request_id=request.request_id,
+        prompt_tokens=list(request.prompt_tokens), n=n,
+        adapter=request.adapter,
+        max_new_tokens=request.max_new_tokens,
+        sampling=samp, stop_token_ids=list(request.stop_token_ids),
+        logprobs=request.logprobs, first_token=int(first_token),
+        first_lp=first_lp, first_top_vals=first_top_vals,
+        first_top_ids=first_top_ids, t_submit=request.t_submit,
+        kv_format="raw", kv_dtype=str(k_np.dtype), k=k_np, v=v_np,
+    )
+
+
+def make_request(handoff: PrefillHandoff):
+    """Rebuild the engine ``Request`` a handoff describes (decode side)."""
+    from llm_instance_gateway_tpu.server.engine import Request, SamplingParams
+
+    s = handoff.sampling
+    bias = s.get("logit_bias")
+    if bias:
+        # export_handoff stringifies keys for the JSON header; normalize
+        # here so a handoff attached WITHOUT a wire round-trip carries the
+        # same int-keyed dict the engine validates against.
+        bias = {int(k): float(v) for k, v in bias.items()}
+    return Request(
+        prompt_tokens=list(handoff.prompt_tokens),
+        max_new_tokens=handoff.max_new_tokens,
+        sampling=SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_k=int(s.get("top_k", 0)),
+            top_p=float(s.get("top_p", 1.0)),
+            seed=s.get("seed"),
+            presence_penalty=float(s.get("presence_penalty", 0.0)),
+            frequency_penalty=float(s.get("frequency_penalty", 0.0)),
+            logit_bias=bias,
+        ),
+        adapter=handoff.adapter,
+        stop_token_ids=tuple(handoff.stop_token_ids),
+        request_id=handoff.request_id,
+        logprobs=handoff.logprobs,
+    )

@@ -49,6 +49,15 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             time.time(),
         ),
     ]
+    if snapshot.get("pool_role"):
+        # Disaggregation role as a labeled info gauge (operators / future
+        # role-from-scrape discovery; the gateway's routing roles come from
+        # its own pod config).
+        lines += [
+            "# TYPE tpu:pool_role gauge",
+            'tpu:pool_role{role="%s"} 1'
+            % escape_label(snapshot["pool_role"]),
+        ]
     if "prefix_reused_tokens" in snapshot:
         lines += [
             "# TYPE tpu:prefix_reused_tokens counter",

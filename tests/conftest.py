@@ -42,3 +42,25 @@ except Exception:
     pass  # cache is an optimization, never a requirement
 
 sys.path.insert(0, _REPO)
+
+
+def pytest_configure(config):
+    """Build the C++ hot-path libraries BEFORE collection so the
+    native-scheduler parity fuzz (tests/test_native_scheduler.py) actually
+    executes: on a fresh checkout the committed .so can look stale
+    (arbitrary mtimes) and the first in-test build attempt races the
+    collection-time skipif.  When the toolchain is genuinely absent the
+    tests still skip — but with a LOUD warning here instead of a silent
+    's' in the dots."""
+    import warnings
+
+    from llm_instance_gateway_tpu.gateway.scheduling import native
+
+    if not native.available():
+        warnings.warn(
+            "native/libligsched.so could not be built or loaded — the "
+            "native-scheduler parity fuzz (tests/test_native_scheduler.py) "
+            "will be SKIPPED. Install g++/make or run `make native` and "
+            "re-run.",
+            stacklevel=1,
+        )

@@ -1,0 +1,300 @@
+"""Gateway-side disaggregated routing: pool roles + two-stage scheduling.
+
+The scheduler contract for role-split pools: hop 1 (prefill replica) picked
+by the FULL decision tree over the prefill-role set (prefill-queue/TTFT
+signals), hop 2 (decode replica) by KV-headroom/queue signals over the
+decode-role set; collocated pools keep the reference single-hop behavior
+bit-for-bit.  The request handler surfaces both picks (target-pod +
+x-decode-pod headers), and membership plumbing carries roles from --pod
+flags through endpoints to PodMetrics.
+"""
+
+import random
+
+import pytest
+
+from llm_instance_gateway_tpu.gateway.handlers.messages import RequestBody
+from llm_instance_gateway_tpu.gateway.handlers.server import (
+    RequestContext,
+    Server,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    SchedulingError,
+    build_decode_tree,
+    split_pool_roles,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import (
+    ROLE_COLLOCATED,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    Metrics,
+    Pod,
+    PodMetrics,
+    pod_role,
+)
+
+
+def pm(name, role=ROLE_COLLOCATED, queue=0, prefill=0, kv=0.0):
+    return PodMetrics(
+        pod=Pod(name=name, address=f"{name}:8000", role=role),
+        metrics=Metrics(waiting_queue_size=queue, prefill_queue_size=prefill,
+                        kv_cache_usage_percent=kv),
+    )
+
+
+class FakeProvider:
+    def __init__(self, pods):
+        self.pods = pods
+
+    def all_pod_metrics(self):
+        return list(self.pods)
+
+
+def req(critical=True, **kw):
+    return LLMRequest(model="m", resolved_target_model="m",
+                      critical=critical, **kw)
+
+
+class TestRolePartition:
+    def test_default_role_is_collocated(self):
+        assert pod_role(Pod("a", "a:1")) == ROLE_COLLOCATED
+
+    def test_split(self):
+        pods = [pm("c0"), pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE),
+                pm("p1", ROLE_PREFILL)]
+        prefills, decodes = split_pool_roles(pods)
+        assert {p.pod.name for p in prefills} == {"p0", "p1"}
+        assert {p.pod.name for p in decodes} == {"d0"}
+
+
+class TestTwoStageScheduling:
+    def test_collocated_pool_stays_single_hop(self):
+        sched = Scheduler(FakeProvider([pm("c0"), pm("c1")]),
+                          rng=random.Random(0))
+        prefill_pod, decode_pod = sched.schedule_disaggregated(req())
+        assert decode_pod is None
+        assert prefill_pod.name in {"c0", "c1"}
+
+    def test_two_stage_pick_respects_roles(self):
+        pods = [pm("p0", ROLE_PREFILL), pm("p1", ROLE_PREFILL),
+                pm("d0", ROLE_DECODE), pm("d1", ROLE_DECODE)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(1))
+        for _ in range(10):
+            prefill_pod, decode_pod = sched.schedule_disaggregated(req())
+            assert prefill_pod.name.startswith("p")
+            assert decode_pod.name.startswith("d")
+
+    def test_prefill_hop_routes_on_prefill_queue(self):
+        pods = [pm("p0", ROLE_PREFILL, prefill=9, queue=9),
+                pm("p1", ROLE_PREFILL, prefill=0),
+                pm("d0", ROLE_DECODE)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(2))
+        for _ in range(10):
+            prefill_pod, _ = sched.schedule_disaggregated(req())
+            assert prefill_pod.name == "p1"
+
+    def test_decode_hop_routes_on_kv_headroom(self):
+        pods = [pm("p0", ROLE_PREFILL),
+                pm("d0", ROLE_DECODE, kv=0.9),
+                pm("d1", ROLE_DECODE, kv=0.1)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(3))
+        for _ in range(10):
+            _, decode_pod = sched.schedule_disaggregated(req())
+            assert decode_pod.name == "d1"
+
+    def test_single_hop_prefers_collocated_replicas(self):
+        pods = [pm("c0"), pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(4))
+        for _ in range(10):
+            assert sched.schedule(req()).name == "c0"
+
+    def test_single_hop_fallback_in_fully_split_pool(self):
+        """Roles are advisory: with no collocated replica, plain schedule()
+        still routes (degraded single-hop)."""
+        pods = [pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(5))
+        assert sched.schedule(req()).name in {"p0", "d0"}
+
+    def test_missing_decode_side_falls_back(self):
+        pods = [pm("p0", ROLE_PREFILL), pm("c0")]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(6))
+        prefill_pod, decode_pod = sched.schedule_disaggregated(req())
+        assert decode_pod is None
+        assert prefill_pod.name == "c0"  # collocated preferred single-hop
+
+    def test_decode_tree_token_headroom_is_advisory(self):
+        tree = build_decode_tree(token_aware=True)
+        tight = pm("d0", ROLE_DECODE)
+        tight.metrics.kv_tokens_capacity = 100
+        tight.metrics.kv_tokens_free = 1
+        # No pod has headroom for 5000 tokens: the filter falls back to the
+        # KV/queue stages instead of dead-ending.
+        out = tree.filter(req(prompt_tokens=5000), [tight])
+        assert [p.pod.name for p in out] == ["d0"]
+
+    def test_shed_propagates_from_prefill_stage(self):
+        pods = [pm("p0", ROLE_PREFILL, queue=500, kv=0.99),
+                pm("d0", ROLE_DECODE)]
+        sched = Scheduler(FakeProvider(pods), rng=random.Random(7))
+        with pytest.raises(SchedulingError) as e:
+            sched.schedule_disaggregated(req(critical=False))
+        assert e.value.shed
+
+
+class TestNativeTwoStage:
+    def _native(self, pods, seed=0):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler unavailable")
+        return native.NativeScheduler(FakeProvider(pods),
+                                      rng=random.Random(seed))
+
+    def test_two_stage_pick_respects_roles(self):
+        sched = self._native([
+            pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE, kv=0.9),
+            pm("d1", ROLE_DECODE, kv=0.1)])
+        for _ in range(10):
+            prefill_pod, decode_pod = sched.schedule_disaggregated(req())
+            assert prefill_pod.name == "p0"
+            assert decode_pod.name == "d1"
+
+    def test_collocated_pool_stays_single_hop(self):
+        sched = self._native([pm("c0"), pm("c1")])
+        prefill_pod, decode_pod = sched.schedule_disaggregated(req())
+        assert decode_pod is None
+
+    def test_single_hop_prefers_collocated(self):
+        sched = self._native([pm("c0"), pm("p0", ROLE_PREFILL),
+                              pm("d0", ROLE_DECODE)], seed=1)
+        for _ in range(10):
+            assert sched.schedule(req()).name == "c0"
+
+
+class TestAdmissionPassThrough:
+    def test_delegates_two_stage(self):
+        from llm_instance_gateway_tpu.gateway.scheduling.admission import (
+            AdmissionController,
+        )
+
+        pods = [pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE)]
+        ctl = AdmissionController(
+            Scheduler(FakeProvider(pods), rng=random.Random(0)))
+        prefill_pod, decode_pod = ctl.schedule_disaggregated(req())
+        assert prefill_pod.name == "p0" and decode_pod.name == "d0"
+        assert ctl.prefix_index is not None  # drop-in surface for handlers
+
+
+class TestHandlerHeaders:
+    def _server(self, pods):
+        from llm_instance_gateway_tpu.api.v1alpha1 import (
+            InferencePool,
+            InferencePoolSpec,
+        )
+        from llm_instance_gateway_tpu.gateway.datastore import Datastore
+        from llm_instance_gateway_tpu.gateway.testing import make_model
+
+        ds = Datastore(pods=[p.pod for p in pods])
+        ds.set_pool(InferencePool(
+            name="t", spec=InferencePoolSpec(selector={})))
+        ds.store_model(make_model("m"))
+        return Server(Scheduler(FakeProvider(pods),
+                                rng=random.Random(0)), ds)
+
+    def test_decode_pod_header_set_for_disagg_pick(self):
+        server = self._server(
+            [pm("p0", ROLE_PREFILL), pm("d0", ROLE_DECODE)])
+        ctx = RequestContext()
+        result = server.process(
+            ctx, RequestBody(body=b'{"model": "m", "prompt": "hi"}'))
+        assert result.set_headers[server.target_pod_header] == "p0:8000"
+        assert result.set_headers[server.decode_pod_header] == "d0:8000"
+        assert ctx.decode_pod.name == "d0"
+
+    def test_no_decode_header_for_collocated_pool(self):
+        server = self._server([pm("c0")])
+        ctx = RequestContext()
+        result = server.process(
+            ctx, RequestBody(body=b'{"model": "m", "prompt": "hi"}'))
+        assert server.decode_pod_header not in result.set_headers
+        assert ctx.decode_pod is None
+
+    def test_prefix_hashes_skipped_when_prefix_unaware(self):
+        """Satellite: the chained-hash computation is dead weight when the
+        scheduler has no index — the handler must not pay it."""
+        from unittest import mock
+
+        server = self._server([pm("c0")])
+        server.scheduler.prefix_index = None  # prefix_aware=False build
+        with mock.patch(
+            "llm_instance_gateway_tpu.gateway.handlers.request.prefix_hashes"
+        ) as hashes:
+            ctx = RequestContext()
+            server.process(ctx, RequestBody(
+                body=b'{"model": "m", "prompt": "' + b"x" * 2048 + b'"}'))
+            hashes.assert_not_called()
+
+
+class TestMembershipRoles:
+    def test_endpoints_reconciler_carries_role(self):
+        from llm_instance_gateway_tpu.api.v1alpha1 import (
+            InferencePool,
+            InferencePoolSpec,
+        )
+        from llm_instance_gateway_tpu.gateway.controllers.reconcilers import (
+            Endpoint,
+            EndpointsReconciler,
+        )
+        from llm_instance_gateway_tpu.gateway.datastore import Datastore
+
+        ds = Datastore()
+        ds.set_pool(InferencePool(
+            name="t",
+            spec=InferencePoolSpec(selector={}, target_port_number=9000)))
+        rec = EndpointsReconciler(ds)
+        rec.reconcile([
+            Endpoint(name="p0", address="10.0.0.1", role=ROLE_PREFILL),
+            Endpoint(name="d0", address="10.0.0.2", role=ROLE_DECODE),
+            Endpoint(name="c0", address="10.0.0.3"),
+        ])
+        roles = {p.name: p.role for p in ds.all_pods()}
+        assert roles == {"p0": ROLE_PREFILL, "d0": ROLE_DECODE,
+                         "c0": ROLE_COLLOCATED}
+
+    def test_pod_flag_role_parsing(self, tmp_path):
+        from llm_instance_gateway_tpu.gateway import bootstrap
+
+        config = tmp_path / "pool.yaml"
+        config.write_text(
+            "kind: InferencePool\n"
+            'metadata: {name: t, resourceVersion: "1"}\n'
+            "spec: {selector: {app: t}, targetPortNumber: 9000}\n"
+            "---\n"
+            "kind: InferenceModel\n"
+            "metadata: {name: m}\n"
+            "spec: {modelName: m, poolRef: {name: t}}\n")
+        comps = bootstrap.build_gateway(
+            str(config),
+            static_pods=["p0=127.0.0.1:9001,role=prefill",
+                         "d0=127.0.0.1:9002,zone-a,role=decode",
+                         "c0=127.0.0.1:9003"])
+        try:
+            roles = {p.name: p.role for p in comps.datastore.all_pods()}
+            assert roles == {"p0": ROLE_PREFILL, "d0": ROLE_DECODE,
+                             "c0": ROLE_COLLOCATED}
+        finally:
+            comps.stop()
+
+    def test_pod_flag_rejects_unknown_role(self, tmp_path):
+        from llm_instance_gateway_tpu.gateway import bootstrap
+
+        config = tmp_path / "pool.yaml"
+        config.write_text(
+            "kind: InferencePool\n"
+            'metadata: {name: t, resourceVersion: "1"}\n'
+            "spec: {selector: {app: t}, targetPortNumber: 9000}\n")
+        with pytest.raises(ValueError, match="unknown role"):
+            bootstrap.build_gateway(
+                str(config), static_pods=["p0=127.0.0.1:9001,role=bogus"])

@@ -1,0 +1,142 @@
+"""Disaggregated serving end-to-end: prefill replica + decode replica + gateway.
+
+Three real processes: a prefill-role model server, a decode-role model server
+(paged cache + prefix reuse), and the gateway proxy with role-tagged pod
+membership.  A completion through the gateway must traverse BOTH hops
+(x-served-by names both replicas) and produce exactly the tokens the same
+server stack serves collocated — the cross-process version of
+tests/test_kv_handoff.py's engine-level parity.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tests.test_e2e_local import (
+    _launch_module,
+    _teardown_procs,
+    _wait_http,
+)
+
+pytestmark = pytest.mark.e2e
+
+PREFILL_PORT = 18841
+DECODE_PORT = 18842
+GATEWAY_PORT = 18845
+
+
+def _post_with_headers(url: str, payload: dict, timeout_s: float = 120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+@pytest.fixture(scope="module")
+def disagg_stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e_disagg")
+    config = tmp / "pool.yaml"
+    config.write_text(f"""\
+kind: InferencePool
+metadata: {{name: disagg-pool, resourceVersion: "1"}}
+spec: {{selector: {{app: disagg}}, targetPortNumber: {PREFILL_PORT}}}
+---
+kind: InferenceModel
+metadata: {{name: llama3-tiny}}
+spec: {{modelName: llama3-tiny, criticality: Critical, poolRef: {{name: disagg-pool}}}}
+""")
+    procs = []
+
+    def launch(args, log_name):
+        entry = _launch_module(args, tmp / log_name, cwd=str(tmp))
+        procs.append(entry)
+        return entry[0]
+
+    common = ["llm_instance_gateway_tpu.server.api_http", "--model",
+              "llama3-tiny", "--platform", "cpu", "--decode-slots", "2",
+              "--max-seq-len", "128", "--dtype", "float32"]
+    try:
+        launch(common + ["--port", str(PREFILL_PORT), "--role", "prefill"],
+               "prefill.log")
+        launch(common + ["--port", str(DECODE_PORT), "--role", "decode",
+                         "--paged-kv-block", "16", "--prefix-cache"],
+               "decode.log")
+        for port in (PREFILL_PORT, DECODE_PORT):
+            _wait_http(f"http://127.0.0.1:{port}/health")
+        launch(
+            ["llm_instance_gateway_tpu.gateway.proxy", "--config",
+             str(config), "--port", str(GATEWAY_PORT),
+             "--pod", f"pre1=127.0.0.1:{PREFILL_PORT},role=prefill",
+             "--pod", f"dec1=127.0.0.1:{DECODE_PORT},role=decode"],
+            "gateway.log",
+        )
+        _wait_http(f"http://127.0.0.1:{GATEWAY_PORT}/healthz")
+        import time
+
+        time.sleep(2.0)  # one provider pod-refresh cycle
+    except Exception:
+        _teardown_procs(procs)
+        raise
+    yield {"tmp": tmp}
+    _teardown_procs(procs)
+
+
+BODY = {"model": "llama3-tiny", "prompt": "disaggregate this prompt please",
+        "max_tokens": 8, "temperature": 0}
+
+
+def test_two_hop_completion_matches_collocated(disagg_stack):
+    # Reference: the prefill server IS a complete engine — serve the same
+    # request collocated on it (identical weights: both servers init from
+    # the same seed) and compare texts.
+    status, collocated, _ = _post_with_headers(
+        f"http://127.0.0.1:{PREFILL_PORT}/v1/completions", BODY)
+    assert status == 200 and collocated["usage"]["completion_tokens"] == 8
+
+    status, body, headers = _post_with_headers(
+        f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions", BODY)
+    assert status == 200, body
+    # Both hops served it: the proxy stamps "prefill+decode".
+    assert headers.get("x-served-by") == "pre1+dec1", headers
+    assert body["choices"][0]["text"] == collocated["choices"][0]["text"]
+    assert body["usage"] == collocated["usage"]
+
+
+def test_two_hop_streaming(disagg_stack):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions",
+        data=json.dumps({**BODY, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.status == 200
+        assert "text/event-stream" in resp.headers.get("Content-Type", "")
+        raw = resp.read().decode()
+    chunks = [json.loads(line[len("data: "):])
+              for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    text = "".join(c["choices"][0]["text"] for c in chunks if c.get("choices"))
+    assert len(text) > 0
+    assert chunks[-1]["usage"]["completion_tokens"] == 8
+    assert raw.rstrip().endswith("data: [DONE]")
+
+
+def test_decode_replica_prefix_reuse_climbs(disagg_stack):
+    """Attached prompts register in the decode replica's prefix cache:
+    repeating the same prompt drives tpu:prefix_reused_tokens up."""
+    long_prompt = {**BODY, "prompt": "shared preamble " * 6}
+    for _ in range(2):
+        status, _, headers = _post_with_headers(
+            f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions", long_prompt)
+        assert status == 200
+        assert headers.get("x-served-by") == "pre1+dec1"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{DECODE_PORT}/metrics", timeout=10) as resp:
+        metrics = resp.read().decode()
+    assert 'tpu:pool_role{role="decode"} 1' in metrics
+    reused = [line for line in metrics.splitlines()
+              if line.startswith("tpu:prefix_reused_tokens")]
+    assert reused and float(reused[0].split()[-1]) > 0

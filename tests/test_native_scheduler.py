@@ -22,7 +22,10 @@ from llm_instance_gateway_tpu.gateway.provider import StaticProvider
 from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
 
 pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native library not buildable"
+    not native.available(),
+    reason="native/libligsched.so not buildable on this host (needs "
+           "g++/make; see the conftest warning) — C++/Python parity "
+           "fuzzing NOT exercised",
 )
 
 

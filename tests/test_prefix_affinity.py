@@ -67,7 +67,51 @@ class TestPrefixIndex:
         idx.record(deep[:1], "pod-shallow")
         idx.record(deep, "pod-deep")
         assert idx.lookup(deep) == ("pod-deep", 3)
-        assert idx.lookup(deep[:1]) == ("pod-deep", 1)  # overwritten at d1
+        # d1's warm holder survives ONE divergent pick (hysteresis)...
+        assert idx.lookup(deep[:1]) == ("pod-shallow", 1)
+        # ...and is re-learned after a sustained divergence.
+        idx.record(deep, "pod-deep")
+        assert idx.lookup(deep[:1]) == ("pod-deep", 1)
+
+    def test_record_hysteresis_single_blip_keeps_holder(self):
+        """A transient off-holder pick must not erase warm affinity; an
+        alternating divergence never steals (the counter resets on each
+        candidate change)."""
+        idx = PrefixIndex()
+        h = prefix_hashes("w" * PREFIX_BLOCK_CHARS)
+        idx.record(h, "pod-a")
+        idx.record(h, "pod-b")  # blip
+        assert idx.lookup(h) == ("pod-a", 1)
+        idx.record(h, "pod-a")  # holder re-picked: divergence forgotten
+        idx.record(h, "pod-b")
+        assert idx.lookup(h) == ("pod-a", 1)
+        idx.record(h, "pod-c")  # different diverger: counter restarts
+        assert idx.lookup(h) == ("pod-a", 1)
+        idx.record(h, "pod-c")  # 2nd consecutive: stolen
+        assert idx.lookup(h) == ("pod-c", 1)
+
+    def test_prefer_skips_overloaded_holder(self):
+        """Load-aware cap: a holder far above the survivor median spills
+        traffic instead of pinning a hot shared prefix forever."""
+        from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+            HOLDER_KV_SLACK,
+            HOLDER_QUEUE_SLACK,
+        )
+
+        idx = PrefixIndex()
+        hashes = prefix_hashes("hot " * PREFIX_BLOCK_CHARS)
+        idx.record(hashes, "holder")
+        req = LLMRequest(model="m", resolved_target_model="m",
+                         prefix_hashes=hashes)
+        # Within slack of the median: preference holds.
+        survivors = [pm("holder", queue=HOLDER_QUEUE_SLACK), pm("other")]
+        assert idx.prefer(req, survivors).pod.name == "holder"
+        # Queue excess beyond slack: holder skipped.
+        survivors = [pm("holder", queue=HOLDER_QUEUE_SLACK + 1), pm("other")]
+        assert idx.prefer(req, survivors) is None
+        # KV excess beyond slack: holder skipped.
+        survivors = [pm("holder", kv=HOLDER_KV_SLACK + 0.05), pm("other")]
+        assert idx.prefer(req, survivors) is None
 
     def test_lru_eviction(self):
         idx = PrefixIndex(capacity=2)

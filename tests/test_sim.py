@@ -127,7 +127,11 @@ def test_prefix_affinity_raises_hit_rate_without_hurting_slo():
     base = simulate("production", cfg, n_servers=3, decode_slots=8)
     aff = simulate("production_affinity", cfg, n_servers=3, decode_slots=8)
     assert aff.prefix_hits > base.prefix_hits
-    assert aff.completed == base.completed
+    # Near-identical, not bit-identical: the load-aware holder cap
+    # (prefix_affinity.HOLDER_*_SLACK) deliberately spills a hot holder's
+    # overflow to other replicas, which can shift a request across the
+    # run boundary.  Throughput must stay within 1%.
+    assert abs(aff.completed - base.completed) <= max(1, base.completed // 100)
     assert aff.summary()["slo_attainment"] >= (
         base.summary()["slo_attainment"] - 0.02)
 
