@@ -281,21 +281,55 @@ def run_handoff_microbench() -> dict:
         coll.generate(req(64, 2), timeout_s=300)
         coll.generate(req(120, 2), timeout_s=300)
 
-        # --- handoff plane throughput ---
+        # --- handoff plane throughput (+ per-phase trace collection) ---
         n_req, prompt_len = 8, 64
         wire_bytes = 0
+        traces = []  # the /debug/traces shape tools/trace_report.py reads
         t0 = time.perf_counter()
-        for _ in range(n_req):
-            h = pre.prefill_only(req(prompt_len, 2), timeout_s=300)
+        for i in range(n_req):
+            pr = req(prompt_len, 2)
+            h = pre.prefill_only(pr, timeout_s=300)
+            t_s0 = time.time()
             wire = h.to_bytes()
+            t_s1 = time.time()
             wire_bytes += len(wire)
-            ar = dec.attach_prefilled(PrefillHandoff.from_bytes(wire))
+            t_d0 = time.time()
+            handoff2 = PrefillHandoff.from_bytes(wire)
+            t_d1 = time.time()
+            ar = dec.attach_prefilled(handoff2)
+            t_att = time.time()
             if not ar.done.wait(300):
                 raise RuntimeError("attach timed out")
+            spans = [
+                {"name": "engine.queue_wait", "start": pr.t_submit,
+                 "end": pr.t_prefill_start},
+                {"name": "engine.prefill", "start": pr.t_prefill_start,
+                 "end": pr.t_first_token},
+                {"name": "handoff.serialize", "start": t_s0, "end": t_s1},
+                {"name": "handoff.deserialize", "start": t_d0, "end": t_d1},
+                {"name": "handoff.attach", "start": t_d1, "end": t_att},
+                {"name": "engine.decode", "start": t_att, "end": ar.t_done},
+            ]
+            traces.append({"trace_id": f"bench-{i}", "spans": spans})
         wall = time.perf_counter() - t0
         blocks = n_req * (-(-prompt_len // block))
         out["handoff_blocks_per_s"] = round(blocks / wall, 1)
         out["handoff_wire_mb_s"] = round(wire_bytes / wall / 1e6, 2)
+
+        # Per-phase latency table (tools/trace_report.py smoke invocation):
+        # the same code path the CLI uses, so the BENCH trajectory carries
+        # the phase breakdown the tracing subsystem exists to answer.
+        try:
+            from tools import trace_report
+
+            rows = trace_report.phase_table(
+                trace_report.phase_samples({"traces": traces}))
+            out["phase_latency_ms"] = {
+                r["phase"]: {"p50": r["p50_ms"], "p95": r["p95_ms"],
+                             "p99": r["p99_ms"]}
+                for r in rows}
+        except Exception as e:  # additive: never block the throughput metric
+            out["phase_latency_error"] = str(e)[:200]
 
         # --- decode interference A/B ---
         def tpot_ms(r):
@@ -349,6 +383,74 @@ def run_handoff_microbench() -> dict:
     return out
 
 
+def run_pick_microbench(n: int = 4000, n_pods: int = 64,
+                        n_models: int = 128) -> dict:
+    """Scheduler pick microbench with a tracing-overhead A/B.
+
+    Device-independent: a real Python filter-tree scheduler over a static
+    fake fleet, run through the SAME per-pick instrumentation the proxy
+    executes per request (trace-id mint for the echo contract, admission
+    span record, pick-latency histogram observe) — measured once with the
+    tracer DISABLED (LIG_TRACE=0 equivalent: record() short-circuits) and
+    once ENABLED at default sampling.  The acceptance bar is
+    ``pick_traced_ratio`` <= 1.05: turning tracing on costs < 5% of a pick.
+    Each side reports its MIN over interleaved runs — this container's
+    cores are contended and single-run ratios swing 2x from noise alone.
+    """
+    from llm_instance_gateway_tpu import tracing
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod, static_provider,
+    )
+
+    pods = {
+        fake_pod(i): fake_metrics(
+            queue=i % 5, kv=(i % 10) / 10.0,
+            adapters={f"adapter-{i * 2 + j}": 0 for j in range(2)},
+            max_adapters=4)
+        for i in range(n_pods)
+    }
+    scheduler = Scheduler(static_provider(pods))
+    reqs = [
+        LLMRequest(model=f"adapter-{i % n_models}",
+                   resolved_target_model=f"adapter-{i % n_models}",
+                   critical=True, prompt_tokens=25, criticality="Critical")
+        for i in range(64)
+    ]
+
+    def loop(tracer) -> float:
+        gm = GatewayMetrics()
+        t0 = time.perf_counter()
+        for i in range(n):
+            trace_id = tracing.new_trace_id()  # echo contract: always minted
+            t_req = time.time()
+            tp0 = time.perf_counter()
+            pod = scheduler.schedule(reqs[i % len(reqs)])
+            pick_s = time.perf_counter() - tp0
+            gm.record_pick(pod.name, pick_s, False)
+            tracer.record(trace_id, "gateway.admission", t_req, time.time(),
+                          pod=pod.name, pick_s=round(pick_s, 6))
+        return time.perf_counter() - t0
+
+    # Interleaved A/B pairs (warm-up pair discarded), MIN per side: this
+    # container's cores are contended and single-pair ratios swing 2x from
+    # scheduler-side noise alone — each side's minimum is its uncontended
+    # cost, which is the quantity the <5% bound is about.
+    off, on = tracing.Tracer(enabled=False), tracing.Tracer()
+    loop(off), loop(on)
+    base_best = traced_best = float("inf")
+    for _ in range(12):
+        base_best = min(base_best, loop(off))
+        traced_best = min(traced_best, loop(on))
+    return {
+        "pick_us": round(base_best / n * 1e6, 2),
+        "pick_traced_us": round(traced_best / n * 1e6, 2),
+        "pick_traced_ratio": round(traced_best / base_best, 4),
+    }
+
+
 def _collect_handoff_metrics(timeout_s: float = 300.0) -> None:
     """Run the disaggregation phase in a CPU subprocess BEFORE the device
     claim (it must not touch — or wait for — the TPU relay) and merge its
@@ -361,15 +463,22 @@ def _collect_handoff_metrics(timeout_s: float = 300.0) -> None:
             [sys.executable, os.path.abspath(__file__),
              "--handoff-microbench"],
             capture_output=True, text=True, timeout=timeout_s, env=env)
-        lines = [ln for ln in (r.stdout or "").splitlines()
-                 if ln.startswith("{")]
-        if lines:
-            _EXTRA.update(json.loads(lines[-1]))
-        else:
-            _EXTRA["handoff_error"] = (
-                f"no output (rc={r.returncode}): {(r.stderr or '')[-200:]}")
+        stdout, rc = r.stdout, r.returncode
+    except subprocess.TimeoutExpired as e:
+        # The child prints the handoff line BEFORE the pick phase: salvage
+        # whatever JSON made it out before the deadline.
+        stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
+                  else e.stdout) or ""
+        rc = "timeout"
+        _EXTRA["handoff_error"] = f"subprocess deadline ({timeout_s:.0f}s)"
     except Exception as e:  # the phase is additive; never block the ratio
         _EXTRA["handoff_error"] = str(e)[:200]
+        return
+    lines = [ln for ln in (stdout or "").splitlines() if ln.startswith("{")]
+    if lines:
+        _EXTRA.update(json.loads(lines[-1]))
+    elif "handoff_error" not in _EXTRA:
+        _EXTRA["handoff_error"] = f"no output (rc={rc})"
 
 
 # v5e (per chip): 819 GB/s HBM bandwidth, 197 TFLOP/s bf16 on the MXU.
@@ -670,6 +779,15 @@ def main() -> None:
 
 if __name__ == "__main__":
     if "--handoff-microbench" in sys.argv:
-        print(json.dumps(run_handoff_microbench()), flush=True)
+        results = run_handoff_microbench()
+        # Emit the handoff metrics IMMEDIATELY: if the pick phase below
+        # hangs past the parent's subprocess deadline, the parent still
+        # salvages this line (it parses the LAST JSON line it received).
+        print(json.dumps(results), flush=True)
+        try:
+            results.update(run_pick_microbench())
+        except Exception as e:  # additive phase: never block the handoff line
+            results["pick_error"] = str(e)[:200]
+        print(json.dumps(results), flush=True)
     else:
         main()

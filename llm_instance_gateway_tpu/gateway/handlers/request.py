@@ -32,6 +32,11 @@ from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
     prefix_hashes,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.tracing import (
+    TRACE_HEADER,
+    header_trace_id,
+    new_trace_id,
+)
 
 
 class RequestError(Exception):
@@ -55,7 +60,11 @@ def prompt_text(body: dict) -> str:
 
 
 def handle_request_headers(req_ctx, msg: RequestHeaders) -> ProcessingResult:
-    """request.go:122-142."""
+    """request.go:122-142.  Also adopts (or mints) the request's trace id
+    from the x-lig-trace-id header so one id follows the request across the
+    gateway decision path and both model-server hops."""
+    if not req_ctx.trace_id:
+        req_ctx.trace_id = header_trace_id(msg.headers) or new_trace_id()
     return ProcessingResult(phase="request_headers", clear_route_cache=True)
 
 
@@ -86,6 +95,16 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
             raise RequestError(
                 f"error getting target model name for model {model_obj.name}"
             )
+
+    # Adopt/mint the trace id HERE too: gRPC clients (and the load rig) may
+    # open the stream at the body phase without a headers message.
+    if not req_ctx.trace_id:
+        req_ctx.trace_id = new_trace_id()
+    # Model identity is known from here on — record it BEFORE scheduling so
+    # a shed (SchedulingError below) still carries the model dimension into
+    # gateway_shed_total and the trace.
+    req_ctx.model = model
+    req_ctx.resolved_target_model = model_name
 
     text = prompt_text(body)
     # The hash chain (up to 32 chained blake2b calls over 8 KB of prompt)
@@ -123,13 +142,18 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
     else:
         target_pod, decode_pod = server.scheduler.schedule(llm_req), None
 
-    req_ctx.model = llm_req.model
-    req_ctx.resolved_target_model = llm_req.resolved_target_model
     req_ctx.target_pod = target_pod
     req_ctx.decode_pod = decode_pod
+    # Scheduling-layer attribution (admission-queue wait, per-hop pick
+    # split) rides to the transport for the admission span's attrs.
+    req_ctx.admission_wait_s = getattr(llm_req, "admission_wait_s", 0.0)
+    req_ctx.pick_hops_s = getattr(llm_req, "pick_hops_s", None)
 
     set_headers = {
         server.target_pod_header: target_pod.address,
+        # Trace propagation: the upstream replica (and any Envoy-side
+        # implementation of the two-hop relay) sees the same trace id.
+        TRACE_HEADER: req_ctx.trace_id,
         # Body was (possibly) mutated: Content-Length must follow
         # (request.go:89-96).
         "Content-Length": str(len(request_body)),

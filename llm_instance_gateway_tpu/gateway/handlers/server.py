@@ -47,6 +47,15 @@ class RequestContext:
     decode_pod: Pod | None = None
     model: str = ""
     resolved_target_model: str = ""
+    # End-to-end tracing (tracing.py): honored from the inbound
+    # x-lig-trace-id header or minted in the headers/body phase, injected
+    # into the upstream header set, and echoed in every response.
+    trace_id: str = ""
+    # Scheduling attribution for the admission span: time parked in the
+    # admission queue, and the (prefill, decode) pick split of a two-stage
+    # disaggregated pick (None = single-hop).
+    admission_wait_s: float = 0.0
+    pick_hops_s: tuple | None = None
     usage: Usage = field(default_factory=Usage)
 
 

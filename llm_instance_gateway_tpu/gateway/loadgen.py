@@ -34,6 +34,7 @@ from llm_instance_gateway_tpu.gateway.testing import (
     make_model,
     start_ext_proc,
 )
+from llm_instance_gateway_tpu.tracing import TRACE_HEADER
 
 
 def model_name(i: int) -> str:  # benchmark.go:71-73
@@ -89,6 +90,7 @@ def run_load(
     session_prefix_chars: int = 0,
     session_count: int = 64,
     role_split: bool = False,
+    trace_out: str | None = None,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
@@ -130,6 +132,7 @@ def run_load(
         sent = 0
         session_pods: dict[int, set[str]] = {}
         two_stage_hits = 0
+        trace_hits = 0  # responses carrying the echoed x-lig-trace-id
 
         def body_for(i: int) -> tuple[bytes, int | None]:
             if session_prefix_chars:
@@ -153,10 +156,11 @@ def run_load(
                 latencies.append(t1 - t0)
                 t0 = t1
                 assert resp.WhichOneof("response") == "request_body"
+                keys = {h.header.key for h in (resp.request_body.response
+                                               .header_mutation.set_headers)}
+                if TRACE_HEADER in keys:
+                    trace_hits += 1
                 if role_split:
-                    keys = {h.header.key for h in (resp.request_body.response
-                                                   .header_mutation
-                                                   .set_headers)}
                     if (DEFAULT_TARGET_POD_HEADER in keys
                             and DEFAULT_DECODE_POD_HEADER in keys):
                         two_stage_hits += 1
@@ -186,7 +190,16 @@ def run_load(
         "rps": round(requests / wall, 1),
         "p50_us": round(pct(0.5) * 1e6, 1),
         "p99_us": round(pct(0.99) * 1e6, 1),
+        # 1.0 = every scheduled response echoed a trace id in its header
+        # mutation (the client-side correlation contract).
+        "trace_id_rate": round(trace_hits / requests, 4),
     }
+    if trace_out:
+        # Raw per-request samples in the shape tools/trace_report.py reads
+        # ({"phases": {name: [seconds...]}}): the ext-proc Process round
+        # trip IS the gateway decision phase under this rig.
+        with open(trace_out, "w") as f:
+            json.dump({"phases": {"extproc.process": latencies}}, f)
     if role_split:
         # 1.0 = every response carried BOTH hop headers (prefill target +
         # x-decode-pod) — the two-stage pick ran on every request.
@@ -221,12 +234,16 @@ def main(argv=None):
                         help="disaggregated-pool rig: half the fake fleet "
                              "prefill-role, half decode-role; measures the "
                              "two-stage pick rate and cost")
+    parser.add_argument("--trace-out", default=None, metavar="PATH",
+                        help="write per-request phase samples as JSON for "
+                             "tools/trace_report.py")
     args = parser.parse_args(argv)
     summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
                        use_native=args.native,
                        session_prefix_chars=args.session_prefix_chars,
                        session_count=args.sessions,
-                       role_split=args.role_split)
+                       role_split=args.role_split,
+                       trace_out=args.trace_out)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 

@@ -22,6 +22,14 @@ TPU model server (JetStream-style) that wants to join a pool:
 ``tpu:decode_tokens_per_sec``          recent decode throughput (gauge)
 ``tpu:prefix_reused_tokens``           cumulative prompt tokens served from
                                        the prefix cache (counter, optional)
+``tpu:prefill_seconds``                prefill compute latency (histogram,
+                                       optional; mean = _sum/_count feeds
+                                       Metrics.prefill_seconds_mean)
+``tpu:handoff_seconds``                handoff serialize / deserialize+attach
+                                       latency (histogram, optional)
+``tpu:decode_step_seconds``            per-step decode cadence (histogram,
+                                       optional; mean feeds
+                                       Metrics.decode_step_seconds_mean)
 ``tpu:lora_requests_info``             labels ``running_lora_adapters`` (CSV),
                                        ``max_lora``; gauge value = unix ts of
                                        the snapshot (latest series wins)
@@ -52,6 +60,8 @@ KV_FREE_METRIC = "tpu:kv_tokens_free"
 KV_PARKED_METRIC = "tpu:kv_parked_tokens"
 DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
 PREFIX_REUSED_METRIC = "tpu:prefix_reused_tokens"
+PREFILL_SECONDS_METRIC = "tpu:prefill_seconds"
+DECODE_STEP_SECONDS_METRIC = "tpu:decode_step_seconds"
 
 
 class FetchError(Exception):
@@ -101,6 +111,18 @@ def families_to_metrics(
         s = prom_parse.latest_sample(families.get(name, []))
         if s is not None:
             setter(updated, s.value)
+
+    # Phase-latency histograms (optional): the parser sees a histogram as
+    # its component families, so mean = <fam>_sum / <fam>_count.  The labels
+    # (model/role) are single-valued per replica — latest sample suffices.
+    for fam, attr in (
+        (PREFILL_SECONDS_METRIC, "prefill_seconds_mean"),
+        (DECODE_STEP_SECONDS_METRIC, "decode_step_seconds_mean"),
+    ):
+        s_sum = prom_parse.latest_sample(families.get(fam + "_sum", []))
+        s_count = prom_parse.latest_sample(families.get(fam + "_count", []))
+        if s_sum is not None and s_count is not None and s_count.value > 0:
+            setattr(updated, attr, s_sum.value / s_count.value)
 
     # LoRA info: latest series by gauge-value timestamp (metrics.go:135-150 —
     # the reference compares the *gauge value*, which vLLM sets to a unix ts).

@@ -12,9 +12,18 @@ forward -> response phases), and streams the model server's reply back.
 Endpoints:
 - ``POST /v1/completions`` and ``/v1/chat/completions`` — routed inference.
 - ``GET  /metrics``  — gateway self-telemetry (scheduler decisions, shed rate,
-  pick latency; resolves reference TODO provider.go:140).
+  pick latency, TTFT/TPOT/e2e histograms; resolves reference TODO
+  provider.go:140).
+- ``GET  /debug/traces`` — recent request traces (``?trace_id=`` filters);
+  each trace merges the proxy's own spans with the model servers' spans
+  returned in their ``x-lig-spans`` response headers, so one JSON document
+  answers "where did this request spend its time?" across up to three
+  processes.
 - ``GET  /healthz``  — 200 once the InferencePool is synced (main.go:43-52).
 - ``GET  /v1/models`` — logical models from the datastore.
+
+Every response — success or error — carries the request's ``x-lig-trace-id``
+(error bodies embed it too) so clients and the loadgen can correlate.
 """
 
 from __future__ import annotations
@@ -42,6 +51,7 @@ from llm_instance_gateway_tpu.gateway.handlers.server import (
     Server,
 )
 from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics, Timer
+from llm_instance_gateway_tpu import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +71,9 @@ class GatewayProxy:
         # Re-export per-replica prefix-cache reuse at the gateway /metrics
         # (the KV-affinity observable; see GatewayMetrics.pool_signals_fn).
         self.metrics.pool_signals_fn = provider.all_pod_metrics
+        # Request tracing (tracing.py): bounded span ring served by
+        # /debug/traces; sampling/capacity via LIG_TRACE_* env.
+        self.tracer = tracing.Tracer()
         self.request_timeout_s = request_timeout_s
         self._session: aiohttp.ClientSession | None = None
 
@@ -70,6 +83,7 @@ class GatewayProxy:
         app.router.add_post("/v1/completions", self.handle_completion)
         app.router.add_post("/v1/chat/completions", self.handle_completion)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/v1/models", self.handle_models)
         app.on_startup.append(self._on_startup)
@@ -86,6 +100,48 @@ class GatewayProxy:
             await self._session.close()
 
     # -- request path ------------------------------------------------------
+    def _error_response(self, status: int, message: str, kind: str,
+                        trace_id: str) -> web.Response:
+        """Error envelope with the trace id in BOTH the body and the header
+        — failed requests are the ones most worth correlating."""
+        return web.json_response(
+            {"error": {"message": message, "type": kind,
+                       "trace_id": trace_id}},
+            status=status,
+            headers={tracing.TRACE_HEADER: trace_id},
+        )
+
+    @staticmethod
+    def _body_ttft_s(resp_body: bytes) -> float | None:
+        """Server-reported first-token latency from a completions envelope
+        (``ttft_ms``), as seconds — None when the envelope doesn't carry it
+        (chat)."""
+        try:
+            v = json.loads(resp_body).get("ttft_ms")
+            return float(v) / 1e3 if v is not None else None
+        except (json.JSONDecodeError, ValueError, AttributeError, TypeError):
+            return None
+
+    def _finish_phase(self, req_ctx, trace_id: str, path: str, t_req: float,
+                      t_first: float | None, t_last: float) -> None:
+        """Observe a finished request into the gateway TTFT/TPOT/e2e
+        histograms and stamp the trace's summary fields.
+
+        ``t_first`` is the wall clock at which the FIRST generated token
+        existed (stream: first data chunk; JSON: server-reported ttft or
+        prefill-hop completion); TPOT spreads the remaining wall over the
+        remaining tokens.
+        """
+        model = req_ctx.model or "?"
+        completion = req_ctx.usage.completion_tokens
+        ttft_s = (t_first - t_req) if t_first else None
+        tpot_s = None
+        if t_first and completion > 1:
+            tpot_s = max(0.0, t_last - t_first) / (completion - 1)
+        self.metrics.record_phase(model, path, ttft_s, tpot_s,
+                                  e2e_s=t_last - t_req)
+        self.tracer.annotate(trace_id, model=model, path=path, status="ok")
+
     async def handle_completion(self, request: web.Request) -> web.Response:
         body = await request.read()
         req_ctx = RequestContext()
@@ -95,6 +151,10 @@ class GatewayProxy:
         # §5: the reference's only decision-path observability was verbose
         # logs; this is the structured equivalent).
         request_id = request.headers.get("x-request-id") or uuid.uuid4().hex[:16]
+        trace_id = (request.headers.get(tracing.TRACE_HEADER)
+                    or tracing.new_trace_id())
+        req_ctx.trace_id = trace_id
+        t_req = time.time()
         t_start = time.perf_counter()
         loop = asyncio.get_running_loop()
 
@@ -108,19 +168,24 @@ class GatewayProxy:
                     None, self.server.process, req_ctx, RequestBody(body=body)
                 )
         except ProcessingError as e:
-            self.metrics.record_error()
+            self.metrics.record_error(req_ctx.model or None)
+            self.tracer.record(trace_id, "gateway.admission", t_req,
+                               time.time(), error=str(e))
+            self.tracer.annotate(trace_id, model=req_ctx.model or "",
+                                 status="error")
             kind = "invalid_request_error" if e.status == 400 else "api_error"
-            return web.json_response(
-                {"error": {"message": str(e), "type": kind}}, status=e.status
-            )
+            return self._error_response(e.status, str(e), kind, trace_id)
         self.metrics.record_request(req_ctx.model or "?")
         if result.immediate_status is not None:
-            self.metrics.record_shed()
-            return web.json_response(
-                {"error": {"message": "dropping request due to limited backend resources",
-                            "type": "rate_limit_exceeded"}},
-                status=result.immediate_status,
-            )
+            self.metrics.record_shed(req_ctx.model or None)
+            self.tracer.record(trace_id, "gateway.admission", t_req,
+                               time.time(), shed=True)
+            self.tracer.annotate(trace_id, model=req_ctx.model or "",
+                                 status="shed")
+            return self._error_response(
+                result.immediate_status,
+                "dropping request due to limited backend resources",
+                "rate_limit_exceeded", trace_id)
 
         pod = req_ctx.target_pod
         affinity_hit = False
@@ -128,6 +193,19 @@ class GatewayProxy:
         if pm is not None:
             affinity_hit = req_ctx.resolved_target_model in pm.metrics.active_adapters
         self.metrics.record_pick(pod.name, t.seconds, affinity_hit)
+        # One span covers admission + scheduler pick (the pick's own cost
+        # rides as an attribute — it is also a full histogram family).
+        # Queue-wait and per-hop pick splits attribute a slow admission to
+        # admission-queue parking vs prefill-hop vs decode-hop pick cost.
+        attribution = {}
+        if req_ctx.admission_wait_s:
+            attribution["queue_wait_s"] = round(req_ctx.admission_wait_s, 6)
+        if req_ctx.pick_hops_s is not None:
+            attribution["pick_prefill_s"] = round(req_ctx.pick_hops_s[0], 6)
+            attribution["pick_decode_s"] = round(req_ctx.pick_hops_s[1], 6)
+        self.tracer.record(trace_id, "gateway.admission", t_req, time.time(),
+                           pod=pod.name, pick_s=round(t.seconds, 6),
+                           **attribution)
 
         # Forward to the picked replica (Envoy's ORIGINAL_DST role).
         out_body = result.body if result.body is not None else body
@@ -135,7 +213,8 @@ class GatewayProxy:
         if decode_pod is not None:
             # Disaggregated pick: relay prefill-hop -> handoff -> decode-hop.
             resp = await self._disagg_forward(
-                request, pod, decode_pod, out_body, request_id, req_ctx)
+                request, pod, decode_pod, out_body, request_id, req_ctx,
+                trace_id, t_req)
             if resp is not None:
                 return resp
             # Either hop refused (draining, long prompt, unsupported
@@ -144,6 +223,7 @@ class GatewayProxy:
             logger.info("request=%s disaggregated path unavailable; "
                         "single-hop on %s", request_id, pod.name)
         url = f"http://{pod.address}{request.path}"
+        t_up0 = time.time()
         try:
             async with self._session.post(
                 url,
@@ -151,6 +231,7 @@ class GatewayProxy:
                 headers={
                     "Content-Type": "application/json",
                     "x-request-id": request_id,
+                    tracing.TRACE_HEADER: trace_id,
                     self.server.target_pod_header: pod.address,
                 },
             ) as upstream:
@@ -159,15 +240,23 @@ class GatewayProxy:
                     # Streamed generation: relay SSE chunks as they arrive —
                     # buffering would defeat streaming, and usage accounting
                     # happens from the stream's final chunk if present.
-                    return await self._relay_stream(request, upstream, pod, req_ctx)
+                    return await self._relay_stream(
+                        request, upstream, pod, req_ctx,
+                        trace=(trace_id, t_req, "collocated", t_up0))
                 resp_body = await upstream.read()
+                self.tracer.record_wire(
+                    trace_id, upstream.headers.get(tracing.SPANS_HEADER))
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            self.metrics.record_error()
+            self.metrics.record_error(req_ctx.model or None)
+            self.tracer.record(trace_id, "gateway.upstream", t_up0,
+                               time.time(), pod=pod.name, error=str(e))
+            self.tracer.annotate(trace_id, status="upstream_error")
             logger.warning("upstream %s failed: %s", pod.address, e)
-            return web.json_response(
-                {"error": {"message": f"upstream error: {e}", "type": "api_error"}},
-                status=502,
-            )
+            return self._error_response(
+                502, f"upstream error: {e}", "api_error", trace_id)
+        t_up1 = time.time()
+        self.tracer.record(trace_id, "gateway.upstream", t_up0, t_up1,
+                           pod=pod.name, status=status)
 
         # Phases 3+4: response headers + usage accounting.
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
@@ -181,21 +270,28 @@ class GatewayProxy:
         except ProcessingError:
             pass  # non-JSON upstream bodies (e.g. SSE streams) skip accounting
 
+        server_ttft = self._body_ttft_s(resp_body)
+        self._finish_phase(
+            req_ctx, trace_id, "collocated", t_req,
+            t_first=(t_up0 + server_ttft) if server_ttft is not None else None,
+            t_last=t_up1)
         logger.info(
-            "request=%s model=%s target=%s pod=%s status=%d prompt_tokens=%d "
-            "completion_tokens=%d pick_us=%.0f total_ms=%.1f",
-            request_id, req_ctx.model, req_ctx.resolved_target_model, pod.name,
-            status, req_ctx.usage.prompt_tokens, req_ctx.usage.completion_tokens,
+            "request=%s trace=%s model=%s target=%s pod=%s status=%d "
+            "prompt_tokens=%d completion_tokens=%d pick_us=%.0f total_ms=%.1f",
+            request_id, trace_id, req_ctx.model, req_ctx.resolved_target_model,
+            pod.name, status, req_ctx.usage.prompt_tokens,
+            req_ctx.usage.completion_tokens,
             t.seconds * 1e6, (time.perf_counter() - t_start) * 1e3,
         )
         headers = {"x-served-by": pod.name, "x-request-id": request_id,
-                   **hdr_result.set_headers}
+                   tracing.TRACE_HEADER: trace_id, **hdr_result.set_headers}
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
 
     async def _disagg_forward(self, request: web.Request, prefill_pod,
                               decode_pod, out_body: bytes, request_id: str,
-                              req_ctx) -> web.StreamResponse | None:
+                              req_ctx, trace_id: str,
+                              t_req: float) -> web.StreamResponse | None:
         """Two-hop data path for a disaggregated pick.
 
         Hop 1 posts the (possibly rewritten) body to the prefill replica's
@@ -206,37 +302,63 @@ class GatewayProxy:
         from either hop (draining replica, prompt beyond the prefill bucket,
         params the handoff path doesn't carry) degrades gracefully rather
         than failing the request.
+
+        Tracing: both hops get their own gateway-side spans, and each hop's
+        ``x-lig-spans`` response header (engine queue/prefill, handoff
+        serialize/deserialize/attach, decode) merges into the SAME trace —
+        the proxy's /debug/traces shows the full three-process timeline.
         """
+        t_pre0 = time.time()
         try:
             async with self._session.post(
                 f"http://{prefill_pod.address}/v1/prefill",
                 data=out_body,
                 headers={"Content-Type": "application/json",
-                         "x-request-id": request_id},
+                         "x-request-id": request_id,
+                         tracing.TRACE_HEADER: trace_id},
             ) as pre:
                 if pre.status != 200:
                     logger.warning(
                         "prefill hop %s returned %d; falling back",
                         prefill_pod.address, pre.status)
+                    self.tracer.record(
+                        trace_id, "gateway.prefill_hop", t_pre0, time.time(),
+                        pod=prefill_pod.name, status=pre.status,
+                        fallback=True)
                     return None
                 handoff = await pre.read()
+                self.tracer.record_wire(
+                    trace_id, pre.headers.get(tracing.SPANS_HEADER))
+            t_pre1 = time.time()
+            self.tracer.record(trace_id, "gateway.prefill_hop", t_pre0,
+                               t_pre1, pod=prefill_pod.name,
+                               wire_bytes=len(handoff))
+            t_att0 = time.time()
             async with self._session.post(
                 f"http://{decode_pod.address}/v1/attach",
                 data=handoff,
                 headers={"Content-Type": "application/octet-stream",
-                         "x-request-id": request_id},
+                         "x-request-id": request_id,
+                         tracing.TRACE_HEADER: trace_id},
             ) as upstream:
                 status = upstream.status
                 if status != 200:
                     logger.warning(
                         "attach hop %s returned %d; falling back",
                         decode_pod.address, status)
+                    self.tracer.record(
+                        trace_id, "gateway.attach_hop", t_att0, time.time(),
+                        pod=decode_pod.name, status=status, fallback=True)
                     return None
                 if "text/event-stream" in upstream.headers.get(
                         "Content-Type", ""):
                     return await self._relay_stream(
-                        request, upstream, decode_pod, req_ctx)
+                        request, upstream, decode_pod, req_ctx,
+                        trace=(trace_id, t_req, "disaggregated", t_att0),
+                        served_by=f"{prefill_pod.name}+{decode_pod.name}")
                 resp_body = await upstream.read()
+                self.tracer.record_wire(
+                    trace_id, upstream.headers.get(tracing.SPANS_HEADER))
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             # No record_error here: the caller serves the request single-hop
             # next, and THAT path records the request's actual outcome — a
@@ -245,6 +367,9 @@ class GatewayProxy:
             logger.warning("disaggregated path %s->%s failed: %s",
                            prefill_pod.address, decode_pod.address, e)
             return None
+        t_att1 = time.time()
+        self.tracer.record(trace_id, "gateway.attach_hop", t_att0, t_att1,
+                           pod=decode_pod.name, status=status)
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
         try:
             self.server.process(req_ctx, ResponseBody(body=resp_body))
@@ -255,23 +380,29 @@ class GatewayProxy:
             )
         except ProcessingError:
             pass
+        # TTFT on the two-hop path: the first token exists the moment the
+        # prefill hop returns (it rides the handoff's sampling carry).
+        self._finish_phase(req_ctx, trace_id, "disaggregated", t_req,
+                           t_first=t_pre1, t_last=t_att1)
         logger.info(
-            "request=%s model=%s disaggregated prefill=%s decode=%s "
+            "request=%s trace=%s model=%s disaggregated prefill=%s decode=%s "
             "status=%d prompt_tokens=%d completion_tokens=%d",
-            request_id, req_ctx.model, prefill_pod.name, decode_pod.name,
-            status, req_ctx.usage.prompt_tokens,
+            request_id, trace_id, req_ctx.model, prefill_pod.name,
+            decode_pod.name, status, req_ctx.usage.prompt_tokens,
             req_ctx.usage.completion_tokens,
         )
         headers = {
             "x-served-by": f"{prefill_pod.name}+{decode_pod.name}",
             "x-request-id": request_id,
+            tracing.TRACE_HEADER: trace_id,
             **hdr_result.set_headers,
         }
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
 
     async def _relay_stream(self, request: web.Request, upstream, pod,
-                            req_ctx) -> web.StreamResponse:
+                            req_ctx, trace=None,
+                            served_by: str | None = None) -> web.StreamResponse:
         """Relay an SSE stream; never raises once headers are sent.
 
         A mid-stream upstream failure must terminate THIS prepared response
@@ -279,20 +410,29 @@ class GatewayProxy:
         send a second response on the same request.  SSE lines are re-framed
         through a byte buffer so a data line split across transport chunks
         still parses (usage rides the final chunk).
+
+        ``trace`` = (trace_id, t_req, path, t_up0): streaming is where real
+        client-observed TTFT/TPOT live — the first relayed data chunk stamps
+        TTFT, the final chunk closes the stream span and TPOT spreads over
+        the final usage count.
         """
-        resp = web.StreamResponse(
-            status=upstream.status,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "x-served-by": pod.name,
-            },
-        )
+        trace_id, t_req, path, t_up0 = trace or (None, 0.0, "collocated", 0.0)
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "x-served-by": served_by or pod.name,
+        }
+        if trace_id:
+            headers[tracing.TRACE_HEADER] = trace_id
+        resp = web.StreamResponse(status=upstream.status, headers=headers)
         await resp.prepare(request)
         last_data_line = b""
         buf = b""
+        t_first = None
         try:
             async for chunk in upstream.content.iter_any():
+                if t_first is None:
+                    t_first = time.time()
                 buf += chunk
                 *lines, buf = buf.split(b"\n")
                 for line in lines:
@@ -300,7 +440,11 @@ class GatewayProxy:
                         last_data_line = line
                 await resp.write(chunk)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            self.metrics.record_error()
+            self.metrics.record_error(req_ctx.model or None)
+            if trace_id:
+                self.tracer.record(trace_id, "gateway.stream", t_up0,
+                                   time.time(), pod=pod.name, error=str(e))
+                self.tracer.annotate(trace_id, status="stream_error")
             logger.warning("upstream stream from %s broke: %s", pod.address, e)
             try:
                 await resp.write(
@@ -310,6 +454,7 @@ class GatewayProxy:
             except ConnectionResetError:
                 pass
             return resp
+        t_end = time.time()
         try:
             final = json.loads(last_data_line[len(b"data: "):])
             usage = final.get("usage") or {}
@@ -318,13 +463,27 @@ class GatewayProxy:
                 int(usage.get("prompt_tokens", 0) or 0),
                 int(usage.get("completion_tokens", 0) or 0),
             )
+            req_ctx.usage.prompt_tokens = int(usage.get("prompt_tokens", 0) or 0)
+            req_ctx.usage.completion_tokens = int(
+                usage.get("completion_tokens", 0) or 0)
         except (json.JSONDecodeError, ValueError):
             pass
+        if trace_id:
+            self.tracer.record(trace_id, "gateway.stream", t_up0, t_end,
+                               pod=pod.name)
+            self._finish_phase(req_ctx, trace_id, path, t_req,
+                               t_first=t_first, t_last=t_end)
         return resp
 
     # -- ops endpoints -----------------------------------------------------
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def handle_debug_traces(self, request: web.Request) -> web.Response:
+        """Recent request traces as JSON (``?trace_id=`` exact filter,
+        ``?limit=`` count cap) — the merged cross-process timeline."""
+        return web.json_response(
+            tracing.debug_traces_payload(self.tracer, request.query))
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.datastore.has_synced_pool():

@@ -190,7 +190,12 @@ class AdmissionController:
                         "admission queue full; dropping request due to "
                         "limited backend resources", shed=True) from e
             self._work.set()
+            t_park = time.monotonic()
             if waiter.event.wait(self._cfg.max_wait_s) and waiter.pod is not None:
+                # Queue-wait attribution for the tracing layer: this wait is
+                # real pre-upstream latency that would otherwise be
+                # indistinguishable from pick cost in the admission span.
+                llm_req.admission_wait_s = time.monotonic() - t_park
                 return waiter.pod
             waiter.expired = True
             raise SchedulingError(

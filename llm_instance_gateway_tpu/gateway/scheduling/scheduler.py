@@ -25,6 +25,7 @@ reference parity, as the parity tests do):
 from __future__ import annotations
 
 import random
+import time
 from typing import Callable, Protocol, Sequence
 
 from llm_instance_gateway_tpu.gateway.scheduling.config import (
@@ -322,7 +323,9 @@ class Scheduler:
         prefills, decodes = split_pool_roles(pods)
         if not prefills or not decodes:
             return self.schedule(req), None
+        t0 = time.perf_counter()
         prefill_pod = self._pick(req, self._survivors(req, prefills))
+        t1 = time.perf_counter()
         try:
             decode_survivors = self._decode_tree.filter(req, decodes)
         except FilterError as e:
@@ -331,4 +334,7 @@ class Scheduler:
                 shed=e.shed) from e
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
+        # Per-hop pick split for the tracing layer (the admission span's
+        # attribution of "pick" into prefill-hop vs decode-hop cost).
+        req.pick_hops_s = (t1 - t0, time.perf_counter() - t1)
         return prefill_pod, decode_pod

@@ -29,3 +29,9 @@ class LLMRequest:
     # (scheduling/prefix_affinity.py) — lets the scheduler prefer the
     # replica already holding this prefix's KV blocks.  Empty = no hint.
     prefix_hashes: tuple = ()
+    # Tracing attribution (filled by the scheduling layer, read by the
+    # request handler): how long this request waited in the admission
+    # queue before a pod admitted it, and the (prefill_hop, decode_hop)
+    # pick-time split of a disaggregated two-stage pick.
+    admission_wait_s: float = 0.0
+    pick_hops_s: tuple | None = None

@@ -1,4 +1,5 @@
-"""Gateway self-metrics: scheduler decisions, pick latency, shed rate.
+"""Gateway self-metrics: scheduler decisions, pick latency, shed rate,
+per-phase request latency (TTFT / TPOT / e2e).
 
 The reference EPP *consumes* Prometheus but never *exports* it (acknowledged
 TODO, ``backend/provider.go:140``; SURVEY.md §5).  This module resolves that
@@ -6,46 +7,39 @@ gap: lightweight counters/histograms exposed in Prometheus text format by the
 proxy's ``/metrics`` endpoint and the load rig.
 
 Hand-rolled rather than prometheus_client so the request path stays at a few
-dict operations under a lock-free fast path (GIL-atomic int adds).
+dict operations under a lock-free fast path (GIL-atomic int adds).  Label
+values are escaped through the server-side renderer's ``escape_label`` — one
+hostile model name must not poison the exposition — and all latency families
+render as TRUE Prometheus histograms (``_bucket`` lines with ``le=``) via the
+shared ``tracing.render_histogram`` helper.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
 
-_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+from llm_instance_gateway_tpu.tracing import (
+    LATENCY_BUCKETS,
+    PICK_BUCKETS,
+    Histogram,
+    escape_label,  # one escaping impl for every exposition surface
+    render_histogram,
+)
 
+_BUCKETS = PICK_BUCKETS  # historical alias (tests, dashboards)
 
-@dataclass
-class Histogram:
-    buckets: tuple[float, ...] = _BUCKETS
-    counts: list[int] = field(default_factory=lambda: [0] * (len(_BUCKETS) + 1))
-    total: float = 0.0
-    n: int = 0
-
-    def observe(self, v: float) -> None:
-        i = 0
-        for i, b in enumerate(self.buckets):
-            if v <= b:
-                break
-        else:
-            i = len(self.buckets)
-        self.counts[i] += 1
-        self.total += v
-        self.n += 1
-
-    def quantile(self, q: float) -> float:
-        if self.n == 0:
-            return 0.0
-        target = q * self.n
-        acc = 0
-        for i, c in enumerate(self.counts):
-            acc += c
-            if acc >= target:
-                return self.buckets[i] if i < len(self.buckets) else float("inf")
-        return float("inf")
+# Gateway-side phase-latency families, labeled by model and serving path
+# ("collocated" vs "disaggregated") so the two pool topologies compare
+# directly.  TTFT/TPOT definitions: streaming measures real chunk arrival;
+# non-streaming uses the server-reported first-token time (``ttft_ms``) when
+# present, and the prefill-hop completion on the two-hop path (the first
+# token rides the handoff).
+PHASE_FAMILIES = (
+    ("ttft", "gateway_ttft_seconds"),
+    ("tpot", "gateway_tpot_seconds"),
+    ("e2e", "gateway_e2e_seconds"),
+)
 
 
 class GatewayMetrics:
@@ -53,11 +47,18 @@ class GatewayMetrics:
         self._lock = threading.Lock()
         self.requests_total: dict[str, int] = {}  # by model
         self.scheduled_total: dict[str, int] = {}  # by target pod
-        self.shed_total = 0
-        self.errors_total = 0
+        # Shed/error counters keyed by model; the None key is the unlabeled
+        # fallback for pre-admission failures (body unparsed, model unknown)
+        # so per-tenant shed rate is visible without losing those.
+        self.shed_total: dict[str | None, int] = {}
+        self.errors_total: dict[str | None, int] = {}
         self.tokens_prompt_total: dict[str, int] = {}  # by model
         self.tokens_completion_total: dict[str, int] = {}
         self.pick_latency = Histogram()
+        # (model, path) -> Histogram for each phase family.
+        self.phase_latency: dict[str, dict[tuple[str, str], Histogram]] = {
+            key: {} for key, _ in PHASE_FAMILIES
+        }
         self.lora_affinity_hits = 0  # picked pod already had the adapter
         # Optional pool-signal source (set by the proxy): a callable
         # returning the provider's PodMetrics snapshot, re-exported at
@@ -78,13 +79,13 @@ class GatewayMetrics:
             if affinity_hit:
                 self.lora_affinity_hits += 1
 
-    def record_shed(self) -> None:
+    def record_shed(self, model: str | None = None) -> None:
         with self._lock:
-            self.shed_total += 1
+            self.shed_total[model] = self.shed_total.get(model, 0) + 1
 
-    def record_error(self) -> None:
+    def record_error(self, model: str | None = None) -> None:
         with self._lock:
-            self.errors_total += 1
+            self.errors_total[model] = self.errors_total.get(model, 0) + 1
 
     def record_usage(self, model: str, prompt: int, completion: int) -> None:
         with self._lock:
@@ -95,37 +96,70 @@ class GatewayMetrics:
                 self.tokens_completion_total.get(model, 0) + completion
             )
 
+    def record_phase(self, model: str, path: str,
+                     ttft_s: float | None = None,
+                     tpot_s: float | None = None,
+                     e2e_s: float | None = None) -> None:
+        """Observe a finished request's phase latencies (None = unknown —
+        e.g. a chat envelope without a server-reported first-token time)."""
+        with self._lock:
+            for key, value in (("ttft", ttft_s), ("tpot", tpot_s),
+                               ("e2e", e2e_s)):
+                if value is None:
+                    continue
+                table = self.phase_latency[key]
+                h = table.get((model, path))
+                if h is None:
+                    h = table[(model, path)] = Histogram(LATENCY_BUCKETS)
+                h.observe(max(0.0, value))
+
     # -- export ------------------------------------------------------------
+    @staticmethod
+    def _counter_lines(family: str, table: dict, label: str) -> list[str]:
+        """One counter family; a None key renders unlabeled (fallback)."""
+        lines = [f"# TYPE {family} counter"]
+        # None sorts first: stable output, fallback line leads.
+        for key in sorted(table, key=lambda k: (k is not None, k or "")):
+            if key is None:
+                lines.append(f"{family} {table[key]}")
+            else:
+                lines.append(
+                    f'{family}{{{label}="{escape_label(key)}"}} {table[key]}')
+        return lines
+
     def render(self) -> str:
         with self._lock:
-            lines = [
-                "# TYPE gateway_requests_total counter",
-            ]
-            for model, n in sorted(self.requests_total.items()):
-                lines.append(f'gateway_requests_total{{model="{model}"}} {n}')
-            lines.append("# TYPE gateway_scheduled_total counter")
-            for pod, n in sorted(self.scheduled_total.items()):
-                lines.append(f'gateway_scheduled_total{{pod="{pod}"}} {n}')
+            lines = self._counter_lines(
+                "gateway_requests_total", self.requests_total, "model")
+            lines += self._counter_lines(
+                "gateway_scheduled_total", self.scheduled_total, "pod")
+            shed = self._counter_lines(
+                "gateway_shed_total", self.shed_total or {None: 0}, "model")
+            errors = self._counter_lines(
+                "gateway_errors_total", self.errors_total or {None: 0},
+                "model")
+            lines += shed + errors
             lines += [
-                "# TYPE gateway_shed_total counter",
-                f"gateway_shed_total {self.shed_total}",
-                "# TYPE gateway_errors_total counter",
-                f"gateway_errors_total {self.errors_total}",
                 "# TYPE gateway_lora_affinity_hits_total counter",
                 f"gateway_lora_affinity_hits_total {self.lora_affinity_hits}",
-                "# TYPE gateway_pick_latency_seconds summary",
-                f"gateway_pick_latency_seconds_count {self.pick_latency.n}",
-                f"gateway_pick_latency_seconds_sum {self.pick_latency.total}",
-                f'gateway_pick_latency_seconds{{quantile="0.5"}} {self.pick_latency.quantile(0.5)}',
-                f'gateway_pick_latency_seconds{{quantile="0.99"}} {self.pick_latency.quantile(0.99)}',
             ]
+            lines += render_histogram(
+                "gateway_pick_latency_seconds", self.pick_latency)
             for fam, table in (
                 ("gateway_prompt_tokens_total", self.tokens_prompt_total),
                 ("gateway_completion_tokens_total", self.tokens_completion_total),
             ):
-                lines.append(f"# TYPE {fam} counter")
-                for model, n in sorted(table.items()):
-                    lines.append(f'{fam}{{model="{model}"}} {n}')
+                lines += self._counter_lines(fam, table, "model")
+            for key, family in PHASE_FAMILIES:
+                table = self.phase_latency[key]
+                if not table:
+                    continue
+                lines.append(f"# TYPE {family} histogram")
+                for (model, path) in sorted(table):
+                    lines += render_histogram(
+                        family, table[(model, path)],
+                        labels={"model": model, "path": path},
+                        type_line=False)
             pool_signals = self.pool_signals_fn
         if pool_signals is not None:
             # Outside the lock: the provider snapshot is its own O(pods)
@@ -138,7 +172,7 @@ class GatewayMetrics:
                 n = getattr(pm.metrics, "prefix_reused_tokens", 0)
                 rows.append(
                     "gateway_pool_prefix_reused_tokens_total"
-                    f'{{pod="{pm.pod.name}"}} {n}')
+                    f'{{pod="{escape_label(pm.pod.name)}"}} {n}')
             lines.append(
                 "# TYPE gateway_pool_prefix_reused_tokens_total counter")
             lines += rows

@@ -86,6 +86,12 @@ class Metrics:
     # routing policy needs — a replica already holding a shared prefix is
     # cheaper to prefill on (SURVEY §5 observability note).
     prefix_reused_tokens: int = 0
+    # Phase-latency means derived from the replica's tpu:prefill_seconds /
+    # tpu:decode_step_seconds histograms (_sum / _count): the per-replica
+    # observables an SLO-aware routing policy ranks on.  0.0 = no samples
+    # yet (or a foreign server without the families).
+    prefill_seconds_mean: float = 0.0
+    decode_step_seconds_mean: float = 0.0
 
     def clone(self) -> "Metrics":
         m = dataclasses.replace(self)

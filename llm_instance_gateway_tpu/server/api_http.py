@@ -12,8 +12,19 @@ the union of what vLLM exposed to the reference:
 - ``POST /v1/load_lora_adapter``  ``{"lora_name": ..., "lora_path": ...}``
                                   (vLLM-compatible field names, sidecar.py:177-195)
 - ``POST /v1/unload_lora_adapter`` ``{"lora_name": ...}``
-- ``GET  /metrics``               tpu:* exposition (gateway scrape contract)
+- ``GET  /metrics``               tpu:* exposition (gateway scrape contract,
+                                  including the tpu:prefill_seconds /
+                                  tpu:handoff_seconds /
+                                  tpu:decode_step_seconds histograms)
+- ``GET  /debug/traces``          recent request traces (span JSON,
+                                  ``?trace_id=`` filter)
 - ``GET  /health``                200 once the engine loop is up
+
+Tracing: every inference request adopts the ``x-lig-trace-id`` header (or
+mints one), records engine-phase spans (queue wait, prefill, decode, handoff
+serialize/deserialize/attach) into a bounded ring, echoes the id on every
+response, and returns its spans in a compact ``x-lig-spans`` header so the
+gateway proxy can merge the cross-process timeline into one trace.
 """
 
 from __future__ import annotations
@@ -41,6 +52,7 @@ from llm_instance_gateway_tpu.server.lora_manager import (
     LoRAManager,
 )
 from llm_instance_gateway_tpu.server.tokenizer import load_tokenizer
+from llm_instance_gateway_tpu import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +72,8 @@ class ModelServer:
         # working across a checkpoint swap.
         self.aliases = {model_name} | (aliases or set())
         self.lora = lora_manager
+        # Per-process span ring served by /debug/traces (tracing.py).
+        self.tracer = tracing.Tracer()
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -72,8 +86,48 @@ class ModelServer:
         app.router.add_post("/v1/load_lora_adapter", self.handle_load_adapter)
         app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/health", self.handle_health)
         return app
+
+    # -- tracing helpers ----------------------------------------------------
+    @staticmethod
+    def _trace_id_for(request: web.Request) -> str:
+        return (tracing.header_trace_id(request.headers)
+                or tracing.new_trace_id())
+
+    @staticmethod
+    def _engine_spans(req, decode_start: float | None = None,
+                      with_decode: bool = True) -> list:
+        """Span triples derived from a finished engine Request's wall-clock
+        stamps: queue wait (submit -> prefill start), prefill compute
+        (prefill start -> first token), decode (first token -> done).
+        ``decode_start`` overrides the decode span's start (attach path:
+        the first token predates THIS engine; decode begins at attach)."""
+        spans = []
+        if req.t_submit and req.t_prefill_start:
+            spans.append(("engine.queue_wait", req.t_submit,
+                          max(req.t_submit, req.t_prefill_start)))
+        if req.t_prefill_start and req.t_first_token:
+            spans.append(("engine.prefill", req.t_prefill_start,
+                          max(req.t_prefill_start, req.t_first_token)))
+        if with_decode:
+            start = decode_start or req.t_first_token
+            end = req.t_done or time.time()
+            if start and end >= start:
+                spans.append(("engine.decode", start, end))
+        return spans
+
+    def _record_spans(self, trace_id: str, spans, status: str = "ok") -> dict:
+        """Record spans locally and build the response headers that echo the
+        trace id and carry the spans back to the gateway."""
+        for name, s, e in spans:
+            self.tracer.record(trace_id, name, s, e)
+        self.tracer.annotate(trace_id, model=self.model_name, status=status)
+        headers = {tracing.TRACE_HEADER: trace_id}
+        if spans and self.tracer.sampled(trace_id):
+            headers[tracing.SPANS_HEADER] = tracing.wire_spans(spans)
+        return headers
 
     # -- helpers -----------------------------------------------------------
     def _resolve_model(self, requested: str) -> str | None:
@@ -402,7 +456,9 @@ class ModelServer:
                           timeout_s: float = 600.0,
                           stops: list[str] | None = None,
                           echo_prefix: str | None = None,
-                          submit: bool = True):
+                          submit: bool = True,
+                          trace_id: str | None = None,
+                          decode_start: float | None = None):
         """Server-sent-events generation stream (OpenAI stream=true shape).
 
         Tokens appear in ``req.output_tokens`` as the engine decodes (in
@@ -417,24 +473,25 @@ class ModelServer:
             try:
                 self.engine.submit(req)
             except EngineDraining as e:
-                return _err(503, str(e))  # replica leaving the routable set
+                return _err(503, str(e), trace_id)  # replica leaving the set
             except ValueError as e:
-                return _err(400, str(e))
+                return _err(400, str(e), trace_id)
             except queue_mod.Full:
-                return _err(429, "prefill queue is full")
+                return _err(429, "prefill queue is full", trace_id)
 
         # From here the request occupies engine capacity: ANY exit before
         # completion (disconnect during prepare, write failure, handler
         # cancel, unexpected exception) must release the slot — enforced by
         # the finally below, not by enumerating exception types.
         try:
-            resp = web.StreamResponse(
-                headers={
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "x-accel-buffering": "no",
-                }
-            )
+            stream_headers = {
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "x-accel-buffering": "no",
+            }
+            if trace_id:
+                stream_headers[tracing.TRACE_HEADER] = trace_id
+            resp = web.StreamResponse(headers=stream_headers)
             await resp.prepare(http_request)
             loop = asyncio.get_running_loop()
             consumed = 0  # tokens already emitted as text
@@ -467,6 +524,14 @@ class ModelServer:
                 # deadline, any exception): release the decode slot instead
                 # of generating to completion for nobody.
                 req.cancelled.set()
+            elif trace_id:
+                # Completed stream: the engine stamps are final — record the
+                # phase spans (streams can't carry x-lig-spans post-hoc, so
+                # they live on THIS server's /debug/traces, same trace id).
+                self._record_spans(
+                    trace_id,
+                    self._engine_spans(req, decode_start=decode_start),
+                    status=req.finish_reason or "ok")
 
     async def _stream_sse_loop(self, req, model, object_name, make_delta,
                                resp, loop, consumed, deadline, emit):
@@ -587,18 +652,19 @@ class ModelServer:
 
     # -- inference ---------------------------------------------------------
     async def handle_completions(self, request: web.Request) -> web.Response:
+        trace_id = self._trace_id_for(request)
         try:
             body = await request.json()
         except json.JSONDecodeError:
-            return _err(400, "invalid JSON body")
+            return _err(400, "invalid JSON body", trace_id)
         try:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
-            return _err(404, str(e))
+            return _err(404, str(e), trace_id)
         try:
             n, best_of, logprobs, stops = self._parse_choice_params(body)
         except (ValueError, TypeError) as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         prompt_tokens = self._encode_prompt(body)
         echo = bool(body.get("echo"))
 
@@ -614,21 +680,24 @@ class ModelServer:
         if echo and logprobs is not None:
             # OpenAI echo+logprobs returns PROMPT logprobs, which the
             # engine does not record; reject rather than mislabel.
-            return _err(400, "echo is not supported together with logprobs")
+            return _err(400, "echo is not supported together with logprobs",
+                        trace_id)
         if body.get("stream"):
             if n > 1 or best_of > 1:
-                return _err(400, "streaming supports n=1 / best_of=1")
+                return _err(400, "streaming supports n=1 / best_of=1",
+                            trace_id)
             if logprobs is not None:
                 # Explicit rejection beats a silently-null field: chunks
                 # carry no logprobs object.
-                return _err(400, "logprobs is not supported with streaming")
+                return _err(400, "logprobs is not supported with streaming",
+                            trace_id)
             req = self._make_request(body, prompt_tokens, adapter)
             prefix = echo_text() if echo else None
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
                 "text_completion",
                 lambda delta, fin: {"index": 0, "text": delta, "finish_reason": fin},
-                stops=stops, echo_prefix=prefix,
+                stops=stops, echo_prefix=prefix, trace_id=trace_id,
             )
         # best_of candidates decode concurrently (the engine batches them);
         # ranking needs per-token logprobs, so candidates record at least the
@@ -643,16 +712,16 @@ class ModelServer:
         try:
             reqs = await self._run_many(reqs, stops)
         except EngineDraining as e:
-            return _err(503, str(e))  # replica is leaving the routable set
+            return _err(503, str(e), trace_id)  # replica leaving routable set
         except ValueError as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         except queue_mod.Full:
             # Backpressure the gateway cleanly; its scheduler already sees the
             # queue depth via /metrics and will shed/redirect.
-            return _err(429, "prefill queue is full")
+            return _err(429, "prefill queue is full", trace_id)
         for r in reqs:
             if r.error:
-                return _err(500, r.error)
+                return _err(500, r.error, trace_id)
         texts = {id(r): self._truncate_at_stop(r, stops)[0] for r in reqs}
         # OpenAI usage semantics: completion_tokens counts ALL generated
         # candidates, including best_of ones not returned.
@@ -678,6 +747,9 @@ class ModelServer:
                 choice["logprobs"] = self._logprobs_json(
                     r, logprobs, text_limit=len(texts[id(r)]))
             choices.append(choice)
+        headers = self._record_spans(
+            trace_id, self._engine_spans(reqs[0]),
+            status=reqs[0].finish_reason or "ok")
         return web.json_response({
             "id": f"cmpl-{reqs[0].request_id}",
             "object": "text_completion",
@@ -690,31 +762,33 @@ class ModelServer:
                 "total_tokens": len(prompt_tokens) + completion_tokens,
             },
             "ttft_ms": round(reqs[0].ttft_s * 1000, 2),
-        })
+        }, headers=headers)
 
     async def handle_chat(self, request: web.Request) -> web.Response:
+        trace_id = self._trace_id_for(request)
         try:
             body = await request.json()
         except json.JSONDecodeError:
-            return _err(400, "invalid JSON body")
+            return _err(400, "invalid JSON body", trace_id)
         messages = body.get("messages", [])
         try:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
-            return _err(404, str(e))
+            return _err(404, str(e), trace_id)
         try:
             prompt, add_bos = self._chat_prompt(messages)
             n, best_of, _, stops = self._parse_choice_params(body)
             lp_flag, top_n = self._parse_chat_logprobs(body)
         except (ValueError, TypeError) as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         prompt_tokens = self.tokenizer.encode(prompt, add_bos=add_bos)
         if body.get("stream"):
             if n > 1 or best_of > 1:
-                return _err(400, "streaming supports n=1 / best_of=1")
+                return _err(400, "streaming supports n=1 / best_of=1",
+                            trace_id)
             if lp_flag:
                 return _err(400, "logprobs are not supported with "
-                                 "streaming chat completions")
+                                 "streaming chat completions", trace_id)
             req = self._make_request(body, prompt_tokens, adapter)
             return await self._stream_sse(
                 request, req, body.get("model", self.model_name),
@@ -724,7 +798,7 @@ class ModelServer:
                     "delta": ({"content": delta} if delta else {}),
                     "finish_reason": fin,
                 },
-                stops=stops,
+                stops=stops, trace_id=trace_id,
             )
         reqs = [self._make_request(body, list(prompt_tokens), adapter,
                                    logprobs=top_n if lp_flag else None,
@@ -733,14 +807,14 @@ class ModelServer:
         try:
             reqs = await self._run_many(reqs, stops)
         except EngineDraining as e:
-            return _err(503, str(e))  # replica is leaving the routable set
+            return _err(503, str(e), trace_id)  # replica leaving routable set
         except ValueError as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         except queue_mod.Full:
-            return _err(429, "prefill queue is full")
+            return _err(429, "prefill queue is full", trace_id)
         for r in reqs:
             if r.error:
-                return _err(500, r.error)
+                return _err(500, r.error, trace_id)
         choices = []
         for i, r in enumerate(reqs):
             text, _ = self._truncate_at_stop(r, stops)
@@ -754,6 +828,9 @@ class ModelServer:
                     r, top_n, text_limit=len(text))
             choices.append(choice)
         completion_tokens = sum(len(r.output_tokens) for r in reqs)
+        headers = self._record_spans(
+            trace_id, self._engine_spans(reqs[0]),
+            status=reqs[0].finish_reason or "ok")
         return web.json_response({
             "id": f"chatcmpl-{reqs[0].request_id}",
             "object": "chat.completion",
@@ -765,7 +842,7 @@ class ModelServer:
                 "completion_tokens": completion_tokens,
                 "total_tokens": len(prompt_tokens) + completion_tokens,
             },
-        })
+        }, headers=headers)
 
     # -- disaggregation hops (server/kv_transfer.py) -------------------------
     async def handle_prefill(self, request: web.Request) -> web.Response:
@@ -777,14 +854,15 @@ class ModelServer:
         "serve this single-hop instead", so unsupported requests degrade,
         never fail.
         """
+        trace_id = self._trace_id_for(request)
         try:
             body = await request.json()
         except json.JSONDecodeError:
-            return _err(400, "invalid JSON body")
+            return _err(400, "invalid JSON body", trace_id)
         try:
             adapter = self._resolve_model(body.get("model", self.model_name))
         except AdapterError as e:
-            return _err(404, str(e))
+            return _err(404, str(e), trace_id)
         try:
             n, best_of, logprobs, _stops = self._parse_choice_params(body)
             if isinstance(body.get("messages"), list):
@@ -795,10 +873,10 @@ class ModelServer:
             else:
                 prompt_tokens = self._encode_prompt(body)
         except (ValueError, TypeError) as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         if n > 1 or best_of > 1 or body.get("echo"):
             return _err(422, "prefill hop supports single-candidate, "
-                             "non-echo requests")
+                             "non-echo requests", trace_id)
         req = self._make_request(body, prompt_tokens, adapter,
                                  logprobs=logprobs)
         loop = asyncio.get_running_loop()
@@ -806,19 +884,30 @@ class ModelServer:
             handoff = await loop.run_in_executor(
                 None, lambda: self.engine.prefill_only(req))
         except EngineDraining as e:
-            return _err(503, str(e))
+            return _err(503, str(e), trace_id)
         except queue_mod.Full:
-            return _err(429, "prefill queue is full")
+            return _err(429, "prefill queue is full", trace_id)
         except ValueError as e:
-            return _err(422, str(e))  # e.g. prompt beyond the bucket set
+            return _err(422, str(e), trace_id)  # e.g. beyond the bucket set
         except RuntimeError as e:
-            return _err(500, str(e))
+            return _err(500, str(e), trace_id)
         handoff.body = body  # envelope params ride to the decode hop
+        handoff.trace_id = trace_id  # one id across both hops
+        t_ser0 = time.time()
+        wire = handoff.to_bytes()
+        t_ser1 = time.time()
+        self.engine.observe_handoff(t_ser1 - t_ser0)
+        headers = self._record_spans(
+            trace_id,
+            self._engine_spans(req, with_decode=False)
+            + [("handoff.serialize", t_ser0, t_ser1)],
+            status="handoff")
+        headers.update({"x-request-id": req.request_id,
+                        "x-prefill-ttft-ms": f"{req.ttft_s * 1000:.2f}"})
         return web.Response(
-            body=handoff.to_bytes(),
+            body=wire,
             content_type="application/octet-stream",
-            headers={"x-request-id": req.request_id,
-                     "x-prefill-ttft-ms": f"{req.ttft_s * 1000:.2f}"},
+            headers=headers,
         )
 
     async def handle_attach(self, request: web.Request) -> web.Response:
@@ -828,28 +917,41 @@ class ModelServer:
         from llm_instance_gateway_tpu.server.kv_transfer import PrefillHandoff
 
         raw = await request.read()
+        t_des0 = time.time()
         try:
             handoff = PrefillHandoff.from_bytes(raw)
         except Exception as e:
-            return _err(400, f"malformed handoff: {e}")
+            return _err(400, f"malformed handoff: {e}",
+                        self._trace_id_for(request))
+        t_des1 = time.time()
+        # The trace id prefers the header but survives header-stripping
+        # transports via the handoff's own field.
+        trace_id = (tracing.header_trace_id(request.headers)
+                    or handoff.trace_id or tracing.new_trace_id())
         body = handoff.body or {}
         chat = isinstance(body.get("messages"), list)
         try:
             _, _, _, stops = self._parse_choice_params(body)
         except (ValueError, TypeError) as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace_id)
         try:
             req = self.engine.attach_prefilled(handoff)
         except EngineDraining as e:
-            return _err(503, str(e))
+            return _err(503, str(e), trace_id)
         except queue_mod.Full:
-            return _err(429, "attach admission queue is full")
+            return _err(429, "attach admission queue is full", trace_id)
         except AdapterError as e:
-            return _err(404, str(e))
+            return _err(404, str(e), trace_id)
         except ValueError as e:
-            return _err(422, str(e))
+            return _err(422, str(e), trace_id)
+        t_att = time.time()
+        self.engine.observe_handoff(t_att - t_des0)
+        attach_spans = [("handoff.deserialize", t_des0, t_des1),
+                        ("handoff.attach", t_des1, t_att)]
         model = body.get("model", self.model_name)
         if body.get("stream"):
+            for name, s, e in attach_spans:
+                self.tracer.record(trace_id, name, s, e)
             if chat:
                 return await self._stream_sse(
                     request, req, model, "chat.completion.chunk",
@@ -858,12 +960,14 @@ class ModelServer:
                         "delta": ({"content": delta} if delta else {}),
                         "finish_reason": fin,
                     },
-                    stops=stops, submit=False)
+                    stops=stops, submit=False, trace_id=trace_id,
+                    decode_start=t_att)
             return await self._stream_sse(
                 request, req, model, "text_completion",
                 lambda delta, fin: {"index": 0, "text": delta,
                                     "finish_reason": fin},
-                stops=stops, submit=False)
+                stops=stops, submit=False, trace_id=trace_id,
+                decode_start=t_att)
         loop = asyncio.get_running_loop()
         try:
             if stops:
@@ -879,7 +983,12 @@ class ModelServer:
             req.error = "generation timed out"
             req.cancelled.set()
         if req.error:
-            return _err(500, req.error)
+            return _err(500, req.error, trace_id)
+        trace_headers = self._record_spans(
+            trace_id,
+            attach_spans + [("engine.decode", t_att,
+                             req.t_done or time.time())],
+            status=req.finish_reason or "ok")
         text, _ = self._truncate_at_stop(req, stops)
         completion_tokens = len(req.output_tokens)
         usage = {
@@ -903,7 +1012,7 @@ class ModelServer:
                 "model": model,
                 "choices": [choice],
                 "usage": usage,
-            })
+            }, headers=trace_headers)
         choice = {
             "index": 0,
             "text": text,
@@ -920,7 +1029,7 @@ class ModelServer:
             "choices": [choice],
             "usage": usage,
             "ttft_ms": round(req.ttft_s * 1000, 2),
-        })
+        }, headers=trace_headers)
 
     # -- admin -------------------------------------------------------------
     async def handle_models(self, request: web.Request) -> web.Response:
@@ -982,9 +1091,20 @@ class ModelServer:
     # -- ops ---------------------------------------------------------------
     async def handle_metrics(self, request: web.Request) -> web.Response:
         snap = self.engine.metrics_snapshot()
+        # The engine doesn't know its served name; the phase-latency
+        # histogram families are labeled by model + role at render time.
+        snap.setdefault("model_name", self.model_name)
         return web.Response(
             text=metrics_mod.render(snap), content_type="text/plain"
         )
+
+    async def handle_debug_traces(self, request: web.Request) -> web.Response:
+        """Recent traces recorded by THIS replica (``?trace_id=`` filter).
+        The gateway's /debug/traces shows the merged cross-process view;
+        this endpoint is the replica-local ground truth (streaming decode
+        spans live only here)."""
+        return web.json_response(
+            tracing.debug_traces_payload(self.tracer, request.query))
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.engine.draining:
@@ -999,10 +1119,17 @@ class ModelServer:
         return web.Response(text="ok")
 
 
-def _err(status: int, message: str) -> web.Response:
+def _err(status: int, message: str,
+         trace_id: str | None = None) -> web.Response:
+    """Error envelope; when a trace id is known it rides both the body and
+    the header so failed requests stay correlatable."""
+    error: dict = {"message": message, "type": "invalid_request_error"}
+    if trace_id:
+        error["trace_id"] = trace_id
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error"}},
+        {"error": error},
         status=status,
+        headers={tracing.TRACE_HEADER: trace_id} if trace_id else None,
     )
 
 

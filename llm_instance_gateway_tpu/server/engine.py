@@ -43,6 +43,7 @@ from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.server.sampling import sample
+from llm_instance_gateway_tpu.tracing import LATENCY_BUCKETS, Histogram
 
 logger = logging.getLogger(__name__)
 
@@ -238,6 +239,11 @@ class Request:
     finish_reason: str | None = None
     error: str | None = None
     t_submit: float = 0.0
+    # Wall clock of the request's first prefill compute (queue wait ends
+    # here; the tracing layer derives the engine.queue_wait/engine.prefill
+    # span boundary from it).  0.0 = never prefilled on THIS engine (e.g.
+    # an attached handoff, whose prefill ran on the prefill-role replica).
+    t_prefill_start: float = 0.0
     t_first_token: float = 0.0
     t_done: float = 0.0
     done: threading.Event = field(default_factory=threading.Event)
@@ -588,6 +594,14 @@ class Engine:
         self.total_requests = 0
         self.decode_tps_ema = 0.0
         self.ttft_history: list[float] = []
+        # Phase-latency histograms, rendered as tpu:prefill_seconds /
+        # tpu:handoff_seconds / tpu:decode_step_seconds (server/metrics.py).
+        # Mutated under self._lock; exported copy-out via metrics_snapshot.
+        self.phase_hist: dict[str, Histogram] = {
+            "prefill": Histogram(LATENCY_BUCKETS),
+            "handoff": Histogram(LATENCY_BUCKETS),
+            "decode_step": Histogram(LATENCY_BUCKETS),
+        }
 
         if self.paged:
             step_fn = paged_lib.decode_step_paged
@@ -1110,6 +1124,7 @@ class Engine:
         used_tokens += parked
         with self._lock:
             tps = self.decode_tps_ema
+            phase_hist = {k: h.state() for k, h in self.phase_hist.items()}
         running_adapters = self.lora.running_adapters() if self.lora else []
         max_lora = self.lora.max_slots if self.lora else 0
         # The in-flight chunk stream counts as prefilling: invisible, the
@@ -1131,6 +1146,9 @@ class Engine:
             "decode_tokens_per_sec": tps,
             "running_lora_adapters": running_adapters,
             "max_lora": max_lora,
+            # Phase-latency histogram states (server/metrics.py renders
+            # these as the tpu:*_seconds histogram families).
+            "phase_hist": phase_hist,
             **({"prefix_reused_tokens": self.prefix_reused_tokens}
                if self._prefix_enabled else {}),
             **({
@@ -1568,6 +1586,7 @@ class Engine:
         admissions.
         """
         try:
+            self._stamp_prefill_start(req)
             n = len(req.prompt_tokens)
             lora_slot = (self.lora.slot_for(req.adapter)
                          if self.lora is not None else -1)
@@ -1596,6 +1615,7 @@ class Engine:
             self._finish(req, "cancelled")
             return
         try:
+            self._stamp_prefill_start(req)
             n = len(req.prompt_tokens)
             lora_slot = (self.lora.slot_for(req.adapter)
                          if self.lora is not None else -1)
@@ -2043,6 +2063,8 @@ class Engine:
             inst = n_tokens / step_s if step_s > 0 else 0.0
             a = self.cfg.tps_ema_alpha
             self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
+            # Per-cycle cadence (each verify cycle emits >= 1 token/row).
+            self.phase_hist["decode_step"].observe(step_s / max(1, n_cycles))
 
     def _prefill_common(self, req: Request):
         """Shared admission path: bucketed (or ring sequence-parallel)
@@ -2050,6 +2072,7 @@ class Engine:
         — otherwise ``_admit_and_insert`` diverts them to the interleaved
         chunk stream (``_start_stream``/``_stream_step``).
         Returns (slot_idx, first_token_device, n, lora_slot, lp_info)."""
+        self._stamp_prefill_start(req)
         slot_idx = self._free_slot_index()
         n = len(req.prompt_tokens)
         lora_slot = self.lora.slot_for(req.adapter) if self.lora is not None else -1
@@ -2325,6 +2348,7 @@ class Engine:
         if len(live) == 1:
             single_fn(live[0])
             return None
+        self._stamp_prefill_start(*live)
         try:
             first_tokens, k, v, (lps, top_vs, top_is) = (
                 self._bucket_prefill_many(live, ns, lora_slots))
@@ -2464,6 +2488,7 @@ class Engine:
         if req.cancelled.is_set():
             self._finish(req, "cancelled")
             return True
+        self._stamp_prefill_start(req)
         try:
             slot_idx = self._free_slot_index()
             lora_slot = (self.lora.slot_for(req.adapter)
@@ -2608,6 +2633,25 @@ class Engine:
             self.ttft_history.append(req.ttft_s)
             if len(self.ttft_history) > 1000:
                 del self.ttft_history[:500]
+            if req.t_prefill_start and req.t_first_token:
+                # Pure prefill compute (queue wait excluded) — the
+                # tpu:prefill_seconds exposition family.
+                self.phase_hist["prefill"].observe(
+                    max(0.0, req.t_first_token - req.t_prefill_start))
+
+    def observe_handoff(self, seconds: float) -> None:
+        """Record one handoff-plane operation (serialize on the prefill
+        side; deserialize+attach admission on the decode side) into
+        tpu:handoff_seconds.  Called from the HTTP layer."""
+        with self._lock:
+            self.phase_hist["handoff"].observe(max(0.0, seconds))
+
+    def _stamp_prefill_start(self, *reqs: Request) -> None:
+        """Queue wait ends / prefill compute begins (first stamp wins)."""
+        now = time.time()
+        for r in reqs:
+            if not r.t_prefill_start:
+                r.t_prefill_start = now
 
     def _store_logprobs(self, req: Request, lp, top_v, top_i) -> None:
         """Record a token's logprob info iff the request asked for it."""
@@ -2772,6 +2816,9 @@ class Engine:
             inst = n_tokens / step_s if step_s > 0 else 0.0
             a = self.cfg.tps_ema_alpha
             self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
+            # Steady-state cadence: wall per decode step (one token per
+            # active slot per step) — tpu:decode_step_seconds.
+            self.phase_hist["decode_step"].observe(step_s / n_steps)
 
     # ------------------------------------------------------------------
     # pipelined decode: overlap host readback with the next device block
@@ -3056,6 +3103,11 @@ class Engine:
             inst = n_tokens / step_s if step_s > 0 else 0.0
             a = self.cfg.tps_ema_alpha
             self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
+            # Pipelined blocks overlap compute with readback, so step_s is
+            # the block's WALL (dispatch-to-process) — still the honest
+            # per-step cadence the gateway compares across replicas.
+            self.phase_hist["decode_step"].observe(
+                step_s / max(1, blk["n_steps"]))
 
     def _is_stop(self, req: Request, tok: int) -> bool:
         return tok == self.eos_id or tok in req.stop_token_ids

@@ -98,6 +98,10 @@ class PrefillHandoff:
     # Original OpenAI request body (dict) — the decode hop shapes the client
     # envelope (stream/stop/logprobs/model name) from it.
     body: dict | None = None
+    # End-to-end tracing: the request's trace id rides the wire so the
+    # decode hop tags its spans with the SAME id even when the transport
+    # drops the x-lig-trace-id header (tracing.py).
+    trace_id: str | None = None
     _extra: dict = field(default_factory=dict)
 
     # -- KV access ----------------------------------------------------------
@@ -152,6 +156,7 @@ class PrefillHandoff:
             "kv_format": self.kv_format,
             "kv_dtype": self.kv_dtype,
             "body": self.body,
+            "trace_id": self.trace_id,
             "arrays": [
                 {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
                 for name, a in arrays
@@ -216,6 +221,7 @@ class PrefillHandoff:
             k_scale=parsed.get("k_scale"),
             v_scale=parsed.get("v_scale"),
             body=meta.get("body"),
+            trace_id=meta.get("trace_id"),
         )
 
 
@@ -249,22 +255,9 @@ def export_handoff(request, k, v, n: int, first_token: int, lp_info=None,
         first_lp = float(np.asarray(lp))
         first_top_vals = np.asarray(top_v, np.float32).tolist()
         first_top_ids = np.asarray(top_i, np.int32).tolist()
-    if quantize == "int8":
-        kq, ks = _quantize_host(k_np)
-        vq, vs = _quantize_host(v_np)
-        return PrefillHandoff(
-            request_id=request.request_id,
-            prompt_tokens=list(request.prompt_tokens), n=n,
-            adapter=request.adapter,
-            max_new_tokens=request.max_new_tokens,
-            sampling=samp, stop_token_ids=list(request.stop_token_ids),
-            logprobs=request.logprobs, first_token=int(first_token),
-            first_lp=first_lp, first_top_vals=first_top_vals,
-            first_top_ids=first_top_ids, t_submit=request.t_submit,
-            kv_format="int8", kv_dtype=str(k_np.dtype),
-            k=kq, v=vq, k_scale=ks, v_scale=vs,
-        )
-    return PrefillHandoff(
+    # One carry dict for both wire lanes: a new carried field added here
+    # reaches int8 AND raw handoffs, instead of silently diverging.
+    carry = dict(
         request_id=request.request_id,
         prompt_tokens=list(request.prompt_tokens), n=n,
         adapter=request.adapter,
@@ -273,8 +266,14 @@ def export_handoff(request, k, v, n: int, first_token: int, lp_info=None,
         logprobs=request.logprobs, first_token=int(first_token),
         first_lp=first_lp, first_top_vals=first_top_vals,
         first_top_ids=first_top_ids, t_submit=request.t_submit,
-        kv_format="raw", kv_dtype=str(k_np.dtype), k=k_np, v=v_np,
+        kv_dtype=str(k_np.dtype),
     )
+    if quantize == "int8":
+        kq, ks = _quantize_host(k_np)
+        vq, vs = _quantize_host(v_np)
+        return PrefillHandoff(**carry, kv_format="int8",
+                              k=kq, v=vq, k_scale=ks, v_scale=vs)
+    return PrefillHandoff(**carry, kv_format="raw", k=k_np, v=v_np)
 
 
 def make_request(handoff: PrefillHandoff):

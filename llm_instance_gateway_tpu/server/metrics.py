@@ -10,14 +10,22 @@ from __future__ import annotations
 
 import time
 
+# Shared with the gateway render path (gateway/telemetry.py): ONE escaping
+# implementation for every exposition surface.  Adapter names are validated
+# at load time, but escape anyway — one bad label must not poison the whole
+# exposition the gateway scrapes.
+from llm_instance_gateway_tpu.tracing import escape_label, render_histogram
 
-def escape_label(value: str) -> str:
-    """Prometheus label-value escaping (backslash, quote, newline).
+__all__ = ["escape_label", "render", "render_histogram"]
 
-    Adapter names are validated at load time, but escape anyway — one bad
-    label must not poison the whole exposition the gateway scrapes.
-    """
-    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+# Phase-latency histogram families (tracing tentpole): snapshot key ->
+# exposition family.  Labeled by model and pool role so disaggregated and
+# collocated paths compare directly on one dashboard.
+PHASE_FAMILIES = (
+    ("prefill", "tpu:prefill_seconds"),
+    ("handoff", "tpu:handoff_seconds"),
+    ("decode_step", "tpu:decode_step_seconds"),
+)
 
 
 def render(snapshot: dict, extra: dict | None = None) -> str:
@@ -70,6 +78,13 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             "# TYPE tpu:spec_tokens_per_cycle gauge",
             f"tpu:spec_tokens_per_cycle {snapshot['spec_tokens_per_cycle']}",
         ]
+    phase_hist = snapshot.get("phase_hist") or {}
+    if phase_hist:
+        labels = {"model": snapshot.get("model_name", ""),
+                  "role": snapshot.get("pool_role", "") or "collocated"}
+        for key, family in PHASE_FAMILIES:
+            if key in phase_hist:
+                lines += render_histogram(family, phase_hist[key], labels)
     for name, value in (extra or {}).items():
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")

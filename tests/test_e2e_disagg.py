@@ -124,6 +124,67 @@ def test_two_hop_streaming(disagg_stack):
     assert raw.rstrip().endswith("data: [DONE]")
 
 
+def test_trace_covers_all_phases_across_three_processes(disagg_stack):
+    """Observability tentpole acceptance: one request through the
+    three-process stack yields ONE trace id, visible in the response header
+    and retrievable from the PROXY's /debug/traces, whose spans cover
+    gateway pick, prefill, handoff, and decode with non-overlapping,
+    monotonically ordered boundaries; TTFT/TPOT histograms render with
+    valid ``le`` buckets and parse via utils/prom_parse."""
+    status, body, headers = _post_with_headers(
+        f"http://127.0.0.1:{GATEWAY_PORT}/v1/completions", BODY)
+    assert status == 200, body
+    assert headers.get("x-served-by") == "pre1+dec1", headers
+    trace_id = headers.get("x-lig-trace-id")
+    assert trace_id, headers
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{GATEWAY_PORT}/debug/traces"
+            f"?trace_id={trace_id}", timeout=10) as resp:
+        doc = json.loads(resp.read())
+    assert len(doc["traces"]) == 1, doc
+    trace = doc["traces"][0]
+    assert trace["path"] == "disaggregated"
+    spans = {s["name"]: s for s in trace["spans"]}
+
+    # The four-phase chain, in wall-clock order, without overlap: the
+    # gateway pick ends before the prefill engine starts, prefill ends
+    # before the handoff serializes, the serialized bytes deserialize and
+    # attach on the decode replica, and decode runs last.  All processes
+    # share this host's clock, so strict ordering must hold.
+    chain = ["gateway.admission", "engine.prefill", "handoff.serialize",
+             "handoff.deserialize", "handoff.attach", "engine.decode"]
+    for name in chain:
+        assert name in spans, (name, sorted(spans))
+    for a, b in zip(chain, chain[1:]):
+        assert spans[a]["end"] <= spans[b]["start"] + 1e-6, (
+            a, spans[a], b, spans[b])
+        assert spans[a]["start"] <= spans[a]["end"]
+    # The pick itself rides the admission span.
+    assert spans["gateway.admission"]["attrs"]["pick_s"] >= 0
+
+    # Phase histograms on the gateway: valid le buckets, parseable.
+    from llm_instance_gateway_tpu.utils import prom_parse
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{GATEWAY_PORT}/metrics", timeout=10) as resp:
+        families = prom_parse.parse_text(resp.read().decode())
+    for fam in ("gateway_ttft_seconds", "gateway_tpot_seconds",
+                "gateway_e2e_seconds"):
+        buckets = [s for s in families.get(fam + "_bucket", [])
+                   if s.labels.get("path") == "disaggregated"]
+        assert buckets, fam
+        les = [float("inf") if s.labels["le"] == "+Inf"
+               else float(s.labels["le"]) for s in buckets]
+        assert les == sorted(les) and les[-1] == float("inf")
+    # And the model servers export their phase histograms too.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{PREFILL_PORT}/metrics", timeout=10) as resp:
+        server_fams = prom_parse.parse_text(resp.read().decode())
+    assert server_fams["tpu:prefill_seconds_count"][0].value > 0
+    assert server_fams["tpu:handoff_seconds_count"][0].value > 0
+
+
 def test_decode_replica_prefix_reuse_climbs(disagg_stack):
     """Attached prompts register in the decode replica's prefix cache:
     repeating the same prompt drives tpu:prefix_reused_tokens up."""

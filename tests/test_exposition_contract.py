@@ -1,0 +1,237 @@
+"""Exposition contract: every /metrics surface round-trips the parser.
+
+Malformed Prometheus lines historically failed only at SCRAPE time (an
+operator's Prometheus silently dropping the page); this suite makes them
+fail tier-1 instead.  Both render paths — the gateway's
+``GatewayMetrics.render`` (proxy /metrics) and the server's
+``server.metrics.render`` (api_http /metrics) — are exercised through real
+aiohttp endpoints, parsed with ``utils/prom_parse.py``, and linted for
+histogram invariants (cumulative ``le`` buckets, ``+Inf`` == ``_count``)
+and TYPE coverage.
+"""
+
+import asyncio
+import math
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu import tracing
+from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics
+from llm_instance_gateway_tpu.server import metrics as server_metrics
+from llm_instance_gateway_tpu.utils import prom_parse
+
+HOSTILE = 'evil"model\nname\\tenant'
+
+
+def lint_exposition(text: str) -> dict:
+    """Parse + validate one exposition page; returns the parsed families.
+
+    Checks:
+    - every non-comment line parsed into a sample (no silent drops);
+    - every family has a ``# TYPE`` comment (base name for histogram
+      component series);
+    - histogram families: ``le`` values are parseable floats ending in
+      ``+Inf``, bucket counts are cumulative, and the ``+Inf`` bucket
+      equals ``_count``.
+    """
+    families = prom_parse.parse_text(text)
+    types: dict[str, str] = {}
+    n_samples = 0
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif line and not line.startswith("#"):
+            n_samples += 1
+    assert n_samples == sum(len(v) for v in families.values()), (
+        "some exposition lines failed to parse")
+
+    def base_name(fam: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if fam.endswith(suffix) and fam[: -len(suffix)] in types:
+                return fam[: -len(suffix)]
+        return fam
+
+    for fam in families:
+        assert base_name(fam) in types, f"family {fam} has no TYPE line"
+
+    for name, kind in types.items():
+        if kind != "histogram":
+            continue
+        buckets = families.get(name + "_bucket", [])
+        counts = families.get(name + "_count", [])
+        assert buckets and counts, f"histogram {name} missing series"
+        # Group bucket series by their non-le labels.
+        series: dict[tuple, list] = {}
+        for s in buckets:
+            key = tuple(sorted(
+                (k, v) for k, v in s.labels.items() if k != "le"))
+            series.setdefault(key, []).append(s)
+        for key, ss in series.items():
+            les = [math.inf if s.labels["le"] == "+Inf"
+                   else float(s.labels["le"]) for s in ss]
+            assert les == sorted(les), f"{name}{key}: le not ascending"
+            assert les[-1] == math.inf, f"{name}{key}: no +Inf bucket"
+            values = [s.value for s in ss]
+            assert values == sorted(values), f"{name}{key}: not cumulative"
+            count = next(
+                (c.value for c in counts if tuple(sorted(
+                    c.labels.items())) == key), None)
+            assert count == values[-1], (
+                f"{name}{key}: +Inf bucket {values[-1]} != _count {count}")
+    return families
+
+
+def loaded_gateway_metrics() -> GatewayMetrics:
+    gm = GatewayMetrics()
+    for model in ("sql-assist", HOSTILE):
+        gm.record_request(model)
+        gm.record_usage(model, 10, 20)
+        gm.record_phase(model, "collocated", ttft_s=0.05, tpot_s=0.002,
+                        e2e_s=0.4)
+        gm.record_phase(model, "disaggregated", ttft_s=0.03, tpot_s=0.001,
+                        e2e_s=0.2)
+    gm.record_pick("pod-a", 0.0002, affinity_hit=True)
+    gm.record_shed()            # pre-admission: unlabeled fallback
+    gm.record_shed("sql-assist")
+    gm.record_error(HOSTILE)
+    return gm
+
+
+def server_snapshot() -> dict:
+    hist = tracing.Histogram(tracing.LATENCY_BUCKETS)
+    for v in (0.002, 0.01, 7.0):
+        hist.observe(v)
+    return {
+        "model_name": HOSTILE,
+        "pool_role": "prefill",
+        "prefill_queue_size": 2,
+        "decode_queue_size": 1,
+        "num_requests_running": 3,
+        "num_requests_waiting": 3,
+        "kv_cache_usage_perc": 0.25,
+        "kv_tokens_capacity": 8192,
+        "kv_tokens_free": 6144,
+        "decode_tokens_per_sec": 123.4,
+        "running_lora_adapters": ["a1", HOSTILE],
+        "max_lora": 4,
+        "prefix_reused_tokens": 77,
+        "phase_hist": {
+            "prefill": hist.state(),
+            "handoff": tracing.Histogram(tracing.LATENCY_BUCKETS).state(),
+            "decode_step": hist.state(),
+        },
+    }
+
+
+class FakeEngine:
+    def metrics_snapshot(self):
+        return server_snapshot()
+
+
+def test_gateway_render_contract():
+    families = lint_exposition(loaded_gateway_metrics().render())
+    # Labeled + unlabeled shed coexist (pre-admission fallback).
+    shed = {tuple(s.labels.items()): s.value
+            for s in families["gateway_shed_total"]}
+    assert shed[()] == 1 and shed[(("model", "sql-assist"),)] == 1
+    # The hostile model name round-trips through escaping.
+    assert any(s.labels.get("model") == HOSTILE
+               for s in families["gateway_errors_total"])
+    # Pick latency is a true histogram now (satellite): bucket series exist.
+    assert "gateway_pick_latency_seconds_bucket" in families
+    # Tentpole families, labeled by model AND path.
+    for fam in ("gateway_ttft_seconds", "gateway_tpot_seconds",
+                "gateway_e2e_seconds"):
+        paths = {s.labels["path"] for s in families[fam + "_bucket"]}
+        assert paths == {"collocated", "disaggregated"}
+
+
+def test_server_render_contract():
+    families = lint_exposition(server_metrics.render(server_snapshot()))
+    for fam in ("tpu:prefill_seconds", "tpu:handoff_seconds",
+                "tpu:decode_step_seconds"):
+        assert fam + "_bucket" in families
+        labels = families[fam + "_bucket"][0].labels
+        assert labels["model"] == HOSTILE and labels["role"] == "prefill"
+    assert families["tpu:prefill_seconds_count"][0].value == 3
+
+
+def test_proxy_metrics_endpoint_round_trips():
+    """The REAL aiohttp /metrics endpoint on the proxy serves lint-clean
+    text (same render path, plus the pool-signal re-export)."""
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    async def run():
+        pod = Pod(HOSTILE, "127.0.0.1:1")
+        ds = Datastore(pods=[pod])
+        ds.set_pool(InferencePool(name="pool"))
+        provider = StaticProvider(
+            [PodMetrics(pod=pod,
+                        metrics=Metrics(prefix_reused_tokens=9))])
+        proxy = GatewayProxy(
+            Server(Scheduler(provider, token_aware=False,
+                             prefill_aware=False), ds), provider, ds)
+        proxy.metrics = loaded_gateway_metrics()
+        proxy.metrics.pool_signals_fn = provider.all_pod_metrics
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+        finally:
+            await client.close()
+        families = lint_exposition(text)
+        assert any(
+            s.labels["pod"] == HOSTILE
+            for s in families["gateway_pool_prefix_reused_tokens_total"])
+
+    asyncio.run(run())
+
+
+def test_api_http_metrics_endpoint_round_trips():
+    """The REAL aiohttp /metrics endpoint on the model server serves
+    lint-clean text, including the new histogram families."""
+    from llm_instance_gateway_tpu.server.api_http import ModelServer
+
+    async def run():
+        server = ModelServer(FakeEngine(), tokenizer=None,
+                             model_name="llama3-tiny")
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+        finally:
+            await client.close()
+        families = lint_exposition(text)
+        assert "tpu:decode_step_seconds_bucket" in families
+        # ModelServer injects its served name when the snapshot lacks one.
+        assert (families["tpu:prefill_seconds_bucket"][0]
+                .labels["model"] == HOSTILE)
+
+    asyncio.run(run())
+
+
+def test_pick_latency_histogram_math():
+    """The summary -> histogram satellite: counts land in the right le
+    buckets and quantile() still answers from the same state."""
+    gm = GatewayMetrics()
+    for v in (0.0002, 0.0002, 0.04):
+        gm.record_pick("p", v, False)
+    families = lint_exposition(gm.render())
+    by_le = {s.labels["le"]: s.value
+             for s in families["gateway_pick_latency_seconds_bucket"]}
+    assert by_le["0.00025"] == 2.0
+    assert by_le["0.05"] == 3.0
+    assert by_le["+Inf"] == 3.0
+    assert families["gateway_pick_latency_seconds_count"][0].value == 3

@@ -105,8 +105,13 @@ def test_shed_returns_429():
             proxy, body={"model": "batch", "prompt": "x"}
         )
         assert status == 429
-        assert json.loads(body)["error"]["type"] == "rate_limit_exceeded"
-        assert "gateway_shed_total 1" in proxy.metrics.render()
+        payload = json.loads(body)
+        assert payload["error"]["type"] == "rate_limit_exceeded"
+        # Post-admission sheds carry the model dimension (the shed happened
+        # AFTER body parse, so the tenant is known) and the trace id rides
+        # the error body for correlation.
+        assert payload["error"]["trace_id"]
+        assert 'gateway_shed_total{model="batch"} 1' in proxy.metrics.render()
 
     asyncio.run(run())
 
@@ -143,6 +148,62 @@ def test_health_gated_on_pool_sync():
         proxy2 = build_proxy({}, [])
         status2, _, _ = await run_proxy_request(proxy2, path="/healthz", method="get")
         assert status2 == 200
+
+    asyncio.run(run())
+
+
+def test_trace_id_echo_and_debug_traces():
+    """Tentpole contract at the proxy: one trace id in the response header,
+    retrievable from /debug/traces with gateway spans, and TTFT/e2e
+    histograms rendered from the server-reported first-token time."""
+
+    async def run():
+        upstream = await start_fake_model_server("upstream-a")
+        addr = f"127.0.0.1:{upstream.port}"
+        pods = {Pod("good", addr): fake_metrics(queue=0, kv=0.1)}
+        proxy = build_proxy(pods, [make_model("m")])
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/completions", json={"model": "m", "prompt": "hello"},
+                headers={"x-lig-trace-id": "cafe0123cafe0123"})
+            assert resp.status == 200
+            # Inbound id honored and echoed.
+            assert resp.headers["x-lig-trace-id"] == "cafe0123cafe0123"
+            dbg = await client.get(
+                "/debug/traces", params={"trace_id": "cafe0123cafe0123"})
+            doc = await dbg.json()
+            assert len(doc["traces"]) == 1
+            trace = doc["traces"][0]
+            names = [s["name"] for s in trace["spans"]]
+            assert "gateway.admission" in names
+            assert "gateway.upstream" in names
+            for a, b in zip(trace["spans"], trace["spans"][1:]):
+                assert a["start"] <= b["start"]  # export sorted
+            assert trace["model"] == "m"
+            assert trace["path"] == "collocated"
+            metrics_resp = await client.get("/metrics")
+            text = await metrics_resp.text()
+            assert "gateway_e2e_seconds_bucket" in text
+        finally:
+            await client.close()
+            await upstream.close()
+
+    asyncio.run(run())
+
+
+def test_error_body_carries_trace_id():
+    async def run():
+        pods = {Pod("p", "127.0.0.1:1"): fake_metrics()}
+        proxy = build_proxy(pods, [])
+        status, body, headers = await run_proxy_request(
+            proxy, body={"model": "ghost", "prompt": "x"}
+        )
+        assert status == 400
+        err = json.loads(body)["error"]
+        assert err["trace_id"]
+        assert headers["x-lig-trace-id"] == err["trace_id"]
 
     asyncio.run(run())
 

@@ -1,0 +1,117 @@
+"""Tracing substrate unit tests: span ring, sampling, wire format,
+histogram exposition (llm_instance_gateway_tpu/tracing.py)."""
+
+import json
+
+from llm_instance_gateway_tpu import tracing
+from llm_instance_gateway_tpu.utils import prom_parse
+
+
+class TestTraceIds:
+    def test_mint_shape_and_uniqueness(self):
+        ids = {tracing.new_trace_id() for _ in range(1000)}
+        assert len(ids) == 1000
+        assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)
+
+    def test_header_lookup_case_insensitive(self):
+        assert tracing.header_trace_id({"X-Lig-Trace-Id": "abc"}) == "abc"
+        assert tracing.header_trace_id({"x-lig-trace-id": "abc"}) == "abc"
+        assert tracing.header_trace_id({"other": "x"}) is None
+
+
+class TestTracer:
+    def test_record_and_export(self):
+        tr = tracing.Tracer(capacity=8)
+        tr.record("t1", "b", 2.0, 3.0)
+        tr.record("t1", "a", 1.0, 2.0, pod="p0")
+        tr.annotate("t1", model="m", path="collocated", status="ok")
+        t = tr.get("t1")
+        assert t["model"] == "m" and t["path"] == "collocated"
+        assert t["status"] == "ok"
+        # Spans export sorted by start time regardless of record order.
+        assert [s["name"] for s in t["spans"]] == ["a", "b"]
+        assert t["spans"][0]["attrs"] == {"pod": "p0"}
+        assert t["t_created"] == 1.0
+
+    def test_ring_bounds_memory(self):
+        tr = tracing.Tracer(capacity=4)
+        for i in range(200):
+            tr.record(f"t{i}", "s", float(i), float(i + 1))
+        recent = tr.recent(1000)
+        # The flat ring holds capacity*16 span records; old traces age out.
+        assert 0 < len(recent) <= 4 * 16
+        assert tr.get("t0") is None  # evicted
+        assert tr.get("t199") is not None
+
+    def test_recent_most_recent_first(self):
+        tr = tracing.Tracer(capacity=16)
+        for i in range(5):
+            tr.record(f"t{i}", "s", float(i), float(i + 1))
+        assert [t["trace_id"] for t in tr.recent(3)] == ["t4", "t3", "t2"]
+
+    def test_disabled_and_zero_sample_record_nothing(self):
+        for tr in (tracing.Tracer(enabled=False),
+                   tracing.Tracer(sample=0.0)):
+            tr.record("t", "s", 1.0, 2.0)
+            assert tr.recent(10) == []
+            assert not tr.sampled("t")
+
+    def test_sampling_is_deterministic_per_trace(self):
+        a = tracing.Tracer(sample=0.5)
+        b = tracing.Tracer(sample=0.5)
+        ids = [tracing.new_trace_id() for _ in range(256)]
+        decisions = [a.sampled(t) for t in ids]
+        # Deterministic hash: a second tracer (= another process) agrees on
+        # every trace, so cross-process traces are complete or absent.
+        assert decisions == [b.sampled(t) for t in ids]
+        assert any(decisions) and not all(decisions)
+
+    def test_wire_round_trip(self):
+        spans = [("engine.prefill", 10.0, 10.5), ("engine.decode", 10.5, 12.0)]
+        header = tracing.wire_spans(spans)
+        assert json.loads(header)  # valid compact JSON
+        tr = tracing.Tracer()
+        tr.record_wire("t", header)
+        assert [s["name"] for s in tr.get("t")["spans"]] == [
+            "engine.prefill", "engine.decode"]
+
+    def test_wire_parse_tolerates_junk(self):
+        assert tracing.parse_wire("not json") == []
+        assert tracing.parse_wire('[["only-name"]]') == []
+        assert tracing.parse_wire('[["n", 1, 2], ["bad"], ["m", 3, 4]]') == [
+            ("n", 1.0, 2.0), ("m", 3.0, 4.0)]
+
+
+class TestHistogramRender:
+    def test_custom_buckets_size_counts(self):
+        h = tracing.Histogram(tracing.LATENCY_BUCKETS)
+        assert len(h.counts) == len(tracing.LATENCY_BUCKETS) + 1
+        h.observe(0.003)
+        h.observe(100.0)  # overflow bucket
+        assert h.n == 2 and h.counts[-1] == 1
+
+    def test_exposition_shape(self):
+        h = tracing.Histogram((0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        lines = tracing.render_histogram("f_seconds", h, {"model": "m"})
+        text = "\n".join(lines) + "\n"
+        fams = prom_parse.parse_text(text)
+        buckets = fams["f_seconds_bucket"]
+        # Cumulative counts: 1 (<=0.1), 2 (<=1.0), 3 (+Inf).
+        assert [s.value for s in buckets] == [1.0, 2.0, 3.0]
+        assert [s.labels["le"] for s in buckets] == ["0.1", "1", "+Inf"]
+        assert all(s.labels["model"] == "m" for s in buckets)
+        assert fams["f_seconds_count"][0].value == 3
+        assert abs(fams["f_seconds_sum"][0].value - 5.55) < 1e-9
+
+    def test_label_escaping(self):
+        h = tracing.Histogram((1.0,))
+        h.observe(0.5)
+        hostile = 'bad"model\nname\\x'
+        text = "\n".join(
+            tracing.render_histogram("f_seconds", h, {"model": hostile})) + "\n"
+        fams = prom_parse.parse_text(text)
+        # The parser unescapes back to the original hostile value — the
+        # exposition stayed well-formed.
+        assert fams["f_seconds_bucket"][0].labels["model"] == hostile

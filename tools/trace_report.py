@@ -1,0 +1,115 @@
+"""Per-phase latency report from tracing output.
+
+Reads either shape and prints a per-phase p50/p95/p99 table:
+
+- a ``/debug/traces`` JSON document (proxy or api_http; file path, URL, or
+  ``-`` for stdin): ``{"traces": [{"spans": [{"name", "start", "end"}]}]}``
+  — each span's duration is one sample of its phase;
+- a loadgen ``--trace-out`` file: ``{"phases": {name: [seconds, ...]}}``.
+
+Usage:
+  python tools/trace_report.py http://localhost:8081/debug/traces
+  python tools/trace_report.py traces.json --json
+  python -m llm_instance_gateway_tpu.gateway.loadgen --requests 2000 \
+      --trace-out /tmp/phases.json && python tools/trace_report.py /tmp/phases.json
+
+bench.py invokes the same table-building functions on the handoff
+microbench's requests, so every BENCH emission carries the per-phase
+latency breakdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(source: str) -> dict:
+    """Load a traces/phases JSON document from a path, URL, or stdin."""
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with open(source) as f:
+        return json.load(f)
+
+
+def phase_samples(doc: dict) -> dict[str, list[float]]:
+    """Phase name -> duration samples (seconds), from either input shape."""
+    if "phases" in doc:
+        return {str(k): [float(x) for x in v]
+                for k, v in doc["phases"].items()}
+    samples: dict[str, list[float]] = {}
+    for trace in doc.get("traces", []):
+        for span in trace.get("spans", []):
+            try:
+                d = float(span["end"]) - float(span["start"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            samples.setdefault(str(span.get("name", "?")), []).append(
+                max(0.0, d))
+    return samples
+
+
+def percentile(xs: list[float], p: float) -> float:
+    """Nearest-rank percentile over a SORTED sample list."""
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def phase_table(samples: dict[str, list[float]]) -> list[dict]:
+    """One row per phase: n, p50/p95/p99 and mean in milliseconds, sorted
+    by p50 descending (the biggest time sinks lead)."""
+    rows = []
+    for name, xs in samples.items():
+        if not xs:
+            continue
+        xs = sorted(xs)
+        rows.append({
+            "phase": name,
+            "n": len(xs),
+            "p50_ms": round(percentile(xs, 0.50) * 1e3, 3),
+            "p95_ms": round(percentile(xs, 0.95) * 1e3, 3),
+            "p99_ms": round(percentile(xs, 0.99) * 1e3, 3),
+            "mean_ms": round(sum(xs) / len(xs) * 1e3, 3),
+        })
+    rows.sort(key=lambda r: (-r["p50_ms"], r["phase"]))
+    return rows
+
+
+def format_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(no phase samples)"
+    headers = ("phase", "n", "p50_ms", "p95_ms", "p99_ms", "mean_ms")
+    widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
+    def fmt(vals):
+        return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
+                         for i, (v, w) in enumerate(zip(vals, widths)))
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt([r[h] for h in headers]) for r in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="per-phase latency table from /debug/traces JSON or "
+                    "loadgen --trace-out output")
+    parser.add_argument("source",
+                        help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the rows as one JSON line instead of a "
+                             "table")
+    args = parser.parse_args(argv)
+    rows = phase_table(phase_samples(load(args.source)))
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(format_table(rows))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
