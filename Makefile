@@ -2,7 +2,7 @@
 
 IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
 
-.PHONY: test test-e2e native bench loadgen sim docker-build install deploy undeploy fmt
+.PHONY: test test-e2e native bench loadgen sim metrics-docs docker-build install deploy undeploy fmt
 
 test:            ## unit + integration tests (CPU, virtual 8-device mesh)
 	python -m pytest tests/ -q -m "not e2e"
@@ -21,6 +21,9 @@ loadgen:         ## gateway load rig (200 fake pods x 5 adapters)
 
 sim:             ## routing-policy simulation sweep
 	python -m llm_instance_gateway_tpu.sim.run --qps 20 30 --policies random production
+
+metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
+	python tools/gen_metrics_docs.py docs/METRICS.md
 
 docker-build:    ## build the framework image
 	docker build -t $(IMG) .

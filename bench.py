@@ -555,6 +555,22 @@ def _bench_error(msg: str) -> None:
     })
 
 
+def _bench_skip(reason: str, probe_log: list) -> None:
+    """Structured SKIP emission (r05 lesson: the multichip bench wedged 12
+    minutes and then emitted only an opaque error STRING).  ``skipped`` +
+    ``probe_log`` let trajectory tooling distinguish "device never became
+    available" (an environment skip) from a real perf regression, and show
+    exactly how the probe budget was spent."""
+    _emit({
+        "metric": "multiplexed_lora_tokens_per_sec",
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "skipped": reason,
+        "probe_log": probe_log,
+    })
+
+
 def _claim_device_with_retry(probe_timeout_s: float = 120.0) -> None:
     """Adaptive retry-with-backoff on the device grant, BEFORE backend init.
 
@@ -595,8 +611,15 @@ def _claim_device_with_retry(probe_timeout_s: float = 120.0) -> None:
     )
     backoff = 30.0  # dense early: most observed wedges clear in minutes
     attempts = 0
+    t_loop0 = time.monotonic()
+    # Per-probe structured log: emitted with the skip sentinel so the
+    # trajectory record shows HOW the budget was spent (outcomes:
+    # ok / claimed_cpu / probe_timeout / rc=N).
+    probe_log: list[dict] = []
     while True:
         attempts += 1
+        t_p0 = time.monotonic()
+        outcome = "probe_timeout"
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code], timeout=probe_timeout_s + 30,
@@ -607,16 +630,26 @@ def _claim_device_with_retry(probe_timeout_s: float = 120.0) -> None:
             # 'axon,cpu', so a fast-failing relay would otherwise fall back
             # to CPU and publish a tiny-CPU number as the TPU result.
             if "CLAIM_OK" in out and "CLAIM_OK cpu" not in out:
-                return
+                outcome = "ok"
+            elif "CLAIM_OK cpu" in out:
+                outcome = "claimed_cpu"
+            else:
+                outcome = f"rc={r.returncode}"
         except subprocess.TimeoutExpired:
             pass
+        probe_log.append({
+            "attempt": attempts,
+            "t_s": round(t_p0 - t_loop0, 1),
+            "probe_s": round(time.monotonic() - t_p0, 1),
+            "outcome": outcome,
+        })
+        if outcome == "ok":
+            return
         if time.monotonic() + backoff + probe_timeout_s > deadline:
             break
         time.sleep(backoff)
         backoff = min(backoff * 2, 180.0)
-    _bench_error(
-        f"device unavailable after {attempts} probes over "
-        f"{budget_s / 60:.0f} min (wedged relay grant?)")
+    _bench_skip("device_unavailable", probe_log)
     sys.exit(2)
 
 
@@ -635,8 +668,8 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
 
     def watch():
         if not done.wait(timeout_s):
-            _bench_error(f"device unavailable after {timeout_s:.0f}s "
-                         "(wedged relay grant?)")
+            _bench_skip("device_unavailable",
+                        [{"outcome": f"watchdog_timeout_{timeout_s:.0f}s"}])
             os._exit(2)
 
     threading.Thread(target=watch, daemon=True).start()

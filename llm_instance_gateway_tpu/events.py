@@ -1,0 +1,137 @@
+"""Flight recorder: a bounded structured event journal.
+
+The tracing layer (``tracing.py``) answers "where did THIS request spend its
+time?"; this module answers the operator's next question — "what was the
+SYSTEM doing in the 30 seconds before the breach?".  Both the gateway proxy
+and the model server keep one ``EventJournal``: a bounded ring of structured
+events (admission rejections, pick outcomes, disaggregation fallbacks, role
+changes, scrape failures, SLO state transitions) carrying trace ids so an
+event row correlates with the request timeline that produced it.
+
+Design constraints, in order:
+
+- **Emit is hot-path-adjacent**: one dict build + a ``deque.append`` (the
+  append is GIL-atomic on a maxlen-bounded ring, same trick as the span
+  recorder); only the seq/counter bump takes a lock.
+- **Bounded**: the ring holds ``LIG_EVENTS_CAPACITY`` (default 2048) events;
+  old ones age out.  Per-kind COUNTERS are cumulative forever — the exported
+  ``*_events_total{kind=...}`` family keeps rate()-able history even after
+  the rows themselves rotate out.
+- **Queryable**: ``/debug/events?since=<seq>`` serves incremental reads (a
+  poller passes the last seq it saw), ``?kind=`` filters, and
+  ``snapshot()`` feeds the black-box dump written on an SLO fast burn.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+# Event kinds.  One flat namespace shared by the gateway and the model
+# server; the journal accepts any string, these are the kinds the framework
+# itself emits (tools/blackbox_report.py knows how to narrate them).
+ADMISSION_REJECT = "admission_reject"   # 4xx/5xx before a pod was picked
+SHED = "shed"                           # load-shed drop (429)
+PICK = "pick"                           # scheduler outcome for a request
+DISAGG_FALLBACK = "disagg_fallback"     # two-hop path degraded to single-hop
+UPSTREAM_ERROR = "upstream_error"       # replica connection/stream failure
+ROLE_CHANGE = "role_change"             # replica role/drain state change
+SCRAPE_FAILURE = "scrape_failure"       # metrics scrape of a pod failed
+SLO_TRANSITION = "slo_transition"       # objective entered/left a burn state
+HEALTH_TRANSITION = "health_transition"  # pod health state changed
+BREACH_DUMP = "breach_dump"             # black-box dump written
+
+
+class EventJournal:
+    """Bounded, thread-safe structured event ring with cumulative counters."""
+
+    def __init__(self, capacity: int | None = None, clock=time.time):
+        if capacity is None:
+            capacity = int(os.environ.get("LIG_EVENTS_CAPACITY", "2048"))
+        self.capacity = max(1, capacity)
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        # kind -> cumulative count (survives ring rotation; exported as a
+        # labeled counter family on the owning surface's /metrics).
+        self.counts: dict[str, int] = {}
+
+    def emit(self, kind: str, trace_id: str = "", **attrs) -> int:
+        """Record one event; returns its monotonic sequence number."""
+        event = {"seq": 0, "ts": round(self._clock(), 6), "kind": kind}
+        if trace_id:
+            event["trace_id"] = trace_id
+        if attrs:
+            event["attrs"] = attrs
+        # Seq assignment AND the append happen under the lock: emitters run
+        # on the event loop, the provider refresh thread, and the engine
+        # drain thread, and an out-of-order append would make a since-
+        # cursor poller skip the late event forever.
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self._ring.append(event)
+        return event["seq"]
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def events(self, since: int = 0, limit: int = 256,
+               kind: str | None = None) -> list[dict]:
+        """The oldest ``limit`` events with seq > ``since``, oldest first.
+
+        ``since`` makes polling incremental AND lossless: pass the last
+        seq already seen and the next page comes back — a burst larger
+        than ``limit`` is paged through, never silently skipped (trimming
+        the newest rows would drop the oldest ones unrecoverably, exactly
+        the pre-breach record a flight recorder exists to keep).  A poller
+        that sees ``events[0]["seq"] > since + 1`` knows rows rotated out
+        of the bounded ring between polls.
+        """
+        rows = [e for e in list(self._ring) if e["seq"] > since
+                and (kind is None or e["kind"] == kind)]
+        return rows[:max(0, limit)]
+
+    def snapshot(self) -> dict:
+        """Full journal state for the black-box dump."""
+        with self._lock:
+            counts = dict(self.counts)
+            seq = self._seq
+        return {"seq": seq, "capacity": self.capacity, "counts": counts,
+                "events": list(self._ring)}
+
+    def render_prom(self, family: str) -> list[str]:
+        """Cumulative per-kind counters as one Prometheus counter family
+        (``family{kind="..."}``; an unlabeled 0 line when nothing has been
+        emitted — the shared renderer in tracing.py)."""
+        from llm_instance_gateway_tpu.tracing import render_counter
+
+        with self._lock:
+            counts = dict(self.counts)
+        return render_counter(family, counts, "kind")
+
+
+def debug_events_payload(journal: EventJournal, query) -> dict:
+    """The shared ``/debug/events`` response body (proxy and api_http):
+    ``?since=<seq>`` incremental cursor, ``?kind=`` filter, ``?limit=``
+    page size (1..2048, default 256).  Pages are oldest-first; poll with
+    ``since=next_since`` until ``next_since == seq`` (the journal head) to
+    drain a backlog without losing events."""
+    try:
+        since = max(0, int(query.get("since", "0")))
+    except ValueError:
+        since = 0
+    try:
+        limit = max(1, min(int(query.get("limit", "256")), 2048))
+    except ValueError:
+        limit = 256
+    kind = query.get("kind") or None
+    rows = journal.events(since=since, limit=limit, kind=kind)
+    return {"seq": journal.seq,
+            "next_since": rows[-1]["seq"] if rows else journal.seq,
+            "events": rows}

@@ -1,0 +1,357 @@
+"""Per-replica health scoring: fuse every per-pod signal into one 0-1 score
+with hysteresis, and LOG the routing decisions the score would change.
+
+The gateway already holds rich per-replica state — scrape freshness and
+failure streaks (provider), queue/KV gauges and phase-latency means
+(metrics_client), and, new in this PR, per-pod upstream error/timeout and
+handoff-failure streaks recorded by the proxy's data path.  Each signal
+individually is too noisy to act on; fused and hysteresis-filtered they
+identify the ONE replica in a pool that is quietly degrading (CaraServe's
+rank-aware serving presumes exactly this attribution).
+
+This PR is deliberately **log-only**: the scheduler reads the state ONLY to
+count would-be avoidance decisions (``tpu:health_would_avoid_total``), so
+routing stays byte-identical to pre-PR behavior and tier-1 stays
+deterministic.  A later PR can flip the counter into a filter once the
+score's false-positive rate is measured in the field.
+
+Score composition (weighted mean of components, each clamped to [0, 1]):
+
+====================  =====================================================
+``freshness``         scrape recency/failure streak from the provider
+``errors``            upstream error + handoff-failure streaks (proxy)
+``queue``             total queue depth vs ``queue_sat``
+``kv``                1 - KV-cache usage
+``latency``           pod prefill/decode means vs the pool median
+====================  =====================================================
+
+State machine per pod: ``healthy`` -> ``degraded`` -> ``unhealthy`` with
+separate enter/exit thresholds AND a dwell count (``dwell_ticks``
+consecutive ticks at the candidate state) so a single bad scrape never
+flips a replica's state.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import escape_label, render_counter
+
+logger = logging.getLogger(__name__)
+
+HEALTHY, DEGRADED, UNHEALTHY = "healthy", "degraded", "unhealthy"
+STATES = (HEALTHY, DEGRADED, UNHEALTHY)
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    # Freshness: a scrape success within this window scores 1.0; failures
+    # decay the component linearly, reaching 0 at scrape_streak_floor.
+    stale_after_s: float = 3.0
+    scrape_streak_floor: int = 5
+    # Upstream error/handoff streaks: component reaches 0 at the floor.
+    error_streak_floor: int = 4
+    # Queue depth considered fully saturated (component 0).
+    queue_sat: int = 50
+    # Pod phase-mean at this multiple of the pool median scores 0.
+    latency_ratio_sat: float = 4.0
+    # Hysteresis: separate enter/exit thresholds per state boundary, plus
+    # a dwell (consecutive ticks at the candidate state) before committing.
+    # Calibration: an idle healthy replica scores ~0.95-1.0; ONE fully-bad
+    # signal (error streak at floor, or a dead scrape) lands ~0.70 —
+    # degraded; two bad signals land ~0.40 — unhealthy.
+    degraded_enter: float = 0.75
+    degraded_exit: float = 0.85
+    unhealthy_enter: float = 0.45
+    unhealthy_exit: float = 0.60
+    dwell_ticks: int = 2
+    # Component weights (normalized at use; keep them summing to 1.0 for
+    # readable scores).
+    w_freshness: float = 0.30
+    w_errors: float = 0.30
+    w_queue: float = 0.15
+    w_kv: float = 0.10
+    w_latency: float = 0.15
+
+
+def _clamp(v: float) -> float:
+    return 0.0 if v < 0.0 else (1.0 if v > 1.0 else v)
+
+
+class HealthScorer:
+    """Fuses per-pod signals into scores/states; all methods thread-safe.
+
+    ``update()`` runs on the proxy's observability tick (and lazily from
+    ``/debug/health``); ``record_upstream``/``record_handoff`` are called
+    from the proxy's request path; ``note_pick`` from the scheduler's pick
+    seam (executor threads).
+    """
+
+    def __init__(self, provider=None, cfg: HealthConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 clock=time.time):
+        self.provider = provider
+        self.cfg = cfg or HealthConfig()
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Proxy-fed streaks + cumulative counters (per pod name).
+        self._err_streak: dict[str, int] = {}
+        self._handoff_streak: dict[str, int] = {}
+        self.upstream_errors: dict[str, int] = {}
+        self.upstream_timeouts: dict[str, int] = {}
+        self.handoff_failures: dict[str, int] = {}
+        # Scoring state.
+        self._scores: dict[str, float] = {}
+        self._components: dict[str, dict] = {}
+        self._states: dict[str, str] = {}
+        self._pending: dict[str, tuple[str, int]] = {}  # candidate, streak
+        self.last_update = 0.0
+        # Log-only scheduler hook.
+        self.would_avoid_total = 0
+        self.would_avoid: dict[str, int] = {}
+
+    # -- request-path feeds --------------------------------------------------
+    def record_upstream(self, pod_name: str, ok: bool,
+                        timeout: bool = False) -> None:
+        """One upstream outcome for ``pod_name`` (success resets the
+        streak; failures extend it and bump the cumulative counters)."""
+        with self._lock:
+            if ok:
+                self._err_streak[pod_name] = 0
+                return
+            self._err_streak[pod_name] = self._err_streak.get(pod_name, 0) + 1
+            self.upstream_errors[pod_name] = (
+                self.upstream_errors.get(pod_name, 0) + 1)
+            if timeout:
+                self.upstream_timeouts[pod_name] = (
+                    self.upstream_timeouts.get(pod_name, 0) + 1)
+
+    def record_handoff(self, pod_name: str, ok: bool) -> None:
+        """One disaggregation-hop outcome attributed to ``pod_name``."""
+        with self._lock:
+            if ok:
+                self._handoff_streak[pod_name] = 0
+                return
+            self._handoff_streak[pod_name] = (
+                self._handoff_streak.get(pod_name, 0) + 1)
+            self.handoff_failures[pod_name] = (
+                self.handoff_failures.get(pod_name, 0) + 1)
+
+    # -- scoring -------------------------------------------------------------
+    def _freshness(self, pod_name: str, scrape: dict, now: float) -> float:
+        info = scrape.get(pod_name)
+        if info is None:
+            return 1.0  # providers without scrape tracking: innocent
+        last_ok, streak = info
+        if streak:
+            return _clamp(1.0 - streak / self.cfg.scrape_streak_floor)
+        if last_ok is not None and now - last_ok > self.cfg.stale_after_s:
+            # No recorded failures but the scrape loop itself stalled —
+            # half-credit: the data is stale but the pod may be fine.
+            return 0.5
+        return 1.0
+
+    def _latency(self, m, medians: dict) -> float:
+        """Pod phase means vs the pool median; no samples = no penalty."""
+        worst = 1.0
+        for attr, median in medians.items():
+            mean = getattr(m, attr, 0.0)
+            if mean <= 0.0 or median <= 0.0:
+                continue
+            ratio = mean / median
+            comp = _clamp(1.0 - (ratio - 1.0)
+                          / max(1e-9, self.cfg.latency_ratio_sat - 1.0))
+            worst = min(worst, comp)
+        return worst
+
+    def maybe_update(self, min_interval_s: float = 1.0) -> None:
+        """On-demand scoring with a floor between passes.  The dwell-tick
+        hysteresis is defined in UPDATE PASSES, so an unthrottled debug
+        poller would commit state transitions at its own poll rate instead
+        of the configured cadence."""
+        if self._clock() - self.last_update >= min_interval_s:
+            self.update()
+
+    def update(self, now: float | None = None) -> None:
+        """Recompute every pod's score and advance the state machines."""
+        now = self._clock() if now is None else now
+        self.last_update = now
+        provider = self.provider
+        pods = provider.all_pod_metrics() if provider is not None else []
+        scrape_fn = getattr(provider, "scrape_health", None)
+        scrape = scrape_fn() if scrape_fn is not None else {}
+        medians = {}
+        for attr in ("prefill_seconds_mean", "decode_step_seconds_mean"):
+            vals = [getattr(pm.metrics, attr, 0.0) for pm in pods]
+            vals = [v for v in vals if v > 0.0]
+            if vals:
+                medians[attr] = statistics.median(vals)
+        cfg = self.cfg
+        w_total = (cfg.w_freshness + cfg.w_errors + cfg.w_queue + cfg.w_kv
+                   + cfg.w_latency)
+        transitions = []
+        with self._lock:
+            live = set()
+            for pm in pods:
+                name = pm.pod.name
+                live.add(name)
+                m = pm.metrics
+                streak = max(self._err_streak.get(name, 0),
+                             self._handoff_streak.get(name, 0))
+                comp = {
+                    "freshness": self._freshness(name, scrape, now),
+                    "errors": _clamp(
+                        1.0 - streak / cfg.error_streak_floor),
+                    "queue": _clamp(
+                        1.0 - m.total_queue_size / max(1, cfg.queue_sat)),
+                    "kv": _clamp(1.0 - m.kv_cache_usage_percent),
+                    "latency": self._latency(m, medians),
+                }
+                score = (cfg.w_freshness * comp["freshness"]
+                         + cfg.w_errors * comp["errors"]
+                         + cfg.w_queue * comp["queue"]
+                         + cfg.w_kv * comp["kv"]
+                         + cfg.w_latency * comp["latency"]) / w_total
+                self._scores[name] = round(score, 4)
+                self._components[name] = {k: round(v, 4)
+                                          for k, v in comp.items()}
+                t = self._advance(name, score)
+                if t is not None:
+                    transitions.append(t)
+            # Pods that left the pool drop ALL their state — a name reused
+            # by a fresh replica must not inherit an unhealthy verdict, and
+            # the cumulative per-pod counters must not grow (and keep
+            # emitting exposition lines) for every pod name k8s churn ever
+            # produced.
+            for table in (self._scores, self._components, self._states,
+                          self._pending, self._err_streak,
+                          self._handoff_streak, self.upstream_errors,
+                          self.upstream_timeouts, self.handoff_failures,
+                          self.would_avoid):
+                for name in [n for n in table if n not in live]:
+                    del table[name]
+        for name, frm, to, score in transitions:
+            log = logger.warning if to != HEALTHY else logger.info
+            log("pod %s health: %s -> %s (score %.3f)", name, frm, to, score)
+            if self.journal is not None:
+                self.journal.emit(events_mod.HEALTH_TRANSITION, pod=name,
+                                  frm=frm, to=to, score=round(score, 4))
+
+    def _target_state(self, score: float, cur: str) -> str:
+        cfg = self.cfg
+        if cur == HEALTHY:
+            if score < cfg.unhealthy_enter:
+                return UNHEALTHY
+            if score < cfg.degraded_enter:
+                return DEGRADED
+            return HEALTHY
+        if cur == DEGRADED:
+            if score < cfg.unhealthy_enter:
+                return UNHEALTHY
+            if score > cfg.degraded_exit:
+                return HEALTHY
+            return DEGRADED
+        # UNHEALTHY
+        if score > cfg.unhealthy_exit:
+            return HEALTHY if score > cfg.degraded_exit else DEGRADED
+        return UNHEALTHY
+
+    def _advance(self, name: str, score: float):
+        """Dwell-filtered transition; returns (name, frm, to, score) when a
+        transition commits.  Caller holds the lock."""
+        cur = self._states.get(name, HEALTHY)
+        want = self._target_state(score, cur)
+        if want == cur:
+            self._pending.pop(name, None)
+            return None
+        cand, streak = self._pending.get(name, (want, 0))
+        streak = streak + 1 if cand == want else 1
+        if streak >= self.cfg.dwell_ticks:
+            self._states[name] = want
+            self._pending.pop(name, None)
+            return (name, cur, want, score)
+        self._pending[name] = (want, streak)
+        return None
+
+    # -- read surface --------------------------------------------------------
+    def score(self, pod_name: str) -> float | None:
+        with self._lock:
+            return self._scores.get(pod_name)
+
+    def state(self, pod_name: str) -> str:
+        with self._lock:
+            return self._states.get(pod_name, HEALTHY)
+
+    def note_pick(self, pod_name: str) -> None:
+        """Scheduler pick seam, LOG-ONLY this release: count (and debug-log)
+        picks that health-aware routing would have steered elsewhere.  Must
+        never influence the pick — no RNG, no exceptions, no filtering."""
+        with self._lock:
+            st = self._states.get(pod_name, HEALTHY)
+            if st == HEALTHY:
+                return
+            self.would_avoid_total += 1
+            self.would_avoid[pod_name] = self.would_avoid.get(pod_name, 0) + 1
+            n = self.would_avoid[pod_name]
+        logger.debug("health: pick of %s (state=%s) would be avoided "
+                     "(%d so far; routing unchanged this release)",
+                     pod_name, st, n)
+
+    # -- export --------------------------------------------------------------
+    def render(self) -> list[str]:
+        with self._lock:
+            scores = dict(self._scores)
+            states = {n: self._states.get(n, HEALTHY) for n in scores}
+            errors = dict(self.upstream_errors)
+            timeouts = dict(self.upstream_timeouts)
+            handoffs = dict(self.handoff_failures)
+            avoid = dict(self.would_avoid)
+        lines = []
+        if scores:
+            lines.append("# TYPE gateway_pod_health_score gauge")
+            for pod in sorted(scores):
+                lines.append(
+                    'gateway_pod_health_score{pod="%s"} %.4f'
+                    % (escape_label(pod), scores[pod]))
+            lines.append("# TYPE gateway_pod_health_state gauge")
+            for pod in sorted(states):
+                lines.append(
+                    'gateway_pod_health_state{pod="%s",state="%s"} 1'
+                    % (escape_label(pod), escape_label(states[pod])))
+        lines += render_counter("gateway_upstream_errors_total", errors,
+                                "pod")
+        lines += render_counter("gateway_upstream_timeouts_total", timeouts,
+                                "pod")
+        lines += render_counter("gateway_handoff_failures_total", handoffs,
+                                "pod")
+        lines += render_counter("tpu:health_would_avoid_total", avoid, "pod")
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/health`` JSON body."""
+        with self._lock:
+            pods = {}
+            for name in sorted(self._scores):
+                pods[name] = {
+                    "score": self._scores[name],
+                    "state": self._states.get(name, HEALTHY),
+                    "components": self._components.get(name, {}),
+                    "upstream_error_streak": self._err_streak.get(name, 0),
+                    "handoff_failure_streak":
+                        self._handoff_streak.get(name, 0),
+                    "upstream_errors": self.upstream_errors.get(name, 0),
+                    "upstream_timeouts": self.upstream_timeouts.get(name, 0),
+                    "handoff_failures": self.handoff_failures.get(name, 0),
+                    "would_avoid": self.would_avoid.get(name, 0),
+                }
+            return {
+                "pods": pods,
+                "would_avoid_total": self.would_avoid_total,
+                "config": asdict(self.cfg),
+            }

@@ -16,7 +16,9 @@ from __future__ import annotations
 import concurrent.futures as futures
 import logging
 import threading
+import time
 
+from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.metrics_client import fetch_all
 from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
@@ -24,6 +26,9 @@ from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
 logger = logging.getLogger(__name__)
 
 FETCH_METRICS_TIMEOUT_S = 5.0  # provider.go:14
+# Scrape-failure events are throttled: first failure of a streak, then
+# every Nth — a pod that is down for minutes must not fill the journal.
+SCRAPE_EVENT_EVERY = 10
 
 
 class Provider:
@@ -41,6 +46,13 @@ class Provider:
         # consumers (the native scheduler's array cache) can reuse flattened
         # views between refreshes instead of re-marshalling per request.
         self.version = 0
+        # Per-pod scrape freshness (health-scoring observable): last
+        # successful scrape wall time + current consecutive-failure streak.
+        # The proxy sets ``journal`` so failure streaks land in the flight
+        # recorder (throttled).
+        self.journal: events_mod.EventJournal | None = None
+        self._scrape_ok_ts: dict[str, float] = {}
+        self._scrape_fail_streak: dict[str, int] = {}
 
     # -- snapshot accessors (provider.go:34-58) ----------------------------
     def all_pod_metrics(self) -> list[PodMetrics]:
@@ -123,15 +135,48 @@ class Provider:
             timeout_s=FETCH_METRICS_TIMEOUT_S,
             executor=self._executor,
         )
+        now = time.time()
+        failures: list[tuple[str, int]] = []
         with self._lock:
             for pm in snapshot:
-                updated = results.get(pm.pod.name)
-                if updated is not None and pm.pod.name in self._metrics:
-                    self._metrics[pm.pod.name] = PodMetrics(pod=pm.pod, metrics=updated)
+                name = pm.pod.name
+                updated = results.get(name)
+                if updated is not None and name in self._metrics:
+                    self._metrics[name] = PodMetrics(pod=pm.pod, metrics=updated)
+                # Freshness bookkeeping: a pod missing from ``results``
+                # failed or timed out this round (stale metrics persist,
+                # but the health scorer must know they are stale).
+                if updated is not None:
+                    self._scrape_ok_ts[name] = now
+                    self._scrape_fail_streak[name] = 0
+                else:
+                    streak = self._scrape_fail_streak.get(name, 0) + 1
+                    self._scrape_fail_streak[name] = streak
+                    if streak == 1 or streak % SCRAPE_EVENT_EVERY == 0:
+                        failures.append((name, streak))
+            for table in (self._scrape_ok_ts, self._scrape_fail_streak):
+                for name in [n for n in table if n not in self._metrics]:
+                    del table[name]
             self.version += 1
+        journal = self.journal
+        if journal is not None:
+            for name, streak in failures:
+                journal.emit(events_mod.SCRAPE_FAILURE, pod=name,
+                             streak=streak)
         if errs:
             logger.debug("metrics refresh errors: %s", "; ".join(errs))
         return errs
+
+    def scrape_health(self) -> dict[str, tuple[float | None, int]]:
+        """pod name -> (last successful scrape wall time or None, current
+        consecutive-failure streak) — the freshness component the health
+        scorer fuses."""
+        with self._lock:
+            return {
+                name: (self._scrape_ok_ts.get(name),
+                       self._scrape_fail_streak.get(name, 0))
+                for name in self._metrics
+            }
 
     def _debug_dump(self) -> None:
         logger.debug("===DEBUG: current pods and metrics: %s", self.all_pod_metrics())

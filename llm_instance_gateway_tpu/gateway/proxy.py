@@ -19,8 +19,20 @@ Endpoints:
   returned in their ``x-lig-spans`` response headers, so one JSON document
   answers "where did this request spend its time?" across up to three
   processes.
+- ``GET  /debug/slo`` — per-model SLO compliance + multi-window burn rates
+  + burn state (gateway/slo.py), evaluated on demand.
+- ``GET  /debug/health`` — per-replica 0-1 health scores with components
+  and hysteresis states (gateway/health.py; log-only this release).
+- ``GET  /debug/events`` — the flight recorder (events.py): admission
+  rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
+  transitions; ``?since=<seq>`` for incremental polling.
 - ``GET  /healthz``  — 200 once the InferencePool is synced (main.go:43-52).
 - ``GET  /v1/models`` — logical models from the datastore.
+
+On an SLO fast burn the proxy snapshots events + traces + metrics + SLO and
+health payloads into a black-box dump file (``LIG_BLACKBOX_DIR``, cooldown
+``LIG_BLACKBOX_COOLDOWN_S``); ``tools/blackbox_report.py`` renders the
+post-mortem timeline.
 
 Every response — success or error — carries the request's ``x-lig-trace-id``
 (error bodies embed it too) so clients and the loadgen can correlate.
@@ -32,12 +44,17 @@ import argparse
 import asyncio
 import json
 import logging
+import os
+import tempfile
 import time
 import uuid
 
 import aiohttp
 from aiohttp import web
 
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.gateway import slo as slo_mod
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
     RequestBody,
@@ -63,6 +80,9 @@ class GatewayProxy:
         provider,
         datastore: Datastore,
         request_timeout_s: float = 3600.0,
+        slo_cfg: "slo_mod.SLOConfig | None" = None,
+        health_cfg: "health_mod.HealthConfig | None" = None,
+        blackbox_dir: str | None = None,
     ):
         self.server = handler_server
         self.provider = provider
@@ -74,6 +94,38 @@ class GatewayProxy:
         # Request tracing (tracing.py): bounded span ring served by
         # /debug/traces; sampling/capacity via LIG_TRACE_* env.
         self.tracer = tracing.Tracer()
+        # Observability control plane (this PR's tentpole): flight
+        # recorder + SLO burn-rate engine + per-replica health scoring.
+        self.journal = events_mod.EventJournal()
+        self.health = health_mod.HealthScorer(
+            provider=provider, cfg=health_cfg, journal=self.journal)
+        self.slo = slo_mod.SLOEngine(
+            self.metrics, cfg=slo_cfg, journal=self.journal,
+            on_fast_burn=self._on_fast_burn)
+        # Black-box dump directory + dump-storm cooldown; both env-tunable.
+        self.blackbox_dir = (
+            blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
+            or os.path.join(tempfile.gettempdir(), "lig-blackbox"))
+        self._blackbox_cooldown_s = float(
+            os.environ.get("LIG_BLACKBOX_COOLDOWN_S", "60"))
+        self._last_dump_t = 0.0  # of the last SUCCESSFUL dump
+        self._dump_inflight = False
+        # Evaluation cadence for the background tick (0 disables the task;
+        # /debug/slo and /debug/health still evaluate on demand).
+        self.obs_tick_s = float(os.environ.get("LIG_SLO_TICK_S", "5"))
+        self._obs_task: asyncio.Task | None = None
+        # Scrape failures land in the flight recorder (Provider emits,
+        # throttled); StaticProvider and friends simply lack the attribute.
+        if hasattr(provider, "journal"):
+            provider.journal = self.journal
+        # Log-only would-avoid hook on the pick seam.  AdmissionController
+        # wraps the real scheduler; reach through to it.  A multi-pool
+        # front (MultiPoolServer) has no top-level scheduler — its pools'
+        # schedulers are wired by their own components; skip here.
+        outer = getattr(handler_server, "scheduler", None)
+        sched = getattr(outer, "_scheduler", outer)
+        if sched is not None and hasattr(sched, "health_advisor"):
+            sched.health_advisor = self.health
         self.request_timeout_s = request_timeout_s
         self._session: aiohttp.ClientSession | None = None
 
@@ -84,6 +136,9 @@ class GatewayProxy:
         app.router.add_post("/v1/chat/completions", self.handle_completion)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
+        app.router.add_get("/debug/slo", self.handle_debug_slo)
+        app.router.add_get("/debug/health", self.handle_debug_health)
+        app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/v1/models", self.handle_models)
         app.on_startup.append(self._on_startup)
@@ -94,10 +149,71 @@ class GatewayProxy:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=self.request_timeout_s)
         )
+        if self.obs_tick_s > 0:
+            self._obs_task = asyncio.get_running_loop().create_task(
+                self._observability_loop())
 
     async def _on_cleanup(self, app) -> None:
+        if self._obs_task is not None:
+            self._obs_task.cancel()
+            self._obs_task = None
         if self._session is not None:
             await self._session.close()
+
+    async def _observability_loop(self) -> None:
+        """Background evaluation tick: health scores first (cheap, feeds
+        the journal), then the SLO engine (may fire the black-box dump)."""
+        while True:
+            await asyncio.sleep(self.obs_tick_s)
+            try:
+                self.health.update()
+                self.slo.tick()
+            except Exception:
+                logger.exception("observability tick failed")
+
+    def _on_fast_burn(self, model: str, objective: str, burns: dict) -> None:
+        """SLO fast-burn hook: snapshot everything into a black-box dump
+        (rate-limited — a breach across N models must not write N dumps a
+        second) and journal where it went.
+
+        The file write runs OFF the event loop when one is running: a
+        fast burn is exactly when the gateway is already degraded, and a
+        multi-MB synchronous dump to slow disk would stall every in-flight
+        request.  The cooldown stamps only on SUCCESS — a failed write
+        (disk full, unwritable dir) retries on the next breach tick before
+        the pre-incident journal rotates out."""
+        now = time.time()
+        if (self._dump_inflight
+                or now - self._last_dump_t < self._blackbox_cooldown_s):
+            return
+        self._dump_inflight = True
+        reason = {"trigger": "fast_burn", "model": model,
+                  "objective": objective,
+                  "burns": {k: (round(v, 3) if v is not None else None)
+                            for k, v in burns.items()}}
+
+        def write() -> None:
+            try:
+                path = slo_mod.write_blackbox(
+                    self.blackbox_dir, reason, journal=self.journal,
+                    tracer=self.tracer, metrics_text=self._render_metrics(),
+                    slo_payload=self.slo.debug_payload(),
+                    health_payload=self.health.debug_payload())
+                self._last_dump_t = time.time()
+                self.journal.emit(events_mod.BREACH_DUMP, model=model,
+                                  objective=objective, path=path)
+                logger.warning(
+                    "SLO fast burn (%s/%s): black-box dump written to %s",
+                    model, objective, path)
+            except OSError:
+                logger.exception("black-box dump failed")
+            finally:
+                self._dump_inflight = False
+
+        try:
+            asyncio.get_running_loop().run_in_executor(None, write)
+        except RuntimeError:
+            write()  # synchronous contexts (tests, CLI tools)
 
     # -- request path ------------------------------------------------------
     def _error_response(self, status: int, message: str, kind: str,
@@ -168,7 +284,11 @@ class GatewayProxy:
                     None, self.server.process, req_ctx, RequestBody(body=body)
                 )
         except ProcessingError as e:
-            self.metrics.record_error(req_ctx.model or None)
+            self.metrics.record_error(req_ctx.model or None,
+                                      pre_admission=True)
+            self.journal.emit(events_mod.ADMISSION_REJECT, trace_id,
+                              model=req_ctx.model or "", status=e.status,
+                              error=str(e)[:200])
             self.tracer.record(trace_id, "gateway.admission", t_req,
                                time.time(), error=str(e))
             self.tracer.annotate(trace_id, model=req_ctx.model or "",
@@ -178,6 +298,9 @@ class GatewayProxy:
         self.metrics.record_request(req_ctx.model or "?")
         if result.immediate_status is not None:
             self.metrics.record_shed(req_ctx.model or None)
+            self.journal.emit(events_mod.SHED, trace_id,
+                              model=req_ctx.model or "",
+                              status=result.immediate_status)
             self.tracer.record(trace_id, "gateway.admission", t_req,
                                time.time(), shed=True)
             self.tracer.annotate(trace_id, model=req_ctx.model or "",
@@ -210,6 +333,10 @@ class GatewayProxy:
         # Forward to the picked replica (Envoy's ORIGINAL_DST role).
         out_body = result.body if result.body is not None else body
         decode_pod = getattr(req_ctx, "decode_pod", None)
+        self.journal.emit(
+            events_mod.PICK, trace_id, model=req_ctx.model or "",
+            pod=pod.name,
+            **({"decode_pod": decode_pod.name} if decode_pod else {}))
         if decode_pod is not None:
             # Disaggregated pick: relay prefill-hop -> handoff -> decode-hop.
             resp = await self._disagg_forward(
@@ -220,6 +347,10 @@ class GatewayProxy:
             # Either hop refused (draining, long prompt, unsupported
             # params): serve single-hop on the prefill replica — every
             # engine is complete regardless of role.
+            self.journal.emit(events_mod.DISAGG_FALLBACK, trace_id,
+                              model=req_ctx.model or "",
+                              prefill_pod=pod.name,
+                              decode_pod=decode_pod.name)
             logger.info("request=%s disaggregated path unavailable; "
                         "single-hop on %s", request_id, pod.name)
         url = f"http://{pod.address}{request.path}"
@@ -248,6 +379,10 @@ class GatewayProxy:
                     trace_id, upstream.headers.get(tracing.SPANS_HEADER))
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             self.metrics.record_error(req_ctx.model or None)
+            self.health.record_upstream(
+                pod.name, ok=False, timeout=isinstance(e, asyncio.TimeoutError))
+            self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id,
+                              pod=pod.name, error=str(e)[:200])
             self.tracer.record(trace_id, "gateway.upstream", t_up0,
                                time.time(), pod=pod.name, error=str(e))
             self.tracer.annotate(trace_id, status="upstream_error")
@@ -255,6 +390,9 @@ class GatewayProxy:
             return self._error_response(
                 502, f"upstream error: {e}", "api_error", trace_id)
         t_up1 = time.time()
+        # 5xx from the replica counts against its health (the server
+        # answered, but wrongly); 2xx-4xx reset the error streak.
+        self.health.record_upstream(pod.name, ok=status < 500)
         self.tracer.record(trace_id, "gateway.upstream", t_up0, t_up1,
                            pod=pod.name, status=status)
 
@@ -309,6 +447,7 @@ class GatewayProxy:
         the proxy's /debug/traces shows the full three-process timeline.
         """
         t_pre0 = time.time()
+        hop_pod = prefill_pod  # which hop an exception below attributes to
         try:
             async with self._session.post(
                 f"http://{prefill_pod.address}/v1/prefill",
@@ -321,6 +460,7 @@ class GatewayProxy:
                     logger.warning(
                         "prefill hop %s returned %d; falling back",
                         prefill_pod.address, pre.status)
+                    self.health.record_handoff(prefill_pod.name, ok=False)
                     self.tracer.record(
                         trace_id, "gateway.prefill_hop", t_pre0, time.time(),
                         pod=prefill_pod.name, status=pre.status,
@@ -334,6 +474,7 @@ class GatewayProxy:
                                t_pre1, pod=prefill_pod.name,
                                wire_bytes=len(handoff))
             t_att0 = time.time()
+            hop_pod = decode_pod
             async with self._session.post(
                 f"http://{decode_pod.address}/v1/attach",
                 data=handoff,
@@ -346,6 +487,7 @@ class GatewayProxy:
                     logger.warning(
                         "attach hop %s returned %d; falling back",
                         decode_pod.address, status)
+                    self.health.record_handoff(decode_pod.name, ok=False)
                     self.tracer.record(
                         trace_id, "gateway.attach_hop", t_att0, time.time(),
                         pod=decode_pod.name, status=status, fallback=True)
@@ -363,11 +505,16 @@ class GatewayProxy:
             # No record_error here: the caller serves the request single-hop
             # next, and THAT path records the request's actual outcome — a
             # recovered hop must not inflate the error rate (non-200 hop
-            # statuses above are treated identically).
+            # statuses above are treated identically).  The health scorer
+            # DOES see it: hop failures are a per-replica degradation
+            # signal regardless of the request's final outcome.
+            self.health.record_handoff(hop_pod.name, ok=False)
             logger.warning("disaggregated path %s->%s failed: %s",
                            prefill_pod.address, decode_pod.address, e)
             return None
         t_att1 = time.time()
+        self.health.record_handoff(prefill_pod.name, ok=True)
+        self.health.record_handoff(decode_pod.name, ok=True)
         self.tracer.record(trace_id, "gateway.attach_hop", t_att0, t_att1,
                            pod=decode_pod.name, status=status)
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
@@ -441,6 +588,11 @@ class GatewayProxy:
                 await resp.write(chunk)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             self.metrics.record_error(req_ctx.model or None)
+            self.health.record_upstream(
+                pod.name, ok=False,
+                timeout=isinstance(e, asyncio.TimeoutError))
+            self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
+                              pod=pod.name, stream=True, error=str(e)[:200])
             if trace_id:
                 self.tracer.record(trace_id, "gateway.stream", t_up0,
                                    time.time(), pod=pod.name, error=str(e))
@@ -455,6 +607,7 @@ class GatewayProxy:
                 pass
             return resp
         t_end = time.time()
+        self.health.record_upstream(pod.name, ok=True)
         try:
             final = json.loads(last_data_line[len(b"data: "):])
             usage = final.get("usage") or {}
@@ -476,14 +629,49 @@ class GatewayProxy:
         return resp
 
     # -- ops endpoints -----------------------------------------------------
+    def _render_metrics(self) -> str:
+        """The full gateway exposition page: request-path counters and
+        histograms (GatewayMetrics) plus the observability control plane's
+        families — SLO gauges, per-pod health, and the event counters."""
+        text = self.metrics.render()
+        extra = (self.slo.render() + self.health.render()
+                 + self.journal.render_prom("gateway_events_total"))
+        if extra:
+            text += "\n".join(extra) + "\n"
+        return text
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        return web.Response(text=self.metrics.render(), content_type="text/plain")
+        return web.Response(text=self._render_metrics(),
+                            content_type="text/plain")
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:
         """Recent request traces as JSON (``?trace_id=`` exact filter,
         ``?limit=`` count cap) — the merged cross-process timeline."""
         return web.json_response(
             tracing.debug_traces_payload(self.tracer, request.query))
+
+    async def handle_debug_slo(self, request: web.Request) -> web.Response:
+        """Per-model SLO compliance, windowed burn rates, and burn state.
+        Evaluates on demand (floored at the configured cadence — ring
+        growth AND the tick-denominated hysteresis must track
+        LIG_SLO_TICK_S, not an aggressive poller) so a curl sees the
+        current state even when the background task is disabled."""
+        self.slo.maybe_tick(max(1.0, self.obs_tick_s))
+        return web.json_response(self.slo.debug_payload())
+
+    async def handle_debug_health(self, request: web.Request) -> web.Response:
+        """Per-replica health scores, components, states, and the would-
+        avoid counters (routing stays unchanged this release).  Floored at
+        the configured cadence: the dwell-tick hysteresis counts update
+        PASSES, so a fast poller must not drive transitions."""
+        self.health.maybe_update(max(1.0, self.obs_tick_s))
+        return web.json_response(self.health.debug_payload())
+
+    async def handle_debug_events(self, request: web.Request) -> web.Response:
+        """The flight recorder: ``?since=<seq>`` incremental cursor,
+        ``?kind=`` filter, ``?limit=`` cap."""
+        return web.json_response(
+            events_mod.debug_events_payload(self.journal, request.query))
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.datastore.has_synced_pool():

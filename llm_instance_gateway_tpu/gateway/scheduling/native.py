@@ -150,6 +150,10 @@ class NativeScheduler:
         # The gRPC transport calls schedule() from a thread pool; the cached
         # arrays (including the C++ output buffer) are shared state.
         self._call_lock = threading.Lock()
+        # LOG-ONLY health hook (gateway/health.py) — same seam as the
+        # Python Scheduler: counts would-be avoidance picks, never alters
+        # the pick (candidate parity with C++ stays exact).
+        self.health_advisor = None
 
     def _arrays(self, req: LLMRequest, pods: list[PodMetrics],
                 version: int | None):
@@ -269,6 +273,8 @@ class NativeScheduler:
             pick = pods[idxs[self._rng.randrange(len(idxs))]].pod
         if self.prefix_index is not None and req.prefix_hashes:
             self.prefix_index.record(req.prefix_hashes, pick.name)
+        if self.health_advisor is not None:
+            self.health_advisor.note_pick(pick.name)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -304,6 +310,8 @@ class NativeScheduler:
                 shed=e.shed) from e
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
+        if self.health_advisor is not None:
+            self.health_advisor.note_pick(decode_pod.name)
         return prefill_pod, decode_pod
 
 

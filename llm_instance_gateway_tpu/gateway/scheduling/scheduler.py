@@ -246,6 +246,12 @@ class Scheduler:
         # inert while every pod is collocated.
         self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
         self._rng = rng or random.Random()
+        # LOG-ONLY health hook (gateway/health.py, set by the proxy): after
+        # a pick, ``note_pick`` counts would-be avoidance decisions into
+        # tpu:health_would_avoid_total.  It must never change the pick —
+        # no RNG draws, no filtering — so routing stays byte-identical to
+        # a scheduler without the hook.
+        self.health_advisor = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.
@@ -293,6 +299,8 @@ class Scheduler:
             # The pick is about to prefill (and, with the engine's prefix
             # cache on, retain) this prefix: future lookups route here.
             self.prefix_index.record(req.prefix_hashes, pick.name)
+        if self.health_advisor is not None:
+            self.health_advisor.note_pick(pick.name)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -334,6 +342,8 @@ class Scheduler:
                 shed=e.shed) from e
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
+        if self.health_advisor is not None:
+            self.health_advisor.note_pick(decode_pod.name)
         # Per-hop pick split for the tracing layer (the admission span's
         # attribution of "pick" into prefill-hop vs decode-hop cost).
         req.pick_hops_s = (t1 - t0, time.perf_counter() - t1)

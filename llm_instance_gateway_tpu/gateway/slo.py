@@ -1,0 +1,414 @@
+"""SLO engine: multi-window burn-rate evaluation over the gateway's own
+request-phase histograms and shed/error counters.
+
+PR 2 made the gateway *record* TTFT/TPOT/e2e per model and path
+(``gateway_*_seconds`` histograms) and count sheds/errors; nothing yet
+*evaluated* them, so "are we in SLO, and how fast are we burning budget?"
+had no machine answer.  This module is that answer, following the
+multi-window, multi-burn-rate alerting shape managed LLM fleets converge on
+(MinT's aggregation layer; Google SRE workbook alerting):
+
+- An **objective** is "fraction ``target`` of requests must satisfy X" —
+  latency objectives (``ttft``/``tpot``/``e2e`` under ``threshold_s``) and
+  an ``error_rate`` objective (non-shed, non-error completion).  The error
+  *budget* is ``1 - target``.
+- The engine snapshots the cumulative good/total counts each tick and
+  derives **windowed burn rates**: ``burn(w) = bad_fraction(w) / budget``.
+  Burn 1.0 = exactly consuming budget at the sustainable rate; 14.4 over
+  the fast window pair = the classic "2% of a 30-day budget in an hour"
+  page condition, scaled here to whatever windows the config carries (tests
+  shrink them to seconds).
+- **State machine** per (model, objective): ``ok`` -> ``slow_burn`` ->
+  ``fast_burn``.  Escalation is immediate (both windows of the pair over
+  threshold); de-escalation needs ``clear_ticks`` consecutive clear ticks —
+  hysteresis so a breach doesn't flap at the boundary.  Transitions emit
+  ``slo_transition`` events into the flight recorder, and entering
+  ``fast_burn`` fires ``on_fast_burn`` (the proxy wires the black-box dump
+  there).
+
+Counting from histograms: "good" for a latency objective is the cumulative
+count in buckets whose upper edge is <= ``threshold_s`` — thresholds
+therefore snap DOWN to the nearest bucket boundary (the default thresholds
+align with ``tracing.LATENCY_BUCKETS`` exactly).  Observations beyond the
+largest bucket are bad by construction.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import escape_label
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Window:
+    name: str       # label value, e.g. "1m"
+    seconds: float
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str                    # "ttft" | "tpot" | "e2e" | "error_rate"
+    target: float                # required compliance ratio, e.g. 0.95
+    threshold_s: float | None = None  # latency objectives only
+
+    @property
+    def budget(self) -> float:
+        """Error budget (fraction of requests allowed to miss)."""
+        return max(1e-9, 1.0 - self.target)
+
+
+# Defaults: thresholds all sit ON LATENCY_BUCKETS edges (1.0 / 0.1 / 10.0)
+# so histogram counting is exact, targets are deliberately loose for a
+# framework default — operators override per model via SLOConfig.per_model.
+DEFAULT_OBJECTIVES = (
+    Objective("ttft", target=0.95, threshold_s=1.0),
+    Objective("tpot", target=0.95, threshold_s=0.1),
+    Objective("e2e", target=0.95, threshold_s=10.0),
+    Objective("error_rate", target=0.99),
+)
+
+# Fast pair (page-grade) = first two; slow pair (ticket-grade) = last two.
+DEFAULT_WINDOWS = (
+    Window("1m", 60.0),
+    Window("5m", 300.0),
+    Window("30m", 1800.0),
+    Window("6h", 21600.0),
+)
+
+
+@dataclass
+class SLOConfig:
+    objectives: tuple = DEFAULT_OBJECTIVES
+    # model -> tuple[Objective, ...] overrides (absent models get defaults).
+    per_model: dict = field(default_factory=dict)
+    windows: tuple = DEFAULT_WINDOWS  # ascending duration; first two = fast
+    fast_burn_threshold: float = 14.4
+    slow_burn_threshold: float = 6.0
+    # De-escalation hysteresis: consecutive clear ticks required to step
+    # DOWN a state (escalation is immediate — a page must not wait).
+    clear_ticks: int = 3
+    # Windows spanning fewer than this many requests don't judge (a single
+    # slow request in an idle window must not page anyone).
+    min_window_total: int = 10
+
+    def objectives_for(self, model: str) -> tuple:
+        return self.per_model.get(model, self.objectives)
+
+
+def _good_total(hist_state: dict, threshold_s: float) -> tuple[int, int]:
+    """(good, total) from a ``Histogram.state()`` dict: good = observations
+    in buckets with upper edge <= threshold (threshold snaps DOWN)."""
+    good = 0
+    for edge, count in zip(hist_state["buckets"], hist_state["counts"]):
+        if edge <= threshold_s + 1e-12:
+            good += count
+        else:
+            break
+    return good, hist_state["count"]
+
+
+class SLOEngine:
+    """Evaluates objectives over a ``GatewayMetrics`` instance.
+
+    ``tick()`` is driven by the proxy's observability loop (and lazily by
+    ``/debug/slo``); tests drive it with explicit ``now`` values against
+    second-scale windows.  All reads go through
+    ``GatewayMetrics.slo_snapshot()`` so lock discipline stays in
+    telemetry.py.
+    """
+
+    OK, SLOW_BURN, FAST_BURN = "ok", "slow_burn", "fast_burn"
+    _RANK = {OK: 0, SLOW_BURN: 1, FAST_BURN: 2}
+
+    def __init__(self, metrics, cfg: SLOConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 on_fast_burn=None, clock=time.time):
+        self.metrics = metrics
+        self.cfg = cfg or SLOConfig()
+        self.journal = journal
+        self.on_fast_burn = on_fast_burn  # (model, objective, burns) -> None
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (model, objective) -> deque[(ts, good, total)] pruned to the
+        # longest window; one sample per tick, so memory is O(models *
+        # objectives * horizon/tick).
+        self._samples: dict[tuple, collections.deque] = {}
+        self._state: dict[tuple, str] = {}
+        self._clear_streak: dict[tuple, int] = {}
+        self._last_burns: dict[tuple, dict] = {}
+        self.last_tick = 0.0
+
+    # -- counting ------------------------------------------------------------
+    @staticmethod
+    def _models(snap: dict) -> set[str]:
+        models = set(snap["requests"])
+        for table in snap["phase"].values():
+            models.update(m for (m, _path) in table)
+        return models
+
+    @staticmethod
+    def _counts_for(snap: dict, model: str, obj: Objective) -> tuple[int, int]:
+        if obj.name == "error_rate":
+            # Denominator = admitted requests + pre-admission errors (the
+            # latter never reach record_request, so without the widening a
+            # burst of admission failures alongside healthy traffic would
+            # overstate the bad fraction).  max() is a final safety clamp.
+            total = (snap["requests"].get(model, 0)
+                     + snap.get("errors_pre", {}).get(model, 0))
+            bad = snap["shed"].get(model, 0) + snap["errors"].get(model, 0)
+            total = max(total, bad)
+            return total - bad, total
+        good = total = 0
+        for (m, _path), state in snap["phase"].get(obj.name, {}).items():
+            if m != model:
+                continue
+            g, t = _good_total(state, obj.threshold_s)
+            good += g
+            total += t
+        return good, total
+
+    def _burns(self, ring, now: float, obj: Objective) -> dict:
+        """window name -> burn rate (None = window spans too few requests)."""
+        _, cur_good, cur_total = ring[-1]
+        out = {}
+        for w in self.cfg.windows:
+            start = now - w.seconds
+            # Baseline = the newest sample at or before the window start;
+            # a ring not yet spanning the window uses its oldest sample
+            # (the standard startup approximation — the window judges
+            # whatever history exists).
+            base = None
+            for t, g, tot in ring:
+                if t <= start:
+                    base = (t, g, tot)
+                else:
+                    break
+            if base is None:
+                base = ring[0]
+            d_total = cur_total - base[2]
+            d_good = cur_good - base[1]
+            if d_total < self.cfg.min_window_total:
+                out[w.name] = None
+            else:
+                bad_frac = max(0, d_total - d_good) / d_total
+                out[w.name] = bad_frac / obj.budget
+        return out
+
+    # -- evaluation ----------------------------------------------------------
+    def maybe_tick(self, min_interval_s: float = 1.0) -> None:
+        """On-demand evaluation with a floor between passes.  The debug
+        endpoint calls this per request: each real tick appends one ring
+        sample per (model, objective) retained for the full slow-window
+        horizon, so an unthrottled 10 Hz dashboard poll would grow the
+        rings (and the per-tick burn scans) with poll rate instead of with
+        the configured cadence."""
+        if self._clock() - self.last_tick >= min_interval_s:
+            self.tick()
+
+    def tick(self, now: float | None = None) -> None:
+        """One evaluation pass: snapshot counts, update burns and states.
+        Fast-burn hooks fire AFTER the internal lock is released (they
+        re-enter via debug_payload for the dump)."""
+        now = self._clock() if now is None else now
+        snap = self.metrics.slo_snapshot()
+        horizon = self.cfg.windows[-1].seconds
+        fired: list[tuple[str, str, dict]] = []
+        with self._lock:
+            for model in sorted(self._models(snap)):
+                for obj in self.cfg.objectives_for(model):
+                    key = (model, obj.name)
+                    ring = self._samples.get(key)
+                    if ring is None:
+                        ring = self._samples[key] = collections.deque()
+                        # Cold-start baseline: counts present at a model's
+                        # FIRST tick accrued within roughly one tick
+                        # interval (an earlier tick would have seen the
+                        # model otherwise), so a zero sample lets this
+                        # tick judge them instead of blinding the engine
+                        # to a burst that predates it.
+                        ring.append((now, 0, 0))
+                    good, total = self._counts_for(snap, model, obj)
+                    ring.append((now, good, total))
+                    while ring and ring[0][0] < now - horizon - 1.0:
+                        ring.popleft()
+                    burns = self._burns(ring, now, obj)
+                    self._last_burns[key] = burns
+                    if self._advance(key, model, obj, burns):
+                        fired.append((model, obj.name, burns))
+            self.last_tick = now
+        for model, objective, burns in fired:
+            if self.on_fast_burn is not None:
+                try:
+                    self.on_fast_burn(model, objective, burns)
+                except Exception:
+                    logger.exception("fast-burn hook failed")
+
+    def _advance(self, key, model: str, obj: Objective, burns: dict) -> bool:
+        """State machine step; returns True when FAST_BURN was entered."""
+        ws = self.cfg.windows
+        fast_ws = ws[:2] if len(ws) >= 2 else ws
+        slow_ws = ws[2:] if len(ws) > 2 else ws
+
+        def exceeded(group, threshold: float) -> bool:
+            vals = [burns.get(w.name) for w in group]
+            return bool(vals) and all(
+                v is not None and v >= threshold for v in vals)
+
+        want = self.OK
+        if exceeded(slow_ws, self.cfg.slow_burn_threshold):
+            want = self.SLOW_BURN
+        if exceeded(fast_ws, self.cfg.fast_burn_threshold):
+            want = self.FAST_BURN
+        cur = self._state.get(key, self.OK)
+        if want == cur:
+            self._clear_streak[key] = 0
+            return False
+        if self._RANK[want] > self._RANK[cur]:
+            # Escalation is immediate.
+            self._transition(key, model, obj, cur, want, burns)
+            self._clear_streak[key] = 0
+            return want == self.FAST_BURN
+        # De-escalation waits out the hysteresis streak.
+        streak = self._clear_streak.get(key, 0) + 1
+        if streak >= self.cfg.clear_ticks:
+            self._transition(key, model, obj, cur, want, burns)
+            self._clear_streak[key] = 0
+        else:
+            self._clear_streak[key] = streak
+        return False
+
+    def _transition(self, key, model: str, obj: Objective,
+                    frm: str, to: str, burns: dict) -> None:
+        self._state[key] = to
+        rounded = {k: (round(v, 3) if v is not None else None)
+                   for k, v in burns.items()}
+        log = (logger.warning if self._RANK[to] > self._RANK[frm]
+               else logger.info)
+        log("SLO %s/%s: %s -> %s (burn rates %s)",
+            model, obj.name, frm, to, rounded)
+        if self.journal is not None:
+            self.journal.emit(events_mod.SLO_TRANSITION, model=model,
+                              objective=obj.name, frm=frm, to=to,
+                              burns=rounded)
+
+    # -- export --------------------------------------------------------------
+    def state(self, model: str, objective: str) -> str:
+        with self._lock:
+            return self._state.get((model, objective), self.OK)
+
+    def render(self) -> list[str]:
+        """``gateway_slo_compliance_ratio{model,objective}`` (cumulative
+        good/total) and ``gateway_slo_burn_rate{model,objective,window}``
+        gauges; empty when no tick has seen traffic."""
+        with self._lock:
+            samples = {k: ring[-1] for k, ring in self._samples.items()
+                       if ring}
+            burns = dict(self._last_burns)
+        compliance, burn_lines = [], []
+        for (model, objective) in sorted(samples):
+            _, good, total = samples[(model, objective)]
+            if total <= 0:
+                continue
+            labels = (f'model="{escape_label(model)}",'
+                      f'objective="{escape_label(objective)}"')
+            compliance.append(
+                "gateway_slo_compliance_ratio{%s} %.6f"
+                % (labels, good / total))
+            for w in self.cfg.windows:
+                v = burns.get((model, objective), {}).get(w.name)
+                if v is None:
+                    continue
+                burn_lines.append(
+                    'gateway_slo_burn_rate{%s,window="%s"} %.6f'
+                    % (labels, escape_label(w.name), v))
+        lines = []
+        if compliance:
+            lines.append("# TYPE gateway_slo_compliance_ratio gauge")
+            lines += compliance
+        if burn_lines:
+            lines.append("# TYPE gateway_slo_burn_rate gauge")
+            lines += burn_lines
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/slo`` JSON body."""
+        with self._lock:
+            keys = sorted(self._samples)
+            out: dict = {}
+            for (model, objective) in keys:
+                ring = self._samples[(model, objective)]
+                if not ring:
+                    continue
+                _, good, total = ring[-1]
+                obj = next((o for o in self.cfg.objectives_for(model)
+                            if o.name == objective), None)
+                out.setdefault(model, {})[objective] = {
+                    "threshold_s": obj.threshold_s if obj else None,
+                    "target": obj.target if obj else None,
+                    "good": good,
+                    "total": total,
+                    "compliance": round(good / total, 6) if total else None,
+                    "state": self._state.get((model, objective), self.OK),
+                    "burn_rates": {
+                        k: (round(v, 4) if v is not None else None)
+                        for k, v in self._last_burns.get(
+                            (model, objective), {}).items()},
+                }
+            return {
+                "models": out,
+                "windows": {w.name: w.seconds for w in self.cfg.windows},
+                "fast_burn_threshold": self.cfg.fast_burn_threshold,
+                "slow_burn_threshold": self.cfg.slow_burn_threshold,
+                "last_tick": self.last_tick,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Black-box dump (snapshot-on-breach)
+# ---------------------------------------------------------------------------
+
+
+def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
+                   metrics_text: str = "", slo_payload: dict | None = None,
+                   health_payload: dict | None = None,
+                   clock=time.time) -> str:
+    """Write the black-box dump for one breach; returns the file path.
+
+    The dump is everything a post-mortem needs in ONE file: the flight
+    recorder's journal, the trace ring, the SLO/health debug payloads, and
+    the raw /metrics text at the moment of the breach.
+    ``tools/blackbox_report.py`` renders it into a timeline.
+    """
+    os.makedirs(dir_path, exist_ok=True)
+    ts = clock()
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(ts))
+    slug = re.sub(r"[^A-Za-z0-9._-]+", "_",
+                  f"{reason.get('model', '')}-{reason.get('objective', '')}")
+    path = os.path.join(
+        dir_path, f"blackbox-{stamp}-{int(ts * 1000) % 1000:03d}-{slug}.json")
+    payload = {
+        "format": "lig-blackbox/1",
+        "written_at": round(ts, 3),
+        "reason": reason,
+        "events": journal.snapshot() if journal is not None else None,
+        "traces": tracer.recent(64) if tracer is not None else [],
+        "slo": slo_payload,
+        "health": health_payload,
+        "metrics_text": metrics_text,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)  # readers never see a half-written dump
+    return path

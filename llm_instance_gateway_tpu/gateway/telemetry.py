@@ -24,6 +24,7 @@ from llm_instance_gateway_tpu.tracing import (
     PICK_BUCKETS,
     Histogram,
     escape_label,  # one escaping impl for every exposition surface
+    render_counter,
     render_histogram,
 )
 
@@ -52,6 +53,10 @@ class GatewayMetrics:
         # so per-tenant shed rate is visible without losing those.
         self.shed_total: dict[str | None, int] = {}
         self.errors_total: dict[str | None, int] = {}
+        # Subset of errors_total raised before record_request (admission
+        # failures) — the SLO error-rate denominator widener; not its own
+        # exposition family.
+        self.errors_preadmission: dict[str | None, int] = {}
         self.tokens_prompt_total: dict[str, int] = {}  # by model
         self.tokens_completion_total: dict[str, int] = {}
         self.pick_latency = Histogram()
@@ -83,9 +88,18 @@ class GatewayMetrics:
         with self._lock:
             self.shed_total[model] = self.shed_total.get(model, 0) + 1
 
-    def record_error(self, model: str | None = None) -> None:
+    def record_error(self, model: str | None = None,
+                     pre_admission: bool = False) -> None:
+        """``pre_admission`` marks errors raised BEFORE record_request
+        fires (admission/parse failures): the SLO engine widens the
+        error-rate denominator by exactly these, so requests that never
+        entered requests_total still count once — not as a shrunken
+        denominator that overstates the bad fraction."""
         with self._lock:
             self.errors_total[model] = self.errors_total.get(model, 0) + 1
+            if pre_admission:
+                self.errors_preadmission[model] = (
+                    self.errors_preadmission.get(model, 0) + 1)
 
     def record_usage(self, model: str, prompt: int, completion: int) -> None:
         with self._lock:
@@ -113,19 +127,35 @@ class GatewayMetrics:
                     h = table[(model, path)] = Histogram(LATENCY_BUCKETS)
                 h.observe(max(0.0, value))
 
+    def slo_snapshot(self) -> dict:
+        """Copy-out of the counts the SLO engine evaluates (gateway/slo.py):
+        phase-histogram states keyed by (model, path) per objective, plus
+        the per-model request/shed/error counters.  One method so lock
+        discipline stays HERE — the engine never touches internals."""
+        with self._lock:
+            return {
+                "phase": {
+                    key: {mk: h.state() for mk, h in table.items()}
+                    for key, table in self.phase_latency.items()
+                },
+                "requests": dict(self.requests_total),
+                # None keys are the pre-admission fallback (model unknown):
+                # per-model objectives can't attribute them, drop here.
+                "shed": {k: v for k, v in self.shed_total.items()
+                         if k is not None},
+                "errors": {k: v for k, v in self.errors_total.items()
+                           if k is not None},
+                "errors_pre": {k: v for k, v in
+                               self.errors_preadmission.items()
+                               if k is not None},
+            }
+
     # -- export ------------------------------------------------------------
     @staticmethod
     def _counter_lines(family: str, table: dict, label: str) -> list[str]:
-        """One counter family; a None key renders unlabeled (fallback)."""
-        lines = [f"# TYPE {family} counter"]
-        # None sorts first: stable output, fallback line leads.
-        for key in sorted(table, key=lambda k: (k is not None, k or "")):
-            if key is None:
-                lines.append(f"{family} {table[key]}")
-            else:
-                lines.append(
-                    f'{family}{{{label}="{escape_label(key)}"}} {table[key]}')
-        return lines
+        """One counter family; a None key (or empty table) renders the
+        unlabeled fallback line (shared renderer in tracing.py)."""
+        return render_counter(family, table, label)
 
     def render(self) -> str:
         with self._lock:
@@ -133,12 +163,10 @@ class GatewayMetrics:
                 "gateway_requests_total", self.requests_total, "model")
             lines += self._counter_lines(
                 "gateway_scheduled_total", self.scheduled_total, "pod")
-            shed = self._counter_lines(
-                "gateway_shed_total", self.shed_total or {None: 0}, "model")
-            errors = self._counter_lines(
-                "gateway_errors_total", self.errors_total or {None: 0},
-                "model")
-            lines += shed + errors
+            lines += self._counter_lines(
+                "gateway_shed_total", self.shed_total, "model")
+            lines += self._counter_lines(
+                "gateway_errors_total", self.errors_total, "model")
             lines += [
                 "# TYPE gateway_lora_affinity_hits_total counter",
                 f"gateway_lora_affinity_hits_total {self.lora_affinity_hits}",

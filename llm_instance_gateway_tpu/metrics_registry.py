@@ -1,0 +1,184 @@
+"""Registry of every metric family the framework exposes.
+
+The exposition surface has grown PR over PR (gateway request counters,
+phase histograms, SLO gauges, health scores, event counters; server-side
+``tpu:*`` contract families) and nothing kept it discoverable: an operator
+had to curl ``/metrics`` and guess semantics.  This module is the single
+declarative list — name, type, labels, help, surface — that:
+
+- generates ``docs/METRICS.md`` (``make metrics-docs``;
+  ``tests/test_metrics_docs.py`` asserts the file is current), and
+- is cross-checked against the REAL rendered expositions by the contract
+  suite, so a family added to a render path without a registry entry (or
+  vice versa) fails tier-1 instead of silently drifting.
+
+Keep entries in render order per surface; the doc generator preserves it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+GATEWAY_SURFACE = "gateway /metrics (proxy)"
+SERVER_SURFACE = "model server /metrics (api_http)"
+
+
+@dataclass(frozen=True)
+class Family:
+    name: str
+    kind: str                 # counter | gauge | histogram
+    labels: tuple             # label names ("" entries not allowed)
+    help: str
+    surface: str
+
+
+GATEWAY_FAMILIES = (
+    Family("gateway_requests_total", "counter", ("model",),
+           "Requests admitted past body parsing, by model.",
+           GATEWAY_SURFACE),
+    Family("gateway_scheduled_total", "counter", ("pod",),
+           "Scheduler picks, by target pod.", GATEWAY_SURFACE),
+    Family("gateway_shed_total", "counter", ("model",),
+           "Load-shed drops (429); unlabeled line = pre-admission fallback "
+           "(model unknown).", GATEWAY_SURFACE),
+    Family("gateway_errors_total", "counter", ("model",),
+           "Request failures (admission errors, upstream failures, broken "
+           "streams); unlabeled line = pre-admission fallback.",
+           GATEWAY_SURFACE),
+    Family("gateway_lora_affinity_hits_total", "counter", (),
+           "Picks that landed on a pod already serving the requested "
+           "adapter.", GATEWAY_SURFACE),
+    Family("gateway_pick_latency_seconds", "histogram", (),
+           "Scheduler pick latency.", GATEWAY_SURFACE),
+    Family("gateway_prompt_tokens_total", "counter", ("model",),
+           "Prompt tokens accounted from upstream usage, by model.",
+           GATEWAY_SURFACE),
+    Family("gateway_completion_tokens_total", "counter", ("model",),
+           "Completion tokens accounted from upstream usage, by model.",
+           GATEWAY_SURFACE),
+    Family("gateway_ttft_seconds", "histogram", ("model", "path"),
+           "Client-observed time to first token (path = collocated | "
+           "disaggregated).", GATEWAY_SURFACE),
+    Family("gateway_tpot_seconds", "histogram", ("model", "path"),
+           "Client-observed time per output token after the first.",
+           GATEWAY_SURFACE),
+    Family("gateway_e2e_seconds", "histogram", ("model", "path"),
+           "Client-observed end-to-end request latency.", GATEWAY_SURFACE),
+    Family("gateway_pool_prefix_reused_tokens_total", "counter", ("pod",),
+           "Per-replica tpu:prefix_reused_tokens re-exported at the "
+           "gateway (KV-affinity observable).", GATEWAY_SURFACE),
+    Family("gateway_slo_compliance_ratio", "gauge", ("model", "objective"),
+           "Cumulative fraction of requests meeting the objective "
+           "(gateway/slo.py; objectives: ttft, tpot, e2e, error_rate).",
+           GATEWAY_SURFACE),
+    Family("gateway_slo_burn_rate", "gauge",
+           ("model", "objective", "window"),
+           "Windowed error-budget burn rate (1.0 = budget consumed exactly "
+           "at the sustainable rate; fast-burn pages at 14.4 by default).",
+           GATEWAY_SURFACE),
+    Family("gateway_pod_health_score", "gauge", ("pod",),
+           "Fused 0-1 replica health score (gateway/health.py; freshness, "
+           "errors, queue, KV, latency components).", GATEWAY_SURFACE),
+    Family("gateway_pod_health_state", "gauge", ("pod", "state"),
+           "Hysteresis health state (healthy | degraded | unhealthy); the "
+           "labeled series is 1.", GATEWAY_SURFACE),
+    Family("gateway_upstream_errors_total", "counter", ("pod",),
+           "Upstream connection/stream/5xx failures, by pod.",
+           GATEWAY_SURFACE),
+    Family("gateway_upstream_timeouts_total", "counter", ("pod",),
+           "Upstream timeouts (subset of errors), by pod.", GATEWAY_SURFACE),
+    Family("gateway_handoff_failures_total", "counter", ("pod",),
+           "Disaggregation hop failures attributed to the refusing/failing "
+           "pod.", GATEWAY_SURFACE),
+    Family("tpu:health_would_avoid_total", "counter", ("pod",),
+           "Picks that health-aware routing WOULD have steered elsewhere "
+           "(log-only this release; routing unchanged).", GATEWAY_SURFACE),
+    Family("gateway_events_total", "counter", ("kind",),
+           "Flight-recorder events by kind (events.py; the journal itself "
+           "is served by /debug/events).", GATEWAY_SURFACE),
+)
+
+SERVER_FAMILIES = (
+    Family("tpu:prefill_queue_size", "gauge", (),
+           "Requests awaiting prefill.", SERVER_SURFACE),
+    Family("tpu:decode_queue_size", "gauge", (),
+           "Prefilled requests awaiting a decode slot.", SERVER_SURFACE),
+    Family("tpu:num_requests_running", "gauge", (),
+           "In-flight requests.", SERVER_SURFACE),
+    Family("tpu:num_requests_waiting", "gauge", (),
+           "Total queued (prefill + decode).", SERVER_SURFACE),
+    Family("tpu:kv_cache_usage_perc", "gauge", (),
+           "Paged-KV utilization 0..1 (parked KV included).",
+           SERVER_SURFACE),
+    Family("tpu:kv_tokens_capacity", "gauge", (),
+           "Total KV token capacity.", SERVER_SURFACE),
+    Family("tpu:kv_tokens_free", "gauge", (),
+           "Free KV token headroom.", SERVER_SURFACE),
+    Family("tpu:kv_parked_tokens", "gauge", (),
+           "Prefilled-but-unslotted KV tokens held outside the cache.",
+           SERVER_SURFACE),
+    Family("tpu:decode_tokens_per_sec", "gauge", (),
+           "Recent decode throughput (EMA).", SERVER_SURFACE),
+    Family("tpu:lora_requests_info", "gauge",
+           ("running_lora_adapters", "max_lora"),
+           "Resident-adapter info gauge; value is a unix timestamp "
+           "(latest series wins).", SERVER_SURFACE),
+    Family("tpu:pool_role", "gauge", ("role",),
+           "Disaggregation role info gauge (collocated | prefill | "
+           "decode).", SERVER_SURFACE),
+    Family("tpu:prefix_reused_tokens", "counter", (),
+           "Cumulative prompt tokens served from the prefix cache.",
+           SERVER_SURFACE),
+    Family("tpu:spec_cycles", "counter", (),
+           "Speculative-decoding verify cycles.", SERVER_SURFACE),
+    Family("tpu:spec_tokens_per_cycle", "gauge", (),
+           "Accepted tokens per speculative cycle (draft-quality signal).",
+           SERVER_SURFACE),
+    Family("tpu:prefill_seconds", "histogram", ("model", "role"),
+           "Prefill compute latency.", SERVER_SURFACE),
+    Family("tpu:handoff_seconds", "histogram", ("model", "role"),
+           "KV-handoff serialize / deserialize+attach latency.",
+           SERVER_SURFACE),
+    Family("tpu:decode_step_seconds", "histogram", ("model", "role"),
+           "Per-step decode cadence.", SERVER_SURFACE),
+    Family("tpu:events_total", "counter", ("kind",),
+           "Replica-side flight-recorder events by kind (served by the "
+           "replica's /debug/events).", SERVER_SURFACE),
+)
+
+
+def all_families() -> tuple[Family, ...]:
+    return GATEWAY_FAMILIES + SERVER_FAMILIES
+
+
+def registered_names() -> set[str]:
+    return {f.name for f in all_families()}
+
+
+def render_markdown() -> str:
+    """The full ``docs/METRICS.md`` content (generated; do not hand-edit)."""
+    out = [
+        "# Metrics reference",
+        "",
+        "<!-- GENERATED by `make metrics-docs` from "
+        "llm_instance_gateway_tpu/metrics_registry.py — do not edit. -->",
+        "",
+        "Every Prometheus family the framework exposes, by surface.  "
+        "Histogram families expose the usual `_bucket`/`_sum`/`_count` "
+        "series.  Counter families keyed by an attribution label render an "
+        "unlabeled fallback line when no labeled sample exists yet.",
+        "",
+    ]
+    for surface in (GATEWAY_SURFACE, SERVER_SURFACE):
+        out += [f"## {surface}", "",
+                "| family | type | labels | help |",
+                "|---|---|---|---|"]
+        for f in all_families():
+            if f.surface != surface:
+                continue
+            labels = ", ".join(f.labels) if f.labels else "—"
+            help_cell = f.help.replace("|", "\\|")  # literal pipes in cells
+            out.append(
+                f"| `{f.name}` | {f.kind} | {labels} | {help_cell} |")
+        out.append("")
+    return "\n".join(out)

@@ -18,6 +18,9 @@ the union of what vLLM exposed to the reference:
                                   tpu:decode_step_seconds histograms)
 - ``GET  /debug/traces``          recent request traces (span JSON,
                                   ``?trace_id=`` filter)
+- ``GET  /debug/events``          replica-side flight recorder (admission
+                                  rejections, handoff refusals, drain
+                                  transitions; ``?since=`` cursor)
 - ``GET  /health``                200 once the engine loop is up
 
 Tracing: every inference request adopts the ``x-lig-trace-id`` header (or
@@ -52,12 +55,25 @@ from llm_instance_gateway_tpu.server.lora_manager import (
     LoRAManager,
 )
 from llm_instance_gateway_tpu.server.tokenizer import load_tokenizer
+from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu import tracing
 
 logger = logging.getLogger(__name__)
 
 MAX_N = 8          # n / best_of cap (each candidate occupies engine capacity)
 MAX_LOGPROBS = 5   # engine.LOGPROB_TOPK — the OpenAI completions maximum
+# OpenAI chat accepts top_logprobs up to 20; the engine computes a top-5
+# device-side (LOGPROB_TOPK), so requests above MAX_LOGPROBS are accepted
+# and truncated, with the cap noted in the response's logprobs object
+# (README "OpenAI surface divergences").
+OPENAI_MAX_TOP_LOGPROBS = 20
+
+_FFFD = "�"
+# A partial UTF-8 character pending completion by a later byte-fallback
+# token is at most 3 bytes (a 4-byte sequence missing its last byte); its
+# decode renders at most that many replacement chars, so a longer trailing
+# U+FFFD run is genuine model output, never an artifact.
+_MAX_PARTIAL_FFFD = 3
 
 
 class ModelServer:
@@ -74,6 +90,14 @@ class ModelServer:
         self.lora = lora_manager
         # Per-process span ring served by /debug/traces (tracing.py).
         self.tracer = tracing.Tracer()
+        # Server-side flight recorder (events.py): admission rejections,
+        # handoff failures, drain/role changes — served by /debug/events
+        # and counted in the tpu:events_total family on /metrics.
+        self.events = events_mod.EventJournal()
+        if engine is not None and hasattr(engine, "event_sink"):
+            # The engine reports lifecycle events (drain start) through
+            # this seam without importing any HTTP-layer machinery.
+            engine.event_sink = self.events.emit
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -87,6 +111,7 @@ class ModelServer:
         app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
+        app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/health", self.handle_health)
         return app
 
@@ -128,6 +153,17 @@ class ModelServer:
         if spans and self.tracer.sampled(trace_id):
             headers[tracing.SPANS_HEADER] = tracing.wire_spans(spans)
         return headers
+
+    def _reject(self, status: int, message: str, trace_id: str | None,
+                reason: str) -> web.Response:
+        """Capacity/lifecycle rejection: journal it (the flight recorder
+        correlates replica-side 429/503/422 with gateway-side picks via the
+        trace id) and answer the usual error envelope.  Plain 400 client
+        errors do NOT come through here — they are request defects, not
+        system events."""
+        self.events.emit(events_mod.ADMISSION_REJECT, trace_id or "",
+                         status=status, reason=reason)
+        return _err(status, message, trace_id)
 
     # -- helpers -----------------------------------------------------------
     def _resolve_model(self, requested: str) -> str | None:
@@ -293,17 +329,38 @@ class ModelServer:
         req.finish_reason = "stop"
         return full[:idx], True
 
+    @staticmethod
+    def _held_back(cur: str, full: str) -> int:
+        """How many trailing replacement chars of ``cur`` (a prefix decode)
+        are partial-multi-byte artifacts rather than genuine U+FFFD output.
+
+        A char the model actually emitted survives verbatim into the FULL
+        decode at the same index; an artifact resolves into a different
+        character once the completing bytes arrive.  So: hold back the
+        smallest trailing-U+FFFD suffix whose removal makes ``cur`` agree
+        with the full decode, bounded by one UTF-8 char's worth of pending
+        bytes (``_MAX_PARTIAL_FFFD``) — beyond that the run is genuine."""
+        run = len(cur) - len(cur.rstrip(_FFFD))
+        cap = min(run, _MAX_PARTIAL_FFFD)
+        for hold in range(cap + 1):
+            keep = len(cur) - hold
+            if full[:keep] == cur[:keep]:
+                return hold
+        return cap
+
     def _per_token_records(self, req: Request, k: int,
                            text_limit: int | None = None):
         """Per-generated-token ``(piece, logprob, deduped_tops)`` rows — the
         ONE walk both logprobs envelopes (completions and chat) build from.
 
         Piece attribution holds back trailing replacement chars while more
-        tokens remain: a UTF-8 character split across byte-fallback tokens
-        is attributed whole to its COMPLETING token (predecessors emit "")
-        — so the pieces' concatenation equals the full decode exactly,
-        instead of leaking U+FFFD for characters that decode fine in
-        ``message.content``/``text``.  ``deduped_tops`` keeps the most
+        tokens remain *and the full decode resolves them*: a UTF-8
+        character split across byte-fallback tokens is attributed whole to
+        its COMPLETING token (predecessors emit ""), while a token that
+        GENUINELY decodes to U+FFFD keeps its char in place (``_held_back``
+        distinguishes the two; held-back chars are bounded by one UTF-8
+        char's max pending bytes).  Either way the pieces' concatenation
+        equals the full decode exactly.  ``deduped_tops`` keeps the most
         probable id per surface string (byte-fallback ids can collide).
 
         ``text_limit`` clips the walk to the RETURNED text (stop-sequence
@@ -313,14 +370,17 @@ class ModelServer:
         rows = []
         committed = ""
         n = len(req.output_tokens)
+        full = None  # full decode, computed lazily on the first FFFD tail
         for i in range(n):
             if text_limit is not None and len(committed) >= text_limit:
                 break
             cur = self.tokenizer.decode(req.output_tokens[: i + 1])
-            if i + 1 < n:
-                # Trailing replacement chars may be a partial multi-byte
-                # sequence the next token completes: hold them back.
-                cur = cur.rstrip("�")
+            if i + 1 < n and cur.endswith(_FFFD):
+                if full is None:
+                    full = self.tokenizer.decode(req.output_tokens)
+                hold = self._held_back(cur, full)
+                if hold:
+                    cur = cur[:-hold]
             piece = cur[len(committed):]
             if text_limit is not None:
                 piece = piece[: max(0, text_limit - len(committed))]
@@ -406,20 +466,23 @@ class ModelServer:
         ) + "\nassistant:", True
 
     @staticmethod
-    def _parse_chat_logprobs(body: dict) -> tuple[bool, int]:
-        """(logprobs flag, top_logprobs N) with OpenAI chat validation."""
+    def _parse_chat_logprobs(body: dict) -> tuple[bool, int, int]:
+        """(logprobs flag, EFFECTIVE top-N, REQUESTED top-N) with OpenAI
+        chat validation.  The OpenAI range [0, 20] is accepted in full;
+        the engine records a device-side top-5 (LOGPROB_TOPK), so the
+        effective N truncates there and responses note the cap when it
+        bit (``top_logprobs_truncated_to``)."""
         lp_flag = bool(body.get("logprobs"))
         top_n = body.get("top_logprobs")
         if top_n is None:
-            return lp_flag, 0
+            return lp_flag, 0, 0
         if not lp_flag:
             raise ValueError("top_logprobs requires logprobs: true")
         top_n = int(top_n)
-        if not 0 <= top_n <= MAX_LOGPROBS:
-            # OpenAI allows up to 20; the engine computes top-5 device-side
-            # (LOGPROB_TOPK) — state the real ceiling.
-            raise ValueError(f"top_logprobs must be in [0, {MAX_LOGPROBS}]")
-        return lp_flag, top_n
+        if not 0 <= top_n <= OPENAI_MAX_TOP_LOGPROBS:
+            raise ValueError(
+                f"top_logprobs must be in [0, {OPENAI_MAX_TOP_LOGPROBS}]")
+        return lp_flag, min(top_n, MAX_LOGPROBS), top_n
 
     async def _run(self, req: Request, stops: list[str] | None = None) -> Request:
         loop = asyncio.get_running_loop()
@@ -473,11 +536,12 @@ class ModelServer:
             try:
                 self.engine.submit(req)
             except EngineDraining as e:
-                return _err(503, str(e), trace_id)  # replica leaving the set
+                return self._reject(503, str(e), trace_id, "draining")  # replica leaving the set
             except ValueError as e:
                 return _err(400, str(e), trace_id)
             except queue_mod.Full:
-                return _err(429, "prefill queue is full", trace_id)
+                return self._reject(429, "prefill queue is full",
+                                    trace_id, "queue_full")
 
         # From here the request occupies engine capacity: ANY exit before
         # completion (disconnect during prepare, write failure, handler
@@ -712,13 +776,14 @@ class ModelServer:
         try:
             reqs = await self._run_many(reqs, stops)
         except EngineDraining as e:
-            return _err(503, str(e), trace_id)  # replica leaving routable set
+            return self._reject(503, str(e), trace_id, "draining")
         except ValueError as e:
             return _err(400, str(e), trace_id)
         except queue_mod.Full:
             # Backpressure the gateway cleanly; its scheduler already sees the
             # queue depth via /metrics and will shed/redirect.
-            return _err(429, "prefill queue is full", trace_id)
+            return self._reject(429, "prefill queue is full", trace_id,
+                                "queue_full")
         for r in reqs:
             if r.error:
                 return _err(500, r.error, trace_id)
@@ -778,7 +843,7 @@ class ModelServer:
         try:
             prompt, add_bos = self._chat_prompt(messages)
             n, best_of, _, stops = self._parse_choice_params(body)
-            lp_flag, top_n = self._parse_chat_logprobs(body)
+            lp_flag, top_n, top_req = self._parse_chat_logprobs(body)
         except (ValueError, TypeError) as e:
             return _err(400, str(e), trace_id)
         prompt_tokens = self.tokenizer.encode(prompt, add_bos=add_bos)
@@ -807,11 +872,12 @@ class ModelServer:
         try:
             reqs = await self._run_many(reqs, stops)
         except EngineDraining as e:
-            return _err(503, str(e), trace_id)  # replica leaving routable set
+            return self._reject(503, str(e), trace_id, "draining")
         except ValueError as e:
             return _err(400, str(e), trace_id)
         except queue_mod.Full:
-            return _err(429, "prefill queue is full", trace_id)
+            return self._reject(429, "prefill queue is full", trace_id,
+                                "queue_full")
         for r in reqs:
             if r.error:
                 return _err(500, r.error, trace_id)
@@ -826,6 +892,10 @@ class ModelServer:
             if lp_flag:
                 choice["logprobs"] = self._chat_logprobs_json(
                     r, top_n, text_limit=len(text))
+                if top_req > top_n:
+                    # Divergence note: the client asked for more than the
+                    # engine's device-side top-k records.
+                    choice["logprobs"]["top_logprobs_truncated_to"] = top_n
             choices.append(choice)
         completion_tokens = sum(len(r.output_tokens) for r in reqs)
         headers = self._record_spans(
@@ -868,15 +938,16 @@ class ModelServer:
             if isinstance(body.get("messages"), list):
                 prompt, add_bos = self._chat_prompt(body["messages"])
                 prompt_tokens = self.tokenizer.encode(prompt, add_bos=add_bos)
-                lp_flag, top_n = self._parse_chat_logprobs(body)
+                lp_flag, top_n, _ = self._parse_chat_logprobs(body)
                 logprobs = top_n if lp_flag else None
             else:
                 prompt_tokens = self._encode_prompt(body)
         except (ValueError, TypeError) as e:
             return _err(400, str(e), trace_id)
         if n > 1 or best_of > 1 or body.get("echo"):
-            return _err(422, "prefill hop supports single-candidate, "
-                             "non-echo requests", trace_id)
+            return self._reject(422, "prefill hop supports single-candidate, "
+                                     "non-echo requests", trace_id,
+                                "prefill_unsupported")
         req = self._make_request(body, prompt_tokens, adapter,
                                  logprobs=logprobs)
         loop = asyncio.get_running_loop()
@@ -884,11 +955,13 @@ class ModelServer:
             handoff = await loop.run_in_executor(
                 None, lambda: self.engine.prefill_only(req))
         except EngineDraining as e:
-            return _err(503, str(e), trace_id)
+            return self._reject(503, str(e), trace_id, "draining")
         except queue_mod.Full:
-            return _err(429, "prefill queue is full", trace_id)
+            return self._reject(429, "prefill queue is full", trace_id,
+                                "queue_full")
         except ValueError as e:
-            return _err(422, str(e), trace_id)  # e.g. beyond the bucket set
+            return self._reject(422, str(e), trace_id,
+                                "prefill_refused")  # e.g. beyond the bucket set
         except RuntimeError as e:
             return _err(500, str(e), trace_id)
         handoff.body = body  # envelope params ride to the decode hop
@@ -937,13 +1010,14 @@ class ModelServer:
         try:
             req = self.engine.attach_prefilled(handoff)
         except EngineDraining as e:
-            return _err(503, str(e), trace_id)
+            return self._reject(503, str(e), trace_id, "draining")
         except queue_mod.Full:
-            return _err(429, "attach admission queue is full", trace_id)
+            return self._reject(429, "attach admission queue is full",
+                                trace_id, "queue_full")
         except AdapterError as e:
             return _err(404, str(e), trace_id)
         except ValueError as e:
-            return _err(422, str(e), trace_id)
+            return self._reject(422, str(e), trace_id, "attach_refused")
         t_att = time.time()
         self.engine.observe_handoff(t_att - t_des0)
         attach_spans = [("handoff.deserialize", t_des0, t_des1),
@@ -1005,6 +1079,15 @@ class ModelServer:
             if req.logprobs is not None:
                 choice["logprobs"] = self._chat_logprobs_json(
                     req, req.logprobs, text_limit=len(text))
+                try:
+                    top_req = int(body.get("top_logprobs") or 0)
+                except (TypeError, ValueError):
+                    top_req = 0
+                if top_req > req.logprobs:
+                    # The prefill hop already capped the recorded top-k;
+                    # keep the truncation note on the attach path too.
+                    choice["logprobs"]["top_logprobs_truncated_to"] = (
+                        req.logprobs)
             return web.json_response({
                 "id": f"chatcmpl-{req.request_id}",
                 "object": "chat.completion",
@@ -1094,9 +1177,11 @@ class ModelServer:
         # The engine doesn't know its served name; the phase-latency
         # histogram families are labeled by model + role at render time.
         snap.setdefault("model_name", self.model_name)
-        return web.Response(
-            text=metrics_mod.render(snap), content_type="text/plain"
-        )
+        text = metrics_mod.render(snap)
+        # Flight-recorder counters (server-side twin of the gateway's
+        # gateway_events_total family).
+        text += "\n".join(self.events.render_prom("tpu:events_total")) + "\n"
+        return web.Response(text=text, content_type="text/plain")
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:
         """Recent traces recorded by THIS replica (``?trace_id=`` filter).
@@ -1105,6 +1190,13 @@ class ModelServer:
         spans live only here)."""
         return web.json_response(
             tracing.debug_traces_payload(self.tracer, request.query))
+
+    async def handle_debug_events(self, request: web.Request) -> web.Response:
+        """This replica's flight recorder (admission rejections, handoff
+        refusals, drain transitions); same query contract as the gateway's
+        ``/debug/events`` (``?since=``/``?kind=``/``?limit=``)."""
+        return web.json_response(
+            events_mod.debug_events_payload(self.events, request.query))
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.engine.draining:

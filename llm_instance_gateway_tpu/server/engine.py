@@ -582,6 +582,11 @@ class Engine:
         self._work = threading.Condition()
         self._running = False
         self._draining = False
+        # Flight-recorder seam (llm_instance_gateway_tpu/events.py): the
+        # HTTP layer installs EventJournal.emit here so engine lifecycle
+        # changes (drain start) land in /debug/events without the engine
+        # importing any server machinery.  Signature: (kind, **attrs).
+        self.event_sink = None
         # Requests mid-admission (popped from the queue, slot not yet
         # registered): counted into num_requests_waiting so drain() and the
         # routing signal never see a phantom-quiescent engine.
@@ -916,6 +921,14 @@ class Engine:
         then calls ``stop()`` (stragglers fail as the loop exits; k8s
         would be at the end of terminationGracePeriod anyway)."""
         self._draining = True
+        if self.event_sink is not None:
+            try:
+                # "role_change" in the flight recorder's shared kind
+                # namespace: the replica is leaving the routable set.
+                self.event_sink("role_change", role=self.cfg.role,
+                                draining=True)
+            except Exception:
+                logger.exception("event sink failed on drain")
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             snap = self.metrics_snapshot()

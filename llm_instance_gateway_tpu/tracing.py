@@ -149,6 +149,25 @@ def _fmt(v: float) -> str:
     return format(v, "g")
 
 
+def render_counter(name: str, table: dict, label: str) -> list[str]:
+    """One labeled counter family: ``# TYPE`` line, a ``None`` key (or an
+    empty table) rendered as the unlabeled fallback line, remaining keys
+    sorted and escaped.  The ONE implementation behind every counter
+    family the gateway, the event journal, and the health scorer expose —
+    exposition-format fixes land here once."""
+    lines = [f"# TYPE {name} counter"]
+    if not table:
+        lines.append(f"{name} 0")
+    # None sorts first: stable output, fallback line leads.
+    for key in sorted(table, key=lambda k: (k is not None, k or "")):
+        if key is None:
+            lines.append(f"{name} {table[key]}")
+        else:
+            lines.append(
+                f'{name}{{{label}="{escape_label(key)}"}} {table[key]}')
+    return lines
+
+
 def render_histogram(name: str, hist, labels: dict[str, str] | None = None,
                      type_line: bool = True) -> list[str]:
     """Prometheus histogram exposition lines for one series.

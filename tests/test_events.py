@@ -1,0 +1,100 @@
+"""Flight-recorder unit tests: journal bounds, cursors, counters, and the
+shared /debug/events query contract (events.py)."""
+
+from llm_instance_gateway_tpu import events
+
+
+def make_journal(capacity=8):
+    t = {"now": 100.0}
+    j = events.EventJournal(capacity=capacity, clock=lambda: t["now"])
+    return j, t
+
+
+class TestJournal:
+    def test_seq_is_monotonic_and_counts_cumulative(self):
+        j, _ = make_journal()
+        seqs = [j.emit(events.PICK, pod="p") for _ in range(3)]
+        j.emit(events.SHED, model="m")
+        assert seqs == [1, 2, 3]
+        assert j.seq == 4
+        assert j.counts == {events.PICK: 3, events.SHED: 1}
+
+    def test_ring_is_bounded_but_counts_survive_rotation(self):
+        j, _ = make_journal(capacity=4)
+        for i in range(10):
+            j.emit(events.PICK, pod=f"p{i}")
+        rows = j.events(limit=100)
+        assert len(rows) == 4
+        assert [e["seq"] for e in rows] == [7, 8, 9, 10]
+        assert j.counts[events.PICK] == 10  # counter kept full history
+
+    def test_since_cursor_and_kind_filter(self):
+        j, _ = make_journal()
+        j.emit(events.PICK, pod="a")
+        j.emit(events.SHED, model="m")
+        j.emit(events.PICK, pod="b")
+        assert [e["seq"] for e in j.events(since=1)] == [2, 3]
+        picks = j.events(kind=events.PICK)
+        assert [e["attrs"]["pod"] for e in picks] == ["a", "b"]
+
+    def test_trace_id_rides_the_event(self):
+        j, _ = make_journal()
+        j.emit(events.UPSTREAM_ERROR, trace_id="t1", pod="p")
+        (e,) = j.events()
+        assert e["trace_id"] == "t1" and e["attrs"] == {"pod": "p"}
+
+    def test_snapshot_shape(self):
+        j, t = make_journal()
+        t["now"] = 123.5
+        j.emit(events.SLO_TRANSITION, model="m", frm="ok", to="fast_burn")
+        snap = j.snapshot()
+        assert snap["seq"] == 1 and snap["capacity"] == 8
+        assert snap["events"][0]["ts"] == 123.5
+        assert snap["counts"] == {events.SLO_TRANSITION: 1}
+
+    def test_render_prom_escapes_and_falls_back(self):
+        j, _ = make_journal()
+        assert j.render_prom("tpu:events_total") == [
+            "# TYPE tpu:events_total counter", "tpu:events_total 0"]
+        j.emit('evil"kind\nx')
+        lines = j.render_prom("tpu:events_total")
+        assert 'kind="evil\\"kind\\nx"' in lines[1]
+
+
+class TestDebugPayload:
+    def test_query_contract(self):
+        j, _ = make_journal()
+        for i in range(5):
+            j.emit(events.PICK, pod=f"p{i}")
+        payload = events.debug_events_payload(j, {"since": "3"})
+        assert payload["seq"] == 5
+        assert [e["seq"] for e in payload["events"]] == [4, 5]
+        assert payload["next_since"] == 5
+        # Hostile/absent params fall back instead of raising.
+        payload = events.debug_events_payload(
+            j, {"since": "zzz", "limit": "nope"})
+        assert len(payload["events"]) == 5
+
+    def test_limit_pages_oldest_first_without_loss(self):
+        """A burst larger than the page size is PAGED, not trimmed — the
+        flight recorder must never silently drop its oldest rows."""
+        j, _ = make_journal(capacity=64)
+        for i in range(5):
+            j.emit(events.PICK, pod=f"p{i}")
+        page1 = events.debug_events_payload(j, {"limit": "2"})
+        assert [e["seq"] for e in page1["events"]] == [1, 2]
+        assert page1["next_since"] == 2
+        page2 = events.debug_events_payload(
+            j, {"limit": "2", "since": str(page1["next_since"])})
+        assert [e["seq"] for e in page2["events"]] == [3, 4]
+        page3 = events.debug_events_payload(
+            j, {"limit": "2", "since": str(page2["next_since"])})
+        assert [e["seq"] for e in page3["events"]] == [5]
+        assert page3["next_since"] == page3["seq"] == 5  # caught up
+
+    def test_kind_filter(self):
+        j, _ = make_journal()
+        j.emit(events.PICK, pod="a")
+        j.emit(events.SHED, model="m")
+        payload = events.debug_events_payload(j, {"kind": events.SHED})
+        assert [e["kind"] for e in payload["events"]] == [events.SHED]

@@ -222,6 +222,131 @@ def test_api_http_metrics_endpoint_round_trips():
     asyncio.run(run())
 
 
+def loaded_observability():
+    """A proxy-shaped observability stack (SLO engine + health scorer +
+    journal) with hostile labels exercised on every new family."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway import health, slo
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    gm = loaded_gateway_metrics()
+    journal = events.EventJournal(capacity=64)
+    journal.emit(events.PICK, trace_id="t1", pod=HOSTILE)
+    journal.emit(events.SHED, model=HOSTILE)
+    engine = slo.SLOEngine(gm, cfg=slo.SLOConfig(min_window_total=1),
+                           journal=journal)
+    engine.tick(now=1000.0)
+    # Traffic BETWEEN ticks so even the 1m window has a delta to judge.
+    gm.record_phase("sql-assist", "collocated", ttft_s=0.05, tpot_s=0.002,
+                    e2e_s=0.4)
+    engine.tick(now=1070.0)
+    provider = StaticProvider(
+        [PodMetrics(pod=Pod(HOSTILE, "127.0.0.1:1"), metrics=Metrics())])
+    scorer = health.HealthScorer(provider=provider, journal=journal)
+    for _ in range(5):
+        scorer.record_upstream(HOSTILE, ok=False, timeout=True)
+    scorer.record_handoff(HOSTILE, ok=False)
+    scorer.update(now=100.0)
+    scorer.update(now=105.0)
+    scorer.update(now=110.0)
+    scorer.note_pick(HOSTILE)  # degraded pod: counts as would-avoid
+    return gm, engine, scorer, journal
+
+
+def test_slo_health_events_exposition_contract():
+    """Satellite: the new gateway_slo_*, gateway_pod_health_*, upstream/
+    handoff counters, would-avoid counter, and event-counter families lint
+    clean on the composed gateway page — TYPE coverage, label escaping,
+    and gauge-vs-counter semantics."""
+    gm, engine, scorer, journal = loaded_observability()
+    text = gm.render() + "\n".join(
+        engine.render() + scorer.render()
+        + journal.render_prom("gateway_events_total")) + "\n"
+    families = lint_exposition(text)
+    types = {line.split(" ")[2]: line.split(" ")[3]
+             for line in text.splitlines() if line.startswith("# TYPE ")}
+    # Gauge families (point-in-time, may go down).
+    for fam in ("gateway_slo_compliance_ratio", "gateway_slo_burn_rate",
+                "gateway_pod_health_score", "gateway_pod_health_state"):
+        assert types[fam] == "gauge", fam
+        assert families[fam], fam
+    # Counter families (cumulative only).
+    for fam in ("gateway_upstream_errors_total",
+                "gateway_upstream_timeouts_total",
+                "gateway_handoff_failures_total",
+                "tpu:health_would_avoid_total", "gateway_events_total"):
+        assert types[fam] == "counter", fam
+    # Hostile labels round-trip on every new dimension.
+    assert {s.labels["model"] for s in
+            families["gateway_slo_compliance_ratio"]} == {"sql-assist",
+                                                          HOSTILE}
+    assert any(s.labels["window"] == "1m"
+               for s in families["gateway_slo_burn_rate"])
+    assert {s.labels["objective"] for s in
+            families["gateway_slo_compliance_ratio"]} >= {
+        "ttft", "tpot", "e2e", "error_rate"}
+    assert [s.labels["pod"] for s in
+            families["gateway_pod_health_score"]] == [HOSTILE]
+    assert families["gateway_pod_health_state"][0].labels["state"] in (
+        "healthy", "degraded", "unhealthy")
+    assert [s.labels["pod"] for s in
+            families["tpu:health_would_avoid_total"]] == [HOSTILE]
+    # Direct emits plus the transitions the scorer itself journaled.
+    assert {s.labels["kind"] for s in
+            families["gateway_events_total"]} >= {"pick", "shed",
+                                                  "health_transition"}
+
+
+def test_empty_observability_state_still_lints():
+    """Fresh proxy, zero traffic: the composed page must still parse (the
+    would-avoid/upstream counters render unlabeled 0 fallbacks; SLO and
+    health families are simply absent)."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway import health, slo
+
+    gm = GatewayMetrics()
+    engine = slo.SLOEngine(gm)
+    scorer = health.HealthScorer()
+    journal = events.EventJournal()
+    text = gm.render() + "\n".join(
+        engine.render() + scorer.render()
+        + journal.render_prom("gateway_events_total")) + "\n"
+    families = lint_exposition(text)
+    assert families["gateway_events_total"][0].value == 0
+    assert families["tpu:health_would_avoid_total"][0].value == 0
+
+
+def test_server_events_family_round_trips():
+    """Satellite: tpu:events_total on the model-server surface — rendered
+    through the REAL aiohttp endpoint, with hostile event kinds escaped."""
+    import asyncio as asyncio_mod
+
+    from llm_instance_gateway_tpu.server.api_http import ModelServer
+
+    async def run():
+        server = ModelServer(FakeEngine(), tokenizer=None,
+                             model_name="llama3-tiny")
+        server.events.emit("admission_reject", status=429,
+                           reason="queue_full")
+        server.events.emit(HOSTILE)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+        finally:
+            await client.close()
+        families = lint_exposition(text)
+        kinds = {s.labels["kind"]: s.value
+                 for s in families["tpu:events_total"]}
+        assert kinds == {"admission_reject": 1.0, HOSTILE: 1.0}
+
+    asyncio_mod.run(run())
+
+
 def test_pick_latency_histogram_math():
     """The summary -> histogram satellite: counts land in the right le
     buckets and quantile() still answers from the same state."""

@@ -1,0 +1,213 @@
+"""Health-scorer tests: component fusion, hysteresis state machine,
+scrape-freshness coupling to the provider, and the acceptance-critical
+routing-diff property — per-pod error streaks move scores and states while
+the scheduler's picks stay byte-identical, with only the would-avoid
+counter differing (gateway/health.py)."""
+
+import random
+
+from llm_instance_gateway_tpu import events
+from llm_instance_gateway_tpu.gateway import health
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+POD_A = Pod("pod-a", "10.0.0.1:8000")
+POD_B = Pod("pod-b", "10.0.0.2:8000")
+
+
+def make_provider(metrics_a=None, metrics_b=None):
+    return StaticProvider([
+        PodMetrics(pod=POD_A, metrics=metrics_a or Metrics()),
+        PodMetrics(pod=POD_B, metrics=metrics_b or Metrics()),
+    ])
+
+
+def make_scorer(provider=None, journal=None, **cfg_overrides):
+    cfg = health.HealthConfig(**cfg_overrides) if cfg_overrides else None
+    return health.HealthScorer(provider=provider or make_provider(),
+                               cfg=cfg, journal=journal)
+
+
+class TestScore:
+    def test_idle_pool_scores_healthy(self):
+        h = make_scorer()
+        h.update(now=100.0)
+        assert h.score("pod-a") >= 0.95
+        assert h.state("pod-a") == health.HEALTHY
+
+    def test_error_streak_degrades_with_dwell(self):
+        j = events.EventJournal()
+        h = make_scorer(journal=j)
+        h.update(now=100.0)
+        for _ in range(5):
+            h.record_upstream("pod-b", ok=False, timeout=True)
+        h.update(now=105.0)
+        # Dwell: one bad tick proposes the transition, the second commits.
+        assert h.state("pod-b") == health.HEALTHY
+        h.update(now=110.0)
+        assert h.state("pod-b") == health.DEGRADED
+        assert h.score("pod-b") < h.score("pod-a")
+        (t,) = [e for e in j.events(kind=events.HEALTH_TRANSITION)]
+        assert t["attrs"]["pod"] == "pod-b"
+        assert t["attrs"]["to"] == health.DEGRADED
+        assert h.upstream_timeouts["pod-b"] == 5
+
+    def test_success_resets_streak_and_recovers(self):
+        h = make_scorer()
+        h.update(now=100.0)
+        for _ in range(5):
+            h.record_upstream("pod-b", ok=False)
+        h.update(now=105.0)
+        h.update(now=110.0)
+        assert h.state("pod-b") == health.DEGRADED
+        h.record_upstream("pod-b", ok=True)
+        # Exit threshold + dwell: two clean ticks back to healthy.
+        h.update(now=115.0)
+        h.update(now=120.0)
+        assert h.state("pod-b") == health.HEALTHY
+        # Cumulative counters keep the history even after recovery.
+        assert h.upstream_errors["pod-b"] == 5
+
+    def test_scrape_staleness_and_errors_reach_unhealthy(self):
+        class DeadScrapeProvider(StaticProvider):
+            def scrape_health(self):
+                return {"pod-a": (100.0, 0), "pod-b": (100.0, 9)}
+
+        provider = DeadScrapeProvider([
+            PodMetrics(pod=POD_A, metrics=Metrics()),
+            PodMetrics(pod=POD_B, metrics=Metrics()),
+        ])
+        h = health.HealthScorer(provider=provider)
+        for _ in range(8):
+            h.record_upstream("pod-b", ok=False)
+        h.update(now=200.0)
+        h.update(now=205.0)
+        # Dead scrape (streak 9 >= floor) + maxed error streak: two zeroed
+        # components push below unhealthy_enter.
+        assert h.state("pod-b") == health.UNHEALTHY
+        assert h.state("pod-a") == health.HEALTHY
+        comp = h.debug_payload()["pods"]["pod-b"]["components"]
+        assert comp["freshness"] == 0.0 and comp["errors"] == 0.0
+
+    def test_queue_kv_and_latency_components(self):
+        provider = make_provider(
+            metrics_a=Metrics(prefill_seconds_mean=0.1,
+                              decode_step_seconds_mean=0.01),
+            metrics_b=Metrics(waiting_queue_size=60,
+                              kv_cache_usage_percent=0.95,
+                              prefill_seconds_mean=0.5,
+                              decode_step_seconds_mean=0.01),
+        )
+        h = make_scorer(provider=provider)
+        h.update(now=100.0)
+        comp = h.debug_payload()["pods"]["pod-b"]["components"]
+        assert comp["queue"] == 0.0          # 60 > queue_sat
+        assert comp["kv"] < 0.1
+        assert comp["latency"] < 1.0         # 5x the pool prefill median
+        assert h.debug_payload()["pods"]["pod-a"]["components"]["latency"] \
+            == 1.0
+
+    def test_handoff_failures_count_against_health(self):
+        h = make_scorer()
+        h.update(now=100.0)
+        for _ in range(5):
+            h.record_handoff("pod-a", ok=False)
+        h.update(now=105.0)
+        h.update(now=110.0)
+        assert h.state("pod-a") == health.DEGRADED
+        assert h.handoff_failures["pod-a"] == 5
+
+    def test_departed_pod_state_is_dropped(self):
+        h = make_scorer()
+        for _ in range(8):
+            h.record_upstream("pod-b", ok=False)
+        h.update(now=100.0)
+        h.update(now=105.0)
+        assert h.state("pod-b") != health.HEALTHY
+        h.provider = StaticProvider(
+            [PodMetrics(pod=POD_A, metrics=Metrics())])
+        h.update(now=110.0)
+        # A fresh replica reusing the name must not inherit the verdict,
+        # and the cumulative per-pod counters must not grow (or keep
+        # emitting exposition lines) under pod churn.
+        assert h.state("pod-b") == health.HEALTHY
+        assert h.score("pod-b") is None
+        assert "pod-b" not in h.upstream_errors
+        assert 'pod="pod-b"' not in "\n".join(h.render())
+
+
+class TestRenderContract:
+    def test_exposition_families(self):
+        h = make_scorer()
+        h.update(now=100.0)
+        h.record_upstream("pod-b", ok=False, timeout=True)
+        h.note_pick("pod-a")
+        text = "\n".join(h.render())
+        assert 'gateway_pod_health_score{pod="pod-a"}' in text
+        assert 'gateway_pod_health_state{pod="pod-a",state="healthy"} 1' \
+            in text
+        assert 'gateway_upstream_errors_total{pod="pod-b"} 1' in text
+        assert 'gateway_upstream_timeouts_total{pod="pod-b"} 1' in text
+        # Healthy pick: no would-avoid — unlabeled fallback 0 keeps the
+        # family present for dashboards.
+        assert "tpu:health_would_avoid_total 0" in text
+
+
+class TestRoutingUnchanged:
+    """The acceptance-critical diff property: attaching the scorer changes
+    NOTHING about routing — identical RNG, identical pick sequence — and
+    only the would-avoid counter moves."""
+
+    def _schedulers(self):
+        provider = make_provider(
+            metrics_a=Metrics(waiting_queue_size=3),
+            metrics_b=Metrics(waiting_queue_size=3),
+        )
+        mk = lambda: Scheduler(provider, token_aware=False,
+                               prefill_aware=False, prefix_aware=False,
+                               rng=random.Random(7))
+        return mk(), mk()
+
+    def test_picks_byte_identical_with_advisor(self):
+        plain, advised = self._schedulers()
+        scorer = make_scorer()
+        scorer.update(now=100.0)
+        for _ in range(6):
+            scorer.record_upstream("pod-b", ok=False)
+        scorer.update(now=105.0)
+        scorer.update(now=110.0)
+        assert scorer.state("pod-b") == health.DEGRADED
+        advised.health_advisor = scorer
+
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True)
+        picks_plain = [plain.schedule(req).name for _ in range(64)]
+        picks_advised = [advised.schedule(req).name for _ in range(64)]
+        assert picks_plain == picks_advised  # routing byte-identical
+        assert picks_advised.count("pod-b") > 0  # the case exercises both
+        # ...and the ONLY observable difference is the would-avoid count.
+        assert scorer.would_avoid_total == picks_advised.count("pod-b")
+        assert scorer.would_avoid == {
+            "pod-b": picks_advised.count("pod-b")}
+
+    def test_native_scheduler_has_the_same_seam(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            import pytest
+            pytest.skip("native scheduler library not built")
+        provider = make_provider()
+        plain = native.NativeScheduler(provider, token_aware=False,
+                                       prefill_aware=False,
+                                       prefix_aware=False,
+                                       rng=random.Random(7))
+        advised = native.NativeScheduler(provider, token_aware=False,
+                                         prefill_aware=False,
+                                         prefix_aware=False,
+                                         rng=random.Random(7))
+        scorer = make_scorer()
+        advised.health_advisor = scorer
+        req = LLMRequest(model="m", resolved_target_model="m", critical=True)
+        assert [plain.schedule(req).name for _ in range(32)] == \
+            [advised.schedule(req).name for _ in range(32)]

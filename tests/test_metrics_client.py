@@ -129,3 +129,39 @@ class TestProvider:
             assert len(prov.all_pod_metrics()) == 2
         finally:
             prov.stop()
+
+    def test_scrape_health_tracks_freshness_and_streaks(self):
+        """Tentpole: per-pod scrape freshness + failure streaks feed the
+        health scorer, and failures land in the flight recorder
+        (throttled: first, then every 10th)."""
+        from llm_instance_gateway_tpu import events
+
+        prov, _ = self.make(
+            res={"p1": Metrics()},
+            err={"p2": FetchError("connection refused")},
+        )
+        journal = events.EventJournal()
+        prov.journal = journal
+        prov.refresh_pods_once()
+        for _ in range(11):
+            prov.refresh_metrics_once()
+        sh = prov.scrape_health()
+        ok_ts, ok_streak = sh["p1"]
+        assert ok_ts is not None and ok_streak == 0
+        fail_ts, fail_streak = sh["p2"]
+        assert fail_ts is None and fail_streak == 11
+        rows = journal.events(kind=events.SCRAPE_FAILURE, limit=100)
+        # Throttle: streak 1 and streak 10 only.
+        assert [e["attrs"]["streak"] for e in rows] == [1, 10]
+        assert all(e["attrs"]["pod"] == "p2" for e in rows)
+
+    def test_scrape_health_forgets_removed_pods(self):
+        prov, ds = self.make(
+            err={"p2": FetchError("x")}, res={"p1": Metrics()})
+        prov.refresh_pods_once()
+        prov.refresh_metrics_once()
+        assert prov.scrape_health()["p2"][1] == 1
+        ds.delete_pod("p2")
+        prov.refresh_pods_once()
+        prov.refresh_metrics_once()
+        assert "p2" not in prov.scrape_health()

@@ -1,0 +1,65 @@
+"""Metrics-docs satellite: docs/METRICS.md is generated from the registry
+(``make metrics-docs``) and must stay current, and the registry must cover
+every family the REAL render paths expose — a family added to a renderer
+without a registry entry (or a doc regenerate) fails here, not in an
+operator's dashboard."""
+
+import pathlib
+
+from llm_instance_gateway_tpu import metrics_registry
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_docs_file_is_current():
+    committed = (REPO / "docs" / "METRICS.md").read_text()
+    assert committed == metrics_registry.render_markdown(), (
+        "docs/METRICS.md is stale — run `make metrics-docs`")
+
+
+def test_registry_entries_are_well_formed():
+    names = [f.name for f in metrics_registry.all_families()]
+    assert len(names) == len(set(names)), "duplicate family names"
+    for f in metrics_registry.all_families():
+        assert f.kind in ("counter", "gauge", "histogram"), f.name
+        assert f.help.strip(), f.name
+        # Convention check: counters end in _total unless they are the
+        # pre-existing tpu:* contract counters.
+        if f.kind == "counter" and not f.name.startswith("tpu:"):
+            assert f.name.endswith("_total"), f.name
+
+
+def _rendered_family_names(text: str) -> set:
+    return {line.split(" ")[2] for line in text.splitlines()
+            if line.startswith("# TYPE ")}
+
+
+def test_registry_covers_gateway_surface():
+    from test_exposition_contract import loaded_observability
+
+    gm, engine, scorer, journal = loaded_observability()
+    text = gm.render() + "\n".join(
+        engine.render() + scorer.render()
+        + journal.render_prom("gateway_events_total")) + "\n"
+    rendered = _rendered_family_names(text)
+    registered = metrics_registry.registered_names()
+    missing = rendered - registered
+    assert not missing, f"rendered but unregistered: {missing}"
+
+
+def test_registry_covers_server_surface():
+    from test_exposition_contract import server_snapshot
+
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.server import metrics as server_metrics
+
+    snap = dict(server_snapshot())
+    snap["spec_cycles"] = 3
+    snap["spec_tokens_per_cycle"] = 2.5
+    journal = events.EventJournal()
+    journal.emit(events.ADMISSION_REJECT, status=429)
+    text = (server_metrics.render(snap)
+            + "\n".join(journal.render_prom("tpu:events_total")) + "\n")
+    rendered = _rendered_family_names(text)
+    missing = rendered - metrics_registry.registered_names()
+    assert not missing, f"rendered but unregistered: {missing}"

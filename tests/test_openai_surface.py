@@ -292,9 +292,30 @@ class TestChatLogprobs:
         assert "requires logprobs" in data["error"]["message"]
 
     def test_top_logprobs_out_of_range(self, model_server):
+        # OpenAI's own ceiling is 20; beyond it is a client error.
         status, data = self._chat(model_server,
-                                  {"logprobs": True, "top_logprobs": 9})
+                                  {"logprobs": True, "top_logprobs": 21})
         assert status == 400
+        assert "top_logprobs" in data["error"]["message"]
+
+    def test_top_logprobs_above_engine_topk_truncates_with_note(
+            self, model_server):
+        """Satellite (ADVICE): the full OpenAI range [0, 20] is accepted;
+        entries truncate to the engine's device-side top-5 and the
+        response's logprobs object says so."""
+        status, data = self._chat(model_server,
+                                  {"logprobs": True, "top_logprobs": 20})
+        assert status == 200
+        lp = data["choices"][0]["logprobs"]
+        assert lp["top_logprobs_truncated_to"] == 5
+        assert all(len(e["top_logprobs"]) <= 5 for e in lp["content"])
+
+    def test_top_logprobs_within_engine_topk_has_no_note(self, model_server):
+        status, data = self._chat(model_server,
+                                  {"logprobs": True, "top_logprobs": 5})
+        assert status == 200
+        assert "top_logprobs_truncated_to" not in \
+            data["choices"][0]["logprobs"]
 
     def test_streaming_chat_logprobs_rejected(self, model_server):
         status, data = self._chat(model_server,
@@ -321,6 +342,57 @@ class TestChatLogprobs:
         comp = model_server._logprobs_json(req, k=1)
         assert "".join(comp["tokens"]) == "a😀b"
         assert comp["text_offset"] == [0, 1, 1, 1, 1, 2]
+
+    def test_genuine_replacement_char_token_keeps_attribution(self):
+        """Satellite regression (ADVICE): a token that LEGITIMATELY decodes
+        to U+FFFD must keep its char on its own row — the old rstrip-based
+        holdback shifted it (and its bytes) onto the NEXT token.  Partial
+        multi-byte holdback still works (previous test); only genuinely-
+        emitted replacement chars stay put."""
+        from llm_instance_gateway_tpu.server.api_http import ModelServer
+        from llm_instance_gateway_tpu.server.engine import Request
+
+        class FFFDVocabTokenizer:
+            """id 0 -> 'a', id 1 -> a genuine U+FFFD char, id 2 -> 'b'."""
+
+            _TABLE = {0: "a", 1: "�", 2: "b"}
+
+            def decode(self, ids):
+                return "".join(self._TABLE[i] for i in ids)
+
+        server = ModelServer(engine=None, tokenizer=FFFDVocabTokenizer(),
+                             model_name="m")
+        req = Request(prompt_tokens=[0], max_new_tokens=8, sampling=None)
+        req.output_tokens = [0, 1, 2]
+        req.output_logprobs = [-0.5] * 3
+        req.output_top_logprobs = [{t: -0.5} for t in req.output_tokens]
+        chat = server._chat_logprobs_json(req, top_n=1)["content"]
+        assert [e["token"] for e in chat] == ["a", "�", "b"]
+        assert chat[1]["bytes"] == list("�".encode())
+        comp = server._logprobs_json(req, k=1)
+        assert comp["tokens"] == ["a", "�", "b"]
+        assert comp["text_offset"] == [0, 1, 2]
+
+    def test_trailing_genuine_fffd_run_not_held_back(self):
+        """A run of genuine U+FFFD longer than one UTF-8 char's max pending
+        bytes is model output by construction; attribution stays exact and
+        concatenation equals the full decode."""
+        from llm_instance_gateway_tpu.server.api_http import ModelServer
+        from llm_instance_gateway_tpu.server.engine import Request
+
+        class FFFDVocabTokenizer:
+            def decode(self, ids):
+                return "".join({0: "a", 1: "�"}[i] for i in ids)
+
+        server = ModelServer(engine=None, tokenizer=FFFDVocabTokenizer(),
+                             model_name="m")
+        req = Request(prompt_tokens=[0], max_new_tokens=8, sampling=None)
+        req.output_tokens = [0, 1, 1, 1, 1, 0]
+        req.output_logprobs = [-0.5] * 6
+        req.output_top_logprobs = [{t: -0.5} for t in req.output_tokens]
+        comp = server._logprobs_json(req, k=0)
+        assert comp["tokens"] == ["a", "�", "�", "�",
+                                  "�", "a"]
 
 
 class TestChatTemplate:

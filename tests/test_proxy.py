@@ -208,6 +208,65 @@ def test_error_body_carries_trace_id():
     asyncio.run(run())
 
 
+def test_flight_recorder_on_request_path():
+    """Tentpole: the REAL request path journals pick / shed /
+    admission_reject / upstream_error events carrying the trace id, the
+    health scorer sees upstream outcomes, and /debug/events serves it all
+    (events.py wiring in proxy.py)."""
+    from llm_instance_gateway_tpu import events
+
+    async def run():
+        upstream = await start_fake_model_server("upstream-a")
+        addr = f"127.0.0.1:{upstream.port}"
+        pods = {Pod("good", addr): fake_metrics(queue=0, kv=0.1)}
+        proxy = build_proxy(pods, [make_model("m")])
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            await client.post("/v1/completions",
+                              json={"model": "m", "prompt": "hello"},
+                              headers={"x-lig-trace-id": "feed0123feed0123"})
+            await client.post("/v1/completions",
+                              json={"model": "ghost", "prompt": "x"})
+            dbg = await (await client.get("/debug/events")).json()
+            by_kind = {}
+            for e in dbg["events"]:
+                by_kind.setdefault(e["kind"], []).append(e)
+            (pick,) = by_kind[events.PICK]
+            assert pick["trace_id"] == "feed0123feed0123"
+            assert pick["attrs"] == {"model": "m", "pod": "good"}
+            (reject,) = by_kind[events.ADMISSION_REJECT]
+            assert reject["attrs"]["status"] == 400
+            # The successful upstream round-trip reset pod health streaks.
+            assert proxy.health._err_streak.get("good", 0) == 0
+        finally:
+            await client.close()
+            await upstream.close()
+
+    asyncio.run(run())
+
+
+def test_shed_and_upstream_error_events():
+    from llm_instance_gateway_tpu import events
+
+    async def run():
+        pods = {Pod("p", "127.0.0.1:1"): fake_metrics(queue=50, kv=0.99)}
+        proxy = build_proxy(pods, [make_model("batch", Criticality.SHEDDABLE),
+                                   make_model("m")])
+        await run_proxy_request(proxy, body={"model": "batch", "prompt": "x"})
+        assert [e["attrs"]["model"] for e in
+                proxy.journal.events(kind=events.SHED)] == ["batch"]
+        # Nothing listens on 127.0.0.1:1 -> upstream_error + health streak.
+        status, _, _ = await run_proxy_request(
+            proxy, body={"model": "m", "prompt": "x"})
+        assert status == 502
+        (err,) = proxy.journal.events(kind=events.UPSTREAM_ERROR)
+        assert err["attrs"]["pod"] == "p"
+        assert proxy.health.upstream_errors["p"] == 1
+
+    asyncio.run(run())
+
+
 def test_models_listing():
     async def run():
         proxy = build_proxy({}, [make_model("m1"), make_model("m2", Criticality.SHEDDABLE)])

@@ -1,0 +1,302 @@
+"""SLO engine tests: burn-rate math from real histograms, the multi-window
+state machine with hysteresis, the /debug/slo endpoint, and the synthetic
+breach -> fast burn -> black-box dump -> post-mortem report path the
+acceptance criteria name (gateway/slo.py, tools/blackbox_report.py)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu import events
+from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+from llm_instance_gateway_tpu.gateway import slo
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.server import Server
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics
+from llm_instance_gateway_tpu.gateway.testing import fake_metrics, make_model
+from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+
+# Second-scale windows so tests drive the clock explicitly; thresholds on
+# LATENCY_BUCKETS edges so histogram counting is exact.
+TEST_CFG = dict(
+    windows=(slo.Window("5s", 5.0), slo.Window("15s", 15.0),
+             slo.Window("60s", 60.0), slo.Window("180s", 180.0)),
+    min_window_total=5,
+    clear_ticks=2,
+)
+
+
+def make_engine(journal=None, on_fast_burn=None, **cfg_overrides):
+    gm = GatewayMetrics()
+    cfg = slo.SLOConfig(**{**TEST_CFG, **cfg_overrides})
+    eng = slo.SLOEngine(gm, cfg=cfg, journal=journal,
+                        on_fast_burn=on_fast_burn)
+    return gm, eng
+
+
+def record_ttft(gm, value_s, n, model="m"):
+    for _ in range(n):
+        gm.record_phase(model, "collocated", ttft_s=value_s)
+
+
+class TestBurnMath:
+    def test_good_total_snaps_threshold_to_bucket_edge(self):
+        from llm_instance_gateway_tpu import tracing
+
+        h = tracing.Histogram(tracing.LATENCY_BUCKETS)
+        for v in (0.5, 1.0, 2.0, 100.0):  # 100 beyond the largest bucket
+            h.observe(v)
+        good, total = slo._good_total(h.state(), 1.0)
+        assert (good, total) == (2, 4)  # <=1.0 counts; 2.0 and 100 are bad
+
+    def test_insufficient_window_is_none(self):
+        gm, eng = make_engine()
+        record_ttft(gm, 5.0, 3)  # below min_window_total
+        eng.tick(now=1000.0)
+        eng.tick(now=1005.0)
+        burns = eng.debug_payload()["models"]["m"]["ttft"]["burn_rates"]
+        assert all(v is None for v in burns.values())
+        assert eng.state("m", "ttft") == eng.OK
+
+    def test_burn_rate_value(self):
+        gm, eng = make_engine()
+        eng.tick(now=1000.0)
+        # 10 good + 10 bad in the window: bad_frac 0.5, budget 0.05 -> 10.
+        record_ttft(gm, 0.05, 10)
+        record_ttft(gm, 5.0, 10)
+        eng.tick(now=1004.0)
+        burns = eng.debug_payload()["models"]["m"]["ttft"]["burn_rates"]
+        assert burns["5s"] == pytest.approx(10.0)
+        compliance = eng.debug_payload()["models"]["m"]["ttft"]["compliance"]
+        assert compliance == pytest.approx(0.5)
+
+    def test_error_rate_objective_from_shed_and_error_counters(self):
+        gm, eng = make_engine()
+        for _ in range(20):
+            gm.record_request("m")
+        eng.tick(now=1000.0)
+        for _ in range(20):
+            gm.record_request("m")
+        for _ in range(6):
+            gm.record_shed("m")
+        for _ in range(4):
+            gm.record_error("m")
+        # t=1006 so the 5s window's baseline is the t=1000 sample (start
+        # 1001 > 1000 would exclude it; the engine picks the newest sample
+        # at or before the window start).
+        eng.tick(now=1006.0)
+        d = eng.debug_payload()["models"]["m"]["error_rate"]
+        # 20 new requests, 10 newly bad: bad_frac 0.5, budget 0.01 -> 50.
+        assert d["burn_rates"]["5s"] == pytest.approx(50.0)
+
+    def test_pre_admission_errors_widen_denominator(self):
+        """Admission failures never reach record_request; the error-rate
+        denominator counts them once instead of overstating the bad
+        fraction for the healthy traffic beside them."""
+        gm, eng = make_engine()
+        eng.tick(now=1000.0)
+        for _ in range(10):
+            gm.record_request("m")
+        for _ in range(5):
+            gm.record_error("m", pre_admission=True)
+        eng.tick(now=1004.0)
+        d = eng.debug_payload()["models"]["m"]["error_rate"]
+        # 10 admitted ok + 5 pre-admission errors: bad_frac 5/15.
+        assert d["burn_rates"]["5s"] == pytest.approx((5 / 15) / 0.01)
+
+
+class TestStateMachine:
+    def test_fast_burn_needs_both_fast_windows(self):
+        gm, eng = make_engine()
+        eng.tick(now=1000.0)
+        record_ttft(gm, 5.0, 30)
+        # t=1004: the 5s window sees the burst but the 15s baseline is the
+        # same t=1000 sample — both exceed, so fast burn trips (this is the
+        # standard two-window page: short window for recency, long window
+        # so a 1-second blip can't page).
+        eng.tick(now=1004.0)
+        assert eng.state("m", "ttft") == eng.FAST_BURN
+
+    def test_transition_emits_event_and_fires_hook(self):
+        j = events.EventJournal(capacity=64)
+        fired = []
+        gm, eng = make_engine(journal=j,
+                              on_fast_burn=lambda m, o, b: fired.append((m, o)))
+        eng.tick(now=1000.0)
+        record_ttft(gm, 5.0, 30)
+        eng.tick(now=1004.0)
+        assert ("m", "ttft") in fired
+        kinds = [e["attrs"] for e in j.events(kind=events.SLO_TRANSITION)]
+        assert any(a["objective"] == "ttft" and a["to"] == "fast_burn"
+                   for a in kinds)
+
+    def test_clear_needs_consecutive_ticks(self):
+        gm, eng = make_engine()
+        eng.tick(now=1000.0)
+        record_ttft(gm, 5.0, 30)
+        eng.tick(now=1004.0)
+        assert eng.state("m", "ttft") == eng.FAST_BURN
+        # Burn subsides: the short windows age the burst out as good
+        # traffic arrives, but ONE clear tick must not de-escalate
+        # (clear_ticks=2).
+        record_ttft(gm, 0.05, 400)
+        eng.tick(now=1030.0)
+        assert eng.state("m", "ttft") == eng.FAST_BURN
+        record_ttft(gm, 0.05, 400)
+        eng.tick(now=1060.0)
+        assert eng.state("m", "ttft") == eng.OK
+
+    def test_per_model_objective_overrides(self):
+        gm, eng = make_engine()
+        eng.cfg.per_model["strict"] = (
+            slo.Objective("ttft", target=0.999, threshold_s=0.01),)
+        eng.tick(now=1000.0)
+        record_ttft(gm, 0.05, 30, model="strict")  # fine for defaults...
+        eng.tick(now=1004.0)
+        # ...but the strict model's 10ms threshold marks them all bad.
+        assert eng.state("strict", "ttft") == eng.FAST_BURN
+        assert "tpot" not in eng.debug_payload()["models"]["strict"]
+
+
+def build_proxy(tmp_path=None, **proxy_kwargs):
+    pod = Pod("pod-a", "127.0.0.1:1")
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name="pool"))
+    ds.store_model(make_model("m"))
+    provider = StaticProvider([PodMetrics(pod=pod, metrics=fake_metrics())])
+    scheduler = Scheduler(provider, token_aware=False, prefill_aware=False)
+    if tmp_path is not None:
+        proxy_kwargs.setdefault("blackbox_dir", str(tmp_path / "blackbox"))
+    proxy_kwargs.setdefault("slo_cfg", slo.SLOConfig(**TEST_CFG))
+    return GatewayProxy(Server(scheduler, ds), provider, ds, **proxy_kwargs)
+
+
+class TestBreachEndToEnd:
+    def test_breach_writes_blackbox_and_report_renders(self, tmp_path):
+        """The acceptance path: synthetic breach -> fast-burn transition ->
+        slo_transition + breach_dump events -> dump file -> blackbox_report
+        renders a timeline naming the breach."""
+        import tools.blackbox_report as blackbox_report
+
+        import time as time_mod
+
+        proxy = build_proxy(tmp_path)
+        # Span stamps use the REAL clock: the dump's written_at does too,
+        # and the report's timeline window is relative to it.
+        t_now = time_mod.time()
+        proxy.tracer.record("t-bad", "gateway.upstream", t_now - 5.0,
+                            t_now - 1.0, pod="pod-a")
+        proxy.slo.tick(now=1000.0)
+        for _ in range(10):
+            proxy.metrics.record_phase("m", "collocated", ttft_s=0.05)
+        for _ in range(30):
+            proxy.metrics.record_phase("m", "collocated", ttft_s=5.0)
+        proxy.slo.tick(now=1004.0)
+
+        assert proxy.slo.state("m", "ttft") == proxy.slo.FAST_BURN
+        kinds = {e["kind"] for e in proxy.journal.events(limit=100)}
+        assert events.SLO_TRANSITION in kinds
+        assert events.BREACH_DUMP in kinds
+
+        dumps = list((tmp_path / "blackbox").glob("blackbox-*.json"))
+        assert len(dumps) == 1
+        dump = json.loads(dumps[0].read_text())
+        assert dump["format"] == "lig-blackbox/1"
+        assert dump["reason"]["model"] == "m"
+        assert dump["reason"]["objective"] == "ttft"
+        # The dump embeds the journal, the trace ring, and the exposition.
+        assert any(e["kind"] == events.SLO_TRANSITION
+                   for e in dump["events"]["events"])
+        assert any(t["trace_id"] == "t-bad" for t in dump["traces"])
+        assert "gateway_slo_burn_rate" in dump["metrics_text"]
+
+        report = blackbox_report.render_report(dump, window_s=3600.0)
+        assert "fast_burn" in report
+        assert "model=m objective=ttft" in report
+        assert "slo_transition" in report
+        assert "t-bad" in report  # the trace made the timeline
+
+    def test_dump_cooldown(self, tmp_path):
+        proxy = build_proxy(tmp_path)
+        proxy.slo.tick(now=1000.0)
+        for _ in range(30):
+            proxy.metrics.record_phase("m", "collocated", ttft_s=5.0)
+            proxy.metrics.record_phase("m2", "collocated", ttft_s=5.0)
+        # Both models breach in one tick: the cooldown admits one dump.
+        proxy.slo.tick(now=1004.0)
+        assert len(list((tmp_path / "blackbox").glob("*.json"))) == 1
+
+    def test_failed_dump_does_not_consume_cooldown(self, tmp_path):
+        """An unwritable dump dir must leave the cooldown unarmed so the
+        next breach tick retries before the pre-incident journal rotates
+        out (the cooldown stamps only on SUCCESS)."""
+        (tmp_path / "blackbox").write_text("a file, not a dir")
+        proxy = build_proxy(tmp_path)
+        proxy.slo.tick(now=1000.0)
+        for _ in range(30):
+            proxy.metrics.record_phase("m", "collocated", ttft_s=5.0)
+        proxy.slo.tick(now=1004.0)  # fast burn; dump write raises OSError
+        assert proxy.slo.state("m", "ttft") == proxy.slo.FAST_BURN
+        assert not any(e["kind"] == events.BREACH_DUMP
+                       for e in proxy.journal.events(limit=100))
+        assert proxy._last_dump_t == 0.0  # retry stays armed
+        assert proxy._dump_inflight is False
+
+
+class TestDebugEndpoints:
+    def test_debug_slo_health_events_endpoints(self, tmp_path):
+        async def run():
+            proxy = build_proxy(tmp_path)
+            for _ in range(20):
+                proxy.metrics.record_phase("m", "collocated", ttft_s=0.05)
+            proxy.journal.emit(events.PICK, trace_id="t1", pod="pod-a")
+            client = TestClient(TestServer(proxy.build_app()))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/slo")
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["models"]["m"]["ttft"]["total"] == 20
+                assert body["models"]["m"]["ttft"]["state"] == "ok"
+
+                resp = await client.get("/debug/health")
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["pods"]["pod-a"]["state"] == "healthy"
+                assert body["would_avoid_total"] == 0
+
+                resp = await client.get("/debug/events?kind=pick")
+                body = await resp.json()
+                assert [e["trace_id"] for e in body["events"]] == ["t1"]
+                # Incremental cursor: nothing newer than seq.
+                resp = await client.get(
+                    f"/debug/events?since={body['seq']}")
+                assert (await resp.json())["events"] == []
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_metrics_page_carries_slo_families(self, tmp_path):
+        async def run():
+            proxy = build_proxy(tmp_path)
+            for _ in range(20):
+                proxy.metrics.record_phase("m", "collocated", ttft_s=0.05)
+            proxy.slo.tick(now=1000.0)
+            proxy.slo.tick(now=1004.0)
+            client = TestClient(TestServer(proxy.build_app()))
+            await client.start_server()
+            try:
+                text = await (await client.get("/metrics")).text()
+            finally:
+                await client.close()
+            assert "gateway_slo_compliance_ratio{model=\"m\"" in text
+            assert "gateway_slo_burn_rate{model=\"m\"" in text
+            assert "gateway_events_total" in text
+
+        asyncio.run(run())

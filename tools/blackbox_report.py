@@ -1,0 +1,149 @@
+"""Post-mortem timeline from a black-box dump.
+
+The gateway writes a dump (``slo.write_blackbox``) the moment any SLO
+objective enters fast burn: flight-recorder events, recent traces, the SLO
+and health debug payloads, and the raw /metrics text, all in one JSON file.
+This tool renders it into the narrative an on-caller actually reads —
+"what was the system doing in the 30 seconds before the breach?":
+
+- the breach reason (model, objective, burn rates per window),
+- SLO compliance/state per model-objective at dump time,
+- per-replica health scores, states, and streaks,
+- a merged chronological timeline of journal events and trace spans
+  leading up to the dump (``--window`` seconds, default 60).
+
+Usage:
+  python tools/blackbox_report.py /tmp/lig-blackbox/blackbox-*.json
+  python tools/blackbox_report.py dump.json --window 30
+  python tools/blackbox_report.py dump.json --json   # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_ts(ts: float, t0: float) -> str:
+    """Absolute clock + offset relative to the dump instant (negative =
+    before the breach)."""
+    clock = time.strftime("%H:%M:%S", time.gmtime(ts))
+    return f"{clock} ({ts - t0:+7.2f}s)"
+
+
+def _event_line(e: dict, t0: float) -> str:
+    attrs = e.get("attrs") or {}
+    detail = " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+    trace = f" trace={e['trace_id']}" if e.get("trace_id") else ""
+    return (f"  {_fmt_ts(e['ts'], t0)}  EVENT {e['kind']:<18}"
+            f"{trace}  {detail}".rstrip())
+
+
+def _span_rows(traces: list, t0: float, window_s: float) -> list[tuple]:
+    rows = []
+    for t in traces or []:
+        for span in t.get("spans", []):
+            if span["end"] < t0 - window_s:
+                continue
+            rows.append((span["start"],
+                         f"  {_fmt_ts(span['start'], t0)}  SPAN  "
+                         f"{span['name']:<18} trace={t['trace_id']} "
+                         f"dur={1e3 * (span['end'] - span['start']):.1f}ms "
+                         f"status={t.get('status', '')}"))
+    return rows
+
+
+def timeline(dump: dict, window_s: float = 60.0) -> list[str]:
+    """Merged event+span rows inside the pre-breach window, oldest first."""
+    t0 = float(dump.get("written_at") or 0.0)
+    rows: list[tuple] = []
+    events = (dump.get("events") or {}).get("events", [])
+    for e in events:
+        if e["ts"] >= t0 - window_s:
+            rows.append((e["ts"], _event_line(e, t0)))
+    rows += _span_rows(dump.get("traces"), t0, window_s)
+    rows.sort(key=lambda r: r[0])
+    return [line for _, line in rows]
+
+
+def render_report(dump: dict, window_s: float = 60.0) -> str:
+    reason = dump.get("reason") or {}
+    lines = [
+        "=" * 72,
+        "BLACK-BOX POST-MORTEM "
+        f"(written {time.strftime('%Y-%m-%d %H:%M:%SZ', time.gmtime(float(dump.get('written_at') or 0)))})",
+        "=" * 72,
+        "",
+        f"Trigger : {reason.get('trigger', '?')} on "
+        f"model={reason.get('model', '?')} "
+        f"objective={reason.get('objective', '?')}",
+        f"Burns   : {json.dumps(reason.get('burns', {}))}",
+        "",
+    ]
+    slo = dump.get("slo") or {}
+    if slo.get("models"):
+        lines.append("SLO state at dump time:")
+        for model in sorted(slo["models"]):
+            for objective, o in sorted(slo["models"][model].items()):
+                burns = {k: v for k, v in
+                         (o.get("burn_rates") or {}).items()
+                         if v is not None}
+                lines.append(
+                    f"  {model}/{objective:<11} state={o.get('state'):<10}"
+                    f" compliance={o.get('compliance')}"
+                    f" good/total={o.get('good')}/{o.get('total')}"
+                    f" burns={json.dumps(burns)}")
+        lines.append("")
+    health = dump.get("health") or {}
+    if health.get("pods"):
+        lines.append("Replica health at dump time:")
+        for pod in sorted(health["pods"]):
+            p = health["pods"][pod]
+            lines.append(
+                f"  {pod:<20} score={p.get('score')} "
+                f"state={p.get('state'):<10}"
+                f" err_streak={p.get('upstream_error_streak', 0)}"
+                f" handoff_streak={p.get('handoff_failure_streak', 0)}"
+                f" would_avoid={p.get('would_avoid', 0)}")
+        wa = health.get("would_avoid_total")
+        if wa is not None:
+            lines.append(f"  would-avoid picks (log-only): {wa}")
+        lines.append("")
+    counts = (dump.get("events") or {}).get("counts") or {}
+    if counts:
+        lines.append("Event counts (cumulative): " + ", ".join(
+            f"{k}={counts[k]}" for k in sorted(counts)))
+        lines.append("")
+    lines.append(f"Timeline (last {window_s:.0f}s before the dump):")
+    rows = timeline(dump, window_s)
+    lines += rows if rows else ["  (no events or spans in the window)"]
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render a post-mortem timeline from a black-box dump")
+    parser.add_argument("dump", help="dump file path, or - for stdin")
+    parser.add_argument("--window", type=float, default=60.0,
+                        help="seconds of pre-breach timeline to show")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the merged timeline as JSON rows")
+    args = parser.parse_args(argv)
+    if args.dump == "-":
+        dump = json.load(sys.stdin)
+    else:
+        with open(args.dump) as f:
+            dump = json.load(f)
+    if args.json:
+        print(json.dumps({"reason": dump.get("reason"),
+                          "timeline": timeline(dump, args.window)}, indent=1))
+    else:
+        print(render_report(dump, args.window))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Generate docs/METRICS.md from the metric registry.
+
+Usage: ``python tools/gen_metrics_docs.py [output-path]`` (default
+``docs/METRICS.md``; ``make metrics-docs`` is the canonical entry point).
+``tests/test_metrics_docs.py`` asserts the committed file matches the
+registry, so adding a metric family means updating
+``llm_instance_gateway_tpu/metrics_registry.py`` and re-running this.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_instance_gateway_tpu.metrics_registry import render_markdown  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out = argv[0] if argv else "docs/METRICS.md"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(render_markdown())
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
