@@ -2,13 +2,16 @@
 
 IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
 
-.PHONY: test test-e2e native bench loadgen sim metrics-docs docker-build install deploy undeploy fmt
+.PHONY: test test-e2e chaos native bench loadgen sim metrics-docs docker-build install deploy undeploy fmt
 
 test:            ## unit + integration tests (CPU, virtual 8-device mesh)
 	python -m pytest tests/ -q -m "not e2e"
 
 test-e2e:        ## full local stack: server + gateway + sidecar as processes
 	python -m pytest tests/test_e2e_local.py -q -m e2e
+
+chaos:           ## seeded fault-injection scenarios vs the in-process stack
+	python tools/chaos.py --seed 0 --scenario all
 
 native:          ## build the C++ scheduler hot path
 	$(MAKE) -C llm_instance_gateway_tpu/native

@@ -451,6 +451,72 @@ def run_pick_microbench(n: int = 4000, n_pods: int = 64,
     }
 
 
+def run_policy_microbench(n: int = 4000, n_pods: int = 64) -> dict:
+    """Health-policy enforcement cost A/B (robustness PR acceptance bar:
+    ``pick_policy_ratio`` <= 1.05 — enforcing ``health_policy=avoid``
+    costs < 5% of a pick vs ``log_only``).
+
+    Same harness shape as ``run_pick_microbench``: a real Python
+    filter-tree scheduler over a static fleet, with a REAL ResiliencePlane
+    advisor attached on both sides — log_only pays only the note_pick
+    count, avoid additionally runs ``filter_by_policy`` over the survivor
+    set (one degraded pod in the fleet so the filter actually filters).
+    Interleaved runs, MIN per side (contended cores swing single runs 2x).
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway import health, resilience
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod,
+    )
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    provider = StaticProvider([
+        PodMetrics(pod=fake_pod(i),
+                   metrics=fake_metrics(queue=i % 5, kv=(i % 10) / 10.0))
+        for i in range(n_pods)
+    ])
+    req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                     prompt_tokens=25, criticality="Critical")
+
+    def make_side(policy: str):
+        plane = resilience.ResiliencePlane(
+            health.HealthScorer(provider=provider),
+            cfg=resilience.ResilienceConfig(health_policy=policy))
+        plane.health.update()
+        # Degrade ONE pod so avoid-mode filtering does real work.
+        for _ in range(8):
+            plane.health.record_upstream("pod-0", ok=False)
+        plane.health.update()
+        plane.health.update()
+        sched = Scheduler(provider, prefix_aware=False,
+                          rng=random_mod.Random(0))
+        sched.health_advisor = plane
+        return sched
+
+    log_only, avoid = make_side("log_only"), make_side("avoid")
+
+    def loop(sched) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sched.schedule(req)
+        return time.perf_counter() - t0
+
+    loop(log_only), loop(avoid)  # warmup pair
+    base_best = avoid_best = float("inf")
+    for _ in range(12):
+        base_best = min(base_best, loop(log_only))
+        avoid_best = min(avoid_best, loop(avoid))
+    return {
+        "pick_policy_log_only_us": round(base_best / n * 1e6, 2),
+        "pick_policy_avoid_us": round(avoid_best / n * 1e6, 2),
+        "pick_policy_ratio": round(avoid_best / base_best, 4),
+    }
+
+
 def _collect_handoff_metrics(timeout_s: float = 300.0) -> None:
     """Run the disaggregation phase in a CPU subprocess BEFORE the device
     claim (it must not touch — or wait for — the TPU relay) and merge its
@@ -821,6 +887,12 @@ if __name__ == "__main__":
             results.update(run_pick_microbench())
         except Exception as e:  # additive phase: never block the handoff line
             results["pick_error"] = str(e)[:200]
+        try:
+            # Resilience microbench (robustness PR): enforcement cost of
+            # health_policy=avoid vs log_only rides every emission.
+            results.update(run_policy_microbench())
+        except Exception as e:
+            results["pick_policy_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

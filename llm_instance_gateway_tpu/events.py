@@ -42,6 +42,13 @@ SCRAPE_FAILURE = "scrape_failure"       # metrics scrape of a pod failed
 SLO_TRANSITION = "slo_transition"       # objective entered/left a burn state
 HEALTH_TRANSITION = "health_transition"  # pod health state changed
 BREACH_DUMP = "breach_dump"             # black-box dump written
+CIRCUIT_TRANSITION = "circuit_transition"  # per-pod breaker state changed
+RETRY = "retry"                         # proxy retried a failed attempt
+HEDGE = "hedge"                         # TTFT hedge fired (and its outcome)
+POLICY_ESCAPE = "policy_escape"         # avoid-policy last-resort pick
+CLIENT_DISCONNECT = "client_disconnect"  # client dropped a live stream
+KV_RELEASE = "kv_release"               # abandoned handoff KV released
+FAULT_INJECT = "fault_inject"           # chaos harness applied a fault
 
 
 class EventJournal:

@@ -525,6 +525,62 @@ def add_common_args(parser) -> None:
     parser.add_argument("-v", "--verbose", action="count", default=0)
 
 
+def add_resilience_args(parser) -> None:
+    """Failure-policy flags for the HTTP proxy transport
+    (gateway/resilience.py; defaults mirror ResilienceConfig)."""
+    from llm_instance_gateway_tpu.gateway.resilience import (
+        HEALTH_POLICIES,
+        ResilienceConfig,
+    )
+
+    d = ResilienceConfig()
+    parser.add_argument("--health-policy", choices=list(HEALTH_POLICIES),
+                        default=d.health_policy,
+                        help="pick-seam enforcement: log_only counts "
+                             "would-avoid picks only (routing unchanged); "
+                             "avoid deprioritizes degraded/unhealthy/"
+                             "circuit-open replicas with a last-resort "
+                             "escape hatch; strict sheds instead")
+    parser.add_argument("--connect-timeout-s", type=float,
+                        default=d.connect_timeout_s,
+                        help="upstream TCP connect timeout (0 = unbounded)")
+    parser.add_argument("--ttft-timeout-s", type=float,
+                        default=d.ttft_timeout_s,
+                        help="time allowed until the first upstream "
+                             "response byte (SSE: first chunk; JSON: "
+                             "response headers). 0 = unbounded")
+    parser.add_argument("--stream-idle-timeout-s", type=float,
+                        default=d.stream_idle_timeout_s,
+                        help="max gap between SSE chunks / body reads "
+                             "(0 = unbounded)")
+    parser.add_argument("--max-retries", type=int, default=d.max_retries,
+                        help="retry attempts per request for idempotent "
+                             "failures (budgeted globally)")
+    parser.add_argument("--retry-budget-ratio", type=float,
+                        default=d.retry_budget_ratio,
+                        help="retry tokens earned per primary request "
+                             "(caps retry volume as a traffic fraction)")
+    parser.add_argument("--hedge-ttft-s", type=float, default=d.hedge_ttft_s,
+                        help="hedge non-streaming requests when no "
+                             "response within this many seconds "
+                             "(0 = disabled)")
+
+
+def resilience_from_args(args):
+    """Build a ResilienceConfig from ``add_resilience_args`` flags."""
+    from llm_instance_gateway_tpu.gateway.resilience import ResilienceConfig
+
+    return ResilienceConfig(
+        health_policy=args.health_policy,
+        connect_timeout_s=args.connect_timeout_s,
+        ttft_timeout_s=args.ttft_timeout_s,
+        stream_idle_timeout_s=args.stream_idle_timeout_s,
+        max_retries=args.max_retries,
+        retry_budget_ratio=args.retry_budget_ratio,
+        hedge_ttft_s=args.hedge_ttft_s,
+    )
+
+
 def components_from_args(args) -> "GatewayComponents | MultiPoolComponents":
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -1,0 +1,362 @@
+"""Deterministic fault injection for the robustness plane.
+
+``tests/test_resilience.py`` and ``tools/chaos.py`` need to *cause* the
+failures the resilience plane (gateway/resilience.py) exists to absorb —
+reproducibly, from a seed, against both the in-process test stack and the
+real 3-process e2e stack.  This module is that harness:
+
+- ``FaultSpec``/``FaultSchedule``: a declarative, time-windowed schedule of
+  faults (replica blackhole, slow-TTFT brownout, injected error statuses,
+  mid-stream disconnect, scrape flap, handoff failure).  Schedules are
+  plain data — JSON-serializable for the e2e path — and ``arm()`` pins the
+  schedule's t0, so a given schedule replays identically.
+- ``aiohttp_middleware``: applied by the REAL model server (``api_http``)
+  when the ``LIG_FAULTS`` env var names a schedule file — the e2e chaos
+  stack injects faults into actual serving processes without forking the
+  server code.
+- ``make_chaos_app``: a minimal OpenAI-shaped fake upstream whose handlers
+  consult the schedule — the in-process stack (no subprocesses, no model)
+  that ``tools/chaos.py`` drives and the fast resilience tests use.
+- ``ChaosProvider``: a StaticProvider whose ``scrape_health`` flaps per the
+  schedule, for the scrape-flap scenario (that fault lives on the
+  gateway's scrape plane, not the HTTP data path).
+
+Fault kinds (``FaultSpec.kind``):
+
+====================  ====================================================
+``blackhole``         handler hangs (connect succeeds, no bytes follow)
+``brownout``          handler sleeps ``delay_s`` before answering
+``error``             handler answers ``status`` (default 500) immediately
+``midstream_disconnect``  SSE stream cut after ``after_chunks`` chunks
+``scrape_flap``       pod's metrics scrapes fail (ChaosProvider only)
+``handoff_failure``   ``/v1/prefill`` / ``/v1/attach`` fail (``mode``:
+                      ``error`` -> 500, ``disconnect`` -> transport cut)
+====================  ====================================================
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+
+from aiohttp import web
+
+BLACKHOLE = "blackhole"
+BROWNOUT = "brownout"
+ERROR = "error"
+MIDSTREAM_DISCONNECT = "midstream_disconnect"
+SCRAPE_FLAP = "scrape_flap"
+HANDOFF_FAILURE = "handoff_failure"
+FAULT_KINDS = (BLACKHOLE, BROWNOUT, ERROR, MIDSTREAM_DISCONNECT,
+               SCRAPE_FLAP, HANDOFF_FAILURE)
+
+# Default path scope per kind (overridable via params["paths"]).
+_COMPLETION_PATHS = ("/v1/completions", "/v1/chat/completions")
+_KIND_PATHS = {
+    HANDOFF_FAILURE: ("/v1/prefill", "/v1/attach"),
+}
+# How long a blackholed handler hangs per request before giving up with a
+# 503 — long enough that every sane TTFT timeout fires first, short enough
+# that a harness teardown never waits minutes on stragglers.
+_BLACKHOLE_HANG_S = 30.0
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One fault window.  ``pod=""`` matches every pod; times are seconds
+    relative to ``FaultSchedule.arm()``."""
+
+    kind: str
+    pod: str = ""
+    start_s: float = 0.0
+    duration_s: float = 1e9
+    params: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(expected one of {FAULT_KINDS})")
+
+    def paths(self) -> tuple:
+        return tuple(self.params.get(
+            "paths", _KIND_PATHS.get(self.kind, _COMPLETION_PATHS)))
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "pod": self.pod, "start_s": self.start_s,
+                "duration_s": self.duration_s, "params": dict(self.params)}
+
+
+class FaultSchedule:
+    """A set of fault windows on one clock.  ``arm()`` pins t0 (idempotent:
+    the first arm wins, so middleware and harness share one origin)."""
+
+    def __init__(self, faults: list[FaultSpec], seed: int = 0, clock=time.time):
+        self.faults = list(faults)
+        self.seed = seed
+        self._clock = clock
+        self._t0: float | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict, clock=time.time) -> "FaultSchedule":
+        faults = [FaultSpec(kind=f["kind"], pod=f.get("pod", ""),
+                            start_s=float(f.get("start_s", 0.0)),
+                            duration_s=float(f.get("duration_s", 1e9)),
+                            params=dict(f.get("params", {})))
+                  for f in d.get("faults", [])]
+        return cls(faults, seed=int(d.get("seed", 0)), clock=clock)
+
+    @classmethod
+    def from_file(cls, path: str) -> "FaultSchedule":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "faults": [f.to_dict() for f in self.faults]}
+
+    def arm(self, now: float | None = None) -> None:
+        if self._t0 is None:
+            self._t0 = self._clock() if now is None else now
+
+    def elapsed(self, now: float | None = None) -> float:
+        if self._t0 is None:
+            self.arm(now)
+        return (self._clock() if now is None else now) - self._t0
+
+    def active(self, pod: str = "", path: str | None = None,
+               kind: str | None = None,
+               now: float | None = None) -> FaultSpec | None:
+        """The first fault window covering (pod, path, kind) right now."""
+        t = self.elapsed(now)
+        for f in self.faults:
+            if kind is not None and f.kind != kind:
+                continue
+            if f.pod and pod and f.pod != pod:
+                continue
+            if path is not None and path not in f.paths():
+                continue
+            if f.start_s <= t < f.start_s + f.duration_s:
+                return f
+        return None
+
+    def inject_now(self, kind: str, pod: str = "", duration_s: float = 1e9,
+                   **params) -> FaultSpec:
+        """Append a fault window opening at the current schedule time —
+        harness phases ('warm up clean, then break pod X') stay explicit
+        instead of guessing wall-clock offsets."""
+        spec = FaultSpec(kind, pod=pod, start_s=self.elapsed(),
+                         duration_s=duration_s, params=params)
+        self.faults.append(spec)
+        return spec
+
+    def remaining(self, spec: FaultSpec, now: float | None = None) -> float:
+        return max(0.0, spec.start_s + spec.duration_s - self.elapsed(now))
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"{f.kind}(pod={f.pod or '*'}, t=[{f.start_s:g},"
+            f"{f.start_s + min(f.duration_s, 9e8):g}))"
+            for f in self.faults) or "empty"
+
+
+async def _apply_http_fault(schedule: FaultSchedule, spec: FaultSpec,
+                            request: web.Request, journal=None):
+    """Apply one data-path fault inside an aiohttp handler/middleware.
+    Returns a Response to short-circuit with, or None to proceed normally
+    (brownout: after its delay)."""
+    if journal is not None:
+        journal.emit("fault_inject", fault=spec.kind, path=request.path,
+                     pod=spec.pod)
+    if spec.kind == BLACKHOLE:
+        await asyncio.sleep(min(_BLACKHOLE_HANG_S,
+                                schedule.remaining(spec) + 1.0))
+        return web.Response(status=503, text="blackhole fault elapsed")
+    if spec.kind == BROWNOUT:
+        await asyncio.sleep(float(spec.params.get("delay_s", 1.0)))
+        return None
+    if spec.kind == ERROR:
+        return web.Response(status=int(spec.params.get("status", 500)),
+                            text="injected fault")
+    if spec.kind == HANDOFF_FAILURE:
+        if spec.params.get("mode", "error") == "disconnect":
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError("injected handoff disconnect")
+        return web.Response(status=int(spec.params.get("status", 500)),
+                            text="injected handoff fault")
+    # MIDSTREAM_DISCONNECT is applied inside the streaming handler (the
+    # middleware can't truncate a live SSE relay) — pass through here.
+    return None
+
+
+def aiohttp_middleware(schedule: FaultSchedule, journal=None):
+    """Middleware for the REAL model server: consult the schedule before
+    every ``/v1/*`` handler.  Mid-stream disconnects are approximated by
+    closing the transport ``after_s`` seconds into the request."""
+    schedule.arm()
+
+    @web.middleware
+    async def fault_middleware(request: web.Request, handler):
+        if not request.path.startswith("/v1/"):
+            return await handler(request)
+        spec = schedule.active(path=request.path)
+        if spec is None:
+            return await handler(request)
+        if spec.kind == MIDSTREAM_DISCONNECT:
+            loop = asyncio.get_running_loop()
+            transport = request.transport
+
+            def cut():
+                if transport is not None:
+                    transport.close()
+
+            loop.call_later(float(spec.params.get("after_s", 0.2)), cut)
+            if journal is not None:
+                journal.emit("fault_inject", fault=spec.kind,
+                             path=request.path)
+            return await handler(request)
+        short = await _apply_http_fault(schedule, spec, request, journal)
+        return short if short is not None else await handler(request)
+
+    return fault_middleware
+
+
+def make_chaos_app(name: str, schedule: FaultSchedule,
+                   state: dict | None = None) -> web.Application:
+    """A minimal OpenAI-shaped fake upstream for the in-process chaos
+    stack: echoes which pod served, supports SSE streaming, the
+    disaggregation hops, and the release endpoint — every handler gated by
+    the fault schedule.  ``state`` (optional) collects observations the
+    harness asserts on (served counts, release calls)."""
+    state = state if state is not None else {}
+    state.setdefault("served", 0)
+    state.setdefault("released", [])
+
+    def _note_served():
+        state["served"] += 1
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        spec = schedule.active(pod=name, path=request.path)
+        if spec is not None and spec.kind != MIDSTREAM_DISCONNECT:
+            short = await _apply_http_fault(schedule, spec, request)
+            if short is not None:
+                return short
+        body = await request.json()
+        stream = bool(body.get("stream"))
+        usage = {"prompt_tokens": 4, "completion_tokens": 4,
+                 "total_tokens": 8}
+        if not stream:
+            _note_served()
+            return web.json_response({
+                "id": "cmpl-1", "object": "text_completion",
+                "model": body.get("model", "m"), "served_by": name,
+                "choices": [{"index": 0, "text": "ok",
+                             "finish_reason": "stop"}],
+                "usage": usage, "ttft_ms": 1.0,
+            })
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        cut_after = None
+        if spec is not None and spec.kind == MIDSTREAM_DISCONNECT:
+            cut_after = int(spec.params.get("after_chunks", 2))
+        for i in range(4):
+            if cut_after is not None and i >= cut_after:
+                request.transport.close()
+                return resp
+            chunk = {"choices": [{"index": 0, "text": f"t{i}"}]}
+            if i == 3:
+                chunk["usage"] = usage
+            await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            await asyncio.sleep(0.01)
+        await resp.write(b"data: [DONE]\n\n")
+        _note_served()
+        return resp
+
+    async def prefill(request: web.Request) -> web.Response:
+        spec = schedule.active(pod=name, path=request.path)
+        if spec is not None:
+            short = await _apply_http_fault(schedule, spec, request)
+            if short is not None:
+                return short
+        await request.read()
+        return web.Response(
+            body=b"FAKE-HANDOFF",
+            content_type="application/octet-stream",
+            headers={"x-request-id": f"eng-{name}-{state['served']}"})
+
+    async def attach(request: web.Request) -> web.Response:
+        spec = schedule.active(pod=name, path=request.path)
+        if spec is not None:
+            short = await _apply_http_fault(schedule, spec, request)
+            if short is not None:
+                return short
+        await request.read()
+        _note_served()
+        return web.json_response({
+            "id": "cmpl-a", "object": "text_completion", "served_by": name,
+            "choices": [{"index": 0, "text": "ok", "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 4, "completion_tokens": 4,
+                      "total_tokens": 8},
+            "ttft_ms": 1.0,
+        })
+
+    async def release(request: web.Request) -> web.Response:
+        body = await request.json()
+        state["released"].append(body.get("request_id"))
+        return web.json_response({"request_id": body.get("request_id"),
+                                  "released": True})
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", completions)
+    app.router.add_post("/v1/prefill", prefill)
+    app.router.add_post("/v1/attach", attach)
+    app.router.add_post("/v1/prefill/release", release)
+    return app
+
+
+class ChaosProvider:
+    """StaticProvider shape whose ``scrape_health`` flaps per the
+    schedule — the scrape-flap fault lives on the gateway's metrics plane,
+    not the HTTP data path."""
+
+    def __init__(self, pod_metrics: list, schedule: FaultSchedule,
+                 clock=time.time, flap_step: int = 5):
+        self._pm = list(pod_metrics)
+        self.schedule = schedule
+        self._clock = clock
+        # Failure-streak growth per scrape_health call: the real scrape
+        # loop runs ~100x faster than the health tick, so one health-tick
+        # observation of a flapping pod sees a multi-failure streak.
+        self.flap_step = flap_step
+        self._last_ok: dict[str, float] = {}
+        self._streak: dict[str, int] = {}
+
+    def all_pod_metrics(self) -> list:
+        return list(self._pm)
+
+    def get_pod_metrics(self, pod_name: str):
+        for pm in self._pm:
+            if pm.pod.name == pod_name:
+                return pm
+        return None
+
+    def scrape_health(self) -> dict:
+        """Each call is one scrape round: flapped pods extend their failure
+        streak, clean pods stamp fresh success."""
+        now = self._clock()
+        out = {}
+        for pm in self._pm:
+            name = pm.pod.name
+            if self.schedule.active(pod=name, kind=SCRAPE_FLAP,
+                                    path=None) is not None:
+                self._streak[name] = (self._streak.get(name, 0)
+                                      + self.flap_step)
+            else:
+                self._streak[name] = 0
+                self._last_ok[name] = now
+            out[name] = (self._last_ok.get(name), self._streak.get(name, 0))
+        return out

@@ -9,11 +9,12 @@ individually is too noisy to act on; fused and hysteresis-filtered they
 identify the ONE replica in a pool that is quietly degrading (CaraServe's
 rank-aware serving presumes exactly this attribution).
 
-This PR is deliberately **log-only**: the scheduler reads the state ONLY to
-count would-be avoidance decisions (``tpu:health_would_avoid_total``), so
-routing stays byte-identical to pre-PR behavior and tier-1 stays
-deterministic.  A later PR can flip the counter into a filter once the
-score's false-positive rate is measured in the field.
+The scorer itself stays policy-free: ``note_pick`` only counts would-be
+avoidance decisions (``tpu:health_would_avoid_total``).  Enforcement lives
+in ``gateway/resilience.py``: with ``health_policy=log_only`` (the default)
+routing stays byte-identical to the scorer-less scheduler; ``avoid``/
+``strict`` read ``state()`` through the ResiliencePlane advisor and steer
+picks off non-healthy replicas.
 
 Score composition (weighted mean of components, each clamped to [0, 1]):
 
@@ -111,6 +112,8 @@ class HealthScorer:
         self._components: dict[str, dict] = {}
         self._states: dict[str, str] = {}
         self._pending: dict[str, tuple[str, int]] = {}  # candidate, streak
+        # Cached non-healthy set for the pick seam (rebuilt in update()).
+        self._non_healthy: frozenset = frozenset()
         self.last_update = 0.0
         # Log-only scheduler hook.
         self.would_avoid_total = 0
@@ -236,6 +239,8 @@ class HealthScorer:
                           self.would_avoid):
                 for name in [n for n in table if n not in live]:
                     del table[name]
+            self._non_healthy = frozenset(
+                n for n, s in self._states.items() if s != HEALTHY)
         for name, frm, to, score in transitions:
             log = logger.warning if to != HEALTHY else logger.info
             log("pod %s health: %s -> %s (score %.3f)", name, frm, to, score)
@@ -288,10 +293,20 @@ class HealthScorer:
         with self._lock:
             return self._states.get(pod_name, HEALTHY)
 
+    def non_healthy(self) -> frozenset:
+        """Pods currently degraded/unhealthy.  Returns the cached
+        frozenset maintained by ``update()`` — the enforcing pick seam
+        reads this per request, and a rebuild (or even a lock) per pick
+        would bust the <5% enforcement budget.  States only change inside
+        ``update()``, so the cache cannot go stale between ticks."""
+        return self._non_healthy
+
     def note_pick(self, pod_name: str) -> None:
-        """Scheduler pick seam, LOG-ONLY this release: count (and debug-log)
-        picks that health-aware routing would have steered elsewhere.  Must
-        never influence the pick — no RNG, no exceptions, no filtering."""
+        """Scheduler pick seam: count (and debug-log) picks landing on a
+        non-healthy replica.  Must never influence the pick — no RNG, no
+        exceptions, no filtering — so ``health_policy=log_only`` routing
+        stays byte-identical (enforcement is ``filter_by_policy``'s job,
+        upstream of the draw)."""
         with self._lock:
             st = self._states.get(pod_name, HEALTHY)
             if st == HEALTHY:
@@ -299,9 +314,8 @@ class HealthScorer:
             self.would_avoid_total += 1
             self.would_avoid[pod_name] = self.would_avoid.get(pod_name, 0) + 1
             n = self.would_avoid[pod_name]
-        logger.debug("health: pick of %s (state=%s) would be avoided "
-                     "(%d so far; routing unchanged this release)",
-                     pod_name, st, n)
+        logger.debug("health: pick of %s (state=%s) counted as would-avoid "
+                     "(%d so far)", pod_name, st, n)
 
     # -- export --------------------------------------------------------------
     def render(self) -> list[str]:

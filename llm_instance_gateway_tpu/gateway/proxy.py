@@ -22,7 +22,9 @@ Endpoints:
 - ``GET  /debug/slo`` — per-model SLO compliance + multi-window burn rates
   + burn state (gateway/slo.py), evaluated on demand.
 - ``GET  /debug/health`` — per-replica 0-1 health scores with components
-  and hysteresis states (gateway/health.py; log-only this release).
+  and hysteresis states (gateway/health.py), plus the resilience plane:
+  health policy, per-pod circuit-breaker states, retry-budget level
+  (gateway/resilience.py).
 - ``GET  /debug/events`` — the flight recorder (events.py): admission
   rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
   transitions; ``?since=<seq>`` for incremental polling.
@@ -36,6 +38,14 @@ post-mortem timeline.
 
 Every response — success or error — carries the request's ``x-lig-trace-id``
 (error bodies embed it too) so clients and the loadgen can correlate.
+
+Failure policy (gateway/resilience.py): idempotent upstream failures
+(connect errors, 503s, TTFT timeouts — anything before the first relayed
+byte) retry with decorrelated-jitter backoff under a global retry budget,
+re-running admission + pick each attempt so ``health_policy=avoid`` steers
+the re-pick off the failed replica; non-streaming requests can hedge on a
+slow TTFT; per-phase timeouts (connect / TTFT / stream-idle) replace the
+old single 3600 s client timeout.
 """
 
 from __future__ import annotations
@@ -54,6 +64,7 @@ from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
@@ -79,7 +90,7 @@ class GatewayProxy:
         handler_server: Server,
         provider,
         datastore: Datastore,
-        request_timeout_s: float = 3600.0,
+        resilience_cfg: "resilience_mod.ResilienceConfig | None" = None,
         slo_cfg: "slo_mod.SLOConfig | None" = None,
         health_cfg: "health_mod.HealthConfig | None" = None,
         blackbox_dir: str | None = None,
@@ -99,6 +110,13 @@ class GatewayProxy:
         self.journal = events_mod.EventJournal()
         self.health = health_mod.HealthScorer(
             provider=provider, cfg=health_cfg, journal=self.journal)
+        # Active robustness plane (this PR's tentpole): the enforcing
+        # health policy, per-pod circuit breakers, and the retry/hedge
+        # budget the data path below spends.  Upstream outcomes are
+        # recorded THROUGH it so the health scorer and the breaker see
+        # the same signal stream.
+        self.resilience = resilience_mod.ResiliencePlane(
+            self.health, cfg=resilience_cfg, journal=self.journal)
         self.slo = slo_mod.SLOEngine(
             self.metrics, cfg=slo_cfg, journal=self.journal,
             on_fast_burn=self._on_fast_burn)
@@ -118,15 +136,19 @@ class GatewayProxy:
         # throttled); StaticProvider and friends simply lack the attribute.
         if hasattr(provider, "journal"):
             provider.journal = self.journal
-        # Log-only would-avoid hook on the pick seam.  AdmissionController
-        # wraps the real scheduler; reach through to it.  A multi-pool
-        # front (MultiPoolServer) has no top-level scheduler — its pools'
-        # schedulers are wired by their own components; skip here.
+        # Health/resilience hook on the pick seam (log_only counts,
+        # avoid/strict enforce — gateway/resilience.py).  The
+        # AdmissionController wraps the real scheduler; reach through to
+        # it.  A multi-pool front (MultiPoolServer) has no top-level
+        # scheduler — its pools' schedulers are wired by their own
+        # components; skip here.
         outer = getattr(handler_server, "scheduler", None)
         sched = getattr(outer, "_scheduler", outer)
         if sched is not None and hasattr(sched, "health_advisor"):
-            sched.health_advisor = self.health
-        self.request_timeout_s = request_timeout_s
+            sched.health_advisor = self.resilience
+        # Strong refs to in-flight KV-release tasks (the event loop only
+        # keeps weak ones; see _spawn_release).
+        self._release_tasks: set = set()
         self._session: aiohttp.ClientSession | None = None
 
     # -- app wiring --------------------------------------------------------
@@ -146,8 +168,15 @@ class GatewayProxy:
         return app
 
     async def _on_startup(self, app) -> None:
+        # Per-phase timeouts (gateway/resilience.py) replace the old single
+        # total timeout: connect is bounded here; TTFT and idle-between-
+        # chunks are enforced per request on the data path, so a dead
+        # replica fails in seconds while a long healthy stream runs
+        # indefinitely.
+        rcfg = self.resilience.cfg
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=self.request_timeout_s)
+            timeout=aiohttp.ClientTimeout(
+                total=None, connect=rcfg.connect_timeout_s or None)
         )
         if self.obs_tick_s > 0:
             self._obs_task = asyncio.get_running_loop().create_task(
@@ -166,7 +195,7 @@ class GatewayProxy:
         while True:
             await asyncio.sleep(self.obs_tick_s)
             try:
-                self.health.update()
+                self.resilience.tick()  # health pass + breaker bookkeeping
                 self.slo.tick()
             except Exception:
                 logger.exception("observability tick failed")
@@ -239,14 +268,18 @@ class GatewayProxy:
             return None
 
     def _finish_phase(self, req_ctx, trace_id: str, path: str, t_req: float,
-                      t_first: float | None, t_last: float) -> None:
+                      t_first: float | None, t_last: float,
+                      status: str = "ok") -> None:
         """Observe a finished request into the gateway TTFT/TPOT/e2e
         histograms and stamp the trace's summary fields.
 
         ``t_first`` is the wall clock at which the FIRST generated token
         existed (stream: first data chunk; JSON: server-reported ttft or
         prefill-hop completion); TPOT spreads the remaining wall over the
-        remaining tokens.
+        remaining tokens.  ``status`` rides the trace summary (e.g.
+        ``client_disconnect`` for a partially-delivered stream — the
+        observation still lands in the histograms, so e2e percentiles see
+        the aborted request).
         """
         model = req_ctx.model or "?"
         completion = req_ctx.usage.completion_tokens
@@ -256,7 +289,7 @@ class GatewayProxy:
             tpot_s = max(0.0, t_last - t_first) / (completion - 1)
         self.metrics.record_phase(model, path, ttft_s, tpot_s,
                                   e2e_s=t_last - t_req)
-        self.tracer.annotate(trace_id, model=model, path=path, status="ok")
+        self.tracer.annotate(trace_id, model=model, path=path, status=status)
 
     async def handle_completion(self, request: web.Request) -> web.Response:
         body = await request.read()
@@ -271,130 +304,324 @@ class GatewayProxy:
                     or tracing.new_trace_id())
         req_ctx.trace_id = trace_id
         t_req = time.time()
-        t_start = time.perf_counter()
         loop = asyncio.get_running_loop()
+        rcfg = self.resilience.cfg
+        # Hedging is for non-streaming requests only (two live SSE relays
+        # for one client are unmergeable); the flag lives in the body, so
+        # parse it only when hedging is enabled at all.
+        hedge_ok = False
+        if rcfg.hedge_ttft_s > 0:
+            try:
+                hedge_ok = not json.loads(body).get("stream", False)
+            except (json.JSONDecodeError, AttributeError, UnicodeDecodeError):
+                hedge_ok = False
 
-        # Phase 1+2: headers then body, through the same core the gRPC
-        # transport uses.  Scheduling is CPU-only (no I/O) but can walk a
-        # large pool; run in executor to keep the event loop responsive.
+        # Phase 1: headers through the same core the gRPC transport uses.
         self.server.process(req_ctx, RequestHeaders(headers=dict(request.headers)))
-        try:
-            with Timer() as t:
-                result = await loop.run_in_executor(
-                    None, self.server.process, req_ctx, RequestBody(body=body)
-                )
-        except ProcessingError as e:
-            self.metrics.record_error(req_ctx.model or None,
-                                      pre_admission=True)
-            self.journal.emit(events_mod.ADMISSION_REJECT, trace_id,
-                              model=req_ctx.model or "", status=e.status,
-                              error=str(e)[:200])
-            self.tracer.record(trace_id, "gateway.admission", t_req,
-                               time.time(), error=str(e))
-            self.tracer.annotate(trace_id, model=req_ctx.model or "",
-                                 status="error")
-            kind = "invalid_request_error" if e.status == 400 else "api_error"
-            return self._error_response(e.status, str(e), kind, trace_id)
-        self.metrics.record_request(req_ctx.model or "?")
-        if result.immediate_status is not None:
-            self.metrics.record_shed(req_ctx.model or None)
-            self.journal.emit(events_mod.SHED, trace_id,
-                              model=req_ctx.model or "",
-                              status=result.immediate_status)
-            self.tracer.record(trace_id, "gateway.admission", t_req,
-                               time.time(), shed=True)
-            self.tracer.annotate(trace_id, model=req_ctx.model or "",
-                                 status="shed")
-            return self._error_response(
-                result.immediate_status,
-                "dropping request due to limited backend resources",
-                "rate_limit_exceeded", trace_id)
 
-        pod = req_ctx.target_pod
-        affinity_hit = False
-        pm = self.provider.get_pod_metrics(pod.name) if hasattr(self.provider, "get_pod_metrics") else None
-        if pm is not None:
-            affinity_hit = req_ctx.resolved_target_model in pm.metrics.active_adapters
-        self.metrics.record_pick(pod.name, t.seconds, affinity_hit)
-        # One span covers admission + scheduler pick (the pick's own cost
-        # rides as an attribute — it is also a full histogram family).
-        # Queue-wait and per-hop pick splits attribute a slow admission to
-        # admission-queue parking vs prefill-hop vs decode-hop pick cost.
-        attribution = {}
-        if req_ctx.admission_wait_s:
-            attribution["queue_wait_s"] = round(req_ctx.admission_wait_s, 6)
-        if req_ctx.pick_hops_s is not None:
-            attribution["pick_prefill_s"] = round(req_ctx.pick_hops_s[0], 6)
-            attribution["pick_decode_s"] = round(req_ctx.pick_hops_s[1], 6)
-        self.tracer.record(trace_id, "gateway.admission", t_req, time.time(),
-                           pod=pod.name, pick_s=round(t.seconds, 6),
-                           **attribution)
+        # Phase 2 + forward, as a bounded retry loop: each attempt re-runs
+        # admission + pick (so a failure recorded on the previous attempt
+        # steers the re-pick under health_policy=avoid) and one upstream
+        # forward.  Only failures where NO byte has reached the client are
+        # retried, every retry spends the global retry budget, and backoff
+        # is decorrelated jitter — retries cannot amplify an outage.
+        attempt = 0
+        backoff_s = 0.0
+        while True:
+            # Scheduling is CPU-only (no I/O) but can walk a large pool;
+            # run in executor to keep the event loop responsive.
+            try:
+                with Timer() as t:
+                    result = await loop.run_in_executor(
+                        None, self.server.process, req_ctx,
+                        RequestBody(body=body)
+                    )
+            except ProcessingError as e:
+                self.metrics.record_error(req_ctx.model or None,
+                                          pre_admission=True)
+                self.journal.emit(events_mod.ADMISSION_REJECT, trace_id,
+                                  model=req_ctx.model or "", status=e.status,
+                                  error=str(e)[:200])
+                self.tracer.record(trace_id, "gateway.admission", t_req,
+                                   time.time(), error=str(e))
+                self.tracer.annotate(trace_id, model=req_ctx.model or "",
+                                     status="error")
+                kind = ("invalid_request_error" if e.status == 400
+                        else "api_error")
+                return self._error_response(e.status, str(e), kind, trace_id)
+            if attempt == 0:
+                self.metrics.record_request(req_ctx.model or "?")
+                self.resilience.retry_budget.note_request()
+            if result.immediate_status is not None:
+                self.metrics.record_shed(req_ctx.model or None)
+                self.journal.emit(events_mod.SHED, trace_id,
+                                  model=req_ctx.model or "",
+                                  status=result.immediate_status)
+                self.tracer.record(trace_id, "gateway.admission", t_req,
+                                   time.time(), shed=True)
+                self.tracer.annotate(trace_id, model=req_ctx.model or "",
+                                     status="shed")
+                return self._error_response(
+                    result.immediate_status,
+                    "dropping request due to limited backend resources",
+                    "rate_limit_exceeded", trace_id)
 
-        # Forward to the picked replica (Envoy's ORIGINAL_DST role).
-        out_body = result.body if result.body is not None else body
-        decode_pod = getattr(req_ctx, "decode_pod", None)
-        self.journal.emit(
-            events_mod.PICK, trace_id, model=req_ctx.model or "",
-            pod=pod.name,
-            **({"decode_pod": decode_pod.name} if decode_pod else {}))
-        if decode_pod is not None:
-            # Disaggregated pick: relay prefill-hop -> handoff -> decode-hop.
-            resp = await self._disagg_forward(
-                request, pod, decode_pod, out_body, request_id, req_ctx,
-                trace_id, t_req)
+            pod = req_ctx.target_pod
+            affinity_hit = False
+            pm = (self.provider.get_pod_metrics(pod.name)
+                  if hasattr(self.provider, "get_pod_metrics") else None)
+            if pm is not None:
+                affinity_hit = (req_ctx.resolved_target_model
+                                in pm.metrics.active_adapters)
+            self.metrics.record_pick(pod.name, t.seconds, affinity_hit)
+            # One span covers admission + scheduler pick (the pick's own
+            # cost rides as an attribute — it is also a full histogram
+            # family).  Queue-wait and per-hop pick splits attribute a slow
+            # admission to admission-queue parking vs prefill-hop vs
+            # decode-hop pick cost.
+            attribution = {}
+            if req_ctx.admission_wait_s:
+                attribution["queue_wait_s"] = round(req_ctx.admission_wait_s, 6)
+            if req_ctx.pick_hops_s is not None:
+                attribution["pick_prefill_s"] = round(req_ctx.pick_hops_s[0], 6)
+                attribution["pick_decode_s"] = round(req_ctx.pick_hops_s[1], 6)
+            if attempt:
+                attribution["attempt"] = attempt
+            self.tracer.record(trace_id, "gateway.admission", t_req,
+                               time.time(), pod=pod.name,
+                               pick_s=round(t.seconds, 6), **attribution)
+
+            # Forward to the picked replica (Envoy's ORIGINAL_DST role).
+            out_body = result.body if result.body is not None else body
+            decode_pod = getattr(req_ctx, "decode_pod", None)
+            self.journal.emit(
+                events_mod.PICK, trace_id, model=req_ctx.model or "",
+                pod=pod.name,
+                **({"decode_pod": decode_pod.name} if decode_pod else {}),
+                **({"attempt": attempt} if attempt else {}))
+            if decode_pod is not None:
+                # Disaggregated pick: relay prefill -> handoff -> decode.
+                resp = await self._disagg_forward(
+                    request, pod, decode_pod, out_body, request_id, req_ctx,
+                    trace_id, t_req)
+                if resp is not None:
+                    return resp
+                # Either hop refused (draining, long prompt, unsupported
+                # params): serve single-hop on the prefill replica — every
+                # engine is complete regardless of role.
+                self.journal.emit(events_mod.DISAGG_FALLBACK, trace_id,
+                                  model=req_ctx.model or "",
+                                  prefill_pod=pod.name,
+                                  decode_pod=decode_pod.name)
+                logger.info("request=%s disaggregated path unavailable; "
+                            "single-hop on %s", request_id, pod.name)
+
+            resp, failure = await self._forward_collocated(
+                request, pod, body, out_body, request_id, req_ctx, trace_id,
+                t_req, hedge_ok=hedge_ok and decode_pod is None)
             if resp is not None:
                 return resp
-            # Either hop refused (draining, long prompt, unsupported
-            # params): serve single-hop on the prefill replica — every
-            # engine is complete regardless of role.
-            self.journal.emit(events_mod.DISAGG_FALLBACK, trace_id,
-                              model=req_ctx.model or "",
-                              prefill_pod=pod.name,
-                              decode_pod=decode_pod.name)
-            logger.info("request=%s disaggregated path unavailable; "
-                        "single-hop on %s", request_id, pod.name)
-        url = f"http://{pod.address}{request.path}"
-        t_up0 = time.time()
+
+            # Retry-eligible failure: nothing reached the client yet.
+            if (attempt >= rcfg.max_retries
+                    or not self.resilience.retry_budget.try_spend()):
+                self.metrics.record_error(req_ctx.model or None)
+                self.tracer.annotate(trace_id, status="upstream_error")
+                status = 504 if "timeout" in failure else 502
+                return self._error_response(
+                    status,
+                    f"upstream {failure} after {attempt + 1} attempt(s)",
+                    "api_error", trace_id)
+            attempt += 1
+            self.metrics.record_retry(failure)
+            self.journal.emit(events_mod.RETRY, trace_id, pod=pod.name,
+                              reason=failure, attempt=attempt)
+            backoff_s = resilience_mod.retry_backoff(
+                self.resilience.rng, backoff_s or rcfg.backoff_base_s,
+                rcfg.backoff_base_s, rcfg.backoff_cap_s)
+            await asyncio.sleep(backoff_s)
+
+    @staticmethod
+    async def _bounded(awaitable, timeout_s: float):
+        """Await with an optional bound (0 disables) — every upstream
+        await on the data path goes through a per-phase limit; an
+        unbounded hop would resurrect the hung-request failure mode the
+        per-phase timeouts exist to kill."""
+        if timeout_s and timeout_s > 0:
+            return await asyncio.wait_for(awaitable, timeout_s)
+        return await awaitable
+
+    async def _post_upstream(self, path: str, pod, out_body: bytes,
+                             request_id: str, trace_id: str):
+        """POST to one replica, bounded by the TTFT timeout: the await
+        resolves when response HEADERS are up (SSE: immediately; JSON: when
+        generation finished server-side).  Raises asyncio.TimeoutError /
+        aiohttp.ClientError for the caller to classify."""
+        ttft = self.resilience.cfg.ttft_timeout_s
+        coro = self._session.post(
+            f"http://{pod.address}{path}",
+            data=out_body,
+            headers={
+                "Content-Type": "application/json",
+                "x-request-id": request_id,
+                tracing.TRACE_HEADER: trace_id,
+                self.server.target_pod_header: pod.address,
+            },
+        )
+        return await (asyncio.wait_for(coro, ttft) if ttft > 0 else coro)
+
+    def _repick_pod(self, body: bytes, exclude: str):
+        """Scheduler re-pick for a hedge, on a throwaway context (runs in
+        the executor).  None when admission fails or the pick lands on the
+        pod already being hedged against."""
+        ctx = RequestContext()
         try:
-            async with self._session.post(
-                url,
-                data=out_body,
-                headers={
-                    "Content-Type": "application/json",
-                    "x-request-id": request_id,
-                    tracing.TRACE_HEADER: trace_id,
-                    self.server.target_pod_header: pod.address,
-                },
-            ) as upstream:
-                status = upstream.status
-                if "text/event-stream" in upstream.headers.get("Content-Type", ""):
-                    # Streamed generation: relay SSE chunks as they arrive —
-                    # buffering would defeat streaming, and usage accounting
-                    # happens from the stream's final chunk if present.
-                    return await self._relay_stream(
-                        request, upstream, pod, req_ctx,
-                        trace=(trace_id, t_req, "collocated", t_up0))
-                resp_body = await upstream.read()
-                self.tracer.record_wire(
-                    trace_id, upstream.headers.get(tracing.SPANS_HEADER))
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            self.metrics.record_error(req_ctx.model or None)
-            self.health.record_upstream(
-                pod.name, ok=False, timeout=isinstance(e, asyncio.TimeoutError))
+            result = self.server.process(ctx, RequestBody(body=body))
+        except ProcessingError:
+            return None
+        if result.immediate_status is not None or ctx.target_pod is None:
+            return None
+        return None if ctx.target_pod.name == exclude else ctx.target_pod
+
+    async def _post_with_hedge(self, request, pod, raw_body: bytes,
+                               out_body: bytes, request_id: str,
+                               trace_id: str):
+        """TTFT-based hedge: when the primary hasn't produced response
+        headers within ``hedge_ttft_s``, re-pick a different replica and
+        race a second identical request; first success wins, the loser is
+        cancelled.  Returns (upstream, winning_pod, outcome)."""
+        primary = asyncio.ensure_future(
+            self._post_upstream(request.path, pod, out_body, request_id,
+                                trace_id))
+        done, _ = await asyncio.wait(
+            {primary}, timeout=self.resilience.cfg.hedge_ttft_s)
+        if done:
+            return primary.result(), pod, None  # may raise; caller classifies
+        loop = asyncio.get_running_loop()
+        hedge_pod = await loop.run_in_executor(
+            None, self._repick_pod, raw_body, pod.name)
+        if hedge_pod is None:
+            self.metrics.record_hedge("no_candidate")
+            return (await primary), pod, None
+        self.metrics.record_hedge("fired")
+        self.journal.emit(events_mod.HEDGE, trace_id, pod=pod.name,
+                          hedge_pod=hedge_pod.name)
+        hedge = asyncio.ensure_future(
+            self._post_upstream(request.path, hedge_pod, out_body,
+                                request_id, trace_id))
+        owner = {primary: pod, hedge: hedge_pod}
+        pending = set(owner)
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            winners = [tk for tk in done
+                       if not tk.cancelled() and tk.exception() is None]
+            if not winners:
+                continue  # this round only produced failures; wait the rest
+            winner = primary if primary in winners else winners[0]
+            for tk in set(owner) - {winner}:
+                if tk.done() and not tk.cancelled():
+                    if tk.exception() is None:
+                        # The loser also answered: its success still counts
+                        # (clears streaks / half-open probe accounting).
+                        self.resilience.record_upstream(owner[tk].name,
+                                                        ok=True)
+                        tk.result().close()
+                    else:
+                        # The loser's failure still reaches the breaker.
+                        self.resilience.record_upstream(
+                            owner[tk].name, ok=False,
+                            timeout=isinstance(tk.exception(),
+                                               asyncio.TimeoutError))
+                else:
+                    tk.cancel()
+            outcome = "won" if winner is hedge else "lost"
+            self.metrics.record_hedge(outcome)
+            return winner.result(), owner[winner], outcome
+        # Both attempts failed: surface the primary's error (the caller's
+        # pod attribution matches), after recording the hedge-side failure.
+        self.metrics.record_hedge("failed")
+        self.resilience.record_upstream(
+            hedge_pod.name, ok=False,
+            timeout=isinstance(hedge.exception(), asyncio.TimeoutError))
+        raise primary.exception()
+
+    async def _forward_collocated(self, request, pod, raw_body: bytes,
+                                  out_body: bytes, request_id: str, req_ctx,
+                                  trace_id: str, t_req: float,
+                                  hedge_ok: bool = False):
+        """One single-hop forward attempt.
+
+        Returns ``(response, None)`` when a client-ready response exists
+        (success, streamed, or a passthrough non-503 upstream status), or
+        ``(None, reason)`` for a retry-eligible failure — exactly the set
+        where no byte has reached the client: connect errors, TTFT
+        timeouts, 503s, and failed non-streaming body reads.
+        """
+        rcfg = self.resilience.cfg
+        t_up0 = time.time()
+        hedge_outcome = None
+
+        def _failed(reason: str, err, timeout: bool = False):
+            self.resilience.record_upstream(pod.name, ok=False,
+                                            timeout=timeout)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id,
-                              pod=pod.name, error=str(e)[:200])
+                              pod=pod.name, reason=reason,
+                              error=str(err)[:200])
             self.tracer.record(trace_id, "gateway.upstream", t_up0,
-                               time.time(), pod=pod.name, error=str(e))
-            self.tracer.annotate(trace_id, status="upstream_error")
-            logger.warning("upstream %s failed: %s", pod.address, e)
-            return self._error_response(
-                502, f"upstream error: {e}", "api_error", trace_id)
+                               time.time(), pod=pod.name, error=str(err))
+            logger.warning("upstream %s failed (%s): %s",
+                           pod.address, reason, err)
+            return None, reason
+
+        try:
+            if hedge_ok:
+                upstream, pod, hedge_outcome = await self._post_with_hedge(
+                    request, pod, raw_body, out_body, request_id, trace_id)
+            else:
+                upstream = await self._post_upstream(
+                    request.path, pod, out_body, request_id, trace_id)
+        except asyncio.TimeoutError as e:
+            return _failed("ttft_timeout", str(e) or "ttft timeout",
+                           timeout=True)
+        except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
+            return _failed("connect", e)
+        status = upstream.status
+        try:
+            if status == 503:
+                # Draining / queue-full replica: the canonical idempotent
+                # retry case (no generation happened).
+                upstream.release()
+                return _failed("upstream_503", "upstream answered 503")
+            if "text/event-stream" in upstream.headers.get("Content-Type", ""):
+                # Streamed generation: relay SSE chunks as they arrive —
+                # buffering would defeat streaming, and usage accounting
+                # happens from the stream's final chunk if present.  A
+                # stream that dies BEFORE its first chunk comes back as a
+                # retry-eligible failure (already recorded by the relay).
+                return await self._relay_stream(
+                    request, upstream, pod, req_ctx,
+                    trace=(trace_id, t_req, "collocated", t_up0))
+            idle = rcfg.stream_idle_timeout_s
+            resp_body = await (asyncio.wait_for(upstream.read(), idle)
+                               if idle > 0 else upstream.read())
+            self.tracer.record_wire(
+                trace_id, upstream.headers.get(tracing.SPANS_HEADER))
+        except asyncio.TimeoutError as e:
+            upstream.close()
+            return _failed("read_timeout", str(e) or "body read timeout",
+                           timeout=True)
+        except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
+            upstream.close()
+            return _failed("read", e)
         t_up1 = time.time()
         # 5xx from the replica counts against its health (the server
         # answered, but wrongly); 2xx-4xx reset the error streak.
-        self.health.record_upstream(pod.name, ok=status < 500)
+        self.resilience.record_upstream(pod.name, ok=status < 500)
         self.tracer.record(trace_id, "gateway.upstream", t_up0, t_up1,
-                           pod=pod.name, status=status)
+                           pod=pod.name, status=status,
+                           **({"hedge": hedge_outcome} if hedge_outcome
+                              else {}))
 
         # Phases 3+4: response headers + usage accounting.
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
@@ -406,7 +633,7 @@ class GatewayProxy:
                 req_ctx.usage.completion_tokens,
             )
         except ProcessingError:
-            pass  # non-JSON upstream bodies (e.g. SSE streams) skip accounting
+            pass  # non-JSON upstream bodies skip accounting
 
         server_ttft = self._body_ttft_s(resp_body)
         self._finish_phase(
@@ -415,16 +642,15 @@ class GatewayProxy:
             t_last=t_up1)
         logger.info(
             "request=%s trace=%s model=%s target=%s pod=%s status=%d "
-            "prompt_tokens=%d completion_tokens=%d pick_us=%.0f total_ms=%.1f",
+            "prompt_tokens=%d completion_tokens=%d total_ms=%.1f",
             request_id, trace_id, req_ctx.model, req_ctx.resolved_target_model,
             pod.name, status, req_ctx.usage.prompt_tokens,
-            req_ctx.usage.completion_tokens,
-            t.seconds * 1e6, (time.perf_counter() - t_start) * 1e3,
+            req_ctx.usage.completion_tokens, (time.time() - t_req) * 1e3,
         )
         headers = {"x-served-by": pod.name, "x-request-id": request_id,
                    tracing.TRACE_HEADER: trace_id, **hdr_result.set_headers}
         return web.Response(body=resp_body, status=status, headers=headers,
-                            content_type="application/json")
+                            content_type="application/json"), None
 
     async def _disagg_forward(self, request: web.Request, prefill_pod,
                               decode_pod, out_body: bytes, request_id: str,
@@ -448,73 +674,109 @@ class GatewayProxy:
         """
         t_pre0 = time.time()
         hop_pod = prefill_pod  # which hop an exception below attributes to
+        engine_req_id = None  # the prefill engine's id, for abandon-release
+        rcfg = self.resilience.cfg
+        resp_obj = None  # in-flight hop response, closed on failure
         try:
-            async with self._session.post(
-                f"http://{prefill_pod.address}/v1/prefill",
-                data=out_body,
-                headers={"Content-Type": "application/json",
-                         "x-request-id": request_id,
-                         tracing.TRACE_HEADER: trace_id},
-            ) as pre:
-                if pre.status != 200:
-                    logger.warning(
-                        "prefill hop %s returned %d; falling back",
-                        prefill_pod.address, pre.status)
-                    self.health.record_handoff(prefill_pod.name, ok=False)
-                    self.tracer.record(
-                        trace_id, "gateway.prefill_hop", t_pre0, time.time(),
-                        pod=prefill_pod.name, status=pre.status,
-                        fallback=True)
-                    return None
-                handoff = await pre.read()
-                self.tracer.record_wire(
-                    trace_id, pre.headers.get(tracing.SPANS_HEADER))
+            # Both hops ride the per-phase bounds: response headers within
+            # the TTFT budget, body within the idle budget — a blackholed
+            # replica must degrade this request to single-hop in bounded
+            # time, not hang it (the single total timeout is gone).
+            pre = resp_obj = await self._bounded(
+                self._session.post(
+                    f"http://{prefill_pod.address}/v1/prefill",
+                    data=out_body,
+                    headers={"Content-Type": "application/json",
+                             "x-request-id": request_id,
+                             tracing.TRACE_HEADER: trace_id},
+                ), rcfg.ttft_timeout_s)
+            if pre.status != 200:
+                logger.warning(
+                    "prefill hop %s returned %d; falling back",
+                    prefill_pod.address, pre.status)
+                pre.release()
+                self.resilience.record_handoff(prefill_pod.name, ok=False)
+                self.tracer.record(
+                    trace_id, "gateway.prefill_hop", t_pre0, time.time(),
+                    pod=prefill_pod.name, status=pre.status,
+                    fallback=True)
+                return None
+            handoff = await self._bounded(pre.read(),
+                                          rcfg.stream_idle_timeout_s)
+            engine_req_id = pre.headers.get("x-request-id")
+            self.tracer.record_wire(
+                trace_id, pre.headers.get(tracing.SPANS_HEADER))
             t_pre1 = time.time()
             self.tracer.record(trace_id, "gateway.prefill_hop", t_pre0,
                                t_pre1, pod=prefill_pod.name,
                                wire_bytes=len(handoff))
             t_att0 = time.time()
             hop_pod = decode_pod
-            async with self._session.post(
-                f"http://{decode_pod.address}/v1/attach",
-                data=handoff,
-                headers={"Content-Type": "application/octet-stream",
-                         "x-request-id": request_id,
-                         tracing.TRACE_HEADER: trace_id},
-            ) as upstream:
-                status = upstream.status
-                if status != 200:
-                    logger.warning(
-                        "attach hop %s returned %d; falling back",
-                        decode_pod.address, status)
-                    self.health.record_handoff(decode_pod.name, ok=False)
-                    self.tracer.record(
-                        trace_id, "gateway.attach_hop", t_att0, time.time(),
-                        pod=decode_pod.name, status=status, fallback=True)
-                    return None
-                if "text/event-stream" in upstream.headers.get(
-                        "Content-Type", ""):
-                    return await self._relay_stream(
-                        request, upstream, decode_pod, req_ctx,
-                        trace=(trace_id, t_req, "disaggregated", t_att0),
-                        served_by=f"{prefill_pod.name}+{decode_pod.name}")
-                resp_body = await upstream.read()
-                self.tracer.record_wire(
-                    trace_id, upstream.headers.get(tracing.SPANS_HEADER))
+            upstream = resp_obj = await self._bounded(
+                self._session.post(
+                    f"http://{decode_pod.address}/v1/attach",
+                    data=handoff,
+                    headers={"Content-Type": "application/octet-stream",
+                             "x-request-id": request_id,
+                             tracing.TRACE_HEADER: trace_id},
+                ), rcfg.ttft_timeout_s)
+            status = upstream.status
+            if status != 200:
+                logger.warning(
+                    "attach hop %s returned %d; falling back",
+                    decode_pod.address, status)
+                upstream.release()
+                self.resilience.record_handoff(decode_pod.name, ok=False)
+                self.tracer.record(
+                    trace_id, "gateway.attach_hop", t_att0, time.time(),
+                    pod=decode_pod.name, status=status, fallback=True)
+                return None
+            if "text/event-stream" in upstream.headers.get(
+                    "Content-Type", ""):
+                resp, fail = await self._relay_stream(
+                    request, upstream, decode_pod, req_ctx,
+                    trace=(trace_id, t_req, "disaggregated", t_att0),
+                    served_by=f"{prefill_pod.name}+{decode_pod.name}")
+                if resp is not None:
+                    return resp
+                # The attach stream died before its first chunk: the
+                # decode engine holds abandoned work — release it and
+                # fall back single-hop (nothing reached the client).
+                self.resilience.record_handoff(decode_pod.name, ok=False)
+                if engine_req_id:
+                    self._spawn_release(decode_pod, engine_req_id, trace_id)
+                self.tracer.record(
+                    trace_id, "gateway.attach_hop", t_att0, time.time(),
+                    pod=decode_pod.name, fallback=True, error=fail)
+                return None
+            resp_body = await self._bounded(upstream.read(),
+                                            rcfg.stream_idle_timeout_s)
+            self.tracer.record_wire(
+                trace_id, upstream.headers.get(tracing.SPANS_HEADER))
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            if resp_obj is not None:
+                resp_obj.close()
             # No record_error here: the caller serves the request single-hop
             # next, and THAT path records the request's actual outcome — a
             # recovered hop must not inflate the error rate (non-200 hop
             # statuses above are treated identically).  The health scorer
-            # DOES see it: hop failures are a per-replica degradation
-            # signal regardless of the request's final outcome.
-            self.health.record_handoff(hop_pod.name, ok=False)
+            # and breaker DO see it: hop failures are a per-replica
+            # degradation signal regardless of the request's final outcome.
+            self.resilience.record_handoff(hop_pod.name, ok=False)
+            if hop_pod is decode_pod and engine_req_id:
+                # The decode hop died AFTER the handoff bytes were posted:
+                # the decode engine may have parked (or be decoding) KV
+                # nobody will ever read — the caller reroutes single-hop
+                # next.  Best-effort release of the abandoned work; the
+                # engine-side TTL sweep (--handoff-ttl-s) is the backstop
+                # when this message is lost too.
+                self._spawn_release(decode_pod, engine_req_id, trace_id)
             logger.warning("disaggregated path %s->%s failed: %s",
                            prefill_pod.address, decode_pod.address, e)
             return None
         t_att1 = time.time()
-        self.health.record_handoff(prefill_pod.name, ok=True)
-        self.health.record_handoff(decode_pod.name, ok=True)
+        self.resilience.record_handoff(prefill_pod.name, ok=True)
+        self.resilience.record_handoff(decode_pod.name, ok=True)
         self.tracer.record(trace_id, "gateway.attach_hop", t_att0, t_att1,
                            pod=decode_pod.name, status=status)
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
@@ -547,16 +809,79 @@ class GatewayProxy:
         return web.Response(body=resp_body, status=status, headers=headers,
                             content_type="application/json")
 
+    def _spawn_release(self, pod, engine_req_id: str,
+                       trace_id: str) -> None:
+        """Fire-and-forget ``POST /v1/prefill/release`` at ``pod``: cancel
+        work abandoned by a failed hop (queued / parked / decoding KV whose
+        response path is gone).  Journaled either way — the release is
+        best-effort, the flight recorder is the audit trail."""
+
+        async def release() -> None:
+            ok = False
+            try:
+                # Bounded: the pod being released is the one that just
+                # failed — an unbounded POST at it would pin this task for
+                # the life of the process.
+                async with await asyncio.wait_for(
+                    self._session.post(
+                        f"http://{pod.address}/v1/prefill/release",
+                        json={"request_id": engine_req_id},
+                        headers={tracing.TRACE_HEADER: trace_id},
+                    ), timeout=5.0,
+                ) as r:
+                    ok = (r.status == 200
+                          and bool((await r.json()).get("released")))
+            except Exception:  # best-effort: a failed release must never
+                pass           # surface as an unhandled task exception
+            self.journal.emit(events_mod.KV_RELEASE, trace_id, pod=pod.name,
+                              request_id=engine_req_id, released=ok)
+
+        # The loop holds only a weak ref to tasks: keep a strong one until
+        # completion or the release can be garbage-collected mid-flight.
+        task = asyncio.get_running_loop().create_task(release())
+        self._release_tasks.add(task)
+        task.add_done_callback(self._release_tasks.discard)
+
+    def _client_disconnected(self, req_ctx, pod, trace_id, t_req, path,
+                             t_up0, t_first) -> None:
+        """Mid-stream client disconnect accounting: journal the event,
+        count it, and observe the PARTIAL request into the e2e histograms
+        with the trace summary stamped ``client_disconnect`` — previously
+        these requests vanished from every aggregate."""
+        now = time.time()
+        self.metrics.record_client_disconnect(req_ctx.model or None)
+        self.journal.emit(events_mod.CLIENT_DISCONNECT, trace_id or "",
+                          pod=pod.name, model=req_ctx.model or "")
+        logger.info("client disconnected mid-stream (pod=%s)", pod.name)
+        if trace_id:
+            self.tracer.record(trace_id, "gateway.stream", t_up0, now,
+                               pod=pod.name, client_disconnect=True)
+            self._finish_phase(req_ctx, trace_id, path, t_req,
+                               t_first=t_first, t_last=now,
+                               status="client_disconnect")
+
     async def _relay_stream(self, request: web.Request, upstream, pod,
                             req_ctx, trace=None,
-                            served_by: str | None = None) -> web.StreamResponse:
-        """Relay an SSE stream; never raises once headers are sent.
+                            served_by: str | None = None):
+        """Relay an SSE stream.  Returns ``(response, None)`` once any byte
+        has been committed to the client, or ``(None, reason)`` when the
+        stream died BEFORE its first chunk — that failure is still
+        retry-eligible, so the 200 headers must not be sent yet (a
+        committed stream that later breaks is terminated with the error
+        event + [DONE] instead; bubbling up would make the handler try to
+        send a second response).
 
-        A mid-stream upstream failure must terminate THIS prepared response
-        (error event + [DONE]) — bubbling up would make the handler try to
-        send a second response on the same request.  SSE lines are re-framed
-        through a byte buffer so a data line split across transport chunks
-        still parses (usage rides the final chunk).
+        SSE lines are re-framed through a byte buffer so a data line split
+        across transport chunks still parses (usage rides the final chunk).
+
+        Per-phase timeouts: the FIRST chunk is bounded by ``ttft_timeout_s``
+        and every later inter-chunk gap by ``stream_idle_timeout_s`` — a
+        braking replica fails or terminates in bounded time instead of
+        hanging the client for the old 3600 s total.
+
+        A ``ConnectionResetError`` (or handler-task cancellation) from the
+        client side is journaled as ``client_disconnect``, counted, and
+        the partial request still lands in the e2e histograms.
 
         ``trace`` = (trace_id, t_req, path, t_up0): streaming is where real
         client-observed TTFT/TPOT live — the first relayed data chunk stamps
@@ -564,6 +889,43 @@ class GatewayProxy:
         the final usage count.
         """
         trace_id, t_req, path, t_up0 = trace or (None, 0.0, "collocated", 0.0)
+        rcfg = self.resilience.cfg
+        chunks = upstream.content.iter_any()
+        # First chunk BEFORE prepare(): until a byte is relayed, a dead
+        # stream is an idempotent failure the caller may retry/reroute —
+        # committing 200 headers here would forfeit that.
+        pending = None
+        try:
+            pending = await self._bounded(chunks.__anext__(),
+                                          rcfg.ttft_timeout_s)
+        except StopAsyncIteration:
+            pending = None  # legitimate empty stream: relay it as-is
+        except asyncio.TimeoutError:
+            upstream.close()
+            self.resilience.record_upstream(pod.name, ok=False, timeout=True)
+            self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
+                              pod=pod.name, stream=True,
+                              error="no first chunk within TTFT budget")
+            if trace_id:
+                self.tracer.record(trace_id, "gateway.stream", t_up0,
+                                   time.time(), pod=pod.name,
+                                   error="ttft timeout")
+            logger.warning("stream from %s produced no first chunk in time",
+                           pod.address)
+            return None, "ttft_timeout"
+        except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
+            upstream.close()
+            self.resilience.record_upstream(pod.name, ok=False)
+            self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
+                              pod=pod.name, stream=True,
+                              error=str(e)[:200] or "stream broke pre-first-"
+                                                    "chunk")
+            if trace_id:
+                self.tracer.record(trace_id, "gateway.stream", t_up0,
+                                   time.time(), pod=pod.name, error=str(e))
+            logger.warning("stream from %s broke before first chunk: %s",
+                           pod.address, e)
+            return None, "read"
         headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -577,7 +939,8 @@ class GatewayProxy:
         buf = b""
         t_first = None
         try:
-            async for chunk in upstream.content.iter_any():
+            while pending is not None:
+                chunk = pending
                 if t_first is None:
                     t_first = time.time()
                 buf += chunk
@@ -585,14 +948,41 @@ class GatewayProxy:
                 for line in lines:
                     if line.startswith(b"data: ") and line != b"data: [DONE]":
                         last_data_line = line
-                await resp.write(chunk)
+                try:
+                    await resp.write(chunk)
+                except (ConnectionResetError, ConnectionError):
+                    # The UPSTREAM was serving fine — its streaks/probe
+                    # accounting must not dangle on the client's exit.
+                    self.resilience.record_upstream(pod.name, ok=True)
+                    upstream.close()
+                    self._client_disconnected(req_ctx, pod, trace_id, t_req,
+                                              path, t_up0, t_first)
+                    return resp, None
+                try:
+                    pending = await self._bounded(
+                        chunks.__anext__(), rcfg.stream_idle_timeout_s)
+                except StopAsyncIteration:
+                    pending = None
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler task when the CLIENT's connection
+            # drops mid-stream — account for the partial request, then let
+            # the cancellation propagate (swallowing it would break the
+            # server's teardown contract).
+            self.resilience.record_upstream(pod.name, ok=True)
+            upstream.close()
+            self._client_disconnected(req_ctx, pod, trace_id, t_req,
+                                      path, t_up0, t_first)
+            raise
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            timed_out = isinstance(e, asyncio.TimeoutError)
+            if timed_out:
+                upstream.close()  # the hung read owns the connection
             self.metrics.record_error(req_ctx.model or None)
-            self.health.record_upstream(
-                pod.name, ok=False,
-                timeout=isinstance(e, asyncio.TimeoutError))
+            self.resilience.record_upstream(pod.name, ok=False,
+                                            timeout=timed_out)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
-                              pod=pod.name, stream=True, error=str(e)[:200])
+                              pod=pod.name, stream=True,
+                              error=str(e)[:200] or "stream idle timeout")
             if trace_id:
                 self.tracer.record(trace_id, "gateway.stream", t_up0,
                                    time.time(), pod=pod.name, error=str(e))
@@ -603,11 +993,18 @@ class GatewayProxy:
                     b'data: {"error": {"message": "upstream stream interrupted"}}\n\n'
                     b"data: [DONE]\n\n"
                 )
-            except ConnectionResetError:
-                pass
-            return resp
+            except (ConnectionResetError, ConnectionError):
+                # The client is ALSO gone: account for it instead of
+                # silently dropping the request from every aggregate.
+                self._client_disconnected(req_ctx, pod, trace_id, t_req,
+                                          path, t_up0, t_first)
+            except asyncio.CancelledError:
+                self._client_disconnected(req_ctx, pod, trace_id, t_req,
+                                          path, t_up0, t_first)
+                raise
+            return resp, None
         t_end = time.time()
-        self.health.record_upstream(pod.name, ok=True)
+        self.resilience.record_upstream(pod.name, ok=True)
         try:
             final = json.loads(last_data_line[len(b"data: "):])
             usage = final.get("usage") or {}
@@ -626,7 +1023,7 @@ class GatewayProxy:
                                pod=pod.name)
             self._finish_phase(req_ctx, trace_id, path, t_req,
                                t_first=t_first, t_last=t_end)
-        return resp
+        return resp, None
 
     # -- ops endpoints -----------------------------------------------------
     def _render_metrics(self) -> str:
@@ -635,6 +1032,7 @@ class GatewayProxy:
         families — SLO gauges, per-pod health, and the event counters."""
         text = self.metrics.render()
         extra = (self.slo.render() + self.health.render()
+                 + self.resilience.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -660,12 +1058,15 @@ class GatewayProxy:
         return web.json_response(self.slo.debug_payload())
 
     async def handle_debug_health(self, request: web.Request) -> web.Response:
-        """Per-replica health scores, components, states, and the would-
-        avoid counters (routing stays unchanged this release).  Floored at
-        the configured cadence: the dwell-tick hysteresis counts update
-        PASSES, so a fast poller must not drive transitions."""
+        """Per-replica health scores, components, states, would-avoid
+        counters, plus the resilience plane (policy, per-pod circuit
+        states, retry budget).  Floored at the configured cadence: the
+        dwell-tick hysteresis counts update PASSES, so a fast poller must
+        not drive transitions."""
         self.health.maybe_update(max(1.0, self.obs_tick_s))
-        return web.json_response(self.health.debug_payload())
+        payload = self.health.debug_payload()
+        payload["resilience"] = self.resilience.debug_payload()
+        return web.json_response(payload)
 
     async def handle_debug_events(self, request: web.Request) -> web.Response:
         """The flight recorder: ``?since=<seq>`` incremental cursor,
@@ -693,10 +1094,12 @@ def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="TPU-native inference gateway")
     parser.add_argument("--port", type=int, default=8081)
     bootstrap.add_common_args(parser)
+    bootstrap.add_resilience_args(parser)
     args = parser.parse_args(argv)
 
     comps = bootstrap.components_from_args(args)
-    proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore)
+    proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore,
+                         resilience_cfg=bootstrap.resilience_from_args(args))
     try:
         web.run_app(proxy.build_app(), port=args.port)
     finally:

@@ -1,0 +1,441 @@
+"""Active robustness plane: health-enforcing routing policy, per-pod
+circuit breakers, and the retry/hedge budget the proxy's data path spends.
+
+PR 3 built the *observables* (per-replica health scores with hysteresis,
+upstream error/timeout streaks, the event journal); this module makes them
+load-bearing:
+
+- ``ResilienceConfig.health_policy`` promotes the scheduler's pick seam from
+  LOG-ONLY to enforcing.  ``log_only`` (the default) keeps routing
+  byte-identical to PR 3 — same RNG draws, same picks — and only counts
+  would-avoid decisions.  ``avoid`` deprioritizes degraded/unhealthy/
+  circuit-open replicas: the pick runs over the healthy subset of the
+  tree's survivors, with a last-resort escape hatch (a fully-unhealthy
+  pool still serves, loudly).  ``strict`` sheds instead of using the
+  escape hatch.
+- ``CircuitBreaker``: per-pod closed -> open -> half_open state machine fed
+  by the SAME ``record_upstream``/``record_handoff`` signals the health
+  scorer consumes.  Trips on a consecutive-failure streak or a windowed
+  error rate; after ``open_cooldown_s`` it admits ``half_open_probes``
+  probe requests — one success closes, one failure re-opens.  Exported as
+  ``gateway_circuit_state{pod}`` (0 closed / 1 open / 2 half-open), every
+  transition journaled.
+- ``RetryBudget``: a token bucket that caps retries to a fraction of real
+  traffic (``retry_budget_ratio``) so retries cannot amplify an outage —
+  the classic Envoy/Finagle retry-budget shape.  ``retry_backoff`` is
+  decorrelated jitter.
+
+``ResiliencePlane`` composes the three with the health scorer and IS the
+object the proxy hands to the scheduler as ``health_advisor`` — it keeps
+the scorer's ``note_pick`` counting AND answers ``should_avoid`` when the
+policy enforces.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.tracing import escape_label
+
+logger = logging.getLogger(__name__)
+
+LOG_ONLY, AVOID, STRICT = "log_only", "avoid", "strict"
+HEALTH_POLICIES = (LOG_ONLY, AVOID, STRICT)
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+CIRCUIT_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Knobs for the whole robustness plane (flags: ``add_resilience_args``).
+
+    Defaults are deliberately conservative: ``log_only`` policy (routing
+    unchanged), hedging off, retries bounded by a budget.  The per-phase
+    timeouts replace the old single ``request_timeout_s=3600`` client
+    timeout: connect / time-to-first-byte / idle-between-chunks each get
+    their own bound, so a dead replica fails in seconds while a long
+    healthy generation still streams for hours.
+    """
+
+    health_policy: str = LOG_ONLY
+    # Circuit breaker (per pod).
+    trip_consecutive: int = 5
+    trip_error_rate: float = 0.5
+    error_window: int = 20
+    min_volume: int = 10
+    open_cooldown_s: float = 10.0
+    half_open_probes: int = 1
+    # Retries (idempotent failures only: connect errors, 503s, TTFT
+    # timeouts — nothing after the first relayed byte).
+    max_retries: int = 2
+    retry_budget_ratio: float = 0.2
+    retry_budget_min: float = 3.0
+    retry_budget_cap: float = 64.0
+    backoff_base_s: float = 0.025
+    backoff_cap_s: float = 1.0
+    # TTFT-based hedge for non-streaming requests; 0 disables.
+    hedge_ttft_s: float = 0.0
+    # Per-phase timeouts (0 disables a phase's bound).
+    connect_timeout_s: float = 5.0
+    ttft_timeout_s: float = 300.0
+    stream_idle_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.health_policy not in HEALTH_POLICIES:
+            raise ValueError(
+                f"health_policy {self.health_policy!r} not in "
+                f"{HEALTH_POLICIES}")
+
+
+@dataclass
+class _PodCircuit:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    opened_t: float = 0.0
+    probes_inflight: int = 0
+    probe_t: float = 0.0  # when the last probe pick was admitted
+    opens_total: int = 0
+    window: deque = None  # recent outcomes (True=ok), maxlen=error_window
+
+    def __post_init__(self):
+        if self.window is None:
+            self.window = deque(maxlen=20)
+
+
+class CircuitBreaker:
+    """Per-pod circuit breaker over upstream outcomes; all methods
+    thread-safe (request path, scheduler executor threads, and the
+    observability tick all touch it)."""
+
+    def __init__(self, cfg: ResilienceConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 clock=time.time):
+        self.cfg = cfg or ResilienceConfig()
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pods: dict[str, _PodCircuit] = {}
+        # blocked_set() cache for the pick seam: rebuilt only after a
+        # state/probe change (dirty flag) or when an open pod's cooldown
+        # elapses (expiry).  Unlocked reads may see a one-event-stale set
+        # — harmless for routing, and the common all-closed case costs a
+        # single attribute read per pick.
+        self._blocked_cache: frozenset = frozenset()
+        self._cache_expiry: float = float("inf")
+        self._cache_dirty = False
+
+    def _get(self, pod_name: str) -> _PodCircuit:
+        pc = self._pods.get(pod_name)
+        if pc is None:
+            pc = self._pods[pod_name] = _PodCircuit(
+                window=deque(maxlen=max(1, self.cfg.error_window)))
+        return pc
+
+    def _transition(self, pod_name: str, pc: _PodCircuit, to: str) -> None:
+        frm, pc.state = pc.state, to
+        self._cache_dirty = True
+        if to == OPEN:
+            pc.opened_t = self._clock()
+            pc.opens_total += 1
+        if to in (CLOSED, OPEN):
+            pc.probes_inflight = 0
+        if to == CLOSED:
+            pc.consecutive_failures = 0
+            pc.window.clear()
+        log = logger.warning if to != CLOSED else logger.info
+        log("circuit for pod %s: %s -> %s", pod_name, frm, to)
+        if self.journal is not None:
+            self.journal.emit(events_mod.CIRCUIT_TRANSITION, pod=pod_name,
+                              frm=frm, to=to)
+
+    def _maybe_half_open(self, pod_name: str, pc: _PodCircuit) -> None:
+        now = self._clock()
+        if (pc.state == OPEN
+                and now - pc.opened_t >= self.cfg.open_cooldown_s):
+            self._transition(pod_name, pc, HALF_OPEN)
+        if (pc.state == HALF_OPEN and pc.probes_inflight > 0 and pc.probe_t
+                and now - pc.probe_t >= self.cfg.open_cooldown_s):
+            # The probe's outcome never came back (client vanished before
+            # the upstream round-trip, a hop path that records elsewhere):
+            # reap the stale slot, or the pod would stay probe-quota-full
+            # — and therefore excluded under policy=avoid — forever.
+            pc.probes_inflight = 0
+            self._cache_dirty = True
+
+    def record(self, pod_name: str, ok: bool) -> None:
+        """One upstream outcome.  In half-open (including an open circuit
+        whose cooldown just elapsed) this IS the probe verdict: success
+        closes the circuit, failure re-opens it for a full cooldown."""
+        with self._lock:
+            pc = self._get(pod_name)
+            self._maybe_half_open(pod_name, pc)
+            if pc.state == HALF_OPEN:
+                pc.probes_inflight = max(0, pc.probes_inflight - 1)
+                self._transition(pod_name, pc, CLOSED if ok else OPEN)
+                return
+            pc.window.append(ok)
+            if ok:
+                pc.consecutive_failures = 0
+                return
+            pc.consecutive_failures += 1
+            if pc.state != CLOSED:
+                return
+            errs = sum(1 for o in pc.window if not o)
+            rate_trip = (len(pc.window) >= self.cfg.min_volume
+                         and errs / len(pc.window)
+                         >= self.cfg.trip_error_rate)
+            if (pc.consecutive_failures >= self.cfg.trip_consecutive
+                    or rate_trip):
+                self._transition(pod_name, pc, OPEN)
+
+    def state(self, pod_name: str) -> str:
+        """Current state (advances open -> half_open when the cooldown has
+        elapsed, so readers never see a stale open)."""
+        with self._lock:
+            pc = self._pods.get(pod_name)
+            if pc is None:
+                return CLOSED
+            self._maybe_half_open(pod_name, pc)
+            return pc.state
+
+    def allow(self, pod_name: str) -> bool:
+        """Pick-time consultation: closed always; open only after the
+        cooldown (as a half-open probe); half-open up to
+        ``half_open_probes`` concurrent probes."""
+        with self._lock:
+            pc = self._pods.get(pod_name)
+            if pc is None:
+                return True
+            self._maybe_half_open(pod_name, pc)
+            if pc.state == CLOSED:
+                return True
+            if pc.state == HALF_OPEN:
+                return pc.probes_inflight < self.cfg.half_open_probes
+            return False
+
+    def note_pick(self, pod_name: str) -> None:
+        """A pick landed on this pod; a half-open pod counts it as its
+        in-flight probe so concurrent picks can't stampede the replica."""
+        with self._lock:
+            pc = self._pods.get(pod_name)
+            if pc is None:
+                return
+            self._maybe_half_open(pod_name, pc)
+            if pc.state == HALF_OPEN:
+                pc.probes_inflight += 1
+                pc.probe_t = self._clock()
+                self._cache_dirty = True
+
+    def blocked_set(self) -> frozenset:
+        """Pods a pick must not land on right now (open inside cooldown,
+        or half-open with the probe quota spent).  Served from the cache
+        unless an event dirtied it or an open pod's cooldown elapsed — the
+        pick-seam hot path must not pay a per-pick rebuild."""
+        now = self._clock()
+        if not self._cache_dirty and now < self._cache_expiry:
+            return self._blocked_cache
+        with self._lock:
+            out = set()
+            expiry = float("inf")
+            for name, pc in self._pods.items():
+                self._maybe_half_open(name, pc)
+                if pc.state == OPEN:
+                    out.add(name)
+                    expiry = min(expiry,
+                                 pc.opened_t + self.cfg.open_cooldown_s)
+                elif (pc.state == HALF_OPEN and pc.probes_inflight
+                        >= self.cfg.half_open_probes):
+                    out.add(name)
+                    # The stale-probe reaper frees the quota at
+                    # probe_t + cooldown; the cache must revisit then.
+                    expiry = min(expiry,
+                                 pc.probe_t + self.cfg.open_cooldown_s)
+            self._blocked_cache = frozenset(out)
+            self._cache_expiry = expiry
+            self._cache_dirty = False
+            return self._blocked_cache
+
+    def prune(self, live: set[str]) -> None:
+        """Drop state for pods that left the pool (name reuse must not
+        inherit an open circuit)."""
+        with self._lock:
+            for name in [n for n in self._pods if n not in live]:
+                del self._pods[name]
+                self._cache_dirty = True
+
+    def render(self) -> list[str]:
+        with self._lock:
+            states = {}
+            for name, pc in self._pods.items():
+                self._maybe_half_open(name, pc)
+                states[name] = pc.state
+        if not states:
+            return []
+        lines = ["# TYPE gateway_circuit_state gauge"]
+        for pod in sorted(states):
+            lines.append('gateway_circuit_state{pod="%s"} %d'
+                         % (escape_label(pod),
+                            CIRCUIT_STATE_CODE[states[pod]]))
+        return lines
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            return {
+                name: {"state": pc.state,
+                       "consecutive_failures": pc.consecutive_failures,
+                       "opens_total": pc.opens_total,
+                       "probes_inflight": pc.probes_inflight}
+                for name, pc in sorted(self._pods.items())
+            }
+
+
+class RetryBudget:
+    """Token bucket bounding retries to a fraction of real traffic.
+
+    Every primary request deposits ``ratio`` tokens (bounded by ``cap``);
+    a retry withdraws one.  During an outage the deposit stream shrinks
+    with successful traffic, so retry volume decays instead of doubling
+    the load on whatever is left — ``min_tokens`` keeps a cold gateway
+    able to retry at all.
+    """
+
+    def __init__(self, ratio: float = 0.2, min_tokens: float = 3.0,
+                 cap: float = 64.0):
+        self.ratio = ratio
+        self.cap = max(cap, min_tokens)
+        self._tokens = min_tokens
+        self._lock = threading.Lock()
+        self.spent_total = 0
+        self.denied_total = 0
+
+    def note_request(self) -> None:
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_total += 1
+                return True
+            self.denied_total += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+def retry_backoff(rng: random.Random, prev_s: float, base_s: float,
+                  cap_s: float) -> float:
+    """Decorrelated-jitter backoff (AWS architecture blog shape): each
+    sleep is uniform in [base, 3 * previous], capped — retries desynchronize
+    across clients instead of thundering in lockstep."""
+    return min(cap_s, rng.uniform(base_s, max(base_s, prev_s * 3.0)))
+
+
+class ResiliencePlane:
+    """One object owning the robustness state: the proxy records upstream
+    outcomes through it (fanning into the health scorer AND the breaker),
+    and the scheduler consults it as its ``health_advisor``
+    (``note_pick``/``should_avoid``/``policy`` seam)."""
+
+    def __init__(self, health: "health_mod.HealthScorer",
+                 cfg: ResilienceConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 clock=time.time, rng: random.Random | None = None):
+        self.cfg = cfg or ResilienceConfig()
+        self.health = health
+        self.journal = journal
+        self.breaker = CircuitBreaker(self.cfg, journal=journal, clock=clock)
+        self.retry_budget = RetryBudget(
+            ratio=self.cfg.retry_budget_ratio,
+            min_tokens=self.cfg.retry_budget_min,
+            cap=self.cfg.retry_budget_cap)
+        self.rng = rng or random.Random()
+        self.escape_hatch_total = 0
+
+    # -- scheduler advisor seam -------------------------------------------
+    @property
+    def policy(self) -> str:
+        return self.cfg.health_policy
+
+    def note_pick(self, pod_name: str) -> None:
+        """Must never raise or draw RNG — the log_only byte-identical
+        guarantee rides on this (tests/test_health.py pins it)."""
+        self.health.note_pick(pod_name)
+        self.breaker.note_pick(pod_name)
+
+    def should_avoid(self, pod_name: str) -> bool:
+        """True when enforcing policy should steer picks off this pod:
+        health state degraded/unhealthy, or the circuit is not admitting
+        (open inside cooldown, or half-open with its probe quota full)."""
+        if self.health.state(pod_name) != health_mod.HEALTHY:
+            return True
+        return not self.breaker.allow(pod_name)
+
+    def avoid_set(self) -> frozenset:
+        """Batch form of ``should_avoid`` — the pick seam calls this once
+        per candidate set; both sides serve cached frozensets, so the
+        healthy-pool common case is two attribute reads."""
+        bad_health = self.health.non_healthy()
+        bad_circuit = self.breaker.blocked_set()
+        if not bad_circuit:
+            return bad_health
+        if not bad_health:
+            return bad_circuit
+        return bad_health | bad_circuit
+
+    def note_escape_hatch(self) -> None:
+        """Every tree survivor was avoidable; the pick proceeded over the
+        full set (policy=avoid last resort)."""
+        self.escape_hatch_total += 1
+        if self.journal is not None:
+            self.journal.emit(events_mod.POLICY_ESCAPE,
+                              policy=self.cfg.health_policy)
+
+    # -- request-path feeds ------------------------------------------------
+    def record_upstream(self, pod_name: str, ok: bool,
+                        timeout: bool = False) -> None:
+        self.health.record_upstream(pod_name, ok, timeout=timeout)
+        self.breaker.record(pod_name, ok)
+
+    def record_handoff(self, pod_name: str, ok: bool) -> None:
+        self.health.record_handoff(pod_name, ok)
+        self.breaker.record(pod_name, ok)
+
+    # -- lifecycle ---------------------------------------------------------
+    def tick(self) -> None:
+        """Observability-loop tick: health pass first, then breaker
+        bookkeeping (cooldown advance + departed-pod pruning)."""
+        self.health.update()
+        provider = self.health.provider
+        if provider is not None:
+            self.breaker.prune(
+                {pm.pod.name for pm in provider.all_pod_metrics()})
+
+    # -- export ------------------------------------------------------------
+    def render(self) -> list[str]:
+        return self.breaker.render()
+
+    def debug_payload(self) -> dict:
+        return {
+            "policy": self.cfg.health_policy,
+            "circuits": self.breaker.debug_payload(),
+            "retry_budget": {
+                "tokens": round(self.retry_budget.tokens, 3),
+                "spent_total": self.retry_budget.spent_total,
+                "denied_total": self.retry_budget.denied_total,
+            },
+            "escape_hatch_total": self.escape_hatch_total,
+            "config": asdict(self.cfg),
+        }

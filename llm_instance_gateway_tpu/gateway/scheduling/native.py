@@ -30,6 +30,7 @@ from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
     Scheduler,
     SchedulingError,
     build_decode_tree,
+    filter_by_policy,
     split_pool_roles,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
@@ -150,9 +151,10 @@ class NativeScheduler:
         # The gRPC transport calls schedule() from a thread pool; the cached
         # arrays (including the C++ output buffer) are shared state.
         self._call_lock = threading.Lock()
-        # LOG-ONLY health hook (gateway/health.py) — same seam as the
-        # Python Scheduler: counts would-be avoidance picks, never alters
-        # the pick (candidate parity with C++ stays exact).
+        # Health/resilience hook (gateway/resilience.py) — same seam as
+        # the Python Scheduler: log_only counts would-be avoidance picks
+        # and never alters the pick (candidate parity with C++ stays
+        # exact); avoid/strict filter via filter_by_policy in _pick.
         self.health_advisor = None
 
     def _arrays(self, req: LLMRequest, pods: list[PodMetrics],
@@ -264,6 +266,12 @@ class NativeScheduler:
 
     def _pick(self, req: LLMRequest, pods: list[PodMetrics],
               idxs: list[int]) -> Pod:
+        # Same policy seam as the Python Scheduler: the C++ candidate set
+        # narrows to non-avoided pods BEFORE the tie-break and the RNG
+        # draw; log_only returns the indices unchanged, keeping the
+        # fuzz-pinned candidate parity exact.
+        idxs = filter_by_policy(self.health_advisor, idxs,
+                                name_of=lambda i: pods[i].pod.name)
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, [pods[i] for i in idxs])
@@ -308,6 +316,8 @@ class NativeScheduler:
             raise SchedulingError(
                 f"no decode replica for disaggregated request: {e}",
                 shed=e.shed) from e
+        decode_survivors = filter_by_policy(
+            self.health_advisor, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:

@@ -70,6 +70,54 @@ class PodMetricsProvider(Protocol):
     def all_pod_metrics(self) -> list[PodMetrics]: ...
 
 
+def filter_by_policy(advisor, candidates: list, name_of=None) -> list:
+    """Apply the advisor's health policy over a candidate set.
+
+    The advisor seam (``gateway/resilience.py:ResiliencePlane``) exposes
+    ``policy`` + ``should_avoid``; schedulers call this AFTER the filter
+    tree, BEFORE the prefix-affinity tie-break and the RNG draw.
+
+    - ``log_only`` (or no advisor / a bare HealthScorer without a policy):
+      returns ``candidates`` UNCHANGED — the byte-identical guarantee the
+      same-RNG diff tests pin.
+    - ``avoid``: the subset the advisor would not avoid; when EVERY
+      candidate is avoidable, the full set comes back (last-resort escape
+      hatch — a fully-unhealthy pool still serves) and the advisor's
+      ``note_escape_hatch`` counter/journal fires.
+    - ``strict``: like ``avoid`` but an all-avoidable set sheds
+      (``SchedulingError(shed=True)`` -> 429) instead of escaping.
+
+    ``name_of`` maps a candidate to its pod name (defaults to the
+    ``PodMetrics`` shape; the native scheduler passes an index mapper).
+    """
+    if advisor is None or not candidates:
+        return candidates
+    policy = getattr(advisor, "policy", "log_only")
+    if policy == "log_only":
+        return candidates
+    if name_of is None:
+        name_of = lambda pm: pm.pod.name  # noqa: E731
+    batch = getattr(advisor, "avoid_set", None)
+    if batch is not None:
+        bad = batch()  # two lock acquisitions total, not two per pod
+        if not bad:
+            return candidates
+        preferred = [c for c in candidates if name_of(c) not in bad]
+    else:
+        preferred = [c for c in candidates
+                     if not advisor.should_avoid(name_of(c))]
+    if preferred:
+        return preferred
+    if policy == "strict":
+        raise SchedulingError(
+            "all candidate replicas are unhealthy or circuit-open "
+            "(health_policy=strict)", shed=True)
+    note = getattr(advisor, "note_escape_hatch", None)
+    if note is not None:
+        note()
+    return candidates
+
+
 def _drop_filter() -> Filter:
     def drop(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
         raise FilterError(
@@ -246,11 +294,13 @@ class Scheduler:
         # inert while every pod is collocated.
         self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
         self._rng = rng or random.Random()
-        # LOG-ONLY health hook (gateway/health.py, set by the proxy): after
-        # a pick, ``note_pick`` counts would-be avoidance decisions into
-        # tpu:health_would_avoid_total.  It must never change the pick —
-        # no RNG draws, no filtering — so routing stays byte-identical to
-        # a scheduler without the hook.
+        # Health/resilience hook (set by the proxy).  With the default
+        # ``log_only`` policy ``note_pick`` only counts would-be avoidance
+        # decisions into tpu:health_would_avoid_total — no RNG draws, no
+        # filtering, routing byte-identical to a scheduler without the
+        # hook (pinned by the same-RNG diff tests).  With ``avoid`` /
+        # ``strict`` (gateway/resilience.py) the survivor set additionally
+        # passes through ``filter_by_policy`` before the tie-break/draw.
         self.health_advisor = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
@@ -288,6 +338,10 @@ class Scheduler:
         return survivors
 
     def _pick(self, req: LLMRequest, survivors: Sequence[PodMetrics]) -> Pod:
+        # Enforcing health policy narrows the candidate set FIRST, so the
+        # prefix-affinity tie-break can't pin a request to an avoided
+        # holder (log_only returns the set unchanged).
+        survivors = filter_by_policy(self.health_advisor, list(survivors))
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, survivors)
@@ -340,6 +394,8 @@ class Scheduler:
             raise SchedulingError(
                 f"no decode replica for disaggregated request: {e}",
                 shed=e.shed) from e
+        decode_survivors = filter_by_policy(
+            self.health_advisor, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:

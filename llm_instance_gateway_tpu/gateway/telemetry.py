@@ -65,6 +65,12 @@ class GatewayMetrics:
             key: {} for key, _ in PHASE_FAMILIES
         }
         self.lora_affinity_hits = 0  # picked pod already had the adapter
+        # Resilience data path (gateway/resilience.py): retries performed
+        # (by failure reason), hedges fired (by outcome), and client-side
+        # stream disconnects (by model; None = model unknown).
+        self.retries_total: dict[str, int] = {}
+        self.hedges_total: dict[str, int] = {}
+        self.client_disconnects_total: dict[str | None, int] = {}
         # Optional pool-signal source (set by the proxy): a callable
         # returning the provider's PodMetrics snapshot, re-exported at
         # render time so operators see per-replica prefix-cache hit volume
@@ -100,6 +106,20 @@ class GatewayMetrics:
             if pre_admission:
                 self.errors_preadmission[model] = (
                     self.errors_preadmission.get(model, 0) + 1)
+
+    def record_retry(self, reason: str) -> None:
+        with self._lock:
+            self.retries_total[reason] = self.retries_total.get(reason, 0) + 1
+
+    def record_hedge(self, outcome: str) -> None:
+        with self._lock:
+            self.hedges_total[outcome] = (
+                self.hedges_total.get(outcome, 0) + 1)
+
+    def record_client_disconnect(self, model: str | None = None) -> None:
+        with self._lock:
+            self.client_disconnects_total[model] = (
+                self.client_disconnects_total.get(model, 0) + 1)
 
     def record_usage(self, model: str, prompt: int, completion: int) -> None:
         with self._lock:
@@ -171,6 +191,13 @@ class GatewayMetrics:
                 "# TYPE gateway_lora_affinity_hits_total counter",
                 f"gateway_lora_affinity_hits_total {self.lora_affinity_hits}",
             ]
+            lines += self._counter_lines(
+                "gateway_retries_total", self.retries_total, "reason")
+            lines += self._counter_lines(
+                "gateway_hedges_total", self.hedges_total, "outcome")
+            lines += self._counter_lines(
+                "gateway_client_disconnects_total",
+                self.client_disconnects_total, "model")
             lines += render_histogram(
                 "gateway_pick_latency_seconds", self.pick_latency)
             for fam, table in (

@@ -48,6 +48,17 @@ GATEWAY_FAMILIES = (
     Family("gateway_lora_affinity_hits_total", "counter", (),
            "Picks that landed on a pod already serving the requested "
            "adapter.", GATEWAY_SURFACE),
+    Family("gateway_retries_total", "counter", ("reason",),
+           "Budgeted data-path retries performed, by failure reason "
+           "(connect | ttft_timeout | upstream_503 | read | read_timeout; "
+           "gateway/resilience.py).", GATEWAY_SURFACE),
+    Family("gateway_hedges_total", "counter", ("outcome",),
+           "TTFT hedges, by outcome (fired | won | lost | no_candidate | "
+           "failed); enabled via --hedge-ttft-s.", GATEWAY_SURFACE),
+    Family("gateway_client_disconnects_total", "counter", ("model",),
+           "Client-side disconnects of live SSE relays; the partial "
+           "request is still observed into the e2e histograms.",
+           GATEWAY_SURFACE),
     Family("gateway_pick_latency_seconds", "histogram", (),
            "Scheduler pick latency.", GATEWAY_SURFACE),
     Family("gateway_prompt_tokens_total", "counter", ("model",),
@@ -91,8 +102,12 @@ GATEWAY_FAMILIES = (
            "Disaggregation hop failures attributed to the refusing/failing "
            "pod.", GATEWAY_SURFACE),
     Family("tpu:health_would_avoid_total", "counter", ("pod",),
-           "Picks that health-aware routing WOULD have steered elsewhere "
-           "(log-only this release; routing unchanged).", GATEWAY_SURFACE),
+           "Picks that landed on a non-healthy replica (always counted; "
+           "with health_policy=log_only routing is otherwise unchanged).",
+           GATEWAY_SURFACE),
+    Family("gateway_circuit_state", "gauge", ("pod",),
+           "Per-pod circuit-breaker state (0 closed / 1 open / 2 "
+           "half-open; gateway/resilience.py).", GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),

@@ -36,6 +36,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import queue as queue_mod
 import time
 
@@ -100,12 +101,28 @@ class ModelServer:
             engine.event_sink = self.events.emit
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        # Deterministic fault injection (gateway/faultinject.py): the
+        # LIG_FAULTS env var names a JSON schedule; the middleware applies
+        # blackhole/brownout/error/disconnect faults to /v1/* handlers so
+        # the 3-process e2e chaos stack exercises the REAL server binary.
+        middlewares = []
+        faults_path = os.environ.get("LIG_FAULTS")
+        if faults_path:
+            from llm_instance_gateway_tpu.gateway import faultinject
+
+            schedule = faultinject.FaultSchedule.from_file(faults_path)
+            middlewares.append(
+                faultinject.aiohttp_middleware(schedule,
+                                               journal=self.events))
+            logger.warning("fault injection armed from %s: %s",
+                           faults_path, schedule.describe())
+        app = web.Application(middlewares=middlewares)
         app.router.add_post("/v1/completions", self.handle_completions)
         app.router.add_post("/v1/chat/completions", self.handle_chat)
         # Cross-engine disaggregation hops (gateway/proxy.py two-hop relay).
         app.router.add_post("/v1/prefill", self.handle_prefill)
         app.router.add_post("/v1/attach", self.handle_attach)
+        app.router.add_post("/v1/prefill/release", self.handle_release)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_post("/v1/load_lora_adapter", self.handle_load_adapter)
         app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
@@ -1114,6 +1131,27 @@ class ModelServer:
             "ttft_ms": round(req.ttft_s * 1000, 2),
         }, headers=trace_headers)
 
+    async def handle_release(self, request: web.Request) -> web.Response:
+        """Best-effort release of abandoned disaggregation work: the
+        gateway posts ``{"request_id": ...}`` when a decode hop failed
+        AFTER the handoff bytes were delivered — the engine may hold the
+        imported KV parked (or decoding) with nobody left to read the
+        response.  Idempotent; unknown ids answer ``released: false``
+        (the request finished, was never admitted, or already swept by
+        the engine's ``--handoff-ttl-s`` backstop)."""
+        trace_id = self._trace_id_for(request)
+        try:
+            body = await request.json()
+            request_id = body["request_id"]
+        except (json.JSONDecodeError, KeyError, TypeError):
+            return _err(400, "body must be JSON with request_id", trace_id)
+        released = bool(self.engine.release_request(str(request_id)))
+        if released:
+            logger.info("released abandoned request %s", request_id)
+        return web.json_response(
+            {"request_id": request_id, "released": released},
+            headers={tracing.TRACE_HEADER: trace_id})
+
     # -- admin -------------------------------------------------------------
     async def handle_models(self, request: web.Request) -> web.Response:
         data = [{"id": self.model_name, "object": "model", "root": self.model_name}]
@@ -1327,6 +1365,14 @@ def main(argv=None) -> None:
              "requires --paged-kv-block",
     )
     parser.add_argument(
+        "--handoff-ttl-s", type=float, default=60.0,
+        help="abandoned-handoff backstop: an attach-imported request still "
+             "parked in decode_wait this many seconds after admission is "
+             "presumed abandoned (its gateway rerouted) and is released; "
+             "0 disables. The gateway's POST /v1/prefill/release is the "
+             "fast path, this TTL the safety net.",
+    )
+    parser.add_argument(
         "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
         help="serve sharded over a device mesh, e.g. 'tensor=8' on a v5e-8 "
              "pool or 'data=2,tensor=4'; axes: data,fsdp,tensor,expert,"
@@ -1435,6 +1481,7 @@ def main(argv=None) -> None:
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,
             role=args.role,
+            handoff_ttl_s=args.handoff_ttl_s,
             speculative_k=args.speculative,
             kv_cache_quant=(None if args.kv_quantize == "none"
                             else args.kv_quantize),

@@ -115,6 +115,13 @@ class EngineConfig:
     # paged admissions stay per-request (block allocation is per-row
     # backpressure).
     prefill_batch: int = 1
+    # Abandoned-handoff TTL: an attach-imported request still PARKED in
+    # decode_wait this many seconds after admission is presumed abandoned
+    # (its gateway gave up on the hop and rerouted) and is failed/freed by
+    # the engine loop's sweep instead of eventually decoding tokens nobody
+    # will read.  0 disables; the gateway's best-effort
+    # ``POST /v1/prefill/release`` is the fast path, this is the backstop.
+    handoff_ttl_s: float = 0.0
     # Paged KV cache (models/paged.py): block size in tokens; None = the
     # default contiguous-lane cache.  With paging, the kv metrics report
     # allocated/total blocks — vLLM's gpu_cache_usage_perc semantics, which
@@ -344,6 +351,10 @@ class _WaitingPrefill:
     # Imported via attach_prefilled: the insert may map already-cached
     # prefix blocks instead of re-writing identical content.
     from_handoff: bool = False
+    # Wall clock at park time; with ``handoff_ttl_s`` set, an imported
+    # handoff that sat parked past the TTL is abandoned work (the gateway
+    # that posted it gave up and rerouted) and is swept instead of slotted.
+    t_parked: float = 0.0
 
 
 @dataclass
@@ -591,6 +602,9 @@ class Engine:
         # registered): counted into num_requests_waiting so drain() and the
         # routing signal never see a phantom-quiescent engine.
         self._admitting = 0
+        # Live requests by id (inserted at submit/attach, removed in
+        # _finish) — the handle ``release_request`` cancels through.
+        self._live: dict[str, Request] = {}
         self._thread: threading.Thread | None = None
 
         # Telemetry (exported by server.metrics in the gateway contract).
@@ -1008,6 +1022,7 @@ class Engine:
             raise
         with self._lock:
             self.total_requests += 1
+            self._live[request.request_id] = request
         with self._work:
             self._work.notify()
         return request
@@ -1104,9 +1119,33 @@ class Engine:
             raise
         with self._lock:
             self.total_requests += 1
+            self._live[request.request_id] = request
         with self._work:
             self._work.notify()
         return request
+
+    def release_request(self, request_id: str) -> bool:
+        """Best-effort cancel of a live request by id (the gateway's
+        abandon path: a decode hop whose response was lost after the
+        handoff was posted — ``POST /v1/prefill/release``).
+
+        Marks the request cancelled and wakes the loop; the existing
+        cancel seams finish it wherever it sits (queued, parked in
+        ``decode_wait`` — freeing the parked KV accounting — or active in
+        a slot, where the decode block sweep clears it).  Returns whether
+        a live request with that id existed.  Idempotent; unknown ids are
+        a no-op (the request may have finished, or never arrived).
+        """
+        with self._lock:
+            req = self._live.get(request_id)
+        if req is None or req.done.is_set():
+            return False
+        req.cancelled.set()
+        if self.event_sink is not None:
+            self.event_sink("kv_release", request_id=request_id)
+        with self._work:
+            self._work.notify()
+        return True
 
     # ------------------------------------------------------------------
     # metrics snapshot (the scrape contract, gateway/metrics_client.py)
@@ -1568,8 +1607,38 @@ class Engine:
             break
         return did
 
+    def _sweep_decode_wait(self) -> bool:
+        """Drop cancelled entries ANYWHERE in decode_wait (not just the
+        head: a released attach must free its parked KV even while older
+        work blocks the front), plus handoff imports parked past the TTL —
+        abandoned work whose gateway already rerouted."""
+        now = time.time()
+        ttl = self.cfg.handoff_ttl_s
+        keep: list[_WaitingPrefill] = []
+        swept = False
+        for w in self.decode_wait:
+            expired = (ttl > 0 and w.from_handoff and w.t_parked
+                       and now - w.t_parked > ttl)
+            if w.request.cancelled.is_set() or expired:
+                self._parked_kv_tokens -= w.k.shape[2]
+                if expired and not w.request.cancelled.is_set():
+                    logger.warning(
+                        "handoff %s parked %.1fs > ttl %.1fs; releasing",
+                        w.request.request_id, now - w.t_parked, ttl)
+                    if self.event_sink is not None:
+                        self.event_sink("kv_release",
+                                        request_id=w.request.request_id,
+                                        reason="ttl")
+                self._finish(w.request, "cancelled")
+                swept = True
+            else:
+                keep.append(w)
+        if swept:
+            self.decode_wait = collections.deque(keep)
+        return swept
+
     def _drain_decode_wait(self, pipelined: bool) -> bool:
-        did = False
+        did = self._sweep_decode_wait()
         while self.decode_wait:
             w = self.decode_wait[0]
             if w.request.cancelled.is_set():
@@ -1689,7 +1758,8 @@ class Engine:
                 first_token=jnp.asarray(handoff.first_token, jnp.int32),
                 k=k, v=v, n=handoff.n, lora_slot=lora_slot,
                 first_token_host=handoff.first_token,
-                lp_info=None, first_emitted=True, from_handoff=True)
+                lp_info=None, first_emitted=True, from_handoff=True,
+                t_parked=time.time())
             self.decode_wait.append(w)
             self._parked_kv_tokens += w.k.shape[2]
         except Exception as e:  # engine must survive a poison handoff
@@ -3133,6 +3203,8 @@ class Engine:
             return  # idempotent: a request finishes (and releases) once
         req.finish_reason = reason
         req.t_done = time.time()
+        with self._lock:
+            self._live.pop(req.request_id, None)
         # Release BEFORE signalling done: a caller that wakes on done and
         # immediately unloads the adapter must not see a stale pin.
         if req.adapter is not None and self.lora is not None:

@@ -299,6 +299,56 @@ def test_slo_health_events_exposition_contract():
                                                   "health_transition"}
 
 
+def test_resilience_families_exposition_contract():
+    """Robustness-PR satellite: gateway_circuit_state{pod},
+    gateway_retries_total{reason}, gateway_hedges_total{outcome}, and
+    gateway_client_disconnects_total{model} lint clean on the composed
+    page — TYPE coverage, hostile-label escaping, gauge-vs-counter
+    semantics, and the documented 0/1/2 circuit-state encoding."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway import health, resilience
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    gm = loaded_gateway_metrics()
+    gm.record_retry("connect")
+    gm.record_retry("ttft_timeout")
+    gm.record_hedge("fired")
+    gm.record_hedge("won")
+    gm.record_client_disconnect(HOSTILE)
+    journal = events.EventJournal(capacity=64)
+    provider = StaticProvider(
+        [PodMetrics(pod=Pod(HOSTILE, "127.0.0.1:1"), metrics=Metrics())])
+    plane = resilience.ResiliencePlane(
+        health.HealthScorer(provider=provider, journal=journal),
+        cfg=resilience.ResilienceConfig(trip_consecutive=2),
+        journal=journal)
+    for _ in range(2):
+        plane.record_upstream(HOSTILE, ok=False)
+    text = gm.render() + "\n".join(
+        plane.render() + journal.render_prom("gateway_events_total")) + "\n"
+    families = lint_exposition(text)
+    types = {line.split(" ")[2]: line.split(" ")[3]
+             for line in text.splitlines() if line.startswith("# TYPE ")}
+    assert types["gateway_circuit_state"] == "gauge"
+    for fam in ("gateway_retries_total", "gateway_hedges_total",
+                "gateway_client_disconnects_total"):
+        assert types[fam] == "counter", fam
+    assert {s.labels["reason"] for s in families["gateway_retries_total"]} \
+        == {"connect", "ttft_timeout"}
+    assert {s.labels["outcome"] for s in families["gateway_hedges_total"]} \
+        == {"fired", "won"}
+    # Hostile labels round-trip on the new pod/model dimensions.
+    (circuit,) = families["gateway_circuit_state"]
+    assert circuit.labels["pod"] == HOSTILE and circuit.value == 1.0  # open
+    assert any(s.labels.get("model") == HOSTILE
+               for s in families["gateway_client_disconnects_total"])
+    # The breaker transition landed in the event-counter family.
+    assert any(s.labels["kind"] == "circuit_transition"
+               for s in families["gateway_events_total"])
+
+
 def test_empty_observability_state_still_lints():
     """Fresh proxy, zero traffic: the composed page must still parse (the
     would-avoid/upstream counters render unlabeled 0 fallbacks; SLO and

@@ -370,3 +370,113 @@ class TestAttachSemantics:
                 dec.attach_prefilled(h)
         finally:
             pre.stop(), dec.stop()
+
+
+class TestAbandonedHandoffRelease:
+    """Regression (robustness PR): a decode-hop failure after a successful
+    prefill hop abandons imported KV on the decode replica — the gateway's
+    best-effort ``release_request`` (and the engine's ``handoff_ttl_s``
+    sweep as the backstop) must free it instead of decoding tokens nobody
+    will read."""
+
+    def _parked_attach(self, dec, pre):
+        """Fill every decode slot, then attach a handoff so it PARKS in
+        decode_wait (the abandoned-work position).  Returns (attached
+        request, blockers)."""
+        blockers = [make_req(prompt=(1, 2, 3 + i), max_new=200)
+                    for i in range(2)]
+        for b in blockers:
+            dec.submit(b)
+        wire = pre.prefill_only(make_req(max_new=8), timeout_s=180).to_bytes()
+        req = dec.attach_prefilled(PrefillHandoff.from_bytes(wire))
+        deadline = 60.0
+        import time as time_mod
+
+        t0 = time_mod.monotonic()
+        while dec.metrics_snapshot()["kv_parked_tokens"] == 0:
+            assert time_mod.monotonic() - t0 < deadline, "never parked"
+            time_mod.sleep(0.02)
+        return req, blockers
+
+    def _finish_blockers(self, dec, blockers):
+        for b in blockers:
+            b.cancelled.set()
+        for b in blockers:
+            assert b.done.wait(60)
+
+    def test_release_request_frees_parked_attach(self):
+        pre = make_engine(role="prefill")
+        dec = make_engine(role="decode")
+        try:
+            req, blockers = self._parked_attach(dec, pre)
+            assert dec.release_request(req.request_id) is True
+            assert req.done.wait(60)
+            assert req.finish_reason == "cancelled"
+            import time as time_mod
+
+            t0 = time_mod.monotonic()
+            while dec.metrics_snapshot()["kv_parked_tokens"] != 0:
+                assert time_mod.monotonic() - t0 < 60
+                time_mod.sleep(0.02)
+            # Idempotent: the request is no longer live.
+            assert dec.release_request(req.request_id) is False
+            # Unknown ids are a clean no-op.
+            assert dec.release_request("no-such-id") is False
+            self._finish_blockers(dec, blockers)
+        finally:
+            pre.stop(), dec.stop()
+
+    def test_handoff_ttl_sweep_is_the_backstop(self):
+        """With the release message lost, the TTL sweep frees a parked
+        import on its own; a NON-handoff parked prefill is never TTL-swept
+        (its caller is still waiting on done)."""
+        pre = make_engine(role="prefill")
+        dec = make_engine(role="decode", handoff_ttl_s=0.3)
+        try:
+            req, blockers = self._parked_attach(dec, pre)
+            assert req.done.wait(60)  # swept without any release call
+            assert req.finish_reason == "cancelled"
+            assert dec.metrics_snapshot()["kv_parked_tokens"] == 0
+            self._finish_blockers(dec, blockers)
+        finally:
+            pre.stop(), dec.stop()
+
+    def test_release_endpoint_over_http(self):
+        """The ``POST /v1/prefill/release`` surface end-to-end against a
+        real engine: parked attach -> released true; repeat -> false."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_instance_gateway_tpu.server.api_http import ModelServer
+
+        pre = make_engine(role="prefill")
+        dec = make_engine(role="decode")
+        try:
+            req, blockers = self._parked_attach(dec, pre)
+            server = ModelServer(dec, tokenizer=None, model_name="m")
+
+            async def run():
+                client = TestClient(TestServer(server.build_app()))
+                await client.start_server()
+                try:
+                    r1 = await client.post(
+                        "/v1/prefill/release",
+                        json={"request_id": req.request_id})
+                    assert r1.status == 200
+                    assert (await r1.json())["released"] is True
+                    assert req.done.wait(60)
+                    r2 = await client.post(
+                        "/v1/prefill/release",
+                        json={"request_id": req.request_id})
+                    assert (await r2.json())["released"] is False
+                    r3 = await client.post("/v1/prefill/release",
+                                           json={"nope": 1})
+                    assert r3.status == 400
+                finally:
+                    await client.close()
+
+            asyncio.run(run())
+            self._finish_blockers(dec, blockers)
+        finally:
+            pre.stop(), dec.stop()

@@ -256,13 +256,26 @@ def test_shed_and_upstream_error_events():
         await run_proxy_request(proxy, body={"model": "batch", "prompt": "x"})
         assert [e["attrs"]["model"] for e in
                 proxy.journal.events(kind=events.SHED)] == ["batch"]
-        # Nothing listens on 127.0.0.1:1 -> upstream_error + health streak.
+        # Nothing listens on 127.0.0.1:1 -> each attempt journals an
+        # upstream_error, the budgeted retries fire (1 pod: the re-pick
+        # lands on the same dead pod), then 502.
         status, _, _ = await run_proxy_request(
             proxy, body={"model": "m", "prompt": "x"})
         assert status == 502
-        (err,) = proxy.journal.events(kind=events.UPSTREAM_ERROR)
-        assert err["attrs"]["pod"] == "p"
-        assert proxy.health.upstream_errors["p"] == 1
+        attempts = 1 + proxy.resilience.cfg.max_retries
+        errs = proxy.journal.events(kind=events.UPSTREAM_ERROR)
+        assert len(errs) == attempts
+        assert errs[0]["attrs"]["pod"] == "p"
+        retries = proxy.journal.events(kind=events.RETRY)
+        assert [e["attrs"]["attempt"] for e in retries] == \
+            list(range(1, attempts))
+        assert proxy.health.upstream_errors["p"] == attempts
+        # The failed CLIENT request counts once in gateway_errors_total;
+        # the retries are their own labeled family.
+        text = proxy.metrics.render()
+        assert 'gateway_errors_total{model="m"} 1' in text
+        assert f'gateway_retries_total{{reason="connect"}} {attempts - 1}' \
+            in text
 
     asyncio.run(run())
 

@@ -1,0 +1,857 @@
+"""Resilience-plane tests: circuit breaker, retry budget, enforcing health
+policy (avoid/strict vs the pinned log_only), the proxy's retry/hedge data
+path with per-phase timeouts, client-disconnect accounting, and the seeded
+chaos scenarios (slow-marked; ``make chaos`` runs the same set standalone).
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu import events
+from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+from llm_instance_gateway_tpu.gateway import health, resilience
+from llm_instance_gateway_tpu.gateway.datastore import Datastore
+from llm_instance_gateway_tpu.gateway.handlers.server import Server
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    SchedulingError,
+    filter_by_policy,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.testing import fake_metrics, make_model
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+REQ = LLMRequest(model="m", resolved_target_model="m", critical=True)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_breaker(journal=None, clock=None, **overrides):
+    kwargs = dict(trip_consecutive=3, trip_error_rate=0.5, error_window=8,
+                  min_volume=4, open_cooldown_s=10.0, half_open_probes=1)
+    kwargs.update(overrides)
+    return resilience.CircuitBreaker(resilience.ResilienceConfig(**kwargs),
+                                     journal=journal,
+                                     clock=clock or FakeClock())
+
+
+class TestCircuitBreaker:
+    def test_trips_on_consecutive_failures(self):
+        j = events.EventJournal()
+        b = make_breaker(journal=j)
+        for _ in range(2):
+            b.record("p", ok=False)
+        assert b.state("p") == resilience.CLOSED
+        b.record("p", ok=False)
+        assert b.state("p") == resilience.OPEN
+        assert not b.allow("p")
+        (t,) = j.events(kind=events.CIRCUIT_TRANSITION)
+        assert t["attrs"] == {"pod": "p", "frm": "closed", "to": "open"}
+
+    def test_success_resets_streak(self):
+        # High rate threshold so only the consecutive-streak trip is in
+        # play for this case.
+        b = make_breaker(trip_error_rate=0.99)
+        for _ in range(2):
+            b.record("p", ok=False)
+        b.record("p", ok=True)
+        for _ in range(2):
+            b.record("p", ok=False)
+        assert b.state("p") == resilience.CLOSED
+
+    def test_trips_on_windowed_error_rate(self):
+        b = make_breaker()
+        # Alternate so the consecutive streak never reaches 3, but the
+        # window (>= min_volume=4) crosses the 50% error rate.
+        for ok in (True, False, True, False, False):
+            b.record("p", ok=ok)
+        assert b.state("p") == resilience.OPEN
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = FakeClock()
+        j = events.EventJournal()
+        b = make_breaker(journal=j, clock=clock)
+        for _ in range(3):
+            b.record("p", ok=False)
+        assert not b.allow("p")  # open, inside cooldown
+        clock.t += 11.0
+        assert b.state("p") == resilience.HALF_OPEN
+        assert b.allow("p")
+        b.note_pick("p")           # the probe is in flight...
+        assert not b.allow("p")    # ...and the quota (1) is spent
+        b.record("p", ok=True)
+        assert b.state("p") == resilience.CLOSED
+        kinds = [e["attrs"]["to"] for e in
+                 j.events(kind=events.CIRCUIT_TRANSITION)]
+        assert kinds == ["open", "half_open", "closed"]
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        b = make_breaker(clock=clock)
+        for _ in range(3):
+            b.record("p", ok=False)
+        clock.t += 11.0
+        b.note_pick("p")
+        b.record("p", ok=False)
+        assert b.state("p") == resilience.OPEN
+        assert not b.allow("p")  # fresh cooldown
+
+    def test_stale_probe_slot_is_reaped(self):
+        """A probe pick whose outcome never comes back (client vanished,
+        hedge loser cancelled) must not leave the pod probe-quota-full —
+        and therefore avoid-excluded — forever: the slot frees after
+        another cooldown."""
+        clock = FakeClock()
+        b = make_breaker(clock=clock)
+        for _ in range(3):
+            b.record("p", ok=False)
+        clock.t += 11.0
+        assert b.allow("p")
+        b.note_pick("p")          # probe admitted...
+        assert not b.allow("p")   # ...quota spent, and no outcome EVER comes
+        clock.t += 11.0           # one more cooldown: the slot is reaped
+        assert b.allow("p")
+        assert "p" not in b.blocked_set()
+
+    def test_prune_drops_departed_pods(self):
+        b = make_breaker()
+        for _ in range(3):
+            b.record("gone", ok=False)
+        b.prune({"alive"})
+        assert b.state("gone") == resilience.CLOSED
+        assert b.render() == []
+
+    def test_render_states(self):
+        clock = FakeClock()
+        b = make_breaker(clock=clock)
+        b.record("a", ok=True)
+        for _ in range(3):
+            b.record("b", ok=False)
+        text = "\n".join(b.render())
+        assert "# TYPE gateway_circuit_state gauge" in text
+        assert 'gateway_circuit_state{pod="a"} 0' in text
+        assert 'gateway_circuit_state{pod="b"} 1' in text
+        clock.t += 11.0
+        assert 'gateway_circuit_state{pod="b"} 2' in "\n".join(b.render())
+
+
+class TestRetryBudget:
+    def test_budget_bounds_retry_volume(self):
+        budget = resilience.RetryBudget(ratio=0.5, min_tokens=2.0, cap=10.0)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()  # min tokens exhausted
+        for _ in range(4):
+            budget.note_request()       # 4 * 0.5 = 2 tokens back
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()
+        assert budget.denied_total == 2
+
+    def test_cap(self):
+        budget = resilience.RetryBudget(ratio=1.0, min_tokens=0.0, cap=3.0)
+        for _ in range(100):
+            budget.note_request()
+        assert budget.tokens == 3.0
+
+    def test_backoff_decorrelated_jitter_bounds(self):
+        rng = random.Random(0)
+        prev = 0.025
+        for _ in range(100):
+            nxt = resilience.retry_backoff(rng, prev, 0.025, 1.0)
+            assert 0.025 <= nxt <= 1.0
+            prev = nxt
+
+
+class _Advisor:
+    """Minimal advisor double for filter_by_policy."""
+
+    def __init__(self, policy, avoid=()):
+        self.policy = policy
+        self._avoid = set(avoid)
+        self.escapes = 0
+
+    def should_avoid(self, name):
+        return name in self._avoid
+
+    def note_escape_hatch(self):
+        self.escapes += 1
+
+    def note_pick(self, name):
+        pass
+
+
+def _pods(*names):
+    return [PodMetrics(pod=Pod(n, f"10.0.0.{i}:8000"), metrics=Metrics())
+            for i, n in enumerate(names)]
+
+
+class TestFilterByPolicy:
+    def test_log_only_returns_identical_object(self):
+        pods = _pods("a", "b")
+        assert filter_by_policy(_Advisor("log_only", {"a", "b"}), pods) \
+            is pods
+        assert filter_by_policy(None, pods) is pods
+
+    def test_avoid_filters_avoidable(self):
+        pods = _pods("a", "b", "c")
+        out = filter_by_policy(_Advisor("avoid", {"b"}), pods)
+        assert [pm.pod.name for pm in out] == ["a", "c"]
+
+    def test_avoid_escape_hatch_serves_fully_unhealthy_pool(self):
+        pods = _pods("a", "b")
+        adv = _Advisor("avoid", {"a", "b"})
+        assert filter_by_policy(adv, pods) is pods
+        assert adv.escapes == 1
+
+    def test_strict_sheds_fully_unhealthy_pool(self):
+        with pytest.raises(SchedulingError) as ei:
+            filter_by_policy(_Advisor("strict", {"a", "b"}), _pods("a", "b"))
+        assert ei.value.shed
+
+
+def make_plane(provider, policy="avoid", journal=None, **cfg_overrides):
+    cfg = resilience.ResilienceConfig(health_policy=policy, **cfg_overrides)
+    scorer = health.HealthScorer(
+        provider=provider, journal=journal,
+        cfg=health.HealthConfig(dwell_ticks=2))
+    return resilience.ResiliencePlane(scorer, cfg=cfg, journal=journal)
+
+
+def degraded_plane(provider, bad="pod-b", policy="avoid", **cfg_overrides):
+    plane = make_plane(provider, policy=policy, **cfg_overrides)
+    plane.health.update(now=100.0)
+    for _ in range(6):
+        plane.health.record_upstream(bad, ok=False)
+    plane.health.update(now=105.0)
+    plane.health.update(now=110.0)
+    assert plane.health.state(bad) == health.DEGRADED
+    return plane
+
+
+class TestSchedulerEnforcement:
+    def _provider(self):
+        return StaticProvider(_pods("pod-a", "pod-b"))
+
+    def test_avoid_steers_picks_off_degraded_pod(self):
+        provider = self._provider()
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.health_advisor = degraded_plane(provider)
+        picks = [sched.schedule(REQ).name for _ in range(32)]
+        assert set(picks) == {"pod-a"}
+
+    def test_avoid_open_circuit_steers_picks(self):
+        provider = self._provider()
+        plane = make_plane(provider)
+        plane.health.update(now=100.0)  # both pods healthy
+        for _ in range(plane.cfg.trip_consecutive):
+            plane.breaker.record("pod-b", ok=False)
+        assert plane.breaker.state("pod-b") == resilience.OPEN
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.health_advisor = plane
+        picks = [sched.schedule(REQ).name for _ in range(16)]
+        assert set(picks) == {"pod-a"}
+
+    def test_avoid_escape_hatch_when_all_pods_bad(self):
+        provider = self._provider()
+        plane = make_plane(provider)
+        plane.health.update(now=100.0)
+        for pod in ("pod-a", "pod-b"):
+            for _ in range(plane.cfg.trip_consecutive):
+                plane.breaker.record(pod, ok=False)
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.health_advisor = plane
+        # Fully-unhealthy pool still serves (last-resort escape hatch).
+        picks = {sched.schedule(REQ).name for _ in range(16)}
+        assert picks == {"pod-a", "pod-b"}
+        assert plane.escape_hatch_total == 16
+
+    def test_strict_sheds_when_all_pods_bad(self):
+        provider = self._provider()
+        plane = make_plane(provider, policy="strict")
+        plane.health.update(now=100.0)
+        for pod in ("pod-a", "pod-b"):
+            for _ in range(plane.cfg.trip_consecutive):
+                plane.breaker.record(pod, ok=False)
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.health_advisor = plane
+        with pytest.raises(SchedulingError) as ei:
+            sched.schedule(REQ)
+        assert ei.value.shed
+
+    def test_log_only_plane_is_byte_identical(self):
+        """The full ResiliencePlane (not just the bare scorer) under
+        log_only: picks match an advisor-less scheduler draw for draw,
+        even with a degraded pod AND an open breaker."""
+        provider = self._provider()
+        mk = lambda: Scheduler(provider, token_aware=False,  # noqa: E731
+                               prefill_aware=False, prefix_aware=False,
+                               rng=random.Random(7))
+        plain, advised = mk(), mk()
+        plane = degraded_plane(provider, policy="log_only")
+        for _ in range(plane.cfg.trip_consecutive):
+            plane.breaker.record("pod-b", ok=False)
+        advised.health_advisor = plane
+        assert [plain.schedule(REQ).name for _ in range(64)] == \
+            [advised.schedule(REQ).name for _ in range(64)]
+
+    def test_native_scheduler_avoid_parity(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = self._provider()
+        sched = native.NativeScheduler(provider, token_aware=False,
+                                       prefill_aware=False,
+                                       prefix_aware=False,
+                                       rng=random.Random(7))
+        sched.health_advisor = degraded_plane(provider)
+        picks = [sched.schedule(REQ).name for _ in range(32)]
+        assert set(picks) == {"pod-a"}
+
+    def test_disaggregated_decode_hop_avoids(self):
+        pods = [
+            PodMetrics(pod=Pod("pre", "10.0.0.1:8000", role="prefill"),
+                       metrics=Metrics()),
+            PodMetrics(pod=Pod("dec-a", "10.0.0.2:8000", role="decode"),
+                       metrics=Metrics()),
+            PodMetrics(pod=Pod("dec-b", "10.0.0.3:8000", role="decode"),
+                       metrics=Metrics()),
+        ]
+        provider = StaticProvider(pods)
+        plane = make_plane(provider)
+        plane.health.update(now=100.0)
+        for _ in range(plane.cfg.trip_consecutive):
+            plane.breaker.record("dec-b", ok=False)
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.health_advisor = plane
+        picks = [sched.schedule_disaggregated(REQ) for _ in range(16)]
+        assert {p.name for p, _ in picks} == {"pre"}
+        assert {d.name for _, d in picks} == {"dec-a"}
+
+
+# ---------------------------------------------------------------------------
+# Proxy data path: retries, timeouts, hedging, disconnect accounting
+# ---------------------------------------------------------------------------
+
+
+async def start_upstream(name: str, behavior: str = "ok",
+                         delay_s: float = 0.0):
+    """Fake OpenAI upstream: behavior = ok | hang | error503."""
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        if behavior == "hang":
+            await asyncio.sleep(30)
+        if delay_s:
+            await asyncio.sleep(delay_s)
+        if behavior == "error503":
+            return web.Response(status=503, text="draining")
+        body = await request.json()
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for i in range(4):
+                await resp.write(
+                    b'data: {"choices": [{"index": 0, "text": "t"}]}\n\n')
+                await asyncio.sleep(0.05)
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        return web.json_response({
+            "id": "cmpl-1", "object": "text_completion", "served_by": name,
+            "model": body.get("model"),
+            "choices": [{"index": 0, "text": "hi", "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 4, "completion_tokens": 2,
+                      "total_tokens": 6},
+        })
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", completions)
+    server = TestServer(app)
+    await server.start_server()
+    return server
+
+
+def build_proxy(pods: dict, rcfg: resilience.ResilienceConfig,
+                seed: int = 7) -> GatewayProxy:
+    ds = Datastore(pods=list(pods))
+    ds.set_pool(InferencePool(name="pool"))
+    ds.store_model(make_model("m"))
+    provider = StaticProvider(
+        [PodMetrics(pod=p, metrics=m) for p, m in pods.items()])
+    scheduler = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(seed))
+    return GatewayProxy(Server(scheduler, ds), provider, ds,
+                        resilience_cfg=rcfg)
+
+
+async def run_via_client(proxy, body, n=1):
+    client = TestClient(TestServer(proxy.build_app()))
+    await client.start_server()
+    out = []
+    try:
+        for _ in range(n):
+            resp = await client.post("/v1/completions", json=body)
+            out.append((resp.status, await resp.read()))
+    finally:
+        await client.close()
+    return out
+
+
+def test_retry_reroutes_around_dead_pod():
+    """A dead replica in the pool: the retry loop re-picks and lands on
+    the live one; the retry is counted by reason and journaled."""
+
+    async def run():
+        up = await start_upstream("live")
+        pods = {
+            Pod("dead", "127.0.0.1:1"): fake_metrics(),
+            Pod("live", f"127.0.0.1:{up.port}"): fake_metrics(),
+        }
+        rcfg = resilience.ResilienceConfig(
+            health_policy="avoid", max_retries=3, retry_budget_min=8.0,
+            backoff_base_s=0.001, backoff_cap_s=0.01)
+        proxy = build_proxy(pods, rcfg)
+        results = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"}, n=8)
+        await up.close()
+        assert all(status == 200 for status, _ in results), results
+        assert json.loads(results[0][1])["served_by"] == "live"
+        text = proxy.metrics.render()
+        # Some requests first landed on the dead pod and retried over.
+        assert proxy.metrics.retries_total.get("connect", 0) >= 1, text
+        retry_events = proxy.journal.events(kind=events.RETRY)
+        assert retry_events and all(
+            e["attrs"]["reason"] == "connect" for e in retry_events)
+        # The failed client request count stays zero: every request
+        # ultimately succeeded.
+        assert "gateway_errors_total 0" in text
+
+    asyncio.run(run())
+
+
+def test_retry_budget_exhaustion_stops_retrying():
+    async def run():
+        pods = {Pod("dead", "127.0.0.1:1"): fake_metrics()}
+        rcfg = resilience.ResilienceConfig(
+            max_retries=5, retry_budget_min=1.0, retry_budget_ratio=0.0,
+            backoff_base_s=0.001, backoff_cap_s=0.01)
+        proxy = build_proxy(pods, rcfg)
+        (s1, _), (s2, _) = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"}, n=2)
+        assert s1 == 502 and s2 == 502
+        # One retry token existed in total: request 1 spent it, request 2
+        # retried zero times.
+        assert sum(proxy.metrics.retries_total.values()) == 1
+        assert proxy.resilience.retry_budget.denied_total >= 1
+
+    asyncio.run(run())
+
+
+def test_ttft_timeout_yields_504_and_opens_circuit():
+    async def run():
+        up = await start_upstream("hung", behavior="hang")
+        pods = {Pod("hung", f"127.0.0.1:{up.port}"): fake_metrics()}
+        rcfg = resilience.ResilienceConfig(
+            ttft_timeout_s=0.15, max_retries=1, retry_budget_min=4.0,
+            trip_consecutive=2, backoff_base_s=0.001, backoff_cap_s=0.01)
+        proxy = build_proxy(pods, rcfg)
+        (status, body), = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"})
+        await up.close()
+        assert status == 504
+        assert b"ttft_timeout" in body
+        # 2 attempts x ttft timeout tripped the 2-failure breaker.
+        assert proxy.resilience.breaker.state("hung") == resilience.OPEN
+        assert 'gateway_circuit_state{pod="hung"} 1' in \
+            proxy._render_metrics()
+        assert proxy.health.upstream_timeouts["hung"] == 2
+
+    asyncio.run(run())
+
+
+def test_503_is_retried():
+    async def run():
+        up_bad = await start_upstream("drain", behavior="error503")
+        up_ok = await start_upstream("live")
+        pods = {
+            Pod("drain", f"127.0.0.1:{up_bad.port}"): fake_metrics(),
+            Pod("live", f"127.0.0.1:{up_ok.port}"): fake_metrics(),
+        }
+        rcfg = resilience.ResilienceConfig(
+            health_policy="avoid", max_retries=3, retry_budget_min=16.0,
+            backoff_base_s=0.001, backoff_cap_s=0.01)
+        proxy = build_proxy(pods, rcfg)
+        results = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"}, n=8)
+        await up_bad.close()
+        await up_ok.close()
+        assert all(s == 200 for s, _ in results)
+        assert proxy.metrics.retries_total.get("upstream_503", 0) >= 1
+
+    asyncio.run(run())
+
+
+def test_stream_that_never_starts_is_retried():
+    """An upstream that sends SSE headers but never a first chunk: no byte
+    has reached the client, so the failure is retried onto the live pod —
+    the client sees a clean 200 stream, not a committed-then-broken one."""
+
+    async def run():
+        async def headers_only(request: web.Request) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await asyncio.sleep(30)
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", headers_only)
+        dead = TestServer(app)
+        await dead.start_server()
+        live = await start_upstream("live")
+        pods = {
+            Pod("headers-only", f"127.0.0.1:{dead.port}"): fake_metrics(),
+            Pod("live", f"127.0.0.1:{live.port}"): fake_metrics(),
+        }
+        rcfg = resilience.ResilienceConfig(
+            health_policy="avoid", ttft_timeout_s=0.2,
+            stream_idle_timeout_s=2.0, max_retries=4, retry_budget_min=16.0,
+            trip_consecutive=2, backoff_base_s=0.001, backoff_cap_s=0.01)
+        proxy = build_proxy(pods, rcfg)
+        results = await run_via_client(
+            proxy, {"model": "m", "prompt": "x", "stream": True}, n=6)
+        await dead.close()
+        await live.close()
+        for status, raw in results:
+            assert status == 200
+            assert b"upstream stream interrupted" not in raw
+            assert raw.rstrip().endswith(b"data: [DONE]")
+        assert proxy.metrics.retries_total.get("ttft_timeout", 0) >= 1
+        assert proxy.resilience.breaker.state("headers-only") == \
+            resilience.OPEN
+
+    asyncio.run(run())
+
+
+def test_blackholed_disagg_hop_bounded_and_falls_back():
+    """A blackholed prefill replica in a role-split pool: the hop awaits
+    are bounded by the per-phase timeouts, so the request degrades to
+    single-hop fallback in bounded time instead of hanging forever."""
+
+    async def run():
+        async def hang(request: web.Request) -> web.Response:
+            await asyncio.sleep(30)
+            return web.Response(status=503)
+
+        async def completions(request: web.Request) -> web.Response:
+            body = await request.json()
+            return web.json_response({
+                "id": "c", "object": "text_completion", "served_by": "pre",
+                "model": body.get("model"),
+                "choices": [{"index": 0, "text": "ok",
+                             "finish_reason": "stop"}],
+                "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                          "total_tokens": 2},
+            })
+
+        app = web.Application()
+        app.router.add_post("/v1/prefill", hang)       # blackholed hop
+        app.router.add_post("/v1/completions", completions)
+        up = TestServer(app)
+        await up.start_server()
+        pods = {
+            Pod("pre", f"127.0.0.1:{up.port}", role="prefill"):
+                fake_metrics(),
+            Pod("dec", "127.0.0.1:1", role="decode"): fake_metrics(),
+        }
+        rcfg = resilience.ResilienceConfig(
+            ttft_timeout_s=0.2, stream_idle_timeout_s=1.0, max_retries=0)
+        proxy = build_proxy(pods, rcfg)
+        t0 = time.monotonic()
+        (status, body), = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"})
+        await up.close()
+        assert status == 200, body  # single-hop fallback on the prefill pod
+        assert json.loads(body)["served_by"] == "pre"
+        assert time.monotonic() - t0 < 5.0  # bounded, not the old forever
+        fallbacks = proxy.journal.events(kind=events.DISAGG_FALLBACK)
+        assert len(fallbacks) == 1
+
+    asyncio.run(run())
+
+
+def test_stream_idle_timeout_terminates_stream():
+    """An upstream that starts an SSE stream then stalls: the idle bound
+    fires and the client gets the error event + [DONE] instead of a hung
+    socket."""
+
+    async def run():
+        async def stalling(request: web.Request) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"choices":[{"text":"a"}]}\n\n')
+            await asyncio.sleep(30)
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", stalling)
+        up = TestServer(app)
+        await up.start_server()
+        pods = {Pod("stall", f"127.0.0.1:{up.port}"): fake_metrics()}
+        rcfg = resilience.ResilienceConfig(
+            ttft_timeout_s=2.0, stream_idle_timeout_s=0.2, max_retries=0)
+        proxy = build_proxy(pods, rcfg)
+        t0 = time.monotonic()
+        (status, raw), = await run_via_client(
+            proxy, {"model": "m", "prompt": "x", "stream": True})
+        await up.close()
+        assert status == 200  # headers were already streaming
+        assert time.monotonic() - t0 < 5.0
+        assert b"upstream stream interrupted" in raw
+        assert raw.rstrip().endswith(b"data: [DONE]")
+        assert proxy.health.upstream_timeouts["stall"] == 1
+
+    asyncio.run(run())
+
+
+def test_hedge_no_candidate_single_pod():
+    """Hedging enabled but the pool has one pod: the repick can't find a
+    different replica — outcome 'no_candidate', request still served by
+    the (slow) primary."""
+
+    async def run():
+        up = await start_upstream("slow", delay_s=0.2)
+        pods = {Pod("slow", f"127.0.0.1:{up.port}"): fake_metrics()}
+        rcfg = resilience.ResilienceConfig(hedge_ttft_s=0.05,
+                                           ttft_timeout_s=5.0)
+        proxy = build_proxy(pods, rcfg)
+        (status, _), = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"})
+        await up.close()
+        assert status == 200
+        assert proxy.metrics.hedges_total == {"no_candidate": 1}
+
+    asyncio.run(run())
+
+
+def test_hedge_wins_against_slow_primary():
+    """Two pods, one browned out: requests that land on the slow pod hedge
+    to the fast one and the hedge wins."""
+
+    async def run():
+        slow = await start_upstream("slow", delay_s=0.5)
+        fast = await start_upstream("fast")
+        pods = {
+            Pod("slow", f"127.0.0.1:{slow.port}"): fake_metrics(),
+            Pod("fast", f"127.0.0.1:{fast.port}"): fake_metrics(),
+        }
+        rcfg = resilience.ResilienceConfig(hedge_ttft_s=0.05,
+                                           ttft_timeout_s=5.0)
+        proxy = build_proxy(pods, rcfg)
+        results = await run_via_client(
+            proxy, {"model": "m", "prompt": "x"}, n=10)
+        await slow.close()
+        await fast.close()
+        assert all(s == 200 for s, _ in results)
+        hedges = proxy.metrics.hedges_total
+        assert hedges.get("fired", 0) >= 1, hedges
+        assert hedges.get("won", 0) >= 1, hedges
+        hedge_events = proxy.journal.events(kind=events.HEDGE)
+        assert any(e["attrs"]["pod"] == "slow" and
+                   e["attrs"]["hedge_pod"] == "fast" for e in hedge_events)
+        assert 'gateway_hedges_total{outcome="won"}' in proxy.metrics.render()
+
+    asyncio.run(run())
+
+
+def test_client_disconnect_mid_stream_is_accounted():
+    """Satellite: a client dropping a live SSE relay journals
+    client_disconnect, bumps the counter, and the partial request still
+    lands in the e2e histograms."""
+
+    async def run():
+        async def slow_stream(request: web.Request) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for _ in range(50):
+                await resp.write(b'data: {"choices":[{"text":"x"}]}\n\n')
+                await asyncio.sleep(0.05)
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", slow_stream)
+        up = TestServer(app)
+        await up.start_server()
+        pods = {Pod("p", f"127.0.0.1:{up.port}"): fake_metrics()}
+        proxy = build_proxy(pods, resilience.ResilienceConfig(
+            stream_idle_timeout_s=2.0, ttft_timeout_s=2.0))
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "m", "prompt": "x", "stream": True})
+            await resp.content.read(10)  # first bytes arrived...
+            resp.close()                 # ...then the client walks away
+            for _ in range(40):          # the relay notices on next write
+                if proxy.journal.events(kind=events.CLIENT_DISCONNECT):
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            await client.close()
+            await up.close()
+        (ev,) = proxy.journal.events(kind=events.CLIENT_DISCONNECT)
+        assert ev["attrs"]["pod"] == "p"
+        text = proxy.metrics.render()
+        assert 'gateway_client_disconnects_total{model="m"} 1' in text
+        # The partial request was observed into the e2e histogram.
+        assert 'gateway_e2e_seconds_count{model="m",path="collocated"} 1' \
+            in text
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos scenarios (the same set `make chaos` runs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["blackhole", "brownout", "midstream",
+                                      "scrape_flap", "handoff"])
+def test_chaos_scenario(scenario):
+    from tools import chaos
+
+    report = chaos.run_scenario(scenario, seed=0)
+    assert report["scenario"] == scenario
+
+
+# ---------------------------------------------------------------------------
+# 3-process e2e fault injection (real servers, LIG_FAULTS schedule file)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_e2e_blackhole_reroutes_with_avoid_policy(tmp_path):
+    """Acceptance: real gateway + two real model servers, one blackholed
+    via the LIG_FAULTS schedule — with health_policy=avoid every request
+    still succeeds (>99%), traffic converges onto the live replica, and
+    the breaker opens on the blackholed one."""
+    import os
+    import urllib.request
+
+    from tests.test_e2e_local import (
+        _launch_module,
+        _teardown_procs,
+        _wait_http,
+    )
+
+    srv1, srv2, gw = 18851, 18852, 18855
+    config = tmp_path / "pool.yaml"
+    config.write_text(f"""\
+kind: InferencePool
+metadata: {{name: chaos-pool, resourceVersion: "1"}}
+spec: {{selector: {{app: chaos}}, targetPortNumber: {srv1}}}
+---
+kind: InferenceModel
+metadata: {{name: llama3-tiny}}
+spec: {{modelName: llama3-tiny, criticality: Critical, poolRef: {{name: chaos-pool}}}}
+""")
+    faults = tmp_path / "faults.json"
+    faults.write_text(json.dumps({
+        "seed": 0,
+        "faults": [{"kind": "blackhole", "start_s": 0.0}],
+    }))
+    procs = []
+
+    def launch(args, log_name, extra_env=None):
+        old = {}
+        for k, v in (extra_env or {}).items():
+            old[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            entry = _launch_module(args, tmp_path / log_name,
+                                   cwd=str(tmp_path))
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        procs.append(entry)
+
+    common = ["llm_instance_gateway_tpu.server.api_http", "--model",
+              "llama3-tiny", "--platform", "cpu", "--decode-slots", "2",
+              "--max-seq-len", "128", "--dtype", "float32"]
+    try:
+        launch(common + ["--port", str(srv1)], "srv1.log")
+        launch(common + ["--port", str(srv2)], "srv2.log",
+               extra_env={"LIG_FAULTS": str(faults)})
+        for port in (srv1, srv2):
+            _wait_http(f"http://127.0.0.1:{port}/health")
+        body = {"model": "llama3-tiny", "prompt": "hello", "max_tokens": 4,
+                "temperature": 0}
+        # Warm the live replica DIRECTLY (first request pays jit compile,
+        # which must not eat the gateway's TTFT budget below).
+        warm = urllib.request.Request(
+            f"http://127.0.0.1:{srv1}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(warm, timeout=120) as resp:
+            assert resp.status == 200
+        # max-retries 6 > trip_consecutive (5): even if the FIRST request
+        # re-picks the blackholed pod repeatedly, its failures trip the
+        # breaker mid-request and the next re-pick avoids it — no request
+        # can exhaust its attempts before enforcement kicks in.
+        launch(
+            ["llm_instance_gateway_tpu.gateway.proxy", "--config",
+             str(config), "--port", str(gw),
+             "--pod", f"srv1=127.0.0.1:{srv1}",
+             "--pod", f"srv2=127.0.0.1:{srv2}",
+             "--health-policy", "avoid", "--ttft-timeout-s", "5.0",
+             "--max-retries", "6", "--retry-budget-ratio", "1.0"],
+            "gateway.log")
+        _wait_http(f"http://127.0.0.1:{gw}/healthz")
+        time.sleep(2.0)  # one provider pod-refresh cycle
+
+        served, statuses = [], []
+        for _ in range(12):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw}/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                statuses.append(resp.status)
+                served.append(resp.headers.get("x-served-by"))
+        success = statuses.count(200) / len(statuses)
+        assert success > 0.99, (statuses, served)
+        assert set(served) == {"srv1"}, served  # converged on the live pod
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw}/metrics", timeout=10) as resp:
+            metrics = resp.read().decode()
+        assert 'gateway_circuit_state{pod="srv2"} 1' in metrics, metrics
+        assert "gateway_retries_total" in metrics
+    finally:
+        _teardown_procs(procs)

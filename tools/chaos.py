@@ -1,0 +1,359 @@
+#!/usr/bin/env python
+"""Deterministic chaos runner for the in-process gateway stack.
+
+Builds the REAL proxy (scheduler + admission + health + resilience plane)
+over fake chaos upstreams (``gateway/faultinject.py``), applies a seeded
+fault schedule, drives load, and asserts recovery invariants per scenario:
+
+====================  ====================================================
+``blackhole``         faulted pod stops getting picks within 2 health
+                      ticks (breaker + avoid policy); success rate > 99%
+``brownout``          slow-TTFT pod: hedges fire and win; all requests ok
+``midstream``         mid-stream upstream cut: clients get the error
+                      event + [DONE]; the journal records it; stack lives
+``scrape_flap``       scrape-plane-only failure steers routing off the
+                      pod within 2 ticks with zero data-path errors
+``handoff``           decode-hop failures fall back single-hop; an
+                      abandoned attach triggers the KV release call
+====================  ====================================================
+
+Usage: ``python tools/chaos.py --seed 0 --scenario all`` (``make chaos``).
+Exits non-zero when any scenario's invariant fails; prints one JSON report
+line per scenario.  ``tests/test_resilience.py`` runs the same scenarios
+as a ``slow``-marked pytest, so tier-1 stays fast.
+
+Health ticks are driven EXPLICITLY (``proxy.resilience.tick()`` between
+request rounds) instead of by the background task, so "within N ticks"
+assertions are deterministic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from aiohttp.test_utils import TestClient, TestServer  # noqa: E402
+
+from llm_instance_gateway_tpu import events as events_mod  # noqa: E402
+from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool  # noqa: E402
+from llm_instance_gateway_tpu.gateway import faultinject  # noqa: E402
+from llm_instance_gateway_tpu.gateway.datastore import Datastore  # noqa: E402
+from llm_instance_gateway_tpu.gateway.handlers.server import Server  # noqa: E402
+from llm_instance_gateway_tpu.gateway.health import HealthConfig  # noqa: E402
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider  # noqa: E402
+from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy  # noqa: E402
+from llm_instance_gateway_tpu.gateway.resilience import (  # noqa: E402
+    ResilienceConfig,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (  # noqa: E402
+    Scheduler,
+)
+from llm_instance_gateway_tpu.gateway.testing import make_model  # noqa: E402
+from llm_instance_gateway_tpu.gateway.types import (  # noqa: E402
+    Metrics,
+    Pod,
+    PodMetrics,
+)
+
+GOOD, BAD = "pod-good", "pod-bad"
+
+
+class ChaosStack:
+    """One in-process gateway + N chaos upstreams, torn down together."""
+
+    def __init__(self, schedule, seed: int, rcfg: ResilienceConfig,
+                 roles: dict[str, str] | None = None,
+                 provider_cls=StaticProvider):
+        self.schedule = schedule
+        self.seed = seed
+        self.rcfg = rcfg
+        self.roles = roles or {GOOD: "collocated", BAD: "collocated"}
+        self.provider_cls = provider_cls
+        self.upstreams: dict[str, TestServer] = {}
+        self.state: dict[str, dict] = {}
+        self.client: TestClient | None = None
+        self.proxy: GatewayProxy | None = None
+
+    async def __aenter__(self) -> "ChaosStack":
+        pods = []
+        for name, role in self.roles.items():
+            state: dict = {}
+            server = TestServer(
+                faultinject.make_chaos_app(name, self.schedule, state=state))
+            await server.start_server()
+            self.upstreams[name] = server
+            self.state[name] = state
+            pods.append(Pod(name, f"127.0.0.1:{server.port}", role=role))
+        ds = Datastore(pods=pods)
+        ds.set_pool(InferencePool(name="chaos-pool"))
+        ds.store_model(make_model("m"))
+        provider = self.provider_cls(
+            [PodMetrics(pod=p, metrics=Metrics()) for p in pods])
+        scheduler = Scheduler(provider, token_aware=False,
+                              prefill_aware=False, prefix_aware=False,
+                              rng=random.Random(self.seed))
+        self.proxy = GatewayProxy(
+            Server(scheduler, ds), provider, ds,
+            resilience_cfg=self.rcfg,
+            # Fast hysteresis for harness time: 2-tick dwell is the
+            # quantity the acceptance criterion counts.
+            health_cfg=HealthConfig(dwell_ticks=2, error_streak_floor=3))
+        self.proxy.obs_tick_s = 0  # ticks are driven explicitly
+        self.client = TestClient(TestServer(self.proxy.build_app()))
+        await self.client.start_server()
+        self.schedule.arm()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self.client is not None:
+            await self.client.close()
+        for server in self.upstreams.values():
+            await server.close()
+
+    def tick(self) -> None:
+        self.proxy.resilience.tick()
+
+    async def request(self, stream: bool = False) -> int:
+        body = {"model": "m", "prompt": "chaos", "max_tokens": 4}
+        if stream:
+            body["stream"] = True
+        resp = await self.client.post("/v1/completions", json=body)
+        await resp.read()
+        return resp.status
+
+    def picks_by_round(self, events: list[dict]) -> list[str]:
+        return [e["attrs"]["pod"] for e in events]
+
+
+def _provider_factory(schedule):
+    def build(pod_metrics):
+        return faultinject.ChaosProvider(pod_metrics, schedule)
+
+    return build
+
+
+async def scenario_blackhole(seed: int) -> dict:
+    """Acceptance-critical: with health_policy=avoid, a blackholed replica
+    gets ZERO new picks within 2 health-evaluation ticks of the fault
+    while overall success stays > 99% (retries absorb the in-window
+    failures)."""
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(
+        health_policy="avoid", max_retries=3, retry_budget_min=32.0,
+        trip_consecutive=3, open_cooldown_s=300.0,
+        connect_timeout_s=2.0, ttft_timeout_s=0.25,
+        stream_idle_timeout_s=2.0, backoff_base_s=0.005, backoff_cap_s=0.02)
+    async with ChaosStack(schedule, seed, rcfg) as stack:
+        statuses = []
+        for _ in range(10):  # clean warmup: both pods in rotation
+            statuses.append(await stack.request())
+        stack.tick()
+        warm_picks = stack.picks_by_round(
+            stack.proxy.journal.events(kind=events_mod.PICK, limit=2048))
+        assert BAD in warm_picks and GOOD in warm_picks, warm_picks
+
+        schedule.inject_now(faultinject.BLACKHOLE, pod=BAD)
+        round_picks: list[list[str]] = []
+        for _ in range(6):  # 6 rounds == 6 health ticks under fault
+            seq0 = stack.proxy.journal.seq
+            for _ in range(5):
+                statuses.append(await stack.request())
+            stack.tick()
+            round_picks.append(stack.picks_by_round(
+                stack.proxy.journal.events(since=seq0, limit=2048,
+                                           kind=events_mod.PICK)))
+
+        ok = sum(1 for s in statuses if s == 200)
+        success_rate = ok / len(statuses)
+        bad_after_2_ticks = sum(p.count(BAD) for p in round_picks[2:])
+        circuit = stack.proxy.resilience.breaker.state(BAD)
+        report = {
+            "scenario": "blackhole", "requests": len(statuses),
+            "success_rate": round(success_rate, 4),
+            "bad_picks_per_round": [p.count(BAD) for p in round_picks],
+            "bad_picks_after_2_ticks": bad_after_2_ticks,
+            "circuit_state_bad": circuit,
+            "retries": dict(stack.proxy.metrics.retries_total),
+        }
+        assert success_rate > 0.99, report
+        assert bad_after_2_ticks == 0, report
+        assert circuit == "open", report
+        assert sum(stack.proxy.metrics.retries_total.values()) >= 1, report
+        return report
+
+
+async def scenario_brownout(seed: int) -> dict:
+    """Slow-TTFT replica: TTFT hedging masks the brownout — hedges fire,
+    at least one wins, every request succeeds."""
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(
+        health_policy="avoid", max_retries=2, retry_budget_min=16.0,
+        hedge_ttft_s=0.1, ttft_timeout_s=5.0, connect_timeout_s=2.0,
+        stream_idle_timeout_s=5.0)
+    async with ChaosStack(schedule, seed, rcfg) as stack:
+        schedule.inject_now(faultinject.BROWNOUT, pod=BAD, delay_s=0.6)
+        statuses = [await stack.request() for _ in range(20)]
+        hedges = dict(stack.proxy.metrics.hedges_total)
+        report = {"scenario": "brownout", "requests": len(statuses),
+                  "success_rate": statuses.count(200) / len(statuses),
+                  "hedges": hedges}
+        assert all(s == 200 for s in statuses), report
+        assert hedges.get("fired", 0) >= 1, report
+        assert hedges.get("won", 0) >= 1, report
+        return report
+
+
+async def scenario_midstream(seed: int) -> dict:
+    """Mid-stream upstream cut: the client's stream terminates with the
+    error event + [DONE] (never a hung socket), the journal records the
+    stream failure, and the stack keeps serving."""
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(
+        health_policy="avoid", max_retries=1, ttft_timeout_s=2.0,
+        stream_idle_timeout_s=1.0, connect_timeout_s=2.0)
+    async with ChaosStack(schedule, seed, rcfg) as stack:
+        schedule.inject_now(faultinject.MIDSTREAM_DISCONNECT, pod=BAD,
+                            after_chunks=2)
+        cut = served = 0
+        for _ in range(10):
+            resp = await stack.client.post(
+                "/v1/completions",
+                json={"model": "m", "prompt": "x", "max_tokens": 4,
+                      "stream": True})
+            raw = (await resp.read()).decode()
+            assert resp.status == 200
+            assert raw.rstrip().endswith("data: [DONE]")
+            if "upstream stream interrupted" in raw:
+                cut += 1
+            else:
+                served += 1
+        errs = stack.proxy.journal.events(kind=events_mod.UPSTREAM_ERROR,
+                                          limit=2048)
+        stream_errs = [e for e in errs if e["attrs"].get("stream")]
+        # The faulted pod must have been hit at least once and every cut
+        # stream must have closed cleanly for the client.
+        report = {"scenario": "midstream", "cut_streams": cut,
+                  "clean_streams": served,
+                  "journaled_stream_errors": len(stream_errs)}
+        assert cut >= 1 and served >= 1, report
+        assert len(stream_errs) >= cut, report
+        # Post-fault: the stack still serves non-streaming traffic.
+        assert await stack.request() == 200
+        return report
+
+
+async def scenario_scrape_flap(seed: int) -> dict:
+    """Scrape-plane-only failure (data path healthy): the health scorer's
+    freshness component degrades the pod and avoid-policy steers routing
+    off it within 2 ticks — with zero request failures throughout."""
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="avoid", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    async with ChaosStack(schedule, seed, rcfg,
+                          provider_cls=_provider_factory(schedule)) as stack:
+        statuses = [await stack.request() for _ in range(10)]
+        stack.tick()
+        schedule.inject_now(faultinject.SCRAPE_FLAP, pod=BAD)
+        round_picks = []
+        for _ in range(5):
+            seq0 = stack.proxy.journal.seq
+            for _ in range(5):
+                statuses.append(await stack.request())
+            stack.tick()
+            round_picks.append(stack.picks_by_round(
+                stack.proxy.journal.events(since=seq0, limit=2048,
+                                           kind=events_mod.PICK)))
+        report = {
+            "scenario": "scrape_flap",
+            "success_rate": statuses.count(200) / len(statuses),
+            "bad_picks_per_round": [p.count(BAD) for p in round_picks],
+            "bad_state": stack.proxy.health.state(BAD),
+        }
+        assert all(s == 200 for s in statuses), report
+        assert sum(p.count(BAD) for p in round_picks[2:]) == 0, report
+        return report
+
+
+async def scenario_handoff(seed: int) -> dict:
+    """Disaggregated pool, decode hop failing: every request degrades to
+    single-hop (disagg_fallback journaled) and still succeeds; an
+    abandoned attach (transport cut after the handoff was posted) fires
+    the best-effort KV release at the decode replica."""
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="avoid", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    roles = {GOOD: "prefill", BAD: "decode"}
+    async with ChaosStack(schedule, seed, rcfg, roles=roles) as stack:
+        spec = schedule.inject_now(faultinject.HANDOFF_FAILURE, pod=BAD,
+                                   mode="error")
+        statuses = [await stack.request() for _ in range(5)]
+        fallbacks = stack.proxy.journal.events(
+            kind=events_mod.DISAGG_FALLBACK, limit=2048)
+        assert all(s == 200 for s in statuses), statuses
+        assert len(fallbacks) == 5, fallbacks
+
+        # Phase 2: the attach DIES mid-flight -> abandoned work on the
+        # decode replica -> the gateway fires /v1/prefill/release at it.
+        schedule.faults.remove(spec)
+        schedule.inject_now(faultinject.HANDOFF_FAILURE, pod=BAD,
+                            mode="disconnect")
+        statuses2 = [await stack.request() for _ in range(3)]
+        await asyncio.sleep(0.2)  # let the fire-and-forget releases land
+        released = list(stack.state[BAD]["released"])
+        kv_events = stack.proxy.journal.events(kind=events_mod.KV_RELEASE,
+                                               limit=2048)
+        report = {"scenario": "handoff",
+                  "fallbacks": len(fallbacks),
+                  "phase2_success": statuses2.count(200) / len(statuses2),
+                  "released_ids": released,
+                  "kv_release_events": len(kv_events)}
+        assert all(s == 200 for s in statuses2), report
+        assert released, report
+        assert kv_events and all(
+            e["attrs"]["pod"] == BAD for e in kv_events), report
+        return report
+
+
+SCENARIOS = {
+    "blackhole": scenario_blackhole,
+    "brownout": scenario_brownout,
+    "midstream": scenario_midstream,
+    "scrape_flap": scenario_scrape_flap,
+    "handoff": scenario_handoff,
+}
+
+
+def run_scenario(name: str, seed: int = 0) -> dict:
+    """Run one scenario to completion (sync wrapper for pytest/CLI)."""
+    return asyncio.run(SCENARIOS[name](seed))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--scenario", default="all",
+                        choices=["all", *SCENARIOS])
+    args = parser.parse_args(argv)
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    failed = 0
+    for name in names:
+        try:
+            report = run_scenario(name, seed=args.seed)
+            report["ok"] = True
+        except AssertionError as e:
+            report = {"scenario": name, "ok": False, "detail": str(e)[:500]}
+            failed += 1
+        print(json.dumps(report))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
