@@ -2,7 +2,7 @@
 
 IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
 
-.PHONY: test test-e2e chaos native bench loadgen sim metrics-docs docker-build install deploy undeploy fmt
+.PHONY: test test-e2e chaos native bench loadgen sim metrics-docs top usage-check docker-build install deploy undeploy fmt
 
 test:            ## unit + integration tests (CPU, virtual 8-device mesh)
 	python -m pytest tests/ -q -m "not e2e"
@@ -27,6 +27,13 @@ sim:             ## routing-policy simulation sweep
 
 metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
 	python tools/gen_metrics_docs.py docs/METRICS.md
+
+top:             ## one-shot lig-top render of a running gateway's /debug/usage
+	python tools/lig_top.py --once --url $${LIG_URL:-http://localhost:8081}
+
+usage-check:     ## attribution conservation + noisy-neighbor + docs currency
+	python -m pytest tests/test_usage.py tests/test_metrics_docs.py -q
+	python tools/chaos.py --seed 0 --scenario noisy_neighbor
 
 docker-build:    ## build the framework image
 	docker build -t $(IMG) .

@@ -370,6 +370,37 @@ def run_handoff_microbench() -> dict:
         out["disagg_decode_tpot_max_ms"] = round(vals[-1], 2)
         out["disagg_decode_ttft_p50_ms"] = round(sorted(
             r.ttft_s for r in decoders)[len(decoders) // 2] * 1e3, 2)
+
+        # --- usage-attribution overhead A/B ---
+        # Same engine/workload with the capacity-attribution tracker ON
+        # (the default) vs OFF: decode-heavy requests so the per-dispatch
+        # charge path dominates the delta.  Acceptance bar (observability
+        # PR): usage_attribution_ratio <= 1.05 — attribution costs < 5%
+        # of decode-step cost.  Interleaved rounds, MIN per side (the
+        # PR-2/PR-4 microbench precedent: contended cores swing single
+        # runs 2x).
+        off_engine = engine(paged_kv_block=block, usage_attribution=False)
+        try:
+            def decode_wall(e) -> float:
+                rs = [req(16, 24) for _ in range(4)]
+                t0 = time.perf_counter()
+                for r in rs:
+                    e.submit(r)
+                for r in rs:
+                    if not r.done.wait(300):
+                        raise RuntimeError("usage A/B request timed out")
+                return time.perf_counter() - t0
+
+            decode_wall(coll), decode_wall(off_engine)  # warmup pair
+            on_best = off_best = float("inf")
+            for _ in range(3):
+                off_best = min(off_best, decode_wall(off_engine))
+                on_best = min(on_best, decode_wall(coll))
+            out["usage_attribution_on_s"] = round(on_best, 4)
+            out["usage_attribution_off_s"] = round(off_best, 4)
+            out["usage_attribution_ratio"] = round(on_best / off_best, 4)
+        finally:
+            off_engine.stop()
         if jax.default_backend() == "cpu":
             # Both engines share this host's cores, so cross-engine CPU
             # contention inflates the disagg numbers; on separate TPU

@@ -49,6 +49,7 @@ POLICY_ESCAPE = "policy_escape"         # avoid-policy last-resort pick
 CLIENT_DISCONNECT = "client_disconnect"  # client dropped a live stream
 KV_RELEASE = "kv_release"               # abandoned handoff KV released
 FAULT_INJECT = "fault_inject"           # chaos harness applied a fault
+NOISY_NEIGHBOR = "noisy_neighbor"       # adapter usage flag changed (usage.py)
 
 
 class EventJournal:

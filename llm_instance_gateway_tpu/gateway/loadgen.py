@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import time
 
 import grpc
@@ -39,6 +40,49 @@ from llm_instance_gateway_tpu.tracing import TRACE_HEADER
 
 def model_name(i: int) -> str:  # benchmark.go:71-73
     return f"adapter-{i}"
+
+
+def parse_adapter_mix(spec: str) -> dict[str, float]:
+    """``"a=0.7,b=0.2,base=0.1"`` -> normalized weight dict.  ``base``
+    routes to the shared base model (no adapter); weights need not sum to
+    1 (they normalize), but must be positive."""
+    mix: dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, raw = part.partition("=")
+        try:
+            w = float(raw)
+        except ValueError:
+            raise ValueError(f"adapter-mix entry {part!r}: weight must be "
+                             "a number") from None
+        if not name or w <= 0:
+            raise ValueError(f"adapter-mix entry {part!r}: need name=weight "
+                             "with weight > 0")
+        mix[name.strip()] = mix.get(name.strip(), 0.0) + w
+    if not mix:
+        raise ValueError("empty adapter mix")
+    total = sum(mix.values())
+    return {k: v / total for k, v in mix.items()}
+
+
+def build_mix_fixture(num_fake_pods: int, mix: dict[str, float]):
+    """Weighted-adapter rig: every pod serves ALL mix adapters (affinity
+    is trivially satisfiable — the variable under test is the traffic
+    skew, the reproducible noisy-neighbor input), plus the shared base
+    model for the ``base`` key."""
+    adapters = sorted(n for n in mix if n != "base")
+    pods = {}
+    for i in range(num_fake_pods):
+        pods[fake_pod(i)] = fake_metrics(
+            queue=i % 5, kv=(i % 10) / 10.0,
+            adapters={name: 0 for name in adapters},
+            max_adapters=len(adapters) + 1,
+        )
+    models = [make_model(name, Criticality.CRITICAL) for name in adapters]
+    models.append(make_model("shared-base", Criticality.CRITICAL))
+    return pods, models
 
 
 def build_fixture(num_fake_pods: int, num_models_per_pod: int,
@@ -91,6 +135,8 @@ def run_load(
     session_count: int = 64,
     role_split: bool = False,
     trace_out: str | None = None,
+    adapter_mix: dict[str, float] | None = None,
+    mix_seed: int = 0,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
@@ -103,15 +149,30 @@ def run_load(
     the session's replica).  ``role_split`` makes the fleet half
     prefill-role / half decode-role: every pick becomes TWO-stage
     (prefill replica by the full tree, decode replica by KV headroom) and
-    the summary reports the two-stage rate + per-hop header coverage."""
+    the summary reports the two-stage rate + per-hop header coverage.
+    ``adapter_mix`` (``parse_adapter_mix`` output) switches to WEIGHTED
+    adapter traffic drawn from a seeded RNG — the reproducible
+    noisy-neighbor input — and the summary gains a per-adapter latency
+    breakdown."""
     if session_prefix_chars and session_prefix_chars < PREFIX_BLOCK_CHARS:
         raise ValueError(
             f"session_prefix_chars must be >= {PREFIX_BLOCK_CHARS} (the "
             "affinity hash covers whole blocks only; a shorter prefix "
             "would measure a no-op)")
-    pods, models = build_fixture(num_fake_pods, num_models_per_pod,
-                                 with_base_model=bool(session_prefix_chars),
-                                 role_split=role_split)
+    if adapter_mix and session_prefix_chars:
+        raise ValueError("adapter-mix and session modes are exclusive "
+                         "(each defines its own traffic shape)")
+    if adapter_mix and role_split:
+        raise ValueError("adapter-mix builds an all-collocated fleet; "
+                         "combining it with --role-split would report a "
+                         "meaningless two_stage_rate")
+    if adapter_mix:
+        pods, models = build_mix_fixture(num_fake_pods, adapter_mix)
+    else:
+        pods, models = build_fixture(
+            num_fake_pods, num_models_per_pod,
+            with_base_model=bool(session_prefix_chars),
+            role_split=role_split)
     factory = None
     if use_native:
         from llm_instance_gateway_tpu.gateway.scheduling.native import (
@@ -133,27 +194,41 @@ def run_load(
         session_pods: dict[int, set[str]] = {}
         two_stage_hits = 0
         trace_hits = 0  # responses carrying the echoed x-lig-trace-id
+        # Weighted adapter draw: seeded, so a mix scenario replays exactly.
+        mix_rng = random.Random(mix_seed)
+        mix_names = sorted(adapter_mix) if adapter_mix else []
+        mix_weights = [adapter_mix[n] for n in mix_names] if adapter_mix \
+            else []
+        per_adapter_lat: dict[str, list[float]] = {}
 
-        def body_for(i: int) -> tuple[bytes, int | None]:
+        def body_for(i: int) -> tuple[bytes, int | None, str | None]:
+            if adapter_mix:
+                name = mix_rng.choices(mix_names, weights=mix_weights)[0]
+                target = "shared-base" if name == "base" else name
+                return generate_request(target), None, name
             if session_prefix_chars:
                 sid = i % session_count
                 return generate_request(
                     "shared-base",
-                    prompt=session_prompt(sid, i, session_prefix_chars)), sid
-            return generate_request(model_name(i % total_models)), None
+                    prompt=session_prompt(sid, i, session_prefix_chars)), \
+                    sid, None
+            return generate_request(model_name(i % total_models)), None, None
 
         while sent < requests:
             batch = min(requests - sent, max(1, requests // streams))
             bodies = [body_for(sent + k) for k in range(batch)]
             msgs = [
                 pb.ProcessingRequest(request_body=pb.HttpBody(body=body))
-                for body, _ in bodies
+                for body, _, _ in bodies
             ]
             t0 = time.perf_counter()
             # One stream per batch: measures per-message processing inline.
             for k, resp in enumerate(stub(iter(msgs))):
                 t1 = time.perf_counter()
                 latencies.append(t1 - t0)
+                adapter = bodies[k][2]
+                if adapter is not None:
+                    per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
                 t0 = t1
                 assert resp.WhichOneof("response") == "request_body"
                 keys = {h.header.key for h in (resp.request_body.response
@@ -200,6 +275,21 @@ def run_load(
         # trip IS the gateway decision phase under this rig.
         with open(trace_out, "w") as f:
             json.dump({"phases": {"extproc.process": latencies}}, f)
+    if adapter_mix:
+        # Per-adapter latency breakdown: the observable a noisy-neighbor
+        # scenario compares against the gateway's usage attribution.
+        out["adapter_mix"] = {k: round(v, 4)
+                              for k, v in sorted(adapter_mix.items())}
+        breakdown = {}
+        for name in sorted(per_adapter_lat):
+            vals = sorted(per_adapter_lat[name])
+            breakdown[name] = {
+                "requests": len(vals),
+                "p50_us": round(vals[len(vals) // 2] * 1e6, 1),
+                "p99_us": round(
+                    vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1e6, 1),
+            }
+        out["per_adapter"] = breakdown
     if role_split:
         # 1.0 = every response carried BOTH hop headers (prefill target +
         # x-decode-pod) — the two-stage pick ran on every request.
@@ -237,13 +327,24 @@ def main(argv=None):
     parser.add_argument("--trace-out", default=None, metavar="PATH",
                         help="write per-request phase samples as JSON for "
                              "tools/trace_report.py")
+    parser.add_argument("--adapter-mix", default=None, metavar="SPEC",
+                        help='weighted adapter traffic, e.g. '
+                             '"a=0.7,b=0.2,base=0.1" ("base" = the shared '
+                             'base model); seeded draw for reproducible '
+                             'noisy-neighbor scenarios, per-adapter '
+                             'latency breakdown in the report')
+    parser.add_argument("--mix-seed", type=int, default=0,
+                        help="seed for the weighted adapter draw")
     args = parser.parse_args(argv)
     summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
                        use_native=args.native,
                        session_prefix_chars=args.session_prefix_chars,
                        session_count=args.sessions,
                        role_split=args.role_split,
-                       trace_out=args.trace_out)
+                       trace_out=args.trace_out,
+                       adapter_mix=(parse_adapter_mix(args.adapter_mix)
+                                    if args.adapter_mix else None),
+                       mix_seed=args.mix_seed)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 

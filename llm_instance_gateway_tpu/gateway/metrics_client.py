@@ -49,6 +49,7 @@ from llm_instance_gateway_tpu.utils import prom_parse
 # Metric-name contract (metrics.go:19-32 equivalent).
 LORA_INFO_METRIC = "tpu:lora_requests_info"
 LORA_ADAPTERS_LABEL = "running_lora_adapters"
+LORA_WAITING_LABEL = "waiting_lora_adapters"
 LORA_MAX_LABEL = "max_lora"
 PREFILL_QUEUE_METRIC = "tpu:prefill_queue_size"
 DECODE_QUEUE_METRIC = "tpu:decode_queue_size"
@@ -62,6 +63,12 @@ DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
 PREFIX_REUSED_METRIC = "tpu:prefix_reused_tokens"
 PREFILL_SECONDS_METRIC = "tpu:prefill_seconds"
 DECODE_STEP_SECONDS_METRIC = "tpu:decode_step_seconds"
+# Capacity-attribution families (server/usage.py; all optional).
+ADAPTER_STEP_SECONDS_METRIC = "tpu:adapter_step_seconds_total"
+ADAPTER_TOKENS_METRIC = "tpu:adapter_tokens_total"
+ADAPTER_KV_SECONDS_METRIC = "tpu:adapter_kv_block_seconds_total"
+IDLE_SLOT_SECONDS_METRIC = "tpu:idle_slot_seconds_total"
+PREFILL_PADDING_METRIC = "tpu:prefill_padding_tokens_total"
 
 
 class FetchError(Exception):
@@ -124,14 +131,48 @@ def families_to_metrics(
         if s_sum is not None and s_count is not None and s_count.value > 0:
             setattr(updated, attr, s_sum.value / s_count.value)
 
+    # Capacity attribution (optional): every labeled sample folds in, keyed
+    # by its (model, adapter[, phase]) labels — replicas expose one model,
+    # so "latest sample" selection does not apply; rebuild the dicts whole
+    # each scrape (cumulative counters, never merged with stale keys).
+    for fam, attr, with_phase in (
+        (ADAPTER_STEP_SECONDS_METRIC, "adapter_step_seconds", True),
+        (ADAPTER_TOKENS_METRIC, "adapter_tokens", True),
+        (ADAPTER_KV_SECONDS_METRIC, "adapter_kv_block_seconds", False),
+    ):
+        samples = families.get(fam, [])
+        if samples:
+            table = {}
+            for s in samples:
+                adapter = s.labels.get("adapter", "")
+                if not adapter:
+                    continue
+                model = s.labels.get("model", "")
+                key = ((model, adapter, s.labels.get("phase", ""))
+                       if with_phase else (model, adapter))
+                table[key] = s.value
+            setattr(updated, attr, table)
+    for fam, setter in (
+        (IDLE_SLOT_SECONDS_METRIC,
+         lambda m, x: setattr(m, "idle_slot_seconds", float(x))),
+        (PREFILL_PADDING_METRIC,
+         lambda m, x: setattr(m, "prefill_padding_tokens", int(x))),
+    ):
+        s = prom_parse.latest_sample(families.get(fam, []))
+        if s is not None:
+            setter(updated, s.value)
+
     # LoRA info: latest series by gauge-value timestamp (metrics.go:135-150 —
     # the reference compares the *gauge value*, which vLLM sets to a unix ts).
+    # Running AND waiting adapters union into the affinity set (the
+    # reference unions both CSVs into ActiveModels).
     lora_samples = families.get(LORA_INFO_METRIC, [])
     if lora_samples:
         best = max(lora_samples, key=lambda s: s.value)
         adapters: dict[str, int] = {}
         csv = best.labels.get(LORA_ADAPTERS_LABEL, "")
-        for name in csv.split(","):
+        waiting_csv = best.labels.get(LORA_WAITING_LABEL, "")
+        for name in (csv + "," + waiting_csv).split(","):
             name = name.strip()
             if name:
                 adapters[name] = 0

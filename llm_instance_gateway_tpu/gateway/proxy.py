@@ -25,9 +25,13 @@ Endpoints:
   and hysteresis states (gateway/health.py), plus the resilience plane:
   health policy, per-pod circuit-breaker states, retry-budget level
   (gateway/resilience.py).
+- ``GET  /debug/usage`` — pool-wide capacity attribution: per-{model,
+  adapter} consumption shares, noisy-neighbor scores/flags, pool-waste
+  aggregates (gateway/usage.py; live console: ``tools/lig_top.py``).
 - ``GET  /debug/events`` — the flight recorder (events.py): admission
   rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
-  transitions; ``?since=<seq>`` for incremental polling.
+  transitions, noisy-neighbor flags; ``?since=<seq>`` for incremental
+  polling.
 - ``GET  /healthz``  — 200 once the InferencePool is synced (main.go:43-52).
 - ``GET  /v1/models`` — logical models from the datastore.
 
@@ -66,6 +70,7 @@ from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
 from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
+from llm_instance_gateway_tpu.gateway import usage as usage_mod
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
     RequestBody,
@@ -93,6 +98,7 @@ class GatewayProxy:
         resilience_cfg: "resilience_mod.ResilienceConfig | None" = None,
         slo_cfg: "slo_mod.SLOConfig | None" = None,
         health_cfg: "health_mod.HealthConfig | None" = None,
+        usage_cfg: "usage_mod.UsageConfig | None" = None,
         blackbox_dir: str | None = None,
     ):
         self.server = handler_server
@@ -120,6 +126,13 @@ class GatewayProxy:
         self.slo = slo_mod.SLOEngine(
             self.metrics, cfg=slo_cfg, journal=self.journal,
             on_fast_burn=self._on_fast_burn)
+        # Capacity-attribution rollup (gateway/usage.py): per-{model,
+        # adapter} consumption shares + noisy-neighbor scoring over the
+        # replicas' tpu:adapter_*_total families, journaling transitions
+        # and feeding /debug/usage + the gateway_usage_* exposition.
+        self.usage = usage_mod.UsageRollup(
+            provider, metrics=self.metrics, cfg=usage_cfg,
+            journal=self.journal)
         # Black-box dump directory + dump-storm cooldown; both env-tunable.
         self.blackbox_dir = (
             blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
@@ -146,6 +159,11 @@ class GatewayProxy:
         sched = getattr(outer, "_scheduler", outer)
         if sched is not None and hasattr(sched, "health_advisor"):
             sched.health_advisor = self.resilience
+        # Usage seam on the same pick path: LOG-ONLY (counts picks serving
+        # a flagged noisy model; routing byte-identical — the fairness
+        # analogue of the health scorer's pre-enforcement stage).
+        if sched is not None and hasattr(sched, "usage_advisor"):
+            sched.usage_advisor = self.usage
         # Strong refs to in-flight KV-release tasks (the event loop only
         # keeps weak ones; see _spawn_release).
         self._release_tasks: set = set()
@@ -160,6 +178,7 @@ class GatewayProxy:
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/slo", self.handle_debug_slo)
         app.router.add_get("/debug/health", self.handle_debug_health)
+        app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/v1/models", self.handle_models)
@@ -197,6 +216,7 @@ class GatewayProxy:
             try:
                 self.resilience.tick()  # health pass + breaker bookkeeping
                 self.slo.tick()
+                self.usage.tick()  # capacity shares + noisy-neighbor flags
             except Exception:
                 logger.exception("observability tick failed")
 
@@ -227,7 +247,8 @@ class GatewayProxy:
                     self.blackbox_dir, reason, journal=self.journal,
                     tracer=self.tracer, metrics_text=self._render_metrics(),
                     slo_payload=self.slo.debug_payload(),
-                    health_payload=self.health.debug_payload())
+                    health_payload=self.health.debug_payload(),
+                    usage_payload=self.usage.debug_payload())
                 self._last_dump_t = time.time()
                 self.journal.emit(events_mod.BREACH_DUMP, model=model,
                                   objective=objective, path=path)
@@ -1032,7 +1053,7 @@ class GatewayProxy:
         families — SLO gauges, per-pod health, and the event counters."""
         text = self.metrics.render()
         extra = (self.slo.render() + self.health.render()
-                 + self.resilience.render()
+                 + self.resilience.render() + self.usage.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -1067,6 +1088,15 @@ class GatewayProxy:
         payload = self.health.debug_payload()
         payload["resilience"] = self.resilience.debug_payload()
         return web.json_response(payload)
+
+    async def handle_debug_usage(self, request: web.Request) -> web.Response:
+        """Pool-wide capacity attribution: per-{model, adapter} consumption
+        shares, admitted-traffic shares, noisy-neighbor scores/flags, and
+        pool-waste aggregates (gateway/usage.py; rendered live by
+        ``tools/lig_top.py``).  Floored at the configured cadence — the
+        enter/exit hysteresis counts rollup passes."""
+        self.usage.maybe_tick(max(1.0, self.obs_tick_s))
+        return web.json_response(self.usage.debug_payload())
 
     async def handle_debug_events(self, request: web.Request) -> web.Response:
         """The flight recorder: ``?since=<seq>`` incremental cursor,

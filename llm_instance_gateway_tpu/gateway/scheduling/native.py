@@ -156,6 +156,9 @@ class NativeScheduler:
         # and never alters the pick (candidate parity with C++ stays
         # exact); avoid/strict filter via filter_by_policy in _pick.
         self.health_advisor = None
+        # Usage seam (gateway/usage.py) — log-only pick counting, same
+        # contract as the Python Scheduler's usage_advisor.
+        self.usage_advisor = None
 
     def _arrays(self, req: LLMRequest, pods: list[PodMetrics],
                 version: int | None):
@@ -283,6 +286,8 @@ class NativeScheduler:
             self.prefix_index.record(req.prefix_hashes, pick.name)
         if self.health_advisor is not None:
             self.health_advisor.note_pick(pick.name)
+        if self.usage_advisor is not None:
+            self.usage_advisor.note_pick(pick.name, req.model)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -322,6 +327,8 @@ class NativeScheduler:
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
             self.health_advisor.note_pick(decode_pod.name)
+        if self.usage_advisor is not None:
+            self.usage_advisor.note_pick(decode_pod.name, req.model)
         return prefill_pod, decode_pod
 
 

@@ -302,6 +302,13 @@ class Scheduler:
         # ``strict`` (gateway/resilience.py) the survivor set additionally
         # passes through ``filter_by_policy`` before the tie-break/draw.
         self.health_advisor = None
+        # Usage seam (gateway/usage.py, set by the proxy): LOG-ONLY —
+        # ``note_pick`` counts picks that serve a currently-flagged noisy
+        # model into gateway_usage_would_deprioritize_total.  No RNG, no
+        # filtering: routing byte-identical with the seam attached (pinned
+        # by the same-RNG diff test), so a future fairness-routing policy
+        # has the observable before it has the enforcement.
+        self.usage_advisor = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.
@@ -355,6 +362,8 @@ class Scheduler:
             self.prefix_index.record(req.prefix_hashes, pick.name)
         if self.health_advisor is not None:
             self.health_advisor.note_pick(pick.name)
+        if self.usage_advisor is not None:
+            self.usage_advisor.note_pick(pick.name, req.model)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -400,6 +409,8 @@ class Scheduler:
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
             self.health_advisor.note_pick(decode_pod.name)
+        if self.usage_advisor is not None:
+            self.usage_advisor.note_pick(decode_pod.name, req.model)
         # Per-hop pick split for the tracing layer (the admission span's
         # attribution of "pick" into prefill-hop vs decode-hop cost).
         req.pick_hops_s = (t1 - t0, time.perf_counter() - t1)

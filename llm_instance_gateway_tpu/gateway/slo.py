@@ -382,6 +382,7 @@ class SLOEngine:
 def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
                    metrics_text: str = "", slo_payload: dict | None = None,
                    health_payload: dict | None = None,
+                   usage_payload: dict | None = None,
                    clock=time.time) -> str:
     """Write the black-box dump for one breach; returns the file path.
 
@@ -405,6 +406,9 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
         "traces": tracer.recent(64) if tracer is not None else [],
         "slo": slo_payload,
         "health": health_payload,
+        # Who was consuming the pool at the moment of the breach — the
+        # first question a fast-burn post-mortem asks (gateway/usage.py).
+        "usage": usage_payload,
         "metrics_text": metrics_text,
     }
     tmp = path + ".tmp"

@@ -147,6 +147,13 @@ class GatewayMetrics:
                     h = table[(model, path)] = Histogram(LATENCY_BUCKETS)
                 h.observe(max(0.0, value))
 
+    def requests_snapshot(self) -> dict:
+        """Locked copy of the per-model request counters — the usage
+        rollup's admitted-traffic source (an unlocked ``dict()`` of a
+        table gRPC threads mutate can die mid-resize)."""
+        with self._lock:
+            return dict(self.requests_total)
+
     def slo_snapshot(self) -> dict:
         """Copy-out of the counts the SLO engine evaluates (gateway/slo.py):
         phase-histogram states keyed by (model, path) per objective, plus

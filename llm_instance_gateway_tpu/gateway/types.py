@@ -92,10 +92,26 @@ class Metrics:
     # yet (or a foreign server without the families).
     prefill_seconds_mean: float = 0.0
     decode_step_seconds_mean: float = 0.0
+    # Per-adapter capacity attribution scraped from the replica's
+    # tpu:adapter_*_total families (server/usage.py).  Keys:
+    # (model, adapter, phase) for step-seconds/tokens, (model, adapter)
+    # for KV block-seconds; values are the replica's CUMULATIVE counters.
+    # The gateway-wide rollup (gateway/usage.py) sums these across pods
+    # and differences between scrape ticks.
+    adapter_step_seconds: dict = field(default_factory=dict)
+    adapter_tokens: dict = field(default_factory=dict)
+    adapter_kv_block_seconds: dict = field(default_factory=dict)
+    # Pool-waste counters (cumulative): slot-seconds decode dispatches ran
+    # with empty rows, and prompt tokens prefilled as bucket/ring padding.
+    idle_slot_seconds: float = 0.0
+    prefill_padding_tokens: int = 0
 
     def clone(self) -> "Metrics":
         m = dataclasses.replace(self)
         m.active_adapters = dict(self.active_adapters)
+        m.adapter_step_seconds = dict(self.adapter_step_seconds)
+        m.adapter_tokens = dict(self.adapter_tokens)
+        m.adapter_kv_block_seconds = dict(self.adapter_kv_block_seconds)
         return m
 
     @property

@@ -1,0 +1,349 @@
+"""Pool-wide capacity attribution: per-{model, adapter} consumption shares
+and noisy-neighbor detection over the replicas' ``tpu:adapter_*_total``
+families (server/usage.py).
+
+The engine side charges every decode step, token, and KV block-second to
+an {adapter}; this module answers the POOL question: *who is consuming the
+fleet, and is anyone consuming far more than their admitted traffic
+justifies?*  CaraServe (arxiv 2401.11240) and the heterogeneous-LoRA
+serving literature (arxiv 2511.22880) both identify rank/load heterogeneity
+across adapters as the dominant interference source in multi-LoRA serving;
+this rollup is the attribution layer a fairness/cost-aware router needs.
+
+Mechanics (one ``tick()`` per provider scrape/observability cadence):
+
+- Sum each pod's cumulative per-(model, adapter) counters, difference
+  against the previous tick, and EMA the resulting **consumption shares**
+  per resource (``step_seconds`` | ``tokens`` | ``kv_block_seconds``).
+- Derive each key's **admitted-traffic share** from the gateway's own
+  ``requests_total`` deltas (a request's model name IS the adapter name
+  for LoRA traffic; base-model traffic folds into the ``base`` key).
+  Laplace smoothing keeps the ratio finite for keys with zero admitted
+  traffic in a window (their consumption is all backlog).
+- ``noisy score = step-seconds share / smoothed traffic share``: 1.0 means
+  consumption proportional to admission; a long-prompt flooder scores far
+  above its traffic share.  A key flags **noisy** after ``enter_ticks``
+  consecutive ticks over ``noisy_ratio`` with at least ``min_share`` of
+  pool step-seconds (tiny adapters never flag), and clears after
+  ``exit_ticks`` below — the same dwell-style hysteresis as
+  ``gateway/health.py``.  Transitions journal ``noisy_neighbor`` events
+  into the flight recorder.
+
+The scheduler seam is **log-only** (``note_pick``): picks serving a
+currently-flagged model only count into
+``gateway_usage_would_deprioritize_total`` — no RNG, no filtering, routing
+byte-identical (pinned by the same-RNG diff test in tests/test_usage.py)
+— so a future fairness-routing PR has the observable ready.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import escape_label, render_counter
+
+BASE = "base"
+QUIET, NOISY = "quiet", "noisy"
+RESOURCES = ("step_seconds", "tokens", "kv_block_seconds")
+
+
+@dataclass(frozen=True)
+class UsageConfig:
+    # Consumption-share / traffic-share ratio at which a key is a noisy
+    # candidate (2.0 = consuming double what its admission justifies).
+    noisy_ratio: float = 2.0
+    # Floor on the key's share of pool step-seconds: a 2x-ratio adapter
+    # consuming 3% of the pool is not a neighbor problem.
+    min_share: float = 0.2
+    # Hysteresis (ticks are rollup update passes, like health dwell).
+    enter_ticks: int = 2
+    exit_ticks: int = 2
+    # Weight of the newest tick's delta shares in the EMA (1.0 = no
+    # smoothing; the default damps single-tick spikes without hiding a
+    # sustained flood from the 2-tick detection bar).
+    ema_alpha: float = 0.6
+
+
+class UsageRollup:
+    """Thread-safe pool rollup; ``tick()`` runs on the proxy's
+    observability cadence (and lazily from ``/debug/usage``)."""
+
+    def __init__(self, provider, metrics=None, cfg: UsageConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 clock=time.time):
+        self.provider = provider
+        self.metrics = metrics  # GatewayMetrics (admitted-traffic source)
+        self.cfg = cfg or UsageConfig()
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._prev_totals: dict[str, dict] = {r: {} for r in RESOURCES}
+        self._prev_requests: dict[str, float] = {}
+        self._shares: dict[str, dict] = {r: {} for r in RESOURCES}
+        self._traffic: dict[tuple, float] = {}
+        self._scores: dict[tuple, float] = {}
+        self._states: dict[tuple, str] = {}
+        self._pending: dict[tuple, tuple[str, int]] = {}
+        self._totals: dict[str, dict] = {r: {} for r in RESOURCES}
+        self._pool_waste: dict[str, float] = {}
+        # Cached flagged model/adapter names for the log-only pick seam
+        # (frozenset read without the lock, like health.non_healthy()).
+        self._noisy_models: frozenset = frozenset()
+        self.last_tick = 0.0
+        self.ticks = 0
+        self.would_deprioritize_total = 0
+        self.would_deprioritize: dict[str, int] = {}
+
+    # -- rollup --------------------------------------------------------------
+    @staticmethod
+    def _sum_pods(pods) -> tuple[dict[str, dict], dict[str, float]]:
+        """(per-resource {(model, adapter): cumulative}, pool-waste sums)."""
+        totals: dict[str, dict] = {r: {} for r in RESOURCES}
+        waste = {"idle_slot_seconds": 0.0, "prefill_padding_tokens": 0.0}
+        for pm in pods:
+            m = pm.metrics
+            for (model, adapter, _phase), v in getattr(
+                    m, "adapter_step_seconds", {}).items():
+                key = (model, adapter)
+                totals["step_seconds"][key] = (
+                    totals["step_seconds"].get(key, 0.0) + v)
+            for (model, adapter, _phase), v in getattr(
+                    m, "adapter_tokens", {}).items():
+                key = (model, adapter)
+                totals["tokens"][key] = totals["tokens"].get(key, 0.0) + v
+            for (model, adapter), v in getattr(
+                    m, "adapter_kv_block_seconds", {}).items():
+                key = (model, adapter)
+                totals["kv_block_seconds"][key] = (
+                    totals["kv_block_seconds"].get(key, 0.0) + v)
+            waste["idle_slot_seconds"] += getattr(m, "idle_slot_seconds", 0.0)
+            waste["prefill_padding_tokens"] += getattr(
+                m, "prefill_padding_tokens", 0)
+        return totals, waste
+
+    def maybe_tick(self, min_interval_s: float = 1.0) -> None:
+        """On-demand rollup with a floor between passes — the enter/exit
+        hysteresis counts UPDATE PASSES, so an unthrottled debug poller
+        must not drive flag transitions at its own poll rate."""
+        if self._clock() - self.last_tick >= min_interval_s:
+            self.tick()
+
+    def tick(self, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        pods = self.provider.all_pod_metrics()
+        totals, waste = self._sum_pods(pods)
+        if self.metrics is None:
+            requests = {}
+        else:
+            # Locked accessor when available (GatewayMetrics); plain copy
+            # for bare test fakes.
+            snap = getattr(self.metrics, "requests_snapshot", None)
+            requests = snap() if snap is not None else dict(
+                self.metrics.requests_total)
+        cfg = self.cfg
+        transitions = []
+        with self._lock:
+            self.last_tick = now
+            self.ticks += 1
+            self._totals = totals
+            self._pool_waste = waste
+            # Per-resource delta shares, EMA-smoothed.
+            for resource in RESOURCES:
+                prev = self._prev_totals[resource]
+                cur = totals[resource]
+                deltas = {k: max(0.0, v - prev.get(k, 0.0))
+                          for k, v in cur.items()}
+                self._prev_totals[resource] = dict(cur)
+                total_delta = sum(deltas.values())
+                if total_delta <= 0.0:
+                    continue  # no movement: shares keep their EMA
+                shares = self._shares[resource]
+                a = cfg.ema_alpha
+                for k in set(deltas) | set(shares):
+                    cur_share = deltas.get(k, 0.0) / total_delta
+                    shares[k] = a * cur_share + (1 - a) * shares.get(k, 0.0)
+            # Keys absent from every pod's cumulative exposition are gone
+            # (adapter unloaded / pod churned): drop their share EMAs so
+            # the exposition doesn't grow a line per tenant ever seen.
+            live = set()
+            for resource in RESOURCES:
+                live |= set(totals[resource])
+            for resource in RESOURCES:
+                shares = self._shares[resource]
+                for k in [k for k in shares if k not in live]:
+                    del shares[k]
+            # Admitted-traffic shares over the same window.  A request's
+            # model name is the adapter name for LoRA traffic; base-tenant
+            # requests arrive under the SERVED model name, so each
+            # (model, base) key claims its own model's traffic, and any
+            # request name claimed by no key (aliases, foreign models)
+            # splits evenly across the base keys — each unit of traffic is
+            # counted at most once (multi-model pools must not inflate
+            # every base key with the whole pool's unclaimed traffic).
+            req_delta = {m: max(0.0, v - self._prev_requests.get(m, 0.0))
+                         for m, v in requests.items()}
+            self._prev_requests = dict(requests)
+            keys = set(self._shares["step_seconds"])
+            adapter_names = {adapter for (_m, adapter) in keys
+                             if adapter != BASE}
+            base_models = {model for (model, adapter) in keys
+                           if adapter == BASE}
+            leftover = sum(v for m, v in req_delta.items()
+                           if m not in adapter_names
+                           and m not in base_models)
+            total_traffic = sum(req_delta.values())
+            if keys and (total_traffic > 0 or not self._traffic):
+                n = len(keys)
+                # An adapter name shared by several served models splits
+                # its traffic evenly — requests_total cannot attribute the
+                # model, and letting each key claim the whole delta would
+                # double-count (deflating every copy's noisy score).
+                adapter_models: dict[str, int] = {}
+                for (_m, adapter) in keys:
+                    if adapter != BASE:
+                        adapter_models[adapter] = (
+                            adapter_models.get(adapter, 0) + 1)
+                for key in keys:
+                    (model, adapter) = key
+                    if adapter == BASE:
+                        t = (req_delta.get(model, 0.0)
+                             + leftover / max(1, len(base_models)))
+                    else:
+                        t = (req_delta.get(adapter, 0.0)
+                             / adapter_models[adapter])
+                    # Laplace smoothing keeps zero-traffic keys finite.
+                    smoothed = (t + 1.0) / (total_traffic + n)
+                    a = cfg.ema_alpha
+                    self._traffic[key] = (a * smoothed
+                                          + (1 - a) * self._traffic.get(
+                                              key, smoothed))
+            # Scores + dwell-filtered flag state.
+            for key in keys:
+                share = self._shares["step_seconds"].get(key, 0.0)
+                traffic = self._traffic.get(key, 1.0)
+                score = share / max(traffic, 1e-9)
+                self._scores[key] = round(score, 4)
+                want = (NOISY if score >= cfg.noisy_ratio
+                        and share >= cfg.min_share else QUIET)
+                cur = self._states.get(key, QUIET)
+                if want == cur:
+                    self._pending.pop(key, None)
+                    continue
+                cand, streak = self._pending.get(key, (want, 0))
+                streak = streak + 1 if cand == want else 1
+                dwell = (cfg.enter_ticks if want == NOISY
+                         else cfg.exit_ticks)
+                if streak >= dwell:
+                    self._states[key] = want
+                    self._pending.pop(key, None)
+                    transitions.append((key, cur, want, self._scores[key],
+                                        round(share, 4)))
+                else:
+                    self._pending[key] = (want, streak)
+            # Keys that vanished from every pod's exposition drop state —
+            # journaling an exit first when one leaves while flagged, so
+            # the flight recorder never shows an unmatched noisy 'enter'
+            # (an operator paging on transitions would see it noisy
+            # forever).
+            for key in [k for k in self._states if k not in keys]:
+                if self._states[key] == NOISY:
+                    transitions.append((key, NOISY, QUIET,
+                                        self._scores.get(key, 0.0), 0.0))
+            for table in (self._scores, self._states, self._pending,
+                          self._traffic):
+                for key in [k for k in table if k not in keys]:
+                    del table[key]
+            # Flagged names for the pick seam: base-tenant requests arrive
+            # under the served MODEL name, adapter traffic under the
+            # adapter name — store whichever note_pick will actually see.
+            self._noisy_models = frozenset(
+                (model if adapter == BASE else adapter)
+                for (model, adapter), st in self._states.items()
+                if st == NOISY)
+        for key, frm, to, score, share in transitions:
+            if self.journal is not None:
+                self.journal.emit(events_mod.NOISY_NEIGHBOR,
+                                  model=key[0], adapter=key[1], frm=frm,
+                                  to=to, score=score, share=share)
+
+    # -- log-only scheduler seam ----------------------------------------------
+    def note_pick(self, pod_name: str, model: str | None) -> None:
+        """Count picks serving a currently-flagged noisy model.  Must never
+        influence the pick — no RNG, no exceptions, no filtering — so
+        routing stays byte-identical with the seam attached (same-RNG diff
+        test in tests/test_usage.py); a future fairness policy promotes
+        this observable the way health_policy promoted note_pick."""
+        if model is None or model not in self._noisy_models:
+            return
+        with self._lock:
+            self.would_deprioritize_total += 1
+            self.would_deprioritize[model] = (
+                self.would_deprioritize.get(model, 0) + 1)
+
+    def noisy(self) -> frozenset:
+        """Currently-flagged adapter/model names (cached; lock-free read)."""
+        return self._noisy_models
+
+    # -- export ---------------------------------------------------------------
+    def render(self) -> list[str]:
+        with self._lock:
+            shares = {r: dict(t) for r, t in self._shares.items()}
+            scores = dict(self._scores)
+            would = dict(self.would_deprioritize)
+        lines = []
+        share_rows = [
+            (model, adapter, resource, share)
+            for resource in RESOURCES
+            for (model, adapter), share in sorted(shares[resource].items())
+        ]
+        if share_rows:
+            lines.append("# TYPE gateway_usage_share gauge")
+            for model, adapter, resource, share in share_rows:
+                lines.append(
+                    'gateway_usage_share{model="%s",adapter="%s",'
+                    'resource="%s"} %.4f'
+                    % (escape_label(model), escape_label(adapter),
+                       resource, share))
+        if scores:
+            lines.append("# TYPE gateway_noisy_neighbor_score gauge")
+            for (model, adapter) in sorted(scores):
+                lines.append(
+                    'gateway_noisy_neighbor_score{model="%s",adapter="%s"} '
+                    '%.4f' % (escape_label(model), escape_label(adapter),
+                              scores[(model, adapter)]))
+        lines += render_counter("gateway_usage_would_deprioritize_total",
+                                would, "model")
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/usage`` JSON body (also what ``tools/lig_top.py``
+        renders): adapters sorted by step-seconds share, descending."""
+        with self._lock:
+            keys = (set(self._shares["step_seconds"]) | set(self._scores)
+                    | set(self._states))
+            rows = []
+            for key in keys:
+                model, adapter = key
+                rows.append({
+                    "model": model,
+                    "adapter": adapter,
+                    "share": {r: round(self._shares[r].get(key, 0.0), 4)
+                              for r in RESOURCES},
+                    "traffic_share": round(self._traffic.get(key, 0.0), 4),
+                    "score": self._scores.get(key, 0.0),
+                    "state": self._states.get(key, QUIET),
+                    "totals": {r: round(self._totals[r].get(key, 0.0), 4)
+                               for r in RESOURCES},
+                })
+            rows.sort(key=lambda r: -r["share"]["step_seconds"])
+            return {
+                "adapters": rows,
+                "pool_waste": dict(self._pool_waste),
+                "noisy": sorted(self._noisy_models),
+                "would_deprioritize_total": self.would_deprioritize_total,
+                "ticks": self.ticks,
+                "config": asdict(self.cfg),
+            }

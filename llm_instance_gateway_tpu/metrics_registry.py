@@ -108,6 +108,17 @@ GATEWAY_FAMILIES = (
     Family("gateway_circuit_state", "gauge", ("pod",),
            "Per-pod circuit-breaker state (0 closed / 1 open / 2 "
            "half-open; gateway/resilience.py).", GATEWAY_SURFACE),
+    Family("gateway_usage_share", "gauge", ("model", "adapter", "resource"),
+           "Pool-wide consumption share per {model, adapter} (EMA of "
+           "scrape-tick deltas; resource: step_seconds | tokens | "
+           "kv_block_seconds; gateway/usage.py).", GATEWAY_SURFACE),
+    Family("gateway_noisy_neighbor_score", "gauge", ("model", "adapter"),
+           "Step-seconds consumption share over admitted-traffic share "
+           "(1.0 = proportional; flags noisy past the configured ratio "
+           "with hysteresis).", GATEWAY_SURFACE),
+    Family("gateway_usage_would_deprioritize_total", "counter", ("model",),
+           "Picks that served a currently-flagged noisy model (log-only "
+           "usage seam; routing unchanged).", GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),
@@ -135,9 +146,11 @@ SERVER_FAMILIES = (
     Family("tpu:decode_tokens_per_sec", "gauge", (),
            "Recent decode throughput (EMA).", SERVER_SURFACE),
     Family("tpu:lora_requests_info", "gauge",
-           ("running_lora_adapters", "max_lora"),
-           "Resident-adapter info gauge; value is a unix timestamp "
-           "(latest series wins).", SERVER_SURFACE),
+           ("running_lora_adapters", "waiting_lora_adapters", "max_lora"),
+           "Adapter-activity info gauge (vLLM semantics: running = "
+           "actively decoding, waiting = parked in decode_wait / queued); "
+           "value is a unix timestamp (latest series wins).",
+           SERVER_SURFACE),
     Family("tpu:pool_role", "gauge", ("role",),
            "Disaggregation role info gauge (collocated | prefill | "
            "decode).", SERVER_SURFACE),
@@ -156,6 +169,34 @@ SERVER_FAMILIES = (
            SERVER_SURFACE),
     Family("tpu:decode_step_seconds", "histogram", ("model", "role"),
            "Per-step decode cadence.", SERVER_SURFACE),
+    Family("tpu:adapter_step_seconds_total", "counter",
+           ("model", "adapter", "phase"),
+           "TPU step wall-seconds charged to each adapter (decode "
+           "dispatches split evenly across active slots; prefills charged "
+           "whole to their owner; adapter=base = no-LoRA rows; "
+           "server/usage.py).", SERVER_SURFACE),
+    Family("tpu:adapter_tokens_total", "counter",
+           ("model", "adapter", "phase"),
+           "Tokens attributed per adapter (prompt tokens at prefill, "
+           "emitted tokens at decode).", SERVER_SURFACE),
+    Family("tpu:adapter_kv_block_seconds_total", "counter",
+           ("model", "adapter"),
+           "Time-integral of KV blocks held per adapter (parked "
+           "decode_wait KV included; token-seconds when the cache is not "
+           "paged).", SERVER_SURFACE),
+    Family("tpu:step_seconds_total", "counter", ("phase",),
+           "Engine wall step-seconds per phase — the conservation "
+           "denominator: per-adapter step-seconds sum to this within "
+           "epsilon (tests/test_usage.py).", SERVER_SURFACE),
+    Family("tpu:idle_slot_seconds_total", "counter", (),
+           "Slot-seconds decode dispatches ran with empty rows (pool "
+           "waste).", SERVER_SURFACE),
+    Family("tpu:prefill_padding_tokens_total", "counter", (),
+           "Prompt tokens prefilled as bucket/ring padding and thrown "
+           "away (pool waste).", SERVER_SURFACE),
+    Family("tpu:decode_batch_occupancy", "histogram", (),
+           "Active-slots / total-slots fraction per decode dispatch.",
+           SERVER_SURFACE),
     Family("tpu:events_total", "counter", ("kind",),
            "Replica-side flight-recorder events by kind (served by the "
            "replica's /debug/events).", SERVER_SURFACE),

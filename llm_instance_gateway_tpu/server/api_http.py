@@ -21,6 +21,10 @@ the union of what vLLM exposed to the reference:
 - ``GET  /debug/events``          replica-side flight recorder (admission
                                   rejections, handoff refusals, drain
                                   transitions; ``?since=`` cursor)
+- ``GET  /debug/usage``           per-adapter capacity attribution snapshot
+                                  (step-seconds / tokens / KV block-seconds
+                                  per {adapter, phase} + pool waste;
+                                  server/usage.py)
 - ``GET  /health``                200 once the engine loop is up
 
 Tracing: every inference request adopts the ``x-lig-trace-id`` header (or
@@ -129,6 +133,7 @@ class ModelServer:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/events", self.handle_debug_events)
+        app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/health", self.handle_health)
         return app
 
@@ -1235,6 +1240,26 @@ class ModelServer:
         ``/debug/events`` (``?since=``/``?kind=``/``?limit=``)."""
         return web.json_response(
             events_mod.debug_events_payload(self.events, request.query))
+
+    async def handle_debug_usage(self, request: web.Request) -> web.Response:
+        """This replica's per-adapter capacity attribution (server/usage.py):
+        step-seconds / tokens / KV block-seconds per {adapter, phase} plus
+        the pool-waste observables — the raw payload the gateway's
+        ``gateway/usage.py`` rollup (and ``tools/lig_top.py``) aggregates.
+        Tuple keys flatten to ``"adapter|phase"`` strings for JSON."""
+        snap = self.engine.metrics_snapshot()
+        usage = snap.get("usage") or {}
+        flat = dict(usage)
+        for key in ("step_seconds", "tokens"):
+            flat[key] = {f"{a}|{p}": v
+                         for (a, p), v in (usage.get(key) or {}).items()}
+        return web.json_response({
+            "model": self.model_name,
+            "role": snap.get("pool_role", "collocated"),
+            "running_lora_adapters": snap.get("running_lora_adapters", []),
+            "waiting_lora_adapters": snap.get("waiting_lora_adapters", []),
+            "usage": flat,
+        })
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.engine.draining:

@@ -43,6 +43,7 @@ from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.server.sampling import sample
+from llm_instance_gateway_tpu.server.usage import UsageTracker, owner_key
 from llm_instance_gateway_tpu.tracing import LATENCY_BUCKETS, Histogram
 
 logger = logging.getLogger(__name__)
@@ -172,6 +173,13 @@ class EngineConfig:
     # gateway can always fall back to single-hop serving — but it is
     # exported via /metrics and drives the gateway's two-stage routing.
     role: str = "collocated"
+    # Per-adapter capacity attribution (server/usage.py): charge decode
+    # step wall time, tokens, and KV block-seconds to the {adapter} of
+    # each active slot, plus pool-waste observables (batch occupancy,
+    # idle-slot-seconds, prefill padding).  A few dict ops per DISPATCH;
+    # the off switch exists for the bench.py overhead A/B
+    # (usage_attribution_ratio), not for production use.
+    usage_attribution: bool = True
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -621,6 +629,12 @@ class Engine:
             "handoff": Histogram(LATENCY_BUCKETS),
             "decode_step": Histogram(LATENCY_BUCKETS),
         }
+        # Capacity attribution (server/usage.py): who is consuming this
+        # replica.  Own lock; charged from the engine thread, snapshotted
+        # by the scrape thread.
+        self.usage: UsageTracker | None = (
+            UsageTracker(b, kv_block=self._block if self.paged else 1)
+            if self.cfg.usage_attribution else None)
 
         if self.paged:
             step_fn = paged_lib.decode_step_paged
@@ -1151,6 +1165,36 @@ class Engine:
     # metrics snapshot (the scrape contract, gateway/metrics_client.py)
     # ------------------------------------------------------------------
 
+    def _adapter_activity(self) -> tuple[list[str], list[str]]:
+        """(running, waiting) LoRA adapter name lists for the
+        ``tpu:lora_requests_info`` gauge — vLLM reference semantics:
+        *running* = adapters with a slotted (actively decoding) request or
+        the in-flight chunk stream; *waiting* = adapters whose requests are
+        prefilled-but-parked in ``decode_wait`` (or pulled-but-unadmitted).
+        A request parked without a slot is NOT running — counting it as
+        such made the gateway's affinity scorer steer traffic toward the
+        replica least able to take it.  Scrape-thread safe: a deque walk
+        raced by the engine thread degrades to an empty waiting set for
+        one scrape rather than corrupting anything."""
+        running: set[str] = set()
+        waiting: set[str] = set()
+        for s in self.slots:
+            if s is not None and s.request.adapter:
+                running.add(s.request.adapter)
+        stream = self._stream
+        if stream is not None and stream.request.adapter:
+            running.add(stream.request.adapter)
+        try:
+            for w in list(self.decode_wait):
+                if w.request.adapter:
+                    waiting.add(w.request.adapter)
+        except RuntimeError:  # deque mutated during the scrape-side walk
+            pass
+        pending = self._pending
+        if pending is not None and pending.adapter:
+            waiting.add(pending.adapter)
+        return sorted(running), sorted(waiting)
+
     def metrics_snapshot(self) -> dict:
         active = sum(1 for s in self.slots if s is not None)
         if self.paged:
@@ -1177,7 +1221,7 @@ class Engine:
         with self._lock:
             tps = self.decode_tps_ema
             phase_hist = {k: h.state() for k, h in self.phase_hist.items()}
-        running_adapters = self.lora.running_adapters() if self.lora else []
+        running_adapters, waiting_adapters = self._adapter_activity()
         max_lora = self.lora.max_slots if self.lora else 0
         # The in-flight chunk stream counts as prefilling: invisible, the
         # gateway would route MORE traffic to the replica busiest streaming.
@@ -1197,10 +1241,15 @@ class Engine:
             "kv_parked_tokens": parked,
             "decode_tokens_per_sec": tps,
             "running_lora_adapters": running_adapters,
+            "waiting_lora_adapters": waiting_adapters,
             "max_lora": max_lora,
             # Phase-latency histogram states (server/metrics.py renders
             # these as the tpu:*_seconds histogram families).
             "phase_hist": phase_hist,
+            # Per-adapter capacity attribution (server/usage.py) — the
+            # tpu:adapter_*_total / pool-waste families.
+            **({"usage": self.usage.snapshot()}
+               if self.usage is not None else {}),
             **({"prefix_reused_tokens": self.prefix_reused_tokens}
                if self._prefix_enabled else {}),
             **({
@@ -1238,6 +1287,11 @@ class Engine:
         self._slot_bias_vals[i] = 0.0
         if self.paged:
             self._paged_free_row(i)
+        # NO usage KV sync here: _clear_slot runs inside the decode result
+        # loops (k finished slots would rebuild the holdings list k times
+        # per dispatch); every dispatch syncs once at its end, and the
+        # rarer non-dispatch clears are off by at most one dispatch
+        # interval.
 
     # -- paged-pool allocator (host side; device sees only table contents) --
 
@@ -1635,6 +1689,7 @@ class Engine:
                 keep.append(w)
         if swept:
             self.decode_wait = collections.deque(keep)
+            self._usage_sync_kv()  # parked holdings changed
         return swept
 
     def _drain_decode_wait(self, pipelined: bool) -> bool:
@@ -1644,6 +1699,7 @@ class Engine:
             if w.request.cancelled.is_set():
                 self.decode_wait.popleft()
                 self._parked_kv_tokens -= w.k.shape[2]
+                self._usage_sync_kv()
                 self._finish(w.request, "cancelled")
                 did = True
                 continue
@@ -1654,7 +1710,16 @@ class Engine:
                 break  # pool backpressure: KV stays parked off-cache
             self.decode_wait.popleft()
             self._parked_kv_tokens -= w.k.shape[2]
-            self._insert_waiting(slot_idx, w, pipelined)
+            # Mid-admission guard: between the pop (decode_queue -> 0) and
+            # _register_slot (running -> 1) the insert runs a device op —
+            # without this count a drain()/scrape polling that window sees
+            # a phantom-quiescent engine and declares victory with the
+            # request still in flight.
+            self._admitting += 1
+            try:
+                self._insert_waiting(slot_idx, w, pipelined)
+            finally:
+                self._admitting -= 1
             did = True
         return did
 
@@ -1762,6 +1827,7 @@ class Engine:
                 t_parked=time.time())
             self.decode_wait.append(w)
             self._parked_kv_tokens += w.k.shape[2]
+            self._usage_sync_kv()
         except Exception as e:  # engine must survive a poison handoff
             logger.exception("attach failed for %s", req.request_id)
             req.error = str(e)
@@ -2103,6 +2169,8 @@ class Engine:
         n_tokens = 0
         self.spec_cycles += n_cycles
         t_steps = toks_np.shape[0]
+        owners = [s.request.adapter for s in self.slots if s is not None]
+        tok_by_owner: dict[str, int] = {}
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -2112,6 +2180,7 @@ class Engine:
                 self._clear_slot(i)
                 continue
             finished = False
+            row_start = n_tokens
             for j in range(t_steps):
                 if not valid_np[j, i]:
                     continue  # rejected / frozen / past-EOS entry
@@ -2130,6 +2199,10 @@ class Engine:
                     self._clear_slot(i)
                     finished = True
                     break
+            if n_tokens > row_start:
+                key = owner_key(req.adapter)
+                tok_by_owner[key] = (tok_by_owner.get(key, 0)
+                                     + n_tokens - row_start)
             req.stream_event.set()
             if finished:
                 continue
@@ -2141,6 +2214,9 @@ class Engine:
             self._spec_extra_pos[i] = epos_np[i]
             self._spec_has_extra[i] = bool(ehas_np[i])
         self.spec_emitted += n_tokens
+        if self.usage is not None:
+            self.usage.charge_decode(step_s, owners, tok_by_owner)
+            self._usage_sync_kv()
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0
@@ -2206,6 +2282,8 @@ class Engine:
             self._sync_tables()
             c = n - reused
             bucket = self._bucket(c)
+            if self.usage is not None:
+                self.usage.charge_padding(bucket - c)
             tokens = np.zeros((bucket,), np.int32)
             tokens[:c] = req.prompt_tokens[reused:]
             positions = reused + np.arange(bucket, dtype=np.int32)
@@ -2255,6 +2333,8 @@ class Engine:
 
         sp = req.sampling
         padded = -(-n // self._ring_pad) * self._ring_pad
+        if self.usage is not None:
+            self.usage.charge_padding(padded - n)
         tokens = np.zeros((1, padded), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.broadcast_to(
@@ -2280,6 +2360,8 @@ class Engine:
         Returns (first_token device scalar, k, v, lp_info)."""
         sp = req.sampling
         bucket = self._bucket(n)
+        if self.usage is not None:
+            self.usage.charge_padding(bucket - n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.zeros((1, bucket), np.int32)
@@ -2299,6 +2381,8 @@ class Engine:
         Returns (first_tokens [P] device, k [L,P,S,...], v, lp_infos)."""
         bucket = self._bucket(max(ns))
         p = len(reqs)
+        if self.usage is not None:
+            self.usage.charge_padding(sum(bucket - n for n in ns))
         tokens = np.zeros((p, bucket), np.int32)
         positions = np.zeros((p, bucket), np.int32)
         for i, (req, n) in enumerate(zip(reqs, ns)):
@@ -2375,6 +2459,7 @@ class Engine:
         # outside the decode cache — count the padded rows so the routing
         # signal sees the pressure (metrics_snapshot).
         self._parked_kv_tokens += w.k.shape[2]
+        self._usage_sync_kv()
 
     def _do_prefill_ahead_group(self, reqs, pipelined: bool) -> None:
         """Batched prefill-ahead: one program, every row parks in
@@ -2710,6 +2795,7 @@ class Engine:
             self._dev_counts = self._counts().at[slot_idx].set(0)
         # Budget for device-side stop: the prefill already produced token 1.
         self._slot_remaining[slot_idx] = max(0, slot.request.max_new_tokens - 1)
+        self._usage_sync_kv()
 
     def _record_ttft(self, req: Request) -> None:
         with self._lock:
@@ -2721,6 +2807,36 @@ class Engine:
                 # tpu:prefill_seconds exposition family.
                 self.phase_hist["prefill"].observe(
                     max(0.0, req.t_first_token - req.t_prefill_start))
+        if (self.usage is not None
+                and req.t_prefill_start and req.t_first_token):
+            # Attribution: the prefill's wall charged whole to its owner
+            # (grouped prefills charge each rider the shared program wall
+            # — per-request compute-seconds, the same accounting the
+            # engine-total conservation denominator accumulates), prompt
+            # tokens counted as phase=prefill.
+            self.usage.charge_step(
+                "prefill",
+                max(0.0, req.t_first_token - req.t_prefill_start),
+                [req.adapter],
+                tokens={owner_key(req.adapter): len(req.prompt_tokens)})
+
+    def _usage_sync_kv(self) -> None:
+        """Refresh the attribution tracker's KV-holdings integral (engine
+        thread): active slot rows at their current position, parked
+        ``decode_wait`` KV at its padded size (the same HBM the
+        ``kv_parked_tokens`` gauge counts), and the in-flight chunk
+        stream's filled prefix."""
+        if self.usage is None:
+            return
+        holdings: list[tuple[str | None, int]] = [
+            (s.request.adapter, s.position)
+            for s in self.slots if s is not None]
+        holdings += [(w.request.adapter, w.k.shape[2])
+                     for w in self.decode_wait]
+        if self._stream is not None and self._stream.next_start > 0:
+            holdings.append((self._stream.request.adapter,
+                             self._stream.next_start))
+        self.usage.sync_kv(holdings)
 
     def observe_handoff(self, seconds: float) -> None:
         """Record one handoff-plane operation (serialize on the prefill
@@ -2866,6 +2982,10 @@ class Engine:
         top_i_np = np.asarray(step_top_i)
         step_s = time.perf_counter() - t0
         n_tokens = 0
+        # Attribution: owners captured BEFORE the loop clears finished
+        # slots (they were all resident for this dispatch's wall).
+        owners = [s.request.adapter for s in self.slots if s is not None]
+        tok_by_owner: dict[str, int] = {}
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -2875,6 +2995,7 @@ class Engine:
                 self._clear_slot(i)
                 continue
             finished = False
+            slot_tokens = 0
             for k in range(n_steps):
                 if not valid_np[k, i]:
                     continue  # device froze this row (budget/EOS)
@@ -2883,6 +3004,7 @@ class Engine:
                 self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
                                      top_i_np[k, i])
                 n_tokens += 1
+                slot_tokens += 1
                 slot.position += 1
                 self._slot_tokens[i] = tok
                 self._slot_remaining[i] = max(0, self._slot_remaining[i] - 1)
@@ -2891,9 +3013,15 @@ class Engine:
                     self._clear_slot(i)
                     finished = True
                     break  # tokens past the stop condition are trimmed
+            if slot_tokens:
+                key = owner_key(req.adapter)
+                tok_by_owner[key] = tok_by_owner.get(key, 0) + slot_tokens
             req.stream_event.set()
             if not finished:
                 self._slot_positions[i] = slot.position
+        if self.usage is not None:
+            self.usage.charge_decode(step_s, owners, tok_by_owner)
+            self._usage_sync_kv()
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0
@@ -3119,6 +3247,11 @@ class Engine:
         top_i_np = np.asarray(blk["top_i"])
         n_tokens = 0
         n_pending = 0  # prefill first-tokens materialized in this block
+        # Attribution owners = every row resident at DISPATCH time (they
+        # all shared this block's wall); tokens counted per owner below
+        # (pending-first tokens are prefill products, excluded).
+        owners = [s.request.adapter for s in blk["rows"] if s is not None]
+        tok_by_owner: dict[str, int] = {}
         for i, slot in enumerate(blk["rows"]):
             if slot is None:
                 continue
@@ -3151,6 +3284,7 @@ class Engine:
                 if self._is_finished(req, tok0):
                     finished = True
             if not finished:
+                row_tokens = 0
                 for k in range(blk["n_steps"]):
                     if not valid_np[k, i]:
                         continue  # device froze this row (budget/EOS)
@@ -3159,6 +3293,7 @@ class Engine:
                     self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
                                          top_i_np[k, i])
                     n_tokens += 1
+                    row_tokens += 1
                     slot.position += 1
                     if (
                         self._is_finished(req, tok)
@@ -3166,6 +3301,9 @@ class Engine:
                     ):
                         finished = True
                         break
+                if row_tokens:
+                    key = owner_key(req.adapter)
+                    tok_by_owner[key] = tok_by_owner.get(key, 0) + row_tokens
             req.stream_event.set()
             if finished:
                 self._finish(req, "stop" if self._is_stop(req, req.output_tokens[-1])
@@ -3181,6 +3319,9 @@ class Engine:
         if blk.get("spec"):
             # First tokens come from prefill, not speculation.
             self.spec_emitted += n_tokens - n_pending
+        if self.usage is not None:
+            self.usage.charge_decode(step_s, owners, tok_by_owner)
+            self._usage_sync_kv()
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0

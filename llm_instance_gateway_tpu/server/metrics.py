@@ -50,9 +50,15 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         "# TYPE tpu:decode_tokens_per_sec gauge",
         f"tpu:decode_tokens_per_sec {snapshot['decode_tokens_per_sec']:.3f}",
         "# TYPE tpu:lora_requests_info gauge",
-        'tpu:lora_requests_info{running_lora_adapters="%s",max_lora="%d"} %f'
+        # Running vs waiting adapters are DISTINCT labels (vLLM reference
+        # semantics): a request parked in decode_wait is waiting, not
+        # running — the gateway unions both into its affinity set but an
+        # operator must see which replica is actually decoding a tenant.
+        'tpu:lora_requests_info{running_lora_adapters="%s",'
+        'waiting_lora_adapters="%s",max_lora="%d"} %f'
         % (
             escape_label(",".join(snapshot.get("running_lora_adapters", []))),
+            escape_label(",".join(snapshot.get("waiting_lora_adapters", []))),
             snapshot.get("max_lora", 0),
             time.time(),
         ),
@@ -85,6 +91,14 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         for key, family in PHASE_FAMILIES:
             if key in phase_hist:
                 lines += render_histogram(family, phase_hist[key], labels)
+    usage = snapshot.get("usage")
+    if usage:
+        # Capacity attribution (server/usage.py): per-{adapter,phase}
+        # step-seconds/tokens, KV block-seconds, the engine-wall
+        # conservation denominator, and the pool-waste observables.
+        from llm_instance_gateway_tpu.server.usage import render_usage
+
+        lines += render_usage(usage, snapshot.get("model_name", ""))
     for name, value in (extra or {}).items():
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")

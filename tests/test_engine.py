@@ -454,22 +454,43 @@ class TestMetricsSnapshot:
         assert 0.0 <= snap["kv_cache_usage_perc"] <= 1.0
 
     def test_renders_gateway_parseable_exposition(self, engine_env):
-        """The server's exposition must round-trip through the gateway parser."""
+        """The server's exposition must round-trip through the gateway
+        parser.  Adapter activity follows the vLLM info-gauge semantics:
+        a resident-but-IDLE adapter is not running (nor waiting), while an
+        in-flight request surfaces its adapter in the gateway's affinity
+        set (running ∪ waiting)."""
         from llm_instance_gateway_tpu.server import metrics as server_metrics
         from llm_instance_gateway_tpu.gateway.metrics_client import families_to_metrics
         from llm_instance_gateway_tpu.gateway.types import Metrics
         from llm_instance_gateway_tpu.utils import prom_parse
 
+        def scrape():
+            text = server_metrics.render(engine.metrics_snapshot())
+            return families_to_metrics(prom_parse.parse_text(text),
+                                       Metrics())
+
         engine, lora, _ = engine_env
         lora.load("scrape-adapter", weights={}, alpha=8.0, rank=2)
         try:
-            text = server_metrics.render(engine.metrics_snapshot())
-            families = prom_parse.parse_text(text)
-            metrics, errs = families_to_metrics(families, Metrics())
+            metrics, errs = scrape()
             assert errs == []
             assert metrics.kv_tokens_capacity == 4 * 64
-            assert "scrape-adapter" in metrics.active_adapters
+            assert "scrape-adapter" not in metrics.active_adapters  # idle
             assert metrics.max_active_adapters == CFG.max_lora_slots
+
+            req = make_req((5, 6, 7), max_new=48, adapter="scrape-adapter")
+            engine.submit(req)
+            seen = False
+            deadline = time.time() + 60
+            while time.time() < deadline and not req.done.is_set():
+                metrics, errs = scrape()
+                assert errs == []
+                if "scrape-adapter" in metrics.active_adapters:
+                    seen = True
+                    break
+                time.sleep(0.005)
+            assert req.done.wait(60)
+            assert seen, "in-flight adapter never surfaced in the info gauge"
         finally:
             lora.unload("scrape-adapter")
 

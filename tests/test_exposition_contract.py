@@ -99,9 +99,14 @@ def loaded_gateway_metrics() -> GatewayMetrics:
 
 
 def server_snapshot() -> dict:
+    from llm_instance_gateway_tpu.server import usage as usage_mod
+
     hist = tracing.Histogram(tracing.LATENCY_BUCKETS)
     for v in (0.002, 0.01, 7.0):
         hist.observe(v)
+    occupancy = tracing.Histogram(usage_mod.OCCUPANCY_BUCKETS)
+    occupancy.observe(0.5)
+    occupancy.observe(1.0)
     return {
         "model_name": HOSTILE,
         "pool_role": "prefill",
@@ -114,12 +119,26 @@ def server_snapshot() -> dict:
         "kv_tokens_free": 6144,
         "decode_tokens_per_sec": 123.4,
         "running_lora_adapters": ["a1", HOSTILE],
+        "waiting_lora_adapters": [HOSTILE],
         "max_lora": 4,
         "prefix_reused_tokens": 77,
         "phase_hist": {
             "prefill": hist.state(),
             "handoff": tracing.Histogram(tracing.LATENCY_BUCKETS).state(),
             "decode_step": hist.state(),
+        },
+        # Capacity attribution (server/usage.py) with a hostile adapter
+        # name on every labeled dimension.
+        "usage": {
+            "step_seconds": {(HOSTILE, "decode"): 1.25,
+                             ("base", "prefill"): 0.5},
+            "tokens": {(HOSTILE, "decode"): 40, ("base", "prefill"): 16},
+            "kv_block_seconds": {HOSTILE: 9.5, "base": 3.25},
+            "engine_step_seconds": {"decode": 1.25, "prefill": 0.5},
+            "idle_slot_seconds": 2.75,
+            "padding_tokens": 12,
+            "occupancy": occupancy.state(),
+            "kv_block_tokens": 16,
         },
     }
 
@@ -155,6 +174,27 @@ def test_server_render_contract():
         labels = families[fam + "_bucket"][0].labels
         assert labels["model"] == HOSTILE and labels["role"] == "prefill"
     assert families["tpu:prefill_seconds_count"][0].value == 3
+    # Capacity-attribution families (this PR): hostile adapter labels
+    # round-trip, counters are cumulative, occupancy is a true histogram.
+    step = {(s.labels["adapter"], s.labels["phase"]): s.value
+            for s in families["tpu:adapter_step_seconds_total"]}
+    assert step == {(HOSTILE, "decode"): 1.25, ("base", "prefill"): 0.5}
+    assert all(s.labels["model"] == HOSTILE
+               for s in families["tpu:adapter_step_seconds_total"])
+    kv = {s.labels["adapter"]: s.value
+          for s in families["tpu:adapter_kv_block_seconds_total"]}
+    assert kv == {HOSTILE: 9.5, "base": 3.25}
+    engine_total = {s.labels["phase"]: s.value
+                    for s in families["tpu:step_seconds_total"]}
+    assert engine_total == {"decode": 1.25, "prefill": 0.5}
+    assert families["tpu:idle_slot_seconds_total"][0].value == 2.75
+    assert families["tpu:prefill_padding_tokens_total"][0].value == 12
+    assert "tpu:decode_batch_occupancy_bucket" in families
+    assert families["tpu:decode_batch_occupancy_count"][0].value == 2
+    # Running vs waiting adapters are distinct labels on the info gauge.
+    info = families["tpu:lora_requests_info"][0].labels
+    assert info["running_lora_adapters"] == f"a1,{HOSTILE}"
+    assert info["waiting_lora_adapters"] == HOSTILE
 
 
 def test_proxy_metrics_endpoint_round_trips():
@@ -347,6 +387,63 @@ def test_resilience_families_exposition_contract():
     # The breaker transition landed in the event-counter family.
     assert any(s.labels["kind"] == "circuit_transition"
                for s in families["gateway_events_total"])
+
+
+def loaded_usage_rollup():
+    """A REAL UsageRollup over a provider whose pod exposes hostile-labeled
+    attribution counters, ticked twice so deltas/shares/scores exist."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway import usage as gusage
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    gm = loaded_gateway_metrics()
+    m = Metrics(
+        adapter_step_seconds={(HOSTILE, HOSTILE, "decode"): 1.0,
+                              (HOSTILE, "base", "decode"): 1.0},
+        adapter_tokens={(HOSTILE, HOSTILE, "decode"): 10},
+        adapter_kv_block_seconds={(HOSTILE, HOSTILE): 5.0},
+        idle_slot_seconds=1.5, prefill_padding_tokens=7)
+    provider = StaticProvider(
+        [PodMetrics(pod=Pod("pod-u", "127.0.0.1:1"), metrics=m)])
+    journal = events.EventJournal(capacity=64)
+    rollup = gusage.UsageRollup(provider, metrics=gm, journal=journal)
+    rollup.tick(now=100.0)
+    m.adapter_step_seconds = {(HOSTILE, HOSTILE, "decode"): 9.0,
+                              (HOSTILE, "base", "decode"): 2.0}
+    rollup.tick(now=105.0)
+    rollup.note_pick("pod-u", None)  # model-less pick: never counted
+    return gm, rollup, journal
+
+
+def test_usage_rollup_exposition_contract():
+    """Capacity-attribution satellite: gateway_usage_share{model,adapter,
+    resource}, gateway_noisy_neighbor_score{model,adapter}, and the
+    would-deprioritize counter lint clean on the composed gateway page
+    with hostile labels."""
+    gm, rollup, journal = loaded_usage_rollup()
+    text = gm.render() + "\n".join(
+        rollup.render()
+        + journal.render_prom("gateway_events_total")) + "\n"
+    families = lint_exposition(text)
+    types = {line.split(" ")[2]: line.split(" ")[3]
+             for line in text.splitlines() if line.startswith("# TYPE ")}
+    assert types["gateway_usage_share"] == "gauge"
+    assert types["gateway_noisy_neighbor_score"] == "gauge"
+    assert types["gateway_usage_would_deprioritize_total"] == "counter"
+    shares = {(s.labels["adapter"], s.labels["resource"]): s.value
+              for s in families["gateway_usage_share"]}
+    # Step-second shares over the tick delta: 8/10 vs 2/10 (EMA-weighted).
+    assert shares[(HOSTILE, "step_seconds")] > shares[("base",
+                                                       "step_seconds")]
+    assert all(s.labels["model"] == HOSTILE
+               for s in families["gateway_usage_share"])
+    assert {s.labels["adapter"]
+            for s in families["gateway_noisy_neighbor_score"]} == {
+        HOSTILE, "base"}
+    # Unlabeled fallback keeps the counter family present at zero.
+    assert families["gateway_usage_would_deprioritize_total"][0].value == 0
 
 
 def test_empty_observability_state_still_lints():

@@ -739,7 +739,8 @@ def test_client_disconnect_mid_stream_is_accounted():
 
 @pytest.mark.slow
 @pytest.mark.parametrize("scenario", ["blackhole", "brownout", "midstream",
-                                      "scrape_flap", "handoff"])
+                                      "scrape_flap", "handoff",
+                                      "noisy_neighbor"])
 def test_chaos_scenario(scenario):
     from tools import chaos
 

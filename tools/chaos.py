@@ -15,6 +15,8 @@ fault schedule, drives load, and asserts recovery invariants per scenario:
                       pod within 2 ticks with zero data-path errors
 ``handoff``           decode-hop failures fall back single-hop; an
                       abandoned attach triggers the KV release call
+``noisy_neighbor``    one adapter floods long prompts: the usage rollup
+                      flags it within 2 ticks, quiet adapters never flag
 ====================  ====================================================
 
 Usage: ``python tools/chaos.py --seed 0 --scenario all`` (``make chaos``).
@@ -69,12 +71,14 @@ class ChaosStack:
 
     def __init__(self, schedule, seed: int, rcfg: ResilienceConfig,
                  roles: dict[str, str] | None = None,
-                 provider_cls=StaticProvider):
+                 provider_cls=StaticProvider,
+                 models: tuple[str, ...] = ("m",)):
         self.schedule = schedule
         self.seed = seed
         self.rcfg = rcfg
         self.roles = roles or {GOOD: "collocated", BAD: "collocated"}
         self.provider_cls = provider_cls
+        self.models = models
         self.upstreams: dict[str, TestServer] = {}
         self.state: dict[str, dict] = {}
         self.client: TestClient | None = None
@@ -92,7 +96,8 @@ class ChaosStack:
             pods.append(Pod(name, f"127.0.0.1:{server.port}", role=role))
         ds = Datastore(pods=pods)
         ds.set_pool(InferencePool(name="chaos-pool"))
-        ds.store_model(make_model("m"))
+        for model in self.models:
+            ds.store_model(make_model(model))
         provider = self.provider_cls(
             [PodMetrics(pod=p, metrics=Metrics()) for p in pods])
         scheduler = Scheduler(provider, token_aware=False,
@@ -119,8 +124,9 @@ class ChaosStack:
     def tick(self) -> None:
         self.proxy.resilience.tick()
 
-    async def request(self, stream: bool = False) -> int:
-        body = {"model": "m", "prompt": "chaos", "max_tokens": 4}
+    async def request(self, stream: bool = False, model: str = "m",
+                      prompt: str = "chaos") -> int:
+        body = {"model": model, "prompt": prompt, "max_tokens": 4}
         if stream:
             body["stream"] = True
         resp = await self.client.post("/v1/completions", json=body)
@@ -322,12 +328,107 @@ async def scenario_handoff(seed: int) -> dict:
         return report
 
 
+async def scenario_noisy_neighbor(seed: int) -> dict:
+    """Capacity-attribution acceptance: one adapter floods long prompts
+    (most of the pool's step-seconds on a modest traffic share) while two
+    quiet adapters send ordinary traffic.  The usage rollup must flag the
+    hog within 2 rollup ticks of the flood — and NEVER flag the quiet
+    adapters (zero false positives).
+
+    The gateway side is fully real: requests flow through the proxy (so
+    admitted-traffic shares come from the live requests_total counters)
+    and the REAL ``gateway/usage.py`` rollup scores them.  The replica
+    side synthesizes the scraped ``tpu:adapter_step_seconds_total``
+    counters each round — cumulative, proportional to the prompt tokens
+    each adapter actually sent — exactly what a scrape of the engine's
+    attribution tracker would return."""
+    from llm_instance_gateway_tpu import events as ev
+
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="log_only", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    hog, quiet_a, quiet_b = "hog", "quiet-a", "quiet-b"
+    models = (hog, quiet_a, quiet_b)
+    # Long prompt for the hog: ~16x the quiet prompt, the prefill
+    # step-second skew the synthetic counters mirror.
+    long_prompt, short_prompt = "flood " * 160, "chaos"
+    async with ChaosStack(schedule, seed, rcfg, models=models) as stack:
+        usage = stack.proxy.usage
+        provider = stack.proxy.provider
+        step_totals = {m: 0.0 for m in models}
+
+        def scrape(prompt_tokens: dict[str, int]) -> None:
+            """One synthetic scrape round: step-seconds grow with the
+            prompt tokens each adapter sent this round (1ms/token)."""
+            for m, toks in prompt_tokens.items():
+                step_totals[m] += toks * 1e-3
+            for pm in provider.all_pod_metrics():
+                pm.metrics.adapter_step_seconds = {
+                    ("m", m, "prefill"): step_totals[m] / 2  # 2 pods
+                    for m in models}
+
+        async def round_(hog_requests: int) -> dict[str, int]:
+            toks = {m: 0 for m in models}
+            for _ in range(hog_requests):
+                assert await stack.request(
+                    model=hog, prompt=long_prompt) == 200
+                toks[hog] += len(long_prompt.split())
+            for m in (quiet_a, quiet_b):
+                for _ in range(3):
+                    assert await stack.request(
+                        model=m, prompt=short_prompt) == 200
+                    toks[m] += 1
+            return toks
+
+        # Clean warmup rounds: everyone quiet, shares settle.
+        for _ in range(3):
+            scrape(await round_(hog_requests=0))
+            usage.tick()
+        assert usage.noisy() == frozenset(), dict(usage._states)
+
+        # Flood: the hog sends a few LONG-prompt requests per round —
+        # small traffic share, dominant step-seconds share.
+        flagged_after = None
+        rounds = 6
+        for i in range(1, rounds + 1):
+            scrape(await round_(hog_requests=3))
+            usage.tick()
+            if flagged_after is None and hog in usage.noisy():
+                flagged_after = i
+        payload = usage.debug_payload()
+        by_adapter = {r["adapter"]: r for r in payload["adapters"]}
+        flags = stack.proxy.journal.events(kind=ev.NOISY_NEIGHBOR,
+                                           limit=2048)
+        report = {
+            "scenario": "noisy_neighbor",
+            "flagged_after_ticks": flagged_after,
+            "hog_score": by_adapter[hog]["score"],
+            "quiet_scores": {m: by_adapter[m]["score"]
+                             for m in (quiet_a, quiet_b)},
+            "noisy": payload["noisy"],
+            "journaled_flags": [e["attrs"]["adapter"] for e in flags],
+        }
+        # Detection bar: the hog flags within 2 rollup ticks of the flood.
+        assert flagged_after is not None and flagged_after <= 2, report
+        assert payload["noisy"] == [hog], report
+        # Zero false positives: quiet adapters stay quiet AND below the
+        # score threshold for the whole run.
+        cfg = usage.cfg
+        for m in (quiet_a, quiet_b):
+            assert by_adapter[m]["state"] == "quiet", report
+            assert by_adapter[m]["score"] < cfg.noisy_ratio, report
+        assert set(report["journaled_flags"]) == {hog}, report
+        return report
+
+
 SCENARIOS = {
     "blackhole": scenario_blackhole,
     "brownout": scenario_brownout,
     "midstream": scenario_midstream,
     "scrape_flap": scenario_scrape_flap,
     "handoff": scenario_handoff,
+    "noisy_neighbor": scenario_noisy_neighbor,
 }
 
 

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""lig-top: a top(1)-style live console over the gateway's /debug/usage.
+
+Answers "who is consuming the pool RIGHT NOW" from the capacity-attribution
+plane (gateway/usage.py over the replicas' tpu:adapter_*_total families):
+one row per {model, adapter} with its consumption shares (TPU step-seconds,
+tokens, KV block-seconds), admitted-traffic share, noisy-neighbor score,
+and flag state — plus the pool-waste line (idle slot-seconds, prefill
+padding) nobody previously saw.
+
+Usage:
+    python tools/lig_top.py --url http://localhost:8081            # live
+    python tools/lig_top.py --url http://localhost:8081 --once     # CI logs
+    make top                                                       # one-shot
+
+``--once`` renders a single frame to stdout (no ANSI) so CI jobs and
+post-mortems can embed the table; live mode redraws every ``--interval``
+seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+CLEAR = "\x1b[2J\x1b[H"
+BOLD, RED, DIM, RESET = "\x1b[1m", "\x1b[31m", "\x1b[2m", "\x1b[0m"
+
+COLUMNS = ("MODEL", "ADAPTER", "STEP%", "TOK%", "KV%", "TRAF%", "SCORE",
+           "STATE")
+WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7)
+
+
+def fetch_usage(url: str, timeout_s: float = 5.0) -> dict:
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/usage", timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _row(values, color: str = "") -> str:
+    cells = []
+    for v, w in zip(values, WIDTHS):
+        s = str(v)
+        if len(s) > w:
+            s = s[: w - 1] + "…"
+        cells.append(s.ljust(w))
+    line = " ".join(cells).rstrip()
+    return f"{color}{line}{RESET}" if color else line
+
+
+def render_table(payload: dict, color: bool = False) -> str:
+    """One frame of the console (pure function — unit-tested and shared by
+    --once).  Rows arrive pre-sorted by step-seconds share, descending."""
+    lines = []
+    waste = payload.get("pool_waste") or {}
+    noisy = payload.get("noisy") or []
+    header = ("lig-top — pool capacity attribution  "
+              f"(ticks={payload.get('ticks', 0)})")
+    lines.append(f"{BOLD}{header}{RESET}" if color else header)
+    lines.append(
+        "pool waste: idle_slot_seconds=%.1f prefill_padding_tokens=%d"
+        % (waste.get("idle_slot_seconds", 0.0),
+           waste.get("prefill_padding_tokens", 0)))
+    lines.append("noisy: %s" % (", ".join(noisy) if noisy else "none"))
+    lines.append("")
+    head = _row(COLUMNS, BOLD if color else "")
+    lines.append(head)
+    rows = payload.get("adapters") or []
+    if not rows:
+        lines.append("(no attribution samples yet — is traffic flowing "
+                     "and are replicas exposing tpu:adapter_*_total?)")
+    for r in rows:
+        share = r.get("share") or {}
+        flagged = r.get("state") == "noisy"
+        lines.append(_row((
+            r.get("model", ""), r.get("adapter", ""),
+            "%.1f" % (100 * share.get("step_seconds", 0.0)),
+            "%.1f" % (100 * share.get("tokens", 0.0)),
+            "%.1f" % (100 * share.get("kv_block_seconds", 0.0)),
+            "%.1f" % (100 * r.get("traffic_share", 0.0)),
+            "%.2f" % r.get("score", 0.0),
+            r.get("state", "quiet"),
+        ), RED if (flagged and color) else ""))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--url", default="http://localhost:8081",
+                        help="gateway base URL (default %(default)s)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh seconds in live mode")
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit (CI logs)")
+    args = parser.parse_args(argv)
+    try:
+        if args.once:
+            print(render_table(fetch_usage(args.url)))
+            return 0
+        while True:
+            frame = render_table(fetch_usage(args.url), color=True)
+            sys.stdout.write(CLEAR + frame + "\n"
+                             + f"{DIM}{args.url}  ^C to quit{RESET}\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        print(f"lig-top: cannot reach {args.url}/debug/usage: {e}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
