@@ -548,6 +548,184 @@ def run_policy_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
+                               n_models: int = 1000,
+                               batch: int = 64) -> dict:
+    """Snapshot-resident native pick cost (the data-plane fast path).
+
+    200 pods x 1000 adapters — the LOADGEN fixture scale — over a REAL
+    versioned ``Provider`` so the resident state marshals once and every
+    pick crosses the FFI with request scalars only.  Three measurements,
+    MIN over interleaved runs (contended-core precedent from the other
+    microbenches):
+
+    - ``pick_native_us``: one ``schedule()`` = one ``lig_pick`` crossing.
+    - ``pick_many_us``: per-pick cost with ``batch`` requests amortized
+      into ONE ``lig_pick_many`` crossing.
+    - ``pick_python_us``: the Python oracle on the SAME fixture, and
+      ``pick_native_speedup`` = python/native — the compute-only gap the
+      e2e loadgen ratio is chasing.
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway.scheduling import native
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.testing import (
+        build_handler_server, fake_metrics, fake_pod, make_model,
+    )
+
+    if not native.available():
+        return {"native_pick_error": "libligsched.so unavailable"}
+    per_pod = max(1, n_models // n_pods)
+    pods = {
+        fake_pod(i): fake_metrics(
+            queue=i % 5, kv=(i % 10) / 10.0,
+            adapters={f"adapter-{i * per_pod + j}": 0
+                      for j in range(per_pod)},
+            max_adapters=per_pod + 1)
+        for i in range(n_pods)
+    }
+    models = [make_model(f"adapter-{i}") for i in range(n_models)]
+    # build_handler_server gives a versioned Provider (snapshot cache key).
+    provider = build_handler_server(pods, models).scheduler._provider
+    nat = native.NativeScheduler(provider, rng=random_mod.Random(0))
+    py = Scheduler(provider, rng=random_mod.Random(0), prefix_aware=False)
+    reqs = [
+        LLMRequest(model=f"adapter-{i % n_models}",
+                   resolved_target_model=f"adapter-{i % n_models}",
+                   critical=True, prompt_tokens=25, criticality="Critical")
+        for i in range(256)
+    ]
+
+    def loop_single(sched) -> float:
+        t0 = time.perf_counter()
+        for i in range(n):
+            sched.schedule(reqs[i % len(reqs)])
+        return time.perf_counter() - t0
+
+    def loop_many() -> float:
+        t0 = time.perf_counter()
+        done = 0
+        while done < n:
+            take = min(batch, n - done)
+            nat.pick_many([reqs[(done + k) % len(reqs)]
+                           for k in range(take)])
+            done += take
+        return time.perf_counter() - t0
+
+    loop_single(nat), loop_many(), loop_single(py)  # warmup
+    nat_best = many_best = py_best = float("inf")
+    for _ in range(8):
+        nat_best = min(nat_best, loop_single(nat))
+        many_best = min(many_best, loop_many())
+        py_best = min(py_best, loop_single(py))
+    return {
+        "pick_native_us": round(nat_best / n * 1e6, 2),
+        "pick_many_us": round(many_best / n * 1e6, 2),
+        "pick_python_us": round(py_best / n * 1e6, 2),
+        "pick_native_speedup": round(py_best / nat_best, 2),
+        "native_picks_per_s": round(n / nat_best, 1),
+    }
+
+
+def run_relay_microbench(n_chunks: int = 256, chunk_bytes: int = 160,
+                         rounds: int = 6) -> dict:
+    """Zero-copy relay A/B: chunks/s through the REAL proxy relay loop,
+    fast (verbatim write + tail references) vs slow (per-chunk line
+    re-framing) — same upstream script, same sockets, interleaved rounds
+    with MAX throughput per side (the µbench the regression gate rides).
+    """
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway import resilience
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, make_model,
+    )
+    from llm_instance_gateway_tpu.gateway.types import Pod, PodMetrics
+
+    filler = b'data: {"choices": [{"index": 0, "text": "' + \
+        b"x" * max(1, chunk_bytes - 60) + b'"}]}\n\n'
+    final = (b'data: {"choices": [{"index": 0, "text": "."}], '
+             b'"usage": {"prompt_tokens": 7, "completion_tokens": 3}}\n\n')
+
+    async def measure() -> dict:
+        async def completions(request: web.Request) -> web.StreamResponse:
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for _ in range(n_chunks - 2):
+                await resp.write(filler)
+            await resp.write(final)
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", completions)
+        up = TestServer(app)
+        await up.start_server()
+
+        async def one_side(fast: bool):
+            pods = {Pod("p", f"127.0.0.1:{up.port}"): fake_metrics()}
+            ds = Datastore(pods=list(pods))
+            ds.set_pool(InferencePool(name="pool"))
+            ds.store_model(make_model("m"))
+            provider = StaticProvider(
+                [PodMetrics(pod=p, metrics=m) for p, m in pods.items()])
+            proxy = GatewayProxy(
+                Server(Scheduler(provider, token_aware=False,
+                                 prefill_aware=False, prefix_aware=False),
+                       ds),
+                provider, ds,
+                resilience_cfg=resilience.ResilienceConfig(),
+                fast_relay=fast)
+            client = TestClient(TestServer(proxy.build_app()))
+            await client.start_server()
+
+            async def one_round() -> float:
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"model": "m", "prompt": "x", "stream": True})
+                raw = await resp.read()
+                wall = time.perf_counter() - t0
+                assert resp.status == 200 and raw.endswith(
+                    b"data: [DONE]\n\n")
+                return wall
+
+            return client, one_round
+
+        fast_client, fast_round = await one_side(True)
+        slow_client, slow_round = await one_side(False)
+        try:
+            await fast_round(), await slow_round()  # warmup pair
+            fast_best = slow_best = float("inf")
+            for _ in range(rounds):
+                fast_best = min(fast_best, await fast_round())
+                slow_best = min(slow_best, await slow_round())
+        finally:
+            await fast_client.close()
+            await slow_client.close()
+            await up.close()
+        return {
+            "relay_fast_chunks_per_s": round(n_chunks / fast_best, 1),
+            "relay_slow_chunks_per_s": round(n_chunks / slow_best, 1),
+            # >= 1.0: verbatim relay at least matches the line scanner.
+            "relay_fast_ratio": round(slow_best / fast_best, 4),
+        }
+
+    return asyncio.run(measure())
+
+
 def _collect_handoff_metrics(timeout_s: float = 300.0) -> None:
     """Run the disaggregation phase in a CPU subprocess BEFORE the device
     claim (it must not touch — or wait for — the TPU relay) and merge its
@@ -924,6 +1102,17 @@ if __name__ == "__main__":
             results.update(run_policy_microbench())
         except Exception as e:
             results["pick_policy_error"] = str(e)[:200]
+        try:
+            # Data-plane fast path (perf PR 6): snapshot-resident native
+            # pick + batched pick_many cost at the loadgen fixture scale.
+            results.update(run_native_pick_microbench())
+        except Exception as e:
+            results["native_pick_error"] = str(e)[:200]
+        try:
+            # Zero-copy relay A/B rides every emission too.
+            results.update(run_relay_microbench())
+        except Exception as e:
+            results["relay_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

@@ -31,7 +31,10 @@ from llm_instance_gateway_tpu.gateway.handlers.messages import (
 from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
     prefix_hashes,
 )
-from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.scheduling.types import (
+    LazyPrefixHashes,
+    LLMRequest,
+)
 from llm_instance_gateway_tpu.tracing import (
     TRACE_HEADER,
     header_trace_id,
@@ -107,14 +110,6 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
     req_ctx.resolved_target_model = model_name
 
     text = prompt_text(body)
-    # The hash chain (up to 32 chained blake2b calls over 8 KB of prompt)
-    # runs on EVERY request body in the ext-proc hot path — skip it when
-    # the scheduler was built prefix-unaware: dead weight otherwise.
-    # Skipping requires an EXPLICIT prefix_index=None (the prefix_aware=
-    # False build); a custom drop-in scheduler without the attribute still
-    # gets hashes — it may consume req.prefix_hashes without exposing the
-    # index.
-    prefix_aware = getattr(server.scheduler, "prefix_index", True) is not None
     llm_req = LLMRequest(
         model=model,
         resolved_target_model=model_name,
@@ -122,10 +117,16 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
         prompt_tokens=len(text) // 4,
         criticality=(model_obj.spec.criticality.value
                      if model_obj.spec.criticality else "Default"),
+        # The hash chain (up to 32 chained blake2b calls over 8 KB of
+        # prompt) used to run on EVERY request body in the ext-proc hot
+        # path; the lazy thunk defers it until a scheduler actually
+        # evaluates req.prefix_hashes — a prefix-unaware build (or a
+        # custom drop-in that never reads the field) never pays it, and a
+        # consumer that does read it gets the identical tuple.
         # Model-seeded: identical boilerplate under different models must
         # not alias (their KV blocks can't be shared).
-        prefix_hashes=(prefix_hashes(text, model=model_name)
-                       if prefix_aware else ()),
+        prefix_hashes=LazyPrefixHashes(
+            lambda: prefix_hashes(text, model=model_name)),
     )
 
     request_body = msg.body

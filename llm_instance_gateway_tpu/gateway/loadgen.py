@@ -2,8 +2,19 @@
 
 Parity: reference ``pkg/ext-proc/test/benchmark/benchmark.go:20-110`` — spin a
 local ext-proc server with ``numFakePods`` fake pods × ``numModelsPerPod``
-adapters (default 200×5 = 1000 models), fire N gRPC Process requests
+adapters (default 200×5 = 1000 models), fire N Process requests
 round-robining model names, and report throughput + latency summary.
+
+Two transports (the data-plane fast-path A/B; every emission carries which
+one ran as ``relay_mode``):
+
+- **fast** (default): drives the handler ``Server.process`` in-process —
+  no gRPC stream, no proto (de)serialization — i.e. the pick →
+  header-mutate hot path alone, the loop the ≥10k routed picks/s/core
+  target is about.
+- **slow** (``--no-fast-path``): the pre-existing gRPC ext-proc stream,
+  paying the full proto marshalling tax per request — the baseline the
+  fast/slow ratio in every artifact compares against.
 
 Run:  python -m llm_instance_gateway_tpu.gateway.loadgen --requests 10000
 Also imported by bench.py for the scheduler-throughput component.
@@ -16,19 +27,18 @@ import json
 import random
 import time
 
-import grpc
-
 from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
-from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
-from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub
+from llm_instance_gateway_tpu.gateway.handlers.messages import RequestBody
 from llm_instance_gateway_tpu.gateway.handlers.server import (
     DEFAULT_DECODE_POD_HEADER,
     DEFAULT_TARGET_POD_HEADER,
+    RequestContext,
 )
 from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
     PREFIX_BLOCK_CHARS,
 )
 from llm_instance_gateway_tpu.gateway.testing import (
+    build_handler_server,
     fake_metrics,
     fake_pod,
     generate_request,
@@ -137,11 +147,16 @@ def run_load(
     trace_out: str | None = None,
     adapter_mix: dict[str, float] | None = None,
     mix_seed: int = 0,
+    fast_path: bool = True,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
     ``use_native`` swaps the Python filter tree for the C++ scheduler hot
     path (``scheduling/native.py``) — the A/B the recorded results compare.
+    ``fast_path`` picks the transport: in-process ``Server.process``
+    dispatch (fast; no gRPC stream, no proto marshalling) vs the
+    pre-existing gRPC ext-proc stream (slow) — the summary's
+    ``relay_mode`` field records which one ran.
     ``session_prefix_chars`` > 0 switches to session traffic: every request
     carries one of ``session_count`` shared prompt prefixes, measuring the
     prefix-affinity path's hot-loop cost (hashing rides the pick) and its
@@ -181,76 +196,118 @@ def run_load(
         if not available():
             raise RuntimeError("native scheduler library unavailable")
         factory = make_scheduler
-    server = start_ext_proc(pods, models, port=port,
-                            scheduler_factory=factory)
     total_models = num_fake_pods * num_models_per_pod
     latencies: list[float] = []
-    try:
-        channel = grpc.insecure_channel(f"localhost:{port}")
-        stub = make_process_stub(channel)
+    session_pods: dict[int, set[str]] = {}
+    two_stage_hits = 0
+    trace_hits = 0  # responses carrying the echoed x-lig-trace-id
+    # Weighted adapter draw: seeded, so a mix scenario replays exactly.
+    mix_rng = random.Random(mix_seed)
+    mix_names = sorted(adapter_mix) if adapter_mix else []
+    mix_weights = [adapter_mix[n] for n in mix_names] if adapter_mix \
+        else []
+    per_adapter_lat: dict[str, list[float]] = {}
+
+    def body_for(i: int) -> tuple[bytes, int | None, str | None]:
+        if adapter_mix:
+            name = mix_rng.choices(mix_names, weights=mix_weights)[0]
+            target = "shared-base" if name == "base" else name
+            return generate_request(target), None, name
+        if session_prefix_chars:
+            sid = i % session_count
+            return generate_request(
+                "shared-base",
+                prompt=session_prompt(sid, i, session_prefix_chars)), \
+                sid, None
+        return generate_request(model_name(i % total_models)), None, None
+
+    def account(keys: dict, sid: int | None) -> None:
+        """Per-response bookkeeping shared by both transports; ``keys``
+        maps set-header name -> value."""
+        nonlocal trace_hits, two_stage_hits
+        if TRACE_HEADER in keys:
+            trace_hits += 1
+        if role_split and (DEFAULT_TARGET_POD_HEADER in keys
+                           and DEFAULT_DECODE_POD_HEADER in keys):
+            two_stage_hits += 1
+        if sid is not None:
+            target = keys.get(DEFAULT_TARGET_POD_HEADER)
+            if target:
+                session_pods.setdefault(sid, set()).add(target)
+
+    if fast_path:
+        # In-process dispatch: the handler core alone — request parse,
+        # admission, pick, header mutation — with ZERO transport framing.
+        server = build_handler_server(pods, models, scheduler_factory=factory)
         t_start = time.perf_counter()
-        # Round-robin model names (benchmark.go:64-69), batched into streams.
-        sent = 0
-        session_pods: dict[int, set[str]] = {}
-        two_stage_hits = 0
-        trace_hits = 0  # responses carrying the echoed x-lig-trace-id
-        # Weighted adapter draw: seeded, so a mix scenario replays exactly.
-        mix_rng = random.Random(mix_seed)
-        mix_names = sorted(adapter_mix) if adapter_mix else []
-        mix_weights = [adapter_mix[n] for n in mix_names] if adapter_mix \
-            else []
-        per_adapter_lat: dict[str, list[float]] = {}
-
-        def body_for(i: int) -> tuple[bytes, int | None, str | None]:
-            if adapter_mix:
-                name = mix_rng.choices(mix_names, weights=mix_weights)[0]
-                target = "shared-base" if name == "base" else name
-                return generate_request(target), None, name
-            if session_prefix_chars:
-                sid = i % session_count
-                return generate_request(
-                    "shared-base",
-                    prompt=session_prompt(sid, i, session_prefix_chars)), \
-                    sid, None
-            return generate_request(model_name(i % total_models)), None, None
-
-        while sent < requests:
-            batch = min(requests - sent, max(1, requests // streams))
-            bodies = [body_for(sent + k) for k in range(batch)]
-            msgs = [
-                pb.ProcessingRequest(request_body=pb.HttpBody(body=body))
-                for body, _, _ in bodies
-            ]
+        for i in range(requests):
+            body, sid, adapter = body_for(i)
+            msg = RequestBody(body=body)
+            # Body construction stays OUTSIDE the sample, matching the
+            # slow path (which builds every body before its timer): the
+            # latency A/B measures the gateway's processing, not the rig's
+            # request generator.
             t0 = time.perf_counter()
-            # One stream per batch: measures per-message processing inline.
-            for k, resp in enumerate(stub(iter(msgs))):
-                t1 = time.perf_counter()
-                latencies.append(t1 - t0)
-                adapter = bodies[k][2]
-                if adapter is not None:
-                    per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
-                t0 = t1
-                assert resp.WhichOneof("response") == "request_body"
-                keys = {h.header.key for h in (resp.request_body.response
-                                               .header_mutation.set_headers)}
-                if TRACE_HEADER in keys:
-                    trace_hits += 1
-                if role_split:
-                    if (DEFAULT_TARGET_POD_HEADER in keys
-                            and DEFAULT_DECODE_POD_HEADER in keys):
-                        two_stage_hits += 1
-                sid = bodies[k][1]
-                if sid is not None:
-                    for h in (resp.request_body.response
-                              .header_mutation.set_headers):
-                        if h.header.key == DEFAULT_TARGET_POD_HEADER:
-                            session_pods.setdefault(sid, set()).add(
-                                h.header.raw_value or h.header.value)
-            sent += batch
+            res = server.process(RequestContext(), msg)
+            t1 = time.perf_counter()
+            latencies.append(t1 - t0)
+            if adapter is not None:
+                per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
+            assert res.immediate_status is None, \
+                f"request {i} shed ({res.immediate_status})"
+            account(res.set_headers, sid)
         wall = time.perf_counter() - t_start
-        channel.close()
-    finally:
-        server.stop(None)
+    else:
+        import grpc
+
+        from llm_instance_gateway_tpu.gateway.extproc import (
+            ext_proc_v3_pb2 as pb,
+        )
+        from llm_instance_gateway_tpu.gateway.extproc.service import (
+            make_process_stub,
+        )
+
+        server = start_ext_proc(pods, models, port=port,
+                                scheduler_factory=factory)
+        try:
+            channel = grpc.insecure_channel(f"localhost:{port}")
+            stub = make_process_stub(channel)
+            t_start = time.perf_counter()
+            # Round-robin model names (benchmark.go:64-69), batched into
+            # streams.
+            sent = 0
+            while sent < requests:
+                batch = min(requests - sent, max(1, requests // streams))
+                bodies = [body_for(sent + k) for k in range(batch)]
+                msgs = [
+                    pb.ProcessingRequest(request_body=pb.HttpBody(body=body))
+                    for body, _, _ in bodies
+                ]
+                t0 = time.perf_counter()
+                # One stream per batch: measures per-message processing
+                # inline.
+                for k, resp in enumerate(stub(iter(msgs))):
+                    t1 = time.perf_counter()
+                    latencies.append(t1 - t0)
+                    adapter = bodies[k][2]
+                    if adapter is not None:
+                        per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
+                    t0 = t1
+                    assert resp.WhichOneof("response") == "request_body"
+                    keys = {
+                        h.header.key: (h.header.raw_value.decode("utf-8",
+                                                                 "replace")
+                                       if h.header.raw_value
+                                       else h.header.value)
+                        for h in (resp.request_body.response
+                                  .header_mutation.set_headers)
+                    }
+                    account(keys, bodies[k][1])
+                sent += batch
+            wall = time.perf_counter() - t_start
+            channel.close()
+        finally:
+            server.stop(None)
 
     latencies.sort()
 
@@ -268,6 +325,10 @@ def run_load(
         # 1.0 = every scheduled response echoed a trace id in its header
         # mutation (the client-side correlation contract).
         "trace_id_rate": round(trace_hits / requests, 4),
+        # Which data-plane transport ran: "fast" = in-process dispatch,
+        # "slow" = gRPC ext-proc stream — so every future artifact carries
+        # the fast/slow axis alongside the scheduler one.
+        "relay_mode": "fast" if fast_path else "slow",
     }
     if trace_out:
         # Raw per-request samples in the shape tools/trace_report.py reads
@@ -335,6 +396,11 @@ def main(argv=None):
                              'latency breakdown in the report')
     parser.add_argument("--mix-seed", type=int, default=0,
                         help="seed for the weighted adapter draw")
+    parser.add_argument("--no-fast-path", action="store_true",
+                        help="drive the gRPC ext-proc stream (proto "
+                             "marshalling per request) instead of the "
+                             "in-process fast path — the slow side of the "
+                             "relay_mode A/B")
     args = parser.parse_args(argv)
     summary = run_load(args.requests, args.fake_pods, args.models_per_pod,
                        use_native=args.native,
@@ -344,7 +410,8 @@ def main(argv=None):
                        trace_out=args.trace_out,
                        adapter_mix=(parse_adapter_mix(args.adapter_mix)
                                     if args.adapter_mix else None),
-                       mix_seed=args.mix_seed)
+                       mix_seed=args.mix_seed,
+                       fast_path=not args.no_fast_path)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 

@@ -88,6 +88,31 @@ from llm_instance_gateway_tpu import tracing
 
 logger = logging.getLogger(__name__)
 
+# Fast-relay final-usage window: the zero-copy path keeps only the trailing
+# bytes of the stream (as whole chunk references, never per-chunk copies) to
+# parse the final usage chunk from; SSE usage envelopes are a few hundred
+# bytes, so 16 KB of tail is orders of magnitude of margin.
+RELAY_TAIL_BYTES = 16384
+# Upstream keepalive pool: how long an idle per-pod connection survives and
+# how many concurrent connections one pod may hold.  Reuse is the point —
+# a fresh TCP handshake per request is pure data-plane tax.
+UPSTREAM_KEEPALIVE_S = float(os.environ.get("LIG_UPSTREAM_KEEPALIVE_S", "30"))
+UPSTREAM_CONNS_PER_POD = int(os.environ.get("LIG_UPSTREAM_CONNS_PER_POD",
+                                            "32"))
+
+
+def final_data_line(tail: bytes) -> bytes:
+    """Last complete ``data: `` line of an SSE stream that is not the
+    ``[DONE]`` terminator, from the stream's trailing bytes — the fast
+    relay's end-of-stream usage parse (raw bytes; the per-chunk loop never
+    re-frames lines).  Matches the slow path's incremental scan: only
+    ``\\n``-terminated lines count."""
+    lines = tail.split(b"\n")
+    for line in reversed(lines[:-1]):
+        if line.startswith(b"data: ") and line != b"data: [DONE]":
+            return line
+    return b""
+
 
 class GatewayProxy:
     def __init__(
@@ -100,6 +125,7 @@ class GatewayProxy:
         health_cfg: "health_mod.HealthConfig | None" = None,
         usage_cfg: "usage_mod.UsageConfig | None" = None,
         blackbox_dir: str | None = None,
+        fast_relay: bool = True,
     ):
         self.server = handler_server
         self.provider = provider
@@ -168,6 +194,18 @@ class GatewayProxy:
         # keeps weak ones; see _spawn_release).
         self._release_tasks: set = set()
         self._session: aiohttp.ClientSession | None = None
+        # Data-plane fast path (this PR's tentpole): the zero-copy SSE
+        # relay.  ``fast_relay=False`` keeps the pre-existing line-scanning
+        # relay — the byte-parity oracle the A/B tests compare against.
+        self.fast_relay = fast_relay
+        # Preallocated header templates: the per-request mutation copies a
+        # template and stamps the request-scoped values instead of
+        # rebuilding the static keys on every hop.
+        self._sse_headers_tpl = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        }
+        self._upstream_headers_tpl = {"Content-Type": "application/json"}
 
     # -- app wiring --------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -193,9 +231,31 @@ class GatewayProxy:
         # replica fails in seconds while a long healthy stream runs
         # indefinitely.
         rcfg = self.resilience.cfg
+        # Per-pod keepalive connection pool: upstream connections are
+        # reused across requests (a handshake per request is data-plane
+        # tax), with creation/reuse counted per pod through aiohttp's
+        # trace hooks — the ``gateway_upstream_connections_total`` family
+        # and the reuse-ratio gauge come straight from these two events.
+        connector = aiohttp.TCPConnector(
+            limit=0, limit_per_host=UPSTREAM_CONNS_PER_POD,
+            keepalive_timeout=UPSTREAM_KEEPALIVE_S)
+        trace_cfg = aiohttp.TraceConfig()
+
+        async def _conn_created(session, ctx, params) -> None:
+            pod = (getattr(ctx, "trace_request_ctx", None) or {}).get("pod")
+            self.metrics.record_upstream_conn(pod or "?", reused=False)
+
+        async def _conn_reused(session, ctx, params) -> None:
+            pod = (getattr(ctx, "trace_request_ctx", None) or {}).get("pod")
+            self.metrics.record_upstream_conn(pod or "?", reused=True)
+
+        trace_cfg.on_connection_create_end.append(_conn_created)
+        trace_cfg.on_connection_reuseconn.append(_conn_reused)
         self._session = aiohttp.ClientSession(
+            connector=connector,
             timeout=aiohttp.ClientTimeout(
-                total=None, connect=rcfg.connect_timeout_s or None)
+                total=None, connect=rcfg.connect_timeout_s or None),
+            trace_configs=[trace_cfg],
         )
         if self.obs_tick_s > 0:
             self._obs_task = asyncio.get_running_loop().create_task(
@@ -479,15 +539,15 @@ class GatewayProxy:
         generation finished server-side).  Raises asyncio.TimeoutError /
         aiohttp.ClientError for the caller to classify."""
         ttft = self.resilience.cfg.ttft_timeout_s
+        headers = dict(self._upstream_headers_tpl)
+        headers["x-request-id"] = request_id
+        headers[tracing.TRACE_HEADER] = trace_id
+        headers[self.server.target_pod_header] = pod.address
         coro = self._session.post(
             f"http://{pod.address}{path}",
             data=out_body,
-            headers={
-                "Content-Type": "application/json",
-                "x-request-id": request_id,
-                tracing.TRACE_HEADER: trace_id,
-                self.server.target_pod_header: pod.address,
-            },
+            headers=headers,
+            trace_request_ctx={"pod": pod.name},
         )
         return await (asyncio.wait_for(coro, ttft) if ttft > 0 else coro)
 
@@ -710,6 +770,7 @@ class GatewayProxy:
                     headers={"Content-Type": "application/json",
                              "x-request-id": request_id,
                              tracing.TRACE_HEADER: trace_id},
+                    trace_request_ctx={"pod": prefill_pod.name},
                 ), rcfg.ttft_timeout_s)
             if pre.status != 200:
                 logger.warning(
@@ -740,6 +801,7 @@ class GatewayProxy:
                     headers={"Content-Type": "application/octet-stream",
                              "x-request-id": request_id,
                              tracing.TRACE_HEADER: trace_id},
+                    trace_request_ctx={"pod": decode_pod.name},
                 ), rcfg.ttft_timeout_s)
             status = upstream.status
             if status != 200:
@@ -848,6 +910,7 @@ class GatewayProxy:
                         f"http://{pod.address}/v1/prefill/release",
                         json={"request_id": engine_req_id},
                         headers={tracing.TRACE_HEADER: trace_id},
+                        trace_request_ctx={"pod": pod.name},
                     ), timeout=5.0,
                 ) as r:
                     ok = (r.status == 200
@@ -892,8 +955,17 @@ class GatewayProxy:
         event + [DONE] instead; bubbling up would make the handler try to
         send a second response).
 
-        SSE lines are re-framed through a byte buffer so a data line split
-        across transport chunks still parses (usage rides the final chunk).
+        Two relay modes, byte-parity pinned by tests/test_fast_relay.py:
+
+        - **fast** (default, ``self.fast_relay``): zero-copy — every
+          upstream chunk is written to the client verbatim with NO
+          per-chunk decode/split/re-encode; the only per-chunk work is
+          appending a chunk *reference* to a bounded tail deque.  The
+          final usage chunk and ``[DONE]`` exclusion are parsed ONCE at
+          stream end from the raw tail bytes (``final_data_line``).
+        - **slow** (the pre-existing path, kept as the parity oracle):
+          SSE lines are re-framed through a byte buffer per chunk so a
+          data line split across transport chunks still parses.
 
         Per-phase timeouts: the FIRST chunk is bounded by ``ttft_timeout_s``
         and every later inter-chunk gap by ``stream_idle_timeout_s`` — a
@@ -947,28 +1019,38 @@ class GatewayProxy:
             logger.warning("stream from %s broke before first chunk: %s",
                            pod.address, e)
             return None, "read"
-        headers = {
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-            "x-served-by": served_by or pod.name,
-        }
+        headers = dict(self._sse_headers_tpl)
+        headers["x-served-by"] = served_by or pod.name
         if trace_id:
             headers[tracing.TRACE_HEADER] = trace_id
         resp = web.StreamResponse(status=upstream.status, headers=headers)
         await resp.prepare(request)
+        fast = self.fast_relay
         last_data_line = b""
         buf = b""
+        # Fast relay: chunk REFERENCES only — the deque keeps enough tail
+        # bytes for the end-of-stream usage parse, trimmed by whole chunks.
+        tail: list[bytes] = []
+        tail_len = 0
         t_first = None
         try:
             while pending is not None:
                 chunk = pending
                 if t_first is None:
                     t_first = time.time()
-                buf += chunk
-                *lines, buf = buf.split(b"\n")
-                for line in lines:
-                    if line.startswith(b"data: ") and line != b"data: [DONE]":
-                        last_data_line = line
+                if fast:
+                    tail.append(chunk)
+                    tail_len += len(chunk)
+                    while (len(tail) > 1
+                           and tail_len - len(tail[0]) >= RELAY_TAIL_BYTES):
+                        tail_len -= len(tail.pop(0))
+                else:
+                    buf += chunk
+                    *lines, buf = buf.split(b"\n")
+                    for line in lines:
+                        if (line.startswith(b"data: ")
+                                and line != b"data: [DONE]"):
+                            last_data_line = line
                 try:
                     await resp.write(chunk)
                 except (ConnectionResetError, ConnectionError):
@@ -1026,6 +1108,8 @@ class GatewayProxy:
             return resp, None
         t_end = time.time()
         self.resilience.record_upstream(pod.name, ok=True)
+        if fast:
+            last_data_line = final_data_line(b"".join(tail))
         try:
             final = json.loads(last_data_line[len(b"data: "):])
             usage = final.get("usage") or {}
@@ -1123,13 +1207,18 @@ def main(argv: list[str] | None = None) -> None:
 
     parser = argparse.ArgumentParser(description="TPU-native inference gateway")
     parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--no-fast-relay", action="store_true",
+                        help="disable the zero-copy SSE relay fast path "
+                             "(falls back to the line-scanning relay; the "
+                             "A/B axis for byte-parity and perf checks)")
     bootstrap.add_common_args(parser)
     bootstrap.add_resilience_args(parser)
     args = parser.parse_args(argv)
 
     comps = bootstrap.components_from_args(args)
     proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore,
-                         resilience_cfg=bootstrap.resilience_from_args(args))
+                         resilience_cfg=bootstrap.resilience_from_args(args),
+                         fast_relay=not args.no_fast_relay)
     try:
         web.run_app(proxy.build_app(), port=args.port)
     finally:

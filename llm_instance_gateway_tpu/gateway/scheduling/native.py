@@ -6,6 +6,22 @@ computation in C++ and the final random pick kept in Python so RNG behavior
 matches.  Falls back transparently when the shared library can't be built
 (``available()`` is False); callers should construct via ``make_scheduler``.
 
+Snapshot-resident fast path (the data-plane tentpole): the whole routable
+world — pod metric arrays, the health/circuit avoid-set, the adapter
+residency table, usage-deprioritization marks, and the threshold config —
+is marshalled into a native ``State`` handle ONCE per provider snapshot
+version (i.e. at scrape cadence), not per pick.  The per-pick FFI crossing
+then carries only request scalars (interned adapter id, critical,
+prompt_tokens) and reads the candidate set out of a persistent buffer; the
+RNG draw stays in Python, so picks are byte-identical to the Python
+``Scheduler`` parity oracle (same-RNG diff tests).  ``pick_many`` batches N
+requests into one crossing for the bench/load rigs.
+
+Fallback-to-Python rules: no library -> ``make_scheduler`` returns the
+Python ``Scheduler``; a provider without ``snapshot()`` (or a role-filtered
+subset) has no version to key the resident state on, so the state is
+re-marshalled per pick — semantics identical, amortization lost.
+
 The library auto-builds on first use via the Makefile next to the source —
 the image ships g++/make, and the build is one translation unit (<1 s).
 """
@@ -17,6 +33,7 @@ import logging
 import os
 import random
 import threading
+import weakref
 
 import numpy as np
 
@@ -51,9 +68,24 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libligsched.so")
 
 LIG_SHED = -1
 LIG_ERROR = -2
+LIG_SHED_STRICT = -3
+
+# filter_by_policy parity: the policy string marshals to a native mode code
+# at snapshot-update time (log_only never filters natively either).
+_POLICY_CODE = {"log_only": 0, "avoid": 1, "strict": 2}
+
+_SHED_MSG = ("failed to apply filter, resulted 0 pods: dropping request due "
+             "to limited backend resources")
+_STRICT_MSG = ("all candidate replicas are unhealthy or circuit-open "
+               "(health_policy=strict)")
 
 _lib = None
 _lib_lock = threading.Lock()
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f64p = ctypes.POINTER(ctypes.c_double)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 def _load_library():
@@ -73,28 +105,40 @@ def _load_library():
         except OSError as e:
             logger.warning("native scheduler load failed: %s", e)
             return None
-        lib.lig_schedule_candidates.restype = ctypes.c_int32
-        lib.lig_schedule_candidates.argtypes = [
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),   # waiting
-            ctypes.POINTER(ctypes.c_int32),   # prefill
-            ctypes.POINTER(ctypes.c_double),  # kv_usage
-            ctypes.POINTER(ctypes.c_int64),   # kv_free
-            ctypes.POINTER(ctypes.c_int64),   # kv_capacity
-            ctypes.POINTER(ctypes.c_uint8),   # has_affinity
-            ctypes.POINTER(ctypes.c_int32),   # n_active
-            ctypes.POINTER(ctypes.c_int32),   # max_active
-            ctypes.c_uint8,                   # critical
-            ctypes.c_int64,                   # prompt_tokens
-            ctypes.c_double,                  # kv_cache_threshold
-            ctypes.c_int32,                   # queue_threshold_critical
-            ctypes.c_int32,                   # queueing_threshold_lora
-            ctypes.c_double,                  # token_headroom_factor
-            ctypes.c_int32,                   # prefill_queue_threshold
-            ctypes.c_uint8,                   # token_aware
-            ctypes.c_uint8,                   # prefill_aware
-            ctypes.POINTER(ctypes.c_int32),   # out
-        ]
+        try:
+            lib.lig_state_new.restype = ctypes.c_void_p
+            lib.lig_state_new.argtypes = []
+            lib.lig_state_free.restype = None
+            lib.lig_state_free.argtypes = [ctypes.c_void_p]
+            lib.lig_state_update.restype = ctypes.c_int32
+            lib.lig_state_update.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                _i32p, _i32p, _f64p, _i64p, _i64p,  # waiting..kv_capacity
+                _i32p, _i32p,                       # n_active, max_active
+                _u8p,                               # avoid marks
+                ctypes.c_int32, _i32p, _i32p,       # adapters CSR
+                _u8p,                               # adapter noisy marks
+                ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_double, ctypes.c_int32,
+                ctypes.c_uint8, ctypes.c_uint8,     # token/prefill aware
+                ctypes.c_uint8,                     # policy mode
+            ]
+            lib.lig_pick.restype = ctypes.c_int32
+            lib.lig_pick.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint8,
+                ctypes.c_int64, _i32p, _u8p,
+            ]
+            lib.lig_pick_many.restype = ctypes.c_int32
+            lib.lig_pick_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                _i32p, _u8p, _i64p,   # adapter_ids, criticals, prompt_tokens
+                _i32p, _i32p, _u8p,   # out_counts, out_cands, out_flags
+            ]
+        except AttributeError as e:
+            # A stale .so predating the snapshot API: rebuildable hosts get
+            # a fresh build on the next ensure; meanwhile fall back.
+            logger.warning("native scheduler ABI mismatch: %s", e)
+            return None
         _lib = lib
         return _lib
 
@@ -107,8 +151,34 @@ def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+class _NativeState:
+    """One native snapshot handle + the Python-side cache keys guarding it."""
+
+    __slots__ = ("handle", "key", "avoid", "out", "intern", "_finalizer",
+                 "__weakref__")
+
+    def __init__(self, lib):
+        self.handle = lib.lig_state_new()
+        if not self.handle:
+            raise RuntimeError("lig_state_new failed")
+        self.key = None          # (version, n_pods, policy, cfg_gen)
+        self.avoid = None        # frozenset marshalled into the avoid marks
+        self.out = np.empty(0, np.int32)  # persistent candidate buffer
+        # Adapter interning for THIS state's residency CSR: name -> dense
+        # id, rebuilt from scratch at every marshal so the table (and the
+        # native bitmap sized from it) stays bounded by the adapters
+        # actually resident in the snapshot — never by historical churn.
+        # A request adapter absent from the table was not resident on any
+        # pod at snapshot time (id -1: no affinity anywhere) — exactly the
+        # Python tree's view of the same snapshot.
+        self.intern: dict[str, int] = {}
+        self._finalizer = weakref.finalize(
+            self, lib.lig_state_free, self.handle)
+
+
 class NativeScheduler:
-    """Same interface as Scheduler.schedule; C++ candidate computation."""
+    """Same interface as Scheduler.schedule; C++ candidate computation over
+    a snapshot-resident native state."""
 
     def __init__(
         self,
@@ -147,95 +217,112 @@ class NativeScheduler:
         # seam, and it keeps the fuzz-pinned C++ candidate parity for the
         # main tree untouched.
         self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
-        self._snapshot: dict | None = None
-        # The gRPC transport calls schedule() from a thread pool; the cached
-        # arrays (including the C++ output buffer) are shared state.
+        # Snapshot-resident native state: ``_state`` is keyed on the
+        # provider's monotonic snapshot version (plus policy/config
+        # generations) and re-marshalled only when one of them moves;
+        # ``_scratch`` serves version-less calls (role subsets, the legacy
+        # candidates() API) where there is nothing to key a cache on.
+        self._state = _NativeState(lib)
+        self._scratch = _NativeState(lib)
+        self._cfg_gen = 0
+        # (version, pods-after-role-policy, effective version) — see
+        # _routable_pods.
+        self._role_cache: tuple | None = None
+        # The gRPC transport calls schedule() from a thread pool; the
+        # native state handles and persistent buffers are shared state.
         self._call_lock = threading.Lock()
         # Health/resilience hook (gateway/resilience.py) — same seam as
         # the Python Scheduler: log_only counts would-be avoidance picks
         # and never alters the pick (candidate parity with C++ stays
-        # exact); avoid/strict filter via filter_by_policy in _pick.
+        # exact); avoid/strict marshal the advisor's avoid_set into the
+        # native snapshot so policy filtering costs zero extra crossings.
         self.health_advisor = None
         # Usage seam (gateway/usage.py) — log-only pick counting, same
-        # contract as the Python Scheduler's usage_advisor.
+        # contract as the Python Scheduler's usage_advisor.  The noisy
+        # marks ALSO ride the native snapshot (per-adapter bits, refreshed
+        # at marshal time) so a future enforcing fairness policy is one
+        # policy-mode away, but the log-only counter keeps reading the
+        # advisor's live set for byte-exact parity with the Python path.
         self.usage_advisor = None
 
-    def _arrays(self, req: LLMRequest, pods: list[PodMetrics],
-                version: int | None):
-        """Flattened metric arrays, cached per provider snapshot version.
+    # -- marshalling --------------------------------------------------------
+    def _policy_and_avoid(self) -> tuple[str, frozenset]:
+        """The advisor's current policy + avoid-set (both cheap cached
+        reads on the ResiliencePlane).  log_only marshals no marks."""
+        advisor = self.health_advisor
+        if advisor is None:
+            return "log_only", frozenset()
+        policy = getattr(advisor, "policy", "log_only")
+        if policy == "log_only":
+            return policy, frozenset()
+        batch = getattr(advisor, "avoid_set", None)
+        if batch is not None:
+            return policy, frozenset(batch())
+        return policy, None  # per-pod should_avoid: no cheap change signal
 
-        Marshalling Python attributes into arrays costs more than the C++
-        tree itself; metrics only change at scrape cadence (50 ms), so the
-        arrays are rebuilt once per snapshot and shared by every request in
-        between.  Per-adapter residency vectors are cached the same way.
-        ``version`` must be read atomically WITH ``pods`` (Provider.snapshot)
-        or None to disable caching.
-        """
-        cached = self._snapshot
-        if version is None or cached is None or cached["version"] != version \
-                or cached["n"] != len(pods):
-            n = len(pods)
-            cached = {
-                "version": version,
-                "n": n,
-                "waiting": np.fromiter(
-                    (pm.metrics.total_queue_size for pm in pods), np.int32, n),
-                "prefill": np.fromiter(
-                    (pm.metrics.prefill_queue_size for pm in pods), np.int32, n),
-                "kv_usage": np.fromiter(
-                    (pm.metrics.kv_cache_usage_percent for pm in pods), np.float64, n),
-                "kv_free": np.fromiter(
-                    (pm.metrics.kv_tokens_free for pm in pods), np.int64, n),
-                "kv_capacity": np.fromiter(
-                    (pm.metrics.kv_tokens_capacity for pm in pods), np.int64, n),
-                "n_active": np.fromiter(
-                    (len(pm.metrics.active_adapters) for pm in pods), np.int32, n),
-                "max_active": np.fromiter(
-                    (pm.metrics.max_active_adapters for pm in pods), np.int32, n),
-                "affinity": {},
-                "out": np.empty(n, np.int32),
-            }
-            self._snapshot = cached
-        adapter = req.resolved_target_model
-        affinity = cached["affinity"].get(adapter)
-        if affinity is None:
-            affinity = np.fromiter(
-                (adapter in pm.metrics.active_adapters for pm in pods),
-                np.uint8, cached["n"],
-            )
-            cached["affinity"][adapter] = affinity
-        return cached, affinity
-
-    def candidates(self, req: LLMRequest, pods: list[PodMetrics],
-                   version: int | None = None) -> list[int]:
+    def _marshal(self, state: _NativeState, pods: list[PodMetrics],
+                 policy: str, bad: frozenset | None) -> None:
+        """Push the full routable world into ``state`` (tick-time cost)."""
         n = len(pods)
-        if n == 0:
-            # Parity: the Python tree's failure branches land in the drop
-            # filter on an empty pool, i.e. shed -> 429.
-            raise SchedulingError(
-                "failed to apply filter, resulted 0 pods: no pods", shed=True
-            )
-        with self._call_lock:
-            return self._candidates_locked(req, pods, n, version)
-
-    def _candidates_locked(self, req, pods, n, version) -> list[int]:
-        cached, affinity = self._arrays(req, pods, version)
-        waiting = cached["waiting"]
-        prefill = cached["prefill"]
-        kv_usage = cached["kv_usage"]
-        kv_free = cached["kv_free"]
-        n_active = cached["n_active"]
-        max_active = cached["max_active"]
-        out = cached["out"]
-        count = self._lib.lig_schedule_candidates(
-            n,
+        waiting = np.fromiter(
+            (pm.metrics.total_queue_size for pm in pods), np.int32, n)
+        prefill = np.fromiter(
+            (pm.metrics.prefill_queue_size for pm in pods), np.int32, n)
+        kv_usage = np.fromiter(
+            (pm.metrics.kv_cache_usage_percent for pm in pods), np.float64, n)
+        kv_free = np.fromiter(
+            (pm.metrics.kv_tokens_free for pm in pods), np.int64, n)
+        kv_capacity = np.fromiter(
+            (pm.metrics.kv_tokens_capacity for pm in pods), np.int64, n)
+        n_active = np.fromiter(
+            (len(pm.metrics.active_adapters) for pm in pods), np.int32, n)
+        max_active = np.fromiter(
+            (pm.metrics.max_active_adapters for pm in pods), np.int32, n)
+        if bad is None:
+            advisor = self.health_advisor
+            avoid = np.fromiter(
+                (advisor.should_avoid(pm.pod.name) for pm in pods),
+                np.uint8, n)
+        elif bad:
+            avoid = np.fromiter(
+                (pm.pod.name in bad for pm in pods), np.uint8, n)
+        else:
+            avoid = np.zeros(n, np.uint8)
+        # Adapter residency as CSR, interning names to dense ids.  The
+        # table is rebuilt per marshal (see _NativeState.intern): only the
+        # adapters resident in THIS snapshot get ids, so the native bitmap
+        # never grows with historical adapter churn.
+        table: dict[str, int] = {}
+        offsets = np.empty(n + 1, np.int32)
+        ids: list[int] = []
+        for i, pm in enumerate(pods):
+            offsets[i] = len(ids)
+            for name in pm.metrics.active_adapters:
+                aid = table.get(name)
+                if aid is None:
+                    aid = table[name] = len(table)
+                ids.append(aid)
+        offsets[n] = len(ids)
+        res_ids = np.asarray(ids, dtype=np.int32)
+        n_adapters = len(table)
+        noisy = np.zeros(max(1, n_adapters), np.uint8)
+        usage = self.usage_advisor
+        if usage is not None:
+            get_noisy = getattr(usage, "noisy", None)
+            if get_noisy is not None:
+                for name in get_noisy():
+                    aid = table.get(name)
+                    if aid is not None:
+                        noisy[aid] = 1
+        rc = self._lib.lig_state_update(
+            self._void(state), n,
             _ptr(waiting, ctypes.c_int32), _ptr(prefill, ctypes.c_int32),
             _ptr(kv_usage, ctypes.c_double), _ptr(kv_free, ctypes.c_int64),
-            _ptr(cached["kv_capacity"], ctypes.c_int64),
-            _ptr(affinity, ctypes.c_uint8), _ptr(n_active, ctypes.c_int32),
-            _ptr(max_active, ctypes.c_int32),
-            1 if req.critical else 0,
-            req.prompt_tokens,
+            _ptr(kv_capacity, ctypes.c_int64),
+            _ptr(n_active, ctypes.c_int32), _ptr(max_active, ctypes.c_int32),
+            _ptr(avoid, ctypes.c_uint8),
+            n_adapters, _ptr(offsets, ctypes.c_int32),
+            _ptr(res_ids, ctypes.c_int32), _ptr(noisy, ctypes.c_uint8),
             self.cfg.kv_cache_threshold,
             self.cfg.queue_threshold_critical,
             self.cfg.queueing_threshold_lora,
@@ -243,21 +330,85 @@ class NativeScheduler:
             self.cfg.prefill_queue_threshold,
             1 if self.token_aware else 0,
             1 if self.prefill_aware else 0,
-            _ptr(out, ctypes.c_int32),
+            _POLICY_CODE.get(policy, 0),
         )
-        if count == LIG_SHED:
+        if rc != 0:
+            raise SchedulingError(f"native state update failed ({rc})")
+        if state.out.shape[0] < n:
+            state.out = np.empty(n, np.int32)
+        state.avoid = bad
+        state.intern = table
+
+    @staticmethod
+    def _void(state: _NativeState):
+        return ctypes.c_void_p(state.handle)
+
+    def _ensure_state(self, version, pods: list[PodMetrics],
+                      policy_mode: bool = True) -> _NativeState:
+        """Return a marshalled state for ``pods``.
+
+        With a real snapshot ``version`` the resident state is reused until
+        the provider version, the scheduler config, or the advisor's
+        avoid-set moves — the tick-time handshake that makes the per-pick
+        call carry request scalars only.  Version-less calls (role subsets,
+        ad-hoc pods lists) marshal the scratch handle every time.
+        """
+        if policy_mode:
+            policy, bad = self._policy_and_avoid()
+        else:
+            policy, bad = "log_only", frozenset()
+        if version is None:
+            self._marshal(self._scratch, pods, policy, bad)
+            self._scratch.key = None
+            return self._scratch
+        state = self._state
+        key = (version, len(pods), policy, self._cfg_gen)
+        # ``bad is None`` = an advisor with per-pod should_avoid only (no
+        # batch set to compare): no cheap change signal, so re-marshal.
+        if state.key != key or bad is None or state.avoid != bad:
+            self._marshal(state, pods, policy, bad)
+            state.key = key
+        return state
+
+    # -- candidate computation ---------------------------------------------
+    def candidates(self, req: LLMRequest, pods: list[PodMetrics],
+                   version: int | None = None) -> list[int]:
+        """Tree survivors WITHOUT policy filtering (legacy API — the parity
+        fuzz drives it; policy belongs to the pick seam)."""
+        if not pods:
+            # Parity: the Python tree's failure branches land in the drop
+            # filter on an empty pool, i.e. shed -> 429.
             raise SchedulingError(
-                "failed to apply filter, resulted 0 pods: dropping request due "
-                "to limited backend resources",
-                shed=True,
+                "failed to apply filter, resulted 0 pods: no pods", shed=True
             )
+        with self._call_lock:
+            state = self._ensure_state(None, pods, policy_mode=False)
+            count, _ = self._pick_candidates_locked(state, req)
+            return state.out[:count].tolist()
+
+    def _pick_candidates_locked(self, state: _NativeState,
+                                req: LLMRequest) -> tuple[int, int]:
+        """One FFI crossing: request scalars in, candidate count + flags
+        out (candidates land in ``state.out``)."""
+        adapter_id = state.intern.get(req.resolved_target_model, -1)
+        flags = ctypes.c_uint8(0)
+        count = self._lib.lig_pick(
+            self._void(state), adapter_id,
+            1 if req.critical else 0, req.prompt_tokens,
+            _ptr(state.out, ctypes.c_int32), ctypes.byref(flags))
+        if count == LIG_SHED:
+            raise SchedulingError(_SHED_MSG, shed=True)
+        if count == LIG_SHED_STRICT:
+            raise SchedulingError(_STRICT_MSG, shed=True)
         if count < 0:
             raise SchedulingError(f"native scheduler error {count}")
-        return out[:count].tolist()
+        return count, flags.value
 
     def update_config(self, cfg: SchedulerConfig) -> None:
-        """Swap thresholds at runtime — cfg fields cross the FFI per call."""
+        """Swap thresholds at runtime — re-marshalled on the next pick via
+        the config generation in the snapshot cache key."""
         self.cfg = cfg
+        self._cfg_gen += 1
         self._decode_tree = build_decode_tree(
             cfg, token_aware=self.token_aware)
 
@@ -267,54 +418,138 @@ class NativeScheduler:
             return snapshot()  # atomic (version, pods) pair
         return None, self._provider.all_pod_metrics()
 
-    def _pick(self, req: LLMRequest, pods: list[PodMetrics],
-              idxs: list[int]) -> Pod:
-        # Same policy seam as the Python Scheduler: the C++ candidate set
-        # narrows to non-avoided pods BEFORE the tie-break and the RNG
-        # draw; log_only returns the indices unchanged, keeping the
-        # fuzz-pinned candidate parity exact.
-        idxs = filter_by_policy(self.health_advisor, idxs,
-                                name_of=lambda i: pods[i].pod.name)
+    def _routable_pods(self):
+        """(pods, version) after the single-hop role policy, with the
+        O(pods) role partition cached per snapshot version — the per-pick
+        path must not re-walk 200 pods to rediscover an unchanged split."""
+        version, pods = self._snapshot_pods()
+        cache = self._role_cache
+        if version is not None and cache is not None and cache[0] == version:
+            return cache[1], cache[2]
+        collocated = [pm for pm in pods
+                      if pod_role(pm.pod) == ROLE_COLLOCATED]
+        if collocated and len(collocated) != len(pods):
+            use, use_version = collocated, None
+        else:
+            use, use_version = pods, version
+        if version is not None:
+            self._role_cache = (version, use, use_version)
+        return use, use_version
+
+    # -- pick ---------------------------------------------------------------
+    def _finish_pick(self, req: LLMRequest, pods: list[PodMetrics],
+                     cand: list[int], flags: int) -> Pod:
+        """Post-candidate seams, identical to Scheduler._pick ordering:
+        escape-hatch note, prefix tie-break, RNG draw, note_pick hooks.
+
+        Runs OUTSIDE ``_call_lock`` (``cand`` is the caller's copy of the
+        candidate indices): the lazy prefix-hash resolution and the
+        prefix-index bookkeeping here can cost more than the pick itself,
+        and serializing them would collapse the threaded gRPC transport to
+        single-thread hash speed — the Python Scheduler runs the same
+        seams unlocked."""
+        advisor = self.health_advisor
+        if flags & 1 and advisor is not None:
+            note = getattr(advisor, "note_escape_hatch", None)
+            if note is not None:
+                note()
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
-            held = self.prefix_index.prefer(req, [pods[i] for i in idxs])
+            held = self.prefix_index.prefer(req, [pods[i] for i in cand])
             if held is not None:
                 pick = held.pod
         if pick is None:
-            pick = pods[idxs[self._rng.randrange(len(idxs))]].pod
+            pick = pods[cand[self._rng.randrange(len(cand))]].pod
         if self.prefix_index is not None and req.prefix_hashes:
             self.prefix_index.record(req.prefix_hashes, pick.name)
-        if self.health_advisor is not None:
-            self.health_advisor.note_pick(pick.name)
+        if advisor is not None:
+            advisor.note_pick(pick.name)
         if self.usage_advisor is not None:
             self.usage_advisor.note_pick(pick.name, req.model)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
-        version, pods = self._snapshot_pods()
         # Same role policy as the Python Scheduler: single-hop traffic
         # prefers collocated replicas; a role-filtered SUBSET bypasses the
-        # snapshot-version array cache (it keys on (version, n) and a
+        # snapshot-version resident state (it keys on (version, n) and a
         # subset would poison it).
-        collocated = [pm for pm in pods
-                      if pod_role(pm.pod) == ROLE_COLLOCATED]
-        if collocated and len(collocated) != len(pods):
-            pods, version = collocated, None
-        idxs = self.candidates(req, pods, version)
-        return self._pick(req, pods, idxs)
+        pods, version = self._routable_pods()
+        if not pods:
+            raise SchedulingError(
+                "failed to apply filter, resulted 0 pods: no pods", shed=True)
+        with self._call_lock:
+            state = self._ensure_state(version, pods)
+            count, flags = self._pick_candidates_locked(state, req)
+            cand = state.out[:count].tolist()
+        return self._finish_pick(req, pods, cand, flags)
+
+    def pick_many(self, reqs: list[LLMRequest]) -> list[Pod]:
+        """Batched scheduling: ONE FFI crossing for the whole batch (the
+        bench/load-rig amortization entry).  Semantics are pick-for-pick
+        identical to calling ``schedule`` in a loop — same candidate sets,
+        same RNG consumption, same advisor seams — including raising the
+        shed ``SchedulingError`` at the first request that sheds."""
+        if not reqs:
+            return []
+        pods, version = self._routable_pods()
+        if not pods:
+            raise SchedulingError(
+                "failed to apply filter, resulted 0 pods: no pods", shed=True)
+        n, n_reqs = len(pods), len(reqs)
+        with self._call_lock:
+            state = self._ensure_state(version, pods)
+            intern = state.intern
+            adapter_ids = np.fromiter(
+                (intern.get(r.resolved_target_model, -1) for r in reqs),
+                np.int32, n_reqs)
+            criticals = np.fromiter(
+                (1 if r.critical else 0 for r in reqs), np.uint8, n_reqs)
+            prompt_tokens = np.fromiter(
+                (r.prompt_tokens for r in reqs), np.int64, n_reqs)
+            counts = np.empty(n_reqs, np.int32)
+            cands = np.empty(n_reqs * n, np.int32)
+            flags = np.empty(n_reqs, np.uint8)
+            rc = self._lib.lig_pick_many(
+                self._void(state), n_reqs,
+                _ptr(adapter_ids, ctypes.c_int32),
+                _ptr(criticals, ctypes.c_uint8),
+                _ptr(prompt_tokens, ctypes.c_int64),
+                _ptr(counts, ctypes.c_int32), _ptr(cands, ctypes.c_int32),
+                _ptr(flags, ctypes.c_uint8))
+            if rc != 0:
+                raise SchedulingError(f"native pick_many failed ({rc})")
+        # counts/cands/flags are call-local: the finish seams (prefix
+        # hashing, RNG, advisors) run unlocked, same as schedule().
+        picks: list[Pod] = []
+        for r_idx in range(n_reqs):
+            count = int(counts[r_idx])
+            if count == LIG_SHED:
+                raise SchedulingError(_SHED_MSG, shed=True)
+            if count == LIG_SHED_STRICT:
+                raise SchedulingError(_STRICT_MSG, shed=True)
+            if count < 0:
+                raise SchedulingError(f"native scheduler error {count}")
+            cand = cands[r_idx * n:r_idx * n + count].tolist()
+            picks.append(self._finish_pick(
+                reqs[r_idx], pods, cand, int(flags[r_idx])))
+        return picks
 
     def schedule_disaggregated(
         self, req: LLMRequest
     ) -> tuple[Pod, Pod | None]:
         """Two-stage routing (see ``Scheduler.schedule_disaggregated``):
-        C++ candidates over the prefill-role subset, then the decode tree
+        native candidates over the prefill-role subset (scratch state —
+        subsets have no snapshot version), then the Python decode tree
         over the decode-role subset."""
         version, pods = self._snapshot_pods()
         prefills, decodes = split_pool_roles(pods)
         if not prefills or not decodes:
             return self.schedule(req), None
-        idxs = self.candidates(req, prefills, None)  # subset: no cache
-        prefill_pod = self._pick(req, prefills, idxs)
+        with self._call_lock:
+            state = self._ensure_state(None, prefills)
+            count, flags = self._pick_candidates_locked(state, req)
+            cand = state.out[:count].tolist()
+        prefill_pod = self._finish_pick(req, prefills, cand, flags)
         try:
             decode_survivors = self._decode_tree.filter(req, decodes)
         except FilterError as e:

@@ -9,6 +9,60 @@ requests must land on replicas with enough KV-token headroom, SURVEY.md §5
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+
+class LazyPrefixHashes(Sequence):
+    """Sequence facade that defers the prefix-hash chain until a consumer
+    actually touches it.
+
+    The chain (up to 32 chained blake2b digests over 8 KB of prompt,
+    prefix_affinity.py) used to run on EVERY request body in the ext-proc
+    hot path; threading this thunk instead means the digests only compute
+    when a prefix-aware scheduler evaluates ``req.prefix_hashes`` — for a
+    prefix-unaware build (or a custom drop-in that never reads the field)
+    the cost is one object allocation.  Computes once, then serves the
+    cached tuple; truthiness, iteration, indexing, and equality all match
+    the eager tuple the field used to hold.
+    """
+
+    __slots__ = ("_fn", "_value")
+
+    def __init__(self, fn: Callable[[], tuple]):
+        self._fn = fn
+        self._value: tuple | None = None
+
+    def _resolve(self) -> tuple:
+        if self._value is None:
+            self._value = tuple(self._fn())
+            self._fn = None  # drop the closure (it pins the prompt text)
+        return self._value
+
+    def __bool__(self) -> bool:
+        return bool(self._resolve())
+
+    def __len__(self) -> int:
+        return len(self._resolve())
+
+    def __iter__(self):
+        return iter(self._resolve())
+
+    def __getitem__(self, i):
+        return self._resolve()[i]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, LazyPrefixHashes):
+            other = other._resolve()
+        return self._resolve() == tuple(other) if isinstance(
+            other, (tuple, list)) else self._resolve() == other
+
+    def __hash__(self):
+        return hash(self._resolve())
+
+    def __repr__(self) -> str:
+        if self._value is None:
+            return "LazyPrefixHashes(<unevaluated>)"
+        return f"LazyPrefixHashes({self._value!r})"
 
 
 @dataclass
@@ -28,7 +82,9 @@ class LLMRequest:
     # TPU addition: chained block hashes of the prompt's leading text
     # (scheduling/prefix_affinity.py) — lets the scheduler prefer the
     # replica already holding this prefix's KV blocks.  Empty = no hint.
-    prefix_hashes: tuple = ()
+    # May hold a ``LazyPrefixHashes`` (the request handler threads one so
+    # the digest chain never runs unless a scheduler consumes it).
+    prefix_hashes: "tuple | LazyPrefixHashes" = ()
     # Tracing attribution (filled by the scheduling layer, read by the
     # request handler): how long this request waited in the admission
     # queue before a pod admitted it, and the (prefill_hop, decode_hop)

@@ -71,6 +71,11 @@ class GatewayMetrics:
         self.retries_total: dict[str, int] = {}
         self.hedges_total: dict[str, int] = {}
         self.client_disconnects_total: dict[str | None, int] = {}
+        # Upstream keepalive pool (proxy TraceConfig hooks): connections
+        # by (pod, created|reused).  The reuse ratio — reused / total — is
+        # the pooled-relay health signal: near 0 means every request pays
+        # a TCP handshake, near 1 means the pool is doing its job.
+        self.upstream_connections_total: dict[tuple[str, str], int] = {}
         # Optional pool-signal source (set by the proxy): a callable
         # returning the provider's PodMetrics snapshot, re-exported at
         # render time so operators see per-replica prefix-cache hit volume
@@ -120,6 +125,25 @@ class GatewayMetrics:
         with self._lock:
             self.client_disconnects_total[model] = (
                 self.client_disconnects_total.get(model, 0) + 1)
+
+    def record_upstream_conn(self, pod: str, reused: bool) -> None:
+        key = (pod, "reused" if reused else "created")
+        with self._lock:
+            self.upstream_connections_total[key] = (
+                self.upstream_connections_total.get(key, 0) + 1)
+
+    def connection_reuse_ratio(self) -> float:
+        """reused / (created + reused) across the pool; 0.0 before any
+        upstream connection exists."""
+        with self._lock:
+            created = reused = 0
+            for (pod, state), n in self.upstream_connections_total.items():
+                if state == "reused":
+                    reused += n
+                else:
+                    created += n
+        total = created + reused
+        return reused / total if total else 0.0
 
     def record_usage(self, model: str, prompt: int, completion: int) -> None:
         with self._lock:
@@ -205,6 +229,28 @@ class GatewayMetrics:
             lines += self._counter_lines(
                 "gateway_client_disconnects_total",
                 self.client_disconnects_total, "model")
+            # Upstream keepalive-pool stats (two-label family, so not
+            # through render_counter): per-pod created/reused counters and
+            # the pool-wide reuse ratio gauge.
+            lines.append("# TYPE gateway_upstream_connections_total counter")
+            if not self.upstream_connections_total:
+                lines.append("gateway_upstream_connections_total 0")
+            created = reused = 0
+            for (pod, state) in sorted(self.upstream_connections_total):
+                n = self.upstream_connections_total[(pod, state)]
+                if state == "reused":
+                    reused += n
+                else:
+                    created += n
+                lines.append(
+                    "gateway_upstream_connections_total"
+                    f'{{pod="{escape_label(pod)}",state="{state}"}} {n}')
+            total_conns = created + reused
+            lines += [
+                "# TYPE gateway_upstream_connection_reuse_ratio gauge",
+                "gateway_upstream_connection_reuse_ratio "
+                f"{(reused / total_conns) if total_conns else 0.0:.4f}",
+            ]
             lines += render_histogram(
                 "gateway_pick_latency_seconds", self.pick_latency)
             for fam, table in (

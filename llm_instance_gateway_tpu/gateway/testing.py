@@ -72,20 +72,17 @@ def make_model(
     )
 
 
-def start_ext_proc(
+def build_handler_server(
     pod_metrics: dict[Pod, Metrics],
     models: list[InferenceModel],
-    port: int = 9002,
     scheduler_factory=None,
     **scheduler_kwargs,
 ):
-    """StartExtProc (test/utils.go:21-51): real gRPC server, fake metrics.
-
-    ``scheduler_factory(provider)`` overrides the default Python
-    ``Scheduler`` (e.g. ``scheduling.native.make_scheduler`` for the C++
-    hot path — the loadgen's A/B axis).
-    Returns the started grpc server; caller must ``server.stop(None)``.
-    """
+    """The rig's in-process core: real handler ``Server`` + real scheduler
+    over a refreshed ``Provider`` (so the native snapshot cache has a
+    version to key on) and an in-memory datastore.  Returns the handler
+    server; the loadgen's fast path drives ``server.process`` on it
+    directly — no gRPC, no proto marshalling."""
     datastore = Datastore(pods=list(pod_metrics))
     datastore.set_pool(
         InferencePool(name="test-pool", spec=InferencePoolSpec(selector={"app": "t"}))
@@ -104,8 +101,28 @@ def start_ext_proc(
             f"(kwargs {sorted(scheduler_kwargs)} would be silently dropped)")
     scheduler = (scheduler_factory(provider) if scheduler_factory is not None
                  else Scheduler(provider, **scheduler_kwargs))
-    handler_server = Server(scheduler, datastore)
-    grpc_server = build_grpc_server(handler_server, datastore, port=port)
+    return Server(scheduler, datastore)
+
+
+def start_ext_proc(
+    pod_metrics: dict[Pod, Metrics],
+    models: list[InferenceModel],
+    port: int = 9002,
+    scheduler_factory=None,
+    **scheduler_kwargs,
+):
+    """StartExtProc (test/utils.go:21-51): real gRPC server, fake metrics.
+
+    ``scheduler_factory(provider)`` overrides the default Python
+    ``Scheduler`` (e.g. ``scheduling.native.make_scheduler`` for the C++
+    hot path — the loadgen's A/B axis).
+    Returns the started grpc server; caller must ``server.stop(None)``.
+    """
+    handler_server = build_handler_server(
+        pod_metrics, models, scheduler_factory=scheduler_factory,
+        **scheduler_kwargs)
+    grpc_server = build_grpc_server(
+        handler_server, handler_server.datastore, port=port)
     grpc_server.start()
     return grpc_server
 

@@ -59,6 +59,13 @@ GATEWAY_FAMILIES = (
            "Client-side disconnects of live SSE relays; the partial "
            "request is still observed into the e2e histograms.",
            GATEWAY_SURFACE),
+    Family("gateway_upstream_connections_total", "counter", ("pod", "state"),
+           "Upstream keepalive-pool connections by pod and state "
+           "(created = fresh TCP handshake, reused = served off a pooled "
+           "connection).", GATEWAY_SURFACE),
+    Family("gateway_upstream_connection_reuse_ratio", "gauge", (),
+           "Pool-wide connection reuse: reused / (created + reused); near "
+           "0 means every request pays a handshake.", GATEWAY_SURFACE),
     Family("gateway_pick_latency_seconds", "histogram", (),
            "Scheduler pick latency.", GATEWAY_SURFACE),
     Family("gateway_prompt_tokens_total", "counter", ("model",),

@@ -6,28 +6,56 @@
 // and this C++ mirror exists for large pools where the Python loop's
 // per-pod overhead dominates pick latency (200+ pods at tens of kHz).
 //
-// Contract: lig_schedule_candidates() fills `out` with the indices of the
-// surviving candidate set (the final random pick stays in Python so RNG
-// behavior is unchanged) and returns the count; returns LIG_SHED (-1) for
-// the load-shedding drop and LIG_ERROR (-2) on invalid input.  Semantics
-// mirror gateway/scheduling/{filter,scheduler}.py exactly; the parity test
-// (tests/test_native_scheduler.py) fuzzes both against each other.
+// Two entry families:
+//
+// 1. Stateless (legacy): lig_schedule_candidates() takes every pod array per
+//    call.  Kept as the reference entry — zero hidden state, and the
+//    cross-checking fuzz can drive it directly.
+//
+// 2. Snapshot-resident (the data-plane fast path): the caller allocates a
+//    State handle (lig_state_new), pushes the pod arrays + policy avoid
+//    marks + adapter-residency table + config into it ONCE per
+//    observability/scrape tick (lig_state_update), and the per-pick call
+//    (lig_pick / batched lig_pick_many) crosses the FFI with only request
+//    scalars: (adapter_id, critical, prompt_tokens).  The candidate set is
+//    written into a caller-owned buffer; the final random draw stays in
+//    Python so RNG behavior is byte-identical to the Python Scheduler
+//    (the parity oracle — tests/test_native_scheduler.py fuzzes both).
+//
+//    Policy semantics mirror scheduler.py filter_by_policy(): with
+//    policy_mode=1 (avoid) the candidate set narrows to non-avoided pods,
+//    falling back to the full set (escape hatch, flag bit 0) when every
+//    candidate is avoidable; policy_mode=2 (strict) sheds instead
+//    (LIG_SHED_STRICT).  policy_mode=0 (log_only) never filters.
+//
+//    Usage-deprioritization marks (gateway/usage.py noisy set) ride the
+//    snapshot as per-adapter bits; a pick whose adapter is marked returns
+//    flag bit 1 — the log-only observable stays in Python, the mark is
+//    resident here so a future enforcing fairness policy has it without a
+//    second marshalling seam.
+//
+// Contract: candidate-filling calls return the survivor count, LIG_SHED
+// (-1) for the load-shedding drop, LIG_ERROR (-2) on invalid input, and
+// LIG_SHED_STRICT (-3) for the strict-policy shed.  Semantics mirror
+// gateway/scheduling/{filter,scheduler}.py exactly.
 //
 // Build: make -C llm_instance_gateway_tpu/native  (emits libligsched.so)
 
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
+#include <new>
 #include <vector>
 
 namespace {
 
-struct Pods {
-  int n;
+struct PodArrays {
+  int32_t n;
   const int32_t* waiting;        // total queue depth
   const int32_t* prefill;        // prefill queue depth
   const double* kv_usage;        // 0..1
   const int64_t* kv_free;        // free KV tokens
-  const uint8_t* has_affinity;   // request's adapter resident on pod?
+  const int64_t* kv_capacity;    // KV token capacity (<=0: not exported)
   const int32_t* n_active;       // resident adapter count
   const int32_t* max_active;     // adapter slot count
 };
@@ -44,10 +72,14 @@ struct Config {
 
 using Set = std::vector<int32_t>;
 
+inline bool has_aff(const uint8_t* aff, int32_t i) {
+  return aff != nullptr && aff[i] != 0;
+}
+
 // Bucketing filters: keep pods in [min, min + (max-min)/len(set)]
 // (integer division for queues, float for kv — filter.go:117/:149 parity).
 
-Set least_queuing(const Pods& p, const Set& in) {
+Set least_queuing(const PodArrays& p, const Set& in) {
   int32_t lo = INT32_MAX, hi = 0;
   for (int32_t i : in) {
     lo = p.waiting[i] < lo ? p.waiting[i] : lo;
@@ -60,7 +92,7 @@ Set least_queuing(const Pods& p, const Set& in) {
   return out;
 }
 
-Set least_prefill(const Pods& p, const Set& in) {
+Set least_prefill(const PodArrays& p, const Set& in) {
   int32_t lo = INT32_MAX, hi = 0;
   for (int32_t i : in) {
     lo = p.prefill[i] < lo ? p.prefill[i] : lo;
@@ -73,7 +105,7 @@ Set least_prefill(const Pods& p, const Set& in) {
   return out;
 }
 
-Set least_kv(const Pods& p, const Set& in) {
+Set least_kv(const PodArrays& p, const Set& in) {
   double lo = 1e300, hi = 0.0;
   for (int32_t i : in) {
     lo = p.kv_usage[i] < lo ? p.kv_usage[i] : lo;
@@ -88,33 +120,161 @@ Set least_kv(const Pods& p, const Set& in) {
 
 // Queue stage: optional prefill bucketing, then total-queue bucketing
 // (scheduler.py queue_filter()).
-Set queue_stage(const Pods& p, const Config& c, const Set& in) {
+Set queue_stage(const PodArrays& p, const Config& c, const Set& in) {
   Set s = in;
   if (c.prefill_aware) s = least_prefill(p, s);
   return least_queuing(p, s);
 }
 
 // queueAndKVCacheFilter (scheduler.go:49-56).
-Set queue_kv(const Pods& p, const Config& c, const Set& in) {
+Set queue_kv(const PodArrays& p, const Config& c, const Set& in) {
   return least_kv(p, queue_stage(p, c, in));
 }
 
 // queueLoRAAndKVCacheFilter (scheduler.go:35-46): queue -> low-cost-LoRA
 // predicate (failure passes the queue-stage output through) -> least-KV.
-Set queue_lora_kv(const Pods& p, const Config& c, const Set& in) {
+Set queue_lora_kv(const PodArrays& p, const Config& c, const uint8_t* aff,
+                  const Set& in) {
   Set q = queue_stage(p, c, in);
   Set lora;
   for (int32_t i : q)
-    if (p.has_affinity[i] || p.n_active[i] < p.max_active[i]) lora.push_back(i);
+    if (has_aff(aff, i) || p.n_active[i] < p.max_active[i]) lora.push_back(i);
   return least_kv(p, lora.empty() ? q : lora);
+}
+
+constexpr int32_t kShed = -1;
+constexpr int32_t kError = -2;
+constexpr int32_t kShedStrict = -3;
+
+// The full default tree (scheduler.go:26-91) over ``aff`` as the request's
+// per-pod adapter-affinity view (nullptr = no pod holds the adapter).
+// Fills ``result`` with surviving indices; returns count or kShed.
+int32_t run_tree(const PodArrays& p, const Config& c, const uint8_t* aff,
+                 uint8_t critical, int64_t prompt_tokens, Set* result) {
+  Set all(p.n);
+  for (int32_t i = 0; i < p.n; ++i) all[i] = i;
+
+  // Token-headroom gate (advisory: falls back to the full set).  Pods that
+  // don't export KV-token metrics (capacity <= 0) pass trivially — filter.py
+  // token_headroom parity.
+  Set pool = all;
+  if (c.token_aware && prompt_tokens > 0) {
+    const int64_t need =
+        static_cast<int64_t>(prompt_tokens * c.token_headroom_factor);
+    Set fit;
+    for (int32_t i : all)
+      if (p.kv_capacity[i] <= 0 || p.kv_free[i] >= need) fit.push_back(i);
+    if (!fit.empty()) pool = fit;
+  }
+
+  if (critical) {
+    // lowLatencyFilter (scheduler.go:58-72).
+    Set lowq;
+    for (int32_t i : pool)
+      if (p.waiting[i] < c.queueing_threshold_lora) lowq.push_back(i);
+    if (!lowq.empty()) {
+      Set a;
+      for (int32_t i : lowq)
+        if (has_aff(aff, i)) a.push_back(i);
+      if (!a.empty()) {
+        *result = queue_kv(p, c, a);
+      } else {
+        Set room;
+        for (int32_t i : lowq)
+          if (p.n_active[i] < p.max_active[i]) room.push_back(i);
+        *result = queue_kv(p, c, room.empty() ? lowq : room);
+      }
+    } else {
+      *result = queue_lora_kv(p, c, aff, pool);
+    }
+  } else {
+    // sheddableRequestFilter (scheduler.go:74-90).
+    Set ok;
+    for (int32_t i : pool)
+      if (p.waiting[i] <= c.queue_threshold_critical &&
+          p.kv_usage[i] <= c.kv_cache_threshold)
+        ok.push_back(i);
+    if (ok.empty()) return kShed;
+    *result = queue_lora_kv(p, c, aff, ok);
+  }
+
+  if (result->empty()) return kShed;  // tree exhausted: drop (parity)
+  return static_cast<int32_t>(result->size());
+}
+
+// Snapshot-resident pod state: everything the tick-time update marshals so
+// the per-pick crossing carries request scalars only.
+struct State {
+  int32_t n = 0;
+  std::vector<int32_t> waiting, prefill_q, n_active, max_active;
+  std::vector<double> kv_usage;
+  std::vector<int64_t> kv_free, kv_capacity;
+  std::vector<uint8_t> avoid;    // health/circuit avoid marks, per pod
+  int32_t n_adapters = 0;
+  std::vector<uint8_t> resident;  // n_adapters x n bitmap (row = adapter)
+  std::vector<uint8_t> noisy;     // per-adapter usage-deprioritize marks
+  Config cfg{};
+  uint8_t policy_mode = 0;        // 0 log_only, 1 avoid, 2 strict
+  bool ready = false;
+
+  PodArrays view() const {
+    return PodArrays{n, waiting.data(), prefill_q.data(), kv_usage.data(),
+                     kv_free.data(), kv_capacity.data(), n_active.data(),
+                     max_active.data()};
+  }
+};
+
+int32_t pick_into(State* st, int32_t adapter_id, uint8_t critical,
+                  int64_t prompt_tokens, int32_t* out, uint8_t* flags) {
+  uint8_t f = 0;
+  const uint8_t* aff = nullptr;
+  if (adapter_id >= 0 && adapter_id < st->n_adapters) {
+    aff = st->resident.data() + static_cast<size_t>(adapter_id) * st->n;
+    if (st->noisy[adapter_id]) f |= 2;  // usage-deprioritization mark
+  }
+  Set result;
+  const PodArrays p = st->view();
+  const int32_t rc = run_tree(p, st->cfg, aff, critical, prompt_tokens,
+                              &result);
+  if (rc < 0) {
+    if (flags) *flags = f;
+    return rc;
+  }
+  if (st->policy_mode != 0) {
+    // filter_by_policy parity: narrow to non-avoided candidates BEFORE the
+    // RNG draw; an all-avoidable set escapes (avoid) or sheds (strict).
+    Set preferred;
+    for (int32_t i : result)
+      if (!st->avoid[i]) preferred.push_back(i);
+    if (!preferred.empty()) {
+      result.swap(preferred);
+    } else {
+      bool any_marks = false;
+      for (int32_t i : result)
+        if (st->avoid[i]) { any_marks = true; break; }
+      if (any_marks) {
+        if (st->policy_mode == 2) {
+          if (flags) *flags = f;
+          return kShedStrict;
+        }
+        f |= 1;  // escape hatch: full set serves, Python counts it
+      }
+    }
+  }
+  for (std::size_t k = 0; k < result.size(); ++k) out[k] = result[k];
+  if (flags) *flags = f;
+  return static_cast<int32_t>(result.size());
 }
 
 }  // namespace
 
 extern "C" {
 
-constexpr int32_t LIG_SHED = -1;
-constexpr int32_t LIG_ERROR = -2;
+constexpr int32_t LIG_SHED = kShed;
+constexpr int32_t LIG_ERROR = kError;
+constexpr int32_t LIG_SHED_STRICT = kShedStrict;
+
+// ---- stateless reference entry (legacy ABI, unchanged semantics) ---------
 
 int32_t lig_schedule_candidates(
     int32_t n_pods, const int32_t* waiting, const int32_t* prefill,
@@ -133,65 +293,110 @@ int32_t lig_schedule_candidates(
   if (n_pods <= 0 || !waiting || !prefill || !kv_usage || !kv_free ||
       !kv_capacity || !has_affinity || !n_active || !max_active || !out)
     return LIG_ERROR;
-
-  const Pods p{n_pods, waiting, prefill, kv_usage, kv_free,
-               has_affinity, n_active, max_active};
+  const PodArrays p{n_pods, waiting, prefill, kv_usage, kv_free,
+                    kv_capacity, n_active, max_active};
   const Config c{kv_cache_threshold, queue_threshold_critical,
                  queueing_threshold_lora, token_headroom_factor,
                  prefill_queue_threshold, token_aware != 0,
                  prefill_aware != 0};
-
-  Set all(n_pods);
-  for (int32_t i = 0; i < n_pods; ++i) all[i] = i;
-
-  // Token-headroom gate (advisory: falls back to the full set).  Pods that
-  // don't export KV-token metrics (capacity <= 0) pass trivially — filter.py
-  // token_headroom parity.
-  Set pool = all;
-  if (c.token_aware && prompt_tokens > 0) {
-    const int64_t need =
-        static_cast<int64_t>(prompt_tokens * c.token_headroom_factor);
-    Set fit;
-    for (int32_t i : all)
-      if (kv_capacity[i] <= 0 || kv_free[i] >= need) fit.push_back(i);
-    if (!fit.empty()) pool = fit;
-  }
-
   Set result;
-  if (critical) {
-    // lowLatencyFilter (scheduler.go:58-72).
-    Set lowq;
-    for (int32_t i : pool)
-      if (p.waiting[i] < c.queueing_threshold_lora) lowq.push_back(i);
-    if (!lowq.empty()) {
-      Set aff;
-      for (int32_t i : lowq)
-        if (p.has_affinity[i]) aff.push_back(i);
-      if (!aff.empty()) {
-        result = queue_kv(p, c, aff);
-      } else {
-        Set room;
-        for (int32_t i : lowq)
-          if (p.n_active[i] < p.max_active[i]) room.push_back(i);
-        result = queue_kv(p, c, room.empty() ? lowq : room);
-      }
-    } else {
-      result = queue_lora_kv(p, c, pool);
-    }
-  } else {
-    // sheddableRequestFilter (scheduler.go:74-90).
-    Set ok;
-    for (int32_t i : pool)
-      if (p.waiting[i] <= c.queue_threshold_critical &&
-          p.kv_usage[i] <= c.kv_cache_threshold)
-        ok.push_back(i);
-    if (ok.empty()) return LIG_SHED;
-    result = queue_lora_kv(p, c, ok);
-  }
-
-  if (result.empty()) return LIG_SHED;  // tree exhausted: drop (parity)
+  const int32_t rc = run_tree(p, c, has_affinity, critical, prompt_tokens,
+                              &result);
+  if (rc < 0) return rc;
   for (std::size_t k = 0; k < result.size(); ++k) out[k] = result[k];
-  return static_cast<int32_t>(result.size());
+  return rc;
+}
+
+// ---- snapshot-resident fast path -----------------------------------------
+
+void* lig_state_new(void) { return new (std::nothrow) State(); }
+
+void lig_state_free(void* h) { delete static_cast<State*>(h); }
+
+// Marshal the whole routable world once per tick.  ``resident`` arrives as
+// CSR (res_offsets[n_pods+1] into res_ids) and is exploded into an
+// adapter-major bitmap here so the per-pick affinity view is one row
+// pointer.  Returns 0 on success.
+int32_t lig_state_update(
+    void* h, int32_t n_pods,
+    const int32_t* waiting, const int32_t* prefill, const double* kv_usage,
+    const int64_t* kv_free, const int64_t* kv_capacity,
+    const int32_t* n_active, const int32_t* max_active,
+    const uint8_t* avoid,
+    int32_t n_adapters, const int32_t* res_offsets, const int32_t* res_ids,
+    const uint8_t* adapter_noisy,
+    double kv_cache_threshold, int32_t queue_threshold_critical,
+    int32_t queueing_threshold_lora, double token_headroom_factor,
+    int32_t prefill_queue_threshold, uint8_t token_aware,
+    uint8_t prefill_aware, uint8_t policy_mode) {
+  State* st = static_cast<State*>(h);
+  if (!st || n_pods <= 0 || n_adapters < 0 || !waiting || !prefill ||
+      !kv_usage || !kv_free || !kv_capacity || !n_active || !max_active ||
+      !avoid || (n_adapters > 0 && (!res_offsets || !adapter_noisy)))
+    return LIG_ERROR;
+  st->ready = false;
+  st->n = n_pods;
+  st->waiting.assign(waiting, waiting + n_pods);
+  st->prefill_q.assign(prefill, prefill + n_pods);
+  st->kv_usage.assign(kv_usage, kv_usage + n_pods);
+  st->kv_free.assign(kv_free, kv_free + n_pods);
+  st->kv_capacity.assign(kv_capacity, kv_capacity + n_pods);
+  st->n_active.assign(n_active, n_active + n_pods);
+  st->max_active.assign(max_active, max_active + n_pods);
+  st->avoid.assign(avoid, avoid + n_pods);
+  st->n_adapters = n_adapters;
+  st->resident.assign(
+      static_cast<size_t>(n_adapters) * static_cast<size_t>(n_pods), 0);
+  if (n_adapters > 0) {
+    for (int32_t pod = 0; pod < n_pods; ++pod) {
+      for (int32_t k = res_offsets[pod]; k < res_offsets[pod + 1]; ++k) {
+        const int32_t a = res_ids[k];
+        if (a < 0 || a >= n_adapters) return LIG_ERROR;
+        st->resident[static_cast<size_t>(a) * n_pods + pod] = 1;
+      }
+    }
+    st->noisy.assign(adapter_noisy, adapter_noisy + n_adapters);
+  } else {
+    st->noisy.clear();
+  }
+  st->cfg = Config{kv_cache_threshold, queue_threshold_critical,
+                   queueing_threshold_lora, token_headroom_factor,
+                   prefill_queue_threshold, token_aware != 0,
+                   prefill_aware != 0};
+  st->policy_mode = policy_mode;
+  st->ready = true;
+  return 0;
+}
+
+// One pick: request scalars in, candidate set out (caller buffer of n_pods
+// ints).  Returns the count, LIG_SHED/LIG_SHED_STRICT, or LIG_ERROR.
+// ``flags``: bit 0 = policy escape hatch used; bit 1 = adapter carries a
+// usage-deprioritization mark.
+int32_t lig_pick(void* h, int32_t adapter_id, uint8_t critical,
+                 int64_t prompt_tokens, int32_t* out, uint8_t* flags) {
+  State* st = static_cast<State*>(h);
+  if (!st || !st->ready || !out) return LIG_ERROR;
+  return pick_into(st, adapter_id, critical, prompt_tokens, out, flags);
+}
+
+// Batched picks: one FFI crossing for n_reqs requests.  out_counts[i] gets
+// the per-request count/shed code; out_cands is an (n_reqs x n_pods)
+// row-major buffer; out_flags one byte per request.  Returns 0, or
+// LIG_ERROR on invalid input.
+int32_t lig_pick_many(void* h, int32_t n_reqs, const int32_t* adapter_ids,
+                      const uint8_t* criticals, const int64_t* prompt_tokens,
+                      int32_t* out_counts, int32_t* out_cands,
+                      uint8_t* out_flags) {
+  State* st = static_cast<State*>(h);
+  if (!st || !st->ready || n_reqs <= 0 || !adapter_ids || !criticals ||
+      !prompt_tokens || !out_counts || !out_cands || !out_flags)
+    return LIG_ERROR;
+  for (int32_t r = 0; r < n_reqs; ++r) {
+    out_counts[r] = pick_into(
+        st, adapter_ids[r], criticals[r], prompt_tokens[r],
+        out_cands + static_cast<size_t>(r) * st->n, out_flags + r);
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -95,6 +95,11 @@ def loaded_gateway_metrics() -> GatewayMetrics:
     gm.record_shed()            # pre-admission: unlabeled fallback
     gm.record_shed("sql-assist")
     gm.record_error(HOSTILE)
+    # Upstream keepalive pool (fast-relay PR): created + reused per pod,
+    # hostile pod name included.
+    gm.record_upstream_conn("pod-a", reused=False)
+    gm.record_upstream_conn("pod-a", reused=True)
+    gm.record_upstream_conn(HOSTILE, reused=True)
     return gm
 
 
@@ -164,6 +169,15 @@ def test_gateway_render_contract():
                 "gateway_e2e_seconds"):
         paths = {s.labels["path"] for s in families[fam + "_bucket"]}
         assert paths == {"collocated", "disaggregated"}
+    # Upstream keepalive pool (fast-relay PR): two-label counter with a
+    # hostile pod name round-tripping, plus the pool-wide reuse gauge.
+    conns = {(s.labels["pod"], s.labels["state"]): s.value
+             for s in families["gateway_upstream_connections_total"]}
+    assert conns[("pod-a", "created")] == 1
+    assert conns[("pod-a", "reused")] == 1
+    assert conns[(HOSTILE, "reused")] == 1
+    ratio = families["gateway_upstream_connection_reuse_ratio"][0].value
+    assert abs(ratio - 2 / 3) < 1e-3
 
 
 def test_server_render_contract():

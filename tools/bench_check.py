@@ -1,0 +1,261 @@
+"""CPU-deterministic microbench regression gate (``make bench-check``).
+
+ROADMAP item 5, first slice: the bench.py microbench suite — pick latency
+(Python, native snapshot-resident, batched pick_many), handoff blocks/s,
+the tracing/policy overhead ratios, and the zero-copy relay A/B — gets a
+COMMITTED baseline (``BASELINE_BENCH.json``) and a gate that fails on >20%
+regression against it, plus the absolute ratio bounds the PRs' acceptance
+bars pinned (``pick_traced_ratio``/``pick_policy_ratio`` < 1.05).
+
+Run:    make bench-check            # compare against BASELINE_BENCH.json
+        python tools/bench_check.py --update   # re-baseline (new rig)
+        python tools/bench_check.py --skip-handoff   # quick gate
+
+Every measurement uses the MIN-over-interleaved-runs convention from
+bench.py, so single-run container noise mostly cancels; the 20% tolerance
+absorbs what remains.  Baselines are rig-specific: re-run ``--update``
+when the hardware changes, never to paper over a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+BASELINE_PATH = os.path.join(_REPO, "BASELINE_BENCH.json")
+
+# metric -> ("higher"|"lower", relative tolerance).  "lower" = smaller is
+# better (latency); "higher" = bigger is better (throughput).
+GATED = {
+    "pick_us": ("lower", 0.20),
+    "pick_native_us": ("lower", 0.20),
+    "pick_many_us": ("lower", 0.20),
+    "handoff_blocks_per_s": ("higher", 0.20),
+    "relay_fast_chunks_per_s": ("higher", 0.20),
+}
+# Absolute bounds that hold regardless of the baseline (the PR acceptance
+# bars: tracing/policy enforcement each cost < 5% of a pick).
+ABSOLUTE_MAX = {
+    "pick_traced_ratio": 1.05,
+    "pick_policy_ratio": 1.05,
+}
+# Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
+# 1.0 on a socket-bound rig, so a baseline-relative gate would only measure
+# noise; the invariant worth pinning is that the zero-copy path never gets
+# MEANINGFULLY slower than the line-scanning oracle.
+ABSOLUTE_MIN = {
+    "relay_fast_ratio": 0.80,
+}
+
+
+# ratio-bound metric -> the bench family that produces it, for the
+# retry-on-over-bound pass in collect_families().
+_RATIO_SOURCES = {
+    "pick_traced_ratio": "pick",
+    "pick_policy_ratio": "policy",
+}
+
+# family -> (primary metric, direction) used to choose the conservative
+# run in the --update --runs merge.  Whole families come from ONE run so
+# sibling metrics (e.g. relay_fast/relay_slow chunks/s and their ratio)
+# stay internally consistent in the committed baseline.
+_FAMILY_PRIMARY = {
+    "pick": ("pick_us", "lower"),
+    "policy": ("pick_policy_ratio", "lower"),
+    "native": ("pick_native_us", "lower"),
+    "relay": ("relay_fast_chunks_per_s", "higher"),
+    "handoff": ("handoff_blocks_per_s", "higher"),
+}
+
+
+def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
+    """Run the CPU-deterministic suite in-process; returns metric dicts
+    keyed by microbench family (each family from one coherent run)."""
+    import bench
+
+    fams: dict[str, dict] = {
+        "pick": bench.run_pick_microbench(),
+        "policy": bench.run_policy_microbench(),
+        "native": bench.run_native_pick_microbench(),
+        "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
+    }
+    # The <5% overhead bounds are MIN-ratio estimates (12 interleaved
+    # rounds per side inside each microbench), but one collect() pass on a
+    # phase-shifting container can still catch the two A/B sides in
+    # different host phases and report a spuriously high ratio.  Retry
+    # just the offending microbench and keep the better attempt: a retry
+    # only tightens toward the true uncontended overhead — if the ratio
+    # is GENUINELY above the bound, every retry stays above it and the
+    # gate still fails.
+    _RATIO_FNS = {"pick": bench.run_pick_microbench,
+                  "policy": bench.run_policy_microbench}
+    for metric, fam in _RATIO_SOURCES.items():
+        for _ in range(2):
+            if fams[fam].get(metric, 0.0) <= ABSOLUTE_MAX[metric]:
+                break
+            redo = _RATIO_FNS[fam]()
+            if redo[metric] < fams[fam][metric]:
+                fams[fam] = redo  # whole family: keep the µs coherent
+    if not skip_handoff:
+        handoff = bench.run_handoff_microbench()
+        # Only the scalar plane metrics belong in the gate file.
+        fams["handoff"] = {
+            key: handoff[key]
+            for key in ("handoff_blocks_per_s", "handoff_wire_mb_s",
+                        "usage_attribution_ratio") if key in handoff
+        }
+    return fams
+
+
+def collect(skip_handoff: bool = False) -> dict:
+    """Flat metric dict the gate consumes."""
+    out: dict = {}
+    for fam in collect_families(skip_handoff).values():
+        out.update(fam)
+    return out
+
+
+def compare(baseline: dict, current: dict,
+            require_all: bool = True) -> list[str]:
+    """Gate ``current`` against ``baseline``; returns failure strings
+    (empty = green).  ``require_all=False`` restricts the check to the
+    metrics present in ``current`` (the --skip-handoff quick mode)."""
+    failures = []
+    for metric, (direction, tol) in GATED.items():
+        base = baseline.get(metric)
+        if base is None:
+            continue  # baseline predates the metric: nothing to gate yet
+        cur = current.get(metric)
+        if cur is None:
+            if require_all:
+                failures.append(f"{metric}: missing from current run "
+                                f"(baseline {base})")
+            continue
+        if direction == "lower":
+            limit = base * (1 + tol)
+            if cur > limit:
+                failures.append(
+                    f"{metric}: {cur} > {limit:.4g} "
+                    f"(baseline {base}, +{tol:.0%} tolerance)")
+        else:
+            limit = base * (1 - tol)
+            if cur < limit:
+                failures.append(
+                    f"{metric}: {cur} < {limit:.4g} "
+                    f"(baseline {base}, -{tol:.0%} tolerance)")
+    for metric, bound in ABSOLUTE_MAX.items():
+        cur = current.get(metric)
+        if cur is None:
+            if require_all and metric in baseline:
+                failures.append(f"{metric}: missing from current run")
+            continue
+        if cur > bound:
+            failures.append(f"{metric}: {cur} > absolute bound {bound}")
+    for metric, bound in ABSOLUTE_MIN.items():
+        cur = current.get(metric)
+        if cur is None:
+            if require_all and metric in baseline:
+                failures.append(f"{metric}: missing from current run")
+            continue
+        if cur < bound:
+            failures.append(f"{metric}: {cur} < absolute floor {bound}")
+    return failures
+
+
+def render_table(baseline: dict, current: dict) -> str:
+    rows = ["metric                        baseline      current"]
+    for metric in sorted(set(GATED) | set(ABSOLUTE_MAX) | set(ABSOLUTE_MIN)):
+        if metric in baseline or metric in current:
+            rows.append(f"{metric:<28}  {baseline.get(metric, '-')!s:>10}  "
+                        f"{current.get(metric, '-')!s:>10}")
+    return "\n".join(rows)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="microbench regression gate vs BASELINE_BENCH.json")
+    parser.add_argument("--update", action="store_true",
+                        help="re-baseline: write the collected metrics to "
+                             "BASELINE_BENCH.json instead of gating")
+    parser.add_argument("--skip-handoff", action="store_true",
+                        help="skip the engine handoff phase (~20s): gate "
+                             "only the scheduler/relay microbenches")
+    parser.add_argument("--runs", type=int, default=1,
+                        help="with --update: collect N times and keep the "
+                             "CONSERVATIVE edge per metric (max for "
+                             "latencies, min for throughputs) so the gate "
+                             "tolerance absorbs normal run-to-run noise")
+    args = parser.parse_args(argv)
+
+    if args.update and args.runs > 1:
+        # Conservative-edge merge at FAMILY granularity: per family, keep
+        # the run whose primary gated metric is worst (max latency / min
+        # throughput) so the gate tolerance absorbs run-to-run noise —
+        # but never mix metrics from different runs inside a family, or
+        # the committed siblings (e.g. relay chunks/s vs relay ratio)
+        # contradict each other.
+        best = collect_families(skip_handoff=args.skip_handoff)
+        for _ in range(args.runs - 1):
+            nxt = collect_families(skip_handoff=args.skip_handoff)
+            for fam, (metric, direction) in _FAMILY_PRIMARY.items():
+                if fam not in nxt or fam not in best:
+                    continue
+                worse = (nxt[fam].get(metric, 0)
+                         > best[fam].get(metric, 0))
+                if worse == (direction == "lower"):
+                    best[fam] = nxt[fam]
+        current = {}
+        for fam in best.values():
+            current.update(fam)
+    else:
+        current = collect(skip_handoff=args.skip_handoff)
+    if args.update:
+        if args.skip_handoff and os.path.exists(BASELINE_PATH):
+            # Partial update keeps the existing handoff numbers.
+            with open(BASELINE_PATH) as f:
+                merged = json.load(f).get("metrics", {})
+        else:
+            merged = {}
+        merged.update(current)
+        payload = {
+            "note": ("CPU-deterministic microbench baselines "
+                     "(tools/bench_check.py --update; min over interleaved "
+                     "runs, rig-specific)"),
+            "gates": {m: {"direction": d, "tolerance": t}
+                      for m, (d, t) in GATED.items()},
+            "absolute_max": ABSOLUTE_MAX,
+            "absolute_min": ABSOLUTE_MIN,
+            "metrics": merged,
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(f"baseline written: {BASELINE_PATH}")
+        print(render_table(merged, current))
+        return 0
+
+    if not os.path.exists(BASELINE_PATH):
+        print(f"no baseline at {BASELINE_PATH}; run with --update first",
+              file=sys.stderr)
+        return 2
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)["metrics"]
+    failures = compare(baseline, current,
+                       require_all=not args.skip_handoff)
+    print(render_table(baseline, current))
+    if failures:
+        print("\nBENCH-CHECK FAILED:", file=sys.stderr)
+        for line in failures:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    print("\nbench-check green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
