@@ -548,6 +548,73 @@ def run_policy_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_fairness_microbench(n: int = 4000, n_pods: int = 64) -> dict:
+    """Fairness pick-deprioritization cost A/B (fairness PR acceptance
+    bar: ``pick_fairness_ratio`` <= 1.05 — ``mode=enforce`` costs < 5% of
+    a pick vs the policy OFF).
+
+    Same harness shape as ``run_policy_microbench``: a real Python
+    filter-tree scheduler over a static fleet, with a REAL FairnessPolicy
+    (over a rollup carrying one flagged-noisy adapter resident on part of
+    the fleet, so ``filter_by_fairness`` does real narrowing work on every
+    pick) vs no advisor at all.  Interleaved runs, MIN per side.
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+    from llm_instance_gateway_tpu.gateway import usage as usage_mod
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod,
+    )
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    # A quarter of the fleet hosts the flagged adapter: quiet picks narrow
+    # past it every time (the enforcing path's real work).
+    provider = StaticProvider([
+        PodMetrics(pod=fake_pod(i),
+                   metrics=fake_metrics(
+                       queue=i % 5, kv=(i % 10) / 10.0,
+                       adapters={"hog": 0} if i % 4 == 0 else {},
+                       max_adapters=2))
+        for i in range(n_pods)
+    ])
+    req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                     prompt_tokens=25, criticality="Critical")
+
+    rollup = usage_mod.UsageRollup(provider)
+    # Flag "hog" directly (the microbench measures pick cost, not
+    # detection); seed_noisy keeps the coupled flag tables consistent.
+    rollup.seed_noisy("base", "hog")
+    plane = fairness_mod.FairnessPolicy(
+        rollup, cfg=fairness_mod.FairnessConfig(mode="enforce"),
+        provider=provider)
+
+    off = Scheduler(provider, prefix_aware=False, rng=random_mod.Random(0))
+    enforce = Scheduler(provider, prefix_aware=False,
+                        rng=random_mod.Random(0))
+    enforce.usage_advisor = plane
+
+    def loop(sched) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sched.schedule(req)
+        return time.perf_counter() - t0
+
+    loop(off), loop(enforce)  # warmup pair
+    base_best = enforce_best = float("inf")
+    for _ in range(12):
+        base_best = min(base_best, loop(off))
+        enforce_best = min(enforce_best, loop(enforce))
+    return {
+        "pick_fairness_off_us": round(base_best / n * 1e6, 2),
+        "pick_fairness_enforce_us": round(enforce_best / n * 1e6, 2),
+        "pick_fairness_ratio": round(enforce_best / base_best, 4),
+    }
+
+
 def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
                                n_models: int = 1000,
                                batch: int = 64) -> dict:
@@ -1102,6 +1169,12 @@ if __name__ == "__main__":
             results.update(run_policy_microbench())
         except Exception as e:
             results["pick_policy_error"] = str(e)[:200]
+        try:
+            # Fairness microbench (fairness PR): enforcement cost of
+            # mode=enforce pick deprioritization vs policy off.
+            results.update(run_fairness_microbench())
+        except Exception as e:
+            results["pick_fairness_error"] = str(e)[:200]
         try:
             # Data-plane fast path (perf PR 6): snapshot-resident native
             # pick + batched pick_many cost at the loadgen fixture scale.

@@ -50,6 +50,9 @@ CLIENT_DISCONNECT = "client_disconnect"  # client dropped a live stream
 KV_RELEASE = "kv_release"               # abandoned handoff KV released
 FAULT_INJECT = "fault_inject"           # chaos harness applied a fault
 NOISY_NEIGHBOR = "noisy_neighbor"       # adapter usage flag changed (usage.py)
+QUOTA_THROTTLE = "quota_throttle"       # tenant over quota (fairness.py)
+FAIRNESS_DEMOTE = "fairness_demote"     # over-quota request demoted one tier
+FAIRNESS_ESCAPE = "fairness_escape"     # fairness pick filter last-resort
 
 
 class EventJournal:

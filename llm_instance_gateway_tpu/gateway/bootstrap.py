@@ -564,6 +564,40 @@ def add_resilience_args(parser) -> None:
                         help="hedge non-streaming requests when no "
                              "response within this many seconds "
                              "(0 = disabled)")
+    add_fairness_args(parser)
+
+
+def add_fairness_args(parser: argparse.ArgumentParser) -> None:
+    """Fairness/quota flags alone — for entrypoints (gRPC ext-proc) that
+    carry the handler-core admit() gate without the proxy's data-path
+    resilience surface.  ``add_resilience_args`` includes these."""
+    from llm_instance_gateway_tpu.gateway.fairness import (
+        FAIRNESS_MODES,
+        FairnessConfig,
+    )
+
+    # Defaults are None SENTINELS: flags left unset defer to the pool
+    # document's schedulerConfig.fairnessPolicy section (then to
+    # FairnessConfig defaults) — an explicitly-passed flag wins, per FIELD.
+    f = FairnessConfig()
+    parser.add_argument("--fairness-mode", choices=list(FAIRNESS_MODES),
+                        default=None,
+                        help="usage-seam enforcement (gateway/fairness.py): "
+                             "log_only counts would-deprioritize picks only "
+                             "(routing unchanged); deprioritize makes "
+                             "flagged-noisy tenants lose pick ties; enforce "
+                             "adds rank-weighted tenant quotas with "
+                             f"one-tier criticality demotion "
+                             f"(default {f.mode}; the pool document's "
+                             "fairnessPolicy section overrides unset flags)")
+    parser.add_argument("--fairness-over-ratio", type=float, default=None,
+                        help="share / fair-share ratio beyond which a "
+                             "tenant is over-quota (enforce mode; default "
+                             f"{f.over_ratio})")
+    parser.add_argument("--fairness-quota-rps", type=float, default=None,
+                        help="full-criticality admissions per second for an "
+                             "over-quota tenant; excess demotes one tier "
+                             f"(default {f.quota_rps})")
 
 
 def resilience_from_args(args):
@@ -579,6 +613,25 @@ def resilience_from_args(args):
         retry_budget_ratio=args.retry_budget_ratio,
         hedge_ttft_s=args.hedge_ttft_s,
     )
+
+
+def fairness_from_args(args):
+    """FairnessConfig field overrides from ``add_resilience_args`` flags.
+
+    Returns ONLY the explicitly-passed flags as a field->value dict (None
+    when every flag was left unset).  The proxy overlays these on the pool
+    document's ``schedulerConfig.fairnessPolicy`` section (then defaults)
+    — per FIELD, so ``--fairness-quota-rps`` alone doesn't silently reset
+    a pool-doc ``mode: enforce`` back to log_only — and the overlay is
+    re-applied on every hot reload, so a pool-doc update can't clobber an
+    operator's explicit flags either."""
+    overrides = {
+        "mode": args.fairness_mode,
+        "over_ratio": args.fairness_over_ratio,
+        "quota_rps": args.fairness_quota_rps,
+    }
+    set_overrides = {k: v for k, v in overrides.items() if v is not None}
+    return set_overrides or None
 
 
 def components_from_args(args) -> "GatewayComponents | MultiPoolComponents":

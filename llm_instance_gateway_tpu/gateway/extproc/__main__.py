@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -26,9 +27,51 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--port", type=int, default=9002)  # main.go:33 default
     parser.add_argument("--grpc-workers", type=int, default=16)
     bootstrap.add_common_args(parser)
+    bootstrap.add_fairness_args(parser)
     args = parser.parse_args(argv)
 
     comps = bootstrap.components_from_args(args)
+    stop = threading.Event()
+    # Fairness/quota plane (gateway/fairness.py): the admit() gate lives in
+    # the handler core this transport shares with the HTTP proxy, but the
+    # proxy's observability loop isn't running here — build the usage
+    # rollup + policy and tick them on a daemon thread, or a pool
+    # document's fairnessPolicy section would parse and then sit dead.
+    from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+    from llm_instance_gateway_tpu.gateway import usage as usage_mod
+
+    rollup = usage_mod.UsageRollup(comps.provider)
+    fairness = fairness_mod.FairnessPolicy(
+        rollup, cfg=getattr(comps.scheduler.cfg, "fairness", None),
+        provider=comps.provider,
+        cli_overrides=bootstrap.fairness_from_args(args))
+    if hasattr(comps.handler_server, "fairness"):
+        comps.handler_server.fairness = fairness
+    elif fairness.mode != fairness_mod.LOG_ONLY:
+        # Multi-pool front: no fairness seams on the wrapper (per-pool
+        # wiring is future work) — refuse to leave the config silently
+        # dead.
+        logger.warning(
+            "fairness mode=%s configured but %s has no fairness seams — "
+            "enforcement is INACTIVE (single-pool deployments only)",
+            fairness.mode, type(comps.handler_server).__name__)
+    inner = getattr(comps.scheduler, "_scheduler", comps.scheduler)
+    if hasattr(inner, "usage_advisor"):
+        inner.usage_advisor = fairness  # pick deprioritization seam
+    if hasattr(comps.scheduler, "fairness"):
+        comps.scheduler.fairness = fairness  # pool-doc hot-reload push
+    tick_s = float(os.environ.get("LIG_SLO_TICK_S", "5"))
+
+    def _fairness_tick() -> None:
+        while not stop.wait(tick_s):
+            try:
+                rollup.tick()
+                fairness.tick()
+            except Exception:
+                logger.exception("usage/fairness tick failed")
+
+    threading.Thread(target=_fairness_tick, daemon=True,
+                     name="lig-fairness-tick").start()
     # Admission queueing parks requests ON their handler threads (bounded by
     # maxDepth x maxWaitSeconds); the worker pool must cover the full parked
     # depth on top of the active-stream workers, or parked non-critical
@@ -52,7 +95,6 @@ def main(argv: list[str] | None = None) -> None:
     server.start()
     logger.info("ext-proc gRPC server listening on :%d", args.port)
 
-    stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):  # main.go SIGTERM handling
         signal.signal(sig, lambda *a: stop.set())
     try:

@@ -1,0 +1,370 @@
+"""Fairness & quota plane: the enforcement layer over the PR-5 usage plane.
+
+``gateway/usage.py`` answers *who is consuming the pool*; this module makes
+the gateway **act** on that attribution — the promotion of the log-only
+``usage_advisor`` seam the same way ``gateway/resilience.py`` promoted the
+health seam.  CaraServe (arxiv 2401.11240) and the heterogeneous-LoRA
+serving line (arxiv 2511.22880) both show rank/load heterogeneity across
+adapters is the dominant interference source in multi-LoRA serving; the
+two levers here are exactly the ones they argue for:
+
+- **Pick deprioritization** (``mode=deprioritize`` or ``enforce``): pods
+  currently hosting a flagged-noisy adapter are *marked*; a quiet tenant's
+  pick narrows to unmarked survivors (isolation — the flood can't degrade
+  cotenants on its replicas), while the flagged tenant's own picks narrow
+  to the marked pods (containment — the flood can't claim fresh replicas
+  while flagged).  Both narrowings run AFTER the health/circuit policy
+  filter and BEFORE the prefix tie-break / RNG draw, with the same
+  counted last-resort escape hatch shape as ``filter_by_policy`` (a pool
+  where every survivor hosts the hog still serves, loudly).  ``log_only``
+  keeps routing byte-identical — pinned by same-RNG diff tests across the
+  health x circuit x usage x fairness planes in tests/test_fairness.py.
+
+- **Weighted-fair admission quotas** (``mode=enforce``): each
+  ``{model, adapter}`` key gets a rank-weighted fair share of the pool
+  (``weight = rank_base / rank``, so a rank-64 flood earns a SMALLER share
+  than rank-8 tenants — its steps cost proportionally more TPU).  A key
+  whose EMA step-seconds share (PR-5 ``gateway_usage_share``) exceeds
+  ``over_ratio x fair_share`` is **throttled**: its requests spend a
+  per-key token bucket (refill ``quota_rps``, cost scaled by rank) and an
+  empty bucket demotes the request ONE criticality tier instead of
+  hard-shedding (Critical -> Default -> Sheddable).  Under pool saturation
+  degradation therefore proceeds strictly lowest-criticality-first: the
+  filter tree sheds Sheddable first, demoted Default next, and the 429
+  carries ``Retry-After``.  Decisions journal ``quota_throttle`` /
+  ``fairness_demote`` events and export
+  ``gateway_quota_throttles_total{model,adapter}``,
+  ``gateway_fairness_demotions_total{model,adapter}``, and the
+  ``gateway_tenant_quota_remaining{model,adapter}`` gauge.
+
+Config: ``add_resilience_args``-style bootstrap flags
+(``--fairness-mode`` etc., gateway/bootstrap.py) plus hot-reloadable
+``schedulerConfig.fairnessPolicy`` keys in the InferencePool document
+(scheduling/config.py) — the same dual path the admission queue uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass, replace
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import render_keyed_family
+
+BASE = "base"
+LOG_ONLY, DEPRIORITIZE, ENFORCE = "log_only", "deprioritize", "enforce"
+FAIRNESS_MODES = (LOG_ONLY, DEPRIORITIZE, ENFORCE)
+
+# Criticality ladder for one-tier demotion (graceful degradation order:
+# sheddable traffic dies first, critical last).
+_DEMOTE = {"Critical": "Default", "Default": "Sheddable"}
+
+
+@dataclass(frozen=True)
+class FairnessConfig:
+    """Knobs for the fairness/quota plane (flags: ``add_resilience_args``;
+    pool document: ``schedulerConfig.fairnessPolicy``)."""
+
+    # log_only: observe only (routing byte-identical to the PR-5 seam).
+    # deprioritize: flagged keys lose pick ties (isolation + containment).
+    # enforce: deprioritize + rank-weighted admission quotas with one-tier
+    # demotion.
+    mode: str = LOG_ONLY
+    # A key is over-quota when its EMA step-seconds share exceeds
+    # over_ratio x its rank-weighted fair share.  The default (3x) only
+    # throttles flagrant over-consumption: a busy-but-proportional tenant
+    # legitimately exceeds an equal split, and enforcement that bites at
+    # 1.5x would punish ordinary traffic skew (the adapter_flood chaos
+    # scenario pins a flooding hog throttling while a 60%-of-traffic
+    # quiet tenant does not).
+    over_ratio: float = 3.0
+    # Absolute ceiling on any key's share before the quota bites
+    # regardless of over_ratio: with few tenants ``over_ratio x fair``
+    # can exceed 1.0 and the quota could never bind — a 2-tenant pool's
+    # 90%-share hog must still throttle.  Keys whose FAIR share already
+    # exceeds this cap (near-single-tenant pools) are exempt: the pool is
+    # legitimately theirs.
+    max_share: float = 0.85
+    # Token bucket for throttled keys: full-criticality admissions per
+    # second while over quota; excess demotes one tier.  The burst cap
+    # bounds how fast a key exits a quiet period.
+    quota_rps: float = 4.0
+    quota_burst: float = 8.0
+    # Rank scaling: fair-share weight = rank_base / rank (base tenants and
+    # unknown ranks weigh 1.0); bucket cost = rank / rank_base, so a
+    # rank-64 request spends 8x a rank-8 one against the same bucket.
+    rank_base: int = 8
+    # Retry-After hint (seconds) the proxy stamps on 429 shed responses.
+    retry_after_s: float = 1.0
+
+    def __post_init__(self):
+        if self.mode not in FAIRNESS_MODES:
+            raise ValueError(
+                f"fairness mode {self.mode!r} not in {FAIRNESS_MODES}")
+        if self.over_ratio <= 0 or self.quota_rps <= 0 \
+                or self.quota_burst <= 0 or self.rank_base <= 0 \
+                or not 0 < self.max_share <= 1:
+            raise ValueError("fairness ratios/rates must be positive "
+                             "(max_share in (0, 1])")
+
+
+class FairnessPolicy:
+    """The object the proxy hands to the scheduler as ``usage_advisor``
+    (superset of the UsageRollup seam: ``noisy``/``note_pick`` delegate to
+    the rollup, so ``log_only`` stays byte-identical) and to the handler
+    core as the admission gate (``admit``).  Thread-safe: the pick seam,
+    the transport threads, and the observability tick all touch it."""
+
+    def __init__(self, usage, cfg: FairnessConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 provider=None, clock=time.time,
+                 cli_overrides: dict | None = None):
+        self.usage = usage          # gateway.usage.UsageRollup
+        # Explicitly-passed CLI flags (field -> value) pin those FIELDS:
+        # overlaid on the initial config here and re-applied on every
+        # ``update_config``, so a pool-doc hot reload (with or without a
+        # fairnessPolicy section) can never clobber an operator's flags,
+        # while unpinned fields still track the pool document.
+        self._cli_overrides = dict(cli_overrides or {})
+        self.cfg = replace(cfg or FairnessConfig(), **self._cli_overrides)
+        self.journal = journal
+        self.provider = provider    # adapter-rank source (may be None)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Tick-computed state (all keyed by (model, adapter)):
+        self._fair_shares: dict[tuple, float] = {}
+        self._shares: dict[tuple, float] = {}
+        self._costs: dict[tuple, float] = {}      # bucket cost per request
+        self._throttled: dict[str, tuple] = {}    # request name -> key
+        self._buckets: dict[tuple, list] = {}     # key -> [tokens, last_t]
+        # Exported counters.
+        self.quota_throttles: dict[tuple, int] = {}
+        self.fairness_demotions: dict[tuple, int] = {}
+        self.escape_total = 0
+        self.ticks = 0
+        # (noisy-set identity, pods hosting a flagged adapter): the pick
+        # seam's cached mark set — the rollup rebuilds its noisy frozenset
+        # every tick, so object identity is the cheap staleness signal
+        # (same shape as health.non_healthy() / breaker.blocked_set()).
+        self._noisy_pods_cache: tuple = (None, frozenset())
+
+    # -- config ------------------------------------------------------------
+    @property
+    def mode(self) -> str:
+        return self.cfg.mode
+
+    def update_config(self, cfg: FairnessConfig) -> None:
+        """Hot-reload seam (pool ``schedulerConfig.fairnessPolicy`` via
+        AdmissionController.update_config).  CLI-pinned fields are
+        re-overlaid so a reload can't clobber them.  Buckets keep their
+        levels — a reload must not hand every throttled tenant a fresh
+        burst."""
+        cfg = replace(cfg, **self._cli_overrides)
+        if cfg != self.cfg:
+            self.cfg = cfg
+
+    # -- scheduler advisor seam (superset of UsageRollup's) ----------------
+    def noisy(self) -> frozenset:
+        return self.usage.noisy()
+
+    def note_pick(self, pod_name: str, model: str | None) -> None:
+        """Log-only counting rides the rollup unchanged — no RNG, no
+        exceptions — so attaching this policy in ``log_only`` keeps picks
+        byte-identical (tests/test_fairness.py pins it)."""
+        self.usage.note_pick(pod_name, model)
+
+    def noisy_pods(self) -> frozenset | None:
+        """Pods currently hosting a flagged-noisy adapter — the pick
+        seam's mark set (``filter_by_fairness``), cached per noisy-set
+        generation so the per-pick cost is one frozenset membership test
+        per candidate.  None when no provider is attached (the filter
+        falls back to scanning candidate residency directly)."""
+        if self.provider is None:
+            return None
+        flagged = self.usage.noisy()
+        if not flagged:
+            return frozenset()
+        cached_id, cached = self._noisy_pods_cache
+        if cached_id is flagged:
+            return cached
+        pods = frozenset(
+            pm.pod.name for pm in self.provider.all_pod_metrics()
+            if any(a in flagged for a in pm.metrics.active_adapters))
+        self._noisy_pods_cache = (flagged, pods)
+        return pods
+
+    def note_fairness_escape(self) -> None:
+        """Every survivor hosted a flagged adapter; the pick proceeded
+        over the full set (deprioritize last resort).  Called from the
+        threaded-transport pick seam, so the increment takes the lock."""
+        with self._lock:
+            self.escape_total += 1
+        if self.journal is not None:
+            self.journal.emit(events_mod.FAIRNESS_ESCAPE,
+                              mode=self.cfg.mode)
+
+    # -- tick (fair shares + throttle set) ---------------------------------
+    def _pool_ranks(self) -> dict[str, int]:
+        """Adapter name -> rank, merged over the pool's replicas (max wins:
+        the costliest resident copy is the one the quota must price)."""
+        ranks: dict[str, int] = {}
+        if self.provider is None:
+            return ranks
+        for pm in self.provider.all_pod_metrics():
+            for name, rank in getattr(pm.metrics, "adapter_ranks",
+                                      {}).items():
+                if rank and rank > ranks.get(name, 0):
+                    ranks[name] = rank
+        return ranks
+
+    def tick(self, now: float | None = None) -> None:
+        """Observability-cadence pass: rank-weighted fair shares from the
+        rollup's EMA step-seconds shares, then the throttled set.  Runs
+        AFTER ``usage.tick()`` so shares are current."""
+        now = self._clock() if now is None else now
+        shares = self.usage.shares_snapshot()
+        ranks = self._pool_ranks()
+        cfg = self.cfg
+        weights: dict[tuple, float] = {}
+        costs: dict[tuple, float] = {}
+        for (model, adapter) in shares:
+            rank = (ranks.get(adapter, cfg.rank_base)
+                    if adapter != BASE else cfg.rank_base)
+            weights[(model, adapter)] = cfg.rank_base / max(1, rank)
+            costs[(model, adapter)] = max(1.0, rank / cfg.rank_base)
+        total_w = sum(weights.values())
+        fair = ({k: w / total_w for k, w in weights.items()}
+                if total_w > 0 else {})
+        throttled: dict[str, tuple] = {}
+        for key, share in shares.items():
+            if not fair or fair[key] >= cfg.max_share:
+                continue  # near-single-tenant: the pool is theirs
+            bar = min(cfg.over_ratio * fair[key], cfg.max_share)
+            if share > bar:
+                model, adapter = key
+                # Match what the admission/pick seams actually see: base
+                # tenants arrive under the served MODEL name, adapter
+                # traffic under the adapter name (usage.py semantics).
+                # The same adapter name served under TWO models collides
+                # on that name; a request can't be attributed to one key
+                # at admission time, so charge the dominant offender
+                # (highest pool share) rather than iteration-order's last.
+                name = model if adapter == BASE else adapter
+                prev = throttled.get(name)
+                if prev is None or shares.get(prev, 0.0) < share:
+                    throttled[name] = key
+        with self._lock:
+            self.ticks += 1
+            self._shares = shares
+            self._fair_shares = fair
+            self._costs = costs
+            self._throttled = throttled
+            # GC buckets for keys that left the attribution plane, so the
+            # gauge exposition stays bounded by live tenants.
+            for key in [k for k in self._buckets if k not in shares]:
+                del self._buckets[key]
+
+    def throttled(self) -> frozenset:
+        """Currently over-quota request names (lock-free-ish read for
+        tests/chaos assertions)."""
+        return frozenset(self._throttled)
+
+    # -- admission gate ----------------------------------------------------
+    def admit(self, llm_req) -> str | None:
+        """Quota gate, called by the handler core BEFORE scheduling.
+
+        Returns the tier the request was demoted to (None = untouched).
+        Never raises and never hard-sheds: an over-quota request is worth
+        one tier less, and the filter tree / admission queue then applies
+        the normal lowest-criticality-first degradation under saturation.
+        """
+        if self.cfg.mode != ENFORCE:
+            return None
+        key = self._throttled.get(llm_req.model)
+        if key is None:
+            return None
+        cfg = self.cfg
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = [cfg.quota_burst, now]
+            tokens, last = bucket
+            tokens = min(cfg.quota_burst,
+                         tokens + max(0.0, now - last) * cfg.quota_rps)
+            cost = self._costs.get(key, 1.0)
+            if tokens >= cost:
+                bucket[0], bucket[1] = tokens - cost, now
+                return None
+            bucket[0], bucket[1] = tokens, now
+            self.quota_throttles[key] = self.quota_throttles.get(key, 0) + 1
+        if self.journal is not None:
+            self.journal.emit(events_mod.QUOTA_THROTTLE, model=key[0],
+                              adapter=key[1],
+                              criticality=llm_req.criticality)
+        frm = llm_req.criticality or "Default"
+        to = _DEMOTE.get(frm)
+        if to is None:
+            return None  # already Sheddable: the tree sheds it first
+        llm_req.criticality = to
+        llm_req.critical = False
+        with self._lock:
+            self.fairness_demotions[key] = (
+                self.fairness_demotions.get(key, 0) + 1)
+        if self.journal is not None:
+            self.journal.emit(events_mod.FAIRNESS_DEMOTE, model=key[0],
+                              adapter=key[1], frm=frm, to=to)
+        return to
+
+    # -- export ------------------------------------------------------------
+    def render(self) -> list[str]:
+        with self._lock:
+            throttles = dict(self.quota_throttles)
+            demotions = dict(self.fairness_demotions)
+            # Only CURRENTLY-throttled tenants: a key back under quota
+            # would otherwise export its last (frozen) bucket level
+            # forever — refill is lazy in admit(), so the gauge never
+            # visibly recovers.  Bucket levels are kept (not GC'd) so a
+            # re-throttled oscillator doesn't restart with a full burst.
+            live = set(self._throttled.values())
+            remaining = {key: bucket[0]
+                         for key, bucket in self._buckets.items()
+                         if key in live}
+        lines = render_keyed_family(
+            "gateway_quota_throttles_total", throttles,
+            ("model", "adapter"))
+        lines += render_keyed_family(
+            "gateway_fairness_demotions_total", demotions,
+            ("model", "adapter"))
+        lines += render_keyed_family(
+            "gateway_tenant_quota_remaining", remaining,
+            ("model", "adapter"), kind="gauge", fmt="%.3f")
+        return lines
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            throttled = dict(self._throttled)
+            rows = []
+            for name, key in sorted(throttled.items()):
+                rows.append({
+                    "name": name, "model": key[0], "adapter": key[1],
+                    "share": round(self._shares.get(key, 0.0), 4),
+                    "fair_share": round(self._fair_shares.get(key, 0.0), 4),
+                    "cost": self._costs.get(key, 1.0),
+                    "quota_remaining": round(
+                        self._buckets.get(key, [self.cfg.quota_burst])[0],
+                        3),
+                    "throttles": self.quota_throttles.get(key, 0),
+                    "demotions": self.fairness_demotions.get(key, 0),
+                })
+            return {
+                "mode": self.cfg.mode,
+                "throttled": rows,
+                "quota_throttles_total": sum(self.quota_throttles.values()),
+                "fairness_demotions_total": sum(
+                    self.fairness_demotions.values()),
+                "escape_total": self.escape_total,
+                "ticks": self.ticks,
+                "config": asdict(self.cfg),
+            }

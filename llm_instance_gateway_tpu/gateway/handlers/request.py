@@ -134,6 +134,26 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
         body["model"] = llm_req.resolved_target_model
         request_body = json.dumps(body).encode()
 
+    # Fairness/quota gate (gateway/fairness.py): an over-quota tenant's
+    # request is demoted ONE criticality tier before scheduling — the
+    # filter tree and admission queue then apply the normal
+    # lowest-criticality-first degradation under saturation.  Never sheds
+    # here; never touches the request when the policy is off/log_only.
+    # Charged ONCE per client request: the proxy's retry loop re-enters
+    # this phase with the same req_ctx per attempt, and re-spending the
+    # bucket there would halve the effective quota exactly during the
+    # saturation windows quotas exist for — replay the memoized decision
+    # instead.
+    fairness = getattr(server, "fairness", None)
+    if fairness is not None:
+        if req_ctx.fairness_charged:
+            if req_ctx.fairness_demoted_to is not None:
+                llm_req.criticality = req_ctx.fairness_demoted_to
+                llm_req.critical = False
+        else:
+            req_ctx.fairness_charged = True
+            req_ctx.fairness_demoted_to = fairness.admit(llm_req)
+
     # Disaggregated pools get a two-stage pick (prefill replica + decode
     # replica); schedulers without the seam (custom drop-ins) stay
     # single-hop.  Both raise SchedulingError.

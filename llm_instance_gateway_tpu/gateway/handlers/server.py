@@ -57,6 +57,12 @@ class RequestContext:
     admission_wait_s: float = 0.0
     pick_hops_s: tuple | None = None
     usage: Usage = field(default_factory=Usage)
+    # Fairness quota memo (handlers/request.py): the tenant bucket is
+    # charged ONCE per client request; proxy retry attempts and hedge
+    # re-picks reuse/flag the context and replay the decision instead of
+    # spending another token per internal attempt.
+    fairness_charged: bool = False
+    fairness_demoted_to: str | None = None
 
 
 class ProcessingError(Exception):
@@ -85,6 +91,11 @@ class Server:
         self.datastore = datastore
         self.target_pod_header = target_pod_header
         self.decode_pod_header = decode_pod_header
+        # Fairness/quota admission gate (gateway/fairness.py, wired by the
+        # proxy): consulted in the body phase BEFORE scheduling, so an
+        # over-quota tenant's request is demoted one criticality tier on
+        # every transport (HTTP proxy AND gRPC ext-proc).  None = off.
+        self.fairness = None
 
     def process(
         self, req_ctx: RequestContext, msg: ProcessingMessage

@@ -52,6 +52,54 @@ def model_name(i: int) -> str:  # benchmark.go:71-73
     return f"adapter-{i}"
 
 
+CRITICALITY_TIERS = {"critical": Criticality.CRITICAL,
+                     "default": Criticality.DEFAULT,
+                     "sheddable": Criticality.SHEDDABLE}
+
+
+def parse_criticality_mix(spec: str) -> dict[str, float]:
+    """``"critical=0.1,default=0.6,sheddable=0.3"`` -> normalized weight
+    dict keyed by tier name (``Critical``/``Default``/``Sheddable``).
+    Weights normalize; unknown tiers raise — a typo'd tier would silently
+    skew the traffic shape the chaos scenario and sim calibration share."""
+    mix: dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, raw = part.partition("=")
+        tier = CRITICALITY_TIERS.get(name.strip().lower())
+        if tier is None:
+            raise ValueError(
+                f"criticality-mix entry {part!r}: tier must be one of "
+                f"{sorted(CRITICALITY_TIERS)}")
+        try:
+            w = float(raw)
+        except ValueError:
+            raise ValueError(f"criticality-mix entry {part!r}: weight must "
+                             "be a number") from None
+        if w <= 0:
+            raise ValueError(f"criticality-mix entry {part!r}: weight must "
+                             "be > 0")
+        mix[tier.value] = mix.get(tier.value, 0.0) + w
+    if not mix:
+        raise ValueError("empty criticality mix")
+    total = sum(mix.values())
+    return {k: v / total for k, v in mix.items()}
+
+
+def assign_tiers(model_names: list[str], mix: dict[str, float],
+                 seed: int = 0) -> dict[str, str]:
+    """Seeded weighted tier assignment per model name: uniform round-robin
+    traffic over the models then matches the mix in expectation, and the
+    same seed reproduces the same shape run over run."""
+    rng = random.Random(seed)
+    tiers = sorted(mix)
+    weights = [mix[t] for t in tiers]
+    return {name: rng.choices(tiers, weights=weights)[0]
+            for name in model_names}
+
+
 def parse_adapter_mix(spec: str) -> dict[str, float]:
     """``"a=0.7,b=0.2,base=0.1"`` -> normalized weight dict.  ``base``
     routes to the shared base model (no adapter); weights need not sum to
@@ -147,6 +195,7 @@ def run_load(
     trace_out: str | None = None,
     adapter_mix: dict[str, float] | None = None,
     mix_seed: int = 0,
+    criticality_mix: dict[str, float] | None = None,
     fast_path: bool = True,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
@@ -188,6 +237,18 @@ def run_load(
             num_fake_pods, num_models_per_pod,
             with_base_model=bool(session_prefix_chars),
             role_split=role_split)
+    tier_of: dict[str, str] = {}
+    if criticality_mix:
+        # Re-register the fixture's models with seeded weighted tiers so
+        # uniform traffic over them reproduces the requested criticality
+        # shape — the traffic mold the adapter_flood chaos scenario and
+        # future sim calibration share.
+        tier_of = assign_tiers(
+            sorted(m.spec.model_name for m in models), criticality_mix,
+            seed=mix_seed)
+        models = [make_model(m.spec.model_name,
+                             Criticality(tier_of[m.spec.model_name]))
+                  for m in models]
     factory = None
     if use_native:
         from llm_instance_gateway_tpu.gateway.scheduling.native import (
@@ -207,19 +268,33 @@ def run_load(
     mix_weights = [adapter_mix[n] for n in mix_names] if adapter_mix \
         else []
     per_adapter_lat: dict[str, list[float]] = {}
+    per_tier_lat: dict[str, list[float]] = {}
+    per_tier_shed: dict[str, int] = {}
+    sheds = 0  # only nonzero under --criticality-mix (asserted otherwise)
 
-    def body_for(i: int) -> tuple[bytes, int | None, str | None]:
+    def body_for(i: int) -> tuple[bytes, int | None, str | None, str]:
         if adapter_mix:
             name = mix_rng.choices(mix_names, weights=mix_weights)[0]
             target = "shared-base" if name == "base" else name
-            return generate_request(target), None, name
+            return generate_request(target), None, name, target
         if session_prefix_chars:
             sid = i % session_count
             return generate_request(
                 "shared-base",
                 prompt=session_prompt(sid, i, session_prefix_chars)), \
-                sid, None
-        return generate_request(model_name(i % total_models)), None, None
+                sid, None, "shared-base"
+        target = model_name(i % total_models)
+        return generate_request(target), None, None, target
+
+    def tier_account(target: str, latency_s: float, shed: bool) -> None:
+        """Per-criticality-tier latency/shed tally (criticality-mix mode)."""
+        tier = tier_of.get(target)
+        if tier is None:
+            return
+        if shed:
+            per_tier_shed[tier] = per_tier_shed.get(tier, 0) + 1
+        else:
+            per_tier_lat.setdefault(tier, []).append(latency_s)
 
     def account(keys: dict, sid: int | None) -> None:
         """Per-response bookkeeping shared by both transports; ``keys``
@@ -241,7 +316,7 @@ def run_load(
         server = build_handler_server(pods, models, scheduler_factory=factory)
         t_start = time.perf_counter()
         for i in range(requests):
-            body, sid, adapter = body_for(i)
+            body, sid, adapter, target = body_for(i)
             msg = RequestBody(body=body)
             # Body construction stays OUTSIDE the sample, matching the
             # slow path (which builds every body before its timer): the
@@ -250,11 +325,23 @@ def run_load(
             t0 = time.perf_counter()
             res = server.process(RequestContext(), msg)
             t1 = time.perf_counter()
+            shed = res.immediate_status is not None
+            if criticality_mix:
+                # Sheddable-tier traffic MAY shed under a saturated
+                # fixture — that is the per-tier breakdown's whole point.
+                tier_account(target, t1 - t0, shed)
+            else:
+                assert not shed, f"request {i} shed ({res.immediate_status})"
+            if shed:
+                # Sheds stay OUT of the headline latency/trace tallies
+                # (a near-instant 429 would deflate p50/p99 and make
+                # mix artifacts incomparable to non-mix ones); the
+                # per-tier rows above carry them.
+                sheds += 1
+                continue
             latencies.append(t1 - t0)
             if adapter is not None:
                 per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
-            assert res.immediate_status is None, \
-                f"request {i} shed ({res.immediate_status})"
             account(res.set_headers, sid)
         wall = time.perf_counter() - t_start
     else:
@@ -281,19 +368,27 @@ def run_load(
                 bodies = [body_for(sent + k) for k in range(batch)]
                 msgs = [
                     pb.ProcessingRequest(request_body=pb.HttpBody(body=body))
-                    for body, _, _ in bodies
+                    for body, _, _, _ in bodies
                 ]
                 t0 = time.perf_counter()
                 # One stream per batch: measures per-message processing
                 # inline.
                 for k, resp in enumerate(stub(iter(msgs))):
                     t1 = time.perf_counter()
-                    latencies.append(t1 - t0)
+                    lat = t1 - t0
+                    t0 = t1
+                    shed = resp.WhichOneof("response") != "request_body"
+                    if criticality_mix:
+                        tier_account(bodies[k][3], lat, shed)
+                    else:
+                        assert not shed
+                    if shed:
+                        sheds += 1  # headline tallies exclude sheds
+                        continue
+                    latencies.append(lat)
                     adapter = bodies[k][2]
                     if adapter is not None:
-                        per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
-                    t0 = t1
-                    assert resp.WhichOneof("response") == "request_body"
+                        per_adapter_lat.setdefault(adapter, []).append(lat)
                     keys = {
                         h.header.key: (h.header.raw_value.decode("utf-8",
                                                                  "replace")
@@ -312,6 +407,8 @@ def run_load(
     latencies.sort()
 
     def pct(p: float) -> float:
+        if not latencies:
+            return 0.0  # every request shed (saturated mix fixture)
         return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
 
     out = {
@@ -322,9 +419,10 @@ def run_load(
         "rps": round(requests / wall, 1),
         "p50_us": round(pct(0.5) * 1e6, 1),
         "p99_us": round(pct(0.99) * 1e6, 1),
-        # 1.0 = every scheduled response echoed a trace id in its header
-        # mutation (the client-side correlation contract).
-        "trace_id_rate": round(trace_hits / requests, 4),
+        # 1.0 = every SERVED response echoed a trace id in its header
+        # mutation (the client-side correlation contract; sheds never
+        # reach the trace-echo path and are excluded).
+        "trace_id_rate": round(trace_hits / max(1, requests - sheds), 4),
         # Which data-plane transport ran: "fast" = in-process dispatch,
         # "slow" = gRPC ext-proc stream — so every future artifact carries
         # the fast/slow axis alongside the scheduler one.
@@ -351,6 +449,26 @@ def run_load(
                     vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1e6, 1),
             }
         out["per_adapter"] = breakdown
+    if criticality_mix:
+        # Per-tier latency/shed breakdown: the traffic shape + observable
+        # the adapter_flood chaos scenario and sim calibration share
+        # (zero critical sheds is an acceptance invariant there).
+        out["criticality_mix"] = {k: round(v, 4)
+                                  for k, v in sorted(criticality_mix.items())}
+        # Headline latencies cover served traffic only; the shed count
+        # keeps rps (= requests/wall) interpretable next to them.
+        out["sheds"] = sheds
+        tiers = {}
+        for tier in sorted(set(per_tier_lat) | set(per_tier_shed)):
+            vals = sorted(per_tier_lat.get(tier, []))
+            row = {"requests": len(vals) + per_tier_shed.get(tier, 0),
+                   "shed": per_tier_shed.get(tier, 0)}
+            if vals:
+                row["p50_us"] = round(vals[len(vals) // 2] * 1e6, 1)
+                row["p99_us"] = round(
+                    vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1e6, 1)
+            tiers[tier] = row
+        out["per_tier"] = tiers
     if role_split:
         # 1.0 = every response carried BOTH hop headers (prefill target +
         # x-decode-pod) — the two-stage pick ran on every request.
@@ -396,6 +514,12 @@ def main(argv=None):
                              'latency breakdown in the report')
     parser.add_argument("--mix-seed", type=int, default=0,
                         help="seed for the weighted adapter draw")
+    parser.add_argument("--criticality-mix", default=None, metavar="SPEC",
+                        help='weighted criticality tiers, e.g. '
+                             '"critical=0.1,default=0.6,sheddable=0.3": '
+                             "the fixture's models get seeded tier "
+                             "assignments and the report gains a per-tier "
+                             "latency/shed breakdown")
     parser.add_argument("--no-fast-path", action="store_true",
                         help="drive the gRPC ext-proc stream (proto "
                              "marshalling per request) instead of the "
@@ -411,6 +535,9 @@ def main(argv=None):
                        adapter_mix=(parse_adapter_mix(args.adapter_mix)
                                     if args.adapter_mix else None),
                        mix_seed=args.mix_seed,
+                       criticality_mix=(
+                           parse_criticality_mix(args.criticality_mix)
+                           if args.criticality_mix else None),
                        fast_path=not args.no_fast_path)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))

@@ -51,6 +51,7 @@ LORA_INFO_METRIC = "tpu:lora_requests_info"
 LORA_ADAPTERS_LABEL = "running_lora_adapters"
 LORA_WAITING_LABEL = "waiting_lora_adapters"
 LORA_MAX_LABEL = "max_lora"
+LORA_RANKS_LABEL = "adapter_ranks"  # optional name:rank CSV (rank-aware fairness)
 PREFILL_QUEUE_METRIC = "tpu:prefill_queue_size"
 DECODE_QUEUE_METRIC = "tpu:decode_queue_size"
 RUNNING_METRIC = "tpu:num_requests_running"
@@ -177,6 +178,19 @@ def families_to_metrics(
             if name:
                 adapters[name] = 0
         updated.active_adapters = adapters
+        # Optional name:rank CSV (our server exports it; foreign vLLM-style
+        # servers simply lack the label and ranks stay unknown).
+        ranks: dict[str, int] = {}
+        for entry in best.labels.get(LORA_RANKS_LABEL, "").split(","):
+            name, sep, raw_rank = entry.strip().rpartition(":")
+            if not sep or not name:
+                continue
+            try:
+                ranks[name] = int(float(raw_rank))
+            except (ValueError, OverflowError):  # "inf" overflows int()
+                errs.append(
+                    f"invalid {LORA_RANKS_LABEL} entry: {entry!r}")
+        updated.adapter_ranks = ranks
         raw_max = best.labels.get(LORA_MAX_LABEL)
         if raw_max is None:
             # Without max_lora the slot-room predicates are permanently false

@@ -67,6 +67,7 @@ import aiohttp
 from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
 from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
@@ -124,6 +125,7 @@ class GatewayProxy:
         slo_cfg: "slo_mod.SLOConfig | None" = None,
         health_cfg: "health_mod.HealthConfig | None" = None,
         usage_cfg: "usage_mod.UsageConfig | None" = None,
+        fairness_cfg: "fairness_mod.FairnessConfig | dict | None" = None,
         blackbox_dir: str | None = None,
         fast_relay: bool = True,
     ):
@@ -159,6 +161,29 @@ class GatewayProxy:
         self.usage = usage_mod.UsageRollup(
             provider, metrics=self.metrics, cfg=usage_cfg,
             journal=self.journal)
+        # Fairness & quota plane (gateway/fairness.py): the ENFORCEMENT
+        # layer over the usage rollup — pick deprioritization (wired below
+        # as the scheduler's usage_advisor, a strict superset of the
+        # rollup's log-only seam) plus rank-weighted tenant quotas (wired
+        # into the handler core's admit() gate).  log_only (the default)
+        # keeps routing byte-identical.  Config precedence, per FIELD:
+        # explicit CLI flags (fairness_cfg as the overrides dict from
+        # bootstrap.fairness_from_args — pinned, re-applied on every hot
+        # reload) > the pool document's schedulerConfig.fairnessPolicy
+        # section (already parsed into the scheduler's live config;
+        # without this middle step the section would be dead until a hot
+        # reload) > defaults.  A full FairnessConfig (programmatic
+        # callers/tests) is the initial config, reloadable as a whole.
+        fairness_overrides = None
+        if isinstance(fairness_cfg, dict):
+            fairness_overrides, fairness_cfg = fairness_cfg, None
+        if fairness_cfg is None:
+            sched_cfg = getattr(
+                getattr(handler_server, "scheduler", None), "cfg", None)
+            fairness_cfg = getattr(sched_cfg, "fairness", None)
+        self.fairness = fairness_mod.FairnessPolicy(
+            self.usage, cfg=fairness_cfg, journal=self.journal,
+            provider=provider, cli_overrides=fairness_overrides)
         # Black-box dump directory + dump-storm cooldown; both env-tunable.
         self.blackbox_dir = (
             blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
@@ -185,11 +210,30 @@ class GatewayProxy:
         sched = getattr(outer, "_scheduler", outer)
         if sched is not None and hasattr(sched, "health_advisor"):
             sched.health_advisor = self.resilience
-        # Usage seam on the same pick path: LOG-ONLY (counts picks serving
-        # a flagged noisy model; routing byte-identical — the fairness
-        # analogue of the health scorer's pre-enforcement stage).
+        # Usage/fairness seam on the same pick path: the FairnessPolicy
+        # wraps the rollup (note_pick delegates, so log_only counts picks
+        # serving a flagged noisy key with routing byte-identical) and, in
+        # deprioritize/enforce, narrows survivor sets after the health
+        # filter.  The admission-side quota gate rides the handler core;
+        # the AdmissionController reference feeds fairnessPolicy
+        # hot-reloads from the pool document.
         if sched is not None and hasattr(sched, "usage_advisor"):
-            sched.usage_advisor = self.usage
+            sched.usage_advisor = self.fairness
+        if outer is not None and hasattr(outer, "fairness"):
+            outer.fairness = self.fairness
+        if hasattr(handler_server, "fairness"):
+            handler_server.fairness = self.fairness
+        elif self.fairness.mode != fairness_mod.LOG_ONLY:
+            # A multi-pool front (MultiPoolServer) has no fairness seams:
+            # the admit() gate lives on the per-pool inner servers this
+            # wrapper delegates to, and per-pool wiring is future work
+            # (ROADMAP).  Refuse to leave an enforcing config silently
+            # dead.
+            logger.warning(
+                "fairness mode=%s configured but %s has no fairness "
+                "seams — enforcement is INACTIVE (single-pool "
+                "deployments only)", self.fairness.mode,
+                type(handler_server).__name__)
         # Strong refs to in-flight KV-release tasks (the event loop only
         # keeps weak ones; see _spawn_release).
         self._release_tasks: set = set()
@@ -277,6 +321,7 @@ class GatewayProxy:
                 self.resilience.tick()  # health pass + breaker bookkeeping
                 self.slo.tick()
                 self.usage.tick()  # capacity shares + noisy-neighbor flags
+                self.fairness.tick()  # fair shares + tenant quota state
             except Exception:
                 logger.exception("observability tick failed")
 
@@ -327,14 +372,21 @@ class GatewayProxy:
 
     # -- request path ------------------------------------------------------
     def _error_response(self, status: int, message: str, kind: str,
-                        trace_id: str) -> web.Response:
+                        trace_id: str,
+                        headers: dict | None = None) -> web.Response:
         """Error envelope with the trace id in BOTH the body and the header
-        — failed requests are the ones most worth correlating."""
+        — failed requests are the ones most worth correlating.  429s get a
+        ``Retry-After`` hint (graceful-degradation contract: shed clients
+        back off instead of hammering a saturated pool)."""
+        all_headers = {tracing.TRACE_HEADER: trace_id, **(headers or {})}
+        if status == 429 and "Retry-After" not in all_headers:
+            all_headers["Retry-After"] = str(
+                max(1, int(self.fairness.cfg.retry_after_s)))
         return web.json_response(
             {"error": {"message": message, "type": kind,
                        "trace_id": trace_id}},
             status=status,
-            headers={tracing.TRACE_HEADER: trace_id},
+            headers=all_headers,
         )
 
     @staticmethod
@@ -551,11 +603,19 @@ class GatewayProxy:
         )
         return await (asyncio.wait_for(coro, ttft) if ttft > 0 else coro)
 
-    def _repick_pod(self, body: bytes, exclude: str):
+    def _repick_pod(self, body: bytes, exclude: str,
+                    demoted_to: str | None = None):
         """Scheduler re-pick for a hedge, on a throwaway context (runs in
         the executor).  None when admission fails or the pick lands on the
         pod already being hedged against."""
         ctx = RequestContext()
+        # A hedge probe must not spend the tenant's quota bucket again —
+        # the primary attempt already charged this client request — and
+        # must keep the primary's demotion: hedges fire under exactly the
+        # saturation quotas target, so an undemoted probe would restore
+        # the priority the quota removed.
+        ctx.fairness_charged = True
+        ctx.fairness_demoted_to = demoted_to
         try:
             result = self.server.process(ctx, RequestBody(body=body))
         except ProcessingError:
@@ -566,7 +626,8 @@ class GatewayProxy:
 
     async def _post_with_hedge(self, request, pod, raw_body: bytes,
                                out_body: bytes, request_id: str,
-                               trace_id: str):
+                               trace_id: str,
+                               demoted_to: str | None = None):
         """TTFT-based hedge: when the primary hasn't produced response
         headers within ``hedge_ttft_s``, re-pick a different replica and
         race a second identical request; first success wins, the loser is
@@ -580,7 +641,7 @@ class GatewayProxy:
             return primary.result(), pod, None  # may raise; caller classifies
         loop = asyncio.get_running_loop()
         hedge_pod = await loop.run_in_executor(
-            None, self._repick_pod, raw_body, pod.name)
+            None, self._repick_pod, raw_body, pod.name, demoted_to)
         if hedge_pod is None:
             self.metrics.record_hedge("no_candidate")
             return (await primary), pod, None
@@ -658,7 +719,8 @@ class GatewayProxy:
         try:
             if hedge_ok:
                 upstream, pod, hedge_outcome = await self._post_with_hedge(
-                    request, pod, raw_body, out_body, request_id, trace_id)
+                    request, pod, raw_body, out_body, request_id, trace_id,
+                    demoted_to=req_ctx.fairness_demoted_to)
             else:
                 upstream = await self._post_upstream(
                     request.path, pod, out_body, request_id, trace_id)
@@ -1138,6 +1200,7 @@ class GatewayProxy:
         text = self.metrics.render()
         extra = (self.slo.render() + self.health.render()
                  + self.resilience.render() + self.usage.render()
+                 + self.fairness.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -1177,10 +1240,13 @@ class GatewayProxy:
         """Pool-wide capacity attribution: per-{model, adapter} consumption
         shares, admitted-traffic shares, noisy-neighbor scores/flags, and
         pool-waste aggregates (gateway/usage.py; rendered live by
-        ``tools/lig_top.py``).  Floored at the configured cadence — the
-        enter/exit hysteresis counts rollup passes."""
+        ``tools/lig_top.py``) — plus the fairness plane's throttle and
+        demotion state (gateway/fairness.py).  Floored at the configured
+        cadence — the enter/exit hysteresis counts rollup passes."""
         self.usage.maybe_tick(max(1.0, self.obs_tick_s))
-        return web.json_response(self.usage.debug_payload())
+        payload = self.usage.debug_payload()
+        payload["fairness"] = self.fairness.debug_payload()
+        return web.json_response(payload)
 
     async def handle_debug_events(self, request: web.Request) -> web.Response:
         """The flight recorder: ``?since=<seq>`` incremental cursor,
@@ -1218,6 +1284,7 @@ def main(argv: list[str] | None = None) -> None:
     comps = bootstrap.components_from_args(args)
     proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore,
                          resilience_cfg=bootstrap.resilience_from_args(args),
+                         fairness_cfg=bootstrap.fairness_from_args(args),
                          fast_relay=not args.no_fast_relay)
     try:
         web.run_app(proxy.build_app(), port=args.port)

@@ -55,12 +55,41 @@ class TierQueues:
     def depths(self) -> dict[str, int]:
         return {t: len(q) for t, q in self._queues.items()}
 
-    def push(self, tier: str, item) -> bool:
-        """False = full (caller sheds immediately)."""
+    def push(self, tier: str, item) -> tuple[bool, object | None]:
+        """``(accepted, evicted)``.
+
+        At ``max_depth`` a higher-weight arrival no longer sheds while
+        lower-weight items sit queued (the full-queue inversion): the
+        NEWEST item of the lowest-weight non-empty tier below the
+        arrival's weight is evicted to make room — the caller sheds the
+        evicted waiter (its transport journals the ``shed``).  With no
+        lower-weight occupant the arrival is refused as before
+        (``(False, None)``)."""
         if self.depth() >= self.cfg.max_depth:
-            return False
+            evicted = self._evict_below(tier)
+            if evicted is None:
+                return False, None
+            self._queues.setdefault(tier, deque()).append(item)
+            return True, evicted
         self._queues.setdefault(tier, deque()).append(item)
-        return True
+        return True, None
+
+    def _evict_below(self, tier: str):
+        """Pop the newest item of the lowest-weight non-empty tier whose
+        weight is strictly below ``tier``'s (unlisted tiers weigh the
+        highest configured weight, matching pop_weighted)."""
+        top = max(self._weights.values(), default=1.0)
+        w_new = self._weights.get(tier, top)
+        victim, w_victim = None, None
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            w = self._weights.get(t, top)
+            if w < w_new and (w_victim is None or w < w_victim):
+                victim, w_victim = t, w
+        if victim is None:
+            return None
+        return self._queues[victim].pop()
 
     def pop_weighted(self):
         """Draw a non-empty tier by weight; FIFO within the tier.
@@ -89,6 +118,7 @@ class _Waiter:
     event: threading.Event = field(default_factory=threading.Event)
     pod: object = None
     expired: bool = False  # transport gave up; drain thread must skip it
+    evicted: bool = False  # bumped by a higher-weight arrival at max_depth
 
 
 class AdmissionController:
@@ -117,6 +147,11 @@ class AdmissionController:
         # thread).  Keeps a hot-reload that enables admission from parking
         # more waiters than the already-sized worker pool can absorb.
         self._park_budget: int | None = None
+        # Fairness/quota plane (gateway/fairness.py, wired by the proxy):
+        # update_config pushes the pool document's fairnessPolicy section
+        # into it; the admit() gate itself runs in the handler core so
+        # bare-scheduler deployments get it too.
+        self.fairness = None
         if self._cfg.enabled:
             self._arm()
 
@@ -185,10 +220,20 @@ class AdmissionController:
             with self._lock:
                 over_budget = (self._park_budget is not None
                                and self._queues.depth() >= self._park_budget)
-                if over_budget or not self._queues.push(tier, waiter):
+                if over_budget:
                     raise SchedulingError(
                         "admission queue full; dropping request due to "
                         "limited backend resources", shed=True) from e
+                accepted, evicted = self._queues.push(tier, waiter)
+                if not accepted:
+                    raise SchedulingError(
+                        "admission queue full; dropping request due to "
+                        "limited backend resources", shed=True) from e
+            if evicted is not None:
+                # A lower-tier waiter made room: wake its transport thread
+                # with no pod so it sheds (429) now instead of timing out.
+                evicted.evicted = True
+                evicted.event.set()
             self._work.set()
             t_park = time.monotonic()
             if waiter.event.wait(self._cfg.max_wait_s) and waiter.pod is not None:
@@ -198,6 +243,14 @@ class AdmissionController:
                 llm_req.admission_wait_s = time.monotonic() - t_park
                 return waiter.pod
             waiter.expired = True
+            if waiter.evicted:
+                # Keep the shed reason truthful: this waiter did NOT
+                # consume the wait window — a higher-criticality arrival
+                # took its queue slot.
+                raise SchedulingError(
+                    "evicted from admission queue (higher-criticality "
+                    "arrival or queue reshape); dropping request",
+                    shed=True) from e
             raise SchedulingError(
                 f"no capacity within {self._cfg.max_wait_s:.0f}s admission "
                 "wait; dropping request", shed=True) from e
@@ -206,19 +259,30 @@ class AdmissionController:
         """Hot-reload seam (pool on_update): thresholds go to the wrapped
         scheduler; the admissionQueue section re-arms this controller."""
         self._scheduler.update_config(scheduler_cfg)
+        fairness_cfg = getattr(scheduler_cfg, "fairness", None)
+        if fairness_cfg is not None and self.fairness is not None:
+            self.fairness.update_config(fairness_cfg)
         admission = getattr(scheduler_cfg, "admission", None)
         if admission is not None and admission != self._cfg:
             with self._lock:
                 self._cfg = admission
                 old = self._queues
                 self._queues = TierQueues(admission, self._rng)
-                # Re-park waiters under the new shape (overflow sheds via
-                # their own timeouts).
+                # Re-park waiters under the new shape; ones that no longer
+                # fit (or get evicted by higher-weight re-parks) shed now.
+                bumped = []
                 while True:
                     w = old.pop_weighted()
                     if w is None:
                         break
-                    self._queues.push(w.tier, w)
+                    accepted, evicted = self._queues.push(w.tier, w)
+                    if not accepted:
+                        bumped.append(w)
+                    if evicted is not None:
+                        bumped.append(evicted)
+            for w in bumped:
+                w.evicted = True
+                w.event.set()  # pod is None: the transport sheds it
             logger.info("admission queue config updated: %s", admission)
         if self._cfg.enabled:
             self._arm()  # no-op if already armed; builds drain lazily

@@ -13,6 +13,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from llm_instance_gateway_tpu.gateway.fairness import (
+    FAIRNESS_MODES,
+    FairnessConfig,
+)
+
 
 @dataclass(frozen=True)
 class AdmissionConfig:
@@ -64,6 +69,10 @@ class SchedulerConfig:
     # non-critical traffic, the reference sim's 'smart' policy brought to
     # the live gateway.
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # Fairness & quota plane (gateway/fairness.py): usage-driven pick
+    # deprioritization and rank-weighted tenant quotas, hot-reloadable
+    # through the same pool document as the thresholds.
+    fairness: FairnessConfig = field(default_factory=FairnessConfig)
 
 
 DEFAULT_CONFIG = SchedulerConfig()
@@ -99,6 +108,51 @@ def drain_scaled(cfg: SchedulerConfig) -> SchedulerConfig:
         kv_cache_threshold=cfg.kv_cache_threshold * m,
         queue_threshold_critical=max(1, int(cfg.queue_threshold_critical * m)),
     )
+
+
+_FAIRNESS_KEYS = {
+    "mode": ("mode", str),
+    "overRatio": ("over_ratio", float),
+    "maxShare": ("max_share", float),
+    "quotaRps": ("quota_rps", float),
+    "quotaBurst": ("quota_burst", float),
+    "rankBase": ("rank_base", int),
+    "retryAfterSeconds": ("retry_after_s", float),
+}
+
+
+def _parse_fairness(section) -> FairnessConfig:
+    if not isinstance(section, dict):
+        raise ValueError(
+            f"fairnessPolicy must be a mapping, got {section!r}")
+    unknown = set(section) - set(_FAIRNESS_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown fairnessPolicy keys {sorted(unknown)}; "
+            f"valid: {sorted(_FAIRNESS_KEYS)}")
+    import dataclasses
+
+    kwargs = {}
+    for doc_key, (field_name, kind) in _FAIRNESS_KEYS.items():
+        if doc_key not in section:
+            continue
+        raw = section[doc_key]
+        if kind is str:
+            if raw not in FAIRNESS_MODES:
+                raise ValueError(
+                    f"fairnessPolicy mode must be one of "
+                    f"{FAIRNESS_MODES}, got {raw!r}")
+            kwargs[field_name] = raw
+        else:
+            try:
+                value = float(raw)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"{doc_key} must be a number, got {raw!r}") from e
+            if value <= 0:
+                raise ValueError(f"{doc_key} must be positive, got {raw!r}")
+            kwargs[field_name] = int(value) if kind is int else value
+    return dataclasses.replace(FairnessConfig(), **kwargs)
 
 
 def _parse_admission(section) -> AdmissionConfig:
@@ -151,17 +205,20 @@ def from_pool_spec(overrides: dict) -> SchedulerConfig:
     """
     if not overrides:
         return DEFAULT_CONFIG
-    unknown = set(overrides) - set(_POOL_KEYS) - {"admissionQueue"}
+    unknown = (set(overrides) - set(_POOL_KEYS)
+               - {"admissionQueue", "fairnessPolicy"})
     if unknown:
         raise ValueError(
             f"unknown schedulerConfig keys {sorted(unknown)}; "
-            f"valid: {sorted(_POOL_KEYS) + ['admissionQueue']}"
+            f"valid: {sorted(_POOL_KEYS) + ['admissionQueue', 'fairnessPolicy']}"
         )
     import dataclasses
 
     kwargs = {}
     if "admissionQueue" in overrides:
         kwargs["admission"] = _parse_admission(overrides["admissionQueue"])
+    if "fairnessPolicy" in overrides:
+        kwargs["fairness"] = _parse_fairness(overrides["fairnessPolicy"])
     for doc_key, field_name in _POOL_KEYS.items():
         if doc_key in overrides:
             current = getattr(DEFAULT_CONFIG, field_name)

@@ -47,6 +47,7 @@ from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
     Scheduler,
     SchedulingError,
     build_decode_tree,
+    filter_by_fairness,
     filter_by_policy,
     split_pool_roles,
 )
@@ -65,6 +66,9 @@ _NATIVE_DIR = os.path.join(
     "native",
 )
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libligsched.so")
+# Must match scheduler.cc's lig_abi_version() — bumped on any exported-
+# signature change so a stale prebuilt .so is refused, not miscalled.
+_ABI_VERSION = 2
 
 LIG_SHED = -1
 LIG_ERROR = -2
@@ -73,6 +77,10 @@ LIG_SHED_STRICT = -3
 # filter_by_policy parity: the policy string marshals to a native mode code
 # at snapshot-update time (log_only never filters natively either).
 _POLICY_CODE = {"log_only": 0, "avoid": 1, "strict": 2}
+# filter_by_fairness parity: deprioritize and enforce share the pick-seam
+# narrowing; enforce's extra semantics (admission quotas) live entirely in
+# Python (gateway/fairness.py), so the native code is binary.
+_FAIRNESS_CODE = {"log_only": 0, "deprioritize": 1, "enforce": 1}
 
 _SHED_MSG = ("failed to apply filter, resulted 0 pods: dropping request due "
              "to limited backend resources")
@@ -106,6 +114,20 @@ def _load_library():
             logger.warning("native scheduler load failed: %s", e)
             return None
         try:
+            # Version handshake BEFORE any argtype wiring: a prebuilt .so
+            # from an older tree can pass the mtime staleness check, and
+            # the AttributeError guard below only catches MISSING symbols
+            # — an arity change on an existing one would scramble
+            # arguments in the routing hot path.  Mismatch (or a pre-
+            # handshake library without the symbol) falls back to Python.
+            lib.lig_abi_version.restype = ctypes.c_int32
+            lib.lig_abi_version.argtypes = []
+            abi = lib.lig_abi_version()
+            if abi != _ABI_VERSION:
+                logger.warning(
+                    "native scheduler ABI %d != expected %d; "
+                    "falling back to Python", abi, _ABI_VERSION)
+                return None
             lib.lig_state_new.restype = ctypes.c_void_p
             lib.lig_state_new.argtypes = []
             lib.lig_state_free.restype = None
@@ -121,17 +143,18 @@ def _load_library():
                 ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_double, ctypes.c_int32,
                 ctypes.c_uint8, ctypes.c_uint8,     # token/prefill aware
-                ctypes.c_uint8,                     # policy mode
+                ctypes.c_uint8, ctypes.c_uint8,     # policy/fairness modes
             ]
             lib.lig_pick.restype = ctypes.c_int32
             lib.lig_pick.argtypes = [
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint8,
-                ctypes.c_int64, _i32p, _u8p,
+                ctypes.c_uint8, ctypes.c_int64, _i32p, _u8p,
             ]
             lib.lig_pick_many.restype = ctypes.c_int32
             lib.lig_pick_many.argtypes = [
                 ctypes.c_void_p, ctypes.c_int32,
-                _i32p, _u8p, _i64p,   # adapter_ids, criticals, prompt_tokens
+                _i32p, _u8p, _u8p,    # adapter_ids, criticals, req_noisies
+                _i64p,                # prompt_tokens
                 _i32p, _i32p, _u8p,   # out_counts, out_cands, out_flags
             ]
         except AttributeError as e:
@@ -154,15 +177,16 @@ def _ptr(arr: np.ndarray, ctype):
 class _NativeState:
     """One native snapshot handle + the Python-side cache keys guarding it."""
 
-    __slots__ = ("handle", "key", "avoid", "out", "intern", "_finalizer",
-                 "__weakref__")
+    __slots__ = ("handle", "key", "avoid", "noisy", "out", "intern",
+                 "_finalizer", "__weakref__")
 
     def __init__(self, lib):
         self.handle = lib.lig_state_new()
         if not self.handle:
             raise RuntimeError("lig_state_new failed")
-        self.key = None          # (version, n_pods, policy, cfg_gen)
+        self.key = None          # (version, n_pods, policy, fairness, cfg_gen)
         self.avoid = None        # frozenset marshalled into the avoid marks
+        self.noisy = frozenset()  # noisy names marshalled into the marks
         self.out = np.empty(0, np.int32)  # persistent candidate buffer
         # Adapter interning for THIS state's residency CSR: name -> dense
         # id, rebuilt from scratch at every marshal so the table (and the
@@ -237,12 +261,14 @@ class NativeScheduler:
         # exact); avoid/strict marshal the advisor's avoid_set into the
         # native snapshot so policy filtering costs zero extra crossings.
         self.health_advisor = None
-        # Usage seam (gateway/usage.py) — log-only pick counting, same
-        # contract as the Python Scheduler's usage_advisor.  The noisy
-        # marks ALSO ride the native snapshot (per-adapter bits, refreshed
-        # at marshal time) so a future enforcing fairness policy is one
-        # policy-mode away, but the log-only counter keeps reading the
-        # advisor's live set for byte-exact parity with the Python path.
+        # Usage/fairness seam (gateway/usage.py + gateway/fairness.py) —
+        # same contract as the Python Scheduler's usage_advisor.  The
+        # noisy marks ride the native snapshot (per-adapter bits + per-pod
+        # hog bits, refreshed whenever the advisor's noisy set moves); a
+        # FairnessPolicy in deprioritize/enforce narrows candidates
+        # NATIVELY (filter_by_fairness parity, fairness escape on flag
+        # bit 2), while log_only keeps byte-exact parity with the Python
+        # path and only counts flagged picks.
         self.usage_advisor = None
 
     # -- marshalling --------------------------------------------------------
@@ -260,8 +286,25 @@ class NativeScheduler:
             return policy, frozenset(batch())
         return policy, None  # per-pod should_avoid: no cheap change signal
 
+    def _fairness_and_noisy(self) -> tuple[str, frozenset]:
+        """The usage advisor's fairness mode + live noisy-name set (both
+        cheap cached reads on the FairnessPolicy/UsageRollup).  A bare
+        rollup has no mode — log_only, marks still marshalled for the
+        flag-bit observable."""
+        usage = self.usage_advisor
+        if usage is None:
+            return "log_only", frozenset()
+        mode = getattr(usage, "mode", "log_only")
+        if mode not in _FAIRNESS_CODE:
+            mode = "log_only"
+        get_noisy = getattr(usage, "noisy", None)
+        noisy = frozenset(get_noisy()) if get_noisy is not None \
+            else frozenset()
+        return mode, noisy
+
     def _marshal(self, state: _NativeState, pods: list[PodMetrics],
-                 policy: str, bad: frozenset | None) -> None:
+                 policy: str, bad: frozenset | None, fairness: str,
+                 noisy_names: frozenset) -> None:
         """Push the full routable world into ``state`` (tick-time cost)."""
         n = len(pods)
         waiting = np.fromiter(
@@ -306,14 +349,10 @@ class NativeScheduler:
         res_ids = np.asarray(ids, dtype=np.int32)
         n_adapters = len(table)
         noisy = np.zeros(max(1, n_adapters), np.uint8)
-        usage = self.usage_advisor
-        if usage is not None:
-            get_noisy = getattr(usage, "noisy", None)
-            if get_noisy is not None:
-                for name in get_noisy():
-                    aid = table.get(name)
-                    if aid is not None:
-                        noisy[aid] = 1
+        for name in noisy_names:
+            aid = table.get(name)
+            if aid is not None:
+                noisy[aid] = 1
         rc = self._lib.lig_state_update(
             self._void(state), n,
             _ptr(waiting, ctypes.c_int32), _ptr(prefill, ctypes.c_int32),
@@ -331,12 +370,14 @@ class NativeScheduler:
             1 if self.token_aware else 0,
             1 if self.prefill_aware else 0,
             _POLICY_CODE.get(policy, 0),
+            _FAIRNESS_CODE.get(fairness, 0),
         )
         if rc != 0:
             raise SchedulingError(f"native state update failed ({rc})")
         if state.out.shape[0] < n:
             state.out = np.empty(n, np.int32)
         state.avoid = bad
+        state.noisy = noisy_names
         state.intern = table
 
     @staticmethod
@@ -355,18 +396,24 @@ class NativeScheduler:
         """
         if policy_mode:
             policy, bad = self._policy_and_avoid()
+            fairness, noisy = self._fairness_and_noisy()
         else:
             policy, bad = "log_only", frozenset()
+            fairness, noisy = "log_only", frozenset()
         if version is None:
-            self._marshal(self._scratch, pods, policy, bad)
+            self._marshal(self._scratch, pods, policy, bad, fairness, noisy)
             self._scratch.key = None
             return self._scratch
         state = self._state
-        key = (version, len(pods), policy, self._cfg_gen)
+        key = (version, len(pods), policy, fairness, self._cfg_gen)
         # ``bad is None`` = an advisor with per-pod should_avoid only (no
         # batch set to compare): no cheap change signal, so re-marshal.
-        if state.key != key or bad is None or state.avoid != bad:
-            self._marshal(state, pods, policy, bad)
+        # The noisy-name set is compared like the avoid set — a rollup
+        # flag transition between provider versions must reach the
+        # resident marks.
+        if (state.key != key or bad is None or state.avoid != bad
+                or state.noisy != noisy):
+            self._marshal(state, pods, policy, bad, fairness, noisy)
             state.key = key
         return state
 
@@ -394,7 +441,12 @@ class NativeScheduler:
         flags = ctypes.c_uint8(0)
         count = self._lib.lig_pick(
             self._void(state), adapter_id,
-            1 if req.critical else 0, req.prompt_tokens,
+            1 if req.critical else 0,
+            # Request-noisy matched against the MARSHALLED name set (the
+            # same set the per-pod hog bits were computed from), mirroring
+            # note_pick's req.model matching.
+            1 if req.model in state.noisy else 0,
+            req.prompt_tokens,
             _ptr(state.out, ctypes.c_int32), ctypes.byref(flags))
         if count == LIG_SHED:
             raise SchedulingError(_SHED_MSG, shed=True)
@@ -453,6 +505,12 @@ class NativeScheduler:
             note = getattr(advisor, "note_escape_hatch", None)
             if note is not None:
                 note()
+        if flags & 4 and self.usage_advisor is not None:
+            # Fairness escape hatch: every candidate hosted a flagged
+            # adapter (scheduler.py filter_by_fairness parity).
+            note = getattr(self.usage_advisor, "note_fairness_escape", None)
+            if note is not None:
+                note()
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, [pods[i] for i in cand])
@@ -499,11 +557,15 @@ class NativeScheduler:
         with self._call_lock:
             state = self._ensure_state(version, pods)
             intern = state.intern
+            noisy = state.noisy
             adapter_ids = np.fromiter(
                 (intern.get(r.resolved_target_model, -1) for r in reqs),
                 np.int32, n_reqs)
             criticals = np.fromiter(
                 (1 if r.critical else 0 for r in reqs), np.uint8, n_reqs)
+            req_noisies = np.fromiter(
+                (1 if r.model in noisy else 0 for r in reqs),
+                np.uint8, n_reqs)
             prompt_tokens = np.fromiter(
                 (r.prompt_tokens for r in reqs), np.int64, n_reqs)
             counts = np.empty(n_reqs, np.int32)
@@ -513,6 +575,7 @@ class NativeScheduler:
                 self._void(state), n_reqs,
                 _ptr(adapter_ids, ctypes.c_int32),
                 _ptr(criticals, ctypes.c_uint8),
+                _ptr(req_noisies, ctypes.c_uint8),
                 _ptr(prompt_tokens, ctypes.c_int64),
                 _ptr(counts, ctypes.c_int32), _ptr(cands, ctypes.c_int32),
                 _ptr(flags, ctypes.c_uint8))
@@ -558,6 +621,8 @@ class NativeScheduler:
                 shed=e.shed) from e
         decode_survivors = filter_by_policy(
             self.health_advisor, decode_survivors)
+        decode_survivors = filter_by_fairness(
+            self.usage_advisor, req, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:

@@ -118,6 +118,60 @@ def filter_by_policy(advisor, candidates: list, name_of=None) -> list:
     return candidates
 
 
+def filter_by_fairness(advisor, req: "LLMRequest", candidates: list,
+                       active_of=None) -> list:
+    """Apply the fairness advisor's pick deprioritization over a candidate
+    set (``gateway/fairness.py:FairnessPolicy``); schedulers call this
+    AFTER ``filter_by_policy``, BEFORE the prefix tie-break and RNG draw.
+
+    - ``log_only`` (or no advisor / a bare UsageRollup without a mode):
+      returns ``candidates`` UNCHANGED — the byte-identical guarantee the
+      same-RNG diff tests pin.
+    - ``deprioritize`` / ``enforce``: pods hosting a currently-flagged
+      noisy adapter are *marked*.  A quiet request narrows to unmarked
+      survivors (isolation: the flood can't degrade cotenants on its
+      replicas); when EVERY candidate is marked the full set comes back
+      and ``note_fairness_escape`` fires — the same counted last-resort
+      shape as ``filter_by_policy``.  A request whose OWN key is flagged
+      narrows to the marked pods instead (containment: the flood keeps
+      its existing replicas but can't claim fresh ones); no marked
+      candidate is not an escape — there is nothing to avoid.
+
+    ``active_of`` maps a candidate to its resident-adapter names (defaults
+    to the ``PodMetrics`` shape; the native scheduler's candidate indices
+    are resolved before this runs, so both paths share this function).
+    An advisor exposing ``noisy_pods`` (FairnessPolicy) serves the mark
+    set from a per-tick cache instead — one frozenset membership test per
+    candidate on the hot path (the <5% ``pick_fairness_ratio`` bound).
+    """
+    if advisor is None or not candidates:
+        return candidates
+    if getattr(advisor, "mode", "log_only") == "log_only":
+        return candidates
+    flagged = advisor.noisy()
+    if not flagged:
+        return candidates
+    get_marked = getattr(advisor, "noisy_pods", None)
+    marked = get_marked() if get_marked is not None else None
+    if marked is not None:
+        hosts = [c.pod.name in marked for c in candidates]
+    else:
+        if active_of is None:
+            active_of = lambda pm: pm.metrics.active_adapters  # noqa: E731
+        hosts = [any(a in flagged for a in active_of(c))
+                 for c in candidates]
+    if req.model in flagged:
+        preferred = [c for c, h in zip(candidates, hosts) if h]
+        return preferred or candidates
+    preferred = [c for c, h in zip(candidates, hosts) if not h]
+    if preferred:
+        return preferred
+    note = getattr(advisor, "note_fairness_escape", None)
+    if note is not None:
+        note()
+    return candidates
+
+
 def _drop_filter() -> Filter:
     def drop(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
         raise FilterError(
@@ -302,12 +356,14 @@ class Scheduler:
         # ``strict`` (gateway/resilience.py) the survivor set additionally
         # passes through ``filter_by_policy`` before the tie-break/draw.
         self.health_advisor = None
-        # Usage seam (gateway/usage.py, set by the proxy): LOG-ONLY —
-        # ``note_pick`` counts picks that serve a currently-flagged noisy
-        # model into gateway_usage_would_deprioritize_total.  No RNG, no
-        # filtering: routing byte-identical with the seam attached (pinned
-        # by the same-RNG diff test), so a future fairness-routing policy
-        # has the observable before it has the enforcement.
+        # Usage/fairness seam (gateway/usage.py + gateway/fairness.py, set
+        # by the proxy).  A bare UsageRollup (or a FairnessPolicy in
+        # ``log_only``) only counts flagged picks into
+        # gateway_usage_would_deprioritize_total — no RNG, no filtering,
+        # routing byte-identical (pinned by same-RNG diff tests).  A
+        # FairnessPolicy in ``deprioritize``/``enforce`` additionally runs
+        # the survivor set through ``filter_by_fairness`` after the health
+        # policy filter and before the tie-break/draw.
         self.usage_advisor = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
@@ -347,8 +403,10 @@ class Scheduler:
     def _pick(self, req: LLMRequest, survivors: Sequence[PodMetrics]) -> Pod:
         # Enforcing health policy narrows the candidate set FIRST, so the
         # prefix-affinity tie-break can't pin a request to an avoided
-        # holder (log_only returns the set unchanged).
+        # holder (log_only returns the set unchanged); fairness
+        # deprioritization runs over whatever survives it.
         survivors = filter_by_policy(self.health_advisor, list(survivors))
+        survivors = filter_by_fairness(self.usage_advisor, req, survivors)
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, survivors)
@@ -405,6 +463,8 @@ class Scheduler:
                 shed=e.shed) from e
         decode_survivors = filter_by_policy(
             self.health_advisor, decode_survivors)
+        decode_survivors = filter_by_fairness(
+            self.usage_advisor, req, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:

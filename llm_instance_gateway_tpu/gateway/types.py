@@ -66,6 +66,10 @@ class Metrics:
 
     active_adapters: dict[str, int] = field(default_factory=dict)
     max_active_adapters: int = 0
+    # Resident adapter -> LoRA rank (tpu:lora_requests_info adapter_ranks
+    # label): the heterogeneity signal rank-aware fair-share weighting
+    # consumes (gateway/fairness.py).  Empty for foreign servers.
+    adapter_ranks: dict[str, int] = field(default_factory=dict)
     # Queue depths.  ``waiting_queue_size`` mirrors the reference's vLLM
     # num_requests_waiting; on TPU it is prefill_queue + decode_waiting.
     running_queue_size: int = 0
@@ -109,6 +113,7 @@ class Metrics:
     def clone(self) -> "Metrics":
         m = dataclasses.replace(self)
         m.active_adapters = dict(self.active_adapters)
+        m.adapter_ranks = dict(self.adapter_ranks)
         m.adapter_step_seconds = dict(self.adapter_step_seconds)
         m.adapter_tokens = dict(self.adapter_tokens)
         m.adapter_kv_block_seconds = dict(self.adapter_kv_block_seconds)

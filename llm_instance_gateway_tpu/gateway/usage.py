@@ -29,11 +29,13 @@ Mechanics (one ``tick()`` per provider scrape/observability cadence):
   ``gateway/health.py``.  Transitions journal ``noisy_neighbor`` events
   into the flight recorder.
 
-The scheduler seam is **log-only** (``note_pick``): picks serving a
-currently-flagged model only count into
-``gateway_usage_would_deprioritize_total`` — no RNG, no filtering, routing
-byte-identical (pinned by the same-RNG diff test in tests/test_usage.py)
-— so a future fairness-routing PR has the observable ready.
+The rollup itself stays **observational** (``note_pick`` counts picks
+serving a currently-flagged key into
+``gateway_usage_would_deprioritize_total{model,adapter}`` — no RNG, no
+filtering, routing byte-identical, pinned by the same-RNG diff test in
+tests/test_usage.py).  Enforcement lives one layer up:
+``gateway/fairness.py`` wraps this rollup and promotes the seam to
+deprioritizing picks and gating admission when its mode asks for it.
 """
 
 from __future__ import annotations
@@ -43,7 +45,7 @@ import time
 from dataclasses import asdict, dataclass
 
 from llm_instance_gateway_tpu import events as events_mod
-from llm_instance_gateway_tpu.tracing import escape_label, render_counter
+from llm_instance_gateway_tpu.tracing import escape_label, render_keyed_family
 
 BASE = "base"
 QUIET, NOISY = "quiet", "noisy"
@@ -90,12 +92,17 @@ class UsageRollup:
         self._totals: dict[str, dict] = {r: {} for r in RESOURCES}
         self._pool_waste: dict[str, float] = {}
         # Cached flagged model/adapter names for the log-only pick seam
-        # (frozenset read without the lock, like health.non_healthy()).
+        # (frozenset read without the lock, like health.non_healthy()),
+        # plus the name -> (model, adapter) map so the would-deprioritize
+        # counter attributes throttle candidates to the actual offender.
         self._noisy_models: frozenset = frozenset()
+        self._noisy_key_of: dict[str, tuple] = {}
         self.last_tick = 0.0
         self.ticks = 0
         self.would_deprioritize_total = 0
-        self.would_deprioritize: dict[str, int] = {}
+        # Keyed by (model, adapter) — the key that flagged, not just the
+        # request name note_pick matched.
+        self.would_deprioritize: dict[tuple, int] = {}
 
     # -- rollup --------------------------------------------------------------
     @staticmethod
@@ -259,10 +266,11 @@ class UsageRollup:
             # Flagged names for the pick seam: base-tenant requests arrive
             # under the served MODEL name, adapter traffic under the
             # adapter name — store whichever note_pick will actually see.
-            self._noisy_models = frozenset(
-                (model if adapter == BASE else adapter)
+            self._noisy_key_of = {
+                (model if adapter == BASE else adapter): (model, adapter)
                 for (model, adapter), st in self._states.items()
-                if st == NOISY)
+                if st == NOISY}
+            self._noisy_models = frozenset(self._noisy_key_of)
         for key, frm, to, score, share in transitions:
             if self.journal is not None:
                 self.journal.emit(events_mod.NOISY_NEIGHBOR,
@@ -276,16 +284,38 @@ class UsageRollup:
         routing stays byte-identical with the seam attached (same-RNG diff
         test in tests/test_usage.py); a future fairness policy promotes
         this observable the way health_policy promoted note_pick."""
-        if model is None or model not in self._noisy_models:
+        if model is None:
+            return
+        key = self._noisy_key_of.get(model)
+        if key is None:
             return
         with self._lock:
             self.would_deprioritize_total += 1
-            self.would_deprioritize[model] = (
-                self.would_deprioritize.get(model, 0) + 1)
+            self.would_deprioritize[key] = (
+                self.would_deprioritize.get(key, 0) + 1)
 
     def noisy(self) -> frozenset:
         """Currently-flagged adapter/model names (cached; lock-free read)."""
         return self._noisy_models
+
+    def seed_noisy(self, model: str, adapter: str) -> None:
+        """Bench/test seam: flag one ``{model, adapter}`` key directly.
+        The flag state lives in three coupled tables (``_states``,
+        ``_noisy_key_of``, ``_noisy_models`` — ``tick`` rebuilds the
+        latter two from the first), so external seeding must go through
+        here rather than poking the fields individually."""
+        name = model if adapter == BASE else adapter
+        with self._lock:
+            self._states[(model, adapter)] = NOISY
+            self._noisy_key_of[name] = (model, adapter)
+            self._noisy_models = frozenset(self._noisy_key_of)
+
+    def shares_snapshot(self) -> dict:
+        """Locked copy of the step-seconds EMA shares keyed by
+        ``(model, adapter)`` — the fairness plane's quota input
+        (gateway/fairness.py)."""
+        with self._lock:
+            return dict(self._shares["step_seconds"])
 
     # -- export ---------------------------------------------------------------
     def render(self) -> list[str]:
@@ -314,8 +344,9 @@ class UsageRollup:
                     'gateway_noisy_neighbor_score{model="%s",adapter="%s"} '
                     '%.4f' % (escape_label(model), escape_label(adapter),
                               scores[(model, adapter)]))
-        lines += render_counter("gateway_usage_would_deprioritize_total",
-                                would, "model")
+        lines += render_keyed_family(
+            "gateway_usage_would_deprioritize_total", would,
+            ("model", "adapter"))
         return lines
 
     def debug_payload(self) -> dict:

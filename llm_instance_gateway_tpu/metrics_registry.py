@@ -123,9 +123,23 @@ GATEWAY_FAMILIES = (
            "Step-seconds consumption share over admitted-traffic share "
            "(1.0 = proportional; flags noisy past the configured ratio "
            "with hysteresis).", GATEWAY_SURFACE),
-    Family("gateway_usage_would_deprioritize_total", "counter", ("model",),
-           "Picks that served a currently-flagged noisy model (log-only "
-           "usage seam; routing unchanged).", GATEWAY_SURFACE),
+    Family("gateway_usage_would_deprioritize_total", "counter",
+           ("model", "adapter"),
+           "Picks that served a currently-flagged noisy key, attributed "
+           "to the flagged {model, adapter} (with fairness mode log_only "
+           "routing is otherwise unchanged).", GATEWAY_SURFACE),
+    Family("gateway_quota_throttles_total", "counter", ("model", "adapter"),
+           "Admissions that found the tenant's fairness quota bucket "
+           "empty (gateway/fairness.py, mode=enforce).", GATEWAY_SURFACE),
+    Family("gateway_fairness_demotions_total", "counter",
+           ("model", "adapter"),
+           "Over-quota requests demoted one criticality tier (Critical -> "
+           "Default -> Sheddable; graceful degradation instead of a hard "
+           "shed).", GATEWAY_SURFACE),
+    Family("gateway_tenant_quota_remaining", "gauge", ("model", "adapter"),
+           "Remaining fairness-quota bucket tokens per throttled tenant "
+           "(refill --fairness-quota-rps/s, cost scaled by LoRA rank).",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),
@@ -153,10 +167,12 @@ SERVER_FAMILIES = (
     Family("tpu:decode_tokens_per_sec", "gauge", (),
            "Recent decode throughput (EMA).", SERVER_SURFACE),
     Family("tpu:lora_requests_info", "gauge",
-           ("running_lora_adapters", "waiting_lora_adapters", "max_lora"),
+           ("running_lora_adapters", "waiting_lora_adapters", "max_lora",
+            "adapter_ranks"),
            "Adapter-activity info gauge (vLLM semantics: running = "
            "actively decoding, waiting = parked in decode_wait / queued); "
-           "value is a unix timestamp (latest series wins).",
+           "adapter_ranks is a name:rank CSV (rank-aware fairness "
+           "weighting); value is a unix timestamp (latest series wins).",
            SERVER_SURFACE),
     Family("tpu:pool_role", "gauge", ("role",),
            "Disaggregation role info gauge (collocated | prefill | "

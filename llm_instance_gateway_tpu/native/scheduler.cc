@@ -30,9 +30,15 @@
 //
 //    Usage-deprioritization marks (gateway/usage.py noisy set) ride the
 //    snapshot as per-adapter bits; a pick whose adapter is marked returns
-//    flag bit 1 — the log-only observable stays in Python, the mark is
-//    resident here so a future enforcing fairness policy has it without a
-//    second marshalling seam.
+//    flag bit 1 — the log-only observable stays in Python.  With
+//    fairness_mode=1 (gateway/fairness.py deprioritize/enforce) the marks
+//    become load-bearing: at marshal time each pod gets a "hog" bit (any
+//    flagged adapter resident), and the candidate set narrows AFTER the
+//    health/circuit policy filter — a quiet request (req_noisy=0) prefers
+//    unmarked pods (all-marked sets escape with flag bit 2), a flagged
+//    request (req_noisy=1, matched against the live noisy set in Python)
+//    prefers the marked pods it already dominates.  Semantics mirror
+//    scheduler.py filter_by_fairness() exactly.
 //
 // Contract: candidate-filling calls return the survivor count, LIG_SHED
 // (-1) for the load-shedding drop, LIG_ERROR (-2) on invalid input, and
@@ -213,8 +219,10 @@ struct State {
   int32_t n_adapters = 0;
   std::vector<uint8_t> resident;  // n_adapters x n bitmap (row = adapter)
   std::vector<uint8_t> noisy;     // per-adapter usage-deprioritize marks
+  std::vector<uint8_t> hog;       // per-pod: hosts any flagged adapter
   Config cfg{};
   uint8_t policy_mode = 0;        // 0 log_only, 1 avoid, 2 strict
+  uint8_t fairness_mode = 0;      // 0 log_only, 1 deprioritize/enforce
   bool ready = false;
 
   PodArrays view() const {
@@ -225,7 +233,8 @@ struct State {
 };
 
 int32_t pick_into(State* st, int32_t adapter_id, uint8_t critical,
-                  int64_t prompt_tokens, int32_t* out, uint8_t* flags) {
+                  uint8_t req_noisy, int64_t prompt_tokens, int32_t* out,
+                  uint8_t* flags) {
   uint8_t f = 0;
   const uint8_t* aff = nullptr;
   if (adapter_id >= 0 && adapter_id < st->n_adapters) {
@@ -261,6 +270,29 @@ int32_t pick_into(State* st, int32_t adapter_id, uint8_t critical,
       }
     }
   }
+  if (st->fairness_mode != 0 && !st->hog.empty()) {
+    // filter_by_fairness parity (scheduler.py): quiet requests prefer
+    // non-hog pods (all-hog sets escape, flag bit 2); a flagged request
+    // prefers the hog pods it already dominates (no hog candidate is not
+    // an escape — nothing to avoid).
+    Set pref;
+    if (req_noisy) {
+      for (int32_t i : result)
+        if (st->hog[i]) pref.push_back(i);
+      if (!pref.empty()) result.swap(pref);
+    } else {
+      for (int32_t i : result)
+        if (!st->hog[i]) pref.push_back(i);
+      if (!pref.empty()) {
+        result.swap(pref);
+      } else {
+        bool any_marks = false;
+        for (int32_t i : result)
+          if (st->hog[i]) { any_marks = true; break; }
+        if (any_marks) f |= 4;  // fairness escape: full set serves
+      }
+    }
+  }
   for (std::size_t k = 0; k < result.size(); ++k) out[k] = result[k];
   if (flags) *flags = f;
   return static_cast<int32_t>(result.size());
@@ -273,6 +305,13 @@ extern "C" {
 constexpr int32_t LIG_SHED = kShed;
 constexpr int32_t LIG_ERROR = kError;
 constexpr int32_t LIG_SHED_STRICT = kShedStrict;
+
+// Bump on ANY exported-signature change (the loader refuses mismatches
+// and falls back to Python — an arity change against a prebuilt .so would
+// otherwise scramble arguments or segfault in the routing hot path).
+// 2 = fairness plane: lig_state_update +fairness_mode, lig_pick /
+// lig_pick_many +req_noisy, escape flag bit 2.
+int32_t lig_abi_version(void) { return 2; }
 
 // ---- stateless reference entry (legacy ABI, unchanged semantics) ---------
 
@@ -328,7 +367,7 @@ int32_t lig_state_update(
     double kv_cache_threshold, int32_t queue_threshold_critical,
     int32_t queueing_threshold_lora, double token_headroom_factor,
     int32_t prefill_queue_threshold, uint8_t token_aware,
-    uint8_t prefill_aware, uint8_t policy_mode) {
+    uint8_t prefill_aware, uint8_t policy_mode, uint8_t fairness_mode) {
   State* st = static_cast<State*>(h);
   if (!st || n_pods <= 0 || n_adapters < 0 || !waiting || !prefill ||
       !kv_usage || !kv_free || !kv_capacity || !n_active || !max_active ||
@@ -347,12 +386,14 @@ int32_t lig_state_update(
   st->n_adapters = n_adapters;
   st->resident.assign(
       static_cast<size_t>(n_adapters) * static_cast<size_t>(n_pods), 0);
+  st->hog.assign(static_cast<size_t>(n_pods), 0);
   if (n_adapters > 0) {
     for (int32_t pod = 0; pod < n_pods; ++pod) {
       for (int32_t k = res_offsets[pod]; k < res_offsets[pod + 1]; ++k) {
         const int32_t a = res_ids[k];
         if (a < 0 || a >= n_adapters) return LIG_ERROR;
         st->resident[static_cast<size_t>(a) * n_pods + pod] = 1;
+        if (adapter_noisy[a]) st->hog[pod] = 1;  // hosts a flagged adapter
       }
     }
     st->noisy.assign(adapter_noisy, adapter_noisy + n_adapters);
@@ -364,19 +405,25 @@ int32_t lig_state_update(
                    prefill_queue_threshold, token_aware != 0,
                    prefill_aware != 0};
   st->policy_mode = policy_mode;
+  st->fairness_mode = fairness_mode;
   st->ready = true;
   return 0;
 }
 
 // One pick: request scalars in, candidate set out (caller buffer of n_pods
 // ints).  Returns the count, LIG_SHED/LIG_SHED_STRICT, or LIG_ERROR.
-// ``flags``: bit 0 = policy escape hatch used; bit 1 = adapter carries a
-// usage-deprioritization mark.
+// ``req_noisy``: the request's {model,adapter} is currently flagged noisy
+// (matched against the live noisy-name set in Python, mirroring
+// note_pick).  ``flags``: bit 0 = policy escape hatch used; bit 1 =
+// adapter carries a usage-deprioritization mark; bit 2 = fairness escape
+// hatch (every candidate hosted a flagged adapter).
 int32_t lig_pick(void* h, int32_t adapter_id, uint8_t critical,
-                 int64_t prompt_tokens, int32_t* out, uint8_t* flags) {
+                 uint8_t req_noisy, int64_t prompt_tokens, int32_t* out,
+                 uint8_t* flags) {
   State* st = static_cast<State*>(h);
   if (!st || !st->ready || !out) return LIG_ERROR;
-  return pick_into(st, adapter_id, critical, prompt_tokens, out, flags);
+  return pick_into(st, adapter_id, critical, req_noisy, prompt_tokens, out,
+                   flags);
 }
 
 // Batched picks: one FFI crossing for n_reqs requests.  out_counts[i] gets
@@ -384,16 +431,18 @@ int32_t lig_pick(void* h, int32_t adapter_id, uint8_t critical,
 // row-major buffer; out_flags one byte per request.  Returns 0, or
 // LIG_ERROR on invalid input.
 int32_t lig_pick_many(void* h, int32_t n_reqs, const int32_t* adapter_ids,
-                      const uint8_t* criticals, const int64_t* prompt_tokens,
+                      const uint8_t* criticals, const uint8_t* req_noisies,
+                      const int64_t* prompt_tokens,
                       int32_t* out_counts, int32_t* out_cands,
                       uint8_t* out_flags) {
   State* st = static_cast<State*>(h);
   if (!st || !st->ready || n_reqs <= 0 || !adapter_ids || !criticals ||
-      !prompt_tokens || !out_counts || !out_cands || !out_flags)
+      !req_noisies || !prompt_tokens || !out_counts || !out_cands ||
+      !out_flags)
     return LIG_ERROR;
   for (int32_t r = 0; r < n_reqs; ++r) {
     out_counts[r] = pick_into(
-        st, adapter_ids[r], criticals[r], prompt_tokens[r],
+        st, adapter_ids[r], criticals[r], req_noisies[r], prompt_tokens[r],
         out_cands + static_cast<size_t>(r) * st->n, out_flags + r);
   }
   return 0;

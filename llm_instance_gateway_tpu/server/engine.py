@@ -1243,6 +1243,11 @@ class Engine:
             "running_lora_adapters": running_adapters,
             "waiting_lora_adapters": waiting_adapters,
             "max_lora": max_lora,
+            # Resident adapter -> LoRA rank: the heterogeneity signal the
+            # gateway's rank-aware fair-share weighting (fairness plane)
+            # consumes — a rank-64 flood must not starve rank-8 tenants.
+            "adapter_ranks": (self.lora.adapter_ranks() if self.lora
+                              else {}),
             # Phase-latency histogram states (server/metrics.py renders
             # these as the tpu:*_seconds histogram families).
             "phase_hist": phase_hist,

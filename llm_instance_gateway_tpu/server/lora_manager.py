@@ -116,6 +116,13 @@ class LoRAManager:
         with self._lock:
             return sorted(self._adapters)
 
+    def adapter_ranks(self) -> dict[str, int]:
+        """Resident adapter name -> LoRA rank — the heterogeneity signal
+        the gateway's rank-aware fair-share weighting consumes (exported
+        as the ``adapter_ranks`` label of ``tpu:lora_requests_info``)."""
+        with self._lock:
+            return {name: info.rank for name, info in self._adapters.items()}
+
     @property
     def max_slots(self) -> int:
         return self.cfg.max_lora_slots

@@ -54,12 +54,18 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         # semantics): a request parked in decode_wait is waiting, not
         # running — the gateway unions both into its affinity set but an
         # operator must see which replica is actually decoding a tenant.
+        # ``adapter_ranks`` (name:rank CSV) carries the LoRA-rank
+        # heterogeneity signal the gateway's rank-aware fair-share
+        # weighting consumes (gateway/fairness.py).
         'tpu:lora_requests_info{running_lora_adapters="%s",'
-        'waiting_lora_adapters="%s",max_lora="%d"} %f'
+        'waiting_lora_adapters="%s",max_lora="%d",adapter_ranks="%s"} %f'
         % (
             escape_label(",".join(snapshot.get("running_lora_adapters", []))),
             escape_label(",".join(snapshot.get("waiting_lora_adapters", []))),
             snapshot.get("max_lora", 0),
+            escape_label(",".join(
+                f"{name}:{rank}" for name, rank in sorted(
+                    snapshot.get("adapter_ranks", {}).items()))),
             time.time(),
         ),
     ]

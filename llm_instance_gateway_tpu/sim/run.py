@@ -305,7 +305,17 @@ def simulate(
             try:
                 server = router(req)
             except SchedulingError:
-                if tq is not None and tq.push(queue_tier(req), req):
+                if tq is None:
+                    shed(req)
+                    return
+                accepted, evicted = tq.push(queue_tier(req), req)
+                if evicted is not None:
+                    # Full-queue inversion fix carried into the sim: a
+                    # higher-weight arrival evicts (sheds) the newest
+                    # lowest-weight occupant instead of shedding itself.
+                    parked_at.pop(evicted.rid, None)
+                    shed(evicted)
+                if accepted:
                     parked_at[req.rid] = lp.now
                 else:
                     shed(req)

@@ -149,6 +149,27 @@ def _fmt(v: float) -> str:
     return format(v, "g")
 
 
+def render_keyed_family(name: str, table: dict, labels: tuple,
+                        kind: str = "counter", fmt: str = "%s") -> list[str]:
+    """One multi-label family over tuple keys: ``# TYPE`` line, an
+    unlabeled 0 fallback when the table is empty (counters only — a gauge
+    family with no series simply renders nothing past its TYPE line), keys
+    sorted and every label value escaped.  The tuple-key sibling of
+    ``render_counter`` — the fairness/usage planes key everything by
+    ``(model, adapter)``."""
+    lines = [f"# TYPE {name} {kind}"]
+    if not table:
+        if kind == "counter":
+            lines.append(f"{name} 0")
+        return lines
+    for key in sorted(table):
+        label_str = ",".join(
+            f'{label}="{escape_label(str(part))}"'
+            for label, part in zip(labels, key))
+        lines.append(f"{name}{{{label_str}}} {fmt % (table[key],)}")
+    return lines
+
+
 def render_counter(name: str, table: dict, label: str) -> list[str]:
     """One labeled counter family: ``# TYPE`` line, a ``None`` key (or an
     empty table) rendered as the unlabeled fallback line, remaining keys

@@ -143,6 +143,73 @@ class TestTierQueues:
         tq.push_front("Default", head)
         assert tq.pop_weighted() == 1  # returned head keeps its place
 
+    def test_full_queue_evicts_lower_tier_for_higher_arrival(self):
+        """Regression (full-queue inversion): a Default arrival at
+        max_depth used to shed immediately while Sheddable items sat
+        queued — now the newest Sheddable is evicted to make room."""
+        cfg = AdmissionConfig(max_depth=4, tier_weights=(
+            ("Default", 4.0), ("Sheddable", 1.0)))
+        tq = TierQueues(cfg)
+        for i in range(2):
+            assert tq.push("Default", ("d", i)) == (True, None)
+            assert tq.push("Sheddable", ("s", i)) == (True, None)
+        accepted, evicted = tq.push("Default", ("d", 2))
+        assert accepted
+        assert evicted == ("s", 1)  # newest of the lowest-weight tier
+        assert tq.depth() == 4
+        assert tq.depths() == {"Default": 3, "Sheddable": 1}
+
+    def test_full_queue_same_or_lower_tier_still_sheds(self):
+        cfg = AdmissionConfig(max_depth=2, tier_weights=(
+            ("Default", 4.0), ("Sheddable", 1.0)))
+        tq = TierQueues(cfg)
+        tq.push("Default", ("d", 0))
+        tq.push("Default", ("d", 1))
+        # Same tier: nothing strictly lower-weight to evict.
+        assert tq.push("Default", ("d", 2)) == (False, None)
+        # Lower tier never evicts a higher one.
+        assert tq.push("Sheddable", ("s", 0)) == (False, None)
+        assert tq.depths() == {"Default": 2, "Sheddable": 0}
+
+    def test_controller_eviction_sheds_evicted_waiter(self):
+        """End-to-end through the controller: a Sheddable waiter parked at
+        max_depth is evicted (and sheds 429 immediately) when a Default
+        arrival needs the slot — the higher tier is served first."""
+        sched = FlippableScheduler()
+        ctrl = make_controller(sched, max_depth=1, max_wait_s=5.0)
+        try:
+            results = {}
+
+            def worker(name, criticality):
+                try:
+                    results[name] = ctrl.schedule(
+                        LLMRequest(model="m", criticality=criticality))
+                except SchedulingError as e:
+                    results[name] = e
+
+            t_shed = threading.Thread(
+                target=worker, args=("shed", "Sheddable"))
+            t_shed.start()
+            deadline = time.monotonic() + 2
+            while (ctrl.queue_depths().get("Sheddable", 0) == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert ctrl.queue_depths()["Sheddable"] == 1
+            t_def = threading.Thread(
+                target=worker, args=("kept", "Default"))
+            t_def.start()
+            # The Sheddable waiter is evicted and sheds well before its
+            # 5 s wait budget.
+            t_shed.join(timeout=2)
+            assert not t_shed.is_alive()
+            assert isinstance(results["shed"], SchedulingError)
+            assert results["shed"].shed
+            sched.shedding = False  # capacity frees: Default admits
+            t_def.join(timeout=5)
+            assert results["kept"].name == "p0"
+        finally:
+            ctrl.stop()
+
 
 class TestConfigParsing:
     def test_admission_queue_from_pool_spec(self):

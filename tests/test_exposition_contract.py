@@ -460,6 +460,74 @@ def test_usage_rollup_exposition_contract():
     assert families["gateway_usage_would_deprioritize_total"][0].value == 0
 
 
+def loaded_fairness_policy():
+    """A REAL FairnessPolicy with a hostile-labeled tenant throttled and
+    demoted, so every fairness family renders labeled samples."""
+    from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+
+    class FakeRollup:
+        def shares_snapshot(self):
+            return {(HOSTILE, HOSTILE): 0.9, (HOSTILE, "base"): 0.1}
+
+        def noisy(self):
+            return frozenset()
+
+        def note_pick(self, pod, model):
+            pass
+
+    policy = fairness_mod.FairnessPolicy(
+        FakeRollup(),
+        cfg=fairness_mod.FairnessConfig(mode="enforce", quota_rps=1.0,
+                                        quota_burst=1.0),
+        clock=lambda: 100.0)
+    policy.tick(now=100.0)
+    for _ in range(2):  # second admission exhausts the 1-token burst
+        policy.admit(LLMRequest(model=HOSTILE, critical=True,
+                                criticality="Critical"))
+    return policy
+
+
+def test_fairness_exposition_contract():
+    """Fairness-plane families: quota throttles/demotions counters and the
+    quota-remaining gauge lint clean with hostile labels; the relabeled
+    would-deprioritize counter carries BOTH model and adapter labels."""
+    gm, rollup, journal = loaded_usage_rollup()
+    rollup.seed_noisy(HOSTILE, HOSTILE)
+    rollup.note_pick("pod-u", HOSTILE)
+    policy = loaded_fairness_policy()
+    text = gm.render() + "\n".join(
+        rollup.render() + policy.render()) + "\n"
+    families = lint_exposition(text)
+    (wd,) = [s for s in families["gateway_usage_would_deprioritize_total"]
+             if s.labels]
+    assert wd.labels == {"model": HOSTILE, "adapter": HOSTILE}
+    assert wd.value == 1
+    (thr,) = families["gateway_quota_throttles_total"][-1:]
+    assert thr.labels == {"model": HOSTILE, "adapter": HOSTILE}
+    (dem,) = families["gateway_fairness_demotions_total"][-1:]
+    assert dem.labels == {"model": HOSTILE, "adapter": HOSTILE}
+    assert families["gateway_tenant_quota_remaining"]
+
+
+def test_fairness_empty_state_still_lints():
+    from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+
+    class FakeRollup:
+        def shares_snapshot(self):
+            return {}
+
+        def noisy(self):
+            return frozenset()
+
+    policy = fairness_mod.FairnessPolicy(FakeRollup())
+    families = lint_exposition("\n".join(policy.render()) + "\n")
+    assert families["gateway_quota_throttles_total"][0].value == 0
+    assert families["gateway_fairness_demotions_total"][0].value == 0
+    # Gauges render no unlabeled fallback: absent until a bucket exists.
+    assert "gateway_tenant_quota_remaining" not in families
+
+
 def test_empty_observability_state_still_lints():
     """Fresh proxy, zero traffic: the composed page must still parse (the
     would-avoid/upstream counters render unlabeled 0 fallbacks; SLO and

@@ -1,0 +1,920 @@
+"""Fairness & quota plane tests (gateway/fairness.py + the promoted
+scheduler/admission seams).
+
+The acceptance-critical invariants:
+
+- **log_only is routing-byte-identical to HEAD**: same-RNG diff tests for
+  the Python AND native schedulers across the health x circuit x usage x
+  fairness planes, plus pick_many pick-for-pick parity.
+- **Deprioritization**: with mode=deprioritize/enforce, quiet tenants'
+  picks narrow off pods hosting a flagged-noisy adapter (isolation), the
+  flagged tenant's own picks narrow onto them (containment), with the
+  counted last-resort escape hatch mirroring filter_by_policy — and the
+  native scheduler agrees with the Python oracle pick for pick.
+- **Quotas**: rank-weighted fair shares, token-bucket gating, one-tier
+  criticality demotion (never a hard shed from the gate itself), events
+  journaled, counters exported, Retry-After on the resulting 429s.
+"""
+
+import asyncio
+import random
+import threading
+
+import pytest
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+from llm_instance_gateway_tpu.gateway import usage as gusage
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    filter_by_fairness,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+HOG, QUIET = "hog", "quiet"
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _provider(n=6, hog_on_even=True):
+    """Even pods host the hog adapter, odd pods host only quiet."""
+    pods = []
+    for i in range(n):
+        adapters = {HOG: 0} if (hog_on_even and i % 2 == 0) else {QUIET: 0}
+        pods.append(PodMetrics(
+            pod=Pod(f"pod-{i}", f"127.0.0.1:{i}"),
+            metrics=Metrics(waiting_queue_size=i % 3,
+                            active_adapters=adapters,
+                            max_active_adapters=4)))
+    return StaticProvider(pods)
+
+
+def _flagged_rollup(provider, model=HOG, served="base-model"):
+    """A real UsageRollup with ``model`` flagged noisy via real ticks."""
+    cfg = gusage.UsageConfig(noisy_ratio=2.0, min_share=0.2,
+                             enter_ticks=1, ema_alpha=1.0)
+
+    class FakeGM:
+        requests_total = {}
+
+    rollup = gusage.UsageRollup(provider, metrics=FakeGM(), cfg=cfg)
+    pm = provider.all_pod_metrics()[0]
+    pm.metrics.adapter_step_seconds = {(served, model, "decode"): 0.0,
+                                       (served, QUIET, "decode"): 0.0}
+    rollup.tick(now=0.0)
+    pm.metrics.adapter_step_seconds = {(served, model, "decode"): 9.0,
+                                       (served, QUIET, "decode"): 1.0}
+    FakeGM.requests_total.update({model: 1, QUIET: 9})
+    rollup.tick(now=5.0)
+    assert model in rollup.noisy()
+    return rollup
+
+
+def make_policy(provider, mode="deprioritize", rollup=None, journal=None,
+                clock=None, **cfg_kwargs):
+    rollup = rollup if rollup is not None else _flagged_rollup(provider)
+    return fairness_mod.FairnessPolicy(
+        rollup, cfg=fairness_mod.FairnessConfig(mode=mode, **cfg_kwargs),
+        journal=journal, provider=provider,
+        clock=clock or FakeClock())
+
+
+def _req(model=QUIET, critical=True, criticality="Critical"):
+    return LLMRequest(model=model, resolved_target_model=model,
+                      critical=critical, criticality=criticality)
+
+
+# ---------------------------------------------------------------------------
+# FairnessConfig
+# ---------------------------------------------------------------------------
+
+
+class TestFairnessConfig:
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            fairness_mod.FairnessConfig(mode="banhammer")
+
+    def test_bad_rates_rejected(self):
+        with pytest.raises(ValueError):
+            fairness_mod.FairnessConfig(quota_rps=0)
+        with pytest.raises(ValueError):
+            fairness_mod.FairnessConfig(over_ratio=-1)
+
+    def test_pool_doc_parsing(self):
+        from llm_instance_gateway_tpu.gateway.scheduling.config import (
+            from_pool_spec,
+        )
+
+        cfg = from_pool_spec({"fairnessPolicy": {
+            "mode": "enforce", "overRatio": 2.0, "quotaRps": 1.5,
+            "quotaBurst": 3, "rankBase": 16, "retryAfterSeconds": 2,
+        }})
+        assert cfg.fairness.mode == "enforce"
+        assert cfg.fairness.over_ratio == 2.0
+        assert cfg.fairness.quota_rps == 1.5
+        assert cfg.fairness.rank_base == 16
+        with pytest.raises(ValueError, match="fairnessPolicy"):
+            from_pool_spec({"fairnessPolicy": {"mod": "enforce"}})
+        with pytest.raises(ValueError, match="mode"):
+            from_pool_spec({"fairnessPolicy": {"mode": "nope"}})
+
+
+# ---------------------------------------------------------------------------
+# filter_by_fairness semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFilterByFairness:
+    def test_log_only_returns_unchanged(self):
+        provider = _provider()
+        policy = make_policy(provider, mode="log_only")
+        cands = provider.all_pod_metrics()
+        assert filter_by_fairness(policy, _req(), cands) is cands
+
+    def test_quiet_request_isolated_from_hog_pods(self):
+        provider = _provider()
+        policy = make_policy(provider)
+        cands = provider.all_pod_metrics()
+        out = filter_by_fairness(policy, _req(model=QUIET), cands)
+        assert out and all(HOG not in c.metrics.active_adapters
+                           for c in out)
+
+    def test_noisy_request_contained_on_hog_pods(self):
+        provider = _provider()
+        policy = make_policy(provider)
+        cands = provider.all_pod_metrics()
+        out = filter_by_fairness(policy, _req(model=HOG), cands)
+        assert out and all(HOG in c.metrics.active_adapters for c in out)
+
+    def test_all_marked_escape_hatch_counts(self):
+        provider = _provider()
+        policy = make_policy(provider)
+        # Only hog-hosting candidates survive the (fake) tree.
+        cands = [pm for pm in provider.all_pod_metrics()
+                 if HOG in pm.metrics.active_adapters]
+        out = filter_by_fairness(policy, _req(model=QUIET), cands)
+        assert out == cands  # full set serves (last resort)
+        assert policy.escape_total == 1
+
+    def test_no_marked_candidate_is_not_an_escape_for_noisy(self):
+        provider = _provider()
+        policy = make_policy(provider)
+        cands = [pm for pm in provider.all_pod_metrics()
+                 if HOG not in pm.metrics.active_adapters]
+        out = filter_by_fairness(policy, _req(model=HOG), cands)
+        assert out == cands
+        assert policy.escape_total == 0  # nothing to avoid: no escape
+
+    def test_bare_rollup_without_mode_is_inert(self):
+        provider = _provider()
+        rollup = _flagged_rollup(provider)
+        cands = provider.all_pod_metrics()
+        assert filter_by_fairness(rollup, _req(), cands) is cands
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: log_only is routing-byte-identical across ALL planes
+# ---------------------------------------------------------------------------
+
+
+def _full_plane(provider, fairness_mode="log_only"):
+    """Health plane (one degraded pod + one open circuit) + flagged usage
+    + fairness policy — the full stack of advisors, all log-only."""
+    from llm_instance_gateway_tpu.gateway import health, resilience
+
+    plane = resilience.ResiliencePlane(
+        health.HealthScorer(provider=provider),
+        cfg=resilience.ResilienceConfig(health_policy="log_only"))
+    plane.health.update(now=100.0)
+    for _ in range(8):
+        plane.health.record_upstream("pod-0", ok=False)
+    plane.health.update(now=101.0)
+    plane.health.update(now=102.0)
+    for _ in range(plane.cfg.trip_consecutive):
+        plane.breaker.record("pod-1", ok=False)
+    fairness = make_policy(provider, mode=fairness_mode)
+    return plane, fairness
+
+
+class TestLogOnlyByteIdentical:
+    def test_python_full_plane_diff(self):
+        provider = _provider()
+        mk = lambda: Scheduler(provider, token_aware=False,  # noqa: E731
+                               prefill_aware=False, prefix_aware=False,
+                               rng=random.Random(11))
+        plain, advised = mk(), mk()
+        plane, fairness = _full_plane(provider)
+        advised.health_advisor = plane
+        advised.usage_advisor = fairness
+        reqs = [_req(model=HOG), _req(model=QUIET)]
+        picks_plain = [plain.schedule(reqs[i % 2]).name for i in range(64)]
+        picks_advised = [advised.schedule(reqs[i % 2]).name
+                         for i in range(64)]
+        assert picks_plain == picks_advised
+        # The log-only counter still attributed the flagged key.
+        assert fairness.usage.would_deprioritize == {
+            ("base-model", HOG): 32}
+
+    def test_native_full_plane_diff(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _provider()
+        mk = lambda: native.NativeScheduler(  # noqa: E731
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(11))
+        plain, advised = mk(), mk()
+        plane, fairness = _full_plane(provider)
+        advised.health_advisor = plane
+        advised.usage_advisor = fairness
+        reqs = [_req(model=HOG), _req(model=QUIET)]
+        picks_plain = [plain.schedule(reqs[i % 2]).name for i in range(64)]
+        picks_advised = [advised.schedule(reqs[i % 2]).name
+                         for i in range(64)]
+        assert picks_plain == picks_advised
+
+    def test_pick_many_parity_log_only(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _provider()
+        plane, fairness = _full_plane(provider)
+        loop_s = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(5))
+        batch_s = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(5))
+        for s in (loop_s, batch_s):
+            s.health_advisor = plane
+            s.usage_advisor = fairness
+        reqs = [_req(model=HOG if i % 2 == 0 else QUIET)
+                for i in range(32)]
+        assert [loop_s.schedule(r).name for r in reqs] == \
+            [p.name for p in batch_s.pick_many(reqs)]
+
+
+# ---------------------------------------------------------------------------
+# Enforcing pick deprioritization: Python + native agree, behavior holds
+# ---------------------------------------------------------------------------
+
+
+class TestDeprioritizeEnforced:
+    def test_python_quiet_avoids_hog_pods(self):
+        provider = _provider()
+        sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(7))
+        sched.usage_advisor = make_policy(provider)
+        hog_pods = {f"pod-{i}" for i in range(6) if i % 2 == 0}
+        quiet_picks = {sched.schedule(_req(model=QUIET)).name
+                       for _ in range(32)}
+        assert quiet_picks.isdisjoint(hog_pods)
+        hog_picks = {sched.schedule(_req(model=HOG)).name
+                     for _ in range(32)}
+        assert hog_picks <= hog_pods
+
+    @pytest.mark.parametrize("mode", ["deprioritize", "enforce"])
+    def test_native_matches_python_pick_for_pick(self, mode):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _provider()
+        rollup = _flagged_rollup(provider)
+        py_policy = make_policy(provider, mode=mode, rollup=rollup)
+        nat_policy = make_policy(provider, mode=mode, rollup=rollup)
+        py = Scheduler(provider, token_aware=False, prefill_aware=False,
+                       prefix_aware=False, rng=random.Random(3))
+        nat = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(3))
+        py.usage_advisor, nat.usage_advisor = py_policy, nat_policy
+        for model in (HOG, QUIET):
+            req = _req(model=model)
+            assert [py.schedule(req).name for _ in range(48)] == \
+                [nat.schedule(req).name for _ in range(48)]
+        assert py_policy.escape_total == nat_policy.escape_total
+
+    def test_native_escape_hatch_counts(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        # EVERY pod hosts the hog: quiet requests escape on both paths.
+        pods = [PodMetrics(pod=Pod(f"pod-{i}", f"1.2.3.4:{i}"),
+                           metrics=Metrics(active_adapters={HOG: 0},
+                                           max_active_adapters=4))
+                for i in range(3)]
+        provider = StaticProvider(pods)
+        policy = make_policy(provider)
+        nat = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(1))
+        nat.usage_advisor = policy
+        picks = {nat.schedule(_req(model=QUIET)).name for _ in range(12)}
+        assert picks == {"pod-0", "pod-1", "pod-2"}  # full set serves
+        assert policy.escape_total == 12
+
+    def test_flag_transition_reaches_native_snapshot(self):
+        """A noisy flag flip between provider versions re-marshals the
+        resident state (the noisy-set identity is part of the cache key
+        comparison) — the native path must not route on stale marks."""
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _provider()
+        rollup = _flagged_rollup(provider)
+        policy = make_policy(provider, rollup=rollup)
+        nat = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(2))
+        nat.usage_advisor = policy
+        hog_pods = {f"pod-{i}" for i in range(6) if i % 2 == 0}
+        # A tenant with no affinity anywhere spreads by queue signals;
+        # while the hog is flagged it must stay off the hog's pods.
+        other = _req(model="other")
+        assert {nat.schedule(other).name
+                for _ in range(24)}.isdisjoint(hog_pods)
+        # The flag clears (two quiet ticks) — same provider snapshot.
+        pm = provider.all_pod_metrics()[0]
+        pm.metrics.adapter_step_seconds = {
+            ("base-model", HOG, "decode"): 9.5,
+            ("base-model", QUIET, "decode"): 10.0}
+        rollup.tick(now=10.0)
+        rollup.tick(now=15.0)
+        assert rollup.noisy() == frozenset()
+        assert {nat.schedule(other).name
+                for _ in range(48)} & hog_pods  # hog pods routable again
+
+
+# ---------------------------------------------------------------------------
+# Quotas: fair shares, rank weighting, bucket, demotion
+# ---------------------------------------------------------------------------
+
+
+class TestQuotas:
+    def _ranked_provider(self):
+        return StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"),
+            metrics=Metrics(active_adapters={HOG: 0, QUIET: 0},
+                            adapter_ranks={HOG: 64, QUIET: 8},
+                            max_active_adapters=4))])
+
+    def _policy_with_shares(self, shares, provider=None, clock=None,
+                            **cfg_kwargs):
+        provider = provider or self._ranked_provider()
+
+        class FakeRollup:
+            def __init__(self):
+                self._shares = shares
+
+            def shares_snapshot(self):
+                return dict(self._shares)
+
+            def noisy(self):
+                return frozenset()
+
+            def note_pick(self, pod, model):
+                pass
+
+        journal = events_mod.EventJournal(capacity=64)
+        policy = fairness_mod.FairnessPolicy(
+            FakeRollup(),
+            cfg=fairness_mod.FairnessConfig(mode="enforce", **cfg_kwargs),
+            journal=journal, provider=provider,
+            clock=clock or FakeClock())
+        return policy, journal
+
+    def test_rank_weighting_shrinks_hog_fair_share(self):
+        # rank-64 hog weighs 8/64 = 0.125, rank-8 quiet weighs 1.0:
+        # fair shares 1/9 vs 8/9 — equal CONSUMPTION means the high-rank
+        # adapter is far over its fair share while the low-rank one isn't.
+        shares = {("m", HOG): 0.5, ("m", QUIET): 0.5}
+        policy, _ = self._policy_with_shares(shares, over_ratio=3.0)
+        policy.tick(now=100.0)
+        assert policy.throttled() == frozenset({HOG})
+        payload = policy.debug_payload()
+        (row,) = payload["throttled"]
+        assert row["adapter"] == HOG
+        assert row["fair_share"] == pytest.approx(1 / 9, rel=0.01)
+        assert row["cost"] == pytest.approx(8.0)
+
+    def test_proportional_tenants_never_throttle(self):
+        shares = {("m", HOG): 0.34, ("m", QUIET): 0.33,
+                  ("m", "base"): 0.33}
+        provider = StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+        policy, _ = self._policy_with_shares(shares, provider=provider)
+        policy.tick(now=100.0)
+        assert policy.throttled() == frozenset()
+
+    def test_bucket_gates_then_demotes_with_refill(self):
+        clock = FakeClock(100.0)
+        shares = {("m", HOG): 0.9, ("m", QUIET): 0.1}
+        provider = StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+        policy, journal = self._policy_with_shares(
+            shares, provider=provider, clock=clock,
+            quota_rps=1.0, quota_burst=2.0)
+        policy.tick(now=100.0)
+        assert policy.throttled() == frozenset({HOG})
+        # Burst admits the first 2 at full criticality (cost 1 w/o ranks).
+        for _ in range(2):
+            req = _req(model=HOG, criticality="Critical")
+            assert policy.admit(req) is None
+            assert req.criticality == "Critical" and req.critical
+        # Bucket empty: demote one tier, journal both events.
+        req = _req(model=HOG, criticality="Critical")
+        assert policy.admit(req) == "Default"
+        assert req.criticality == "Default" and not req.critical
+        (thr,) = journal.events(kind=events_mod.QUOTA_THROTTLE, limit=8)
+        assert thr["attrs"]["adapter"] == HOG
+        (dem,) = journal.events(kind=events_mod.FAIRNESS_DEMOTE, limit=8)
+        assert dem["attrs"] == {"model": "m", "adapter": HOG,
+                                "frm": "Critical", "to": "Default"}
+        # Default -> Sheddable; Sheddable stays (the tree sheds it first).
+        req = _req(model=HOG, criticality="Default", critical=False)
+        assert policy.admit(req) == "Sheddable"
+        req = _req(model=HOG, criticality="Sheddable", critical=False)
+        assert policy.admit(req) is None
+        assert req.criticality == "Sheddable"
+        assert policy.quota_throttles[("m", HOG)] == 3
+        assert policy.fairness_demotions[("m", HOG)] == 2
+        # Refill: one second buys one full-criticality admission back.
+        clock.t += 1.0
+        req = _req(model=HOG, criticality="Critical")
+        assert policy.admit(req) is None
+
+    def test_quiet_tenant_admits_free(self):
+        shares = {("m", HOG): 0.9, ("m", QUIET): 0.1}
+        provider = StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+        policy, _ = self._policy_with_shares(shares, provider=provider)
+        policy.tick(now=100.0)
+        for _ in range(50):
+            req = _req(model=QUIET, criticality="Default", critical=False)
+            assert policy.admit(req) is None
+            assert req.criticality == "Default"
+
+    def test_log_only_and_deprioritize_never_gate(self):
+        provider = _provider()
+        for mode in ("log_only", "deprioritize"):
+            policy = make_policy(provider, mode=mode)
+            policy.tick(now=100.0)
+            req = _req(model=HOG)
+            assert policy.admit(req) is None
+            assert req.criticality == "Critical"
+
+    def test_update_config_hot_reload(self):
+        provider = _provider()
+        policy = make_policy(provider, mode="log_only")
+        policy.update_config(fairness_mod.FairnessConfig(mode="enforce"))
+        assert policy.mode == "enforce"
+
+    def test_admission_controller_pushes_fairness_reload(self):
+        from llm_instance_gateway_tpu.gateway.scheduling.admission import (
+            AdmissionController,
+        )
+        from llm_instance_gateway_tpu.gateway.scheduling.config import (
+            from_pool_spec,
+        )
+
+        class Inner:
+            cfg = None
+
+            def schedule(self, req):
+                raise AssertionError("unused")
+
+            def update_config(self, cfg):
+                self.cfg = cfg
+
+        ctrl = AdmissionController(Inner())
+        policy = make_policy(_provider(), mode="log_only")
+        ctrl.fairness = policy
+        ctrl.update_config(from_pool_spec(
+            {"fairnessPolicy": {"mode": "enforce", "quotaRps": 9}}))
+        assert policy.mode == "enforce"
+        assert policy.cfg.quota_rps == 9.0
+
+    def test_cli_pinned_fields_survive_pool_reload(self):
+        # --fairness-mode enforce then a pool-doc hot reload WITHOUT a
+        # fairnessPolicy section (SchedulerConfig.fairness defaults to
+        # log_only): the pinned mode survives, unpinned fields track the
+        # reload.
+        from llm_instance_gateway_tpu.gateway.scheduling.config import (
+            from_pool_spec,
+        )
+
+        provider = _provider()
+        policy = fairness_mod.FairnessPolicy(
+            _flagged_rollup(provider), provider=provider,
+            clock=FakeClock(), cli_overrides={"mode": "enforce"})
+        assert policy.mode == "enforce"
+        policy.update_config(
+            from_pool_spec({"kvCacheThreshold": 0.9}).fairness)
+        assert policy.mode == "enforce"
+        # Unpinned fields still adopt pool-doc values under the pin.
+        policy.update_config(from_pool_spec(
+            {"fairnessPolicy": {"mode": "log_only", "quotaRps": 7}}).fairness)
+        assert policy.mode == "enforce"
+        assert policy.cfg.quota_rps == 7.0
+
+    def test_fairness_from_args_returns_only_set_flags(self):
+        import argparse
+
+        from llm_instance_gateway_tpu.gateway import bootstrap
+
+        parser = argparse.ArgumentParser()
+        bootstrap.add_resilience_args(parser)
+        assert bootstrap.fairness_from_args(parser.parse_args([])) is None
+        overrides = bootstrap.fairness_from_args(
+            parser.parse_args(["--fairness-quota-rps", "2.0"]))
+        assert overrides == {"quota_rps": 2.0}  # mode NOT forced to default
+
+    def test_throttled_name_collision_charges_dominant_key(self):
+        # Same adapter name attributed under two served models, both over
+        # quota: the arrival name maps to the higher-share key, not
+        # iteration-order's last.
+        shares = {("m1", HOG): 0.55, ("m2", HOG): 0.42,
+                  ("m1", QUIET): 0.03}
+        provider = StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+        policy, _ = self._policy_with_shares(
+            shares, provider=provider, over_ratio=1.2)
+        policy.tick(now=100.0)
+        assert policy._throttled[HOG] == ("m1", HOG)
+
+    def test_render_exposition(self):
+        from llm_instance_gateway_tpu.utils import prom_parse
+
+        clock = FakeClock(100.0)
+        shares = {("m", HOG): 0.9, ("m", QUIET): 0.1}
+        provider = StaticProvider([PodMetrics(
+            pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+        policy, _ = self._policy_with_shares(
+            shares, provider=provider, clock=clock,
+            quota_rps=1.0, quota_burst=1.0)
+        policy.tick(now=100.0)
+        for crit in ("Critical", "Critical"):
+            policy.admit(_req(model=HOG, criticality=crit))
+        text = "\n".join(policy.render()) + "\n"
+        fams = prom_parse.parse_text(text)
+        (thr,) = fams["gateway_quota_throttles_total"][-1:]
+        assert thr.labels == {"model": "m", "adapter": HOG}
+        assert thr.value == 1
+        (dem,) = fams["gateway_fairness_demotions_total"][-1:]
+        assert dem.value == 1
+        (rem,) = fams["gateway_tenant_quota_remaining"]
+        assert rem.labels == {"model": "m", "adapter": HOG}
+
+    def test_empty_render_lints(self):
+        provider = _provider()
+        policy = make_policy(provider, mode="log_only")
+        text = "\n".join(policy.render()) + "\n"
+        assert "gateway_quota_throttles_total 0" in text
+        assert "gateway_fairness_demotions_total 0" in text
+
+
+# ---------------------------------------------------------------------------
+# Rank plumbing: engine snapshot -> exposition -> metrics_client
+# ---------------------------------------------------------------------------
+
+
+RANKED_EXPO = """\
+# TYPE tpu:num_requests_running gauge
+tpu:num_requests_running 1
+# TYPE tpu:lora_requests_info gauge
+tpu:lora_requests_info{running_lora_adapters="a",waiting_lora_adapters="b",max_lora="4",adapter_ranks="a:64,b:8"} 100.0
+"""
+
+
+def test_metrics_client_parses_adapter_ranks():
+    from llm_instance_gateway_tpu.gateway.metrics_client import (
+        families_to_metrics,
+    )
+    from llm_instance_gateway_tpu.utils import prom_parse
+
+    metrics, errs = families_to_metrics(
+        prom_parse.parse_text(RANKED_EXPO), Metrics())
+    assert metrics.adapter_ranks == {"a": 64, "b": 8}
+    assert not [e for e in errs if "adapter_ranks" in e]
+
+
+def test_server_metrics_render_carries_ranks():
+    from llm_instance_gateway_tpu.server import metrics as server_metrics
+
+    text = server_metrics.render({
+        "model_name": "tiny", "prefill_queue_size": 0,
+        "decode_queue_size": 0, "num_requests_running": 0,
+        "num_requests_waiting": 0, "kv_cache_usage_perc": 0.0,
+        "kv_tokens_capacity": 10, "kv_tokens_free": 10,
+        "decode_tokens_per_sec": 0.0,
+        "running_lora_adapters": ["t-a"], "waiting_lora_adapters": [],
+        "max_lora": 4, "adapter_ranks": {"t-a": 32},
+    })
+    assert 'adapter_ranks="t-a:32"' in text
+
+
+# ---------------------------------------------------------------------------
+# Loadgen --criticality-mix (the shared traffic shape)
+# ---------------------------------------------------------------------------
+
+
+class TestCriticalityMix:
+    def test_parse_normalizes_and_validates(self):
+        from llm_instance_gateway_tpu.gateway.loadgen import (
+            parse_criticality_mix,
+        )
+
+        mix = parse_criticality_mix(
+            "critical=0.1,default=0.6,sheddable=0.3")
+        assert mix == {"Critical": pytest.approx(0.1),
+                       "Default": pytest.approx(0.6),
+                       "Sheddable": pytest.approx(0.3)}
+        # Weights normalize; tier names are case-insensitive.
+        mix = parse_criticality_mix("Critical=2,DEFAULT=2")
+        assert mix == {"Critical": 0.5, "Default": 0.5}
+        with pytest.raises(ValueError, match="tier"):
+            parse_criticality_mix("criticalish=1")
+        with pytest.raises(ValueError, match="weight"):
+            parse_criticality_mix("critical=-1")
+        with pytest.raises(ValueError, match="empty"):
+            parse_criticality_mix("")
+
+    def test_assign_tiers_seeded_and_reproducible(self):
+        from llm_instance_gateway_tpu.gateway.loadgen import assign_tiers
+
+        names = [f"adapter-{i}" for i in range(200)]
+        mix = {"Critical": 0.1, "Default": 0.6, "Sheddable": 0.3}
+        a = assign_tiers(names, mix, seed=3)
+        assert a == assign_tiers(names, mix, seed=3)
+        counts = {t: sum(1 for v in a.values() if v == t) for t in mix}
+        assert counts["Default"] > counts["Sheddable"] > counts["Critical"]
+
+    def test_run_load_emits_per_tier_breakdown(self):
+        from llm_instance_gateway_tpu.gateway.loadgen import (
+            parse_criticality_mix,
+            run_load,
+        )
+
+        out = run_load(requests=120, num_fake_pods=8, num_models_per_pod=3,
+                       criticality_mix=parse_criticality_mix(
+                           "critical=0.2,default=0.5,sheddable=0.3"))
+        assert set(out["criticality_mix"]) == {"Critical", "Default",
+                                               "Sheddable"}
+        tiers = out["per_tier"]
+        assert sum(row["requests"] for row in tiers.values()) == 120
+        for row in tiers.values():
+            assert row["shed"] == 0  # unsaturated fixture: nothing sheds
+            assert row["p99_us"] >= row["p50_us"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Handler-core admission gate + proxy integration (Retry-After)
+# ---------------------------------------------------------------------------
+
+
+def test_handler_core_demotes_before_scheduling():
+    """A throttled tenant's request reaches the scheduler one tier down:
+    under a saturated pool the (demoted) request sheds where a Critical
+    one would have been served — lowest-criticality-first degradation."""
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.messages import (
+        RequestBody,
+    )
+    from llm_instance_gateway_tpu.gateway.handlers.server import (
+        RequestContext,
+        Server,
+    )
+    from llm_instance_gateway_tpu.gateway.testing import make_model
+
+    # One saturated pod: queue over the sheddable threshold, so only
+    # critical traffic is served.
+    pod = Pod("pod-0", "127.0.0.1:1")
+    provider = StaticProvider([PodMetrics(
+        pod=pod, metrics=Metrics(waiting_queue_size=50,
+                                 kv_cache_usage_percent=0.9))])
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name="pool"))
+    ds.store_model(make_model(HOG))   # Critical tier by default
+    ds.store_model(make_model(QUIET))
+    sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                      prefix_aware=False, rng=random.Random(0))
+    server = Server(sched, ds)
+
+    class AlwaysThrottle:
+        cfg = fairness_mod.FairnessConfig(mode="enforce")
+        mode = "enforce"
+
+        def admit(self, llm_req):
+            if llm_req.model != HOG:
+                return None
+            llm_req.criticality = "Sheddable"
+            llm_req.critical = False
+            return "Sheddable"
+
+    server.fairness = AlwaysThrottle()
+    body = b'{"model": "%s", "prompt": "x"}'
+    # The quiet (critical) request schedules on the saturated pod...
+    res = server.process(RequestContext(),
+                         RequestBody(body=body % QUIET.encode()))
+    assert res.immediate_status is None
+    # ...the demoted hog request sheds 429.
+    res = server.process(RequestContext(),
+                         RequestBody(body=body % HOG.encode()))
+    assert res.immediate_status == 429
+
+
+def test_handler_core_charges_quota_once_per_context():
+    """The proxy retry loop re-enters the body phase with the SAME
+    RequestContext per attempt (and hedge probes pre-mark a throwaway
+    one): the quota bucket must be charged once per client request, with
+    the demotion decision replayed on re-entry — not respent."""
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.messages import (
+        RequestBody,
+    )
+    from llm_instance_gateway_tpu.gateway.handlers.server import (
+        RequestContext,
+        Server,
+    )
+    from llm_instance_gateway_tpu.gateway.testing import make_model
+
+    pod = Pod("pod-0", "127.0.0.1:1")
+    provider = StaticProvider([PodMetrics(pod=pod, metrics=Metrics())])
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name="pool"))
+    ds.store_model(make_model(HOG))
+    sched = Scheduler(provider, token_aware=False, prefill_aware=False,
+                      prefix_aware=False, rng=random.Random(0))
+    server = Server(sched, ds)
+
+    class CountingThrottle:
+        cfg = fairness_mod.FairnessConfig(mode="enforce")
+        mode = "enforce"
+        admits = 0
+
+        def admit(self, llm_req):
+            self.admits += 1
+            llm_req.criticality = "Sheddable"
+            llm_req.critical = False
+            return "Sheddable"
+
+    policy = CountingThrottle()
+    server.fairness = policy
+    body = b'{"model": "%s", "prompt": "x"}' % HOG.encode()
+    ctx = RequestContext()
+    server.process(ctx, RequestBody(body=body))
+    assert policy.admits == 1
+    assert ctx.fairness_charged and ctx.fairness_demoted_to == "Sheddable"
+    # Retry attempts reuse the context: no second charge, decision kept.
+    server.process(ctx, RequestBody(body=body))
+    server.process(ctx, RequestBody(body=body))
+    assert policy.admits == 1
+    # A hedge probe's throwaway context arrives pre-charged.
+    probe = RequestContext()
+    probe.fairness_charged = True
+    server.process(probe, RequestBody(body=body))
+    assert policy.admits == 1
+
+
+def test_extproc_entrypoint_wires_fairness(monkeypatch):
+    """The standalone gRPC ext-proc binary builds the usage rollup +
+    FairnessPolicy and attaches every seam (handler core admit gate, pick
+    deprioritization advisor, hot-reload push), so a pool document's
+    fairnessPolicy section enforces there too — not just behind the HTTP
+    proxy."""
+    from llm_instance_gateway_tpu.gateway.extproc import __main__ as epmain
+
+    captured = {}
+
+    class FakeGrpcServer:
+        def start(self):
+            captured["started"] = True
+
+        def stop(self, grace=None):
+            class _W:
+                def wait(self, t):
+                    pass
+            return _W()
+
+    def fake_build(handler_server, datastore, port, max_workers):
+        captured["handler_server"] = handler_server
+        return FakeGrpcServer()
+
+    monkeypatch.setattr(epmain, "build_grpc_server", fake_build)
+    # Trip the stop event as soon as main parks on it.
+    orig_wait = threading.Event.wait
+
+    def insta_stop(self, timeout=None):
+        if timeout is None:
+            return True
+        return orig_wait(self, timeout)
+
+    monkeypatch.setattr(threading.Event, "wait", insta_stop)
+    import tempfile
+
+    cfg_yaml = """\
+kind: InferencePool
+metadata: {name: p, resourceVersion: "1"}
+spec:
+  selector: {app: x}
+  targetPortNumber: 9999
+  schedulerConfig:
+    fairnessPolicy: {mode: enforce, quotaRps: 2}
+---
+kind: InferenceModel
+metadata: {name: m}
+spec: {modelName: m, poolRef: {name: p}}
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(cfg_yaml)
+        cfg_path = f.name
+    epmain.main(["--config", cfg_path, "--pod",
+                 "pod-0=127.0.0.1:9999"])
+    hs = captured["handler_server"]
+    assert hs.fairness is not None
+    assert hs.fairness.mode == "enforce"
+    assert hs.fairness.cfg.quota_rps == 2.0
+    sched = hs.scheduler
+    inner = getattr(sched, "_scheduler", sched)
+    assert inner.usage_advisor is hs.fairness
+    if hasattr(sched, "fairness"):
+        assert sched.fairness is hs.fairness
+
+
+def test_proxy_shed_carries_retry_after():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import Criticality, InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.testing import make_model
+
+    async def run():
+        pod = Pod("pod-0", "127.0.0.1:1")
+        provider = StaticProvider([PodMetrics(
+            pod=pod, metrics=Metrics(waiting_queue_size=50,
+                                     kv_cache_usage_percent=0.95))])
+        ds = Datastore(pods=[pod])
+        ds.set_pool(InferencePool(name="pool"))
+        ds.store_model(make_model("m", Criticality.SHEDDABLE))
+        proxy = GatewayProxy(
+            Server(Scheduler(provider, token_aware=False,
+                             prefill_aware=False, prefix_aware=False), ds),
+            provider, ds,
+            fairness_cfg=fairness_mod.FairnessConfig(retry_after_s=3))
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/completions", json={"model": "m", "prompt": "x"})
+            assert resp.status == 429
+            assert resp.headers["Retry-After"] == "3"
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_proxy_wires_fairness_everywhere():
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.admission import (
+        AdmissionController,
+    )
+    from llm_instance_gateway_tpu.gateway.testing import make_model
+
+    pod = Pod("pod-0", "127.0.0.1:1")
+    provider = StaticProvider([PodMetrics(pod=pod, metrics=Metrics())])
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name="pool"))
+    ds.store_model(make_model("m"))
+    inner = Scheduler(provider, token_aware=False, prefill_aware=False,
+                      prefix_aware=False)
+    outer = AdmissionController(inner)
+    proxy = GatewayProxy(Server(outer, ds), provider, ds)
+    assert inner.usage_advisor is proxy.fairness
+    assert outer.fairness is proxy.fairness
+    assert proxy.server.fairness is proxy.fairness
+    # /debug/usage carries the fairness section.
+    payload = proxy.usage.debug_payload()
+    payload["fairness"] = proxy.fairness.debug_payload()
+    assert payload["fairness"]["mode"] == "log_only"

@@ -271,9 +271,9 @@ class TestSnapshotResidency:
         calls = []
         orig = sched._marshal
 
-        def counted(state, pods, policy, bad):
+        def counted(state, pods, policy, bad, fairness, noisy):
             calls.append(len(pods))
-            return orig(state, pods, policy, bad)
+            return orig(state, pods, policy, bad, fairness, noisy)
 
         sched._marshal = counted
         return calls

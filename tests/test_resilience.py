@@ -740,7 +740,7 @@ def test_client_disconnect_mid_stream_is_accounted():
 @pytest.mark.slow
 @pytest.mark.parametrize("scenario", ["blackhole", "brownout", "midstream",
                                       "scrape_flap", "handoff",
-                                      "noisy_neighbor"])
+                                      "noisy_neighbor", "adapter_flood"])
 def test_chaos_scenario(scenario):
     from tools import chaos
 

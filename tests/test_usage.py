@@ -469,7 +469,10 @@ class TestUsageRollup:
         assert rollup.noisy() == frozenset({"served"})
         rollup.note_pick("pod-0", "served")
         rollup.note_pick("pod-0", "quiet")
-        assert rollup.would_deprioritize == {"served": 1}
+        # Counted under the flagged (model, adapter) KEY, not just the
+        # matched request name — the offender attribution the log_only
+        # fairness runs need.
+        assert rollup.would_deprioritize == {("served", "base"): 1}
 
     def test_gc_of_flagged_key_journals_exit(self):
         """A noisy key whose adapter leaves every pod's exposition must
@@ -565,7 +568,7 @@ class TestRoutingUnchanged:
         assert picks_plain == picks_advised  # routing byte-identical
         # Only flagged-model picks counted; the quiet model never.
         assert rollup.would_deprioritize_total == 32
-        assert rollup.would_deprioritize == {"m": 32}
+        assert rollup.would_deprioritize == {("base-model", "m"): 32}
 
     def test_native_scheduler_has_the_same_seam(self):
         from llm_instance_gateway_tpu.gateway.scheduling import native
@@ -649,10 +652,13 @@ def test_proxy_debug_usage_endpoint():
         proxy = GatewayProxy(
             Server(Scheduler(provider, token_aware=False,
                              prefill_aware=False), ds), provider, ds)
-        # The pick seam is wired at construction.
+        # The pick seam is wired at construction: the FairnessPolicy wraps
+        # the rollup (log_only keeps it byte-identical to the bare seam).
         outer = proxy.server.scheduler
         sched = getattr(outer, "_scheduler", outer)
-        assert sched.usage_advisor is proxy.usage
+        assert sched.usage_advisor is proxy.fairness
+        assert proxy.fairness.usage is proxy.usage
+        assert proxy.server.fairness is proxy.fairness
         client = TestClient(TestServer(proxy.build_app()))
         await client.start_server()
         try:

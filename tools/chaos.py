@@ -72,13 +72,19 @@ class ChaosStack:
     def __init__(self, schedule, seed: int, rcfg: ResilienceConfig,
                  roles: dict[str, str] | None = None,
                  provider_cls=StaticProvider,
-                 models: tuple[str, ...] = ("m",)):
+                 models: tuple[str, ...] = ("m",),
+                 model_tiers: dict[str, object] | None = None,
+                 fairness_cfg=None):
         self.schedule = schedule
         self.seed = seed
         self.rcfg = rcfg
         self.roles = roles or {GOOD: "collocated", BAD: "collocated"}
         self.provider_cls = provider_cls
         self.models = models
+        # model -> Criticality tier (default Critical, the historical
+        # scenario shape); the fairness scenarios mix tiers.
+        self.model_tiers = model_tiers or {}
+        self.fairness_cfg = fairness_cfg
         self.upstreams: dict[str, TestServer] = {}
         self.state: dict[str, dict] = {}
         self.client: TestClient | None = None
@@ -97,7 +103,9 @@ class ChaosStack:
         ds = Datastore(pods=pods)
         ds.set_pool(InferencePool(name="chaos-pool"))
         for model in self.models:
-            ds.store_model(make_model(model))
+            tier = self.model_tiers.get(model)
+            ds.store_model(make_model(model, tier) if tier is not None
+                           else make_model(model))
         provider = self.provider_cls(
             [PodMetrics(pod=p, metrics=Metrics()) for p in pods])
         scheduler = Scheduler(provider, token_aware=False,
@@ -106,6 +114,7 @@ class ChaosStack:
         self.proxy = GatewayProxy(
             Server(scheduler, ds), provider, ds,
             resilience_cfg=self.rcfg,
+            fairness_cfg=self.fairness_cfg,
             # Fast hysteresis for harness time: 2-tick dwell is the
             # quantity the acceptance criterion counts.
             health_cfg=HealthConfig(dwell_ticks=2, error_streak_floor=3))
@@ -422,6 +431,158 @@ async def scenario_noisy_neighbor(seed: int) -> dict:
         return report
 
 
+async def scenario_adapter_flood(seed: int) -> dict:
+    """Fairness-plane acceptance: one adapter floods long prompts under
+    ``--criticality-mix``-shaped cotenant traffic with the fairness mode
+    ENFORCING.  Within 2 observability ticks of the flood the hog must be
+    throttled (over-quota: bucket-gated, demoted one tier) AND noisy-
+    flagged (quiet tenants' picks steer off the replica hosting it); the
+    quiet tenants' p99 stays within 1.2x of their pre-flood baseline, and
+    ZERO critical requests are shed.
+
+    Traffic shape: the same ``critical/default/sheddable`` tier mix the
+    loadgen's ``--criticality-mix`` emits, so this scenario and future sim
+    calibration share one mold.  The gateway side is fully real (requests
+    flow through the proxy; the REAL UsageRollup + FairnessPolicy score
+    and enforce); the replica side synthesizes the scraped attribution
+    counters per round, like the noisy_neighbor scenario."""
+    import time as time_mod
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import Criticality
+    from llm_instance_gateway_tpu.gateway.fairness import FairnessConfig
+    from llm_instance_gateway_tpu.gateway.loadgen import (
+        parse_criticality_mix,
+    )
+
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="log_only", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    # Tiny bucket so the flood exhausts it within a round; deprioritize +
+    # quotas both ride mode=enforce (default over_ratio: a 60%-of-traffic
+    # quiet tenant must NOT throttle, the flood must).
+    fcfg = FairnessConfig(mode="enforce", quota_rps=0.5, quota_burst=1.0)
+    mix = parse_criticality_mix("critical=0.1,default=0.6,sheddable=0.3")
+    hog, quiet, crit, shed_m = "hog", "quiet-a", "crit", "shed-b"
+    models = (hog, quiet, crit, shed_m)
+    tiers = {hog: Criticality.DEFAULT, quiet: Criticality.DEFAULT,
+             crit: Criticality.CRITICAL, shed_m: Criticality.SHEDDABLE}
+    long_prompt, short_prompt = "flood " * 160, "chaos"
+    async with ChaosStack(schedule, seed, rcfg, models=models,
+                          model_tiers=tiers, fairness_cfg=fcfg) as stack:
+        usage, fairness = stack.proxy.usage, stack.proxy.fairness
+        provider = stack.proxy.provider
+        # The hog adapter is RESIDENT on pod-bad only: once flagged, the
+        # pick plane must steer quiet tenants off that replica.
+        for pm in provider.all_pod_metrics():
+            pm.metrics.active_adapters = (
+                {hog: 0} if pm.pod.name == BAD else {quiet: 0})
+        step_totals = {m: 0.0 for m in models}
+
+        def scrape(prompt_tokens: dict[str, int]) -> None:
+            for m, toks in prompt_tokens.items():
+                step_totals[m] += toks * 1e-3
+            for pm in provider.all_pod_metrics():
+                pm.metrics.adapter_step_seconds = {
+                    ("m", m, "prefill"): step_totals[m] / 2
+                    for m in models}
+
+        quiet_lat: dict[str, list[float]] = {"warm": [], "flood": []}
+        crit_statuses: list[int] = []
+
+        async def timed_quiet(bucket: str) -> None:
+            t0 = time_mod.monotonic()
+            status = await stack.request(model=quiet, prompt=short_prompt)
+            quiet_lat[bucket].append(time_mod.monotonic() - t0)
+            assert status == 200, status
+
+        async def round_(hog_requests: int, bucket: str) -> dict[str, int]:
+            """One traffic round in the shared criticality-mix shape:
+            ~10% critical / 60% default / 30% sheddable cotenants, plus
+            the flood."""
+            toks = {m: 0 for m in models}
+            for _ in range(hog_requests):
+                assert await stack.request(
+                    model=hog, prompt=long_prompt) == 200
+                toks[hog] += len(long_prompt.split())
+            n_quiet = max(1, round(6 * mix["Default"]))
+            n_crit = max(1, round(6 * mix["Critical"]))
+            n_shed = max(1, round(6 * mix["Sheddable"]))
+            for _ in range(n_quiet):
+                await timed_quiet(bucket)
+                toks[quiet] += 1
+            for _ in range(n_crit):
+                crit_statuses.append(await stack.request(
+                    model=crit, prompt=short_prompt))
+                toks[crit] += 1
+            for _ in range(n_shed):
+                await stack.request(model=shed_m, prompt=short_prompt)
+                toks[shed_m] += 1
+            return toks
+
+        def tick() -> None:
+            usage.tick()
+            fairness.tick()
+
+        # Warmup: everyone modest; shares settle, baseline p99 collected.
+        for _ in range(4):
+            scrape(await round_(hog_requests=0, bucket="warm"))
+            tick()
+        assert fairness.throttled() == frozenset(), fairness.debug_payload()
+
+        throttled_after = flagged_after = None
+        for i in range(1, 7):
+            seq0 = stack.proxy.journal.seq
+            scrape(await round_(hog_requests=3, bucket="flood"))
+            tick()
+            if throttled_after is None and hog in fairness.throttled():
+                throttled_after = i
+            if flagged_after is None and hog in usage.noisy():
+                flagged_after = i
+            if i == 6:
+                last_round_picks = [
+                    e["attrs"] for e in stack.proxy.journal.events(
+                        since=seq0, limit=2048, kind=events_mod.PICK)]
+
+        def p99(vals: list[float]) -> float:
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+        base_p99, flood_p99 = p99(quiet_lat["warm"]), p99(quiet_lat["flood"])
+        fdbg = fairness.debug_payload()
+        # Quiet-tenant picks on the hog-hosting replica in the LAST round
+        # (well after the 2-tick bar): the deprioritization steady state.
+        quiet_on_bad = sum(1 for a in last_round_picks
+                           if a["model"] != hog and a["pod"] == BAD)
+        report = {
+            "scenario": "adapter_flood",
+            "throttled_after_ticks": throttled_after,
+            "flagged_after_ticks": flagged_after,
+            "quota_throttles_total": fdbg["quota_throttles_total"],
+            "fairness_demotions_total": fdbg["fairness_demotions_total"],
+            "critical_sheds": sum(1 for s in crit_statuses if s == 429),
+            "crit_requests": len(crit_statuses),
+            "quiet_p99_base_ms": round(base_p99 * 1e3, 2),
+            "quiet_p99_flood_ms": round(flood_p99 * 1e3, 2),
+            "quiet_picks_on_hog_pod_last_round": quiet_on_bad,
+            "throttled": sorted(fairness.throttled()),
+        }
+        # Detection bar: throttled within 2 ticks of the flood.
+        assert throttled_after is not None and throttled_after <= 2, report
+        assert flagged_after is not None, report
+        # The quota actually bit: throttles counted, demotions journaled.
+        assert fdbg["quota_throttles_total"] >= 1, report
+        assert fdbg["fairness_demotions_total"] >= 1, report
+        # Zero critical sheds, every critical request served.
+        assert all(s == 200 for s in crit_statuses), report
+        # Quiet-tenant p99 within 1.2x of baseline (50 ms absolute floor
+        # absorbs in-process rig noise at sub-ms baselines).
+        assert flood_p99 <= max(1.2 * base_p99, base_p99 + 0.05), report
+        # Pick isolation converged: quiet tenants off the hog's replica.
+        assert quiet_on_bad == 0, report
+        return report
+
+
 SCENARIOS = {
     "blackhole": scenario_blackhole,
     "brownout": scenario_brownout,
@@ -429,6 +590,7 @@ SCENARIOS = {
     "scrape_flap": scenario_scrape_flap,
     "handoff": scenario_handoff,
     "noisy_neighbor": scenario_noisy_neighbor,
+    "adapter_flood": scenario_adapter_flood,
 }
 
 

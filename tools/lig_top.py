@@ -65,6 +65,22 @@ def render_table(payload: dict, color: bool = False) -> str:
         % (waste.get("idle_slot_seconds", 0.0),
            waste.get("prefill_padding_tokens", 0)))
     lines.append("noisy: %s" % (", ".join(noisy) if noisy else "none"))
+    fairness = payload.get("fairness") or {}
+    if fairness:
+        lines.append(
+            "fairness: mode=%s throttles=%d demotions=%d escapes=%d"
+            % (fairness.get("mode", "log_only"),
+               fairness.get("quota_throttles_total", 0),
+               fairness.get("fairness_demotions_total", 0),
+               fairness.get("escape_total", 0)))
+        for row in fairness.get("throttled") or []:
+            line = ("  throttled %s/%s share=%.2f fair=%.2f quota=%.1f "
+                    "demotions=%d"
+                    % (row.get("model", ""), row.get("adapter", ""),
+                       row.get("share", 0.0), row.get("fair_share", 0.0),
+                       row.get("quota_remaining", 0.0),
+                       row.get("demotions", 0)))
+            lines.append(f"{RED}{line}{RESET}" if color else line)
     lines.append("")
     head = _row(COLUMNS, BOLD if color else "")
     lines.append(head)
