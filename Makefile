@@ -34,10 +34,11 @@ metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
 top:             ## one-shot lig-top render of a running gateway's /debug/usage
 	python tools/lig_top.py --once --url $${LIG_URL:-http://localhost:8081}
 
-usage-check:     ## attribution conservation + noisy-neighbor + fairness + docs currency
-	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_metrics_docs.py -q
+usage-check:     ## attribution conservation + noisy-neighbor + fairness + placement + docs currency
+	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_metrics_docs.py -q
 	python tools/chaos.py --seed 0 --scenario noisy_neighbor
 	python tools/chaos.py --seed 0 --scenario adapter_flood
+	python tools/chaos.py --seed 0 --scenario cold_start_storm
 
 docker-build:    ## build the framework image
 	docker build -t $(IMG) .

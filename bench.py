@@ -615,6 +615,70 @@ def run_fairness_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_placement_microbench(n: int = 4000, n_pods: int = 64) -> dict:
+    """Placement pick-steering cost A/B (placement PR acceptance bar:
+    ``pick_placement_ratio`` <= 1.05 — ``prefer_resident`` costs < 5% of
+    a pick vs no placement advisor).
+
+    Same harness shape as ``run_fairness_microbench``: a real Python
+    filter-tree scheduler over a static fleet whose pods export residency
+    tiers (a quarter slot-resident, a quarter host-resident for the
+    request's adapter, so ``filter_by_placement`` does real two-level
+    narrowing on every pick) with a REAL ticked PlacementPlanner, vs no
+    advisor at all.  Interleaved runs, MIN per side.
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway import placement as placement_mod
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod,
+    )
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    provider = StaticProvider([
+        PodMetrics(pod=fake_pod(i),
+                   metrics=fake_metrics(
+                       queue=i % 5, kv=(i % 10) / 10.0,
+                       adapters={"hot": 0} if i % 4 == 0 else {},
+                       max_adapters=2,
+                       adapter_tiers=({"hot": "slot"} if i % 4 == 0
+                                      else {"hot": "host"} if i % 4 == 1
+                                      else {})))
+        for i in range(n_pods)
+    ])
+    req = LLMRequest(model="hot", resolved_target_model="hot",
+                     critical=True, prompt_tokens=25,
+                     criticality="Critical")
+    planner = placement_mod.PlacementPlanner(
+        provider, cfg=placement_mod.PlacementConfig(mode="prefer_resident"))
+    planner.tick()
+
+    off = Scheduler(provider, prefix_aware=False, rng=random_mod.Random(0))
+    steered = Scheduler(provider, prefix_aware=False,
+                        rng=random_mod.Random(0))
+    steered.placement_advisor = planner
+
+    def loop(sched) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sched.schedule(req)
+        return time.perf_counter() - t0
+
+    loop(off), loop(steered)  # warmup pair
+    base_best = steer_best = float("inf")
+    for _ in range(12):
+        base_best = min(base_best, loop(off))
+        steer_best = min(steer_best, loop(steered))
+    return {
+        "pick_placement_off_us": round(base_best / n * 1e6, 2),
+        "pick_placement_resident_us": round(steer_best / n * 1e6, 2),
+        "pick_placement_ratio": round(steer_best / base_best, 4),
+    }
+
+
 def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
                                n_models: int = 1000,
                                batch: int = 64) -> dict:
@@ -1175,6 +1239,12 @@ if __name__ == "__main__":
             results.update(run_fairness_microbench())
         except Exception as e:
             results["pick_fairness_error"] = str(e)[:200]
+        try:
+            # Placement microbench (placement PR): steering cost of
+            # placement_mode=prefer_resident vs no advisor.
+            results.update(run_placement_microbench())
+        except Exception as e:
+            results["pick_placement_error"] = str(e)[:200]
         try:
             # Data-plane fast path (perf PR 6): snapshot-resident native
             # pick + batched pick_many cost at the loadgen fixture scale.

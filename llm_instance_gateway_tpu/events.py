@@ -53,6 +53,8 @@ NOISY_NEIGHBOR = "noisy_neighbor"       # adapter usage flag changed (usage.py)
 QUOTA_THROTTLE = "quota_throttle"       # tenant over quota (fairness.py)
 FAIRNESS_DEMOTE = "fairness_demote"     # over-quota request demoted one tier
 FAIRNESS_ESCAPE = "fairness_escape"     # fairness pick filter last-resort
+PLACEMENT_DECISION = "placement_decision"  # planner emitted a tier action
+PLACEMENT_ESCAPE = "placement_escape"   # no resident candidate: full set served
 
 
 class EventJournal:

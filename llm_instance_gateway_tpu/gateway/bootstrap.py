@@ -565,6 +565,46 @@ def add_resilience_args(parser) -> None:
                              "response within this many seconds "
                              "(0 = disabled)")
     add_fairness_args(parser)
+    add_placement_args(parser)
+
+
+def add_placement_args(parser: argparse.ArgumentParser) -> None:
+    """Adapter residency / placement-plane flags (gateway/placement.py).
+    ``add_resilience_args`` includes these."""
+    from llm_instance_gateway_tpu.gateway.placement import (
+        PLACEMENT_MODES,
+        PlacementConfig,
+    )
+
+    p = PlacementConfig()
+    parser.add_argument("--placement-mode", choices=list(PLACEMENT_MODES),
+                        default=p.mode,
+                        help="residency-aware routing: log_only counts "
+                             "picks that missed a resident replica only "
+                             "(routing unchanged); prefer_resident steers "
+                             "picks toward pods where the adapter is slot- "
+                             "or host-RAM-resident, with a counted "
+                             "last-resort escape hatch")
+    parser.add_argument("--placement-prefetch-share", type=float,
+                        default=p.prefetch_min_share,
+                        help="pool step-seconds share at which a non-"
+                             "resident adapter earns a host-RAM prefetch "
+                             "(waiting adapters prefetch regardless)")
+    parser.add_argument("--placement-checkpoint-root", default=p.checkpoint_root,
+                        help="checkpoint path template root for prefetch "
+                             "decisions ({root}/{adapter}); empty = the "
+                             "sidecar resolves sources from its own config")
+
+
+def placement_from_args(args):
+    """Build a PlacementConfig from ``add_placement_args`` flags."""
+    from llm_instance_gateway_tpu.gateway.placement import PlacementConfig
+
+    return PlacementConfig(
+        mode=args.placement_mode,
+        prefetch_min_share=args.placement_prefetch_share,
+        checkpoint_root=args.placement_checkpoint_root,
+    )
 
 
 def add_fairness_args(parser: argparse.ArgumentParser) -> None:

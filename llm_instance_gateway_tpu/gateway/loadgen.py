@@ -100,10 +100,13 @@ def assign_tiers(model_names: list[str], mix: dict[str, float],
             for name in model_names}
 
 
-def parse_adapter_mix(spec: str) -> dict[str, float]:
+def parse_adapter_mix(spec: str, normalize: bool = True) -> dict[str, float]:
     """``"a=0.7,b=0.2,base=0.1"`` -> normalized weight dict.  ``base``
     routes to the shared base model (no adapter); weights need not sum to
-    1 (they normalize), but must be positive."""
+    1 (they normalize), but must be positive.  ``normalize=False`` keeps
+    the raw weights — the --adapter-universe overlay path, where
+    ``base=0.1`` must mean a 0.1 ABSOLUTE share carved out of the Zipf
+    mass, not "100% of a one-entry mix"."""
     mix: dict[str, float] = {}
     for part in spec.split(","):
         part = part.strip()
@@ -121,11 +124,58 @@ def parse_adapter_mix(spec: str) -> dict[str, float]:
         mix[name.strip()] = mix.get(name.strip(), 0.0) + w
     if not mix:
         raise ValueError("empty adapter mix")
+    if not normalize:
+        return mix
     total = sum(mix.values())
     return {k: v / total for k, v in mix.items()}
 
 
-def build_mix_fixture(num_fake_pods: int, mix: dict[str, float]):
+def build_universe_mix(universe: int, zipf_s: float,
+                       extra_mix: dict[str, float] | None = None
+                       ) -> dict[str, float]:
+    """Zipf adapter mix over a synthetic universe — THE SAME weights and
+    ``zipf-0000..`` naming as ``sim/run.py`` (one shared helper, so a
+    loadgen per-residency-tier report and a sim ``ttft_by_adapter``
+    report cross-correlate by adapter name and can never silently
+    diverge).  ``extra_mix`` entries (an explicit ``--adapter-mix``,
+    e.g. ``base=0.1``) merge on top and the whole thing renormalizes, so
+    the universe composes with the existing mix machinery instead of
+    replacing it."""
+    from llm_instance_gateway_tpu.sim.run import universe_name, zipf_weights
+
+    if universe <= 0:
+        raise ValueError("adapter universe must be > 0")
+    mix = {universe_name(k): w
+           for k, w in enumerate(zipf_weights(universe, zipf_s))}
+    if extra_mix:
+        extra_total = sum(extra_mix.values())
+        scale = max(0.0, 1.0 - extra_total)
+        mix = {name: w * scale for name, w in mix.items()}
+        mix.update(extra_mix)
+        total = sum(mix.values())
+        mix = {name: w / total for name, w in mix.items()}
+    return mix
+
+
+def assign_residency_tiers(mix: dict[str, float], slot_per_pod: int = 16,
+                           host_per_pod: int = 128) -> dict[str, str]:
+    """Adapter -> residency tier for the universe fixture: the hottest
+    ``slot_per_pod`` adapters are slot-resident, the next
+    ``host_per_pod`` host-RAM-resident, the long tail disk-only — the
+    <10%-resident shape of the tentpole's target scenario."""
+    ranked = sorted((n for n in mix if n != "base"),
+                    key=lambda n: (-mix[n], n))
+    tiers: dict[str, str] = {}
+    for i, name in enumerate(ranked):
+        if i < slot_per_pod:
+            tiers[name] = "slot"
+        elif i < slot_per_pod + host_per_pod:
+            tiers[name] = "host"
+    return tiers
+
+
+def build_mix_fixture(num_fake_pods: int, mix: dict[str, float],
+                      tiers: dict[str, str] | None = None):
     """Weighted-adapter rig: every pod serves ALL mix adapters (affinity
     is trivially satisfiable — the variable under test is the traffic
     skew, the reproducible noisy-neighbor input), plus the shared base
@@ -133,10 +183,21 @@ def build_mix_fixture(num_fake_pods: int, mix: dict[str, float]):
     adapters = sorted(n for n in mix if n != "base")
     pods = {}
     for i in range(num_fake_pods):
+        if tiers is None:
+            active = {name: 0 for name in adapters}
+            max_adapters = len(adapters) + 1
+        else:
+            # Universe rig: only slot-resident adapters are ACTIVE (the
+            # engine's lora_requests_info semantics); the host tier rides
+            # adapter_tiers, the long tail is absent (disk).
+            active = {n for n, t in tiers.items() if t == "slot"}
+            active = {name: 0 for name in active}
+            max_adapters = max(1, len(active))
         pods[fake_pod(i)] = fake_metrics(
             queue=i % 5, kv=(i % 10) / 10.0,
-            adapters={name: 0 for name in adapters},
-            max_adapters=len(adapters) + 1,
+            adapters=active,
+            max_adapters=max_adapters,
+            adapter_tiers=tiers or {},
         )
     models = [make_model(name, Criticality.CRITICAL) for name in adapters]
     models.append(make_model("shared-base", Criticality.CRITICAL))
@@ -196,6 +257,8 @@ def run_load(
     adapter_mix: dict[str, float] | None = None,
     mix_seed: int = 0,
     criticality_mix: dict[str, float] | None = None,
+    adapter_universe: int = 0,
+    adapter_zipf: float = 1.1,
     fast_path: bool = True,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
@@ -223,14 +286,24 @@ def run_load(
             f"session_prefix_chars must be >= {PREFIX_BLOCK_CHARS} (the "
             "affinity hash covers whole blocks only; a shorter prefix "
             "would measure a no-op)")
-    if adapter_mix and session_prefix_chars:
+    if (adapter_mix or adapter_universe) and session_prefix_chars:
         raise ValueError("adapter-mix and session modes are exclusive "
                          "(each defines its own traffic shape)")
-    if adapter_mix and role_split:
+    if (adapter_mix or adapter_universe) and role_split:
         raise ValueError("adapter-mix builds an all-collocated fleet; "
                          "combining it with --role-split would report a "
                          "meaningless two_stage_rate")
-    if adapter_mix:
+    residency_tiers: dict[str, str] | None = None
+    if adapter_universe:
+        # Seeded Zipf draw over a synthetic universe, composing with an
+        # explicit --adapter-mix (its entries overlay, e.g. base=0.1) and
+        # with --criticality-mix (tier assignment over the same models).
+        adapter_mix = build_universe_mix(adapter_universe, adapter_zipf,
+                                         extra_mix=adapter_mix)
+        residency_tiers = assign_residency_tiers(adapter_mix)
+        pods, models = build_mix_fixture(num_fake_pods, adapter_mix,
+                                         tiers=residency_tiers)
+    elif adapter_mix:
         pods, models = build_mix_fixture(num_fake_pods, adapter_mix)
     else:
         pods, models = build_fixture(
@@ -270,6 +343,17 @@ def run_load(
     per_adapter_lat: dict[str, list[float]] = {}
     per_tier_lat: dict[str, list[float]] = {}
     per_tier_shed: dict[str, int] = {}
+    # Residency-tier breakdown (universe mode): latency of requests whose
+    # adapter is slot- / host- / disk-tier in the fixture — the TTFT-
+    # by-tier shape the placement scenario's acceptance bar reads.
+    per_res_tier_lat: dict[str, list[float]] = {}
+
+    def res_tier_account(adapter: str | None, latency_s: float) -> None:
+        if residency_tiers is None or adapter is None:
+            return
+        tier = ("base" if adapter == "base"
+                else residency_tiers.get(adapter, "disk"))
+        per_res_tier_lat.setdefault(tier, []).append(latency_s)
     sheds = 0  # only nonzero under --criticality-mix (asserted otherwise)
 
     def body_for(i: int) -> tuple[bytes, int | None, str | None, str]:
@@ -342,6 +426,7 @@ def run_load(
             latencies.append(t1 - t0)
             if adapter is not None:
                 per_adapter_lat.setdefault(adapter, []).append(t1 - t0)
+            res_tier_account(adapter, t1 - t0)
             account(res.set_headers, sid)
         wall = time.perf_counter() - t_start
     else:
@@ -389,6 +474,7 @@ def run_load(
                     adapter = bodies[k][2]
                     if adapter is not None:
                         per_adapter_lat.setdefault(adapter, []).append(lat)
+                    res_tier_account(adapter, lat)
                     keys = {
                         h.header.key: (h.header.raw_value.decode("utf-8",
                                                                  "replace")
@@ -434,7 +520,23 @@ def run_load(
         # trip IS the gateway decision phase under this rig.
         with open(trace_out, "w") as f:
             json.dump({"phases": {"extproc.process": latencies}}, f)
-    if adapter_mix:
+    if adapter_universe:
+        # Universe mode: the flat per-adapter dump would be 1000+ rows —
+        # the per-RESIDENCY-tier breakdown is the shape that matters (the
+        # slot/host/disk latency split the placement plane acts on).
+        out["adapter_universe"] = adapter_universe
+        out["adapter_zipf"] = adapter_zipf
+        tiers_summary = {}
+        for tier in sorted(per_res_tier_lat):
+            vals = sorted(per_res_tier_lat[tier])
+            tiers_summary[tier] = {
+                "requests": len(vals),
+                "p50_us": round(vals[len(vals) // 2] * 1e6, 1),
+                "p99_us": round(
+                    vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1e6, 1),
+            }
+        out["per_residency_tier"] = tiers_summary
+    elif adapter_mix:
         # Per-adapter latency breakdown: the observable a noisy-neighbor
         # scenario compares against the gateway's usage attribution.
         out["adapter_mix"] = {k: round(v, 4)
@@ -514,6 +616,17 @@ def main(argv=None):
                              'latency breakdown in the report')
     parser.add_argument("--mix-seed", type=int, default=0,
                         help="seed for the weighted adapter draw")
+    parser.add_argument("--adapter-universe", type=int, default=0,
+                        metavar="N",
+                        help="long-tail traffic: N synthetic adapters with "
+                             "seeded Zipf-weighted traffic (composes with "
+                             "--adapter-mix overlays and --criticality-mix); "
+                             "the fixture tiers the hottest adapters "
+                             "slot/host-resident and the report gains a "
+                             "per-residency-tier latency breakdown")
+    parser.add_argument("--adapter-zipf", type=float, default=1.1,
+                        metavar="S",
+                        help="Zipf exponent for --adapter-universe traffic")
     parser.add_argument("--criticality-mix", default=None, metavar="SPEC",
                         help='weighted criticality tiers, e.g. '
                              '"critical=0.1,default=0.6,sheddable=0.3": '
@@ -532,12 +645,16 @@ def main(argv=None):
                        session_count=args.sessions,
                        role_split=args.role_split,
                        trace_out=args.trace_out,
-                       adapter_mix=(parse_adapter_mix(args.adapter_mix)
+                       adapter_mix=(parse_adapter_mix(
+                                        args.adapter_mix,
+                                        normalize=not args.adapter_universe)
                                     if args.adapter_mix else None),
                        mix_seed=args.mix_seed,
                        criticality_mix=(
                            parse_criticality_mix(args.criticality_mix)
                            if args.criticality_mix else None),
+                       adapter_universe=args.adapter_universe,
+                       adapter_zipf=args.adapter_zipf,
                        fast_path=not args.no_fast_path)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))

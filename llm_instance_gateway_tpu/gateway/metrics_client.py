@@ -52,6 +52,12 @@ LORA_ADAPTERS_LABEL = "running_lora_adapters"
 LORA_WAITING_LABEL = "waiting_lora_adapters"
 LORA_MAX_LABEL = "max_lora"
 LORA_RANKS_LABEL = "adapter_ranks"  # optional name:rank CSV (rank-aware fairness)
+LORA_TIERS_LABEL = "resident_tiers"  # optional name:tier CSV (residency summary)
+# Residency ladder (server/lora_manager.py): one info line per tier with an
+# ``adapters`` CSV; value is a unix timestamp (latest series wins per tier).
+RESIDENCY_INFO_METRIC = "tpu:adapter_residency_info"
+RESIDENCY_TIER_LABEL = "tier"
+RESIDENCY_ADAPTERS_LABEL = "adapters"
 PREFILL_QUEUE_METRIC = "tpu:prefill_queue_size"
 DECODE_QUEUE_METRIC = "tpu:decode_queue_size"
 RUNNING_METRIC = "tpu:num_requests_running"
@@ -178,6 +184,12 @@ def families_to_metrics(
             if name:
                 adapters[name] = 0
         updated.active_adapters = adapters
+        # Running/waiting split kept ALONGSIDE the union: the placement
+        # planner reads waiting as its prefetch-urgency signal.
+        updated.running_adapters = frozenset(
+            n.strip() for n in csv.split(",") if n.strip())
+        updated.waiting_adapters = frozenset(
+            n.strip() for n in waiting_csv.split(",") if n.strip())
         # Optional name:rank CSV (our server exports it; foreign vLLM-style
         # servers simply lack the label and ranks stay unknown).
         ranks: dict[str, int] = {}
@@ -191,6 +203,15 @@ def families_to_metrics(
                 errs.append(
                     f"invalid {LORA_RANKS_LABEL} entry: {entry!r}")
         updated.adapter_ranks = ranks
+        # Optional name:tier residency summary CSV — the fallback source
+        # for adapter_tiers when the dedicated residency family is absent
+        # (the family below overrides when present).
+        tiers: dict[str, str] = {}
+        for entry in best.labels.get(LORA_TIERS_LABEL, "").split(","):
+            name, sep, tier = entry.strip().rpartition(":")
+            if sep and name and tier:
+                tiers[name] = tier
+        updated.adapter_tiers = tiers
         raw_max = best.labels.get(LORA_MAX_LABEL)
         if raw_max is None:
             # Without max_lora the slot-room predicates are permanently false
@@ -202,6 +223,24 @@ def families_to_metrics(
                 updated.max_active_adapters = int(float(raw_max))
             except ValueError:
                 errs.append(f"invalid {LORA_MAX_LABEL} label: {best.labels}")
+
+    # Residency ladder (optional): per-tier info lines; latest sample per
+    # tier wins (value = unix ts, like the LoRA info gauge).  Rebuilt whole
+    # each scrape so demoted/evicted adapters drop their tier immediately.
+    res_samples = families.get(RESIDENCY_INFO_METRIC, [])
+    if res_samples:
+        by_tier: dict[str, prom_parse.Sample] = {}
+        for s in res_samples:
+            tier = s.labels.get(RESIDENCY_TIER_LABEL, "")
+            if tier and (tier not in by_tier or s.value > by_tier[tier].value):
+                by_tier[tier] = s
+        tiers = {}
+        for tier, s in by_tier.items():
+            for name in s.labels.get(RESIDENCY_ADAPTERS_LABEL, "").split(","):
+                name = name.strip()
+                if name:
+                    tiers[name] = tier
+        updated.adapter_tiers = tiers
     return updated, errs
 
 

@@ -1,0 +1,463 @@
+"""Adapter residency & placement plane: tiered-LoRA orchestration at pool
+scale (ROADMAP item 2 — MinT / InfiniLoRA-style disaggregated multi-LoRA
+placement, arxiv 2605.13779 / 2604.07173).
+
+At thousands-of-adapters scale only a sliver of the adapter universe fits
+TPU-slot-resident; the rest must live down a residency ladder the engine
+now implements (``server/lora_manager.py``: TPU slot -> host RAM -> Orbax
+checkpoint, with per-tier load latency exported).  This module is the
+gateway-side brain over that ladder — the ``PlacementPlanner``:
+
+- **Inputs** (fused on the observability tick): the PR-5 usage plane's
+  EMA consumption shares (``gateway/usage.py`` — who is actually hot), the
+  LoRA-affinity scorer's running/waiting split (a WAITING adapter means
+  parked requests are already paying its cold start), per-pod residency
+  tiers scraped from ``tpu:adapter_residency_info``, and per-pod load.
+
+- **Cost model**: a cold (disk-tier) hit costs ``disk_load_s`` of extra
+  TTFT; a host-tier hit costs ``host_load_s``; a slot hit costs nothing.
+  An adapter's expected cold-start tax is its traffic share times the
+  load latency of its best tier — the planner spends its bounded action
+  budget where that tax is largest (prefetch/migrate) and reclaims
+  capacity where it rounds to zero (demote idle slots, evict idle host
+  entries).
+
+- **Decisions** are emitted as a plan, not executed here: the
+  ``lora_sidecar``'s ``--planner-url`` mode polls ``/debug/placement``
+  and drives its replica over the existing adapter wire
+  (``/v1/load|demote|prefetch|evict_lora_adapter``).  The planner is
+  therefore a pure control plane — restartable, and its decision core
+  (``plan()``) is a pure function of its inputs, which is what the sim
+  validates before any live rollout (``sim/run.py`` placement scenario).
+
+- **Routing seam**: ``placement_mode=log_only`` (default) only counts
+  picks that landed on a pod where the adapter was NOT RAM-resident while
+  a resident replica existed (``gateway_placement_would_steer_total``) —
+  routing stays byte-identical, pinned by same-RNG diff tests.
+  ``prefer_resident`` promotes the seam: ``filter_by_placement``
+  (scheduling/scheduler.py, mirrored natively in scheduler.cc) narrows
+  survivor sets to slot/host-resident pods with the usual counted
+  last-resort escape hatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import escape_label, render_keyed_family
+
+# Tier names mirror server/lora_manager.py's RESIDENCY_TIERS — duplicated
+# (not imported) so the gateway process never pulls the server's jax stack.
+TIER_SLOT, TIER_HOST, TIER_DISK = "slot", "host", "disk"
+
+LOG_ONLY, PREFER_RESIDENT = "log_only", "prefer_resident"
+PLACEMENT_MODES = (LOG_ONLY, PREFER_RESIDENT)
+
+# Decision actions (the sidecar's executable verbs; ``migrate`` executes
+# as a load on the target replica — promotion from host when prefetched,
+# Orbax restore otherwise).
+DEMOTE, EVICT, PREFETCH, MIGRATE = "demote", "evict", "prefetch", "migrate"
+
+
+@dataclass(frozen=True)
+class PlacementConfig:
+    """Knobs for the placement plane (flags: ``add_placement_args``)."""
+
+    # log_only: plan + count, routing untouched (byte-identical).
+    # prefer_resident: picks narrow to pods where the adapter is slot- or
+    # host-resident, with a counted escape hatch.
+    mode: str = LOG_ONLY
+    # An adapter whose pool step-seconds share is below this counts as
+    # idle for demotion/eviction dwell purposes.
+    idle_share: float = 0.005
+    # Consecutive idle ticks before a slot-resident adapter demotes to
+    # host RAM, and before a host-resident one evicts to disk.  Demotion
+    # is cheap to undo (one device put), eviction costs a full restore —
+    # hence the longer dwell.
+    demote_idle_ticks: int = 3
+    evict_idle_ticks: int = 6
+    # Share at which an adapter earns host-RAM residency on EVERY replica
+    # (head replication, the MinT shape: the Zipf head is hot enough that
+    # any replica may be asked to serve it, and a host copy turns the
+    # cold-start disk restore into a cheap promote wherever the pick
+    # lands — the filter tree legitimately routes a hot adapter off its
+    # home when the home is the busiest pod).  Below the bar, a WAITING
+    # adapter still prefetches onto one replica — parked requests are
+    # already paying the cold start.
+    prefetch_min_share: float = 0.02
+    # Share at which a hot adapter resident only on overloaded replicas
+    # is replicated toward an under-utilized one.
+    migrate_min_share: float = 0.25
+    # A replica counts overloaded when its total queue exceeds this
+    # factor x the pool median (and under-utilized below 1/factor).
+    hot_queue_factor: float = 2.0
+    # Decision budget per tick: a planner must never emit a load storm
+    # (each prefetch is an Orbax restore on the target replica).
+    max_actions_per_tick: int = 8
+    # Cost-model constants: estimated extra TTFT for a cold (disk) hit
+    # and a host-tier hit.  Calibrated defaults come from the engine's
+    # tpu:adapter_load_seconds exposition once real loads flow.
+    disk_load_s: float = 0.5
+    host_load_s: float = 0.05
+    # Checkpoint path template for prefetch decisions: ``{root}/{name}``.
+    # Empty: decisions carry no path and the sidecar resolves the source
+    # from its own config registry.
+    checkpoint_root: str = ""
+
+    def __post_init__(self):
+        if self.mode not in PLACEMENT_MODES:
+            raise ValueError(
+                f"placement mode {self.mode!r} not in {PLACEMENT_MODES}")
+        if (self.demote_idle_ticks < 1 or self.evict_idle_ticks < 1
+                or self.max_actions_per_tick < 1):
+            raise ValueError("placement dwell/budget knobs must be >= 1")
+        if self.disk_load_s < 0 or self.host_load_s < 0:
+            raise ValueError("placement load-cost constants must be >= 0")
+
+
+class PlacementPlanner:
+    """Gateway-side residency orchestrator + the scheduler's
+    ``placement_advisor`` seam.  Thread-safe: the pick seam reads cached
+    frozensets, the observability tick rebuilds them."""
+
+    def __init__(self, provider, usage=None,
+                 cfg: PlacementConfig | None = None,
+                 journal: events_mod.EventJournal | None = None,
+                 clock=time.time):
+        self.provider = provider
+        self.usage = usage          # gateway.usage.UsageRollup (may be None)
+        self.cfg = cfg or PlacementConfig()
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Tick-computed state:
+        self._idle: dict[tuple[str, str], int] = {}  # (pod, adapter) -> ticks
+        self._decisions: list[dict] = []     # latest tick's plan
+        self._residency: dict[str, dict] = {}  # pod -> {adapter: tier}
+        # adapter -> frozenset(pod names) where slot- or host-resident —
+        # the pick seam's mark set, swapped whole per tick so reads are
+        # lock-free (same shape as usage._noisy_models).
+        self._resident_pods: dict[str, frozenset] = {}
+        # adapter -> (slot-tier pods, host-tier pods): the two-level mark
+        # set prefer_resident steering uses — a slot pick costs nothing,
+        # a host pick pays the promote, so slot-resident candidates win
+        # ties over host-resident ones.
+        self._tier_pods: dict[str, tuple] = {}
+        self._have_residency = False
+        self._model_of: dict[str, str] = {}  # adapter -> model (usage keys)
+        # Exported counters.
+        self.decisions_total: dict[tuple, int] = {}
+        self.would_steer_total = 0
+        self.wrong_tier_total = 0
+        self.escape_total = 0
+        self.ticks = 0
+        self.last_tick = 0.0
+
+    # -- config ------------------------------------------------------------
+    @property
+    def mode(self) -> str:
+        return self.cfg.mode
+
+    def update_config(self, cfg: PlacementConfig) -> None:
+        if cfg != self.cfg:
+            self.cfg = cfg
+
+    # -- scheduler advisor seam --------------------------------------------
+    def resident_pods(self, adapter: str | None) -> frozenset | None:
+        """Pods where ``adapter`` is slot- or host-resident; None when the
+        pool exports no residency data at all (foreign servers — the
+        filter then has nothing to steer on and stays inert)."""
+        if adapter is None or not self._have_residency:
+            return None
+        return self._resident_pods.get(adapter, frozenset())
+
+    def resident_tiers(self, adapter: str | None) -> tuple | None:
+        """(slot-tier pods, host-tier pods) for ``adapter`` — the two-
+        level mark set ``filter_by_placement`` narrows on; None when no
+        residency data exists."""
+        if adapter is None or not self._have_residency:
+            return None
+        return self._tier_pods.get(adapter, (frozenset(), frozenset()))
+
+    def resident_map(self) -> dict[str, tuple] | None:
+        """The whole adapter -> (slot pods, host pods) map (swapped per
+        tick, so identity doubles as a staleness signal for the native
+        scheduler's snapshot marshal); None when the pool exports no
+        residency."""
+        if not self._have_residency:
+            return None
+        return self._tier_pods
+
+    def note_pick(self, pod_name: str, adapter: str | None) -> None:
+        """Count picks that landed OFF a resident replica while one
+        existed.  Never influences the pick — no RNG, no filtering — so
+        log_only keeps routing byte-identical (same-RNG diff tests).  In
+        prefer_resident the count is the wrong-tier-pick observable the
+        cold_start_storm chaos scenario pins at zero (escapes excepted,
+        counted separately)."""
+        if adapter is None or not self._have_residency:
+            return
+        resident = self._resident_pods.get(adapter)
+        if not resident or pod_name in resident:
+            return
+        with self._lock:
+            if self.cfg.mode == PREFER_RESIDENT:
+                self.wrong_tier_total += 1
+            else:
+                self.would_steer_total += 1
+
+    def note_placement_escape(self) -> None:
+        """No candidate held the adapter in a RAM tier: the pick proceeded
+        over the full set (the counted last-resort hatch, mirroring the
+        health/fairness filters)."""
+        with self._lock:
+            self.escape_total += 1
+        if self.journal is not None:
+            self.journal.emit(events_mod.PLACEMENT_ESCAPE,
+                              mode=self.cfg.mode)
+
+    # -- decision core (pure; sim-validated) --------------------------------
+    def plan(self, shares: dict[str, float], waiting: dict[str, set],
+             residency: dict[str, dict], pod_load: dict[str, int],
+             idle: dict[tuple[str, str], int]) -> list[dict]:
+        """Compute one tick's decisions from explicit inputs.
+
+        ``shares``: adapter -> pool step-seconds share (EMA).
+        ``waiting``: adapter -> pods where requests are parked on it.
+        ``residency``: pod -> {adapter: tier}.
+        ``pod_load``: pod -> total queue depth.
+        ``idle``: (pod, adapter) -> consecutive idle ticks (maintained by
+        the caller; ``tick()`` owns the live copy, the sim its own).
+
+        Pure function of its arguments — ``sim/run.py`` drives exactly
+        this method against simulated state, so the policy that deploys
+        is the policy that was validated.
+        """
+        cfg = self.cfg
+        budget = cfg.max_actions_per_tick
+        decisions: list[dict] = []
+
+        def emit(action: str, pod: str, adapter: str, reason: str,
+                 path: str = "") -> bool:
+            if len(decisions) >= budget:
+                return False
+            decisions.append({
+                "action": action, "pod": pod, "adapter": adapter,
+                "path": path or (f"{cfg.checkpoint_root.rstrip('/')}/{adapter}"
+                                 if cfg.checkpoint_root else ""),
+                "reason": reason,
+            })
+            return True
+
+        resident_anywhere: dict[str, set] = {}
+        for pod, tiers in residency.items():
+            for adapter in tiers:
+                resident_anywhere.setdefault(adapter, set()).add(pod)
+        loads = sorted(pod_load.values())
+        median_load = loads[len(loads) // 2] if loads else 0
+
+        # 1) Prefetch, two regimes:
+        #    (a) head replication — adapters above prefetch_min_share stay
+        #        RAM-resident on EVERY replica (hottest first), so wherever
+        #        the load-aware tree lands their next request the cold
+        #        start is a cheap host promote, never a disk restore;
+        #    (b) waiting rescue — a colder adapter with parked requests
+        #        prefetches onto the least-loaded replica (those requests
+        #        are paying its cold start right now).
+        for adapter in sorted(shares, key=lambda a: (-shares[a], a)):
+            share = shares[adapter]
+            if share < cfg.prefetch_min_share:
+                break  # sorted: everything after is colder
+            homes = resident_anywhere.get(adapter, ())
+            for pod in sorted(pod_load, key=lambda p: (pod_load[p], p)):
+                if pod in homes:
+                    continue
+                if not emit(PREFETCH, pod, adapter,
+                            "head share %.3f >= %.3f" % (
+                                share, cfg.prefetch_min_share)):
+                    return decisions
+        for adapter in sorted(waiting):
+            if (adapter in resident_anywhere
+                    or shares.get(adapter, 0.0) >= cfg.prefetch_min_share):
+                continue  # head rule owns the hot ones
+            target = min(pod_load, key=lambda p: (pod_load[p], p),
+                         default=None)
+            if target is None:
+                break
+            if not emit(PREFETCH, target, adapter, "waiting"):
+                return decisions
+
+        # 2) Migrate: hot adapters resident ONLY on overloaded replicas
+        #    grow a copy on an under-utilized one.
+        hot_bar = cfg.hot_queue_factor * max(1, median_load)
+        for adapter in sorted(shares, key=lambda a: (-shares[a], a)):
+            share = shares[adapter]
+            if share < cfg.migrate_min_share:
+                break
+            homes = resident_anywhere.get(adapter)
+            if not homes:
+                continue  # cold: prefetch rule owns it
+            if not all(pod_load.get(p, 0) > hot_bar for p in homes):
+                continue  # at least one calm home: leave it be
+            candidates = [p for p in pod_load
+                          if p not in homes and pod_load[p] <= median_load]
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda p: (pod_load[p], p))
+            if not emit(MIGRATE, target, adapter,
+                        "hot (share %.3f) on overloaded replicas only"
+                        % share):
+                return decisions
+
+        # 3) Demote / evict: reclaim tiers from idle adapters (dwell-
+        #    filtered so one quiet tick never thrashes a working set).
+        for (pod, adapter) in sorted(idle):
+            ticks = idle[(pod, adapter)]
+            tier = residency.get(pod, {}).get(adapter)
+            if tier == TIER_SLOT and ticks >= cfg.demote_idle_ticks:
+                if not emit(DEMOTE, pod, adapter,
+                            "idle %d ticks in slot" % ticks):
+                    return decisions
+            elif tier == TIER_HOST and ticks >= cfg.evict_idle_ticks:
+                if not emit(EVICT, pod, adapter,
+                            "idle %d ticks in host RAM" % ticks):
+                    return decisions
+        return decisions
+
+    # -- tick ---------------------------------------------------------------
+    def tick(self, now: float | None = None) -> None:
+        """Observability-cadence pass: fuse usage shares + residency +
+        waiting split, update idle dwell, emit the tick's plan.  Runs
+        AFTER ``usage.tick()`` so shares are current."""
+        now = self._clock() if now is None else now
+        pods = self.provider.all_pod_metrics()
+        residency: dict[str, dict] = {}
+        waiting: dict[str, set] = {}
+        running: dict[str, set] = {}
+        pod_load: dict[str, int] = {}
+        have_residency = False
+        for pm in pods:
+            tiers = dict(pm.metrics.adapter_tiers)
+            if tiers:
+                have_residency = True
+            residency[pm.pod.name] = tiers
+            pod_load[pm.pod.name] = pm.metrics.total_queue_size
+            for a in pm.metrics.waiting_adapters:
+                waiting.setdefault(a, set()).add(pm.pod.name)
+            for a in pm.metrics.running_adapters:
+                running.setdefault(a, set()).add(pm.pod.name)
+        # Adapter shares (summed over models) + adapter -> model for the
+        # residency gauge's model label.
+        shares: dict[str, float] = {}
+        model_of: dict[str, str] = {}
+        if self.usage is not None:
+            for (model, adapter), share in \
+                    self.usage.shares_snapshot().items():
+                shares[adapter] = shares.get(adapter, 0.0) + share
+                model_of.setdefault(adapter, model)
+        # Idle dwell: an adapter is idle on a pod when its pool share is
+        # below the bar AND nothing runs/waits on it there.
+        idle: dict[tuple[str, str], int] = {}
+        for pod, tiers in residency.items():
+            for adapter in tiers:
+                busy = (shares.get(adapter, 0.0) >= self.cfg.idle_share
+                        or pod in running.get(adapter, ())
+                        or pod in waiting.get(adapter, ()))
+                if busy:
+                    continue
+                idle[(pod, adapter)] = self._idle.get((pod, adapter), 0) + 1
+        decisions = self.plan(shares, waiting, residency, pod_load, idle) \
+            if have_residency else []
+        resident_pods: dict[str, set] = {}
+        slot_pods: dict[str, set] = {}
+        host_pods: dict[str, set] = {}
+        for pod, tiers in residency.items():
+            for adapter, tier in tiers.items():
+                resident_pods.setdefault(adapter, set()).add(pod)
+                (slot_pods if tier == TIER_SLOT
+                 else host_pods).setdefault(adapter, set()).add(pod)
+        with self._lock:
+            self.ticks += 1
+            self.last_tick = now
+            self._idle = idle
+            self._residency = residency
+            self._model_of = model_of
+            self._decisions = decisions
+            for d in decisions:
+                key = (d["action"],)
+                self.decisions_total[key] = (
+                    self.decisions_total.get(key, 0) + 1)
+            self._resident_pods = {a: frozenset(p)
+                                   for a, p in resident_pods.items()}
+            self._tier_pods = {
+                a: (frozenset(slot_pods.get(a, ())),
+                    frozenset(host_pods.get(a, ())))
+                for a in resident_pods}
+            self._have_residency = have_residency
+        if self.journal is not None:
+            for d in decisions:
+                self.journal.emit(events_mod.PLACEMENT_DECISION,
+                                  action=d["action"], pod=d["pod"],
+                                  adapter=d["adapter"], reason=d["reason"])
+
+    # -- export -------------------------------------------------------------
+    def render(self) -> list[str]:
+        with self._lock:
+            residency = {p: dict(t) for p, t in self._residency.items()}
+            model_of = dict(self._model_of)
+            decisions = dict(self.decisions_total)
+            would_steer = self.would_steer_total
+            wrong_tier = self.wrong_tier_total
+            escapes = self.escape_total
+        lines = ["# TYPE gateway_adapter_residency gauge"]
+        for pod in sorted(residency):
+            for adapter in sorted(residency[pod]):
+                lines.append(
+                    'gateway_adapter_residency{model="%s",adapter="%s",'
+                    'pod="%s",tier="%s"} 1'
+                    % (escape_label(model_of.get(adapter, "")),
+                       escape_label(adapter), escape_label(pod),
+                       escape_label(residency[pod][adapter])))
+        lines += render_keyed_family(
+            "gateway_placement_decisions_total", decisions, ("action",))
+        lines += [
+            "# TYPE gateway_placement_would_steer_total counter",
+            f"gateway_placement_would_steer_total {would_steer}",
+            "# TYPE gateway_placement_wrong_tier_picks_total counter",
+            f"gateway_placement_wrong_tier_picks_total {wrong_tier}",
+            "# TYPE gateway_placement_escapes_total counter",
+            f"gateway_placement_escapes_total {escapes}",
+        ]
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/placement`` JSON body — the wire the lora_sidecar's
+        ``--planner-url`` mode polls.  Decisions carry the target pod NAME
+        and ADDRESS so a per-replica sidecar can filter to its own server
+        without knowing pool topology."""
+        addr_of = {pm.pod.name: pm.pod.address
+                   for pm in self.provider.all_pod_metrics()}
+        with self._lock:
+            decisions = [dict(d, address=addr_of.get(d["pod"], ""))
+                         for d in self._decisions]
+            payload = {
+                "mode": self.cfg.mode,
+                "ticks": self.ticks,
+                "decisions": decisions,
+                "residency": {p: dict(t)
+                              for p, t in self._residency.items()},
+                "idle": {f"{pod}|{adapter}": ticks
+                         for (pod, adapter), ticks in self._idle.items()},
+                "counters": {
+                    "decisions_total": {k[0]: v for k, v
+                                        in self.decisions_total.items()},
+                    "would_steer_total": self.would_steer_total,
+                    "wrong_tier_picks_total": self.wrong_tier_total,
+                    "escapes_total": self.escape_total,
+                },
+                "config": asdict(self.cfg),
+            }
+        return payload

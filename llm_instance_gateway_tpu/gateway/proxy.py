@@ -69,6 +69,7 @@ from aiohttp import web
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.gateway import placement as placement_mod
 from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
 from llm_instance_gateway_tpu.gateway import usage as usage_mod
@@ -126,6 +127,7 @@ class GatewayProxy:
         health_cfg: "health_mod.HealthConfig | None" = None,
         usage_cfg: "usage_mod.UsageConfig | None" = None,
         fairness_cfg: "fairness_mod.FairnessConfig | dict | None" = None,
+        placement_cfg: "placement_mod.PlacementConfig | None" = None,
         blackbox_dir: str | None = None,
         fast_relay: bool = True,
     ):
@@ -184,6 +186,15 @@ class GatewayProxy:
         self.fairness = fairness_mod.FairnessPolicy(
             self.usage, cfg=fairness_cfg, journal=self.journal,
             provider=provider, cli_overrides=fairness_overrides)
+        # Adapter residency & placement plane (gateway/placement.py): the
+        # PlacementPlanner fuses usage shares, the running/waiting split,
+        # and scraped residency tiers into prefetch/evict/migrate
+        # decisions (executed by lora_sidecar --planner-url against
+        # /debug/placement), and serves the scheduler's placement_advisor
+        # seam — log_only (default) keeps routing byte-identical.
+        self.placement = placement_mod.PlacementPlanner(
+            provider, usage=self.usage, cfg=placement_cfg,
+            journal=self.journal)
         # Black-box dump directory + dump-storm cooldown; both env-tunable.
         self.blackbox_dir = (
             blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
@@ -219,6 +230,11 @@ class GatewayProxy:
         # hot-reloads from the pool document.
         if sched is not None and hasattr(sched, "usage_advisor"):
             sched.usage_advisor = self.fairness
+        # Placement seam on the same pick path: log_only counts would-
+        # steer picks; prefer_resident narrows survivors to slot/host-
+        # resident pods (filter_by_placement) after the fairness filter.
+        if sched is not None and hasattr(sched, "placement_advisor"):
+            sched.placement_advisor = self.placement
         if outer is not None and hasattr(outer, "fairness"):
             outer.fairness = self.fairness
         if hasattr(handler_server, "fairness"):
@@ -261,6 +277,7 @@ class GatewayProxy:
         app.router.add_get("/debug/slo", self.handle_debug_slo)
         app.router.add_get("/debug/health", self.handle_debug_health)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
+        app.router.add_get("/debug/placement", self.handle_debug_placement)
         app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/v1/models", self.handle_models)
@@ -322,6 +339,7 @@ class GatewayProxy:
                 self.slo.tick()
                 self.usage.tick()  # capacity shares + noisy-neighbor flags
                 self.fairness.tick()  # fair shares + tenant quota state
+                self.placement.tick()  # residency fusion + tier decisions
             except Exception:
                 logger.exception("observability tick failed")
 
@@ -1200,7 +1218,7 @@ class GatewayProxy:
         text = self.metrics.render()
         extra = (self.slo.render() + self.health.render()
                  + self.resilience.render() + self.usage.render()
-                 + self.fairness.render()
+                 + self.fairness.render() + self.placement.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -1246,7 +1264,23 @@ class GatewayProxy:
         self.usage.maybe_tick(max(1.0, self.obs_tick_s))
         payload = self.usage.debug_payload()
         payload["fairness"] = self.fairness.debug_payload()
+        # Residency alongside the usage shares (pod -> adapter -> tier):
+        # lig-top renders WHERE each tenant's weights live next to what
+        # they consume.
+        payload["residency"] = self.placement.debug_payload()["residency"]
         return web.json_response(payload)
+
+    async def handle_debug_placement(self, request: web.Request) -> web.Response:
+        """The placement plane's state + this tick's decisions — the wire
+        ``tools/lora_sidecar.py --planner-url`` polls.  Floored at the
+        configured cadence like the other debug surfaces (idle dwell
+        counts planner passes)."""
+        self.usage.maybe_tick(max(1.0, self.obs_tick_s))
+        if (self.placement.ticks == 0
+                or time.time() - self.placement.last_tick
+                >= max(1.0, self.obs_tick_s)):
+            self.placement.tick()
+        return web.json_response(self.placement.debug_payload())
 
     async def handle_debug_events(self, request: web.Request) -> web.Response:
         """The flight recorder: ``?since=<seq>`` incremental cursor,
@@ -1285,6 +1319,7 @@ def main(argv: list[str] | None = None) -> None:
     proxy = GatewayProxy(comps.handler_server, comps.provider, comps.datastore,
                          resilience_cfg=bootstrap.resilience_from_args(args),
                          fairness_cfg=bootstrap.fairness_from_args(args),
+                         placement_cfg=bootstrap.placement_from_args(args),
                          fast_relay=not args.no_fast_relay)
     try:
         web.run_app(proxy.build_app(), port=args.port)

@@ -48,6 +48,7 @@ from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
     SchedulingError,
     build_decode_tree,
     filter_by_fairness,
+    filter_by_placement,
     filter_by_policy,
     split_pool_roles,
 )
@@ -68,7 +69,7 @@ _NATIVE_DIR = os.path.join(
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libligsched.so")
 # Must match scheduler.cc's lig_abi_version() — bumped on any exported-
 # signature change so a stale prebuilt .so is refused, not miscalled.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 LIG_SHED = -1
 LIG_ERROR = -2
@@ -81,6 +82,9 @@ _POLICY_CODE = {"log_only": 0, "avoid": 1, "strict": 2}
 # narrowing; enforce's extra semantics (admission quotas) live entirely in
 # Python (gateway/fairness.py), so the native code is binary.
 _FAIRNESS_CODE = {"log_only": 0, "deprioritize": 1, "enforce": 1}
+# filter_by_placement parity: log_only marshals no marks (note_pick stays
+# in Python over the planner's own map — routing byte-identical).
+_PLACEMENT_CODE = {"log_only": 0, "prefer_resident": 1}
 
 _SHED_MSG = ("failed to apply filter, resulted 0 pods: dropping request due "
              "to limited backend resources")
@@ -140,10 +144,13 @@ def _load_library():
                 _u8p,                               # avoid marks
                 ctypes.c_int32, _i32p, _i32p,       # adapters CSR
                 _u8p,                               # adapter noisy marks
+                _i32p, _i32p, _u8p, _u8p,           # placement CSR: offsets,
+                #                                     ids, tier codes, any bits
                 ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_double, ctypes.c_int32,
                 ctypes.c_uint8, ctypes.c_uint8,     # token/prefill aware
                 ctypes.c_uint8, ctypes.c_uint8,     # policy/fairness modes
+                ctypes.c_uint8,                     # placement mode
             ]
             lib.lig_pick.restype = ctypes.c_int32
             lib.lig_pick.argtypes = [
@@ -177,16 +184,20 @@ def _ptr(arr: np.ndarray, ctype):
 class _NativeState:
     """One native snapshot handle + the Python-side cache keys guarding it."""
 
-    __slots__ = ("handle", "key", "avoid", "noisy", "out", "intern",
-                 "_finalizer", "__weakref__")
+    __slots__ = ("handle", "key", "avoid", "noisy", "placed", "out",
+                 "intern", "_finalizer", "__weakref__")
 
     def __init__(self, lib):
         self.handle = lib.lig_state_new()
         if not self.handle:
             raise RuntimeError("lig_state_new failed")
-        self.key = None          # (version, n_pods, policy, fairness, cfg_gen)
+        self.key = None          # (version, n_pods, policy, fairness,
+        #                           placement, cfg_gen)
         self.avoid = None        # frozenset marshalled into the avoid marks
         self.noisy = frozenset()  # noisy names marshalled into the marks
+        self.placed = None       # resident map marshalled into the
+        #                           placement marks (identity-compared: the
+        #                           planner swaps the dict whole per tick)
         self.out = np.empty(0, np.int32)  # persistent candidate buffer
         # Adapter interning for THIS state's residency CSR: name -> dense
         # id, rebuilt from scratch at every marshal so the table (and the
@@ -270,6 +281,14 @@ class NativeScheduler:
         # bit 2), while log_only keeps byte-exact parity with the Python
         # path and only counts flagged picks.
         self.usage_advisor = None
+        # Placement seam (gateway/placement.py) — same contract as the
+        # Python Scheduler's placement_advisor.  prefer_resident marshals
+        # the planner's resident map into the snapshot (per-adapter pod
+        # bits + pool-wide "resident anywhere" bits, so the escape-hatch
+        # condition matches the Python filter exactly); log_only marshals
+        # nothing and keeps byte-exact parity, note_pick counting in
+        # Python over the planner's own map.
+        self.placement_advisor = None
 
     # -- marshalling --------------------------------------------------------
     def _policy_and_avoid(self) -> tuple[str, frozenset]:
@@ -302,9 +321,27 @@ class NativeScheduler:
             else frozenset()
         return mode, noisy
 
+    def _placement_and_map(self) -> tuple[str, dict | None]:
+        """The placement advisor's mode + resident map (adapter ->
+        frozenset of pod names; swapped whole per planner tick, so object
+        identity is the staleness signal).  log_only — or a pool with no
+        residency data — marshals no marks."""
+        advisor = self.placement_advisor
+        if advisor is None:
+            return "log_only", None
+        mode = getattr(advisor, "mode", "log_only")
+        if mode not in _PLACEMENT_CODE or _PLACEMENT_CODE[mode] == 0:
+            return "log_only", None
+        get_map = getattr(advisor, "resident_map", None)
+        rmap = get_map() if get_map is not None else None
+        if rmap is None:
+            return "log_only", None
+        return mode, rmap
+
     def _marshal(self, state: _NativeState, pods: list[PodMetrics],
                  policy: str, bad: frozenset | None, fairness: str,
-                 noisy_names: frozenset) -> None:
+                 noisy_names: frozenset, placement: str = "log_only",
+                 resident_map: dict | None = None) -> None:
         """Push the full routable world into ``state`` (tick-time cost)."""
         n = len(pods)
         waiting = np.fromiter(
@@ -347,7 +384,42 @@ class NativeScheduler:
                 ids.append(aid)
         offsets[n] = len(ids)
         res_ids = np.asarray(ids, dtype=np.int32)
+        # Placement marks (prefer_resident only): the planner's resident
+        # map becomes a second CSR over the SAME intern table — names
+        # resident somewhere but active nowhere still intern, so a request
+        # for a demotable-but-idle adapter resolves an id.  placed_any
+        # carries the POOL-wide resident bit: an adapter whose only homes
+        # are outside this pods list still escapes (Python filter parity).
+        placed_lists: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+        placement_code = _PLACEMENT_CODE.get(placement, 0)
+        if placement_code and resident_map:
+            pod_index = {pm.pod.name: i for i, pm in enumerate(pods)}
+            for adapter_name, (slot_pods, host_pods) in resident_map.items():
+                aid = table.get(adapter_name)
+                if aid is None:
+                    aid = table[adapter_name] = len(table)
+                for tier_code, pod_names in ((2, slot_pods), (1, host_pods)):
+                    for pod_name in pod_names:
+                        i = pod_index.get(pod_name)
+                        if i is not None:
+                            placed_lists[i].append((aid, tier_code))
         n_adapters = len(table)
+        placed_offsets = np.empty(n + 1, np.int32)
+        placed_flat: list[int] = []
+        placed_tier_flat: list[int] = []
+        for i in range(n):
+            placed_offsets[i] = len(placed_flat)
+            for aid, tier_code in placed_lists[i]:
+                placed_flat.append(aid)
+                placed_tier_flat.append(tier_code)
+        placed_offsets[n] = len(placed_flat)
+        placed_ids = np.asarray(placed_flat, dtype=np.int32)
+        placed_tiers = np.asarray(placed_tier_flat, dtype=np.uint8)
+        placed_any = np.zeros(max(1, n_adapters), np.uint8)
+        if placement_code and resident_map:
+            for adapter_name, (slot_pods, host_pods) in resident_map.items():
+                if slot_pods or host_pods:
+                    placed_any[table[adapter_name]] = 1
         noisy = np.zeros(max(1, n_adapters), np.uint8)
         for name in noisy_names:
             aid = table.get(name)
@@ -362,6 +434,10 @@ class NativeScheduler:
             _ptr(avoid, ctypes.c_uint8),
             n_adapters, _ptr(offsets, ctypes.c_int32),
             _ptr(res_ids, ctypes.c_int32), _ptr(noisy, ctypes.c_uint8),
+            _ptr(placed_offsets, ctypes.c_int32),
+            _ptr(placed_ids, ctypes.c_int32),
+            _ptr(placed_tiers, ctypes.c_uint8),
+            _ptr(placed_any, ctypes.c_uint8),
             self.cfg.kv_cache_threshold,
             self.cfg.queue_threshold_critical,
             self.cfg.queueing_threshold_lora,
@@ -371,6 +447,7 @@ class NativeScheduler:
             1 if self.prefill_aware else 0,
             _POLICY_CODE.get(policy, 0),
             _FAIRNESS_CODE.get(fairness, 0),
+            placement_code,
         )
         if rc != 0:
             raise SchedulingError(f"native state update failed ({rc})")
@@ -378,6 +455,7 @@ class NativeScheduler:
             state.out = np.empty(n, np.int32)
         state.avoid = bad
         state.noisy = noisy_names
+        state.placed = resident_map if placement_code else None
         state.intern = table
 
     @staticmethod
@@ -397,23 +475,30 @@ class NativeScheduler:
         if policy_mode:
             policy, bad = self._policy_and_avoid()
             fairness, noisy = self._fairness_and_noisy()
+            placement, rmap = self._placement_and_map()
         else:
             policy, bad = "log_only", frozenset()
             fairness, noisy = "log_only", frozenset()
+            placement, rmap = "log_only", None
         if version is None:
-            self._marshal(self._scratch, pods, policy, bad, fairness, noisy)
+            self._marshal(self._scratch, pods, policy, bad, fairness, noisy,
+                          placement, rmap)
             self._scratch.key = None
             return self._scratch
         state = self._state
-        key = (version, len(pods), policy, fairness, self._cfg_gen)
+        key = (version, len(pods), policy, fairness, placement,
+               self._cfg_gen)
         # ``bad is None`` = an advisor with per-pod should_avoid only (no
         # batch set to compare): no cheap change signal, so re-marshal.
         # The noisy-name set is compared like the avoid set — a rollup
         # flag transition between provider versions must reach the
-        # resident marks.
+        # resident marks.  The planner's resident map is identity-compared
+        # (swapped whole per tick), so a planner tick between provider
+        # versions reaches the placement marks the same way.
         if (state.key != key or bad is None or state.avoid != bad
-                or state.noisy != noisy):
-            self._marshal(state, pods, policy, bad, fairness, noisy)
+                or state.noisy != noisy or state.placed is not rmap):
+            self._marshal(state, pods, policy, bad, fairness, noisy,
+                          placement, rmap)
             state.key = key
         return state
 
@@ -511,6 +596,13 @@ class NativeScheduler:
             note = getattr(self.usage_advisor, "note_fairness_escape", None)
             if note is not None:
                 note()
+        if flags & 8 and self.placement_advisor is not None:
+            # Placement escape hatch: the adapter is resident in the pool
+            # but on no candidate (filter_by_placement parity).
+            note = getattr(self.placement_advisor,
+                           "note_placement_escape", None)
+            if note is not None:
+                note()
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, [pods[i] for i in cand])
@@ -524,6 +616,9 @@ class NativeScheduler:
             advisor.note_pick(pick.name)
         if self.usage_advisor is not None:
             self.usage_advisor.note_pick(pick.name, req.model)
+        if self.placement_advisor is not None:
+            self.placement_advisor.note_pick(
+                pick.name, req.resolved_target_model)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -623,12 +718,17 @@ class NativeScheduler:
             self.health_advisor, decode_survivors)
         decode_survivors = filter_by_fairness(
             self.usage_advisor, req, decode_survivors)
+        decode_survivors = filter_by_placement(
+            self.placement_advisor, req, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
             self.health_advisor.note_pick(decode_pod.name)
         if self.usage_advisor is not None:
             self.usage_advisor.note_pick(decode_pod.name, req.model)
+        if self.placement_advisor is not None:
+            self.placement_advisor.note_pick(
+                decode_pod.name, req.resolved_target_model)
         return prefill_pod, decode_pod
 
 

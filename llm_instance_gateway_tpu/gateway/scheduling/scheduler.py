@@ -172,6 +172,70 @@ def filter_by_fairness(advisor, req: "LLMRequest", candidates: list,
     return candidates
 
 
+def filter_by_placement(advisor, req: "LLMRequest", candidates: list,
+                        name_of=None) -> list:
+    """Apply the placement plane's residency steering over a candidate
+    set (``gateway/placement.py:PlacementPlanner``); schedulers call this
+    AFTER ``filter_by_fairness``, BEFORE the prefix tie-break and RNG
+    draw.
+
+    - ``log_only`` (or no advisor): returns ``candidates`` UNCHANGED —
+      the byte-identical guarantee the same-RNG diff tests pin (the
+      advisor's ``note_pick`` still counts would-steer picks).
+    - ``prefer_resident``: narrows to pods where the request's adapter is
+      RAM-resident, slot tier winning ties over host tier (a slot pick
+      decodes immediately, a host pick pays the promote's device put, a
+      cold pick pays the full Orbax restore); when the adapter IS
+      resident somewhere but on NO candidate, the full set comes back and
+      ``note_placement_escape`` fires — the same counted last-resort
+      shape as the health/fairness filters.  An adapter resident NOWHERE
+      (cold tail, base-model traffic) is not an escape: there is nothing
+      to steer toward, and the planner's prefetch rule — not the pick
+      seam — owns it.  A pool exporting no residency data at all
+      (``resident_pods`` returns None) likewise leaves the set untouched.
+    """
+    if advisor is None or not candidates:
+        return candidates
+    if getattr(advisor, "mode", "log_only") != "prefer_resident":
+        return candidates
+    get_tiers = getattr(advisor, "resident_tiers", None)
+    if get_tiers is not None:
+        tiers = get_tiers(req.resolved_target_model)
+        slot_set, host_set = tiers if tiers is not None \
+            else (frozenset(), frozenset())
+    else:  # flat advisor (tests/fakes): one tier, no slot preference
+        slot_set = advisor.resident_pods(req.resolved_target_model) \
+            or frozenset()
+        host_set = frozenset()
+    if not slot_set and not host_set:
+        return candidates
+    # One pass, both tiers (this filter rides the pick hot path — the
+    # <5% pick_placement_ratio bound in BASELINE_BENCH.json).
+    slot_pref: list = []
+    host_pref: list = []
+    if name_of is None:
+        for c in candidates:
+            name = c.pod.name
+            if name in slot_set:
+                slot_pref.append(c)
+            elif name in host_set:
+                host_pref.append(c)
+    else:
+        for c in candidates:
+            name = name_of(c)
+            if name in slot_set:
+                slot_pref.append(c)
+            elif name in host_set:
+                host_pref.append(c)
+    preferred = slot_pref or host_pref
+    if preferred:
+        return preferred
+    note = getattr(advisor, "note_placement_escape", None)
+    if note is not None:
+        note()
+    return candidates
+
+
 def _drop_filter() -> Filter:
     def drop(req: LLMRequest, pods: Sequence[PodMetrics]) -> list[PodMetrics]:
         raise FilterError(
@@ -365,6 +429,13 @@ class Scheduler:
         # the survivor set through ``filter_by_fairness`` after the health
         # policy filter and before the tie-break/draw.
         self.usage_advisor = None
+        # Placement seam (gateway/placement.py, set by the proxy).  A
+        # PlacementPlanner in ``log_only`` only counts picks that missed
+        # a resident replica (gateway_placement_would_steer_total) —
+        # routing byte-identical, pinned by same-RNG diff tests.  In
+        # ``prefer_resident`` the survivor set additionally passes through
+        # ``filter_by_placement`` after the fairness filter.
+        self.placement_advisor = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.
@@ -407,6 +478,8 @@ class Scheduler:
         # deprioritization runs over whatever survives it.
         survivors = filter_by_policy(self.health_advisor, list(survivors))
         survivors = filter_by_fairness(self.usage_advisor, req, survivors)
+        survivors = filter_by_placement(self.placement_advisor, req,
+                                        survivors)
         pick = None
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, survivors)
@@ -422,6 +495,9 @@ class Scheduler:
             self.health_advisor.note_pick(pick.name)
         if self.usage_advisor is not None:
             self.usage_advisor.note_pick(pick.name, req.model)
+        if self.placement_advisor is not None:
+            self.placement_advisor.note_pick(
+                pick.name, req.resolved_target_model)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -465,12 +541,17 @@ class Scheduler:
             self.health_advisor, decode_survivors)
         decode_survivors = filter_by_fairness(
             self.usage_advisor, req, decode_survivors)
+        decode_survivors = filter_by_placement(
+            self.placement_advisor, req, decode_survivors)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
             self.health_advisor.note_pick(decode_pod.name)
         if self.usage_advisor is not None:
             self.usage_advisor.note_pick(decode_pod.name, req.model)
+        if self.placement_advisor is not None:
+            self.placement_advisor.note_pick(
+                decode_pod.name, req.resolved_target_model)
         # Per-hop pick split for the tracing layer (the admission span's
         # attribution of "pick" into prefill-hop vs decode-hop cost).
         req.pick_hops_s = (t1 - t0, time.perf_counter() - t1)

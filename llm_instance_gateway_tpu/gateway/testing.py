@@ -40,6 +40,7 @@ def fake_metrics(
     adapters: dict[str, int] | None = None,
     max_adapters: int = 4,
     prefill: int = 0,
+    adapter_tiers: dict[str, str] | None = None,
 ) -> Metrics:
     return Metrics(
         waiting_queue_size=queue,
@@ -47,6 +48,7 @@ def fake_metrics(
         active_adapters=dict(adapters or {}),
         max_active_adapters=max_adapters,
         prefill_queue_size=prefill,
+        adapter_tiers=dict(adapter_tiers or {}),
     )
 
 

@@ -70,6 +70,16 @@ class Metrics:
     # label): the heterogeneity signal rank-aware fair-share weighting
     # consumes (gateway/fairness.py).  Empty for foreign servers.
     adapter_ranks: dict[str, int] = field(default_factory=dict)
+    # Residency ladder (tpu:adapter_residency_info): adapter -> tier
+    # ("slot" | "host").  Adapters absent are disk-tier (cold).  The
+    # placement planner and the prefer_resident routing seam consume this;
+    # empty for servers without the residency families.
+    adapter_tiers: dict[str, str] = field(default_factory=dict)
+    # The running/waiting split behind active_adapters (which stays the
+    # UNION for the affinity filter): waiting adapters are the planner's
+    # urgency signal — requests parked on an adapter not yet decodable.
+    running_adapters: frozenset = frozenset()
+    waiting_adapters: frozenset = frozenset()
     # Queue depths.  ``waiting_queue_size`` mirrors the reference's vLLM
     # num_requests_waiting; on TPU it is prefill_queue + decode_waiting.
     running_queue_size: int = 0
@@ -114,6 +124,7 @@ class Metrics:
         m = dataclasses.replace(self)
         m.active_adapters = dict(self.active_adapters)
         m.adapter_ranks = dict(self.adapter_ranks)
+        m.adapter_tiers = dict(self.adapter_tiers)
         m.adapter_step_seconds = dict(self.adapter_step_seconds)
         m.adapter_tokens = dict(self.adapter_tokens)
         m.adapter_kv_block_seconds = dict(self.adapter_kv_block_seconds)

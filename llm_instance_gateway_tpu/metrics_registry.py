@@ -140,6 +140,28 @@ GATEWAY_FAMILIES = (
            "Remaining fairness-quota bucket tokens per throttled tenant "
            "(refill --fairness-quota-rps/s, cost scaled by LoRA rank).",
            GATEWAY_SURFACE),
+    Family("gateway_adapter_residency", "gauge",
+           ("model", "adapter", "pod", "tier"),
+           "Adapter residency as the placement planner sees it: one "
+           "series per (pod, adapter) with its tier (slot | host); disk-"
+           "tier adapters have no series (gateway/placement.py).",
+           GATEWAY_SURFACE),
+    Family("gateway_placement_decisions_total", "counter", ("action",),
+           "Placement-planner decisions emitted, by action (prefetch | "
+           "migrate | demote | evict); executed by lora_sidecar "
+           "--planner-url over the adapter wire.", GATEWAY_SURFACE),
+    Family("gateway_placement_would_steer_total", "counter", (),
+           "Picks that landed on a pod without the adapter RAM-resident "
+           "while a resident replica existed (placement_mode=log_only "
+           "observable; routing unchanged).", GATEWAY_SURFACE),
+    Family("gateway_placement_wrong_tier_picks_total", "counter", (),
+           "Same condition under placement_mode=prefer_resident — zero "
+           "modulo counted escapes (the cold_start_storm chaos bar).",
+           GATEWAY_SURFACE),
+    Family("gateway_placement_escapes_total", "counter", (),
+           "prefer_resident last-resort escapes: the adapter was resident "
+           "in the pool but on no candidate, so the full set served.",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),
@@ -168,12 +190,29 @@ SERVER_FAMILIES = (
            "Recent decode throughput (EMA).", SERVER_SURFACE),
     Family("tpu:lora_requests_info", "gauge",
            ("running_lora_adapters", "waiting_lora_adapters", "max_lora",
-            "adapter_ranks"),
+            "adapter_ranks", "resident_tiers"),
            "Adapter-activity info gauge (vLLM semantics: running = "
            "actively decoding, waiting = parked in decode_wait / queued); "
            "adapter_ranks is a name:rank CSV (rank-aware fairness "
-           "weighting); value is a unix timestamp (latest series wins).",
+           "weighting); resident_tiers is a name:tier CSV over the "
+           "slot/host residency ladder; value is a unix timestamp "
+           "(latest series wins).", SERVER_SURFACE),
+    Family("tpu:adapter_residency_info", "gauge", ("tier", "adapters"),
+           "Residency ladder info gauge: one line per tier (slot = "
+           "device buffers, host = host-RAM cache) with an adapters CSV; "
+           "every adapter appears in exactly one tier per replica "
+           "(server/lora_manager.py); value is a unix timestamp.",
            SERVER_SURFACE),
+    Family("tpu:adapter_tier_transitions_total", "counter", ("from", "to"),
+           "Residency-ladder transitions (load, promote, demote, "
+           "prefetch, evict, host-LRU overflow) by from/to tier.",
+           SERVER_SURFACE),
+    Family("tpu:adapter_load_seconds_total", "counter", ("tier",),
+           "Cumulative adapter-load wall seconds by source tier (host = "
+           "device put of a cached copy, disk = full Orbax restore); "
+           "mean = _total / tpu:adapter_loads_total.", SERVER_SURFACE),
+    Family("tpu:adapter_loads_total", "counter", ("tier",),
+           "Adapter loads performed, by source tier.", SERVER_SURFACE),
     Family("tpu:pool_role", "gauge", ("role",),
            "Disaggregation role info gauge (collocated | prefill | "
            "decode).", SERVER_SURFACE),

@@ -220,9 +220,19 @@ struct State {
   std::vector<uint8_t> resident;  // n_adapters x n bitmap (row = adapter)
   std::vector<uint8_t> noisy;     // per-adapter usage-deprioritize marks
   std::vector<uint8_t> hog;       // per-pod: hosts any flagged adapter
+  // Placement plane (gateway/placement.py): per-(adapter, pod) RAM-tier
+  // residency marks (2 = slot tier, 1 = host tier, 0 = absent — the pick
+  // narrows to the BEST tier present among candidates: a slot pick
+  // decodes now, a host pick pays the promote) and a per-adapter
+  // "resident anywhere in the POOL" bit, which may be set even when no
+  // marshalled pod holds the adapter (role subsets) — that is exactly
+  // the Python escape-hatch condition.
+  std::vector<uint8_t> placed;       // n_adapters x n tier values
+  std::vector<uint8_t> placed_any;   // per-adapter: resident somewhere
   Config cfg{};
   uint8_t policy_mode = 0;        // 0 log_only, 1 avoid, 2 strict
   uint8_t fairness_mode = 0;      // 0 log_only, 1 deprioritize/enforce
+  uint8_t placement_mode = 0;     // 0 log_only, 1 prefer_resident
   bool ready = false;
 
   PodArrays view() const {
@@ -293,6 +303,29 @@ int32_t pick_into(State* st, int32_t adapter_id, uint8_t critical,
       }
     }
   }
+  if (st->placement_mode != 0 && adapter_id >= 0 &&
+      adapter_id < st->n_adapters && !st->placed_any.empty() &&
+      st->placed_any[adapter_id]) {
+    // filter_by_placement parity (scheduler.py): the adapter is resident
+    // SOMEWHERE in the pool — narrow to the candidates holding it at the
+    // BEST tier present (slot=2 beats host=1); none resident among the
+    // candidates escapes with flag bit 3.  An adapter resident nowhere
+    // (placed_any == 0) never filters and never escapes — the planner's
+    // prefetch rule owns the cold tail.
+    const uint8_t* prow =
+        st->placed.data() + static_cast<size_t>(adapter_id) * st->n;
+    uint8_t best = 0;
+    for (int32_t i : result)
+      if (prow[i] > best) best = prow[i];
+    if (best > 0) {
+      Set pref;
+      for (int32_t i : result)
+        if (prow[i] == best) pref.push_back(i);
+      result.swap(pref);
+    } else {
+      f |= 8;  // placement escape: full set serves, Python counts it
+    }
+  }
   for (std::size_t k = 0; k < result.size(); ++k) out[k] = result[k];
   if (flags) *flags = f;
   return static_cast<int32_t>(result.size());
@@ -311,7 +344,9 @@ constexpr int32_t LIG_SHED_STRICT = kShedStrict;
 // otherwise scramble arguments or segfault in the routing hot path).
 // 2 = fairness plane: lig_state_update +fairness_mode, lig_pick /
 // lig_pick_many +req_noisy, escape flag bit 2.
-int32_t lig_abi_version(void) { return 2; }
+// 3 = placement plane: lig_state_update +placed CSR (+placed_any bits)
+// and +placement_mode, escape flag bit 3.
+int32_t lig_abi_version(void) { return 3; }
 
 // ---- stateless reference entry (legacy ABI, unchanged semantics) ---------
 
@@ -364,14 +399,19 @@ int32_t lig_state_update(
     const uint8_t* avoid,
     int32_t n_adapters, const int32_t* res_offsets, const int32_t* res_ids,
     const uint8_t* adapter_noisy,
+    const int32_t* placed_offsets, const int32_t* placed_ids,
+    const uint8_t* placed_tiers, const uint8_t* placed_any,
     double kv_cache_threshold, int32_t queue_threshold_critical,
     int32_t queueing_threshold_lora, double token_headroom_factor,
     int32_t prefill_queue_threshold, uint8_t token_aware,
-    uint8_t prefill_aware, uint8_t policy_mode, uint8_t fairness_mode) {
+    uint8_t prefill_aware, uint8_t policy_mode, uint8_t fairness_mode,
+    uint8_t placement_mode) {
   State* st = static_cast<State*>(h);
   if (!st || n_pods <= 0 || n_adapters < 0 || !waiting || !prefill ||
       !kv_usage || !kv_free || !kv_capacity || !n_active || !max_active ||
-      !avoid || (n_adapters > 0 && (!res_offsets || !adapter_noisy)))
+      !avoid || (n_adapters > 0 && (!res_offsets || !adapter_noisy)) ||
+      (placement_mode != 0 && n_adapters > 0 &&
+       (!placed_offsets || !placed_any)))
     return LIG_ERROR;
   st->ready = false;
   st->n = n_pods;
@@ -400,12 +440,29 @@ int32_t lig_state_update(
   } else {
     st->noisy.clear();
   }
+  st->placed.clear();
+  st->placed_any.clear();
+  if (placement_mode != 0 && n_adapters > 0) {
+    st->placed.assign(
+        static_cast<size_t>(n_adapters) * static_cast<size_t>(n_pods), 0);
+    st->placed_any.assign(placed_any, placed_any + n_adapters);
+    for (int32_t pod = 0; pod < n_pods; ++pod) {
+      for (int32_t k = placed_offsets[pod]; k < placed_offsets[pod + 1];
+           ++k) {
+        const int32_t a = placed_ids[k];
+        if (a < 0 || a >= n_adapters) return LIG_ERROR;
+        st->placed[static_cast<size_t>(a) * n_pods + pod] =
+            placed_tiers ? placed_tiers[k] : 1;
+      }
+    }
+  }
   st->cfg = Config{kv_cache_threshold, queue_threshold_critical,
                    queueing_threshold_lora, token_headroom_factor,
                    prefill_queue_threshold, token_aware != 0,
                    prefill_aware != 0};
   st->policy_mode = policy_mode;
   st->fairness_mode = fairness_mode;
+  st->placement_mode = placement_mode;
   st->ready = true;
   return 0;
 }
@@ -414,7 +471,8 @@ int32_t lig_state_update(
 // ints).  Returns the count, LIG_SHED/LIG_SHED_STRICT, or LIG_ERROR.
 // ``req_noisy``: the request's {model,adapter} is currently flagged noisy
 // (matched against the live noisy-name set in Python, mirroring
-// note_pick).  ``flags``: bit 0 = policy escape hatch used; bit 1 =
+// note_pick).  ``flags``: bit 3 = placement escape hatch (adapter
+// resident in the pool but on no candidate); bit 0 = policy escape hatch used; bit 1 =
 // adapter carries a usage-deprioritization mark; bit 2 = fairness escape
 // hatch (every candidate hosted a flagged adapter).
 int32_t lig_pick(void* h, int32_t adapter_id, uint8_t critical,

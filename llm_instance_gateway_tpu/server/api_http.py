@@ -130,6 +130,13 @@ class ModelServer:
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_post("/v1/load_lora_adapter", self.handle_load_adapter)
         app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_adapter)
+        # Residency-ladder verbs (placement plane, server/lora_manager.py):
+        # demote = slot -> host RAM, prefetch = disk -> host RAM (no slot),
+        # evict = host RAM -> disk.  The lora_sidecar's planner mode drives
+        # these from the gateway's /debug/placement decisions.
+        app.router.add_post("/v1/demote_lora_adapter", self.handle_demote_adapter)
+        app.router.add_post("/v1/prefetch_lora_adapter", self.handle_prefetch_adapter)
+        app.router.add_post("/v1/evict_lora_adapter", self.handle_evict_adapter)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/events", self.handle_debug_events)
@@ -1214,6 +1221,74 @@ class ModelServer:
             return _err(404, f"adapter {name!r} not loaded")
         return web.json_response({"status": "ok", "unloaded": name})
 
+    async def handle_demote_adapter(self, request: web.Request) -> web.Response:
+        """Slot -> host RAM (409 while in-flight/parked requests pin the
+        slot; the planner/sidecar just retries next pass)."""
+        if self.lora is None:
+            return _err(400, "LoRA serving is not enabled")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        name = body.get("lora_name")
+        if not name:
+            return _err(400, "lora_name is required")
+        try:
+            demoted = self.lora.demote(name)
+        except AdapterBusyError as e:
+            return _err(409, str(e))
+        except AdapterError as e:
+            return _err(409, str(e))
+        if not demoted:
+            return _err(404, f"adapter {name!r} not slot-resident")
+        return web.json_response({"status": "ok", "demoted": name,
+                                  "tier": "host"})
+
+    async def handle_prefetch_adapter(self, request: web.Request) -> web.Response:
+        """Disk -> host RAM without consuming a device slot (the Orbax
+        restore runs off the event loop like a load); idempotent for
+        RAM-resident names."""
+        if self.lora is None:
+            return _err(400, "LoRA serving is not enabled")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        name = body.get("lora_name")
+        path = body.get("lora_path")
+        if not name or not path:
+            return _err(400, "lora_name and lora_path are required")
+        if name in self.aliases:
+            return _err(409, f"adapter name {name!r} collides with the base "
+                             "model's served names")
+        loop = asyncio.get_running_loop()
+        try:
+            fetched = await loop.run_in_executor(
+                None, lambda: self.lora.prefetch(name, path))
+        except AdapterError as e:
+            return _err(409, str(e))
+        except Exception as e:
+            logger.exception("adapter prefetch failed")
+            return _err(500, f"failed to prefetch adapter: {e}")
+        return web.json_response({"status": "ok", "prefetched": name,
+                                  "already_resident": not fetched})
+
+    async def handle_evict_adapter(self, request: web.Request) -> web.Response:
+        """Host RAM -> disk (slot-resident adapters are untouched: demote
+        first — eviction must never race a live decode)."""
+        if self.lora is None:
+            return _err(400, "LoRA serving is not enabled")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "invalid JSON body")
+        name = body.get("lora_name")
+        if not name:
+            return _err(400, "lora_name is required")
+        if not self.lora.evict_host(name):
+            return _err(404, f"adapter {name!r} not host-resident")
+        return web.json_response({"status": "ok", "evicted": name})
+
     # -- ops ---------------------------------------------------------------
     async def handle_metrics(self, request: web.Request) -> web.Response:
         snap = self.engine.metrics_snapshot()
@@ -1258,6 +1333,10 @@ class ModelServer:
             "role": snap.get("pool_role", "collocated"),
             "running_lora_adapters": snap.get("running_lora_adapters", []),
             "waiting_lora_adapters": snap.get("waiting_lora_adapters", []),
+            # Residency ladder alongside the usage shares (placement
+            # plane): tier -> adapter names, so lig-top and operators see
+            # WHERE each tenant's weights live, not just what they cost.
+            "residency": snap.get("residency", {}),
             "usage": flat,
         })
 
@@ -1307,6 +1386,12 @@ def main(argv=None) -> None:
     parser.add_argument("--decode-slots", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=1024)
     parser.add_argument("--max-loras", type=int, default=4)
+    parser.add_argument(
+        "--host-cache-slots", type=int, default=8,
+        help="host-RAM adapter cache size (the middle tier of the "
+             "slot -> host -> disk residency ladder): demoted/prefetched "
+             "adapters park here so promotion is one device put instead "
+             "of an Orbax restore; 0 disables the tier")
     parser.add_argument(
         "--prefill-buckets", type=int, nargs="+", default=None,
         metavar="N",
@@ -1488,7 +1573,8 @@ def main(argv=None) -> None:
         if args.quantize == "int8":
             draft_params = quantize_params(draft_params)
 
-    lora_manager = LoRAManager(cfg, dtype=dtype, mesh=mesh)
+    lora_manager = LoRAManager(cfg, dtype=dtype, mesh=mesh,
+                               host_cache_slots=args.host_cache_slots)
     engine = Engine(
         cfg, params,
         EngineConfig(

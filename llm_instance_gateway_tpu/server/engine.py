@@ -1248,6 +1248,12 @@ class Engine:
             # consumes — a rank-64 flood must not starve rank-8 tenants.
             "adapter_ranks": (self.lora.adapter_ranks() if self.lora
                               else {}),
+            # Residency ladder (placement plane): tier -> adapter names,
+            # slot<->host<->disk transition counters, per-tier load
+            # latency — rendered as tpu:adapter_residency_info /
+            # tpu:adapter_tier_transitions_total / tpu:adapter_load_*
+            # plus the resident_tiers label on tpu:lora_requests_info.
+            **(self._residency_keys() if self.lora else {}),
             # Phase-latency histogram states (server/metrics.py renders
             # these as the tpu:*_seconds histogram families).
             "phase_hist": phase_hist,
@@ -1265,6 +1271,14 @@ class Engine:
                     self.spec_emitted / self.spec_cycles, 3)
                 if self.spec_cycles else 0.0,
             } if self._spec else {}),
+        }
+
+    def _residency_keys(self) -> dict:
+        transitions, load_seconds = self.lora.residency_counters()
+        return {
+            "residency": self.lora.residency_snapshot(),
+            "tier_transitions": transitions,
+            "adapter_load_seconds": load_seconds,
         }
 
     # ------------------------------------------------------------------

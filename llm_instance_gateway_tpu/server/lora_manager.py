@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -25,6 +27,18 @@ import numpy as np
 from llm_instance_gateway_tpu.models import lora as lora_lib
 
 logger = logging.getLogger(__name__)
+
+# Residency ladder tiers (MinT/InfiniLoRA-style disaggregated placement):
+# ``slot`` = device buffers, decodable this instant; ``host`` = weights
+# parked in host RAM (promotion is one device put, no checkpoint restore);
+# ``disk`` = Orbax checkpoint only (cold: restore + device put).  An
+# adapter is in EXACTLY ONE tier per replica at any time — the
+# conservation invariant tests/test_placement.py lints through the
+# rendered exposition.
+TIER_SLOT = "slot"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+RESIDENCY_TIERS = (TIER_SLOT, TIER_HOST, TIER_DISK)
 
 
 class AdapterError(Exception):
@@ -48,6 +62,17 @@ class AdapterInfo:
     rank: int
     alpha: float
     source: str  # checkpoint path or "inline"
+    # Checkpoint-shaped host copy of the weights (numpy pytree).  This is
+    # what demotion moves into the host tier: extracting weights back out
+    # of the padded device buffers would be a device read plus an un-pad;
+    # keeping the (few-MB) host reference makes slot->host a pointer move
+    # and host->slot one device put.  Excluded from repr (huge).
+    weights: dict | None = None
+
+    def __repr__(self):  # keep logs/debug payloads weight-free
+        return (f"AdapterInfo(name={self.name!r}, slot={self.slot}, "
+                f"rank={self.rank}, alpha={self.alpha}, "
+                f"source={self.source!r})")
 
 
 def save_adapter(path: str, weights: dict, alpha: float, rank: int) -> None:
@@ -80,7 +105,8 @@ class LoRAManager:
     gateway's affinity filter matches against; ``max_slots`` is max_lora.
     """
 
-    def __init__(self, cfg, dtype=jnp.bfloat16, mesh=None):
+    def __init__(self, cfg, dtype=jnp.bfloat16, mesh=None,
+                 host_cache_slots: int = 8, clock=time.perf_counter):
         self.cfg = cfg
         self._lock = threading.Lock()
         # Serializes whole load/unload operations: the buffer update is a
@@ -91,6 +117,19 @@ class LoRAManager:
         self._adapters: dict[str, AdapterInfo] = {}
         self._active: dict[str, int] = {}  # name -> in-flight request count
         self._free_slots = list(range(cfg.max_lora_slots))
+        # Host-RAM tier: name -> (weights numpy pytree, alpha, rank,
+        # source), LRU-bounded.  Promotion (host -> slot) skips the Orbax
+        # restore entirely — one device put; demotion (slot -> host) copies
+        # the checkpoint-shaped weights back to host numpy so a later
+        # promote restores bit-identical deltas.
+        self.host_cache_slots = max(0, host_cache_slots)
+        self._host: "OrderedDict[str, tuple]" = OrderedDict()
+        self._clock = clock
+        # Residency-plane accounting (rendered by server/metrics.py):
+        # tier transitions and per-tier load latency (sum, count).
+        self.tier_transitions: dict[tuple[str, str], int] = {}
+        self.load_seconds: dict[str, list] = {
+            t: [0.0, 0] for t in (TIER_HOST, TIER_DISK)}
         self.buffers = lora_lib.init_lora_buffers(cfg, dtype=dtype)
         # Sharded serving: pin slot buffers to the engine's mesh so the delta
         # matmuls compose with the column-sharded base projections without
@@ -119,9 +158,61 @@ class LoRAManager:
     def adapter_ranks(self) -> dict[str, int]:
         """Resident adapter name -> LoRA rank — the heterogeneity signal
         the gateway's rank-aware fair-share weighting consumes (exported
-        as the ``adapter_ranks`` label of ``tpu:lora_requests_info``)."""
+        as the ``adapter_ranks`` label of ``tpu:lora_requests_info``).
+        Host-tier adapters are included: the planner prices a promotion's
+        rank cost before it happens."""
         with self._lock:
-            return {name: info.rank for name, info in self._adapters.items()}
+            ranks = {name: info.rank for name, info in self._adapters.items()}
+            for name, (_w, _a, rank, _src) in self._host.items():
+                ranks.setdefault(name, rank)
+            return ranks
+
+    def adapter_tiers(self) -> dict[str, str]:
+        """Adapter name -> residency tier for every adapter this replica
+        holds in RAM (slot or host).  Disk-tier adapters are unknowable
+        here (the checkpoint store is unbounded); the placement planner
+        treats absence as disk.  Each name maps to exactly one tier — the
+        conservation invariant the exposition lint pins."""
+        with self._lock:
+            tiers = {name: TIER_SLOT for name in self._adapters}
+            for name in self._host:
+                tiers[name] = TIER_HOST
+            return tiers
+
+    def residency_snapshot(self) -> dict[str, list[str]]:
+        """Tier -> sorted adapter names (tpu:adapter_residency_info)."""
+        with self._lock:
+            return {TIER_SLOT: sorted(self._adapters),
+                    TIER_HOST: sorted(self._host)}
+
+    def residency_counters(self) -> tuple[dict, dict]:
+        """(tier transitions {(from, to): n}, per-tier load latency
+        {tier: [sum_s, count]}) — copies for the metrics snapshot."""
+        with self._lock:
+            return (dict(self.tier_transitions),
+                    {t: list(sc) for t, sc in self.load_seconds.items()})
+
+    def _note_transition(self, frm: str, to: str) -> None:
+        """Caller holds self._lock."""
+        key = (frm, to)
+        self.tier_transitions[key] = self.tier_transitions.get(key, 0) + 1
+
+    def _note_load(self, tier: str, seconds: float) -> None:
+        with self._lock:
+            sc = self.load_seconds.setdefault(tier, [0.0, 0])
+            sc[0] += seconds
+            sc[1] += 1
+
+    def _host_put(self, name: str, weights: dict, alpha: float, rank: int,
+                  source: str) -> None:
+        """Insert into the bounded host tier (caller holds self._lock);
+        LRU overflow falls off to disk (the checkpoint is the backstop)."""
+        self._host[name] = (weights, alpha, rank, source)
+        self._host.move_to_end(name)
+        while len(self._host) > self.host_cache_slots:
+            evicted, _ = self._host.popitem(last=False)
+            self._note_transition(TIER_HOST, TIER_DISK)
+            logger.info("host cache full: adapter %s fell to disk", evicted)
 
     @property
     def max_slots(self) -> int:
@@ -188,9 +279,34 @@ class LoRAManager:
                         f"no free adapter slots (max {self.cfg.max_lora_slots})"
                     )
                 slot = self._free_slots.pop(0)
+                # Promotion path: a host-tier copy skips the Orbax restore
+                # — the whole point of the residency ladder.  The entry is
+                # popped (not copied) so the name is never in two tiers.
+                # A caller supplying NEW weights, or a DIFFERENT checkpoint
+                # path than the cached copy came from, is publishing a new
+                # version: the stale host copy must not shadow it — it is
+                # discarded (the caller's source is authoritative).
+                cached = self._host.pop(name, None)
+                if cached is not None and (
+                        weights is not None
+                        or (checkpoint_path is not None
+                            and checkpoint_path != cached[3])):
+                    self._note_transition(TIER_HOST, TIER_DISK)
+                    logger.info(
+                        "discarding stale host copy of %s (source %s; "
+                        "caller supplied a new source)", name, cached[3])
+                    cached = None
+            source = checkpoint_path or "inline"
+            from_tier, timed_tier = TIER_DISK, None
+            t0 = self._clock()
             try:
-                if checkpoint_path is not None:
-                    weights, alpha, rank = load_adapter_checkpoint(checkpoint_path)
+                if cached is not None:
+                    weights, alpha, rank, source = cached
+                    from_tier = timed_tier = TIER_HOST
+                elif checkpoint_path is not None:
+                    weights, alpha, rank = load_adapter_checkpoint(
+                        checkpoint_path)
+                    timed_tier = TIER_DISK
                 if weights is None:
                     raise AdapterError("either weights or checkpoint_path required")
                 self.buffers = self._pin(lora_lib.load_adapter(
@@ -199,14 +315,20 @@ class LoRAManager:
             except Exception:
                 with self._lock:
                     self._free_slots.insert(0, slot)
+                    if cached is not None:  # promotion failed: keep the copy
+                        self._host[name] = cached
                 raise
+            if timed_tier is not None:
+                self._note_load(timed_tier, self._clock() - t0)
             info = AdapterInfo(
                 name=name, slot=slot, rank=rank, alpha=alpha,
-                source=checkpoint_path or "inline",
+                source=source, weights=weights,
             )
             with self._lock:
                 self._adapters[name] = info
-        logger.info("loaded adapter %s into slot %d (rank %d)", name, slot, rank)
+                self._note_transition(from_tier, TIER_SLOT)
+        logger.info("loaded adapter %s into slot %d (rank %d, from %s)",
+                    name, slot, rank, from_tier)
         return info
 
     def unload(self, name: str) -> bool:
@@ -222,11 +344,95 @@ class LoRAManager:
                         "retry after they drain"
                     )
                 info = self._adapters.pop(name, None)
-            if info is None:
-                return False
+                if info is None:
+                    # Host-tier unload needs no buffer work — drop the copy.
+                    if self._host.pop(name, None) is not None:
+                        self._note_transition(TIER_HOST, TIER_DISK)
+                        logger.info("unloaded host-cached adapter %s", name)
+                        return True
+                    return False
             self.buffers = self._pin(
                 lora_lib.unload_adapter(self.buffers, self.cfg, info.slot))
             with self._lock:
                 self._free_slots.append(info.slot)
+                self._note_transition(TIER_SLOT, TIER_DISK)
         logger.info("unloaded adapter %s from slot %d", name, info.slot)
+        return True
+
+    def demote(self, name: str) -> bool:
+        """Slot -> host RAM: free the device slot, keep the weights hot so
+        a later ``load`` is one device put instead of an Orbax restore.
+        Refuses (AdapterBusyError -> HTTP 409) while the adapter has
+        in-flight or decode_wait-parked requests — the engine acquires at
+        admission and releases at finish, so a demoted slot can never be
+        recycled under a live decode (the same pin ``unload`` honors).
+        Refuses outright when the host tier is disabled: "demoting" into
+        a zero-slot cache would silently discard the weights (fatal for
+        inline-loaded adapters with no checkpoint backstop) while
+        claiming tier=host."""
+        if self.host_cache_slots <= 0:
+            raise AdapterError(
+                "cannot demote: host cache disabled (host_cache_slots=0); "
+                "use unload if the checkpoint store is the backstop")
+        with self._mutate_lock:
+            with self._lock:
+                active = self._active.get(name, 0)
+                if active:
+                    raise AdapterBusyError(
+                        f"adapter {name!r} has {active} in-flight request(s); "
+                        "retry after they drain"
+                    )
+                info = self._adapters.pop(name, None)
+                if info is None:
+                    return False
+                if info.weights is None:
+                    # No host copy to park (legacy load path): a demote
+                    # would lose the weights entirely — refuse.
+                    self._adapters[name] = info
+                    raise AdapterError(
+                        f"adapter {name!r} has no host-side weights to "
+                        "demote (reload it from a checkpoint first)")
+            self.buffers = self._pin(
+                lora_lib.unload_adapter(self.buffers, self.cfg, info.slot))
+            with self._lock:
+                self._free_slots.append(info.slot)
+                self._host_put(name, info.weights, info.alpha, info.rank,
+                               info.source)
+                self._note_transition(TIER_SLOT, TIER_HOST)
+        logger.info("demoted adapter %s: slot %d -> host RAM", name,
+                    info.slot)
+        return True
+
+    def prefetch(self, name: str, checkpoint_path: str) -> bool:
+        """Disk -> host RAM: Orbax-restore into the host tier WITHOUT
+        consuming a device slot, so a later promotion is cheap.  Idempotent
+        for already-RAM-resident names (slot or host)."""
+        if not name or not all(c.isalnum() or c in "._-" for c in name):
+            raise AdapterError(
+                f"invalid adapter name {name!r}: use [A-Za-z0-9._-]")
+        if self.host_cache_slots <= 0:
+            raise AdapterError("host cache disabled (host_cache_slots=0)")
+        with self._mutate_lock:
+            with self._lock:
+                if name in self._adapters or name in self._host:
+                    return False  # already RAM-resident
+            t0 = self._clock()
+            weights, alpha, rank = load_adapter_checkpoint(checkpoint_path)
+            self._note_load(TIER_DISK, self._clock() - t0)
+            with self._lock:
+                self._host_put(name, weights, alpha, rank, checkpoint_path)
+                self._note_transition(TIER_DISK, TIER_HOST)
+        logger.info("prefetched adapter %s into host RAM (rank %d)",
+                    name, rank)
+        return True
+
+    def evict_host(self, name: str) -> bool:
+        """Host RAM -> disk: drop the host copy (the checkpoint remains
+        the backstop).  Slot-resident adapters are untouched — demote
+        first."""
+        with self._mutate_lock, self._lock:
+            if self._host.pop(name, None) is None:
+                return False
+            self._note_transition(TIER_HOST, TIER_DISK)
+        logger.info("evicted adapter %s from host RAM", name)
         return True

@@ -56,9 +56,12 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         # operator must see which replica is actually decoding a tenant.
         # ``adapter_ranks`` (name:rank CSV) carries the LoRA-rank
         # heterogeneity signal the gateway's rank-aware fair-share
-        # weighting consumes (gateway/fairness.py).
+        # weighting consumes (gateway/fairness.py); ``resident_tiers``
+        # (name:tier CSV over the slot+host RAM tiers) is the residency
+        # summary lig-top and /debug/usage render alongside usage shares.
         'tpu:lora_requests_info{running_lora_adapters="%s",'
-        'waiting_lora_adapters="%s",max_lora="%d",adapter_ranks="%s"} %f'
+        'waiting_lora_adapters="%s",max_lora="%d",adapter_ranks="%s",'
+        'resident_tiers="%s"} %f'
         % (
             escape_label(",".join(snapshot.get("running_lora_adapters", []))),
             escape_label(",".join(snapshot.get("waiting_lora_adapters", []))),
@@ -66,9 +69,46 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             escape_label(",".join(
                 f"{name}:{rank}" for name, rank in sorted(
                     snapshot.get("adapter_ranks", {}).items()))),
+            escape_label(",".join(
+                f"{name}:{tier}"
+                for tier, names in sorted(
+                    (snapshot.get("residency") or {}).items())
+                for name in names)),
             time.time(),
         ),
     ]
+    if "residency" in snapshot:
+        # Residency ladder (placement plane, server/lora_manager.py): one
+        # info line per tier (value = unix ts, latest-series semantics like
+        # lora_requests_info), tier-transition counters, per-tier load
+        # latency sums/counts (mean = _total / loads).
+        lines.append("# TYPE tpu:adapter_residency_info gauge")
+        now = time.time()
+        for tier in sorted(snapshot["residency"]):
+            names = snapshot["residency"][tier]
+            lines.append(
+                'tpu:adapter_residency_info{tier="%s",adapters="%s"} %f'
+                % (escape_label(tier),
+                   escape_label(",".join(names)), now))
+        transitions = snapshot.get("tier_transitions") or {}
+        lines.append("# TYPE tpu:adapter_tier_transitions_total counter")
+        if transitions:
+            for (frm, to) in sorted(transitions):
+                lines.append(
+                    'tpu:adapter_tier_transitions_total{from="%s",to="%s"} %d'
+                    % (escape_label(frm), escape_label(to),
+                       transitions[(frm, to)]))
+        else:
+            lines.append("tpu:adapter_tier_transitions_total 0")
+        load_seconds = snapshot.get("adapter_load_seconds") or {}
+        lines.append("# TYPE tpu:adapter_load_seconds_total counter")
+        lines.append("# TYPE tpu:adapter_loads_total counter")
+        for tier in sorted(load_seconds):
+            total_s, count = load_seconds[tier]
+            lines.append('tpu:adapter_load_seconds_total{tier="%s"} %.6f'
+                         % (escape_label(tier), total_s))
+            lines.append('tpu:adapter_loads_total{tier="%s"} %d'
+                         % (escape_label(tier), count))
     if snapshot.get("pool_role"):
         # Disaggregation role as a labeled info gauge (operators / future
         # role-from-scrape discovery; the gateway's routing roles come from

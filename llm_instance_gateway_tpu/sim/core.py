@@ -34,7 +34,12 @@ class LatencyModel:
     decode_base_s: float
     decode_per_kv_token_s: float
     decode_per_seq_s: float
-    adapter_load_s: float = 0.5  # Orbax restore of one adapter
+    adapter_load_s: float = 0.5     # Orbax restore of one adapter (disk tier)
+    # Host-RAM promotion (residency ladder, server/lora_manager.py): the
+    # adapter's weights are already in host memory, so a "load" is one
+    # device put of a few-MB delta — tens of milliseconds, versus the
+    # DISK tier's full Orbax restore (adapter_load_s).
+    host_promote_s: float = 0.02
 
     def prefill_s(self, prompt_tokens: int) -> float:
         return max(
@@ -128,6 +133,8 @@ class SimServer:
         kv_capacity_tokens: int = 44_448,
         max_adapters: int = 4,
         prefix_cache_size: int = 32,
+        host_cache_slots: int = 0,
+        preload: "list[str] | None" = None,
     ):
         self.name = name
         self.pod = Pod(name=name, address=f"{name}:8000")
@@ -137,7 +144,30 @@ class SimServer:
         self.max_adapters = max_adapters
         self.prefill_queue: list[SimRequest] = []
         self.active: list[_ActiveSeq] = []
-        self.resident_adapters: dict[str, int] = {}
+        # Slot tier: adapter -> in-flight refcount (the engine's device
+        # slot buffers).  ``preload`` models the all-resident baseline —
+        # adapters resident at t=0 with no load charge.
+        self.resident_adapters: dict[str, int] = {
+            a: 0 for a in (preload or [])}
+        # Host-RAM tier (residency ladder): adapters whose weights are in
+        # host memory — promotion costs host_promote_s instead of the
+        # full adapter_load_s disk restore.  LRU, bounded.
+        self.host_cache_slots = host_cache_slots
+        self.host_cache: "OrderedDict[str, None]" = OrderedDict()
+        # Per-tier load counters (the sim twin of tpu:adapter_loads_total).
+        self.disk_loads = 0
+        self.host_promotes = 0
+        self.demotions = 0
+        # In-flight adapter loads: adapter -> sim time the weights become
+        # slot-resident.  Loads run OFF the step path (the engine restores
+        # in an executor thread; only the waiting request pays the
+        # latency) — a cold adapter must not freeze every active slot.
+        self.loading: dict[str, float] = {}
+        # Last admission time per slot-resident adapter: slot pressure
+        # demotes the least-recently-USED idle adapter, so a hot adapter
+        # that is momentarily idle between requests is not the one the
+        # cold tail displaces.
+        self.last_used: dict[str, float] = {}
         self.busy_until = 0.0
         self.tokens_generated = 0
         # Prefix cache: retained prefix_ids, LRU-capped (the engine's
@@ -152,11 +182,20 @@ class SimServer:
     # -- metrics the production scheduler consumes -------------------------
     def metrics(self) -> PodMetrics:
         used = sum(a.kv_tokens for a in self.active)
+        tiers = {name: "slot" for name in self.resident_adapters}
+        for name in self.host_cache:
+            tiers.setdefault(name, "host")
         return PodMetrics(
             pod=self.pod,
             metrics=Metrics(
                 active_adapters=dict(self.resident_adapters),
                 max_active_adapters=self.max_adapters,
+                adapter_tiers=tiers,
+                running_adapters=frozenset(
+                    s.request.adapter for s in self.active
+                    if s.request.adapter),
+                waiting_adapters=frozenset(
+                    r.adapter for r in self.prefill_queue if r.adapter),
                 running_queue_size=len(self.active),
                 waiting_queue_size=len(self.prefill_queue),
                 prefill_queue_size=len(self.prefill_queue),
@@ -166,6 +205,83 @@ class SimServer:
                 kv_tokens_free=self.kv_capacity_tokens - used,
             ),
         )
+
+    # -- residency ladder (planner-drivable verbs) -------------------------
+    def _host_put(self, adapter: str) -> None:
+        if self.host_cache_slots <= 0:
+            return
+        self.host_cache[adapter] = None
+        self.host_cache.move_to_end(adapter)
+        while len(self.host_cache) > self.host_cache_slots:
+            self.host_cache.popitem(last=False)  # LRU falls to disk
+
+    def _start_load(self, adapter: str, now: float) -> None:
+        """Kick an async slot load: host_promote_s off the host tier, the
+        full Orbax adapter_load_s off disk.  The requesting sequence stays
+        queued until the load lands; other traffic keeps flowing."""
+        if adapter in self.loading:
+            return
+        if adapter in self.host_cache:
+            del self.host_cache[adapter]
+            cost = self.latency.host_promote_s
+            self.host_promotes += 1
+        else:
+            cost = self.latency.adapter_load_s
+            self.disk_loads += 1
+        self.loading[adapter] = now + cost
+
+    def _finish_loads(self, now: float) -> None:
+        """Land finished restores into slots, displacing least-recently-
+        used IDLE adapters under pressure (vLLM-style slot LRU; the
+        pre-ladder sim's self-eviction, now demoting into the host tier).
+        When every resident adapter is mid-decode there is nothing safe
+        to displace, so the set transiently exceeds ``max_adapters`` —
+        and is squeezed back down as decodes finish and later landings
+        re-apply pressure."""
+        for adapter, ready_at in list(self.loading.items()):
+            if ready_at > now:
+                continue
+            del self.loading[adapter]
+            self.resident_adapters.setdefault(adapter, 0)
+            while len(self.resident_adapters) > self.max_adapters:
+                before = len(self.resident_adapters)
+                self._slot_pressure(adapter)
+                if len(self.resident_adapters) == before:
+                    break  # everything else is busy: transient overflow
+
+    def _slot_pressure(self, keep: str) -> None:
+        """Engine-side LRU displacement: demote the least-recently-used
+        idle adapter to host RAM to make room (vLLM-style slot LRU; the
+        planner's demote/evict decisions ride on top of this backstop)."""
+        idle = [name for name, refs in self.resident_adapters.items()
+                if refs == 0 and name != keep]
+        if not idle:
+            return
+        victim = min(idle, key=lambda n: (self.last_used.get(n, -1.0), n))
+        del self.resident_adapters[victim]
+        self._host_put(victim)
+        self.demotions += 1
+
+    def host_prefetch(self, adapter: str) -> None:
+        """Planner 'prefetch'/'migrate' verb: disk -> host RAM.  Free of
+        TPU step time — the Orbax restore runs host-side off the decode
+        loop (the engine loads in an executor thread); only the later
+        promotion's device put charges the step path."""
+        if adapter in self.resident_adapters or adapter in self.host_cache:
+            return
+        self._host_put(adapter)
+
+    def demote(self, adapter: str) -> None:
+        """Planner 'demote' verb: slot -> host RAM; refused (no-op) while
+        in-flight requests pin the slot — AdapterBusyError semantics."""
+        if self.resident_adapters.get(adapter) == 0:
+            del self.resident_adapters[adapter]
+            self._host_put(adapter)
+            self.demotions += 1
+
+    def evict_host(self, adapter: str) -> None:
+        """Planner 'evict' verb: host RAM -> disk."""
+        self.host_cache.pop(adapter, None)
 
     # -- engine iteration (mirrors server/engine.py:_loop) ------------------
     def kv_free(self) -> int:
@@ -179,14 +295,24 @@ class SimServer:
 
         Returns 0.0 when idle (nothing to do).
         """
+        self._finish_loads(now)
         # Admission: prefill one queued request if a slot is free and the
         # full sequence fits in KV (the engine's slot admission gate).
-        if (
-            self.prefill_queue
-            and len(self.active) < self.decode_slots
-            and self._admit_would_fit(self.prefill_queue[0])
-        ):
-            req = self.prefill_queue.pop(0)
+        # Requests whose adapter is still loading are SKIPPED, not head-
+        # blocking: the engine's executor-thread restore lets other
+        # traffic keep flowing while the waiting request pays the latency.
+        req = None
+        if self.prefill_queue and len(self.active) < self.decode_slots:
+            for i, queued in enumerate(self.prefill_queue):
+                if (queued.adapter is not None
+                        and queued.adapter not in self.resident_adapters):
+                    self._start_load(queued.adapter, now)
+                    continue  # waiting on its load; later traffic flows
+                if not self._admit_would_fit(queued):
+                    break  # KV capacity head-block at the first admissible
+                req = self.prefill_queue.pop(i)
+                break
+        if req is not None:
             prefill_tokens = req.prompt_tokens
             if req.prefix_id is not None:
                 if req.prefix_id in self.cached_prefixes:
@@ -203,19 +329,11 @@ class SimServer:
                 while len(self.cached_prefixes) > self.prefix_cache_size:
                     self.cached_prefixes.popitem(last=False)
             duration = self.latency.prefill_s(prefill_tokens)
-            if req.adapter and req.adapter not in self.resident_adapters:
-                self.resident_adapters[req.adapter] = 0
-                duration += self.latency.adapter_load_s
-                if len(self.resident_adapters) > self.max_adapters:
-                    # Evict LRU-ish: drop an idle adapter (cost already paid).
-                    for name, refs in list(self.resident_adapters.items()):
-                        if refs == 0 and name != req.adapter:
-                            del self.resident_adapters[name]
-                            break
             if req.adapter:
                 self.resident_adapters[req.adapter] = (
                     self.resident_adapters.get(req.adapter, 0) + 1
                 )
+                self.last_used[req.adapter] = now
             req.t_first_token = now + duration
             req.generated = 1
             self.tokens_generated += 1
@@ -245,6 +363,13 @@ class SimServer:
                     refs = self.resident_adapters.get(seq.request.adapter, 1)
                     self.resident_adapters[seq.request.adapter] = max(0, refs - 1)
             return duration
+        if self.loading:
+            # Idle except for in-flight adapter loads: stay scheduled
+            # until the earliest one lands (the event loop only re-kicks
+            # idle servers on arrivals).  A restore that is ready but
+            # waiting for the planner to free a slot polls at a coarse
+            # cadence instead of busy-spinning the event loop.
+            return max(0.01, min(self.loading.values()) - now)
         return 0.0
 
 

@@ -64,11 +64,33 @@ class WorkloadConfig:
     session_fraction: float = 0.0
     n_sessions: int = 64
     session_prefix_tokens: int = 1024
+    # Long-tail adapter universe (the placement plane's target scenario,
+    # MinT scale): > 0 replaces the small ``adapters`` tuple with a
+    # synthetic universe of this many adapters, traffic drawn from a
+    # seeded Zipf(s = adapter_zipf) — the same shape the loadgen's
+    # ``--adapter-universe/--adapter-zipf`` flags emit.
+    adapter_universe: int = 0
+    adapter_zipf: float = 1.1
     seed: int = 0
+
+
+def zipf_weights(n: int, s: float) -> list[float]:
+    """Normalized Zipf weights: w_k proportional to 1/(k+1)^s."""
+    raw = [1.0 / (k + 1) ** s for k in range(n)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+def universe_name(k: int) -> str:
+    return f"zipf-{k:04d}"
 
 
 def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
     rng = pyrandom.Random(cfg.seed)
+    universe = weights = None
+    if cfg.adapter_universe > 0:
+        universe = [universe_name(k) for k in range(cfg.adapter_universe)]
+        weights = zipf_weights(cfg.adapter_universe, cfg.adapter_zipf)
     reqs: list[SimRequest] = []
     t = 0.0
     rid = 0
@@ -77,11 +99,12 @@ def generate_workload(cfg: WorkloadConfig) -> list[SimRequest]:
         u = rng.random()
         critical = u < cfg.critical_fraction
         sheddable = u > 1.0 - cfg.sheddable_fraction
-        adapter = (
-            rng.choice(cfg.adapters)
-            if rng.random() < cfg.adapter_fraction
-            else None
-        )
+        if rng.random() >= cfg.adapter_fraction:
+            adapter = None
+        elif universe is not None:
+            adapter = rng.choices(universe, weights=weights)[0]
+        else:
+            adapter = rng.choice(cfg.adapters)
         is_critical = critical and not sheddable
         prompt = max(8, int(rng.gauss(cfg.prompt_mean, cfg.prompt_std)))
         prefix_id = None
@@ -119,7 +142,8 @@ class _SimProvider:
 
 
 def make_router(policy: str, servers: list[SimServer], seed: int = 0,
-                scheduler_cfg=None, prefix_index=None):
+                scheduler_cfg=None, prefix_index=None,
+                placement_advisor=None):
     rng = pyrandom.Random(seed)
     by_name = {s.pod.name: s for s in servers}
     if policy == "random":
@@ -148,16 +172,22 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0,
 
         return lambda req: min(
             servers, key=lambda s: est(s, req.prompt_tokens))
-    if policy in ("production", "production_affinity"):
+    if policy in ("production", "production_affinity",
+                  "production_placement"):
         kwargs = {} if scheduler_cfg is None else {"cfg": scheduler_cfg}
         # ``production`` is the no-affinity baseline; ``_affinity`` adds the
         # prefix-cache-aware tie-break (scheduling/prefix_affinity.py) —
         # the session prefix_id stands in for the chained prompt hashes.
+        # ``_placement`` wires the REAL PlacementPlanner as the scheduler's
+        # placement_advisor in prefer_resident mode (the advisor itself is
+        # created and ticked by ``simulate`` — see placement_advisor).
         scheduler = Scheduler(_SimProvider(servers),
                               rng=pyrandom.Random(seed),
                               prefix_aware=(policy == "production_affinity"),
                               prefix_index=prefix_index,
                               **kwargs)
+        if policy == "production_placement" and placement_advisor is not None:
+            scheduler.placement_advisor = placement_advisor
 
         def route(req: SimRequest):
             llm_req = LLMRequest(
@@ -193,6 +223,13 @@ class SimResult:
     # fewer, faster" must be weighed on one scale.
     tier_hits: dict = field(default_factory=dict)
     tier_totals: dict = field(default_factory=dict)
+    # Residency-ladder outcome (adapter-universe scenarios): per-adapter
+    # TTFT samples (hot-set percentile computation) + pool-wide tier
+    # load/transition counts.
+    ttft_by_adapter: dict = field(default_factory=dict)
+    disk_loads: int = 0
+    host_promotes: int = 0
+    demotions: int = 0
     # Prefix-cache outcome (session traffic): replica-side hit counts.
     prefix_hits: int = 0
     prefix_misses: int = 0
@@ -231,6 +268,32 @@ class SimResult:
         }
 
 
+class _SimUsage:
+    """Sliding-window traffic shares for the planner's prefetch scoring —
+    the sim stand-in for the gateway UsageRollup's EMA step-seconds
+    shares (``shares_snapshot`` is the only seam the planner reads)."""
+
+    def __init__(self):
+        self.counts: dict[str, float] = {}
+
+    def note(self, adapter: str | None) -> None:
+        if adapter:
+            self.counts[adapter] = self.counts.get(adapter, 0.0) + 1.0
+
+    def decay(self, factor: float = 0.9) -> None:
+        # Slow decay (~10-tick horizon): a one-shot tail request must
+        # fade well below the head adapters' steady shares, or transient
+        # spikes look hot enough to replicate.
+        self.counts = {a: c * factor for a, c in self.counts.items()
+                       if c * factor > 1e-3}
+
+    def shares_snapshot(self) -> dict:
+        total = sum(self.counts.values())
+        if total <= 0:
+            return {}
+        return {("m", a): c / total for a, c in self.counts.items()}
+
+
 def simulate(
     policy: str,
     workload: WorkloadConfig,
@@ -238,6 +301,11 @@ def simulate(
     latency: LatencyModel = V5E_DEFAULT,
     decode_slots: int = 16,
     admission: "AdmissionConfig | None" = None,
+    max_adapters: int = 4,
+    host_cache: int = 0,
+    preload_all: bool = False,
+    planner_cfg=None,
+    planner_tick_s: float = 1.0,
 ) -> SimResult:
     """``policy`` may carry a ``_queued`` suffix (e.g. ``production_queued``):
     sheds then park in the REAL TierQueues policy (gateway
@@ -254,8 +322,17 @@ def simulate(
 
     queued = policy.endswith("_queued")
     base_policy = policy[: -len("_queued")] if queued else policy
+    preload = None
+    if preload_all:
+        preload = ([universe_name(k)
+                    for k in range(workload.adapter_universe)]
+                   if workload.adapter_universe > 0
+                   else list(workload.adapters))
     servers = [
-        SimServer(f"sim-{i}", latency, decode_slots=decode_slots)
+        SimServer(f"sim-{i}", latency, decode_slots=decode_slots,
+                  max_adapters=(max(max_adapters, len(preload))
+                                if preload else max_adapters),
+                  host_cache_slots=host_cache, preload=preload)
         for i in range(n_servers)
     ]
     loop = EventLoop(servers)
@@ -269,8 +346,25 @@ def simulate(
         )
 
         prefix_index = PrefixIndex()
+    # Placement policy: the REAL PlacementPlanner over the sim provider —
+    # its pure decision core (plan()) runs against simulated residency/
+    # load/share state, its decisions apply through the same verbs the
+    # lora_sidecar drives on a live replica.  This is the sim-validation
+    # gate the ROADMAP requires before live rollout.
+    planner = sim_usage = None
+    if base_policy == "production_placement":
+        from llm_instance_gateway_tpu.gateway.placement import (
+            PlacementConfig,
+            PlacementPlanner,
+        )
+
+        sim_usage = _SimUsage()
+        planner = PlacementPlanner(
+            _SimProvider(servers), usage=sim_usage,
+            cfg=planner_cfg or PlacementConfig(mode="prefer_resident"))
     router = make_router(base_policy, servers, seed=workload.seed,
-                         prefix_index=prefix_index)
+                         prefix_index=prefix_index,
+                         placement_advisor=planner)
     requests = generate_workload(workload)
     result = SimResult(policy=policy, qps=workload.qps)
 
@@ -280,12 +374,14 @@ def simulate(
     # The drain re-admits against hysteresis-scaled thresholds, exactly as
     # the live AdmissionController does (config.drain_scaled).
     drain_router = router
-    if queued and base_policy in ("production", "production_affinity"):
+    if queued and base_policy in ("production", "production_affinity",
+                                  "production_placement"):
         drain_router = make_router(
             base_policy, servers, seed=workload.seed,
             scheduler_cfg=drain_scaled(dataclasses.replace(
                 SchedulerConfig(), admission=acfg)),
             prefix_index=prefix_index,
+            placement_advisor=planner,
         )
     parked_at: dict[int, float] = {}
 
@@ -302,6 +398,8 @@ def simulate(
 
     def arrival(req: SimRequest):
         def fire(lp: EventLoop):
+            if sim_usage is not None:
+                sim_usage.note(req.adapter)
             try:
                 server = router(req)
             except SchedulingError:
@@ -349,10 +447,33 @@ def simulate(
         if lp.now + acfg.retry_interval_s < end_s:
             lp.schedule(lp.now + acfg.retry_interval_s, pump)
 
+    by_name_all = {s.pod.name: s for s in servers}
+
+    def planner_pump(lp: EventLoop):
+        """Virtual-time planner tick: the REAL planner plans over the sim
+        provider's metrics; decisions apply through the SimServer's tier
+        verbs (the sidecar-wire equivalent)."""
+        planner.tick(now=lp.now)
+        for d in planner.debug_payload()["decisions"]:
+            server = by_name_all.get(d["pod"])
+            if server is None:
+                continue
+            if d["action"] in ("prefetch", "migrate"):
+                server.host_prefetch(d["adapter"])
+            elif d["action"] == "demote":
+                server.demote(d["adapter"])
+            elif d["action"] == "evict":
+                server.evict_host(d["adapter"])
+        sim_usage.decay()
+        if lp.now + planner_tick_s < end_s:
+            lp.schedule(lp.now + planner_tick_s, planner_pump)
+
     for req in requests:
         loop.schedule(req.arrival_s, arrival(req))
     if tq is not None:
         loop.schedule(acfg.retry_interval_s, pump)
+    if planner is not None:
+        loop.schedule(planner_tick_s, planner_pump)
     # Drain: run past the workload end until queues flush.
     loop.run(until=end_s)
 
@@ -367,6 +488,9 @@ def simulate(
         result.completed += 1
         result.tokens += req.generated
         result.ttfts.append(req.ttft_s)
+        if workload.adapter_universe > 0 and req.adapter:
+            result.ttft_by_adapter.setdefault(
+                req.adapter, []).append((req.arrival_s, req.ttft_s))
         lpt = req.latency_per_output_token_s
         result.per_token.append(lpt)
         result.slo_total += 1
@@ -376,7 +500,110 @@ def simulate(
         result.prefix_hits += s.prefix_hits
         result.prefix_misses += s.prefix_misses
         result.prefix_reused_tokens += s.prefix_reused_tokens
+        result.disk_loads += s.disk_loads
+        result.host_promotes += s.host_promotes
+        result.demotions += s.demotions
     return result
+
+
+def hot_set(universe: int, zipf: float, coverage: float = 0.5) -> list[str]:
+    """Smallest Zipf-rank prefix of the universe covering at least
+    ``coverage`` of expected adapter traffic — the 'hot set' whose p99
+    TTFT the placement acceptance bar constrains."""
+    weights = zipf_weights(universe, zipf)
+    acc, names = 0.0, []
+    for k, w in enumerate(weights):
+        names.append(universe_name(k))
+        acc += w
+        if acc >= coverage:
+            break
+    return names
+
+
+def run_placement_scenario(
+    universe: int = 1000,
+    zipf: float = 1.1,
+    qps: float = 30.0,
+    duration_s: float = 120.0,
+    n_servers: int = 6,
+    max_adapters: int = 16,
+    host_cache: int = 128,
+    hot_coverage: float = 0.5,
+    seed: int = 0,
+) -> dict:
+    """The placement plane's target scenario (ROADMAP item 2, CPU-
+    deterministic, seeded): a long-tail adapter universe where <10% of
+    adapters fit slot-resident, Zipf traffic, two cells:
+
+    - ``all_resident``: every adapter preloaded on every replica (the
+      physically-impossible-at-scale upper bound the bar compares to).
+    - ``tiered``: small slot sets + host-RAM caches, the REAL
+      PlacementPlanner prefetching/demoting/evicting on its tick, and
+      prefer_resident routing steering picks to resident replicas.
+
+    Acceptance: hot-set (top adapters covering ``hot_coverage`` of
+    traffic) p99 TTFT in the tiered cell within 2x the all-resident cell.
+    """
+    from llm_instance_gateway_tpu.gateway.placement import PlacementConfig
+
+    wl = WorkloadConfig(qps=qps, duration_s=duration_s,
+                        adapter_universe=universe, adapter_zipf=zipf,
+                        adapter_fraction=1.0, seed=seed)
+    base = simulate("production", wl, n_servers=n_servers,
+                    preload_all=True)
+    # Planner knobs for the long-tail shape: the head-replication bar
+    # sits just under the hot set's smallest steady share, and the
+    # action budget covers re-replication across the whole pool in one
+    # tick (head adapters evicted by tail churn self-heal next tick).
+    planner_cfg = PlacementConfig(
+        mode="prefer_resident", idle_share=0.005,
+        prefetch_min_share=0.015, evict_idle_ticks=12,
+        max_actions_per_tick=64)
+    tiered = simulate("production_placement", wl, n_servers=n_servers,
+                      max_adapters=max_adapters, host_cache=host_cache,
+                      planner_cfg=planner_cfg, planner_tick_s=1.0)
+    hot = set(hot_set(universe, zipf, hot_coverage))
+    # Steady-state window: the first stretch of the run is the cold fill
+    # (every adapter's FIRST touch is an unavoidable disk restore — the
+    # all-resident cell skips that cost by construction); the acceptance
+    # bar is about serving the hot set once the ladder has settled.
+    # Applied to BOTH cells equally.
+    warmup_s = 0.25 * duration_s
+
+    def hot_p99(res: SimResult) -> float:
+        vals = sorted(v for a, lst in res.ttft_by_adapter.items()
+                      if a in hot for (t, v) in lst if t >= warmup_s)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    base_p99, tiered_p99 = hot_p99(base), hot_p99(tiered)
+    slot_capacity = n_servers * max_adapters
+    report = {
+        "scenario": "placement_tiered_vs_all_resident",
+        "universe": universe, "zipf_s": zipf, "qps": qps,
+        "duration_s": duration_s, "n_servers": n_servers,
+        "max_adapters": max_adapters, "host_cache": host_cache,
+        "seed": seed,
+        "resident_fraction": round(slot_capacity / universe, 4),
+        "hot_set_size": len(hot),
+        "hot_coverage": hot_coverage,
+        "cells": {
+            "all_resident": dict(base.summary(),
+                                 hot_ttft_p99_s=round(base_p99, 4)),
+            "tiered": dict(tiered.summary(),
+                           hot_ttft_p99_s=round(tiered_p99, 4),
+                           disk_loads=tiered.disk_loads,
+                           host_promotes=tiered.host_promotes,
+                           demotions=tiered.demotions),
+        },
+        "hot_ttft_p99_ratio": round(tiered_p99 / base_p99, 3)
+        if base_p99 > 0 else None,
+        # The acceptance bar: <10% resident AND hot-set p99 within 2x.
+        "ok": (slot_capacity < 0.1 * universe
+               and base_p99 > 0 and tiered_p99 <= 2.0 * base_p99),
+    }
+    return report
 
 
 def main(argv=None) -> None:
@@ -395,15 +622,42 @@ def main(argv=None) -> None:
     parser.add_argument("--prefix-tokens", type=int, default=1024)
     parser.add_argument("--csv", default=None, metavar="PATH",
                         help="also write results as CSV (reference main.py parity)")
+    parser.add_argument("--adapter-universe", type=int, default=0,
+                        help="long-tail adapter universe size (>0 replaces "
+                             "the fixed adapter tuple with a seeded Zipf "
+                             "draw — the placement plane's traffic shape)")
+    parser.add_argument("--adapter-zipf", type=float, default=1.1,
+                        help="Zipf exponent for --adapter-universe traffic")
+    parser.add_argument("--placement-scenario", action="store_true",
+                        help="run the tiered-vs-all-resident placement "
+                             "acceptance scenario (1000-adapter Zipf by "
+                             "default) and print its report instead of the "
+                             "policy sweep")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="also write the placement-scenario report JSON "
+                             "to this path (the committed artifact)")
     args = parser.parse_args(argv)
     latency = V5E_DEFAULT if args.latency_model == "v5e" else A100_VLLM
+    if args.placement_scenario:
+        universe = args.adapter_universe or 1000
+        report = run_placement_scenario(
+            universe=universe, zipf=args.adapter_zipf,
+            qps=args.qps[0] if args.qps else 30.0,
+            duration_s=args.duration, n_servers=args.servers)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        raise SystemExit(0 if report["ok"] else 1)
     rows = []
     for qps in args.qps:
         for policy in args.policies:
             cfg = WorkloadConfig(qps=qps, duration_s=args.duration,
                                  session_fraction=args.session_fraction,
                                  n_sessions=args.sessions,
-                                 session_prefix_tokens=args.prefix_tokens)
+                                 session_prefix_tokens=args.prefix_tokens,
+                                 adapter_universe=args.adapter_universe,
+                                 adapter_zipf=args.adapter_zipf)
             result = simulate(policy, cfg, n_servers=args.servers, latency=latency)
             summary = result.summary()
             rows.append(summary)

@@ -98,6 +98,20 @@ class LoraAdapter:
         return hash(self.id)
 
 
+# Planner decision action -> model-server endpoint (the residency-ladder
+# verbs api_http exposes; ``migrate`` executes as a load on the TARGET
+# replica — a promote when the weights were prefetched, an Orbax restore
+# otherwise).
+PLANNER_ACTION_ENDPOINTS = {
+    "prefetch": "/v1/prefetch_lora_adapter",
+    "migrate": "/v1/load_lora_adapter",
+    "demote": "/v1/demote_lora_adapter",
+    "evict": "/v1/evict_lora_adapter",
+    "load": "/v1/load_lora_adapter",
+    "unload": "/v1/unload_lora_adapter",
+}
+
+
 class LoraReconciler:
     def __init__(
         self,
@@ -106,12 +120,22 @@ class LoraReconciler:
         health_check_timeout_s: float = 300.0,
         health_check_interval_s: float = 15.0,
         http_timeout_s: float = 60.0,
+        planner_url: str | None = None,
+        pod_name: str | None = None,
     ):
         self.config_file = config_file
         self.config_validation = config_validation
         self.health_check_timeout_s = health_check_timeout_s
         self.health_check_interval_s = health_check_interval_s
         self.http_timeout_s = http_timeout_s
+        # Planner mode (--planner-url): decisions come from the gateway's
+        # /debug/placement instead of the static ensureExist/ensureNotExist
+        # sections; the config file (when present) still supplies host/
+        # port and the adapter-id -> source registry for decisions whose
+        # ``path`` is empty.  Without a planner URL, behavior is byte-
+        # identical to the static-file sidecar (regression-pinned).
+        self.planner_url = planner_url.rstrip("/") if planner_url else None
+        self.pod_name = pod_name
 
     # -- config (sidecar.py:82-96) ------------------------------------------
     @property
@@ -214,11 +238,87 @@ class LoraReconciler:
         logger.info("unloaded adapter %s", adapter.id)
         return None
 
+    # -- planner mode ---------------------------------------------------------
+    def source_registry(self) -> dict[str, str]:
+        """Adapter id -> checkpoint source from the config's ensureExist
+        section — the path fallback for planner decisions that carry
+        none (the planner may not know the checkpoint layout)."""
+        return {a.id: a.source for a in self._adapters("ensureExist")}
+
+    def planner_decisions(self) -> list[dict]:
+        """Fetch /debug/placement and keep the decisions addressed to
+        THIS replica: by pod name when --pod-name was given, else by the
+        decision's ``address`` matching our model server."""
+        url = f"{self.planner_url}/debug/placement"
+        try:
+            with urllib.request.urlopen(url, timeout=self.http_timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except (OSError, urllib.error.URLError, json.JSONDecodeError) as e:
+            logger.error("cannot poll planner %s: %s", url, e)
+            return []
+        mine = []
+        for d in payload.get("decisions", []):
+            if self.pod_name is not None:
+                if d.get("pod") != self.pod_name:
+                    continue
+            elif d.get("address") != self.model_server:
+                continue
+            mine.append(d)
+        return mine
+
+    def apply_decision(self, decision: dict,
+                       sources: dict[str, str]) -> str | None:
+        """Execute one planner decision over the adapter wire; returns an
+        error string, or None (success / benign refusal).  409s are
+        EXPECTED steady-state refusals (in-flight requests pin a demote;
+        a load may race a slot filling) — the planner re-emits next tick
+        if still warranted, exactly like the static reconciler retries."""
+        action = decision.get("action", "")
+        adapter = decision.get("adapter", "")
+        endpoint = PLANNER_ACTION_ENDPOINTS.get(action)
+        if endpoint is None or not adapter:
+            return f"unintelligible decision {decision!r}"
+        payload = {"lora_name": adapter}
+        if action in ("prefetch", "migrate", "load"):
+            path = decision.get("path") or sources.get(adapter, "")
+            if not path:
+                return (f"{action} {adapter}: no checkpoint path (planner "
+                        "sent none and the config registry has no source)")
+            payload["lora_path"] = path
+        status, body = self._post(endpoint, payload)
+        if status == 409:
+            logger.info("%s %s deferred: %s", action, adapter, body)
+            return None
+        if status not in (200, 404):  # 404 = already gone (evict/demote)
+            return f"{action} {adapter}: HTTP {status} {body}"
+        logger.info("applied planner decision: %s %s", action, adapter)
+        return None
+
+    def reconcile_planner(self) -> list[str]:
+        """Planner-mode reconcile: health-gate, then apply this replica's
+        slice of the gateway's placement plan."""
+        if not self.is_server_healthy():
+            msg = f"server {self.model_server} unhealthy past timeout"
+            logger.error(msg)
+            return [msg]
+        sources = self.source_registry()
+        errors = []
+        for decision in self.planner_decisions():
+            err = self.apply_decision(decision, sources)
+            if err:
+                errors.append(err)
+        logger.info("planner reconcile complete (%d errors)", len(errors))
+        return errors
+
     def reconcile(self) -> list[str]:
         """sidecar.py:215-239: health-gate, then drive to desired state.
 
-        Returns accumulated errors (empty = converged).
+        Returns accumulated errors (empty = converged).  Planner mode
+        delegates to ``reconcile_planner`` — decisions instead of the
+        static ensureExist/ensureNotExist diff.
         """
+        if self.planner_url is not None:
+            return self.reconcile_planner()
         if not self.is_server_healthy():
             msg = f"server {self.model_server} unhealthy past timeout"
             logger.error(msg)
@@ -240,17 +340,23 @@ class LoraReconciler:
 
 
 def watch(reconciler: LoraReconciler, poll_interval_s: float = 2.0) -> None:
-    """Mtime-gated watch loop (PollingObserver equivalent, sidecar.py:242-261)."""
+    """Mtime-gated watch loop (PollingObserver equivalent, sidecar.py:242-261).
+
+    Planner mode polls EVERY interval — decisions change with the
+    gateway's tick, not with a config file's mtime."""
     last_mtime = 0.0
     reconciler.reconcile()
     while True:
-        try:
-            mtime = os.stat(reconciler.config_file).st_mtime
-            if mtime != last_mtime:
-                last_mtime = mtime
-                reconciler.reconcile()
-        except OSError:
-            pass
+        if reconciler.planner_url is not None:
+            reconciler.reconcile()
+        else:
+            try:
+                mtime = os.stat(reconciler.config_file).st_mtime
+                if mtime != last_mtime:
+                    last_mtime = mtime
+                    reconciler.reconcile()
+            except OSError:
+                pass
         time.sleep(poll_interval_s)
 
 
@@ -263,9 +369,21 @@ def main(argv=None) -> None:
     )
     parser.add_argument("--once", action="store_true", help="reconcile once and exit")
     parser.add_argument("--poll-interval", type=float, default=2.0)
+    parser.add_argument(
+        "--planner-url", default=None, metavar="URL",
+        help="gateway base URL whose /debug/placement decisions drive "
+             "this replica's residency ladder (prefetch/demote/evict/"
+             "migrate) INSTEAD of the static ensureExist/ensureNotExist "
+             "diff; the config file still supplies host/port and the "
+             "adapter source registry")
+    parser.add_argument(
+        "--pod-name", default=None,
+        help="this replica's pod name in the gateway's pool (planner "
+             "decisions filter on it; default: match by host:port)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    reconciler = LoraReconciler(args.config)
+    reconciler = LoraReconciler(args.config, planner_url=args.planner_url,
+                                pod_name=args.pod_name)
     if args.once:
         errors = reconciler.reconcile()
         raise SystemExit(1 if errors else 0)

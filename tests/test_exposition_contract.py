@@ -126,6 +126,14 @@ def server_snapshot() -> dict:
         "running_lora_adapters": ["a1", HOSTILE],
         "waiting_lora_adapters": [HOSTILE],
         "max_lora": 4,
+        "adapter_ranks": {"a1": 8, HOSTILE: 64},
+        # Residency ladder (placement plane) with a hostile adapter name
+        # in the tier CSVs: each name in exactly ONE tier (the
+        # conservation lint in tests/test_placement.py reads the same
+        # surface).
+        "residency": {"slot": ["a1"], "host": [HOSTILE]},
+        "tier_transitions": {("disk", "slot"): 2, ("slot", "host"): 1},
+        "adapter_load_seconds": {"host": [0.05, 1], "disk": [1.2, 2]},
         "prefix_reused_tokens": 77,
         "phase_hist": {
             "prefill": hist.state(),
@@ -458,6 +466,56 @@ def test_usage_rollup_exposition_contract():
         HOSTILE, "base"}
     # Unlabeled fallback keeps the counter family present at zero.
     assert families["gateway_usage_would_deprioritize_total"][0].value == 0
+
+
+def loaded_placement_planner():
+    """A ticked PlacementPlanner over a hostile-named residency fixture
+    (shared with the docs-coverage test)."""
+    from llm_instance_gateway_tpu.gateway.placement import (
+        PlacementConfig,
+        PlacementPlanner,
+    )
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics,
+        Pod,
+        PodMetrics,
+    )
+
+    provider = StaticProvider([
+        PodMetrics(pod=Pod("pod-0", "1.1.1.1:1"),
+                   metrics=Metrics(adapter_tiers={HOSTILE: "slot"},
+                                   active_adapters={HOSTILE: 0},
+                                   max_active_adapters=4)),
+        PodMetrics(pod=Pod(HOSTILE, "1.1.1.1:2"),
+                   metrics=Metrics(adapter_tiers={"a1": "host"},
+                                   max_active_adapters=4)),
+    ])
+
+    class FakeUsage:
+        def shares_snapshot(self):
+            return {(HOSTILE, HOSTILE): 0.6, ("m", "a1"): 0.1}
+
+    planner = PlacementPlanner(provider, usage=FakeUsage(),
+                               cfg=PlacementConfig(mode="prefer_resident"))
+    planner.tick()
+    planner.note_pick(HOSTILE, HOSTILE)  # wrong-tier observable
+    planner.note_placement_escape()
+    return planner
+
+
+def test_placement_exposition_contract():
+    """The placement families lint clean and round-trip hostile labels
+    on the gateway surface."""
+    planner = loaded_placement_planner()
+    text = "\n".join(planner.render()) + "\n"
+    fams = lint_exposition(text)
+    assert len(fams) >= 5, sorted(fams)
+    residency = fams["gateway_adapter_residency"]
+    assert any(s.labels.get("pod") == HOSTILE for s in residency)
+    assert any(s.labels.get("adapter") == HOSTILE for s in residency)
+    assert fams["gateway_placement_wrong_tier_picks_total"][0].value == 1
+    assert fams["gateway_placement_escapes_total"][0].value == 1
 
 
 def loaded_fairness_policy():

@@ -271,9 +271,11 @@ class TestSnapshotResidency:
         calls = []
         orig = sched._marshal
 
-        def counted(state, pods, policy, bad, fairness, noisy):
+        def counted(state, pods, policy, bad, fairness, noisy,
+                    placement="log_only", rmap=None):
             calls.append(len(pods))
-            return orig(state, pods, policy, bad, fairness, noisy)
+            return orig(state, pods, policy, bad, fairness, noisy,
+                        placement, rmap)
 
         sched._marshal = counted
         return calls

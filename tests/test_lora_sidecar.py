@@ -23,6 +23,8 @@ class FakeModelServer:
 
     def __init__(self):
         self.loaded: dict[str, str] = {}
+        self.host: dict[str, str] = {}   # host-RAM tier (residency ladder)
+        self.busy: set[str] = set()      # adapters with in-flight requests
         self.calls: list[tuple[str, str]] = []
         self.healthy = True
         outer = self
@@ -53,17 +55,39 @@ class FakeModelServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n))
+                name = body.get("lora_name", "")
                 if self.path == "/v1/load_lora_adapter":
-                    outer.calls.append(("load", body["lora_name"]))
-                    outer.loaded[body["lora_name"]] = body["lora_path"]
+                    outer.calls.append(("load", name))
+                    outer.loaded[name] = body["lora_path"]
+                    outer.host.pop(name, None)  # promote consumes the copy
                     self._send(200, {"status": "ok"})
                 elif self.path == "/v1/unload_lora_adapter":
-                    outer.calls.append(("unload", body["lora_name"]))
-                    if body["lora_name"] in outer.loaded:
-                        del outer.loaded[body["lora_name"]]
+                    outer.calls.append(("unload", name))
+                    if name in outer.loaded:
+                        del outer.loaded[name]
                         self._send(200, {"status": "ok"})
                     else:
                         self._send(404, {"error": "not loaded"})
+                elif self.path == "/v1/demote_lora_adapter":
+                    outer.calls.append(("demote", name))
+                    if name in outer.busy:
+                        self._send(409, {"error": "in-flight requests"})
+                    elif name in outer.loaded:
+                        outer.host[name] = outer.loaded.pop(name)
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(404, {"error": "not slot-resident"})
+                elif self.path == "/v1/prefetch_lora_adapter":
+                    outer.calls.append(("prefetch", name))
+                    outer.host.setdefault(name, body["lora_path"])
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/v1/evict_lora_adapter":
+                    outer.calls.append(("evict", name))
+                    if name in outer.host:
+                        del outer.host[name]
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(404, {"error": "not host-resident"})
                 else:
                     self._send(404, {})
 
@@ -168,3 +192,140 @@ class TestAdapterIdentity:
         # sidecar.py:55-60: equality/hash by id only.
         assert LoraAdapter("x", "/a") == LoraAdapter("x", "/b")
         assert len({LoraAdapter("x", "/a"), LoraAdapter("x", "/b")}) == 1
+
+
+class FakePlanner:
+    """Minimal /debug/placement endpoint serving canned decisions."""
+
+    def __init__(self, decisions):
+        outer = self
+        self.decisions = decisions
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/debug/placement":
+                    body = json.dumps(
+                        {"mode": "prefer_resident",
+                         "decisions": outer.decisions}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_port
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestPlannerMode:
+    def _reconciler(self, config_path, planner, pod_name="pod-0"):
+        return LoraReconciler(
+            config_path, planner_url=planner.url, pod_name=pod_name,
+            health_check_timeout_s=2.0, health_check_interval_s=0.1,
+            http_timeout_s=5.0)
+
+    def test_decisions_drive_residency_verbs(self, fake_server, tmp_path):
+        fake_server.loaded["idle"] = "/ckpt/idle"
+        fake_server.host["stale"] = "/ckpt/stale"
+        path = write_config(tmp_path, fake_server.port,
+                            ensure_exist=("hot",))  # source registry only
+        planner = FakePlanner([
+            {"action": "prefetch", "pod": "pod-0", "adapter": "hot",
+             "path": "", "address": ""},
+            {"action": "demote", "pod": "pod-0", "adapter": "idle",
+             "path": "", "address": ""},
+            {"action": "evict", "pod": "pod-0", "adapter": "stale",
+             "path": "", "address": ""},
+            {"action": "migrate", "pod": "pod-0", "adapter": "mover",
+             "path": "/ckpt/mover", "address": ""},
+        ])
+        try:
+            errors = self._reconciler(path, planner).reconcile()
+            assert errors == []
+            # Planner mode never ran the static ensureExist diff: "hot"
+            # was PREFETCHED (host tier), not loaded into a slot.
+            assert fake_server.calls == [
+                ("prefetch", "hot"), ("demote", "idle"),
+                ("evict", "stale"), ("load", "mover")]
+            assert fake_server.host["hot"] == "/ckpt/hot"  # registry path
+            assert "idle" in fake_server.host
+            assert "stale" not in fake_server.host
+            assert fake_server.loaded["mover"] == "/ckpt/mover"
+        finally:
+            planner.close()
+
+    def test_foreign_pod_decisions_filtered(self, fake_server, tmp_path):
+        path = write_config(tmp_path, fake_server.port)
+        planner = FakePlanner([
+            {"action": "prefetch", "pod": "pod-OTHER", "adapter": "x",
+             "path": "/ckpt/x", "address": ""},
+        ])
+        try:
+            errors = self._reconciler(path, planner).reconcile()
+            assert errors == []
+            assert fake_server.calls == []
+        finally:
+            planner.close()
+
+    def test_address_match_without_pod_name(self, fake_server, tmp_path):
+        path = write_config(tmp_path, fake_server.port)
+        addr = f"127.0.0.1:{fake_server.port}"
+        planner = FakePlanner([
+            {"action": "prefetch", "pod": "pod-9", "adapter": "a",
+             "path": "/ckpt/a", "address": addr},
+            {"action": "prefetch", "pod": "pod-8", "adapter": "b",
+             "path": "/ckpt/b", "address": "10.0.0.1:8000"},
+        ])
+        try:
+            r = self._reconciler(path, planner, pod_name=None)
+            assert r.reconcile() == []
+            assert fake_server.calls == [("prefetch", "a")]
+        finally:
+            planner.close()
+
+    def test_busy_demote_defers_without_error(self, fake_server, tmp_path):
+        fake_server.loaded["pinned"] = "/ckpt/pinned"
+        fake_server.busy.add("pinned")
+        path = write_config(tmp_path, fake_server.port)
+        planner = FakePlanner([
+            {"action": "demote", "pod": "pod-0", "adapter": "pinned",
+             "path": "", "address": ""},
+        ])
+        try:
+            # A 409 (in-flight requests pin the slot) is a deferral, not
+            # an error: the planner re-emits next tick once drained.
+            assert self._reconciler(path, planner).reconcile() == []
+            assert "pinned" in fake_server.loaded
+        finally:
+            planner.close()
+
+    def test_static_file_deployment_unchanged(self, fake_server, tmp_path):
+        """Regression pin: WITHOUT --planner-url the sidecar's wire
+        behavior is byte-identical to the pre-planner sidecar — the exact
+        same call sequence for the same config."""
+        fake_server.loaded["old"] = "/ckpt/old"
+        path = write_config(tmp_path, fake_server.port,
+                            ensure_exist=("a1", "a2"),
+                            ensure_not_exist=("old",))
+        errors = make_reconciler(path).reconcile()
+        assert errors == []
+        # Exactly the historical sequence: loads in id order (skipping
+        # nothing), then unloads — no residency-verb calls ever.
+        assert fake_server.calls == [
+            ("load", "a1"), ("load", "a2"), ("unload", "old")]
+        assert set(fake_server.loaded) == {"a1", "a2"}

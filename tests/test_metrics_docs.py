@@ -38,15 +38,17 @@ def test_registry_covers_gateway_surface():
     from test_exposition_contract import (
         loaded_fairness_policy,
         loaded_observability,
+        loaded_placement_planner,
         loaded_usage_rollup,
     )
 
     gm, engine, scorer, journal = loaded_observability()
     _gm2, rollup, _journal2 = loaded_usage_rollup()
     fairness = loaded_fairness_policy()
+    placement = loaded_placement_planner()
     text = gm.render() + "\n".join(
         engine.render() + scorer.render() + rollup.render()
-        + fairness.render()
+        + fairness.render() + placement.render()
         + journal.render_prom("gateway_events_total")) + "\n"
     rendered = _rendered_family_names(text)
     registered = metrics_registry.registered_names()

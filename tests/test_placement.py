@@ -1,0 +1,870 @@
+"""Adapter residency & placement plane tests (server/lora_manager.py tiers,
+gateway/placement.py planner, the prefer_resident routing seam, and the
+tier-conservation exposition lint).
+
+The acceptance-critical invariants:
+
+- **Tier conservation**: every adapter appears in exactly ONE tier per
+  replica at all times — asserted on the LoRAManager directly after every
+  lifecycle transition AND through the rendered ``/metrics`` exposition.
+- **Lifecycle edges**: unload/demote of an adapter with in-flight (or
+  decode_wait-parked — same acquire/release pin) requests is refused with
+  AdapterBusyError; concurrent loads of one name are idempotent (one
+  slot, one registry entry).
+- **log_only is routing-byte-identical**: same-RNG diff tests, Python AND
+  native, composed with the health/circuit/usage/fairness planes.
+- **prefer_resident parity**: the native scheduler agrees with the Python
+  oracle pick for pick, slot tier beating host tier, with the counted
+  escape hatch.
+- **Sim-validated target scenario**: the committed PLACEMENT_SIM.json
+  artifact (1000 adapters, <10% slot-resident, hot-set p99 TTFT within
+  2x all-resident) reproduces from the current code.
+"""
+
+import dataclasses
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu.gateway import placement as placement_mod
+from llm_instance_gateway_tpu.gateway.placement import (
+    PlacementConfig,
+    PlacementPlanner,
+)
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+    Scheduler,
+    filter_by_placement,
+)
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+
+# ---------------------------------------------------------------------------
+# Engine-side residency ladder (LoRAManager)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    from llm_instance_gateway_tpu.models import llama
+
+    return dataclasses.replace(llama.CONFIGS["llama3-tiny"],
+                               max_lora_slots=2)
+
+
+def _weights(cfg, rank=2):
+    from llm_instance_gateway_tpu.models.lora import target_dims
+
+    d_in, d_out = target_dims(cfg)["q"]
+    return {"q": {"a": np.ones((cfg.n_layers, d_in, rank), np.float32),
+                  "b": np.ones((cfg.n_layers, rank, d_out), np.float32)}}
+
+
+def _manager(cfg, host_cache_slots=4):
+    from llm_instance_gateway_tpu.server.lora_manager import LoRAManager
+
+    return LoRAManager(cfg, host_cache_slots=host_cache_slots)
+
+
+def _assert_one_tier(manager):
+    """The conservation invariant: slot and host tier sets are disjoint
+    and adapter_tiers maps each name exactly once."""
+    snap = manager.residency_snapshot()
+    slot, host = set(snap["slot"]), set(snap["host"])
+    assert not (slot & host), snap
+    tiers = manager.adapter_tiers()
+    assert set(tiers) == slot | host
+    for name, tier in tiers.items():
+        assert (name in slot) == (tier == "slot")
+        assert (name in host) == (tier == "host")
+
+
+class TestLoRAManagerLadder:
+    def test_demote_promote_round_trip(self, tiny_cfg):
+        m = _manager(tiny_cfg)
+        m.load("a1", weights=_weights(tiny_cfg), alpha=32.0, rank=2)
+        _assert_one_tier(m)
+        assert m.demote("a1")
+        _assert_one_tier(m)
+        assert m.adapter_tiers() == {"a1": "host"}
+        # Promote: NO weights argument — the host copy restores the exact
+        # alpha/rank recorded at load time.
+        info = m.load("a1")
+        assert (info.alpha, info.rank) == (32.0, 2)
+        assert m.adapter_tiers() == {"a1": "slot"}
+        _assert_one_tier(m)
+        assert m.tier_transitions[("slot", "host")] == 1
+        assert m.tier_transitions[("host", "slot")] == 1
+        # Promotion latency landed in the host-tier accounting.
+        assert m.load_seconds["host"][1] == 1
+
+    def test_unload_busy_refused_and_pin_released(self, tiny_cfg):
+        from llm_instance_gateway_tpu.server.lora_manager import (
+            AdapterBusyError,
+        )
+
+        m = _manager(tiny_cfg)
+        m.load("a1", weights=_weights(tiny_cfg), rank=2)
+        # acquire() is the admission-time pin — decode_wait-parked
+        # requests hold it exactly like running ones (the engine releases
+        # only at finish), so both refuse the unload the same way.
+        m.acquire("a1")
+        with pytest.raises(AdapterBusyError):
+            m.unload("a1")
+        with pytest.raises(AdapterBusyError):
+            m.demote("a1")
+        assert m.adapter_tiers() == {"a1": "slot"}  # nothing corrupted
+        m.release("a1")
+        assert m.demote("a1")
+        _assert_one_tier(m)
+
+    def test_concurrent_load_same_name_idempotent(self, tiny_cfg):
+        m = _manager(tiny_cfg)
+        w = _weights(tiny_cfg)
+        results, errors = [], []
+
+        def load():
+            try:
+                results.append(m.load("dup", weights=w, rank=2))
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=load) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # One slot consumed, every caller saw the same registry entry.
+        assert len({info.slot for info in results}) == 1
+        assert len(m._free_slots) == tiny_cfg.max_lora_slots - 1
+        _assert_one_tier(m)
+
+    def test_host_overflow_falls_to_disk(self, tiny_cfg):
+        m = _manager(tiny_cfg, host_cache_slots=1)
+        m.load("a1", weights=_weights(tiny_cfg), rank=2)
+        m.load("a2", weights=_weights(tiny_cfg), rank=2)
+        m.demote("a1")
+        m.demote("a2")  # LRU overflow: a1 falls host -> disk
+        assert m.adapter_tiers() == {"a2": "host"}
+        assert m.tier_transitions[("host", "disk")] == 1
+        _assert_one_tier(m)
+
+    def test_demote_refused_when_host_tier_disabled(self, tiny_cfg):
+        from llm_instance_gateway_tpu.server.lora_manager import AdapterError
+
+        m = _manager(tiny_cfg, host_cache_slots=0)
+        m.load("a1", weights=_weights(tiny_cfg), rank=2)
+        # A zero-slot host cache would silently discard the weights while
+        # claiming tier=host — refuse instead.
+        with pytest.raises(AdapterError, match="host cache disabled"):
+            m.demote("a1")
+        assert m.adapter_tiers() == {"a1": "slot"}
+
+    def test_new_source_discards_stale_host_copy(self, tiny_cfg, tmp_path):
+        from llm_instance_gateway_tpu.server.lora_manager import save_adapter
+
+        v1 = str(tmp_path / "v1")
+        v2 = str(tmp_path / "v2")
+        save_adapter(v1, _weights(tiny_cfg), alpha=16.0, rank=2)
+        save_adapter(v2, _weights(tiny_cfg, rank=4), alpha=8.0, rank=4)
+        m = _manager(tiny_cfg)
+        m.load("x", checkpoint_path=v1)
+        m.demote("x")
+        # Publishing v2 must not be shadowed by the v1 host copy.
+        info = m.load("x", checkpoint_path=v2)
+        assert (info.rank, info.alpha, info.source) == (4, 8.0, v2)
+        assert m.tier_transitions[("host", "disk")] == 1  # stale discard
+        _assert_one_tier(m)
+        # Same-path reload IS the promotion fast path (no restore).
+        m.demote("x")
+        loads_before = m.load_seconds["disk"][1]
+        info = m.load("x", checkpoint_path=v2)
+        assert info.rank == 4
+        assert m.load_seconds["disk"][1] == loads_before  # no disk hit
+
+    def test_prefetch_and_evict(self, tiny_cfg, tmp_path):
+        from llm_instance_gateway_tpu.server.lora_manager import save_adapter
+
+        path = str(tmp_path / "ckpt-a3")
+        save_adapter(path, _weights(tiny_cfg), alpha=16.0, rank=2)
+        m = _manager(tiny_cfg)
+        assert m.prefetch("a3", path)
+        assert m.adapter_tiers() == {"a3": "host"}
+        assert m.tier_transitions[("disk", "host")] == 1
+        assert m.load_seconds["disk"][1] == 1  # restore latency recorded
+        assert not m.prefetch("a3", path)  # idempotent for RAM-resident
+        # Promotion consumes the host copy — no slot restore needed.
+        info = m.load("a3")
+        assert info.rank == 2 and m.adapter_tiers() == {"a3": "slot"}
+        _assert_one_tier(m)
+        # evict_host touches only the host tier.
+        assert not m.evict_host("a3")
+        m.demote("a3")
+        assert m.evict_host("a3")
+        assert m.adapter_tiers() == {}
+        _assert_one_tier(m)
+
+    def test_exposition_tier_conservation(self, tiny_cfg):
+        """The rendered /metrics surface carries each adapter in exactly
+        one tier: the residency info lines AND the lora_requests_info
+        resident_tiers label agree with the registry."""
+        from llm_instance_gateway_tpu.server import metrics as metrics_mod
+        from llm_instance_gateway_tpu.utils import prom_parse
+
+        m = _manager(tiny_cfg)
+        m.load("a1", weights=_weights(tiny_cfg), rank=2)
+        m.load("a2", weights=_weights(tiny_cfg, rank=4), rank=4)
+        m.demote("a2")
+        transitions, load_seconds = m.residency_counters()
+        snap = {
+            "prefill_queue_size": 0, "decode_queue_size": 0,
+            "num_requests_running": 0, "num_requests_waiting": 0,
+            "kv_cache_usage_perc": 0.0, "kv_tokens_capacity": 10,
+            "kv_tokens_free": 10, "decode_tokens_per_sec": 0.0,
+            "running_lora_adapters": ["a1"], "waiting_lora_adapters": [],
+            "max_lora": 2, "adapter_ranks": m.adapter_ranks(),
+            "residency": m.residency_snapshot(),
+            "tier_transitions": transitions,
+            "adapter_load_seconds": load_seconds,
+        }
+        text = metrics_mod.render(snap)
+        fams = prom_parse.parse_text_fast(text)
+        seen: dict[str, str] = {}
+        for s in fams["tpu:adapter_residency_info"]:
+            tier = s.labels["tier"]
+            for name in s.labels["adapters"].split(","):
+                if name:
+                    assert name not in seen, (
+                        f"{name} in both {seen[name]} and {tier}")
+                    seen[name] = tier
+        assert seen == {"a1": "slot", "a2": "host"}
+        lora = fams["tpu:lora_requests_info"][0]
+        label_tiers = dict(
+            entry.rsplit(":", 1)
+            for entry in lora.labels["resident_tiers"].split(","))
+        assert label_tiers == seen
+        # Transition counters render with valid from/to tiers only.
+        for s in fams["tpu:adapter_tier_transitions_total"]:
+            if s.labels:
+                assert s.labels["from"] in ("slot", "host", "disk")
+                assert s.labels["to"] in ("slot", "host", "disk")
+
+
+def test_metrics_client_parses_residency_and_split():
+    from llm_instance_gateway_tpu.gateway.metrics_client import (
+        families_to_metrics,
+    )
+    from llm_instance_gateway_tpu.utils import prom_parse
+
+    text = "\n".join([
+        "# TYPE tpu:num_requests_running gauge",
+        "tpu:num_requests_running 1",
+        "# TYPE tpu:num_requests_waiting gauge",
+        "tpu:num_requests_waiting 0",
+        "# TYPE tpu:kv_cache_usage_perc gauge",
+        "tpu:kv_cache_usage_perc 0.1",
+        "# TYPE tpu:lora_requests_info gauge",
+        'tpu:lora_requests_info{running_lora_adapters="a1",'
+        'waiting_lora_adapters="a2",max_lora="4",adapter_ranks="a1:2",'
+        'resident_tiers="a1:slot,a2:host"} 1700000000',
+        "# TYPE tpu:adapter_residency_info gauge",
+        'tpu:adapter_residency_info{tier="slot",adapters="a1"} 1700000001',
+        'tpu:adapter_residency_info{tier="host",adapters="a2,a3"} '
+        "1700000001",
+    ]) + "\n"
+    fams = prom_parse.parse_text_fast(text)
+    metrics, errs = families_to_metrics(fams, Metrics())
+    assert metrics.running_adapters == frozenset({"a1"})
+    assert metrics.waiting_adapters == frozenset({"a2"})
+    assert metrics.active_adapters == {"a1": 0, "a2": 0}
+    # The dedicated residency family overrides the summary label.
+    assert metrics.adapter_tiers == {"a1": "slot", "a2": "host",
+                                     "a3": "host"}
+
+
+# ---------------------------------------------------------------------------
+# PlacementPlanner
+# ---------------------------------------------------------------------------
+
+
+HOT, WARM, COLD = "hot", "warm", "cold"
+
+
+def _pods(n=4, tiers_of=None, waiting_of=None, queue_of=None):
+    pods = []
+    for i in range(n):
+        name = f"pod-{i}"
+        tiers = (tiers_of or {}).get(name, {})
+        pods.append(PodMetrics(
+            pod=Pod(name, f"10.0.0.{i}:8000"),
+            metrics=Metrics(
+                waiting_queue_size=(queue_of or {}).get(name, 0),
+                active_adapters={a: 0 for a, t in tiers.items()
+                                 if t == "slot"},
+                max_active_adapters=4,
+                adapter_tiers=tiers,
+                waiting_adapters=(waiting_of or {}).get(name, frozenset()),
+            )))
+    return pods
+
+
+class FakeUsage:
+    def __init__(self, shares):
+        self._shares = shares  # {(model, adapter): share}
+
+    def shares_snapshot(self):
+        return dict(self._shares)
+
+
+class TestPlanner:
+    def test_tick_builds_tier_maps_and_gauge(self):
+        provider = StaticProvider(_pods(tiers_of={
+            "pod-0": {HOT: "slot"}, "pod-1": {HOT: "host", WARM: "slot"}}))
+        planner = PlacementPlanner(provider, cfg=PlacementConfig())
+        planner.tick()
+        assert planner.resident_pods(HOT) == frozenset({"pod-0", "pod-1"})
+        assert planner.resident_tiers(HOT) == (
+            frozenset({"pod-0"}), frozenset({"pod-1"}))
+        assert planner.resident_pods(COLD) == frozenset()
+        lines = planner.render()
+        assert ('gateway_adapter_residency{model="",adapter="hot",'
+                'pod="pod-0",tier="slot"} 1') in lines
+        assert ('gateway_adapter_residency{model="",adapter="hot",'
+                'pod="pod-1",tier="host"} 1') in lines
+
+    def test_no_residency_data_disables_seam(self):
+        planner = PlacementPlanner(StaticProvider(_pods()),
+                                   cfg=PlacementConfig())
+        planner.tick()
+        assert planner.resident_pods(HOT) is None
+        assert planner.resident_map() is None
+        # note_pick is inert without data — no counters move.
+        planner.note_pick("pod-0", HOT)
+        assert planner.would_steer_total == 0
+
+    def test_head_replication_prefetch(self):
+        provider = StaticProvider(_pods(
+            tiers_of={"pod-0": {HOT: "slot"}},
+            queue_of={"pod-1": 1, "pod-2": 2, "pod-3": 3}))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({("m", HOT): 0.5}),
+            cfg=PlacementConfig(prefetch_min_share=0.02))
+        planner.tick()
+        decisions = planner.debug_payload()["decisions"]
+        # The head adapter earns a host copy on EVERY other replica,
+        # cheapest first.
+        assert [(d["action"], d["pod"]) for d in decisions] == [
+            ("prefetch", "pod-1"), ("prefetch", "pod-2"),
+            ("prefetch", "pod-3")]
+        assert all(d["adapter"] == HOT for d in decisions)
+
+    def test_waiting_adapter_prefetches_to_least_loaded(self):
+        provider = StaticProvider(_pods(
+            tiers_of={"pod-0": {HOT: "slot"}},
+            waiting_of={"pod-2": frozenset({COLD})},
+            queue_of={"pod-0": 5, "pod-1": 0, "pod-2": 3, "pod-3": 4}))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({("m", COLD): 0.001}),
+            cfg=PlacementConfig())
+        planner.tick()
+        decisions = [d for d in planner.debug_payload()["decisions"]
+                     if d["adapter"] == COLD]
+        assert decisions == [{
+            "action": "prefetch", "pod": "pod-1", "adapter": COLD,
+            "path": "", "reason": "waiting", "address": "10.0.0.1:8000"}]
+
+    def test_idle_demote_then_evict_with_dwell(self):
+        tiers = {"pod-0": {COLD: "slot"}}
+        provider = StaticProvider(_pods(tiers_of=tiers))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({}),
+            cfg=PlacementConfig(demote_idle_ticks=2, evict_idle_ticks=3))
+        planner.tick()
+        assert planner.debug_payload()["decisions"] == []  # dwell 1 < 2
+        planner.tick()
+        decisions = planner.debug_payload()["decisions"]
+        assert [(d["action"], d["adapter"]) for d in decisions] == [
+            ("demote", COLD)]
+        # The demote executed: the adapter is host-tier now; once the
+        # idle streak reaches the eviction dwell it falls to disk.
+        pm0 = provider.all_pod_metrics()[0]
+        pm0.metrics.adapter_tiers[COLD] = "host"
+        pm0.metrics.active_adapters.pop(COLD, None)
+        planner.tick()  # idle streak (3) continues across the tier change
+        decisions = planner.debug_payload()["decisions"]
+        assert [(d["action"], d["adapter"]) for d in decisions] == [
+            ("evict", COLD)]
+
+    def test_migrate_hot_adapter_off_overloaded_homes(self):
+        provider = StaticProvider(_pods(
+            tiers_of={"pod-0": {HOT: "slot"}},
+            queue_of={"pod-0": 50, "pod-1": 1, "pod-2": 2, "pod-3": 2}))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({("m", HOT): 0.6}),
+            cfg=PlacementConfig(migrate_min_share=0.25,
+                                prefetch_min_share=0.9))
+        planner.tick()
+        migrates = [d for d in planner.debug_payload()["decisions"]
+                    if d["action"] == "migrate"]
+        assert migrates and migrates[0]["pod"] == "pod-1"
+
+    def test_action_budget_bounds_decisions(self):
+        provider = StaticProvider(_pods(
+            tiers_of={"pod-0": {HOT: "slot"}}))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({("m", HOT): 0.9}),
+            cfg=PlacementConfig(max_actions_per_tick=2))
+        planner.tick()
+        assert len(planner.debug_payload()["decisions"]) == 2
+
+    def test_checkpoint_root_path_template(self):
+        provider = StaticProvider(_pods(tiers_of={"pod-0": {HOT: "slot"}}))
+        planner = PlacementPlanner(
+            provider, usage=FakeUsage({("m", HOT): 0.5}),
+            cfg=PlacementConfig(checkpoint_root="/ckpts/"))
+        planner.tick()
+        d = planner.debug_payload()["decisions"][0]
+        assert d["path"] == "/ckpts/hot"
+
+    def test_note_pick_counters_by_mode(self):
+        tiers_of = {"pod-0": {HOT: "slot"}}
+        provider = StaticProvider(_pods(tiers_of=tiers_of))
+        log = PlacementPlanner(provider,
+                               cfg=PlacementConfig(mode="log_only"))
+        log.tick()
+        log.note_pick("pod-1", HOT)   # resident elsewhere: would-steer
+        log.note_pick("pod-0", HOT)   # resident here: clean
+        log.note_pick("pod-1", COLD)  # resident nowhere: not counted
+        assert log.would_steer_total == 1
+        assert log.wrong_tier_total == 0
+        steer = PlacementPlanner(
+            provider, cfg=PlacementConfig(mode="prefer_resident"))
+        steer.tick()
+        steer.note_pick("pod-1", HOT)
+        assert steer.wrong_tier_total == 1
+        assert steer.would_steer_total == 0
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            PlacementConfig(mode="teleport")
+        with pytest.raises(ValueError):
+            PlacementConfig(demote_idle_ticks=0)
+
+    def test_render_lints(self):
+        from llm_instance_gateway_tpu.utils import prom_parse
+
+        provider = StaticProvider(_pods(tiers_of={
+            "pod-0": {HOT: "slot"}, "pod-1": {HOT: "host"}}))
+        planner = PlacementPlanner(provider,
+                                   usage=FakeUsage({("m", HOT): 0.5}),
+                                   cfg=PlacementConfig())
+        planner.tick()
+        planner.note_placement_escape()
+        text = "\n".join(planner.render()) + "\n"
+        fams = prom_parse.parse_text_fast(text)
+        assert fams["gateway_placement_escapes_total"][0].value == 1
+        decisions = {s.labels["action"]: s.value
+                     for s in fams["gateway_placement_decisions_total"]
+                     if s.labels}
+        assert decisions.get("prefetch", 0) >= 1
+        # The residency gauge carries one series per (pod, adapter) with
+        # exactly one tier each (gateway-side conservation lint).
+        seen = set()
+        for s in fams["gateway_adapter_residency"]:
+            key = (s.labels["pod"], s.labels["adapter"])
+            assert key not in seen
+            seen.add(key)
+
+
+# ---------------------------------------------------------------------------
+# filter_by_placement + routing seams
+# ---------------------------------------------------------------------------
+
+
+def _req(model=HOT):
+    return LLMRequest(model=model, resolved_target_model=model,
+                      critical=True)
+
+
+def _steer_provider():
+    """pods 0,3 slot-host the hot adapter; pod 1 host-tier; pod 2 cold."""
+    return StaticProvider(_pods(n=4, tiers_of={
+        "pod-0": {HOT: "slot"}, "pod-3": {HOT: "slot"},
+        "pod-1": {HOT: "host"}}))
+
+
+class TestFilterByPlacement:
+    def _planner(self, provider, mode="prefer_resident"):
+        planner = PlacementPlanner(provider, cfg=PlacementConfig(mode=mode))
+        planner.tick()
+        return planner
+
+    def test_log_only_returns_unchanged(self):
+        provider = _steer_provider()
+        planner = self._planner(provider, mode="log_only")
+        cands = provider.all_pod_metrics()
+        assert filter_by_placement(planner, _req(), cands) is cands
+
+    def test_slot_tier_beats_host_tier(self):
+        provider = _steer_provider()
+        planner = self._planner(provider)
+        out = filter_by_placement(planner, _req(),
+                                  provider.all_pod_metrics())
+        assert {c.pod.name for c in out} == {"pod-0", "pod-3"}
+
+    def test_host_tier_fallback(self):
+        provider = _steer_provider()
+        planner = self._planner(planner_provider := provider)
+        cands = [pm for pm in planner_provider.all_pod_metrics()
+                 if pm.pod.name in ("pod-1", "pod-2")]
+        out = filter_by_placement(planner, _req(), cands)
+        assert [c.pod.name for c in out] == ["pod-1"]
+
+    def test_cold_adapter_untouched_no_escape(self):
+        provider = _steer_provider()
+        planner = self._planner(provider)
+        cands = provider.all_pod_metrics()
+        assert filter_by_placement(planner, _req(COLD), cands) is cands
+        assert planner.escape_total == 0
+
+    def test_escape_when_resident_but_not_among_candidates(self):
+        provider = _steer_provider()
+        planner = self._planner(provider)
+        cands = [pm for pm in provider.all_pod_metrics()
+                 if pm.pod.name == "pod-2"]
+        out = filter_by_placement(planner, _req(), cands)
+        assert out == cands  # full set serves (last resort)
+        assert planner.escape_total == 1
+
+
+def _full_plane(provider):
+    """Health plane (one degraded pod + one open circuit) + flagged usage
+    + fairness + placement — every advisor attached, all log-only."""
+    from llm_instance_gateway_tpu.gateway import health, resilience
+    from llm_instance_gateway_tpu.gateway import usage as gusage
+
+    plane = resilience.ResiliencePlane(
+        health.HealthScorer(provider=provider),
+        cfg=resilience.ResilienceConfig(health_policy="log_only"))
+    plane.health.update(now=100.0)
+    for _ in range(8):
+        plane.health.record_upstream("pod-0", ok=False)
+    plane.health.update(now=101.0)
+    plane.health.update(now=102.0)
+    for _ in range(plane.cfg.trip_consecutive):
+        plane.breaker.record("pod-1", ok=False)
+
+    class FakeGM:
+        requests_total = {}
+
+    rollup = gusage.UsageRollup(provider, metrics=FakeGM())
+    rollup.seed_noisy("m", HOT)
+    planner = PlacementPlanner(provider,
+                               cfg=PlacementConfig(mode="log_only"))
+    planner.tick()
+    return plane, rollup, planner
+
+
+class TestLogOnlyByteIdentical:
+    def test_python_full_plane_diff(self):
+        provider = _steer_provider()
+        mk = lambda: Scheduler(provider, token_aware=False,  # noqa: E731
+                               prefill_aware=False, prefix_aware=False,
+                               rng=random.Random(11))
+        plain, advised = mk(), mk()
+        plane, rollup, planner = _full_plane(provider)
+        advised.health_advisor = plane
+        advised.usage_advisor = rollup
+        advised.placement_advisor = planner
+        reqs = [_req(HOT), _req(COLD)]
+        assert [plain.schedule(reqs[i % 2]).name for i in range(64)] == \
+            [advised.schedule(reqs[i % 2]).name for i in range(64)]
+        # The log-only observable still counted (hot is resident on pods
+        # 0/1/3 only; every pick of pod-2 for it would have steered).
+        assert planner.would_steer_total >= 0
+
+    def test_native_full_plane_diff(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _steer_provider()
+        mk = lambda: native.NativeScheduler(  # noqa: E731
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(11))
+        plain, advised = mk(), mk()
+        plane, rollup, planner = _full_plane(provider)
+        advised.health_advisor = plane
+        advised.usage_advisor = rollup
+        advised.placement_advisor = planner
+        reqs = [_req(HOT), _req(COLD)]
+        assert [plain.schedule(reqs[i % 2]).name for i in range(64)] == \
+            [advised.schedule(reqs[i % 2]).name for i in range(64)]
+
+
+class TestPreferResidentParity:
+    def _schedulers(self, provider, planner):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        py = Scheduler(provider, token_aware=False, prefill_aware=False,
+                       prefix_aware=False, rng=random.Random(3))
+        nat = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(3))
+        py.placement_advisor = planner
+        nat.placement_advisor = planner
+        return py, nat
+
+    def test_native_matches_python_pick_for_pick(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _steer_provider()
+        planner = PlacementPlanner(
+            provider, cfg=PlacementConfig(mode="prefer_resident"))
+        planner.tick()
+        py, nat = self._schedulers(provider, planner)
+        for model in (HOT, COLD):
+            req = _req(model)
+            assert [py.schedule(req).name for _ in range(48)] == \
+                [nat.schedule(req).name for _ in range(48)]
+
+    def test_pick_many_parity(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        provider = _steer_provider()
+        planner = PlacementPlanner(
+            provider, cfg=PlacementConfig(mode="prefer_resident"))
+        planner.tick()
+        loop_s = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(5))
+        batch_s = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(5))
+        for s in (loop_s, batch_s):
+            s.placement_advisor = planner
+        reqs = [_req(HOT if i % 2 == 0 else COLD) for i in range(32)]
+        assert [loop_s.schedule(r).name for r in reqs] == \
+            [p.name for p in batch_s.pick_many(reqs)]
+
+    def test_native_escape_counts_match(self):
+        from llm_instance_gateway_tpu.gateway.scheduling import native
+
+        if not native.available():
+            pytest.skip("native scheduler library not built")
+        # Hot resident ONLY on a pod outside the candidate set is not
+        # constructible via schedule() (it routes over all pods), so pin
+        # parity of ESCAPE COUNTS instead: a planner whose map names a
+        # pod that no longer exists forces the hatch on every pick.
+        provider = StaticProvider(_pods(n=3))
+        planner = PlacementPlanner(
+            provider, cfg=PlacementConfig(mode="prefer_resident"))
+        planner._have_residency = True
+        planner._resident_pods = {HOT: frozenset({"pod-gone"})}
+        planner._tier_pods = {HOT: (frozenset({"pod-gone"}), frozenset())}
+        py = Scheduler(provider, token_aware=False, prefill_aware=False,
+                       prefix_aware=False, rng=random.Random(2))
+        py.placement_advisor = planner
+        py_before = planner.escape_total
+        picks_py = {py.schedule(_req(HOT)).name for _ in range(12)}
+        py_escapes = planner.escape_total - py_before
+        nat = native.NativeScheduler(
+            provider, token_aware=False, prefill_aware=False,
+            prefix_aware=False, rng=random.Random(2))
+        nat.placement_advisor = planner
+        nat_before = planner.escape_total
+        picks_nat = {nat.schedule(_req(HOT)).name for _ in range(12)}
+        assert planner.escape_total - nat_before == py_escapes == 12
+        assert picks_py == picks_nat == {"pod-0", "pod-1", "pod-2"}
+
+
+# ---------------------------------------------------------------------------
+# api_http: residency-ladder admin endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_api_http_residency_endpoints(tiny_cfg, tmp_path):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.server.api_http import ModelServer
+    from llm_instance_gateway_tpu.server.lora_manager import save_adapter
+
+    m = _manager(tiny_cfg)
+    m.load("a1", weights=_weights(tiny_cfg), rank=2)
+    ckpt = str(tmp_path / "ckpt-a2")
+    save_adapter(ckpt, _weights(tiny_cfg), alpha=16.0, rank=2)
+
+    class FakeEngine:
+        event_sink = None
+
+        def metrics_snapshot(self):
+            return {"residency": m.residency_snapshot(), "usage": {}}
+
+    server = ModelServer(FakeEngine(), tokenizer=None, model_name="base",
+                         lora_manager=m)
+
+    async def run():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            # prefetch: disk -> host (no slot consumed).
+            resp = await client.post("/v1/prefetch_lora_adapter",
+                                     json={"lora_name": "a2",
+                                           "lora_path": ckpt})
+            assert resp.status == 200
+            assert m.adapter_tiers()["a2"] == "host"
+            # demote busy adapter -> 409; after release -> host.
+            m.acquire("a1")
+            resp = await client.post("/v1/demote_lora_adapter",
+                                     json={"lora_name": "a1"})
+            assert resp.status == 409
+            m.release("a1")
+            resp = await client.post("/v1/demote_lora_adapter",
+                                     json={"lora_name": "a1"})
+            assert resp.status == 200
+            assert m.adapter_tiers()["a1"] == "host"
+            # evict host copy; absent name -> 404.
+            resp = await client.post("/v1/evict_lora_adapter",
+                                     json={"lora_name": "a2"})
+            assert resp.status == 200
+            resp = await client.post("/v1/evict_lora_adapter",
+                                     json={"lora_name": "a2"})
+            assert resp.status == 404
+            # /debug/usage renders the residency block.
+            resp = await client.get("/debug/usage")
+            payload = await resp.json()
+            assert payload["residency"]["host"] == ["a1"]
+            _assert_one_tier(m)
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Proxy wiring: /debug/placement + exposition
+# ---------------------------------------------------------------------------
+
+
+def test_proxy_serves_placement_surfaces():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.testing import make_model
+
+    provider = _steer_provider()
+    pods = [pm.pod for pm in provider.all_pod_metrics()]
+    ds = Datastore(pods=pods)
+    ds.set_pool(InferencePool(name="p"))
+    ds.store_model(make_model(HOT))
+    scheduler = Scheduler(provider, token_aware=False, prefill_aware=False,
+                          prefix_aware=False, rng=random.Random(0))
+    proxy = GatewayProxy(
+        Server(scheduler, ds), provider, ds,
+        placement_cfg=PlacementConfig(mode="prefer_resident"))
+    proxy.obs_tick_s = 0
+    # The proxy wired the planner into the scheduler's placement seam.
+    assert scheduler.placement_advisor is proxy.placement
+    proxy.placement.tick()
+
+    async def run():
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/placement")
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["mode"] == "prefer_resident"
+            assert payload["residency"]["pod-0"] == {HOT: "slot"}
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            assert "gateway_adapter_residency" in text
+            assert "gateway_placement_decisions_total" in text
+            # Residency rides /debug/usage too (lig-top renders it).
+            resp = await client.get("/debug/usage")
+            usage = await resp.json()
+            assert usage["residency"]["pod-1"] == {HOT: "host"}
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Sim-validated target scenario (the committed artifact)
+# ---------------------------------------------------------------------------
+
+
+def test_placement_sim_artifact_current():
+    """PLACEMENT_SIM.json reproduces from the current code (the scenario
+    is CPU-deterministic and seeded) and satisfies the acceptance bar:
+    1000+ adapters, <10% slot-resident, hot-set p99 TTFT within 2x
+    all-resident."""
+    import os
+
+    from llm_instance_gateway_tpu.sim.run import run_placement_scenario
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PLACEMENT_SIM.json")
+    with open(path) as f:
+        committed = json.load(f)
+    assert committed["ok"] is True
+    assert committed["universe"] >= 1000
+    assert committed["resident_fraction"] < 0.10
+    fresh = run_placement_scenario(
+        universe=committed["universe"], zipf=committed["zipf_s"],
+        qps=committed["qps"], duration_s=committed["duration_s"],
+        n_servers=committed["n_servers"],
+        max_adapters=committed["max_adapters"],
+        host_cache=committed["host_cache"], seed=committed["seed"])
+    assert fresh["ok"] is True
+    assert fresh["hot_ttft_p99_ratio"] == committed["hot_ttft_p99_ratio"]
+    assert fresh["cells"]["tiered"]["hot_ttft_p99_s"] == \
+        committed["cells"]["tiered"]["hot_ttft_p99_s"]
+
+
+def test_sim_zipf_universe_workload_seeded():
+    from llm_instance_gateway_tpu.sim.run import (
+        WorkloadConfig,
+        generate_workload,
+    )
+
+    cfg = WorkloadConfig(qps=50, duration_s=5, adapter_universe=100,
+                         adapter_zipf=1.2, adapter_fraction=1.0, seed=7)
+    a = [r.adapter for r in generate_workload(cfg)]
+    b = [r.adapter for r in generate_workload(cfg)]
+    assert a == b  # seeded draw reproduces
+    counts: dict = {}
+    for name in a:
+        counts[name] = counts.get(name, 0) + 1
+    ranked = sorted(counts, key=lambda n: -counts[n])
+    # Zipf shape: rank-0 clearly dominates the tail.
+    assert counts[ranked[0]] > 5 * counts.get("zipf-0099", 0.5)
+
+
+def test_loadgen_universe_mode_emits_tier_breakdown():
+    from llm_instance_gateway_tpu.gateway.loadgen import run_load
+
+    out = run_load(requests=400, num_fake_pods=8, adapter_universe=60,
+                   adapter_mix={"base": 0.1})
+    assert out["adapter_universe"] == 60
+    tiers = out["per_residency_tier"]
+    # Slot + host + base at minimum; total accounted requests == served.
+    assert "slot" in tiers and "base" in tiers
+    assert sum(t["requests"] for t in tiers.values()) == 400

@@ -740,7 +740,8 @@ def test_client_disconnect_mid_stream_is_accounted():
 @pytest.mark.slow
 @pytest.mark.parametrize("scenario", ["blackhole", "brownout", "midstream",
                                       "scrape_flap", "handoff",
-                                      "noisy_neighbor", "adapter_flood"])
+                                      "noisy_neighbor", "adapter_flood",
+                                      "cold_start_storm"])
 def test_chaos_scenario(scenario):
     from tools import chaos
 

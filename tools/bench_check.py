@@ -44,6 +44,7 @@ ABSOLUTE_MAX = {
     "pick_traced_ratio": 1.05,
     "pick_policy_ratio": 1.05,
     "pick_fairness_ratio": 1.05,
+    "pick_placement_ratio": 1.05,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
 # 1.0 on a socket-bound rig, so a baseline-relative gate would only measure
@@ -60,6 +61,7 @@ _RATIO_SOURCES = {
     "pick_traced_ratio": "pick",
     "pick_policy_ratio": "policy",
     "pick_fairness_ratio": "fairness",
+    "pick_placement_ratio": "placement",
 }
 
 # family -> (primary metric, direction) used to choose the conservative
@@ -70,6 +72,7 @@ _FAMILY_PRIMARY = {
     "pick": ("pick_us", "lower"),
     "policy": ("pick_policy_ratio", "lower"),
     "fairness": ("pick_fairness_ratio", "lower"),
+    "placement": ("pick_placement_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -85,6 +88,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "pick": bench.run_pick_microbench(),
         "policy": bench.run_policy_microbench(),
         "fairness": bench.run_fairness_microbench(),
+        "placement": bench.run_placement_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
     }
@@ -98,7 +102,8 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
     # gate still fails.
     _RATIO_FNS = {"pick": bench.run_pick_microbench,
                   "policy": bench.run_policy_microbench,
-                  "fairness": bench.run_fairness_microbench}
+                  "fairness": bench.run_fairness_microbench,
+                  "placement": bench.run_placement_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):
             if fams[fam].get(metric, 0.0) <= ABSOLUTE_MAX[metric]:

@@ -17,6 +17,12 @@ fault schedule, drives load, and asserts recovery invariants per scenario:
                       abandoned attach triggers the KV release call
 ``noisy_neighbor``    one adapter floods long prompts: the usage rollup
                       flags it within 2 ticks, quiet adapters never flag
+``adapter_flood``     fairness plane: the flooding hog is throttled AND
+                      noisy-flagged within 2 ticks, zero critical sheds
+``cold_start_storm``  placement plane: Zipf flood over a mostly-non-
+                      resident universe; hot-set p99 TTFT within 2x the
+                      all-resident baseline, zero wrong-tier picks in
+                      prefer_resident mode
 ====================  ====================================================
 
 Usage: ``python tools/chaos.py --seed 0 --scenario all`` (``make chaos``).
@@ -74,7 +80,7 @@ class ChaosStack:
                  provider_cls=StaticProvider,
                  models: tuple[str, ...] = ("m",),
                  model_tiers: dict[str, object] | None = None,
-                 fairness_cfg=None):
+                 fairness_cfg=None, placement_cfg=None):
         self.schedule = schedule
         self.seed = seed
         self.rcfg = rcfg
@@ -85,6 +91,7 @@ class ChaosStack:
         # scenario shape); the fairness scenarios mix tiers.
         self.model_tiers = model_tiers or {}
         self.fairness_cfg = fairness_cfg
+        self.placement_cfg = placement_cfg
         self.upstreams: dict[str, TestServer] = {}
         self.state: dict[str, dict] = {}
         self.client: TestClient | None = None
@@ -115,6 +122,7 @@ class ChaosStack:
             Server(scheduler, ds), provider, ds,
             resilience_cfg=self.rcfg,
             fairness_cfg=self.fairness_cfg,
+            placement_cfg=self.placement_cfg,
             # Fast hysteresis for harness time: 2-tick dwell is the
             # quantity the acceptance criterion counts.
             health_cfg=HealthConfig(dwell_ticks=2, error_streak_floor=3))
@@ -583,6 +591,136 @@ async def scenario_adapter_flood(seed: int) -> dict:
         return report
 
 
+async def scenario_cold_start_storm(seed: int) -> dict:
+    """Placement-plane acceptance: a seeded Zipf flood over a mostly-non-
+    resident adapter universe with ``placement_mode=prefer_resident``.
+
+    Two phases over the SAME stack and traffic shape:
+
+    - ``all_resident`` baseline: every adapter slot-resident on every
+      replica — no pick can ever pay a cold start.
+    - ``storm``: only the Zipf head is RAM-resident (top slice slot-
+      resident on a subset of replicas, next slice host-resident), the
+      long tail is disk-only.  Each routed request's synthetic TTFT = a
+      nominal prefill + the residency penalty of its PICKED replica
+      (0 slot / host promote / full Orbax restore) — the same cost model
+      the sim validates.  (The in-process rig's measured latency is pure
+      harness noise at sub-ms pick costs, so it stays out of the TTFT;
+      the routing is what this scenario tests, through the REAL proxy.)
+
+    Bars: hot-set p99 TTFT within 2x the all-resident baseline, and ZERO
+    wrong-tier picks (a request whose adapter is RAM-resident somewhere
+    must never land on a non-resident replica; the planner's
+    ``wrong_tier_picks_total`` counts exactly that).
+    """
+    from llm_instance_gateway_tpu.gateway.placement import PlacementConfig
+
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="log_only", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    universe = 30
+    names = [f"zipf-{k:02d}" for k in range(universe)]
+    weights = [1.0 / (k + 1) ** 1.1 for k in range(universe)]
+    hot = set(names[:4])       # slot tier in the storm phase
+    warm = set(names[4:10])    # host tier in the storm phase
+    disk_load_s, host_promote_s, prefill_s = 0.5, 0.02, 0.02
+    pods3 = {"pod-a": "collocated", "pod-b": "collocated",
+             "pod-c": "collocated"}
+    pcfg = PlacementConfig(mode="prefer_resident")
+    async with ChaosStack(schedule, seed, rcfg, roles=pods3,
+                          models=tuple(names),
+                          placement_cfg=pcfg) as stack:
+        provider, planner = stack.proxy.provider, stack.proxy.placement
+        rng = random.Random(seed)
+
+        def set_residency(tiers_of_pod) -> None:
+            for pm in provider.all_pod_metrics():
+                tiers = tiers_of_pod(pm.pod.name)
+                pm.metrics.adapter_tiers = tiers
+                pm.metrics.active_adapters = {
+                    a: 0 for a, t in tiers.items() if t == "slot"}
+                pm.metrics.max_active_adapters = universe + 1
+            planner.tick()
+
+        async def run_phase(n_requests: int, residency) -> dict[str, list]:
+            """Fire seeded Zipf traffic; returns adapter -> synthetic
+            TTFTs (nominal prefill + picked replica's residency penalty)."""
+            ttfts: dict[str, list] = {}
+            for _ in range(n_requests):
+                adapter = rng.choices(names, weights=weights)[0]
+                seq0 = stack.proxy.journal.seq
+                status = await stack.request(model=adapter)
+                assert status == 200, status
+                picks = stack.proxy.journal.events(
+                    since=seq0, kind=events_mod.PICK, limit=8)
+                assert picks, "pick event missing"
+                pod = picks[-1]["attrs"]["pod"]
+                tier = residency(pod).get(adapter)
+                penalty = (0.0 if tier == "slot"
+                           else host_promote_s if tier == "host"
+                           else disk_load_s)
+                ttfts.setdefault(adapter, []).append(prefill_s + penalty)
+            return ttfts
+
+        def p99_of(ttfts: dict[str, list], subset) -> float:
+            vals = sorted(v for a, lst in ttfts.items()
+                          if a in subset for v in lst)
+            return vals[min(len(vals) - 1, int(0.99 * len(vals)))] \
+                if vals else 0.0
+
+        # Phase 1: all-resident baseline.
+        all_resident = {a: "slot" for a in names}
+        set_residency(lambda pod: all_resident)
+        base = await run_phase(80, lambda pod: all_resident)
+
+        # Phase 2: the storm — head slot-resident on a SUBSET of
+        # replicas, warm slice host-resident, long tail disk-only.
+        storm_tiers = {
+            "pod-a": {**{a: "slot" for a in list(hot)[:2]},
+                      **{a: "host" for a in warm}},
+            "pod-b": {**{a: "slot" for a in list(hot)[2:]},
+                      **{a: "host" for a in warm}},
+            "pod-c": {a: "host" for a in warm},
+        }
+        set_residency(lambda pod: storm_tiers[pod])
+        planner.wrong_tier_total = 0  # phase boundary: count storm only
+        storm = await run_phase(160, lambda pod: storm_tiers[pod])
+
+        base_p99, storm_p99 = p99_of(base, hot), p99_of(storm, hot)
+        # A disk-tier adapter with PARKED requests earns a prefetch
+        # decision on the next planner tick (the sidecar would execute it
+        # over the residency wire).
+        for pm in provider.all_pod_metrics():
+            if pm.pod.name == "pod-c":
+                pm.metrics.waiting_adapters = frozenset({"zipf-20"})
+        planner.tick()
+        pdbg = planner.debug_payload()
+        prefetches = [d for d in pdbg["decisions"]
+                      if d["action"] == "prefetch"
+                      and d["adapter"] == "zipf-20"]
+        report = {
+            "scenario": "cold_start_storm",
+            "universe": universe,
+            "hot_set": sorted(hot),
+            "hot_p99_base_ms": round(base_p99 * 1e3, 2),
+            "hot_p99_storm_ms": round(storm_p99 * 1e3, 2),
+            "wrong_tier_picks": pdbg["counters"]["wrong_tier_picks_total"],
+            "placement_escapes": pdbg["counters"]["escapes_total"],
+            "decisions_total": pdbg["counters"]["decisions_total"],
+            "waiting_prefetch_decisions": len(prefetches),
+        }
+        # Zero wrong-tier picks: every RAM-resident adapter's pick landed
+        # on a replica actually holding it.
+        assert report["wrong_tier_picks"] == 0, report
+        # Hot-set p99 within 2x the all-resident baseline.
+        assert storm_p99 <= 2.0 * base_p99, report
+        # The planner actually planned: a parked (waiting) disk-tier
+        # adapter earned a prefetch decision.
+        assert prefetches, report
+        return report
+
+
 SCENARIOS = {
     "blackhole": scenario_blackhole,
     "brownout": scenario_brownout,
@@ -591,6 +729,7 @@ SCENARIOS = {
     "handoff": scenario_handoff,
     "noisy_neighbor": scenario_noisy_neighbor,
     "adapter_flood": scenario_adapter_flood,
+    "cold_start_storm": scenario_cold_start_storm,
 }
 
 

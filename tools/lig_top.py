@@ -30,8 +30,8 @@ CLEAR = "\x1b[2J\x1b[H"
 BOLD, RED, DIM, RESET = "\x1b[1m", "\x1b[31m", "\x1b[2m", "\x1b[0m"
 
 COLUMNS = ("MODEL", "ADAPTER", "STEP%", "TOK%", "KV%", "TRAF%", "SCORE",
-           "STATE")
-WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7)
+           "STATE", "TIERS")
+WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7, 14)
 
 
 def fetch_usage(url: str, timeout_s: float = 5.0) -> dict:
@@ -65,6 +65,19 @@ def render_table(payload: dict, color: bool = False) -> str:
         % (waste.get("idle_slot_seconds", 0.0),
            waste.get("prefill_padding_tokens", 0)))
     lines.append("noisy: %s" % (", ".join(noisy) if noisy else "none"))
+    # Residency ladder summary (placement plane): where each tenant's
+    # weights live, next to what they cost.  pod -> {adapter: tier}.
+    residency = payload.get("residency") or {}
+    tier_counts: dict[str, dict[str, int]] = {}
+    for tiers in residency.values():
+        for adapter, tier in tiers.items():
+            per = tier_counts.setdefault(adapter, {})
+            per[tier] = per.get(tier, 0) + 1
+    if residency:
+        slot_total = sum(per.get("slot", 0) for per in tier_counts.values())
+        host_total = sum(per.get("host", 0) for per in tier_counts.values())
+        lines.append("residency: %d slot / %d host copies across %d pods"
+                     % (slot_total, host_total, len(residency)))
     fairness = payload.get("fairness") or {}
     if fairness:
         lines.append(
@@ -91,6 +104,9 @@ def render_table(payload: dict, color: bool = False) -> str:
     for r in rows:
         share = r.get("share") or {}
         flagged = r.get("state") == "noisy"
+        per = tier_counts.get(r.get("adapter", ""), {})
+        tiers_cell = ",".join(f"{t}x{per[t]}" for t in ("slot", "host")
+                              if per.get(t)) or ("-" if residency else "")
         lines.append(_row((
             r.get("model", ""), r.get("adapter", ""),
             "%.1f" % (100 * share.get("step_seconds", 0.0)),
@@ -99,6 +115,7 @@ def render_table(payload: dict, color: bool = False) -> str:
             "%.1f" % (100 * r.get("traffic_share", 0.0)),
             "%.2f" % r.get("score", 0.0),
             r.get("state", "quiet"),
+            tiers_cell,
         ), RED if (flagged and color) else ""))
     return "\n".join(lines)
 
