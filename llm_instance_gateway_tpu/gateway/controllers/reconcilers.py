@@ -8,7 +8,7 @@ semantics mirror the reference reconcilers line by line; citations inline.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from llm_instance_gateway_tpu.api.v1alpha1 import InferenceModel, InferencePool
 from llm_instance_gateway_tpu.gateway.datastore import Datastore

@@ -247,7 +247,8 @@ def families_to_metrics(
 class PodMetricsClient:
     """HTTP scraper (FetchMetrics, metrics.go:38-68)."""
 
-    def __init__(self, timeout_s: float = 5.0, scheme: str = "http"):
+    def __init__(self, timeout_s: float = 5.0,
+                 scheme: str = "http") -> None:
         self.timeout_s = timeout_s
         self.scheme = scheme
         # Build/load the native scanner NOW (seconds of g++ on first build):
@@ -280,7 +281,7 @@ class FakePodMetricsClient:
         self,
         res: dict[str, Metrics] | None = None,
         err: dict[str, Exception] | None = None,
-    ):
+    ) -> None:
         self.res = res or {}
         self.err = err or {}
 

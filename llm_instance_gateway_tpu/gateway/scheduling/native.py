@@ -69,7 +69,13 @@ _NATIVE_DIR = os.path.join(
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libligsched.so")
 # Must match scheduler.cc's lig_abi_version() — bumped on any exported-
 # signature change so a stale prebuilt .so is refused, not miscalled.
-_ABI_VERSION = 3
+# `make lint` (abi-drift rule) cross-checks the argtypes below against the
+# C signatures and the checked-in lint/abi_baseline.json fingerprint.
+_ABI_VERSION = 4
+# Override the library path (e.g. the ASan/UBSan-instrumented build from
+# `make native-asan`); the builder/staleness dance is skipped for overrides
+# — the caller owns the file.
+_LIB_ENV = "LIG_NATIVE_LIB"
 
 LIG_SHED = -1
 LIG_ERROR = -2
@@ -105,15 +111,17 @@ def _load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        from llm_instance_gateway_tpu.utils.native_build import (
-            ensure_native_lib,
-        )
+        lib_path = os.environ.get(_LIB_ENV) or _LIB_PATH
+        if not os.environ.get(_LIB_ENV):
+            from llm_instance_gateway_tpu.utils.native_build import (
+                ensure_native_lib,
+            )
 
-        if ensure_native_lib(_NATIVE_DIR, "libligsched.so",
-                             "scheduler.cc") is None:
-            return None
+            if ensure_native_lib(_NATIVE_DIR, "libligsched.so",
+                                 "scheduler.cc") is None:
+                return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError as e:
             logger.warning("native scheduler load failed: %s", e)
             return None
@@ -143,9 +151,11 @@ def _load_library():
                 _i32p, _i32p,                       # n_active, max_active
                 _u8p,                               # avoid marks
                 ctypes.c_int32, _i32p, _i32p,       # adapters CSR
+                ctypes.c_int32,                     # res_ids length (v4)
                 _u8p,                               # adapter noisy marks
-                _i32p, _i32p, _u8p, _u8p,           # placement CSR: offsets,
-                #                                     ids, tier codes, any bits
+                _i32p, _i32p,                       # placement CSR: offsets,
+                ctypes.c_int32,                     # ids + length (v4),
+                _u8p, _u8p,                         # tier codes, any bits
                 ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_double, ctypes.c_int32,
                 ctypes.c_uint8, ctypes.c_uint8,     # token/prefill aware
@@ -433,9 +443,10 @@ class NativeScheduler:
             _ptr(n_active, ctypes.c_int32), _ptr(max_active, ctypes.c_int32),
             _ptr(avoid, ctypes.c_uint8),
             n_adapters, _ptr(offsets, ctypes.c_int32),
-            _ptr(res_ids, ctypes.c_int32), _ptr(noisy, ctypes.c_uint8),
+            _ptr(res_ids, ctypes.c_int32), len(res_ids),
+            _ptr(noisy, ctypes.c_uint8),
             _ptr(placed_offsets, ctypes.c_int32),
-            _ptr(placed_ids, ctypes.c_int32),
+            _ptr(placed_ids, ctypes.c_int32), len(placed_ids),
             _ptr(placed_tiers, ctypes.c_uint8),
             _ptr(placed_any, ctypes.c_uint8),
             self.cfg.kv_cache_threshold,

@@ -26,7 +26,15 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Protocol, Sequence
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Callable,
+    Iterable,
+    Protocol,
+    Sequence,
+    TypeVar,
+)
 
 from llm_instance_gateway_tpu.gateway.scheduling.config import (
     DEFAULT_CONFIG,
@@ -52,6 +60,17 @@ from llm_instance_gateway_tpu.gateway.types import (
 )
 
 
+if TYPE_CHECKING:
+    from llm_instance_gateway_tpu.gateway.scheduling.prefix_affinity import (
+        PrefixIndex,
+    )
+
+# Candidate element type: the Python scheduler filters PodMetrics, the
+# native scheduler filters survivor INDICES with a name_of mapper — the
+# advisor filters below are generic over both.
+C = TypeVar("C")
+
+
 class SchedulingError(Exception):
     """Raised when no pod can serve the request.
 
@@ -70,7 +89,8 @@ class PodMetricsProvider(Protocol):
     def all_pod_metrics(self) -> list[PodMetrics]: ...
 
 
-def filter_by_policy(advisor, candidates: list, name_of=None) -> list:
+def filter_by_policy(advisor: Any, candidates: list[C],
+                     name_of: Callable[[C], str] | None = None) -> list[C]:
     """Apply the advisor's health policy over a candidate set.
 
     The advisor seam (``gateway/resilience.py:ResiliencePlane``) exposes
@@ -118,8 +138,10 @@ def filter_by_policy(advisor, candidates: list, name_of=None) -> list:
     return candidates
 
 
-def filter_by_fairness(advisor, req: "LLMRequest", candidates: list,
-                       active_of=None) -> list:
+def filter_by_fairness(
+    advisor: Any, req: "LLMRequest", candidates: list[C],
+    active_of: Callable[[C], Iterable[str]] | None = None,
+) -> list[C]:
     """Apply the fairness advisor's pick deprioritization over a candidate
     set (``gateway/fairness.py:FairnessPolicy``); schedulers call this
     AFTER ``filter_by_policy``, BEFORE the prefix tie-break and RNG draw.
@@ -172,8 +194,10 @@ def filter_by_fairness(advisor, req: "LLMRequest", candidates: list,
     return candidates
 
 
-def filter_by_placement(advisor, req: "LLMRequest", candidates: list,
-                        name_of=None) -> list:
+def filter_by_placement(
+    advisor: Any, req: "LLMRequest", candidates: list[C],
+    name_of: Callable[[C], str] | None = None,
+) -> list[C]:
     """Apply the placement plane's residency steering over a candidate
     set (``gateway/placement.py:PlacementPlanner``); schedulers call this
     AFTER ``filter_by_fairness``, BEFORE the prefix tie-break and RNG
@@ -379,10 +403,10 @@ class Scheduler:
         token_aware: bool = True,
         prefill_aware: bool = True,
         prefix_aware: bool = True,
-        prefix_index=None,
+        prefix_index: "PrefixIndex | None" = None,
         rng: random.Random | None = None,
         tree: Filter | None = None,
-    ):
+    ) -> None:
         self._provider = pod_metrics_provider
         self.cfg = cfg
         self._token_aware = token_aware
@@ -419,7 +443,7 @@ class Scheduler:
         # hook (pinned by the same-RNG diff tests).  With ``avoid`` /
         # ``strict`` (gateway/resilience.py) the survivor set additionally
         # passes through ``filter_by_policy`` before the tie-break/draw.
-        self.health_advisor = None
+        self.health_advisor: Any = None
         # Usage/fairness seam (gateway/usage.py + gateway/fairness.py, set
         # by the proxy).  A bare UsageRollup (or a FairnessPolicy in
         # ``log_only``) only counts flagged picks into
@@ -428,14 +452,14 @@ class Scheduler:
         # FairnessPolicy in ``deprioritize``/``enforce`` additionally runs
         # the survivor set through ``filter_by_fairness`` after the health
         # policy filter and before the tie-break/draw.
-        self.usage_advisor = None
+        self.usage_advisor: Any = None
         # Placement seam (gateway/placement.py, set by the proxy).  A
         # PlacementPlanner in ``log_only`` only counts picks that missed
         # a resident replica (gateway_placement_would_steer_total) —
         # routing byte-identical, pinned by same-RNG diff tests.  In
         # ``prefer_resident`` the survivor set additionally passes through
         # ``filter_by_placement`` after the fairness filter.
-        self.placement_advisor = None
+        self.placement_advisor: Any = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.

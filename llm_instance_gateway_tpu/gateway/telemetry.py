@@ -244,7 +244,8 @@ class GatewayMetrics:
                     created += n
                 lines.append(
                     "gateway_upstream_connections_total"
-                    f'{{pod="{escape_label(pod)}",state="{state}"}} {n}')
+                    f'{{pod="{escape_label(pod)}",'
+                    f'state="{escape_label(state)}"}} {n}')
             total_conns = created + reused
             lines += [
                 "# TYPE gateway_upstream_connection_reuse_ratio gauge",

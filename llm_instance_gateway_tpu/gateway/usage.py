@@ -336,7 +336,7 @@ class UsageRollup:
                     'gateway_usage_share{model="%s",adapter="%s",'
                     'resource="%s"} %.4f'
                     % (escape_label(model), escape_label(adapter),
-                       resource, share))
+                       escape_label(resource), share))
         if scores:
             lines.append("# TYPE gateway_noisy_neighbor_score gauge")
             for (model, adapter) in sorted(scores):

@@ -1,0 +1,206 @@
+"""Repo-invariant linter: machine-checked contracts for hand-maintained seams.
+
+Eight PRs of growth left the gateway held together by conventions that only
+same-RNG diff tests and reviewer memory enforced: the advisor-seam ordering
+in both scheduler paths, the native FFI ABI (which drifted once already —
+PR 7 retrofitted the ``lig_abi_version()`` handshake after the fact), the
+lock discipline PR 6 established by moving RNG/note_pick outside the call
+lock, and the metric-family/event-kind/exposition contracts threaded across
+five files per family.  This package pins those invariants mechanically so
+the next refactor can move freely without re-breaking PRs 1-8.
+
+Run: ``python -m llm_instance_gateway_tpu.lint`` (or ``make lint``).
+Exit 0 = clean; every finding prints as ``path:line: [rule] message``.
+
+Rules (each module documents its invariant, the PR that established it,
+and what breaking it costs — see ARCHITECTURE.md "correctness tooling"):
+
+========================  ===================================================
+``seam-order``            advisor filters run policy -> fairness -> placement,
+                          all BEFORE prefix tie-break / RNG (PR 4/7/8)
+``lock-discipline``       no hashing/RNG/note_*/blocking work inside the
+                          native scheduler's call lock; no sync sleep/HTTP in
+                          proxy coroutines (PR 6)
+``abi-drift``             scheduler.cc extern "C" signatures == the ctypes
+                          marshals, and any signature change bumps
+                          ``lig_abi_version()`` + the checked-in baseline
+``metric-currency``       every family name in a render path is registered in
+                          metrics_registry.py and vice versa (PR 3)
+``event-kinds``           every journal/emit kind literal is declared in
+                          events.py (PR 3)
+``label-hygiene``         exposition lines built by f-string/%-format escape
+                          label values via ``escape_label`` (PR 2)
+``flag-docs``             every bootstrap.py ``add_argument`` flag is
+                          documented in README.md or ARCHITECTURE.md
+``usage-conservation``    per-adapter step-second charges always charge the
+                          engine-wall denominator at the same site, and only
+                          server/usage.py writes the accumulator tables (PR 5)
+``mech-*``                mechanical layer (ruff-equivalent fallback): unused
+                          imports, mutable default arguments
+========================  ===================================================
+
+Suppression: a source line containing ``lig-lint: ignore`` (optionally
+``lig-lint: ignore[rule-a,rule-b]``) suppresses findings anchored to that
+line.  Grandfathered findings live in ``lint-baseline.json`` at the repo
+root; ``tests/test_lint.py`` asserts the baseline never grows (it is empty
+at HEAD — every rule went in clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+# The package under lint.  Rules resolve every path relative to a Tree root
+# so the test suite can point them at fixture mini-repos.
+PKG = "llm_instance_gateway_tpu"
+
+_IGNORE_RE = re.compile(r"lig-lint:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int      # 1-indexed; 0 = file-level
+    message: str
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity (baseline entries survive edits that
+        only shift lines)."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Tree:
+    """A lint target rooted at a directory, with cached sources and ASTs."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._src: dict[str, str | None] = {}
+        self._ast: dict[str, ast.Module | None] = {}
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root, *rel.split("/"))
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self.path(rel))
+
+    def read(self, rel: str) -> str | None:
+        if rel not in self._src:
+            try:
+                with open(self.path(rel), encoding="utf-8") as fh:
+                    self._src[rel] = fh.read()
+            except OSError:
+                self._src[rel] = None
+        return self._src[rel]
+
+    def parse(self, rel: str) -> ast.Module | None:
+        if rel not in self._ast:
+            src = self.read(rel)
+            if src is None:
+                self._ast[rel] = None
+            else:
+                try:
+                    self._ast[rel] = ast.parse(src, filename=rel)
+                except SyntaxError:
+                    self._ast[rel] = None
+        return self._ast[rel]
+
+    def py_files(self, *rel_dirs: str, exclude: tuple[str, ...] = ()
+                 ) -> list[str]:
+        """Repo-relative .py paths under ``rel_dirs``, sorted."""
+        out: list[str] = []
+        for rel_dir in rel_dirs:
+            base = self.path(rel_dir)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, fn), self.root
+                    ).replace(os.sep, "/")
+                    if any(rel.startswith(e) for e in exclude):
+                        continue
+                    out.append(rel)
+        return sorted(set(out))
+
+    def suppressed(self, finding: Finding) -> bool:
+        src = self.read(finding.path)
+        if src is None or finding.line <= 0:
+            return False
+        lines = src.splitlines()
+        if finding.line > len(lines):
+            return False
+        m = _IGNORE_RE.search(lines[finding.line - 1])
+        if not m:
+            return False
+        if m.group(1) is None:
+            return True
+        rules = {r.strip() for r in m.group(1).split(",")}
+        return finding.rule in rules
+
+
+RuleFn = Callable[[Tree], "list[Finding]"]
+
+# Populated by the rule modules at import time (order = report order).
+RULES: list[tuple[str, RuleFn]] = []
+
+
+def rule(name: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES.append((name, fn))
+        return fn
+    return deco
+
+
+def _load_rules() -> None:
+    # Import for registration side effects; idempotent (modules cache).
+    from llm_instance_gateway_tpu.lint import (  # noqa: F401
+        abi, contracts, exposition, mechanical, seams,
+    )
+
+
+def load_baseline(tree: Tree) -> set[str]:
+    src = tree.read("lint-baseline.json")
+    if src is None:
+        return set()
+    try:
+        doc = json.loads(src)
+    except ValueError:
+        return set()
+    return set(doc.get("grandfathered", []))
+
+
+def run(root: str, rules: Iterable[str] | None = None,
+        apply_baseline: bool = True) -> list[Finding]:
+    """All unsuppressed, unbaselined findings for the tree at ``root``."""
+    _load_rules()
+    tree = Tree(root)
+    wanted = set(rules) if rules is not None else None
+    baseline = load_baseline(tree) if apply_baseline else set()
+    findings: list[Finding] = []
+    for name, fn in RULES:
+        if wanted is not None and name not in wanted:
+            continue
+        for f in fn(tree):
+            if f.fingerprint() in baseline:
+                continue
+            if tree.suppressed(f):
+                continue
+            findings.append(f)
+    return findings
+
+
+def repo_root() -> str:
+    """The checkout root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        os.path.dirname(__file__))))

@@ -1,0 +1,58 @@
+"""CLI: ``python -m llm_instance_gateway_tpu.lint`` (see package docstring).
+
+Exit status: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from llm_instance_gateway_tpu import lint
+from llm_instance_gateway_tpu.lint import abi
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m llm_instance_gateway_tpu.lint",
+        description="AST-driven repo-invariant checker for the gateway's "
+                    "hand-maintained seams.")
+    parser.add_argument("--root", default=None,
+                        help="tree to lint (default: this checkout)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print registered rule names and exit")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report grandfathered findings too")
+    parser.add_argument("--write-abi-baseline", action="store_true",
+                        help="refingerprint the native ABI "
+                             "(lint/abi_baseline.json) after a deliberate, "
+                             "version-bumped signature change")
+    args = parser.parse_args(argv)
+    root = args.root or lint.repo_root()
+    if args.write_abi_baseline:
+        path = abi.write_baseline(lint.Tree(root))
+        print(f"wrote {path}")
+        return 0
+    if args.list_rules:
+        lint._load_rules()
+        for name, fn in lint.RULES:
+            print(name)
+        return 0
+    rules = args.rules.split(",") if args.rules else None
+    findings = lint.run(root, rules=rules,
+                        apply_baseline=not args.no_baseline)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} finding(s). Invariant catalogue: "
+              f"ARCHITECTURE.md 'correctness tooling'; suppress a line "
+              f"with `lig-lint: ignore[rule]`.", file=sys.stderr)
+        return 1
+    print("lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Rule ``abi-drift``: the native FFI contract can't move silently.
+
+The ABI between ``native/scheduler.cc`` and the ctypes marshals in
+``scheduling/native.py`` drifted once already: PR 7 changed
+``lig_state_update``/``lig_pick`` arity and review had to retrofit the
+``lig_abi_version()`` handshake because a stale prebuilt ``.so`` would have
+scrambled arguments in the routing hot path (wrong pods picked, or a
+segfault, depending on register luck).  The handshake protects RUNTIME
+loads; this rule protects the SOURCE TREE:
+
+1. the ``_ABI_VERSION`` constant in native.py equals the literal returned
+   by ``lig_abi_version()`` in scheduler.cc;
+2. every ``extern "C"`` function's parameter list (count AND types) matches
+   the ctypes ``argtypes``/``restype`` marshal for it; and
+3. the exported signature set matches the checked-in fingerprint
+   (``lint/abi_baseline.json``).  Changing a signature without bumping the
+   version is the exact failure mode PR 7 shipped — it fails here, in the
+   tree, before any .so exists.  A legitimate ABI change bumps the version
+   in BOTH sources and regenerates the baseline
+   (``python -m llm_instance_gateway_tpu.lint --write-abi-baseline``) in
+   the same commit.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+from llm_instance_gateway_tpu.lint import Finding, Tree, rule
+
+CC = "llm_instance_gateway_tpu/native/scheduler.cc"
+PY = "llm_instance_gateway_tpu/gateway/scheduling/native.py"
+BASELINE = "llm_instance_gateway_tpu/lint/abi_baseline.json"
+
+_VERSION_RE = re.compile(
+    r"lig_abi_version\s*\(\s*void\s*\)\s*\{\s*return\s+(\d+)\s*;")
+_FN_RE = re.compile(
+    r"\b([A-Za-z_][A-Za-z0-9_]*)\s*(\*)?\s*\b(lig_[a-z0-9_]+)\s*"
+    r"\(([^)]*)\)\s*\{", re.S)
+_PARAM_RE = re.compile(
+    r"^(?:const\s+)?([A-Za-z_][A-Za-z0-9_]*)\s*(\*)?\s*"
+    r"(?:[A-Za-z_][A-Za-z0-9_]*)?$")
+
+# ctypes expression -> normalized C type.
+_CTYPE_MAP = {
+    "c_void_p": "void*", "c_char_p": "char*",
+    "c_int32": "int32_t", "c_int64": "int64_t",
+    "c_uint8": "uint8_t", "c_uint32": "uint32_t",
+    "c_double": "double", "c_float": "float",
+    "c_int": "int", "c_size_t": "size_t",
+}
+
+
+def _strip_comments(src: str) -> str:
+    src = re.sub(r"/\*.*?\*/", " ", src, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", src)
+
+
+def cc_signatures(tree: Tree) -> tuple[int | None, dict, list[Finding]]:
+    """(abi_version, {fn: {"ret": t, "args": [t...]}}, parse findings)."""
+    findings: list[Finding] = []
+    src = tree.read(CC)
+    if src is None:
+        return None, {}, [Finding("abi-drift", CC, 0,
+                                  "native/scheduler.cc missing")]
+    src = _strip_comments(src)
+    m = _VERSION_RE.search(src)
+    version = int(m.group(1)) if m else None
+    if version is None:
+        findings.append(Finding(
+            "abi-drift", CC, 0,
+            "lig_abi_version() not found — the runtime handshake has "
+            "nothing to return"))
+    sigs: dict[str, dict] = {}
+    for ret, ret_ptr, name, params in _FN_RE.findall(src):
+        args: list[str] = []
+        bad = False
+        params = params.strip()
+        if params and params != "void":
+            for raw in params.split(","):
+                pm = _PARAM_RE.match(" ".join(raw.split()))
+                if pm is None:
+                    findings.append(Finding(
+                        "abi-drift", CC, 0,
+                        f"{name}: unparseable parameter {raw.strip()!r} — "
+                        f"keep extern \"C\" params to plain scalar/pointer "
+                        f"types so the marshal stays checkable"))
+                    bad = True
+                    break
+                args.append(pm.group(1) + ("*" if pm.group(2) else ""))
+        if bad:
+            continue
+        sigs[name] = {"ret": ret + ("*" if ret_ptr else ""), "args": args}
+    if not sigs:
+        findings.append(Finding(
+            "abi-drift", CC, 0,
+            "no extern \"C\" lig_* definitions found in scheduler.cc"))
+    return version, sigs, findings
+
+
+def _ctype_of(node: ast.expr, aliases: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return _CTYPE_MAP.get(node.attr)
+    if isinstance(node, ast.Name):
+        if node.id in aliases:
+            return aliases[node.id]
+        return _CTYPE_MAP.get(node.id)
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    if isinstance(node, ast.Call):
+        # ctypes.POINTER(ctypes.c_X) inline
+        fn = node.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", "")
+        if fn_name == "POINTER" and node.args:
+            inner = _ctype_of(node.args[0], aliases)
+            return inner + "*" if inner else None
+    return None
+
+
+def py_marshals(tree: Tree) -> tuple[int | None, dict, list[Finding]]:
+    """(_ABI_VERSION, {fn: {"ret": t|None, "args": [t...]|None}}, findings)."""
+    findings: list[Finding] = []
+    mod = tree.parse(PY)
+    if mod is None:
+        return None, {}, [Finding("abi-drift", PY, 0,
+                                  "scheduling/native.py missing or "
+                                  "unparseable")]
+    version: int | None = None
+    aliases: dict[str, str] = {}
+    for node in mod.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            if tname == "_ABI_VERSION" and isinstance(
+                    node.value, ast.Constant):
+                version = int(node.value.value)
+            else:
+                t = _ctype_of(node.value, aliases)
+                if t is not None:
+                    aliases[tname] = t
+    marshals: dict[str, dict] = {}
+    for node in ast.walk(mod):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Attribute)
+                and target.attr in ("argtypes", "restype")
+                and isinstance(target.value, ast.Attribute)):
+            continue
+        fn_name = target.value.attr
+        if not fn_name.startswith("lig_"):
+            continue
+        entry = marshals.setdefault(fn_name, {"ret": None, "args": None})
+        if target.attr == "restype":
+            entry["ret"] = _ctype_of(node.value, aliases)
+        else:
+            if not isinstance(node.value, (ast.List, ast.Tuple)):
+                findings.append(Finding(
+                    "abi-drift", PY, node.lineno,
+                    f"{fn_name}.argtypes is not a literal list — the "
+                    f"marshal must stay statically checkable"))
+                continue
+            args = []
+            for el in node.value.elts:
+                t = _ctype_of(el, aliases)
+                if t is None:
+                    findings.append(Finding(
+                        "abi-drift", PY, el.lineno,
+                        f"{fn_name}.argtypes element not resolvable to a "
+                        f"C type ({ast.dump(el)[:60]})"))
+                    t = "?"
+                args.append(t)
+            entry["args"] = args
+    if version is None:
+        findings.append(Finding(
+            "abi-drift", PY, 0,
+            "_ABI_VERSION constant not found in scheduling/native.py"))
+    return version, marshals, findings
+
+
+def _compatible(cc_t: str, py_t: str) -> bool:
+    if cc_t == py_t:
+        return True
+    # Any data pointer marshals as c_void_p; int is int32 on our targets.
+    if py_t == "void*" and cc_t.endswith("*"):
+        return True
+    return False
+
+
+@rule("abi-drift")
+def check_abi(tree: Tree) -> list[Finding]:
+    cc_version, sigs, findings = cc_signatures(tree)
+    py_version, marshals, py_findings = py_marshals(tree)
+    findings += py_findings
+    if cc_version is not None and py_version is not None \
+            and cc_version != py_version:
+        findings.append(Finding(
+            "abi-drift", PY, 0,
+            f"_ABI_VERSION={py_version} but scheduler.cc "
+            f"lig_abi_version() returns {cc_version} — the handshake "
+            f"will refuse every build"))
+    for fn_name, marshal in sorted(marshals.items()):
+        sig = sigs.get(fn_name)
+        if sig is None:
+            findings.append(Finding(
+                "abi-drift", PY, 0,
+                f"ctypes marshals {fn_name} but scheduler.cc does not "
+                f"define it"))
+            continue
+        if marshal["args"] is not None:
+            if len(marshal["args"]) != len(sig["args"]):
+                findings.append(Finding(
+                    "abi-drift", PY, 0,
+                    f"{fn_name}: arity mismatch — scheduler.cc takes "
+                    f"{len(sig['args'])} parameters, argtypes marshals "
+                    f"{len(marshal['args'])} (a stale .so would scramble "
+                    f"arguments; fix the marshal AND bump the ABI "
+                    f"version)"))
+            else:
+                for i, (cc_t, py_t) in enumerate(
+                        zip(sig["args"], marshal["args"])):
+                    if not _compatible(cc_t, py_t):
+                        findings.append(Finding(
+                            "abi-drift", PY, 0,
+                            f"{fn_name}: parameter {i} type mismatch — "
+                            f"scheduler.cc declares {cc_t}, argtypes "
+                            f"marshals {py_t}"))
+        if marshal["ret"] is not None and not _compatible(
+                sig["ret"], marshal["ret"]):
+            findings.append(Finding(
+                "abi-drift", PY, 0,
+                f"{fn_name}: return type mismatch — scheduler.cc returns "
+                f"{sig['ret']}, restype is {marshal['ret']}"))
+
+    # Fingerprint: signature changes require a version bump + regenerated
+    # baseline in the SAME commit.
+    raw = tree.read(BASELINE)
+    if raw is None:
+        findings.append(Finding(
+            "abi-drift", BASELINE, 0,
+            "ABI baseline missing — run `python -m "
+            "llm_instance_gateway_tpu.lint --write-abi-baseline`"))
+        return findings
+    try:
+        baseline = json.loads(raw)
+    except ValueError:
+        findings.append(Finding(
+            "abi-drift", BASELINE, 0, "ABI baseline is not valid JSON"))
+        return findings
+    base_version = baseline.get("abi_version")
+    base_sigs = baseline.get("signatures", {})
+    if sigs != base_sigs:
+        changed = sorted(
+            set(sigs) ^ set(base_sigs)
+            | {n for n in set(sigs) & set(base_sigs)
+               if sigs[n] != base_sigs[n]})
+        if cc_version == base_version:
+            findings.append(Finding(
+                "abi-drift", CC, 0,
+                f"exported ABI changed ({', '.join(changed)}) without a "
+                f"lig_abi_version() bump — a prebuilt .so from the old "
+                f"tree would pass the handshake and scramble arguments"))
+        else:
+            findings.append(Finding(
+                "abi-drift", BASELINE, 0,
+                f"ABI baseline stale for {', '.join(changed)} — version "
+                f"bumped to {cc_version}; regenerate with `python -m "
+                f"llm_instance_gateway_tpu.lint --write-abi-baseline`"))
+    elif cc_version != base_version:
+        findings.append(Finding(
+            "abi-drift", BASELINE, 0,
+            f"baseline records abi_version={base_version} but "
+            f"scheduler.cc returns {cc_version} — regenerate the "
+            f"baseline"))
+    return findings
+
+
+def write_baseline(tree: Tree) -> str:
+    """Regenerate lint/abi_baseline.json from scheduler.cc; returns path."""
+    version, sigs, findings = cc_signatures(tree)
+    if findings:
+        raise SystemExit("cannot fingerprint ABI:\n" + "\n".join(
+            str(f) for f in findings))
+    path = tree.path(BASELINE)
+    import os
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"abi_version": version, "signatures": sigs}, fh,
+                  indent=2, sort_keys=True)
+        fh.write("\n")
+    return path

@@ -1,0 +1,129 @@
+"""Rules ``flag-docs`` and ``usage-conservation``.
+
+**flag-docs**: every ``add_argument("--flag", ...)`` the gateway bootstrap
+exposes must appear in README.md or ARCHITECTURE.md.  The bootstrap is the
+operator surface — five PRs added flags (resilience, fairness, placement)
+and the only discovery path was reading argparse source.  An undocumented
+flag is a feature nobody can deploy.
+
+**usage-conservation** (PR 5's invariant): the capacity-attribution plane
+rests on Σ per-adapter step-seconds == engine-wall step-seconds (the
+conservation test pins it within 1% at runtime).  That only holds because
+every site that charges a per-adapter share also charges the engine-wall
+denominator in the same function, and because nothing outside
+``server/usage.py`` writes the accumulator tables directly.  This rule pins
+both statically: a new charge path that forgets the denominator (or an
+engine call site that pokes ``tracker.step_seconds`` behind the API) fails
+here instead of skewing every noisy-neighbor score derived downstream.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_instance_gateway_tpu.lint import PKG, Finding, Tree, rule
+
+BOOTSTRAP = f"{PKG}/gateway/bootstrap.py"
+USAGE = f"{PKG}/server/usage.py"
+DOCS = ("README.md", "ARCHITECTURE.md")
+
+
+@rule("flag-docs")
+def check_flag_docs(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    mod = tree.parse(BOOTSTRAP)
+    if mod is None:
+        return [Finding("flag-docs", BOOTSTRAP, 0,
+                        "gateway/bootstrap.py missing or unparseable")]
+    docs = "\n".join(tree.read(d) or "" for d in DOCS)
+    if not docs.strip():
+        return [Finding("flag-docs", "README.md", 0,
+                        "README.md / ARCHITECTURE.md missing — flags have "
+                        "nowhere to be documented")]
+    flags: list[tuple[str, int]] = []
+    for node in ast.walk(mod):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str) and arg.value.startswith("--"):
+                flags.append((arg.value, node.lineno))
+    if not flags:
+        findings.append(Finding(
+            "flag-docs", BOOTSTRAP, 0,
+            "no add_argument flags found in bootstrap.py — the operator "
+            "surface moved; re-anchor this rule"))
+        return findings
+    for flag, lineno in flags:
+        if flag not in docs:
+            findings.append(Finding(
+                "flag-docs", BOOTSTRAP, lineno,
+                f"flag {flag} is not documented in README.md or "
+                f"ARCHITECTURE.md — an undocumented flag is a feature "
+                f"nobody can deploy"))
+    return findings
+
+
+def _subscript_attr_store(node: ast.AST) -> str | None:
+    """'attr' when ``node`` stores into ``<obj>.attr[...]``."""
+    if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Store) and isinstance(node.value, ast.Attribute):
+        return node.value.attr
+    return None
+
+
+# Distinctive accumulator names only ("tokens" is too generic to claim).
+_TABLES = ("step_seconds", "engine_step_seconds", "kv_block_seconds")
+
+
+@rule("usage-conservation")
+def check_usage_conservation(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    mod = tree.parse(USAGE)
+    if mod is None:
+        return [Finding("usage-conservation", USAGE, 0,
+                        "server/usage.py missing or unparseable")]
+    charge_fns = 0
+    for fn in ast.walk(mod):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stores = set()
+        for node in ast.walk(fn):
+            attr = _subscript_attr_store(node)
+            if attr:
+                stores.add(attr)
+        if "step_seconds" in stores:
+            charge_fns += 1
+            if "engine_step_seconds" not in stores:
+                findings.append(Finding(
+                    "usage-conservation", USAGE, fn.lineno,
+                    f"{fn.name}: charges per-adapter step_seconds without "
+                    f"charging the engine-wall engine_step_seconds "
+                    f"denominator at the same site — the conservation "
+                    f"invariant (Σ per-adapter == total, PR 5) breaks and "
+                    f"every usage share downstream skews"))
+    if charge_fns == 0:
+        findings.append(Finding(
+            "usage-conservation", USAGE, 0,
+            "no per-adapter charge sites found in server/usage.py — the "
+            "attribution plane moved; re-anchor this rule"))
+
+    # Accumulator tables are written ONLY through the UsageTracker API.
+    for rel in tree.py_files(PKG, exclude=(f"{PKG}/lint/",)):
+        if rel == USAGE:
+            continue
+        other = tree.parse(rel)
+        if other is None:
+            continue
+        for node in ast.walk(other):
+            attr = _subscript_attr_store(node)
+            if attr in _TABLES:
+                findings.append(Finding(
+                    "usage-conservation", rel, node.lineno,
+                    f"direct write into a UsageTracker accumulator "
+                    f"(.{attr}[...]) outside server/usage.py — charge "
+                    f"through charge_step/charge_decode so the "
+                    f"conservation denominator moves with it"))
+    return findings

@@ -1,0 +1,336 @@
+"""Rules ``metric-currency``, ``event-kinds``, ``label-hygiene``.
+
+**metric-currency** (PR 3's registry, superset of the runtime docs test):
+every metric-family name string used in a render path — a ``# TYPE`` line,
+a ``render_counter``/``render_keyed_family``/``render_histogram``/
+``render_prom`` call, or an exposition sample line like
+``tpu:adapter_step_seconds_total{...}`` — must be declared in
+``metrics_registry.py``, and every registered family must still appear
+somewhere in code.  The runtime exposition-contract test catches the same
+drift but only for surfaces a test actually renders; this rule catches it
+without running servers, including families behind feature flags.
+
+**event-kinds** (PR 3's journal): every kind passed to ``journal.emit(...)``
+or an ``event_sink(...)`` — whether a string literal or an
+``events_mod.NAME`` attribute — must be a constant declared in
+``events.py``.  A typo'd kind silently creates a new counter series and
+breaks ``tools/blackbox_report.py``'s narration.
+
+**label-hygiene** (PR 2's hardening): exposition lines assembled by
+f-string or %-format must escape label VALUES through ``escape_label`` —
+one hostile model/adapter name (embedded quote, newline) poisons the whole
+/metrics page for every scraper otherwise.  The rule scopes itself to
+modules that render exposition text (any module containing a ``# TYPE ``
+literal), treats ``tracing.py`` itself as the trusted renderer layer, and
+accepts values that are escape_label calls, locals assigned from
+escape_label, numeric %-conversions, or constant-only expressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from llm_instance_gateway_tpu.lint import PKG, Finding, Tree, rule
+
+REGISTRY = f"{PKG}/metrics_registry.py"
+EVENTS = f"{PKG}/events.py"
+TRUSTED_RENDERERS = (f"{PKG}/tracing.py",)
+
+_TYPE_RE = re.compile(r"# TYPE ([A-Za-z_:][A-Za-z0-9_:]*)")
+_FAMILY_PREFIX_RE = re.compile(
+    r"^(gateway_[A-Za-z0-9_]+|tpu:[A-Za-z0-9_:]+)\{")
+_HIST_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+_RENDER_HELPERS = {"render_counter", "render_keyed_family",
+                   "render_histogram", "render_prom", "_counter_lines"}
+
+
+def _pkg_files(tree: Tree) -> list[str]:
+    return tree.py_files(PKG, exclude=(f"{PKG}/lint/",))
+
+
+def registered_families(tree: Tree) -> tuple[set[str], list[Finding]]:
+    """Family names declared via ``Family("name", ...)`` in the registry
+    (parsed, not imported, so fixture trees work)."""
+    mod = tree.parse(REGISTRY)
+    if mod is None:
+        return set(), [Finding("metric-currency", REGISTRY, 0,
+                               "metrics_registry.py missing or "
+                               "unparseable")]
+    names: set[str] = set()
+    for node in ast.walk(mod):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Family" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            names.add(node.args[0].value)
+    if not names:
+        return set(), [Finding("metric-currency", REGISTRY, 0,
+                               "no Family(...) declarations found in the "
+                               "registry")]
+    return names, []
+
+
+def _string_constants(mod: ast.Module):
+    """(value, lineno) for every string constant, including f-string
+    literal fragments."""
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+
+
+def _family_of(text: str) -> str | None:
+    m = _FAMILY_PREFIX_RE.match(text)
+    if not m:
+        return None
+    return _HIST_SUFFIX_RE.sub("", m.group(1))
+
+
+@rule("metric-currency")
+def check_metric_currency(tree: Tree) -> list[Finding]:
+    registered, findings = registered_families(tree)
+    if not registered:
+        return findings
+    used: dict[str, tuple[str, int]] = {}   # family -> first render site
+    mentioned: set[str] = set()             # any literal equal to a family
+    for rel in _pkg_files(tree):
+        if rel == REGISTRY:
+            continue
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        for value, lineno in _string_constants(mod):
+            if value in registered:
+                mentioned.add(value)
+            for name in _TYPE_RE.findall(value):
+                used.setdefault(name, (rel, lineno))
+            fam = _family_of(value)
+            if fam is not None:
+                used.setdefault(fam, (rel, lineno))
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", "")
+            if name in _RENDER_HELPERS and node.args and isinstance(
+                    node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str):
+                used.setdefault(node.args[0].value, (rel, node.lineno))
+    for name in sorted(set(used) - registered):
+        rel, lineno = used[name]
+        findings.append(Finding(
+            "metric-currency", rel, lineno,
+            f"family {name!r} is rendered here but not declared in "
+            f"metrics_registry.py — add a Family entry (and regenerate "
+            f"docs/METRICS.md) or the family is invisible to operators"))
+    for name in sorted(registered - mentioned - set(used)):
+        findings.append(Finding(
+            "metric-currency", REGISTRY, 0,
+            f"family {name!r} is registered but appears nowhere in code — "
+            f"dead registry entry (or the render path renamed it)"))
+    return findings
+
+
+def declared_kinds(tree: Tree) -> tuple[dict[str, str], list[Finding]]:
+    """{CONSTANT_NAME: "kind-string"} declared at events.py module level."""
+    mod = tree.parse(EVENTS)
+    if mod is None:
+        return {}, [Finding("event-kinds", EVENTS, 0,
+                            "events.py missing or unparseable")]
+    kinds: dict[str, str] = {}
+    for node in mod.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.isupper()
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            kinds[node.targets[0].id] = node.value.value
+    if not kinds:
+        return {}, [Finding("event-kinds", EVENTS, 0,
+                            "no event-kind constants declared in "
+                            "events.py")]
+    return kinds, []
+
+
+@rule("event-kinds")
+def check_event_kinds(tree: Tree) -> list[Finding]:
+    kinds, findings = declared_kinds(tree)
+    if not kinds:
+        return findings
+    values = set(kinds.values())
+    for rel in _pkg_files(tree):
+        if rel == EVENTS:
+            continue
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", "")
+            if name not in ("emit", "event_sink"):
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Constant) and isinstance(
+                    arg0.value, str):
+                if arg0.value not in values:
+                    findings.append(Finding(
+                        "event-kinds", rel, node.lineno,
+                        f"event kind {arg0.value!r} is not declared in "
+                        f"events.py — declare a constant (blackbox_report "
+                        f"narration and the *_events_total contract key "
+                        f"off the declared set)"))
+            elif isinstance(arg0, ast.Attribute):
+                if arg0.attr.isupper() and arg0.attr not in kinds:
+                    findings.append(Finding(
+                        "event-kinds", rel, node.lineno,
+                        f"event kind constant {arg0.attr} is not declared "
+                        f"in events.py"))
+    return findings
+
+
+# -- label hygiene ----------------------------------------------------------
+
+_PCT_CONV_RE = re.compile(r"%(?:\([^)]*\))?[-#0 +]*(?:\d+|\*)?(?:\.\d+)?"
+                          r"([sdifgGeEfFxXor%])")
+
+
+def _contains_escape_call(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", "")
+            if name == "escape_label":
+                return True
+    return False
+
+
+def _safe_names(fn: ast.AST) -> set[str]:
+    """Local names assigned from escape_label(...) or constant-only
+    expressions anywhere in the function."""
+    safe: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            if _expr_safe(node.value, safe):
+                safe.add(node.targets[0].id)
+    return safe
+
+
+def _expr_safe(node: ast.AST, safe_names: set[str]) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in safe_names
+    if isinstance(node, ast.IfExp):
+        return (_expr_safe(node.body, safe_names)
+                and _expr_safe(node.orelse, safe_names))
+    if isinstance(node, ast.BoolOp):
+        return all(_expr_safe(v, safe_names) for v in node.values)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", "")
+        if name in ("escape_label", "int", "float", "len"):
+            return True
+    return _contains_escape_call(node)
+
+
+def _render_modules(tree: Tree) -> list[str]:
+    out = []
+    for rel in _pkg_files(tree):
+        if rel in TRUSTED_RENDERERS:
+            continue
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        if any("# TYPE " in v for v, _ in _string_constants(mod)):
+            out.append(rel)
+    return out
+
+
+@rule("label-hygiene")
+def check_label_hygiene(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    modules = _render_modules(tree)
+    if not modules:
+        findings.append(Finding(
+            "label-hygiene", f"{PKG}/tracing.py", 0,
+            "no exposition-rendering modules found (every render path "
+            "moved?) — re-anchor this rule"))
+        return findings
+    for rel in modules:
+        mod = tree.parse(rel)
+        for fn in ast.walk(mod):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            safe = _safe_names(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.JoinedStr):
+                    findings += _check_fstring(rel, node, safe)
+                elif isinstance(node, ast.BinOp) and isinstance(
+                        node.op, ast.Mod) and isinstance(
+                        node.left, ast.Constant) and isinstance(
+                        node.left.value, str):
+                    findings += _check_percent(rel, node, safe)
+    return findings
+
+
+def _check_fstring(rel: str, node: ast.JoinedStr,
+                   safe: set[str]) -> list[Finding]:
+    findings = []
+    values = node.values
+    for i, part in enumerate(values):
+        if not (isinstance(part, ast.Constant)
+                and isinstance(part.value, str)
+                and part.value.endswith('="')):
+            continue
+        if i + 1 >= len(values):
+            continue
+        nxt = values[i + 1]
+        if not isinstance(nxt, ast.FormattedValue):
+            continue
+        # A numeric format spec (:.6f, :d, :g) cannot smuggle quotes.
+        spec = nxt.format_spec
+        if spec is not None and any(
+                isinstance(v, ast.Constant) and str(v.value)[-1:] in
+                "dfgGeExXo" for v in spec.values):
+            continue
+        if not _expr_safe(nxt.value, safe):
+            findings.append(Finding(
+                "label-hygiene", rel, node.lineno,
+                "f-string label value interpolated without escape_label — "
+                "a hostile label (embedded quote/newline) poisons the "
+                "whole exposition page"))
+    return findings
+
+
+def _check_percent(rel: str, node: ast.BinOp,
+                   safe: set[str]) -> list[Finding]:
+    findings = []
+    literal = node.left.value
+    convs = list(_PCT_CONV_RE.finditer(literal))
+    if not convs:
+        return findings
+    right = node.right
+    elts = list(right.elts) if isinstance(right, ast.Tuple) else [right]
+    arg_i = 0
+    for m in convs:
+        conv = m.group(1)
+        if conv == "%":
+            continue
+        label_pos = literal[max(0, m.start() - 2):m.start()] == '="'
+        if label_pos and conv in ("s", "r"):
+            if arg_i < len(elts) and not _expr_safe(elts[arg_i], safe):
+                findings.append(Finding(
+                    "label-hygiene", rel, node.lineno,
+                    "%-format label value interpolated without "
+                    "escape_label — a hostile label poisons the whole "
+                    "exposition page"))
+        arg_i += 1
+    return findings

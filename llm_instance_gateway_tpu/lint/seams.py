@@ -1,0 +1,240 @@
+"""Rules ``seam-order`` and ``lock-discipline``.
+
+**seam-order** (established by PR 4, extended by PRs 7/8): in BOTH scheduler
+paths (``scheduling/scheduler.py`` and ``scheduling/native.py``) the three
+advisor filters must run in canonical order —
+
+    filter_by_policy -> filter_by_fairness -> filter_by_placement
+
+— and all of them BEFORE any prefix-affinity tie-break or RNG draw.  The
+ordering is load-bearing twice over: ``log_only`` stays routing-byte-identical
+only because the filters see the un-drawn survivor set (the same-RNG diff
+tests pin the bytes, this rule pins the shape), and an enforcing health
+policy must narrow the set before the prefix tie-break can pin a request to
+an avoided holder.  Breaking it costs silent routing skew that only shows up
+as a diff-test failure hundreds of picks into a seed.
+
+**lock-discipline** (established by PR 6): the native scheduler's
+``_call_lock`` exists to guard the resident state handle and its persistent
+buffers across threaded gRPC transports — nothing else.  Prefix hashing
+(the lazy blake2b chain behind ``req.prefix_hashes``), RNG draws, and the
+``note_*`` advisor callbacks can each cost more than the pick itself;
+serializing them collapses the threaded transport to single-thread hash
+speed (the regression PR 6 removed).  So inside ``with self._call_lock:``
+blocks: no hashing, no RNG, no ``note_*`` / prefix-index calls, no
+``req.prefix_hashes`` reads, no blocking I/O.  The same blocking-work ban
+applies to ``async def`` bodies in ``gateway/proxy.py``: one ``time.sleep``
+or sync-HTTP call stalls the whole event loop, not one request.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_instance_gateway_tpu.lint import Finding, Tree, rule
+
+SCHED = "llm_instance_gateway_tpu/gateway/scheduling/scheduler.py"
+NATIVE = "llm_instance_gateway_tpu/gateway/scheduling/native.py"
+PROXY = "llm_instance_gateway_tpu/gateway/proxy.py"
+
+# Canonical advisor-filter order (PR 4 health, PR 7 fairness, PR 8 placement).
+FILTER_ORDER = ("filter_by_policy", "filter_by_fairness",
+                "filter_by_placement")
+
+# Attribute calls that consume randomness or the prefix tie-break seam.
+_RNG_ATTRS = {"randrange", "random", "choice", "shuffle", "getrandbits",
+              "randint", "sample"}
+_PREFIX_ATTRS = {"prefer"}  # prefix_index.prefer(...) is the tie-break entry
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _functions(mod: ast.Module):
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _direct_body_walk(fn: ast.AST):
+    """Walk a function's own body, not nested function definitions."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("seam-order")
+def check_seam_order(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    anchored = 0
+    for rel in (SCHED, NATIVE):
+        mod = tree.parse(rel)
+        if mod is None:
+            findings.append(Finding(
+                "seam-order", rel, 0,
+                "scheduler module missing or unparseable — the advisor-seam "
+                "invariant has nothing to anchor to"))
+            continue
+        for fn in _functions(mod):
+            filter_calls: list[tuple[int, int, str]] = []  # (line, col, name)
+            consume_calls: list[tuple[int, str]] = []      # (line, what)
+            for node in _direct_body_walk(fn):
+                if isinstance(node, ast.Call):
+                    name = _call_name(node)
+                    if name in FILTER_ORDER:
+                        filter_calls.append(
+                            (node.lineno, node.col_offset, name))
+                    elif name in _RNG_ATTRS:
+                        consume_calls.append((node.lineno, "RNG draw"))
+                    elif (name in _PREFIX_ATTRS
+                          and isinstance(node.func, ast.Attribute)
+                          and "prefix" in ast.dump(node.func.value)):
+                        consume_calls.append(
+                            (node.lineno, "prefix tie-break"))
+            if not filter_calls:
+                continue
+            anchored += 1
+            filter_calls.sort()
+            seen = [name for _, _, name in filter_calls]
+            want = [n for n in FILTER_ORDER if n in seen]
+            if seen != want:
+                findings.append(Finding(
+                    "seam-order", rel, filter_calls[0][0],
+                    f"{fn.name}: advisor filters run as {seen}; canonical "
+                    f"order is policy -> fairness -> placement"))
+            missing = [n for n in FILTER_ORDER if n not in seen]
+            if missing:
+                findings.append(Finding(
+                    "seam-order", rel, filter_calls[0][0],
+                    f"{fn.name}: advisor seam incomplete — calls "
+                    f"{seen} but never {missing} (every pick path runs all "
+                    f"three advisor filters)"))
+            last_filter_line = filter_calls[-1][0]
+            for line, what in sorted(consume_calls):
+                if line < last_filter_line:
+                    findings.append(Finding(
+                        "seam-order", rel, line,
+                        f"{fn.name}: {what} at line {line} precedes the "
+                        f"advisor filter at line {last_filter_line} — "
+                        f"filters must narrow the survivor set BEFORE any "
+                        f"tie-break or draw"))
+    if anchored == 0 and not findings:
+        findings.append(Finding(
+            "seam-order", SCHED, 0,
+            "no function calls the advisor filters anywhere — the seam "
+            "moved; re-anchor this rule before trusting it"))
+    return findings
+
+
+# Work forbidden while holding the native scheduler's call lock.
+_LOCKED_FORBIDDEN_CALLS = {
+    "prefer": "prefix tie-break",
+    "record": "prefix-index bookkeeping",
+    "blake2b": "hashing", "md5": "hashing", "sha1": "hashing",
+    "sha256": "hashing", "sha512": "hashing",
+    "sleep": "blocking sleep",
+    "urlopen": "sync HTTP",
+    "request": "sync HTTP",
+}
+_LOCKED_FORBIDDEN_ATTRS = {
+    "prefix_hashes": ("lazy prefix-hash resolution (the blake2b chain runs "
+                      "on first read)"),
+}
+
+
+def _is_call_lock(item: ast.withitem) -> bool:
+    ctx = item.context_expr
+    return (isinstance(ctx, ast.Attribute) and ctx.attr == "_call_lock")
+
+
+@rule("lock-discipline")
+def check_lock_discipline(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    mod = tree.parse(NATIVE)
+    if mod is None:
+        findings.append(Finding(
+            "lock-discipline", NATIVE, 0,
+            "native scheduler module missing or unparseable"))
+    else:
+        lock_blocks = 0
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_call_lock(i) for i in node.items):
+                continue
+            lock_blocks += 1
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call):
+                    name = _call_name(inner)
+                    if name in _LOCKED_FORBIDDEN_CALLS:
+                        findings.append(Finding(
+                            "lock-discipline", NATIVE, inner.lineno,
+                            f"{_LOCKED_FORBIDDEN_CALLS[name]} "
+                            f"({name}) inside the _call_lock block — PR 6 "
+                            f"moved this outside the lock; threaded "
+                            f"transports serialize on it otherwise"))
+                    elif name in _RNG_ATTRS or (
+                            name.startswith("note_")):
+                        findings.append(Finding(
+                            "lock-discipline", NATIVE, inner.lineno,
+                            f"{name}() inside the _call_lock block — RNG "
+                            f"and advisor note_* seams run unlocked "
+                            f"(Scheduler parity + PR 6 lock discipline)"))
+                elif isinstance(inner, ast.Attribute):
+                    if inner.attr in _LOCKED_FORBIDDEN_ATTRS:
+                        findings.append(Finding(
+                            "lock-discipline", NATIVE, inner.lineno,
+                            f".{inner.attr} read inside the _call_lock "
+                            f"block — {_LOCKED_FORBIDDEN_ATTRS[inner.attr]}"))
+        if lock_blocks == 0:
+            findings.append(Finding(
+                "lock-discipline", NATIVE, 0,
+                "no `with self._call_lock:` blocks found — the native "
+                "scheduler's locking moved; re-anchor this rule"))
+
+    # Proxy coroutines: no synchronous sleeps or sync HTTP on the event loop.
+    pmod = tree.parse(PROXY)
+    if pmod is None:
+        findings.append(Finding(
+            "lock-discipline", PROXY, 0,
+            "gateway proxy module missing or unparseable"))
+        return findings
+    for fn in _functions(pmod):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _direct_body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)):
+                base, attr = f.value.id, f.attr
+                if base == "time" and attr == "sleep":
+                    findings.append(Finding(
+                        "lock-discipline", PROXY, node.lineno,
+                        f"{fn.name}: time.sleep() inside a coroutine "
+                        f"stalls the whole event loop — use "
+                        f"asyncio.sleep()"))
+                elif base in ("urllib", "requests") or (
+                        base == "request" and attr == "urlopen"):
+                    findings.append(Finding(
+                        "lock-discipline", PROXY, node.lineno,
+                        f"{fn.name}: synchronous HTTP ({base}.{attr}) "
+                        f"inside a coroutine — use the aiohttp session"))
+            elif isinstance(f, ast.Attribute) and f.attr == "urlopen":
+                findings.append(Finding(
+                    "lock-discipline", PROXY, node.lineno,
+                    f"{fn.name}: synchronous urlopen inside a coroutine — "
+                    f"use the aiohttp session"))
+    return findings

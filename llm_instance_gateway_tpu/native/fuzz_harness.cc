@@ -1,0 +1,364 @@
+// Hostile-snapshot FFI fuzzer for libligsched (built and run by
+// `make native-asan` with -fsanitize=address,undefined).
+//
+// The ctypes marshal in scheduling/native.py is a trusted caller, but the
+// ABI is extern "C": any process that dlopens the .so can hand it garbage,
+// and a marshal BUG (the exact class the PR-7 ABI drift shipped) would do
+// the same from inside the gateway.  This harness drives the snapshot API
+// with the hostile shapes the contract must reject gracefully
+// (LIG_ERROR, never a read out of bounds):
+//
+//   - truncated CSR: offsets claiming more entries than the id buffer holds
+//   - non-monotonic / non-zero-based CSR offsets
+//   - out-of-range adapter ids inside the CSR payload
+//   - zero- and negative-pod pools, negative batch sizes
+//   - picks against never-updated and failed-update (not-ready) states
+//   - null pointers where the v4 ABI expects buffers ("stale-ABI shape":
+//     a caller marshalling the v3 arity would pass nulls/garbage in the
+//     new slots — nulls must fail loudly, not scramble)
+//
+// plus a deterministic random-walk load (seeded LCG, no libc rand) of
+// valid snapshots and pick/pick_many batches so the legitimate paths run
+// under ASan/UBSan too.  Exit 0 = clean; any sanitizer report aborts.
+//
+// Build: make -C llm_instance_gateway_tpu/native asan
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <limits>
+#include <vector>
+
+extern "C" {
+int32_t lig_abi_version(void);
+void* lig_state_new(void);
+void lig_state_free(void* h);
+int32_t lig_state_update(
+    void* h, int32_t n_pods, const int32_t* waiting, const int32_t* prefill,
+    const double* kv_usage, const int64_t* kv_free,
+    const int64_t* kv_capacity, const int32_t* n_active,
+    const int32_t* max_active, const uint8_t* avoid, int32_t n_adapters,
+    const int32_t* res_offsets, const int32_t* res_ids, int32_t res_ids_len,
+    const uint8_t* adapter_noisy, const int32_t* placed_offsets,
+    const int32_t* placed_ids, int32_t placed_ids_len,
+    const uint8_t* placed_tiers, const uint8_t* placed_any,
+    double kv_cache_threshold, int32_t queue_threshold_critical,
+    int32_t queueing_threshold_lora, double token_headroom_factor,
+    int32_t prefill_queue_threshold, uint8_t token_aware,
+    uint8_t prefill_aware, uint8_t policy_mode, uint8_t fairness_mode,
+    uint8_t placement_mode);
+int32_t lig_pick(void* h, int32_t adapter_id, uint8_t critical,
+                 uint8_t req_noisy, int64_t prompt_tokens, int32_t* out,
+                 uint8_t* flags);
+int32_t lig_pick_many(void* h, int32_t n_reqs, const int32_t* adapter_ids,
+                      const uint8_t* criticals, const uint8_t* req_noisies,
+                      const int64_t* prompt_tokens, int32_t* out_counts,
+                      int32_t* out_cands, uint8_t* out_flags);
+}
+
+namespace {
+
+constexpr int32_t kError = -2;
+
+int g_failures = 0;
+
+#define CHECK(cond, what)                                          \
+  do {                                                             \
+    if (!(cond)) {                                                 \
+      std::fprintf(stderr, "FUZZ FAIL: %s (line %d)\n", what,      \
+                   __LINE__);                                      \
+      ++g_failures;                                                \
+    }                                                              \
+  } while (0)
+
+// Deterministic PRNG (no libc rand: reproducible across platforms).
+uint64_t g_seed = 0x9e3779b97f4a7c15ull;
+uint64_t next_u64() {
+  g_seed = g_seed * 6364136223846793005ull + 1442695040888963407ull;
+  return g_seed >> 11;
+}
+int64_t rnd(int64_t lo, int64_t hi) {  // inclusive range
+  return lo + static_cast<int64_t>(next_u64() % (hi - lo + 1));
+}
+
+// A valid snapshot workspace the hostile cases mutate one field at a time.
+struct Snapshot {
+  int32_t n = 0, n_adapters = 0;
+  std::vector<int32_t> waiting, prefill, n_active, max_active;
+  std::vector<double> kv_usage;
+  std::vector<int64_t> kv_free, kv_capacity;
+  std::vector<uint8_t> avoid, noisy, placed_tiers, placed_any;
+  std::vector<int32_t> res_offsets, res_ids, placed_offsets, placed_ids;
+  uint8_t policy = 0, fairness = 0, placement = 0;
+
+  void build(int32_t pods, int32_t adapters, bool extreme) {
+    n = pods;
+    n_adapters = adapters;
+    waiting.assign(n, 0);
+    prefill.assign(n, 0);
+    n_active.assign(n, 0);
+    max_active.assign(n, 4);
+    kv_usage.assign(n, 0.0);
+    kv_free.assign(n, 1 << 20);
+    kv_capacity.assign(n, 1 << 20);
+    avoid.assign(n, 0);
+    for (int32_t i = 0; i < n; ++i) {
+      if (extreme) {
+        // Hostile replica metrics: INT32 extremes exercise the int64
+        // bucket math (the signed-overflow UB this PR fixed).
+        waiting[i] = static_cast<int32_t>(
+            rnd(0, 1) ? rnd(INT32_MAX - 4, INT32_MAX)
+                      : rnd(INT32_MIN, INT32_MIN + 4));
+        prefill[i] = waiting[i];
+        kv_usage[i] = rnd(0, 1) ? 1e300 : -1e300;
+        kv_free[i] = rnd(0, 1) ? INT64_MAX : INT64_MIN;
+        kv_capacity[i] = rnd(0, 1) ? INT64_MAX : 0;
+      } else {
+        waiting[i] = static_cast<int32_t>(rnd(0, 20));
+        prefill[i] = static_cast<int32_t>(rnd(0, 10));
+        kv_usage[i] = static_cast<double>(rnd(0, 100)) / 100.0;
+        n_active[i] = static_cast<int32_t>(rnd(0, 4));
+      }
+      avoid[i] = static_cast<uint8_t>(rnd(0, 4) == 0);
+    }
+    res_offsets.assign(n + 1, 0);
+    res_ids.clear();
+    for (int32_t i = 0; i < n; ++i) {
+      res_offsets[i] = static_cast<int32_t>(res_ids.size());
+      const int32_t k = n_adapters > 0
+                            ? static_cast<int32_t>(rnd(0, 2))
+                            : 0;
+      for (int32_t j = 0; j < k; ++j)
+        res_ids.push_back(static_cast<int32_t>(rnd(0, n_adapters - 1)));
+    }
+    res_offsets[n] = static_cast<int32_t>(res_ids.size());
+    noisy.assign(n_adapters > 0 ? n_adapters : 1, 0);
+    for (auto& b : noisy) b = static_cast<uint8_t>(rnd(0, 3) == 0);
+    placed_offsets = res_offsets;
+    placed_ids = res_ids;
+    placed_tiers.assign(placed_ids.size() ? placed_ids.size() : 1, 0);
+    for (auto& t : placed_tiers) t = static_cast<uint8_t>(rnd(1, 2));
+    placed_any.assign(n_adapters > 0 ? n_adapters : 1, 0);
+    for (auto& b : placed_any) b = static_cast<uint8_t>(rnd(0, 1));
+    policy = static_cast<uint8_t>(rnd(0, 2));
+    fairness = static_cast<uint8_t>(rnd(0, 1));
+    placement = static_cast<uint8_t>(rnd(0, 1));
+  }
+
+  int32_t update(void* h) const {
+    return lig_state_update(
+        h, n, waiting.data(), prefill.data(), kv_usage.data(),
+        kv_free.data(), kv_capacity.data(), n_active.data(),
+        max_active.data(), avoid.data(), n_adapters, res_offsets.data(),
+        res_ids.data(), static_cast<int32_t>(res_ids.size()), noisy.data(),
+        placed_offsets.data(), placed_ids.data(),
+        static_cast<int32_t>(placed_ids.size()), placed_tiers.data(),
+        placed_any.data(), 0.8, 5, 50, 1.2, 4, 1, 1, policy, fairness,
+        placement);
+  }
+};
+
+void picks_against(void* h, const Snapshot& s, int rounds) {
+  std::vector<int32_t> out(s.n > 0 ? s.n : 1);
+  for (int r = 0; r < rounds; ++r) {
+    uint8_t flags = 0;
+    // Out-of-range adapter ids (negative and huge) must behave like
+    // "no affinity anywhere", never index the bitmap.
+    const int32_t aid = static_cast<int32_t>(rnd(-3, s.n_adapters + 3));
+    const int64_t toks = rnd(0, 2) == 0 ? rnd(INT64_MAX - 2, INT64_MAX)
+                                        : rnd(0, 4096);
+    const int32_t rc =
+        lig_pick(h, aid, static_cast<uint8_t>(rnd(0, 1)),
+                 static_cast<uint8_t>(rnd(0, 1)), toks, out.data(), &flags);
+    CHECK(rc >= -3 && rc <= s.n, "lig_pick result out of contract range");
+  }
+}
+
+void fuzz_valid_load() {
+  void* h = lig_state_new();
+  CHECK(h != nullptr, "lig_state_new");
+  for (int iter = 0; iter < 400; ++iter) {
+    Snapshot s;
+    s.build(static_cast<int32_t>(rnd(1, 24)),
+            static_cast<int32_t>(rnd(0, 8)), iter % 5 == 0);
+    CHECK(s.update(h) == 0, "valid snapshot rejected");
+    picks_against(h, s, 16);
+    // Batched crossing over the same state.
+    const int32_t n_reqs = static_cast<int32_t>(rnd(1, 32));
+    std::vector<int32_t> aids(n_reqs), counts(n_reqs);
+    std::vector<uint8_t> crit(n_reqs), noisy_req(n_reqs), flags(n_reqs);
+    std::vector<int64_t> toks(n_reqs);
+    std::vector<int32_t> cands(static_cast<size_t>(n_reqs) * s.n);
+    for (int32_t i = 0; i < n_reqs; ++i) {
+      aids[i] = static_cast<int32_t>(rnd(-2, s.n_adapters + 2));
+      crit[i] = static_cast<uint8_t>(rnd(0, 1));
+      noisy_req[i] = static_cast<uint8_t>(rnd(0, 1));
+      toks[i] = rnd(0, 1 << 14);
+    }
+    CHECK(lig_pick_many(h, n_reqs, aids.data(), crit.data(),
+                        noisy_req.data(), toks.data(), counts.data(),
+                        cands.data(), flags.data()) == 0,
+          "valid pick_many rejected");
+    for (int32_t i = 0; i < n_reqs; ++i)
+      CHECK(counts[i] >= -3 && counts[i] <= s.n,
+            "pick_many count out of contract range");
+  }
+  lig_state_free(h);
+}
+
+void fuzz_hostile_shapes() {
+  void* h = lig_state_new();
+  CHECK(h != nullptr, "lig_state_new");
+  Snapshot good;
+  good.build(6, 4, false);
+  CHECK(good.update(h) == 0, "baseline snapshot rejected");
+
+  {  // Truncated CSR: offsets claim more ids than the buffer holds.
+    Snapshot s = good;
+    s.res_offsets[s.n] = static_cast<int32_t>(s.res_ids.size()) + 8;
+    CHECK(s.update(h) == kError, "truncated resident CSR accepted");
+    CHECK(lig_pick(h, 0, 1, 0, 16, nullptr, nullptr) == kError,
+          "pick against a failed (not-ready) update did not error");
+    CHECK(good.update(h) == 0, "state did not recover after bad update");
+  }
+  {  // Oversized id buffer (offsets end early): also a shape lie.
+    Snapshot s = good;
+    s.res_ids.push_back(0);
+    CHECK(s.update(h) == kError, "oversized resident id buffer accepted");
+  }
+  {  // Non-monotonic offsets.
+    Snapshot s = good;
+    if (s.n >= 2) {
+      s.res_offsets[1] = s.res_offsets[s.n] + 1;
+      CHECK(s.update(h) == kError, "non-monotonic CSR offsets accepted");
+    }
+  }
+  {  // Non-zero-based offsets.
+    Snapshot s = good;
+    for (auto& o : s.res_offsets) o += 1;
+    CHECK(s.update(h) == kError, "non-zero-based CSR offsets accepted");
+  }
+  {  // Out-of-range adapter ids inside the payload.
+    Snapshot s = good;
+    if (!s.res_ids.empty()) {
+      s.res_ids[0] = s.n_adapters + 7;
+      CHECK(s.update(h) == kError, "out-of-range adapter id accepted");
+      s.res_ids[0] = -1;
+      CHECK(s.update(h) == kError, "negative adapter id accepted");
+    }
+  }
+  {  // Hostile placement CSR (validated only when the mode is on).
+    Snapshot s = good;
+    s.placement = 1;
+    s.placed_offsets[s.n] = static_cast<int32_t>(s.placed_ids.size()) + 3;
+    CHECK(s.update(h) == kError, "truncated placement CSR accepted");
+  }
+  {  // Zero- and negative-pod pools.
+    Snapshot s = good;
+    s.n = 0;
+    CHECK(lig_state_update(h, 0, nullptr, nullptr, nullptr, nullptr,
+                           nullptr, nullptr, nullptr, nullptr, 0, nullptr,
+                           nullptr, 0, nullptr, nullptr, nullptr, 0,
+                           nullptr, nullptr, 0.8, 5, 50, 1.2, 4, 1, 1, 0,
+                           0, 0) == kError,
+          "zero-pod pool accepted");
+    CHECK(lig_state_update(h, -4, good.waiting.data(),
+                           good.prefill.data(), good.kv_usage.data(),
+                           good.kv_free.data(), good.kv_capacity.data(),
+                           good.n_active.data(), good.max_active.data(),
+                           good.avoid.data(), good.n_adapters,
+                           good.res_offsets.data(), good.res_ids.data(),
+                           static_cast<int32_t>(good.res_ids.size()),
+                           good.noisy.data(), nullptr, nullptr, 0, nullptr,
+                           nullptr, 0.8, 5, 50, 1.2, 4, 1, 1, 0, 0,
+                           0) == kError,
+          "negative-pod pool accepted");
+  }
+  {  // Stale-ABI shape: a v3-arity caller leaves the new slots null/0.
+    Snapshot s = good;
+    CHECK(lig_state_update(
+              h, s.n, s.waiting.data(), s.prefill.data(),
+              s.kv_usage.data(), s.kv_free.data(), s.kv_capacity.data(),
+              s.n_active.data(), s.max_active.data(), s.avoid.data(),
+              s.n_adapters, s.res_offsets.data(), nullptr,
+              static_cast<int32_t>(s.res_ids.size()), s.noisy.data(),
+              nullptr, nullptr, 0, nullptr, nullptr, 0.8, 5, 50, 1.2, 4,
+              1, 1, 0, 0, 0) == kError,
+          "null id buffer with nonzero claimed length accepted");
+  }
+  {  // NaN/Inf config doubles: the extern "C" surface does not validate
+     // them, so the token-headroom clamp must route NaN away from the
+     // float->int cast (UB) — both clamp comparisons are false for NaN.
+    const double bad_doubles[] = {
+        std::numeric_limits<double>::quiet_NaN(),
+        std::numeric_limits<double>::infinity(),
+        -std::numeric_limits<double>::infinity()};
+    std::vector<int32_t> out(good.n);
+    for (double factor : bad_doubles) {
+      CHECK(lig_state_update(
+                h, good.n, good.waiting.data(), good.prefill.data(),
+                good.kv_usage.data(), good.kv_free.data(),
+                good.kv_capacity.data(), good.n_active.data(),
+                good.max_active.data(), good.avoid.data(),
+                good.n_adapters, good.res_offsets.data(),
+                good.res_ids.data(),
+                static_cast<int32_t>(good.res_ids.size()),
+                good.noisy.data(), good.placed_offsets.data(),
+                good.placed_ids.data(),
+                static_cast<int32_t>(good.placed_ids.size()),
+                good.placed_tiers.data(), good.placed_any.data(), 0.8, 5,
+                50, factor, 4, 1, 1, 0, 0, 0) == 0,
+            "non-finite headroom factor rejected (should marshal)");
+      uint8_t flags = 0;
+      const int32_t rc = lig_pick(h, 0, 1, 0, INT64_MAX, out.data(),
+                                  &flags);
+      CHECK(rc >= -3 && rc <= good.n,
+            "pick under non-finite headroom factor out of range");
+    }
+    CHECK(good.update(h) == 0, "baseline re-update after NaN configs");
+  }
+  {  // Never-updated state + null outputs.
+    void* fresh = lig_state_new();
+    int32_t out[8];
+    uint8_t flags = 0;
+    CHECK(lig_pick(fresh, 0, 1, 0, 16, out, &flags) == kError,
+          "pick against a never-updated state did not error");
+    lig_state_free(fresh);
+    CHECK(lig_pick(nullptr, 0, 1, 0, 16, out, &flags) == kError,
+          "pick against a null state did not error");
+    CHECK(good.update(h) == 0, "baseline re-update failed");
+    CHECK(lig_pick(h, 0, 1, 0, 16, nullptr, &flags) == kError,
+          "pick with a null out buffer did not error");
+    int32_t counts[1];
+    int64_t toks[1] = {16};
+    int32_t aids[1] = {0};
+    uint8_t crit[1] = {1}, noisyr[1] = {0}, oflags[1];
+    int32_t cands[8];
+    CHECK(lig_pick_many(h, 0, aids, crit, noisyr, toks, counts, cands,
+                        oflags) == kError,
+          "pick_many with n_reqs=0 did not error");
+    CHECK(lig_pick_many(h, -1, aids, crit, noisyr, toks, counts, cands,
+                        oflags) == kError,
+          "pick_many with negative n_reqs did not error");
+    CHECK(lig_pick_many(h, 1, nullptr, crit, noisyr, toks, counts, cands,
+                        oflags) == kError,
+          "pick_many with null adapter ids did not error");
+  }
+  lig_state_free(h);
+  lig_state_free(nullptr);  // must be a no-op, not a crash
+}
+
+}  // namespace
+
+int main() {
+  std::printf("libligsched ABI v%d hostile-snapshot fuzz\n",
+              lig_abi_version());
+  fuzz_valid_load();
+  fuzz_hostile_shapes();
+  if (g_failures > 0) {
+    std::fprintf(stderr, "FUZZ: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  std::printf("FUZZ PASS\n");
+  return 0;
+}

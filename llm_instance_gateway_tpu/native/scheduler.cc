@@ -85,13 +85,17 @@ inline bool has_aff(const uint8_t* aff, int32_t i) {
 // Bucketing filters: keep pods in [min, min + (max-min)/len(set)]
 // (integer division for queues, float for kv — filter.go:117/:149 parity).
 
+// Bucket math runs in int64: a hostile replica can report INT32_MIN /
+// INT32_MAX queue depths (the gateway scrapes foreign /metrics text), and
+// (hi - lo) would overflow int32 — signed-overflow UB the ASan/UBSan fuzz
+// (make native-asan) exercises explicitly.
 Set least_queuing(const PodArrays& p, const Set& in) {
-  int32_t lo = INT32_MAX, hi = 0;
+  int64_t lo = INT64_MAX, hi = INT64_MIN;
   for (int32_t i : in) {
     lo = p.waiting[i] < lo ? p.waiting[i] : lo;
     hi = p.waiting[i] > hi ? p.waiting[i] : hi;
   }
-  const int32_t cut = lo + (hi - lo) / static_cast<int32_t>(in.size());
+  const int64_t cut = lo + (hi - lo) / static_cast<int64_t>(in.size());
   Set out;
   for (int32_t i : in)
     if (p.waiting[i] <= cut) out.push_back(i);
@@ -99,12 +103,12 @@ Set least_queuing(const PodArrays& p, const Set& in) {
 }
 
 Set least_prefill(const PodArrays& p, const Set& in) {
-  int32_t lo = INT32_MAX, hi = 0;
+  int64_t lo = INT64_MAX, hi = INT64_MIN;
   for (int32_t i : in) {
     lo = p.prefill[i] < lo ? p.prefill[i] : lo;
     hi = p.prefill[i] > hi ? p.prefill[i] : hi;
   }
-  const int32_t cut = lo + (hi - lo) / static_cast<int32_t>(in.size());
+  const int64_t cut = lo + (hi - lo) / static_cast<int64_t>(in.size());
   Set out;
   for (int32_t i : in)
     if (p.prefill[i] <= cut) out.push_back(i);
@@ -165,8 +169,17 @@ int32_t run_tree(const PodArrays& p, const Config& c, const uint8_t* aff,
   // token_headroom parity.
   Set pool = all;
   if (c.token_aware && prompt_tokens > 0) {
+    // Clamp before the float->int cast: INT64_MAX prompt_tokens * 1.2
+    // exceeds int64 range and the cast is UB (UBSan float-cast-overflow;
+    // caught by the make native-asan fuzz).  The cast runs ONLY inside
+    // the proven-finite range so a NaN factor (both comparisons false)
+    // lands in the else branch (need=0: the advisory gate passes
+    // everyone) instead of casting NaN — same defect class, same fate.
+    const double need_f = prompt_tokens * c.token_headroom_factor;
     const int64_t need =
-        static_cast<int64_t>(prompt_tokens * c.token_headroom_factor);
+        (need_f > 0.0 && need_f < 9.2e18)
+            ? static_cast<int64_t>(need_f)
+            : (need_f >= 9.2e18 ? INT64_MAX : 0);
     Set fit;
     for (int32_t i : all)
       if (p.kv_capacity[i] <= 0 || p.kv_free[i] >= need) fit.push_back(i);
@@ -342,11 +355,18 @@ constexpr int32_t LIG_SHED_STRICT = kShedStrict;
 // Bump on ANY exported-signature change (the loader refuses mismatches
 // and falls back to Python — an arity change against a prebuilt .so would
 // otherwise scramble arguments or segfault in the routing hot path).
+// The invariant linter (`make lint`, abi-drift rule) cross-checks these
+// signatures against the ctypes marshals and the checked-in fingerprint,
+// so a signature change without a bump fails in the TREE, not at load.
 // 2 = fairness plane: lig_state_update +fairness_mode, lig_pick /
 // lig_pick_many +req_noisy, escape flag bit 2.
 // 3 = placement plane: lig_state_update +placed CSR (+placed_any bits)
 // and +placement_mode, escape flag bit 3.
-int32_t lig_abi_version(void) { return 3; }
+// 4 = sanitized-build hardening: lig_state_update +res_ids_len /
+// +placed_ids_len so hostile CSR shapes (truncated offsets, id buffers
+// shorter than offsets claim) are REJECTED with LIG_ERROR instead of
+// read out of bounds.
+int32_t lig_abi_version(void) { return 4; }
 
 // ---- stateless reference entry (legacy ABI, unchanged semantics) ---------
 
@@ -388,9 +408,13 @@ void* lig_state_new(void) { return new (std::nothrow) State(); }
 void lig_state_free(void* h) { delete static_cast<State*>(h); }
 
 // Marshal the whole routable world once per tick.  ``resident`` arrives as
-// CSR (res_offsets[n_pods+1] into res_ids) and is exploded into an
-// adapter-major bitmap here so the per-pick affinity view is one row
-// pointer.  Returns 0 on success.
+// CSR (res_offsets[n_pods+1] into res_ids, res_ids_len entries) and is
+// exploded into an adapter-major bitmap here so the per-pick affinity view
+// is one row pointer.  The explicit ``*_len`` buffer lengths (ABI v4) let
+// hostile CSR shapes — offsets claiming more entries than the id buffer
+// holds, non-monotonic offsets — be rejected with LIG_ERROR instead of
+// read out of bounds (the make native-asan fuzz drives exactly these).
+// Returns 0 on success.
 int32_t lig_state_update(
     void* h, int32_t n_pods,
     const int32_t* waiting, const int32_t* prefill, const double* kv_usage,
@@ -398,9 +422,10 @@ int32_t lig_state_update(
     const int32_t* n_active, const int32_t* max_active,
     const uint8_t* avoid,
     int32_t n_adapters, const int32_t* res_offsets, const int32_t* res_ids,
-    const uint8_t* adapter_noisy,
+    int32_t res_ids_len, const uint8_t* adapter_noisy,
     const int32_t* placed_offsets, const int32_t* placed_ids,
-    const uint8_t* placed_tiers, const uint8_t* placed_any,
+    int32_t placed_ids_len, const uint8_t* placed_tiers,
+    const uint8_t* placed_any,
     double kv_cache_threshold, int32_t queue_threshold_critical,
     int32_t queueing_threshold_lora, double token_headroom_factor,
     int32_t prefill_queue_threshold, uint8_t token_aware,
@@ -413,6 +438,26 @@ int32_t lig_state_update(
       (placement_mode != 0 && n_adapters > 0 &&
        (!placed_offsets || !placed_any)))
     return LIG_ERROR;
+  // CSR shape validation: offsets must be monotonically non-decreasing,
+  // start at 0, and end exactly at the id-buffer length (a truncated or
+  // oversized offsets table is a marshal bug or a hostile caller, never
+  // something to walk).
+  if (n_adapters > 0) {
+    if (res_ids_len < 0 || (res_ids_len > 0 && !res_ids)) return LIG_ERROR;
+    if (res_offsets[0] != 0 || res_offsets[n_pods] != res_ids_len)
+      return LIG_ERROR;
+    for (int32_t pod = 0; pod < n_pods; ++pod)
+      if (res_offsets[pod] > res_offsets[pod + 1]) return LIG_ERROR;
+    if (placement_mode != 0) {
+      if (placed_ids_len < 0 || (placed_ids_len > 0 && !placed_ids))
+        return LIG_ERROR;
+      if (placed_offsets[0] != 0 ||
+          placed_offsets[n_pods] != placed_ids_len)
+        return LIG_ERROR;
+      for (int32_t pod = 0; pod < n_pods; ++pod)
+        if (placed_offsets[pod] > placed_offsets[pod + 1]) return LIG_ERROR;
+    }
+  }
   st->ready = false;
   st->n = n_pods;
   st->waiting.assign(waiting, waiting + n_pods);

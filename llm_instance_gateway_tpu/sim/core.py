@@ -19,9 +19,8 @@ Defaults come from measuring this repo's engine on a v5e chip via
 from __future__ import annotations
 
 import heapq
-import random
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
 

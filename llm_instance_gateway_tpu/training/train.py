@@ -14,7 +14,6 @@ confined to the real ranks' subspace plus harmless padded lanes).
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax

@@ -16,7 +16,6 @@ from llm_instance_gateway_tpu.gateway.scheduling.admission import (
 )
 from llm_instance_gateway_tpu.gateway.scheduling.config import (
     AdmissionConfig,
-    SchedulerConfig,
     drain_scaled,
     from_pool_spec,
 )

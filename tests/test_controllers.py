@@ -9,7 +9,6 @@ file-watch source.
 import os
 import textwrap
 
-import pytest
 
 from llm_instance_gateway_tpu.api.v1alpha1 import (
     InferenceModel,

@@ -191,7 +191,6 @@ class TestRopeScaling:
         from llm_instance_gateway_tpu.models.convert import (
             config_from_hf, params_from_hf_state_dict,
         )
-        import dataclasses as dc
 
         hf_cfg = transformers.LlamaConfig(
             vocab_size=128, hidden_size=64, num_hidden_layers=2,

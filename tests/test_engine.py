@@ -6,7 +6,6 @@ alongside other traffic (other adapters, base model) produce identical tokens
 (greedy).  That is the correctness contract multiplexed serving rests on.
 """
 
-import threading
 import time
 
 import jax

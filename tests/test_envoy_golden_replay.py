@@ -23,7 +23,6 @@ import os
 import struct
 
 import grpc
-import pytest
 
 from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
 from llm_instance_gateway_tpu.gateway.extproc.service import make_process_stub

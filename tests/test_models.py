@@ -18,7 +18,6 @@ from llm_instance_gateway_tpu.models.configs import (
     GEMMA_2B,
     MIXTRAL_8X7B,
     TINY_TEST,
-    ModelConfig,
 )
 
 TINY_GEMMA = GEMMA_2B.tiny()

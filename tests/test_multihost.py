@@ -12,7 +12,6 @@ processes — data-parallel gradient psums ride the inter-process link exactly
 as they would ride DCN.
 """
 
-import os
 
 import pytest
 

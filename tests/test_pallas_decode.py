@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from llm_instance_gateway_tpu.ops.attention import decode_attention as xla_decode
 from llm_instance_gateway_tpu.ops import pallas_decode_attention as pda

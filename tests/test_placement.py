@@ -29,7 +29,6 @@ import threading
 import numpy as np
 import pytest
 
-from llm_instance_gateway_tpu.gateway import placement as placement_mod
 from llm_instance_gateway_tpu.gateway.placement import (
     PlacementConfig,
     PlacementPlanner,

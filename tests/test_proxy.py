@@ -8,7 +8,6 @@ accounting in /metrics, health gating.
 import asyncio
 import json
 
-import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import TINY_TEST

@@ -1,6 +1,5 @@
 """Pool-document scheduler config + stream cancellation tests."""
 
-import threading
 import time
 
 import jax

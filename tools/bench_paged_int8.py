@@ -19,11 +19,9 @@ bench.py (default here 600s) so it can never outstay a chip window.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
