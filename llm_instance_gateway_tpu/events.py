@@ -55,6 +55,8 @@ FAIRNESS_DEMOTE = "fairness_demote"     # over-quota request demoted one tier
 FAIRNESS_ESCAPE = "fairness_escape"     # fairness pick filter last-resort
 PLACEMENT_DECISION = "placement_decision"  # planner emitted a tier action
 PLACEMENT_ESCAPE = "placement_escape"   # no resident candidate: full set served
+STATEBUS_STALE = "statebus_stale"       # peers quiet: local-only enforcement
+STATEBUS_REJOIN = "statebus_rejoin"     # fresh peer state after a stale spell
 
 
 class EventJournal:

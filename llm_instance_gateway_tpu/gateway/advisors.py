@@ -1,0 +1,195 @@
+"""Per-pool advisor stack: one pool's full control-plane bundle.
+
+Before this module the proxy inlined ONE health scorer + resilience plane
++ usage rollup + fairness policy + placement planner over its single
+pool's provider and scheduler, and a multi-pool front
+(``multipool.MultiPoolServer``) got none of it — PR 7 logged a loud
+"enforcement INACTIVE" warning instead.  ``AdvisorStack`` extracts that
+wiring so the proxy builds one stack **per pool**: each pool's scheduler
+gets its own ``health_advisor`` / ``usage_advisor`` / ``placement_advisor``
+seams (Python AND native paths — the advisors are the same objects both
+marshal from), each pool's handler core gets its own fairness ``admit()``
+gate, and the observability tick drives every stack.  The multi-pool
+enforcement carve-out is gone.
+
+The stack is also the unit the replicated state plane gossips
+(``gateway/statebus.py``): each advisor exposes a *local* accessor (what
+this replica derived itself — published) and a *remote overlay* setter
+(the merged peer view — applied), so N gateways fronting the same pools
+share one brain without any advisor growing a network dependency.
+"""
+
+from __future__ import annotations
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.gateway import placement as placement_mod
+from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
+from llm_instance_gateway_tpu.gateway import usage as usage_mod
+
+
+class AdvisorStack:
+    """One pool's advisors, built over that pool's provider and wired
+    into that pool's scheduler + handler core.
+
+    ``metrics`` is the (gateway-wide) GatewayMetrics the usage rollup
+    reads admitted-traffic deltas from; ``request_filter`` scopes those
+    deltas to this pool's models on multi-pool fronts.  ``journal`` is
+    the shared flight recorder (one per gateway process — events carry
+    pod/model attributes that disambiguate pools).
+    """
+
+    def __init__(self, pool_name: str, provider, scheduler=None,
+                 server=None, metrics=None,
+                 journal: "events_mod.EventJournal | None" = None,
+                 resilience_cfg=None, health_cfg=None, usage_cfg=None,
+                 fairness_cfg=None, placement_cfg=None,
+                 request_filter=None):
+        self.pool_name = pool_name
+        self.provider = provider
+        self.journal = journal if journal is not None \
+            else events_mod.EventJournal()
+        self.health = health_mod.HealthScorer(
+            provider=provider, cfg=health_cfg, journal=self.journal)
+        self.resilience = resilience_mod.ResiliencePlane(
+            self.health, cfg=resilience_cfg, journal=self.journal)
+        self.usage = usage_mod.UsageRollup(
+            provider, metrics=metrics, cfg=usage_cfg, journal=self.journal,
+            request_filter=request_filter)
+        # Fairness config precedence, per FIELD: explicit CLI flags (a
+        # dict of overrides from bootstrap.fairness_from_args — pinned,
+        # re-applied on every hot reload) > THIS pool document's
+        # schedulerConfig.fairnessPolicy section (already parsed into the
+        # pool scheduler's live config) > defaults.  A full
+        # FairnessConfig (programmatic callers/tests) is the initial
+        # config, reloadable as a whole.
+        fairness_overrides = None
+        if isinstance(fairness_cfg, dict):
+            fairness_overrides, fairness_cfg = fairness_cfg, None
+        if fairness_cfg is None:
+            sched_cfg = getattr(scheduler, "cfg", None)
+            fairness_cfg = getattr(sched_cfg, "fairness", None)
+        self.fairness = fairness_mod.FairnessPolicy(
+            self.usage, cfg=fairness_cfg, journal=self.journal,
+            provider=provider, cli_overrides=fairness_overrides)
+        self.placement = placement_mod.PlacementPlanner(
+            provider, usage=self.usage, cfg=placement_cfg,
+            journal=self.journal)
+        self.wire(scheduler, server)
+
+    # -- seam wiring --------------------------------------------------------
+    def wire(self, outer_scheduler, server) -> None:
+        """Attach this stack's advisors to the pool's scheduler seams and
+        handler core.  ``outer_scheduler`` may be the AdmissionController
+        wrapping the real scheduler (reach through ``_scheduler``) or the
+        scheduler itself; either may be None for partially-assembled test
+        rigs."""
+        sched = getattr(outer_scheduler, "_scheduler", outer_scheduler)
+        if sched is not None and hasattr(sched, "health_advisor"):
+            sched.health_advisor = self.resilience
+        if sched is not None and hasattr(sched, "usage_advisor"):
+            sched.usage_advisor = self.fairness
+        if sched is not None and hasattr(sched, "placement_advisor"):
+            sched.placement_advisor = self.placement
+        # The AdmissionController feeds fairnessPolicy hot-reloads from
+        # the pool document through this reference.
+        if outer_scheduler is not None and hasattr(outer_scheduler,
+                                                   "fairness"):
+            outer_scheduler.fairness = self.fairness
+        if server is not None and hasattr(server, "fairness"):
+            server.fairness = self.fairness
+
+    # -- lifecycle ----------------------------------------------------------
+    def tick(self) -> None:
+        """One observability pass for this pool, in dependency order:
+        health/breaker first (cheap, feeds the journal), usage shares,
+        then the planes that read them (fairness quotas, placement)."""
+        self.resilience.tick()
+        self.usage.tick()
+        self.fairness.tick()
+        self.placement.tick()
+
+    def pod_names(self) -> set[str]:
+        return {pm.pod.name for pm in self.provider.all_pod_metrics()}
+
+    # -- export -------------------------------------------------------------
+    def render(self) -> list[str]:
+        """This pool's exposition lines (health + circuits + usage +
+        fairness + placement).  Multi-pool fronts merge the per-stack
+        blocks through ``merge_exposition_blocks``."""
+        return (self.health.render() + self.resilience.render()
+                + self.usage.render() + self.fairness.render()
+                + self.placement.render())
+
+
+def merge_exposition_blocks(blocks: list[list[str]]) -> list[str]:
+    """Merge several pools' exposition blocks into one valid page.
+
+    Per-pool stacks render the SAME families (``gateway_pod_health_score``
+    etc.) over disjoint label sets (pod names are unique across pools,
+    model names are per-pool unambiguous), so labeled samples concatenate
+    — but each family's ``# TYPE`` line must appear exactly once, and the
+    unlabeled scalar samples the renderers emit (per-stack counters like
+    ``gateway_placement_escapes_total``, and the empty-family ``0``
+    fallbacks of ``render_keyed_family``) must SUM, not repeat: two
+    unlabeled samples of one family is malformed exposition.
+
+    Counter samples with identical name+labels sum; gauges keep the last
+    value (pools never legitimately collide on a labeled gauge).  Order
+    of first appearance is preserved.
+    """
+    types: dict[str, str] = {}
+    order: list[tuple[str, str]] = []  # ("type"|"sample", key)
+    seen: set[str] = set()
+    values: dict[str, float] = {}
+    int_valued: dict[str, bool] = {}
+
+    def family_of(sample_key: str) -> str:
+        name = sample_key.split("{", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base is not None and base in types:
+                return base
+        return name
+
+    for block in blocks:
+        for line in block:
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                if name not in types:
+                    types[name] = kind
+                    order.append(("type", name))
+                continue
+            if not line or line.startswith("#"):
+                if line not in seen:
+                    seen.add(line)
+                    order.append(("raw", line))
+                continue
+            key, _, raw = line.rpartition(" ")
+            try:
+                value = float(raw)
+            except ValueError:
+                key, value = line, 0.0  # malformed: pass through verbatim
+                raw = ""
+            if key not in values:
+                values[key] = value
+                int_valued[key] = "." not in raw and "e" not in raw.lower()
+                order.append(("sample", key))
+            elif types.get(family_of(key)) == "counter":
+                values[key] += value
+                int_valued[key] = int_valued[key] and (
+                    "." not in raw and "e" not in raw.lower())
+            else:
+                values[key] = value
+    out: list[str] = []
+    for kind, key in order:
+        if kind == "type":
+            out.append(f"# TYPE {key} {types[key]}")
+        elif kind == "raw":
+            out.append(key)
+        else:
+            v = values[key]
+            out.append(f"{key} {int(v)}" if int_valued[key]
+                       and float(v).is_integer() else f"{key} {v:g}")
+    return out

@@ -640,6 +640,52 @@ def add_fairness_args(parser: argparse.ArgumentParser) -> None:
                              f"(default {f.quota_rps})")
 
 
+def add_statebus_args(parser: argparse.ArgumentParser) -> None:
+    """Replicated-state-plane flags (gateway/statebus.py): how N gateway
+    replicas fronting the same pools share their tick-derived state."""
+    from llm_instance_gateway_tpu.gateway.statebus import StateBusConfig
+
+    s = StateBusConfig()
+    parser.add_argument("--replica-id", default="",
+                        help="this gateway's identity on the statebus "
+                             "(default: hostname:port; must be unique "
+                             "per replica)")
+    parser.add_argument("--statebus-peer", action="append", default=[],
+                        metavar="URL",
+                        help="peer gateway base URL to gossip snapshots "
+                             "with (repeatable, e.g. http://gw-1:8081); "
+                             "none = single-replica, statebus inert")
+    parser.add_argument("--statebus-staleness-s", type=float,
+                        default=s.staleness_s,
+                        help="peer snapshots older than this drop from "
+                             "the merged view; all peers stale = "
+                             "local-only enforcement fallback (journaled "
+                             "statebus_stale)")
+    parser.add_argument("--no-statebus-quota-partition",
+                        action="store_true",
+                        help="do NOT divide fairness token buckets by the "
+                             "live replica count (default: partition, so "
+                             "tenant quotas hold fleet-wide under "
+                             "request spraying)")
+
+
+def statebus_from_args(args, port: int = 0):
+    """Build a StateBusConfig from ``add_statebus_args`` flags."""
+    import socket
+
+    from llm_instance_gateway_tpu.gateway.statebus import StateBusConfig
+
+    replica_id = args.replica_id
+    if not replica_id:
+        replica_id = f"{socket.gethostname()}:{port or 0}"
+    return StateBusConfig(
+        replica_id=replica_id,
+        peers=tuple(args.statebus_peer),
+        staleness_s=args.statebus_staleness_s,
+        partition_quota=not args.no_statebus_quota_partition,
+    )
+
+
 def resilience_from_args(args):
     """Build a ResilienceConfig from ``add_resilience_args`` flags."""
     from llm_instance_gateway_tpu.gateway.resilience import ResilienceConfig

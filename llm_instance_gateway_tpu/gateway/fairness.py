@@ -143,6 +143,14 @@ class FairnessPolicy:
         self.fairness_demotions: dict[tuple, int] = {}
         self.escape_total = 0
         self.ticks = 0
+        # Global-fairness partition (statebus): with N live gateway
+        # replicas spraying one tenant's traffic, each replica serves
+        # ~1/N of it, so each local token bucket refills (and bursts) at
+        # 1/N of the configured rate — the FLEET-wide admission rate for
+        # a throttled tenant stays quota_rps regardless of replica count.
+        # 1.0 (single gateway / statebus absent) reproduces the exact
+        # pre-statebus behavior.
+        self.quota_scale = 1.0
         # (noisy-set identity, pods hosting a flagged adapter): the pick
         # seam's cached mark set — the rollup rebuilds its noisy frozenset
         # every tick, so object identity is the cheap staleness signal
@@ -270,6 +278,30 @@ class FairnessPolicy:
         tests/chaos assertions)."""
         return frozenset(self._throttled)
 
+    def set_quota_scale(self, scale: float) -> None:
+        """Statebus seam: partition the tenant quota across the live
+        gateway replica set (``scale = 1 / live_replicas``).  Existing
+        bucket levels above the new burst cap clamp on their next refill
+        (``min(burst, ...)`` in ``admit``), so a shrink takes effect
+        within one admission, not one idle period.
+
+        The even split assumes the load balancer sprays a tenant's
+        traffic roughly uniformly (many sessions hashed across
+        replicas).  A tenant pinned WHOLE to one replica by affinity
+        sees quota_rps/N there, i.e. over-throttling by N — if that is
+        your topology, run ``--no-statebus-quota-partition`` (full local
+        quotas; fleet-wide rate then bounded by N x quota_rps)."""
+        self.quota_scale = max(1e-6, min(1.0, scale))
+
+    def bucket_levels(self) -> list[list]:
+        """Token-bucket levels per throttled key as
+        ``[[model, adapter, tokens], ...]`` — published on the statebus
+        so ``tools/statebus_report.py`` can show the fleet-wide quota
+        spend next to each replica's partition."""
+        with self._lock:
+            return [[k[0], k[1], round(b[0], 4)]
+                    for k, b in sorted(self._buckets.items())]
+
     # -- admission gate ----------------------------------------------------
     def admit(self, llm_req) -> str | None:
         """Quota gate, called by the handler core BEFORE scheduling.
@@ -286,14 +318,22 @@ class FairnessPolicy:
             return None
         cfg = self.cfg
         now = self._clock()
+        scale = self.quota_scale
+        cost = self._costs.get(key, 1.0)
+        # The burst ceiling scales with the partition but NEVER below one
+        # request's cost: min(burst, ...) clamps every refill, so a
+        # ceiling under the cost would starve the tenant at full priority
+        # forever on every replica (the partition is meant to scale the
+        # RATE, not zero out admission).
+        burst = max(cfg.quota_burst * scale, cost)
         with self._lock:
             bucket = self._buckets.get(key)
             if bucket is None:
-                bucket = self._buckets[key] = [cfg.quota_burst, now]
+                bucket = self._buckets[key] = [burst, now]
             tokens, last = bucket
-            tokens = min(cfg.quota_burst,
-                         tokens + max(0.0, now - last) * cfg.quota_rps)
-            cost = self._costs.get(key, 1.0)
+            tokens = min(burst,
+                         tokens + max(0.0, now - last)
+                         * cfg.quota_rps * scale)
             if tokens >= cost:
                 bucket[0], bucket[1] = tokens - cost, now
                 return None
@@ -360,6 +400,7 @@ class FairnessPolicy:
                 })
             return {
                 "mode": self.cfg.mode,
+                "quota_scale": self.quota_scale,
                 "throttled": rows,
                 "quota_throttles_total": sum(self.quota_throttles.values()),
                 "fairness_demotions_total": sum(

@@ -144,9 +144,16 @@ class PlacementPlanner:
         # adapter -> (slot-tier pods, host-tier pods): the two-level mark
         # set prefer_resident steering uses — a slot pick costs nothing,
         # a host pick pays the promote, so slot-resident candidates win
-        # ties over host-resident ones.
+        # ties over host-resident ones.  ``_tier_pods`` is the MERGED view
+        # (local scrape + statebus peer overlay), swapped whole per
+        # rebuild so its identity doubles as the native scheduler's
+        # staleness signal; ``_local_tier_pods`` is what this replica
+        # scraped itself — the statebus publishes only that.
         self._tier_pods: dict[str, tuple] = {}
+        self._local_tier_pods: dict[str, tuple] = {}
+        self._remote_tier_pods: dict[str, tuple] = {}
         self._have_residency = False
+        self._have_local_residency = False
         self._model_of: dict[str, str] = {}  # adapter -> model (usage keys)
         # Exported counters.
         self.decisions_total: dict[tuple, int] = {}
@@ -190,6 +197,47 @@ class PlacementPlanner:
         if not self._have_residency:
             return None
         return self._tier_pods
+
+    def local_resident_map(self) -> dict[str, tuple] | None:
+        """This replica's OWN scraped adapter -> (slot pods, host pods)
+        map, peer overlay excluded — what the statebus publishes."""
+        if not self._have_local_residency:
+            return None
+        return self._local_tier_pods
+
+    def set_remote_resident(self, rmap: dict[str, tuple]) -> None:
+        """Statebus seam: replace the peer-derived residency overlay
+        (adapter -> (slot pods, host pods); empty = local-only fallback).
+        Peer gateways fronting the same pool scrape the same replicas, so
+        the overlay normally agrees with the local view — its value is
+        covering the window where THIS replica's scrape is stale or a pod
+        is only reachable from a peer.  The merged map is swapped whole
+        so the native snapshot re-marshals."""
+        with self._lock:
+            self._remote_tier_pods = dict(rmap)
+            self._rebuild_merged_locked()
+
+    def _rebuild_merged_locked(self) -> None:
+        """Fold the local scrape and the peer overlay into the maps the
+        pick seam reads (caller holds ``_lock``)."""
+        if not self._remote_tier_pods:
+            merged = dict(self._local_tier_pods)
+        else:
+            merged = {}
+            for a in set(self._local_tier_pods) | set(
+                    self._remote_tier_pods):
+                ls, lh = self._local_tier_pods.get(
+                    a, (frozenset(), frozenset()))
+                rs, rh = self._remote_tier_pods.get(
+                    a, (frozenset(), frozenset()))
+                slot = frozenset(ls) | frozenset(rs)
+                # Slot beats host: a pod in both tiers counts slot.
+                host = (frozenset(lh) | frozenset(rh)) - slot
+                merged[a] = (slot, host)
+        self._tier_pods = merged
+        self._resident_pods = {a: s | h for a, (s, h) in merged.items()}
+        self._have_residency = (self._have_local_residency
+                                or bool(self._remote_tier_pods))
 
     def note_pick(self, pod_name: str, adapter: str | None) -> None:
         """Count picks that landed OFF a resident replica while one
@@ -390,13 +438,12 @@ class PlacementPlanner:
                 key = (d["action"],)
                 self.decisions_total[key] = (
                     self.decisions_total.get(key, 0) + 1)
-            self._resident_pods = {a: frozenset(p)
-                                   for a, p in resident_pods.items()}
-            self._tier_pods = {
+            self._local_tier_pods = {
                 a: (frozenset(slot_pods.get(a, ())),
                     frozenset(host_pods.get(a, ())))
                 for a in resident_pods}
-            self._have_residency = have_residency
+            self._have_local_residency = have_residency
+            self._rebuild_merged_locked()
         if self.journal is not None:
             for d in decisions:
                 self.journal.emit(events_mod.PLACEMENT_DECISION,

@@ -67,12 +67,12 @@ import aiohttp
 from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
-from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
-from llm_instance_gateway_tpu.gateway import health as health_mod
-from llm_instance_gateway_tpu.gateway import placement as placement_mod
-from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
-from llm_instance_gateway_tpu.gateway import usage as usage_mod
+from llm_instance_gateway_tpu.gateway import statebus as statebus_mod
+from llm_instance_gateway_tpu.gateway.advisors import (
+    AdvisorStack,
+    merge_exposition_blocks,
+)
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.handlers.messages import (
     RequestBody,
@@ -85,6 +85,7 @@ from llm_instance_gateway_tpu.gateway.handlers.server import (
     RequestContext,
     Server,
 )
+from llm_instance_gateway_tpu.gateway.resilience import retry_backoff
 from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics, Timer
 from llm_instance_gateway_tpu import tracing
 
@@ -122,14 +123,16 @@ class GatewayProxy:
         handler_server: Server,
         provider,
         datastore: Datastore,
-        resilience_cfg: "resilience_mod.ResilienceConfig | None" = None,
+        resilience_cfg=None,
         slo_cfg: "slo_mod.SLOConfig | None" = None,
-        health_cfg: "health_mod.HealthConfig | None" = None,
-        usage_cfg: "usage_mod.UsageConfig | None" = None,
-        fairness_cfg: "fairness_mod.FairnessConfig | dict | None" = None,
-        placement_cfg: "placement_mod.PlacementConfig | None" = None,
+        health_cfg=None,
+        usage_cfg=None,
+        fairness_cfg=None,
+        placement_cfg=None,
         blackbox_dir: str | None = None,
         fast_relay: bool = True,
+        pools: dict | None = None,
+        statebus_cfg: "statebus_mod.StateBusConfig | None" = None,
     ):
         self.server = handler_server
         self.provider = provider
@@ -141,60 +144,85 @@ class GatewayProxy:
         # Request tracing (tracing.py): bounded span ring served by
         # /debug/traces; sampling/capacity via LIG_TRACE_* env.
         self.tracer = tracing.Tracer()
-        # Observability control plane (this PR's tentpole): flight
-        # recorder + SLO burn-rate engine + per-replica health scoring.
+        # ONE flight recorder per gateway process; every pool's advisor
+        # stack journals into it (events carry pod/model attributes).
         self.journal = events_mod.EventJournal()
-        self.health = health_mod.HealthScorer(
-            provider=provider, cfg=health_cfg, journal=self.journal)
-        # Active robustness plane (this PR's tentpole): the enforcing
-        # health policy, per-pod circuit breakers, and the retry/hedge
-        # budget the data path below spends.  Upstream outcomes are
-        # recorded THROUGH it so the health scorer and the breaker see
-        # the same signal stream.
-        self.resilience = resilience_mod.ResiliencePlane(
-            self.health, cfg=resilience_cfg, journal=self.journal)
+        # Per-pool advisor stacks (gateway/advisors.py).  A single-pool
+        # gateway gets exactly one stack over its own provider/scheduler
+        # — identical wiring to the historical inline construction.  A
+        # multi-pool front (``pools`` = MultiPoolComponents.pools) gets a
+        # FULL stack per pool: each pool's scheduler carries its own
+        # advisor seams (Python AND native paths) and each pool's handler
+        # core its own fairness admit() gate — the PR-7 "enforcement
+        # INACTIVE" carve-out is gone.
+        self.stacks: dict[str, AdvisorStack] = {}
+        if pools:
+            for name, comps in pools.items():
+                ds = comps.datastore
+                self.stacks[name] = AdvisorStack(
+                    name, comps.provider,
+                    scheduler=comps.scheduler,
+                    server=comps.handler_server,
+                    metrics=self.metrics, journal=self.journal,
+                    resilience_cfg=resilience_cfg, health_cfg=health_cfg,
+                    usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
+                    placement_cfg=placement_cfg,
+                    # Scope this pool's admitted-traffic shares to its own
+                    # models (the shared GatewayMetrics counts everything).
+                    request_filter=(
+                        lambda m, _ds=ds: _ds.fetch_model(m) is not None))
+                if hasattr(comps.provider, "journal"):
+                    comps.provider.journal = self.journal
+            self._default_pool = next(iter(pools))
+            default = getattr(handler_server, "_default", None)
+            if default in self.stacks:
+                self._default_pool = default
+        else:
+            pool_name = "default"
+            get_pool = getattr(datastore, "get_pool", None)
+            if get_pool is not None:
+                try:
+                    pool_name = get_pool().name or pool_name
+                except Exception:
+                    pass
+            self.stacks[pool_name] = AdvisorStack(
+                pool_name, provider,
+                scheduler=getattr(handler_server, "scheduler", None),
+                server=handler_server,
+                metrics=self.metrics, journal=self.journal,
+                resilience_cfg=resilience_cfg, health_cfg=health_cfg,
+                usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
+                placement_cfg=placement_cfg)
+            self._default_pool = pool_name
+            # Scrape failures land in the flight recorder (Provider
+            # emits, throttled); StaticProvider lacks the attribute.
+            if hasattr(provider, "journal"):
+                provider.journal = self.journal
+        # Back-compat aliases: the default pool's planes under the
+        # historical names.  Single-pool deployments (and every existing
+        # caller/test) see exactly the old object graph; the data path
+        # routes per-pod through ``_stack_for_pod`` so multi-pool fronts
+        # feed the RIGHT pool's health scorer and breaker.
+        stack = self.stacks[self._default_pool]
+        self.health = stack.health
+        self.resilience = stack.resilience
+        self.usage = stack.usage
+        self.fairness = stack.fairness
+        self.placement = stack.placement
+        self._pod_stack_cache: dict[str, AdvisorStack] = {}
+        # SLO engine stays gateway-wide: it reads the shared
+        # GatewayMetrics histograms, which span every pool this process
+        # fronts.
         self.slo = slo_mod.SLOEngine(
             self.metrics, cfg=slo_cfg, journal=self.journal,
             on_fast_burn=self._on_fast_burn)
-        # Capacity-attribution rollup (gateway/usage.py): per-{model,
-        # adapter} consumption shares + noisy-neighbor scoring over the
-        # replicas' tpu:adapter_*_total families, journaling transitions
-        # and feeding /debug/usage + the gateway_usage_* exposition.
-        self.usage = usage_mod.UsageRollup(
-            provider, metrics=self.metrics, cfg=usage_cfg,
-            journal=self.journal)
-        # Fairness & quota plane (gateway/fairness.py): the ENFORCEMENT
-        # layer over the usage rollup — pick deprioritization (wired below
-        # as the scheduler's usage_advisor, a strict superset of the
-        # rollup's log-only seam) plus rank-weighted tenant quotas (wired
-        # into the handler core's admit() gate).  log_only (the default)
-        # keeps routing byte-identical.  Config precedence, per FIELD:
-        # explicit CLI flags (fairness_cfg as the overrides dict from
-        # bootstrap.fairness_from_args — pinned, re-applied on every hot
-        # reload) > the pool document's schedulerConfig.fairnessPolicy
-        # section (already parsed into the scheduler's live config;
-        # without this middle step the section would be dead until a hot
-        # reload) > defaults.  A full FairnessConfig (programmatic
-        # callers/tests) is the initial config, reloadable as a whole.
-        fairness_overrides = None
-        if isinstance(fairness_cfg, dict):
-            fairness_overrides, fairness_cfg = fairness_cfg, None
-        if fairness_cfg is None:
-            sched_cfg = getattr(
-                getattr(handler_server, "scheduler", None), "cfg", None)
-            fairness_cfg = getattr(sched_cfg, "fairness", None)
-        self.fairness = fairness_mod.FairnessPolicy(
-            self.usage, cfg=fairness_cfg, journal=self.journal,
-            provider=provider, cli_overrides=fairness_overrides)
-        # Adapter residency & placement plane (gateway/placement.py): the
-        # PlacementPlanner fuses usage shares, the running/waiting split,
-        # and scraped residency tiers into prefetch/evict/migrate
-        # decisions (executed by lora_sidecar --planner-url against
-        # /debug/placement), and serves the scheduler's placement_advisor
-        # seam — log_only (default) keeps routing byte-identical.
-        self.placement = placement_mod.PlacementPlanner(
-            provider, usage=self.usage, cfg=placement_cfg,
-            journal=self.journal)
+        # Replicated control-plane state bus (gateway/statebus.py): the
+        # tick's derived state becomes versioned per-pool snapshots
+        # gossiped between gateway replicas; the merged view overlays the
+        # stacks' advisors so N gateways share one brain.  Peer-less
+        # (the default) it is inert beyond serving /debug/statebus.
+        self.statebus = statebus_mod.StateBus(
+            self.stacks, cfg=statebus_cfg, journal=self.journal)
         # Black-box dump directory + dump-storm cooldown; both env-tunable.
         self.blackbox_dir = (
             blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
@@ -207,49 +235,6 @@ class GatewayProxy:
         # /debug/slo and /debug/health still evaluate on demand).
         self.obs_tick_s = float(os.environ.get("LIG_SLO_TICK_S", "5"))
         self._obs_task: asyncio.Task | None = None
-        # Scrape failures land in the flight recorder (Provider emits,
-        # throttled); StaticProvider and friends simply lack the attribute.
-        if hasattr(provider, "journal"):
-            provider.journal = self.journal
-        # Health/resilience hook on the pick seam (log_only counts,
-        # avoid/strict enforce — gateway/resilience.py).  The
-        # AdmissionController wraps the real scheduler; reach through to
-        # it.  A multi-pool front (MultiPoolServer) has no top-level
-        # scheduler — its pools' schedulers are wired by their own
-        # components; skip here.
-        outer = getattr(handler_server, "scheduler", None)
-        sched = getattr(outer, "_scheduler", outer)
-        if sched is not None and hasattr(sched, "health_advisor"):
-            sched.health_advisor = self.resilience
-        # Usage/fairness seam on the same pick path: the FairnessPolicy
-        # wraps the rollup (note_pick delegates, so log_only counts picks
-        # serving a flagged noisy key with routing byte-identical) and, in
-        # deprioritize/enforce, narrows survivor sets after the health
-        # filter.  The admission-side quota gate rides the handler core;
-        # the AdmissionController reference feeds fairnessPolicy
-        # hot-reloads from the pool document.
-        if sched is not None and hasattr(sched, "usage_advisor"):
-            sched.usage_advisor = self.fairness
-        # Placement seam on the same pick path: log_only counts would-
-        # steer picks; prefer_resident narrows survivors to slot/host-
-        # resident pods (filter_by_placement) after the fairness filter.
-        if sched is not None and hasattr(sched, "placement_advisor"):
-            sched.placement_advisor = self.placement
-        if outer is not None and hasattr(outer, "fairness"):
-            outer.fairness = self.fairness
-        if hasattr(handler_server, "fairness"):
-            handler_server.fairness = self.fairness
-        elif self.fairness.mode != fairness_mod.LOG_ONLY:
-            # A multi-pool front (MultiPoolServer) has no fairness seams:
-            # the admit() gate lives on the per-pool inner servers this
-            # wrapper delegates to, and per-pool wiring is future work
-            # (ROADMAP).  Refuse to leave an enforcing config silently
-            # dead.
-            logger.warning(
-                "fairness mode=%s configured but %s has no fairness "
-                "seams — enforcement is INACTIVE (single-pool "
-                "deployments only)", self.fairness.mode,
-                type(handler_server).__name__)
         # Strong refs to in-flight KV-release tasks (the event loop only
         # keeps weak ones; see _spawn_release).
         self._release_tasks: set = set()
@@ -278,6 +263,9 @@ class GatewayProxy:
         app.router.add_get("/debug/health", self.handle_debug_health)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/placement", self.handle_debug_placement)
+        app.router.add_get("/debug/statebus", self.handle_debug_statebus)
+        app.router.add_post("/statebus/exchange",
+                            self.handle_statebus_exchange)
         app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/v1/models", self.handle_models)
@@ -329,19 +317,46 @@ class GatewayProxy:
         if self._session is not None:
             await self._session.close()
 
+    def control_tick(self) -> None:
+        """One full control-plane pass: every pool's advisor stack
+        (health/breaker, usage shares, fairness quotas, placement), the
+        gateway-wide SLO engine, then the statebus snapshot+apply — the
+        tick-derived state becomes this replica's published snapshot and
+        the freshest peer state overlays the advisors.  Synchronous (no
+        I/O): chaos and tests drive it explicitly; peer exchange is the
+        async half in ``_observability_loop``."""
+        for stack in self.stacks.values():
+            stack.tick()
+        self.slo.tick()
+        self.statebus.tick()
+        # Prune the pod->stack route cache against live membership (the
+        # breaker.prune pattern): pod names are never reused, so without
+        # this the cache grows monotonically under membership churn.
+        if len(self.stacks) > 1 and self._pod_stack_cache:
+            live = set()
+            for stack in self.stacks.values():
+                live |= stack.pod_names()
+            for name in [n for n in self._pod_stack_cache
+                         if n not in live]:
+                del self._pod_stack_cache[name]
+
     async def _observability_loop(self) -> None:
-        """Background evaluation tick: health scores first (cheap, feeds
-        the journal), then the SLO engine (may fire the black-box dump)."""
+        """Background evaluation tick: per-pool advisor stacks first
+        (cheap, feed the journal), the SLO engine (may fire the black-box
+        dump), the statebus snapshot/merge, then the peer push-pull
+        exchange."""
         while True:
             await asyncio.sleep(self.obs_tick_s)
             try:
-                self.resilience.tick()  # health pass + breaker bookkeeping
-                self.slo.tick()
-                self.usage.tick()  # capacity shares + noisy-neighbor flags
-                self.fairness.tick()  # fair shares + tenant quota state
-                self.placement.tick()  # residency fusion + tier decisions
+                self.control_tick()
             except Exception:
                 logger.exception("observability tick failed")
+            try:
+                if self.statebus.cfg.peers and self._session is not None:
+                    await self.statebus.exchange(self._session)
+                    self.statebus.apply()  # fold what the exchange brought
+            except Exception:
+                logger.exception("statebus exchange failed")
 
     def _on_fast_burn(self, model: str, objective: str, burns: dict) -> None:
         """SLO fast-burn hook: snapshot everything into a black-box dump
@@ -387,6 +402,34 @@ class GatewayProxy:
             asyncio.get_running_loop().run_in_executor(None, write)
         except RuntimeError:
             write()  # synchronous contexts (tests, CLI tools)
+
+    # -- per-pool routing of data-path signals -----------------------------
+    def _stack_for_pod(self, pod_name: str) -> AdvisorStack:
+        """The advisor stack owning ``pod_name``.  Single-pool fronts
+        short-circuit to the only stack; multi-pool lookups are cached
+        (pods never migrate between pools — membership churn only adds
+        names)."""
+        if len(self.stacks) == 1:
+            return self.stacks[self._default_pool]
+        stack = self._pod_stack_cache.get(pod_name)
+        if stack is not None:
+            return stack
+        for stack in self.stacks.values():
+            if pod_name in stack.pod_names():
+                self._pod_stack_cache[pod_name] = stack
+                return stack
+        return self.stacks[self._default_pool]
+
+    def _record_upstream(self, pod_name: str, ok: bool,
+                         timeout: bool = False) -> None:
+        """Route an upstream outcome to the owning pool's resilience plane
+        (health scorer + circuit breaker)."""
+        self._stack_for_pod(pod_name).resilience.record_upstream(
+            pod_name, ok, timeout=timeout)
+
+    def _record_handoff(self, pod_name: str, ok: bool) -> None:
+        self._stack_for_pod(pod_name).resilience.record_handoff(
+            pod_name, ok)
 
     # -- request path ------------------------------------------------------
     def _error_response(self, status: int, message: str, kind: str,
@@ -587,7 +630,7 @@ class GatewayProxy:
             self.metrics.record_retry(failure)
             self.journal.emit(events_mod.RETRY, trace_id, pod=pod.name,
                               reason=failure, attempt=attempt)
-            backoff_s = resilience_mod.retry_backoff(
+            backoff_s = retry_backoff(
                 self.resilience.rng, backoff_s or rcfg.backoff_base_s,
                 rcfg.backoff_base_s, rcfg.backoff_cap_s)
             await asyncio.sleep(backoff_s)
@@ -684,12 +727,12 @@ class GatewayProxy:
                     if tk.exception() is None:
                         # The loser also answered: its success still counts
                         # (clears streaks / half-open probe accounting).
-                        self.resilience.record_upstream(owner[tk].name,
+                        self._record_upstream(owner[tk].name,
                                                         ok=True)
                         tk.result().close()
                     else:
                         # The loser's failure still reaches the breaker.
-                        self.resilience.record_upstream(
+                        self._record_upstream(
                             owner[tk].name, ok=False,
                             timeout=isinstance(tk.exception(),
                                                asyncio.TimeoutError))
@@ -701,7 +744,7 @@ class GatewayProxy:
         # Both attempts failed: surface the primary's error (the caller's
         # pod attribution matches), after recording the hedge-side failure.
         self.metrics.record_hedge("failed")
-        self.resilience.record_upstream(
+        self._record_upstream(
             hedge_pod.name, ok=False,
             timeout=isinstance(hedge.exception(), asyncio.TimeoutError))
         raise primary.exception()
@@ -723,7 +766,7 @@ class GatewayProxy:
         hedge_outcome = None
 
         def _failed(reason: str, err, timeout: bool = False):
-            self.resilience.record_upstream(pod.name, ok=False,
+            self._record_upstream(pod.name, ok=False,
                                             timeout=timeout)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id,
                               pod=pod.name, reason=reason,
@@ -778,7 +821,7 @@ class GatewayProxy:
         t_up1 = time.time()
         # 5xx from the replica counts against its health (the server
         # answered, but wrongly); 2xx-4xx reset the error streak.
-        self.resilience.record_upstream(pod.name, ok=status < 500)
+        self._record_upstream(pod.name, ok=status < 500)
         self.tracer.record(trace_id, "gateway.upstream", t_up0, t_up1,
                            pod=pod.name, status=status,
                            **({"hedge": hedge_outcome} if hedge_outcome
@@ -857,7 +900,7 @@ class GatewayProxy:
                     "prefill hop %s returned %d; falling back",
                     prefill_pod.address, pre.status)
                 pre.release()
-                self.resilience.record_handoff(prefill_pod.name, ok=False)
+                self._record_handoff(prefill_pod.name, ok=False)
                 self.tracer.record(
                     trace_id, "gateway.prefill_hop", t_pre0, time.time(),
                     pod=prefill_pod.name, status=pre.status,
@@ -889,7 +932,7 @@ class GatewayProxy:
                     "attach hop %s returned %d; falling back",
                     decode_pod.address, status)
                 upstream.release()
-                self.resilience.record_handoff(decode_pod.name, ok=False)
+                self._record_handoff(decode_pod.name, ok=False)
                 self.tracer.record(
                     trace_id, "gateway.attach_hop", t_att0, time.time(),
                     pod=decode_pod.name, status=status, fallback=True)
@@ -905,7 +948,7 @@ class GatewayProxy:
                 # The attach stream died before its first chunk: the
                 # decode engine holds abandoned work — release it and
                 # fall back single-hop (nothing reached the client).
-                self.resilience.record_handoff(decode_pod.name, ok=False)
+                self._record_handoff(decode_pod.name, ok=False)
                 if engine_req_id:
                     self._spawn_release(decode_pod, engine_req_id, trace_id)
                 self.tracer.record(
@@ -925,7 +968,7 @@ class GatewayProxy:
             # statuses above are treated identically).  The health scorer
             # and breaker DO see it: hop failures are a per-replica
             # degradation signal regardless of the request's final outcome.
-            self.resilience.record_handoff(hop_pod.name, ok=False)
+            self._record_handoff(hop_pod.name, ok=False)
             if hop_pod is decode_pod and engine_req_id:
                 # The decode hop died AFTER the handoff bytes were posted:
                 # the decode engine may have parked (or be decoding) KV
@@ -938,8 +981,8 @@ class GatewayProxy:
                            prefill_pod.address, decode_pod.address, e)
             return None
         t_att1 = time.time()
-        self.resilience.record_handoff(prefill_pod.name, ok=True)
-        self.resilience.record_handoff(decode_pod.name, ok=True)
+        self._record_handoff(prefill_pod.name, ok=True)
+        self._record_handoff(decode_pod.name, ok=True)
         self.tracer.record(trace_id, "gateway.attach_hop", t_att0, t_att1,
                            pod=decode_pod.name, status=status)
         hdr_result = self.server.process(req_ctx, ResponseHeaders())
@@ -1075,7 +1118,7 @@ class GatewayProxy:
             pending = None  # legitimate empty stream: relay it as-is
         except asyncio.TimeoutError:
             upstream.close()
-            self.resilience.record_upstream(pod.name, ok=False, timeout=True)
+            self._record_upstream(pod.name, ok=False, timeout=True)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
                               pod=pod.name, stream=True,
                               error="no first chunk within TTFT budget")
@@ -1088,7 +1131,7 @@ class GatewayProxy:
             return None, "ttft_timeout"
         except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
             upstream.close()
-            self.resilience.record_upstream(pod.name, ok=False)
+            self._record_upstream(pod.name, ok=False)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
                               pod=pod.name, stream=True,
                               error=str(e)[:200] or "stream broke pre-first-"
@@ -1136,7 +1179,7 @@ class GatewayProxy:
                 except (ConnectionResetError, ConnectionError):
                     # The UPSTREAM was serving fine — its streaks/probe
                     # accounting must not dangle on the client's exit.
-                    self.resilience.record_upstream(pod.name, ok=True)
+                    self._record_upstream(pod.name, ok=True)
                     upstream.close()
                     self._client_disconnected(req_ctx, pod, trace_id, t_req,
                                               path, t_up0, t_first)
@@ -1151,7 +1194,7 @@ class GatewayProxy:
             # drops mid-stream — account for the partial request, then let
             # the cancellation propagate (swallowing it would break the
             # server's teardown contract).
-            self.resilience.record_upstream(pod.name, ok=True)
+            self._record_upstream(pod.name, ok=True)
             upstream.close()
             self._client_disconnected(req_ctx, pod, trace_id, t_req,
                                       path, t_up0, t_first)
@@ -1161,7 +1204,7 @@ class GatewayProxy:
             if timed_out:
                 upstream.close()  # the hung read owns the connection
             self.metrics.record_error(req_ctx.model or None)
-            self.resilience.record_upstream(pod.name, ok=False,
+            self._record_upstream(pod.name, ok=False,
                                             timeout=timed_out)
             self.journal.emit(events_mod.UPSTREAM_ERROR, trace_id or "",
                               pod=pod.name, stream=True,
@@ -1187,7 +1230,7 @@ class GatewayProxy:
                 raise
             return resp, None
         t_end = time.time()
-        self.resilience.record_upstream(pod.name, ok=True)
+        self._record_upstream(pod.name, ok=True)
         if fast:
             last_data_line = final_data_line(b"".join(tail))
         try:
@@ -1214,11 +1257,18 @@ class GatewayProxy:
     def _render_metrics(self) -> str:
         """The full gateway exposition page: request-path counters and
         histograms (GatewayMetrics) plus the observability control plane's
-        families — SLO gauges, per-pod health, and the event counters."""
+        families — SLO gauges, per-pool advisor stacks (health, circuits,
+        usage, fairness, placement — merged so shared families keep one
+        ``# TYPE`` line and per-stack scalar counters sum), the statebus,
+        and the event counters."""
         text = self.metrics.render()
-        extra = (self.slo.render() + self.health.render()
-                 + self.resilience.render() + self.usage.render()
-                 + self.fairness.render() + self.placement.render()
+        if len(self.stacks) == 1:
+            stack_lines = self.stacks[self._default_pool].render()
+        else:
+            stack_lines = merge_exposition_blocks(
+                [stack.render() for stack in self.stacks.values()])
+        extra = (self.slo.render() + stack_lines
+                 + self.statebus.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -1248,10 +1298,18 @@ class GatewayProxy:
         counters, plus the resilience plane (policy, per-pod circuit
         states, retry budget).  Floored at the configured cadence: the
         dwell-tick hysteresis counts update PASSES, so a fast poller must
-        not drive transitions."""
-        self.health.maybe_update(max(1.0, self.obs_tick_s))
+        not drive transitions.  Multi-pool fronts add a ``pools`` section
+        (one health+resilience payload per pool) next to the default
+        pool's top-level fields."""
+        for stack in self.stacks.values():
+            stack.health.maybe_update(max(1.0, self.obs_tick_s))
         payload = self.health.debug_payload()
         payload["resilience"] = self.resilience.debug_payload()
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: dict(stack.health.debug_payload(),
+                           resilience=stack.resilience.debug_payload())
+                for name, stack in self.stacks.items()}
         return web.json_response(payload)
 
     async def handle_debug_usage(self, request: web.Request) -> web.Response:
@@ -1260,27 +1318,93 @@ class GatewayProxy:
         pool-waste aggregates (gateway/usage.py; rendered live by
         ``tools/lig_top.py``) — plus the fairness plane's throttle and
         demotion state (gateway/fairness.py).  Floored at the configured
-        cadence — the enter/exit hysteresis counts rollup passes."""
-        self.usage.maybe_tick(max(1.0, self.obs_tick_s))
+        cadence — the enter/exit hysteresis counts rollup passes.
+        Multi-pool fronts add a ``pools`` section (one usage+fairness+
+        residency payload per pool) next to the default pool's top-level
+        fields."""
+        for stack in self.stacks.values():
+            stack.usage.maybe_tick(max(1.0, self.obs_tick_s))
         payload = self.usage.debug_payload()
         payload["fairness"] = self.fairness.debug_payload()
         # Residency alongside the usage shares (pod -> adapter -> tier):
         # lig-top renders WHERE each tenant's weights live next to what
         # they consume.
         payload["residency"] = self.placement.debug_payload()["residency"]
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: dict(
+                    stack.usage.debug_payload(),
+                    fairness=stack.fairness.debug_payload(),
+                    residency=stack.placement.debug_payload()["residency"])
+                for name, stack in self.stacks.items()}
         return web.json_response(payload)
 
     async def handle_debug_placement(self, request: web.Request) -> web.Response:
         """The placement plane's state + this tick's decisions — the wire
         ``tools/lora_sidecar.py --planner-url`` polls.  Floored at the
         configured cadence like the other debug surfaces (idle dwell
-        counts planner passes)."""
-        self.usage.maybe_tick(max(1.0, self.obs_tick_s))
-        if (self.placement.ticks == 0
-                or time.time() - self.placement.last_tick
-                >= max(1.0, self.obs_tick_s)):
-            self.placement.tick()
-        return web.json_response(self.placement.debug_payload())
+        counts planner passes).  Multi-pool fronts add a ``pools``
+        section (one planner payload per pool) — a sidecar polls with
+        ``?pool=<name>`` to read exactly its pool's slice."""
+        for stack in self.stacks.values():
+            stack.usage.maybe_tick(max(1.0, self.obs_tick_s))
+            if (stack.placement.ticks == 0
+                    or time.time() - stack.placement.last_tick
+                    >= max(1.0, self.obs_tick_s)):
+                stack.placement.tick()
+        pool = request.query.get("pool")
+        if pool:
+            stack = self.stacks.get(pool)
+            if stack is None:
+                return web.json_response(
+                    {"error": f"unknown pool {pool!r}",
+                     "pools": sorted(self.stacks)}, status=404)
+            return web.json_response(stack.placement.debug_payload())
+        payload = self.placement.debug_payload()
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: stack.placement.debug_payload()
+                for name, stack in self.stacks.items()}
+        return web.json_response(payload)
+
+    async def handle_debug_statebus(self,
+                                    request: web.Request) -> web.Response:
+        """The replicated state plane's view: this replica's local
+        snapshot, every known replica's versions/ages, and the merged
+        per-pool overlay the advisors currently apply —
+        ``tools/statebus_report.py`` renders the divergence table."""
+        return web.json_response(self.statebus.debug_payload())
+
+    async def handle_statebus_exchange(
+            self, request: web.Request) -> web.Response:
+        """Push-pull gossip endpoint: a peer POSTs the snapshot docs it
+        knows (its own + transitively learned ones); we merge them and
+        answer with OUR full doc set, so one round trip equalizes both
+        sides even across replicas that never talk directly.
+
+        A gateway with NO peers configured refuses the exchange: the
+        statebus's peer-less contract is "inert beyond /debug/statebus",
+        and merged docs steer enforcement — an open endpoint would let
+        any client that can reach the port flag tenants noisy or mark
+        every pod avoided.  (With peers configured, restrict reachability
+        of this port to the gateway fleet — the gossip wire carries no
+        authentication, like the rest of the gateway's surfaces.)"""
+        if not self.statebus.cfg.peers:
+            return web.json_response(
+                {"error": "statebus has no peers configured "
+                          "(--statebus-peer); exchange refused"},
+                status=403)
+        try:
+            docs = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response({"error": "malformed docs"},
+                                     status=400)
+        if not isinstance(docs, list):
+            return web.json_response({"error": "expected a doc list"},
+                                     status=400)
+        self.statebus.merge(docs)
+        self.statebus.apply()
+        return web.json_response(self.statebus.all_docs())
 
     async def handle_debug_events(self, request: web.Request) -> web.Response:
         """The flight recorder: ``?since=<seq>`` incremental cursor,
@@ -1313,6 +1437,7 @@ def main(argv: list[str] | None = None) -> None:
                              "A/B axis for byte-parity and perf checks)")
     bootstrap.add_common_args(parser)
     bootstrap.add_resilience_args(parser)
+    bootstrap.add_statebus_args(parser)
     args = parser.parse_args(argv)
 
     comps = bootstrap.components_from_args(args)
@@ -1320,7 +1445,10 @@ def main(argv: list[str] | None = None) -> None:
                          resilience_cfg=bootstrap.resilience_from_args(args),
                          fairness_cfg=bootstrap.fairness_from_args(args),
                          placement_cfg=bootstrap.placement_from_args(args),
-                         fast_relay=not args.no_fast_relay)
+                         fast_relay=not args.no_fast_relay,
+                         pools=getattr(comps, "pools", None),
+                         statebus_cfg=bootstrap.statebus_from_args(
+                             args, port=args.port))
     try:
         web.run_app(proxy.build_app(), port=args.port)
     finally:

@@ -363,6 +363,13 @@ class ResiliencePlane:
             cap=self.cfg.retry_budget_cap)
         self.rng = rng or random.Random()
         self.escape_hatch_total = 0
+        # Peer-gateway avoid overlay (statebus merged view): pods some
+        # OTHER replica's health scorer or breaker currently avoids.
+        # Unioned into ``avoid_set``/``should_avoid`` so a replica that
+        # has not yet observed a pod failing still steers off it; local
+        # detection state never includes these (each replica gossips only
+        # its own observations).
+        self._remote_avoid: frozenset = frozenset()
 
     # -- scheduler advisor seam -------------------------------------------
     @property
@@ -379,14 +386,15 @@ class ResiliencePlane:
         """True when enforcing policy should steer picks off this pod:
         health state degraded/unhealthy, or the circuit is not admitting
         (open inside cooldown, or half-open with its probe quota full)."""
+        if pod_name in self._remote_avoid:
+            return True
         if self.health.state(pod_name) != health_mod.HEALTHY:
             return True
         return not self.breaker.allow(pod_name)
 
-    def avoid_set(self) -> frozenset:
-        """Batch form of ``should_avoid`` — the pick seam calls this once
-        per candidate set; both sides serve cached frozensets, so the
-        healthy-pool common case is two attribute reads."""
+    def local_avoid_set(self) -> frozenset:
+        """This replica's OWN avoid set (health + breaker, no peer
+        overlay) — what the statebus publishes to peers."""
         bad_health = self.health.non_healthy()
         bad_circuit = self.breaker.blocked_set()
         if not bad_circuit:
@@ -394,6 +402,23 @@ class ResiliencePlane:
         if not bad_health:
             return bad_circuit
         return bad_health | bad_circuit
+
+    def set_remote_avoid(self, pods) -> None:
+        """Statebus seam: replace the peer-derived avoid overlay (empty =
+        local-only fallback)."""
+        self._remote_avoid = frozenset(pods)
+
+    def avoid_set(self) -> frozenset:
+        """Batch form of ``should_avoid`` — the pick seam calls this once
+        per candidate set; both sides serve cached frozensets, so the
+        healthy-pool common case is two attribute reads (plus one overlay
+        emptiness test)."""
+        local = self.local_avoid_set()
+        if not self._remote_avoid:
+            return local
+        if not local:
+            return self._remote_avoid
+        return local | self._remote_avoid
 
     def note_escape_hatch(self) -> None:
         """Every tree survivor was avoidable; the pick proceeded over the

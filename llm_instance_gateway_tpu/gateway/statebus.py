@@ -1,0 +1,448 @@
+"""Replicated control-plane state bus: N gateways, one brain.
+
+Everything the observability tick derives — usage shares and noisy
+flags, health/circuit avoid sets, placement resident maps, fairness
+bucket levels — historically lived in ONE proxy process.  At the
+million-user scale the ROADMAP targets, a single gateway replica is both
+the throughput bottleneck and a SPOF; the reference solves the analogous
+problem with a reconciler/datastore layer every picker reads (PAPER.md
+backend layer), and MinT (arxiv 2605.13779) is the managed-control-plane
+scale target.  This module is that layer for the standalone gateway:
+
+- **Snapshots**: each observability tick, every pool's advisor stack
+  (``gateway/advisors.py``) contributes its LOCALLY-derived state to a
+  versioned per-replica document — ``(replica_id, tick_seq)`` monotonic
+  versions, one doc per replica, per-pool key families inside
+  (``noisy`` / ``avoid`` / ``resident`` / ``buckets`` / ``shares``).
+- **Gossip**: replicas exchange docs over a small HTTP push-pull
+  (``POST /statebus/exchange``: send every doc you know, receive every
+  doc the peer knows) — one round trip equalizes both sides, and
+  transitively-learned docs mean a line topology still converges.
+  Merge is last-writer-wins per replica (highest ``seq``), so a key
+  family is owned by exactly one replica's detection logic and can
+  never ping-pong.
+- **Merged view**: the freshest doc per peer (staleness-bounded) folds
+  into per-pool overlays the advisors already know how to wear —
+  ``usage.set_remote_noisy`` / ``resilience.set_remote_avoid`` /
+  ``placement.set_remote_resident`` — so BOTH scheduler paths (the
+  Python filter chain and the native snapshot marshals) see peer state
+  through the exact seams the PR-9 lint already guards, with zero
+  scheduler changes.
+- **Global fairness**: with N live replicas spraying one tenant's
+  traffic, each replica's token buckets refill at ``quota_rps / N``
+  (``fairness.set_quota_scale``) — the fleet-wide admission rate for a
+  throttled tenant stays what the operator configured.
+- **Staleness fallback**: when every peer goes quiet past
+  ``staleness_s``, the overlays empty and enforcement degrades to
+  local-only — journaled as ``statebus_stale``, with ``statebus_rejoin``
+  when fresh peer state returns.  A partitioned replica keeps serving
+  (the ``replica_partition`` chaos scenario pins zero 5xx through the
+  partition and rejoin within 2 ticks).
+
+``tools/statebus_report.py`` renders the merged-vs-local divergence per
+replica from ``/debug/statebus``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass
+
+import aiohttp
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import (
+    Histogram,
+    escape_label,
+    render_counter,
+    render_histogram,
+)
+
+# Merge cost is µs-scale dict folding; the pick-latency buckets fit.
+MERGE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                 5e-3, 1e-2, 5e-2)
+
+
+@dataclass(frozen=True)
+class StateBusConfig:
+    """Knobs for the replicated state plane (flags:
+    ``bootstrap.add_statebus_args``)."""
+
+    # This gateway's identity on the bus.  Empty = a random stable id is
+    # minted at construction (bootstrap defaults to host:port).
+    replica_id: str = ""
+    # Peer gateway base URLs (e.g. ``http://gw-1:8081``); empty = the
+    # bus is inert beyond local snapshots and /debug/statebus.
+    peers: tuple = ()
+    # A replica's doc older than this (by local receive time) drops out
+    # of the merged view; when EVERY peer is stale the bus falls back to
+    # local-only enforcement (journaled).
+    staleness_s: float = 15.0
+    # Per-peer exchange round-trip bound.
+    exchange_timeout_s: float = 2.0
+    # Divide fairness token buckets by the live replica count so tenant
+    # quotas hold fleet-wide (False: every replica enforces the full
+    # quota locally — N x over-admission under spraying).
+    partition_quota: bool = True
+    # A replica whose snapshot ages past ``evict_factor x staleness_s``
+    # is FORGOTTEN entirely (doc dropped, stops being regossiped, its
+    # snapshot-age series ends).  Replica identities default to
+    # host:port — pod churn mints new ones, and without eviction the
+    # doc set, the exchange payload, and the metric cardinality grow
+    # monotonically fleet-wide.  Well past the staleness bound so a
+    # partitioned replica's doc survives long enough to version-compare
+    # on rejoin.
+    evict_factor: float = 10.0
+
+    def __post_init__(self):
+        if self.staleness_s <= 0 or self.exchange_timeout_s <= 0:
+            raise ValueError("statebus staleness/timeout must be > 0")
+        if self.evict_factor < 2.0:
+            raise ValueError("statebus evict_factor must be >= 2 "
+                             "(eviction inside the staleness window "
+                             "would flap stale/rejoin)")
+
+
+class StateBus:
+    """The replicated state plane over one gateway's per-pool advisor
+    stacks.  Thread-safe: the observability tick, the exchange endpoint
+    (event loop), and /debug readers all touch it."""
+
+    def __init__(self, stacks: dict, cfg: StateBusConfig | None = None,
+                 journal: "events_mod.EventJournal | None" = None,
+                 clock=time.time):
+        self.stacks = stacks
+        self.cfg = cfg or StateBusConfig()
+        self.replica_id = self.cfg.replica_id or f"gw-{uuid.uuid4().hex[:8]}"
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        # Boot epoch: a restarted replica reuses its id but restarts its
+        # seq counter at 1 — without an epoch, peers holding its OLD doc
+        # reject the fresh ones until seq catches up (one tick per unit,
+        # i.e. a rejoin stall exactly as long as the previous uptime in
+        # ticks).  Versions compare as (boot, seq): a newer boot always
+        # wins for the same replica id.  Found in the live two-proxy
+        # restart drill, not the in-process tests — same-process rigs
+        # never re-mint a bus.
+        self._boot = round(self._clock(), 6)
+        # replica -> {"doc": versioned snapshot, "recv_ts": local clock at
+        # acceptance}.  Staleness is judged by LOCAL receive time, never
+        # the doc's own ``ts`` — peer clocks may skew, and a replica that
+        # stopped talking is stale regardless of what its clock claimed.
+        self._docs: dict[str, dict] = {}
+        self._ever_saw_peer = False
+        self._stale = False
+        # Exported state.
+        self.merge_hist = Histogram(MERGE_BUCKETS)
+        self.stale_fallbacks_total = 0
+        self.exchanges: dict[str, int] = {}
+        self.last_apply_scale = 1.0
+
+    # -- snapshot (publish side) -------------------------------------------
+    def snapshot(self) -> dict:
+        """Build + store this replica's versioned doc from every stack's
+        LOCAL state (remote overlays are never re-published — each key
+        family has exactly one owning replica)."""
+        pools: dict[str, dict] = {}
+        for name, stack in self.stacks.items():
+            resident = stack.placement.local_resident_map() or {}
+            pools[name] = {
+                "noisy": {n: list(k)
+                          for n, k in stack.usage.local_noisy_keys().items()},
+                "avoid": sorted(stack.resilience.local_avoid_set()),
+                "resident": {a: [sorted(s), sorted(h)]
+                             for a, (s, h) in resident.items()},
+                "buckets": stack.fairness.bucket_levels(),
+                "shares": [[m, a, round(v, 4)] for (m, a), v in
+                           sorted(stack.usage.shares_snapshot().items())],
+            }
+        now = self._clock()
+        with self._lock:
+            self._seq += 1
+            doc = {"replica": self.replica_id, "boot": self._boot,
+                   "seq": self._seq, "ts": round(now, 6), "pools": pools}
+            self._docs[self.replica_id] = {"doc": doc, "recv_ts": now}
+        return doc
+
+    # -- merge (gossip receive side) ---------------------------------------
+    def merge(self, docs: list[dict]) -> int:
+        """Fold peer docs in: last-writer-wins per replica by
+        ``(boot, seq)`` — seq orders one process lifetime, the boot
+        epoch orders RESTARTS of the same replica id (a restarted
+        replica's seq resets to 1; without the epoch its fresh docs
+        would lose to its own pre-restart ghost).  Malformed entries are
+        skipped (a hostile/buggy peer must not poison the bus).
+        Returns how many docs were accepted."""
+        t0 = time.perf_counter()
+        now = self._clock()
+        accepted = 0
+        with self._lock:
+            for doc in docs or ():
+                if not isinstance(doc, dict):
+                    continue
+                replica = doc.get("replica")
+                seq = doc.get("seq")
+                boot = doc.get("boot", 0.0)
+                pools = doc.get("pools")
+                if (not isinstance(replica, str) or not replica
+                        or not isinstance(seq, int)
+                        or not isinstance(boot, (int, float))
+                        or not isinstance(pools, dict)
+                        or any(not isinstance(p, dict)
+                               for p in pools.values())):
+                    continue
+                if replica == self.replica_id:
+                    continue  # our own state gossiped back
+                cur = self._docs.get(replica)
+                if cur is not None and (
+                        cur["doc"].get("boot", 0.0),
+                        cur["doc"]["seq"]) >= (boot, seq):
+                    continue
+                self._docs[replica] = {"doc": doc, "recv_ts": now}
+                self._ever_saw_peer = True
+                accepted += 1
+        self.merge_hist.observe(time.perf_counter() - t0)
+        return accepted
+
+    def all_docs(self) -> list[dict]:
+        """Every doc this replica knows (its own + learned) — the
+        push-pull payload; transitive gossip rides on this."""
+        with self._lock:
+            return [e["doc"] for e in self._docs.values()]
+
+    # -- merged view (apply side) ------------------------------------------
+    def _fresh_remote(self, now: float) -> dict[str, dict]:
+        """replica -> doc for peers within the staleness bound (caller
+        need not hold the lock; the dict is a copy)."""
+        bound = self.cfg.staleness_s
+        with self._lock:
+            return {r: e["doc"] for r, e in self._docs.items()
+                    if r != self.replica_id and now - e["recv_ts"] <= bound}
+
+    @staticmethod
+    def merged_overlays(pool: str, docs: dict[str, dict]) -> dict:
+        """Fold the fresh peer docs into one pool's overlay: noisy-name
+        union, avoid-set union, resident-map per-tier union.
+
+        Every inner family is type-checked before use: ``merge`` vets
+        doc shape down to the pool dicts only, and an overlay raise here
+        would freeze apply()/tick() fleet-wide on every pass until the
+        poisoned doc evicts — a hostile/buggy peer degrades to being
+        ignored, never to breaking the bus."""
+        noisy: dict[str, tuple] = {}
+        avoid: set[str] = set()
+        resident: dict[str, tuple] = {}
+        for doc in docs.values():
+            p = doc.get("pools", {}).get(pool)
+            if not isinstance(p, dict):
+                continue
+            fam = p.get("noisy")
+            if isinstance(fam, dict):
+                for name, key in fam.items():
+                    if (isinstance(name, str)
+                            and isinstance(key, (list, tuple))
+                            and len(key) == 2):
+                        noisy[name] = tuple(key)
+            fam = p.get("avoid")
+            if isinstance(fam, (list, tuple)):
+                avoid.update(x for x in fam if isinstance(x, str))
+            fam = p.get("resident")
+            if isinstance(fam, dict):
+                for a, tiers in fam.items():
+                    if not (isinstance(a, str)
+                            and isinstance(tiers, (list, tuple))
+                            and len(tiers) == 2
+                            and all(isinstance(t, (list, tuple))
+                                    for t in tiers)):
+                        continue
+                    cs, ch = resident.get(a, (frozenset(), frozenset()))
+                    slot = cs | frozenset(
+                        x for x in tiers[0] if isinstance(x, str))
+                    host = (ch | frozenset(
+                        x for x in tiers[1] if isinstance(x, str))) - slot
+                    resident[a] = (slot, host)
+        return {"noisy": noisy, "avoid": frozenset(avoid),
+                "resident": resident}
+
+    def apply(self, now: float | None = None) -> None:
+        """Overlay the merged peer view onto every stack's advisors and
+        partition the fairness quota by the live replica count.  When all
+        peers are stale the overlays empty — local-only enforcement —
+        with the ``statebus_stale`` / ``statebus_rejoin`` transitions
+        journaled exactly once each."""
+        now = self._clock() if now is None else now
+        # Forget long-dead replica identities (pod churn mints new
+        # host:port ids): their docs stop being regossiped and their
+        # snapshot-age series end.  ``_ever_saw_peer`` stays true — a
+        # fleet member whose peers ALL died is still degraded, not a
+        # born-single replica.
+        bound = self.cfg.evict_factor * self.cfg.staleness_s
+        with self._lock:
+            for rid in [r for r, e in self._docs.items()
+                        if r != self.replica_id
+                        and now - e["recv_ts"] > bound]:
+                del self._docs[rid]
+        fresh = self._fresh_remote(now)
+        if self._ever_saw_peer:
+            if not fresh and not self._stale:
+                self._stale = True
+                self.stale_fallbacks_total += 1
+                if self.journal is not None:
+                    self.journal.emit(events_mod.STATEBUS_STALE,
+                                      replica=self.replica_id,
+                                      known_peers=len(self._docs) - 1)
+            elif fresh and self._stale:
+                self._stale = False
+                if self.journal is not None:
+                    self.journal.emit(events_mod.STATEBUS_REJOIN,
+                                      replica=self.replica_id,
+                                      peers=len(fresh))
+        live = len(fresh) + 1
+        scale = (1.0 / live) if self.cfg.partition_quota else 1.0
+        self.last_apply_scale = scale
+        for pool, stack in self.stacks.items():
+            overlay = self.merged_overlays(pool, fresh)
+            stack.usage.set_remote_noisy(overlay["noisy"])
+            stack.resilience.set_remote_avoid(overlay["avoid"])
+            stack.placement.set_remote_resident(overlay["resident"])
+            stack.fairness.set_quota_scale(scale)
+
+    def tick(self) -> None:
+        """The synchronous half of the bus, run from the observability
+        tick: publish this replica's snapshot, then apply the freshest
+        merged view.  Peer exchange (the async half) happens separately
+        — in-process rigs drive ``exchange_with`` instead."""
+        self.snapshot()
+        self.apply()
+
+    @property
+    def stale(self) -> bool:
+        return self._stale
+
+    def live_replicas(self, now: float | None = None) -> int:
+        now = self._clock() if now is None else now
+        return len(self._fresh_remote(now)) + 1
+
+    # -- transports ---------------------------------------------------------
+    async def exchange(self, session: aiohttp.ClientSession) -> None:
+        """One push-pull round with every configured peer, CONCURRENTLY:
+        POST our full doc set, merge whatever each peer answers.  Peer
+        rounds are independent, so the wall cost of a partition is ONE
+        exchange timeout, not one per dead peer — a serial walk would
+        stall the observability loop ~2 s x peers exactly when fast-burn
+        detection matters most.  Failures count, never raise: a dead
+        peer degrades to staleness, not an exception."""
+        docs = self.all_docs()
+        timeout = aiohttp.ClientTimeout(total=self.cfg.exchange_timeout_s)
+
+        async def one(peer: str) -> None:
+            url = peer.rstrip("/") + "/statebus/exchange"
+            try:
+                async with session.post(url, json=docs,
+                                        timeout=timeout) as resp:
+                    if resp.status == 200:
+                        self.merge(await resp.json())
+                        self.exchanges["ok"] = self.exchanges.get(
+                            "ok", 0) + 1
+                    else:
+                        self.exchanges["error"] = self.exchanges.get(
+                            "error", 0) + 1
+            except (aiohttp.ClientError, OSError, ValueError,
+                    TimeoutError, asyncio.TimeoutError):
+                self.exchanges["error"] = self.exchanges.get(
+                    "error", 0) + 1
+
+        await asyncio.gather(*(one(p) for p in self.cfg.peers))
+
+    def exchange_with(self, other: "StateBus") -> None:
+        """In-process push-pull (tests, chaos, loadgen replicas in one
+        process): both sides end up knowing the union of both doc sets —
+        the same post-condition one HTTP round trip produces."""
+        other.merge(self.all_docs())
+        self.merge(other.all_docs())
+
+    # -- export -------------------------------------------------------------
+    def render(self) -> list[str]:
+        """The ``gateway_statebus_*`` families."""
+        now = self._clock()
+        with self._lock:
+            ages = {r: max(0.0, now - e["recv_ts"])
+                    for r, e in self._docs.items()}
+            stale_total = self.stale_fallbacks_total
+            exchanges = dict(self.exchanges)
+        fresh_peers = sum(1 for r, age in ages.items()
+                          if r != self.replica_id
+                          and age <= self.cfg.staleness_s)
+        lines = ["# TYPE gateway_statebus_peers gauge",
+                 f"gateway_statebus_peers {fresh_peers}"]
+        lines.append("# TYPE gateway_statebus_snapshot_age_seconds gauge")
+        for replica in sorted(ages):
+            lines.append(
+                'gateway_statebus_snapshot_age_seconds{replica="%s"} %.3f'
+                % (escape_label(replica), ages[replica]))
+        lines += render_histogram("gateway_statebus_merge_seconds",
+                                  self.merge_hist)
+        lines += ["# TYPE gateway_statebus_stale_fallbacks_total counter",
+                  f"gateway_statebus_stale_fallbacks_total {stale_total}"]
+        lines += render_counter("gateway_statebus_exchanges_total",
+                                exchanges, "outcome")
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/statebus`` body: per-replica versions/ages, this
+        replica's local snapshot, and the merged overlay currently worn
+        by the advisors — ``tools/statebus_report.py``'s input."""
+        now = self._clock()
+        fresh = self._fresh_remote(now)
+        with self._lock:
+            replicas = {
+                r: {"seq": e["doc"]["seq"],
+                    "age_s": round(max(0.0, now - e["recv_ts"]), 3),
+                    "fresh": r == self.replica_id or r in fresh,
+                    "pools": sorted(e["doc"].get("pools", {}))}
+                for r, e in sorted(self._docs.items())}
+            local = self._docs.get(self.replica_id)
+            local_pools = dict(local["doc"]["pools"]) if local else {}
+        merged = {}
+        for pool in self.stacks:
+            overlay = self.merged_overlays(pool, fresh)
+            merged[pool] = {
+                "noisy": {n: list(k) for n, k in overlay["noisy"].items()},
+                "avoid": sorted(overlay["avoid"]),
+                "resident": {a: [sorted(s), sorted(h)]
+                             for a, (s, h) in overlay["resident"].items()},
+            }
+        # Fleet quota view: every replica's bucket levels per pool (own
+        # + fresh peers) — statebus_report renders the per-tenant fleet
+        # spend next to each replica's partition.
+        fleet: dict[str, dict] = {}
+        all_fresh = dict(fresh)
+        if local is not None:
+            all_fresh[self.replica_id] = local["doc"]
+        for rid, doc in all_fresh.items():
+            for pool, fams in doc.get("pools", {}).items():
+                buckets = fams.get("buckets")
+                if isinstance(buckets, list) and buckets:
+                    fleet.setdefault(pool, {})[rid] = buckets
+        return {
+            "replica": self.replica_id,
+            "seq": self._seq,
+            "stale": self._stale,
+            "quota_scale": self.last_apply_scale,
+            "live_replicas": len(fresh) + 1,
+            "peers": list(self.cfg.peers),
+            "replicas": replicas,
+            "local": local_pools,
+            "merged": merged,
+            "fleet_buckets": fleet,
+            "counters": {
+                "stale_fallbacks_total": self.stale_fallbacks_total,
+                "exchanges": dict(self.exchanges),
+            },
+            "config": asdict(self.cfg),
+        }

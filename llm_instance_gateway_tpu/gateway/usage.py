@@ -75,12 +75,18 @@ class UsageRollup:
 
     def __init__(self, provider, metrics=None, cfg: UsageConfig | None = None,
                  journal: events_mod.EventJournal | None = None,
-                 clock=time.time):
+                 clock=time.time, request_filter=None):
         self.provider = provider
         self.metrics = metrics  # GatewayMetrics (admitted-traffic source)
         self.cfg = cfg or UsageConfig()
         self.journal = journal
         self._clock = clock
+        # Multi-pool fronts share ONE GatewayMetrics across per-pool
+        # rollups; the filter scopes the admitted-traffic deltas to this
+        # pool's model names so pool B's requests never dilute (or
+        # inflate, via the unclaimed-leftover split) pool A's traffic
+        # shares.  None = claim everything (single-pool, unchanged).
+        self._request_filter = request_filter
         self._lock = threading.Lock()
         self._prev_totals: dict[str, dict] = {r: {} for r in RESOURCES}
         self._prev_requests: dict[str, float] = {}
@@ -97,6 +103,13 @@ class UsageRollup:
         # counter attributes throttle candidates to the actual offender.
         self._noisy_models: frozenset = frozenset()
         self._noisy_key_of: dict[str, tuple] = {}
+        # Peer-gateway noisy flags (statebus merged view): name -> key
+        # overlay unioned into ``_noisy_models`` so the pick seams treat a
+        # tenant flagged ANYWHERE in the replica set as flagged here.
+        # Local detection state (``_states``) never includes these — each
+        # replica gossips only what it derived itself, so a flag can't
+        # ping-pong between replicas after the origin clears it.
+        self._remote_noisy: dict[str, tuple] = {}
         self.last_tick = 0.0
         self.ticks = 0
         self.would_deprioritize_total = 0
@@ -150,6 +163,9 @@ class UsageRollup:
             snap = getattr(self.metrics, "requests_snapshot", None)
             requests = snap() if snap is not None else dict(
                 self.metrics.requests_total)
+            if self._request_filter is not None:
+                requests = {m: v for m, v in requests.items()
+                            if self._request_filter(m)}
         cfg = self.cfg
         transitions = []
         with self._lock:
@@ -270,7 +286,8 @@ class UsageRollup:
                 (model if adapter == BASE else adapter): (model, adapter)
                 for (model, adapter), st in self._states.items()
                 if st == NOISY}
-            self._noisy_models = frozenset(self._noisy_key_of)
+            self._noisy_models = frozenset(
+                self._noisy_key_of) | frozenset(self._remote_noisy)
         for key, frm, to, score, share in transitions:
             if self.journal is not None:
                 self.journal.emit(events_mod.NOISY_NEIGHBOR,
@@ -286,7 +303,7 @@ class UsageRollup:
         this observable the way health_policy promoted note_pick."""
         if model is None:
             return
-        key = self._noisy_key_of.get(model)
+        key = self._noisy_key_of.get(model) or self._remote_noisy.get(model)
         if key is None:
             return
         with self._lock:
@@ -308,7 +325,26 @@ class UsageRollup:
         with self._lock:
             self._states[(model, adapter)] = NOISY
             self._noisy_key_of[name] = (model, adapter)
-            self._noisy_models = frozenset(self._noisy_key_of)
+            self._noisy_models = frozenset(
+                self._noisy_key_of) | frozenset(self._remote_noisy)
+
+    def set_remote_noisy(self, noisy: dict[str, tuple]) -> None:
+        """Statebus seam: replace the peer-derived noisy overlay with the
+        merged view's ``{request name: (model, adapter)}`` mapping (empty
+        = local-only fallback).  The merged frozenset swaps identity so
+        the native scheduler's noisy-mark snapshot re-marshals on the
+        next pick, exactly like a local flag transition."""
+        with self._lock:
+            self._remote_noisy = dict(noisy)
+            self._noisy_models = frozenset(
+                self._noisy_key_of) | frozenset(self._remote_noisy)
+
+    def local_noisy_keys(self) -> dict[str, tuple]:
+        """LOCALLY-derived flags only (``{name: (model, adapter)}``) — the
+        statebus publishes these, never the remote overlay, so a flag is
+        owned by exactly one replica's detection hysteresis."""
+        with self._lock:
+            return dict(self._noisy_key_of)
 
     def shares_snapshot(self) -> dict:
         """Locked copy of the step-seconds EMA shares keyed by

@@ -162,6 +162,24 @@ GATEWAY_FAMILIES = (
            "prefer_resident last-resort escapes: the adapter was resident "
            "in the pool but on no candidate, so the full set served.",
            GATEWAY_SURFACE),
+    Family("gateway_statebus_peers", "gauge", (),
+           "Gateway replicas with a FRESH statebus snapshot (self "
+           "excluded); 0 with peers configured means local-only "
+           "enforcement fallback (gateway/statebus.py).", GATEWAY_SURFACE),
+    Family("gateway_statebus_snapshot_age_seconds", "gauge", ("replica",),
+           "Age of each known replica's newest statebus snapshot (local "
+           "receive clock; own replica included at ~0).", GATEWAY_SURFACE),
+    Family("gateway_statebus_merge_seconds", "histogram", (),
+           "Statebus merge latency per received doc batch (gossip fold, "
+           "not the network round trip).", GATEWAY_SURFACE),
+    Family("gateway_statebus_stale_fallbacks_total", "counter", (),
+           "Transitions into local-only enforcement because every peer "
+           "snapshot aged past the staleness bound (journaled as "
+           "statebus_stale; recovery journals statebus_rejoin).",
+           GATEWAY_SURFACE),
+    Family("gateway_statebus_exchanges_total", "counter", ("outcome",),
+           "Peer push-pull exchange attempts by outcome (ok | error).",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),

@@ -586,6 +586,106 @@ def test_fairness_empty_state_still_lints():
     assert "gateway_tenant_quota_remaining" not in families
 
 
+def loaded_statebus():
+    """A REAL StateBus over one advisor stack, with a hostile replica id
+    on the wire, a merged peer doc, and a stale fallback counted."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway.advisors import AdvisorStack
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.statebus import (
+        StateBus,
+        StateBusConfig,
+    )
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    provider = StaticProvider(
+        [PodMetrics(pod=Pod("pod-0", "127.0.0.1:1"), metrics=Metrics())])
+    stack = AdvisorStack("pool", provider, journal=events.EventJournal())
+    clock = [100.0]
+    bus = StateBus({"pool": stack},
+                   cfg=StateBusConfig(replica_id=HOSTILE,
+                                      peers=("http://peer:1",),
+                                      staleness_s=5.0),
+                   journal=stack.journal, clock=lambda: clock[0])
+    bus.tick()
+    bus.merge([{"replica": HOSTILE + "-peer", "seq": 3, "ts": 100.0,
+                "pools": {"pool": {"noisy": {"hog": ["m", "hog"]},
+                                   "avoid": ["pod-9"], "resident": {},
+                                   "buckets": [], "shares": []}}}])
+    bus.apply()
+    clock[0] = 120.0  # every peer ages out: stale fallback counted
+    bus.apply()
+    return bus
+
+
+def test_statebus_exposition_contract():
+    """Statebus satellite: gateway_statebus_peers / snapshot-age /
+    merge-latency histogram / stale-fallback + exchange counters lint
+    clean with a hostile replica id round-tripping."""
+    bus = loaded_statebus()
+    bus.exchanges["ok"] = 2
+    bus.exchanges["error"] = 1
+    text = "\n".join(bus.render()) + "\n"
+    families = lint_exposition(text)
+    types = {line.split(" ")[2]: line.split(" ")[3]
+             for line in text.splitlines() if line.startswith("# TYPE ")}
+    assert types["gateway_statebus_peers"] == "gauge"
+    assert types["gateway_statebus_snapshot_age_seconds"] == "gauge"
+    assert types["gateway_statebus_merge_seconds"] == "histogram"
+    assert types["gateway_statebus_stale_fallbacks_total"] == "counter"
+    assert types["gateway_statebus_exchanges_total"] == "counter"
+    # Hostile replica ids round-trip on the age gauge (own + peer).
+    replicas = {s.labels["replica"]
+                for s in families["gateway_statebus_snapshot_age_seconds"]}
+    assert replicas == {HOSTILE, HOSTILE + "-peer"}
+    # The aged-out peer left the fresh count at zero and the fallback
+    # counter at one.
+    assert families["gateway_statebus_peers"][0].value == 0
+    assert families["gateway_statebus_stale_fallbacks_total"][0].value == 1
+    assert {s.labels["outcome"] for s in
+            families["gateway_statebus_exchanges_total"]} == {"ok", "error"}
+    assert "gateway_statebus_merge_seconds_bucket" in families
+
+
+def test_multipool_merged_exposition_round_trips():
+    """Two pools' advisor stacks merged through merge_exposition_blocks:
+    one # TYPE line per family, per-stack unlabeled counters summed, and
+    the whole page still parses."""
+    from llm_instance_gateway_tpu import events
+    from llm_instance_gateway_tpu.gateway.advisors import (
+        AdvisorStack,
+        merge_exposition_blocks,
+    )
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics, Pod, PodMetrics)
+
+    journal = events.EventJournal()
+    stacks = []
+    for tag in ("a", HOSTILE):
+        provider = StaticProvider([PodMetrics(
+            pod=Pod(f"{tag}-pod", "127.0.0.1:1"),
+            metrics=Metrics(adapter_tiers={f"{tag}-ad": "slot"},
+                            max_active_adapters=4))])
+        stack = AdvisorStack(f"pool-{tag}", provider, journal=journal)
+        stack.tick()
+        stack.placement.note_placement_escape()  # unlabeled counter += 1
+        stacks.append(stack)
+    text = "\n".join(
+        merge_exposition_blocks([s.render() for s in stacks])) + "\n"
+    families = lint_exposition(text)
+    type_lines = [line for line in text.splitlines()
+                  if line.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines)), type_lines
+    # Per-stack unlabeled counters SUMMED (1 escape per stack).
+    assert families["gateway_placement_escapes_total"][0].value == 2
+    # Labeled samples from BOTH pools coexist (hostile pod included).
+    pods = {s.labels["pod"]
+            for s in families["gateway_adapter_residency"]}
+    assert pods == {"a-pod", f"{HOSTILE}-pod"}
+
+
 def test_empty_observability_state_still_lints():
     """Fresh proxy, zero traffic: the composed page must still parse (the
     would-avoid/upstream counters render unlabeled 0 fallbacks; SLO and

@@ -311,6 +311,25 @@ def test_metric_currency_sample_line_prefix_counts_as_use(tmp_path):
     assert not any("gateway_good_total" in f.message for f in found)
 
 
+def test_metric_currency_flags_unregistered_statebus_family(tmp_path):
+    """ISSUE 11 satellite: a ``gateway_statebus_*`` family rendered by
+    the statebus without a registry entry fails ``make lint`` — the rule
+    picks new modules up automatically (it scans every package file)."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/metrics_registry.py": REGISTRY_FIXTURE.replace(
+            '    Family("gateway_dead_total", "counter", (), "help", '
+            '"s"),\n', ""),
+        f"{PKG}/gateway/statebus.py":
+            'def render(self):\n'
+            '    return ["# TYPE gateway_statebus_bogus_total counter",\n'
+            '            f"gateway_statebus_bogus_total '
+            '{self.bogus}"]\n'})
+    found = run_rule(root, "metric-currency")
+    assert any("gateway_statebus_bogus_total" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+
+
 # -- event-kinds ------------------------------------------------------------
 
 EVENTS_FIXTURE = 'PICK = "pick"\nSHED = "shed"\n'
@@ -337,6 +356,24 @@ def test_event_kinds_flags_undeclared_constant(tmp_path):
             "    journal.emit(events_mod.VANISHED)\n"})
     found = run_rule(root, "event-kinds")
     assert any("VANISHED" in f.message for f in found), messages(found)
+
+
+def test_event_kinds_flags_undeclared_statebus_event(tmp_path):
+    """ISSUE 11 satellite: a statebus event kind emitted without an
+    events.py constant fails — ``statebus_stale``/``statebus_rejoin``
+    must stay declared or the blackbox narration and the events_total
+    contract lose them."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/events.py": EVENTS_FIXTURE
+        + 'STATEBUS_STALE = "statebus_stale"\n',
+        f"{PKG}/gateway/statebus.py":
+            "def apply(self, journal):\n"
+            "    journal.emit('statebus_stale', replica='gw-1')\n"
+            "    journal.emit('statebus_desynced', replica='gw-1')\n"})
+    found = run_rule(root, "event-kinds")
+    assert any("'statebus_desynced'" in f.message
+               for f in found), messages(found)
+    assert not any("'statebus_stale'" in f.message for f in found)
 
 
 # -- label-hygiene ----------------------------------------------------------

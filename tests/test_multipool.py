@@ -109,6 +109,112 @@ class TestMultiPoolServer:
         assert ctx._pool == "pool-b"
 
 
+class TestMultiPoolEnforcement:
+    """ISSUE 11 satellite: per-pool advisor stacks make enforcement
+    ACTIVE on multi-pool fronts — the PR-7 "enforcement INACTIVE"
+    warning (and its carve-out) is gone.  A hog throttled in pool A
+    must leave pool B completely untouched."""
+
+    def _proxy(self, caplog=None, fairness_cfg=None):
+        from types import SimpleNamespace
+
+        from llm_instance_gateway_tpu.gateway.multipool import (
+            _DatastoreView,
+            _ProviderView,
+        )
+        from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+
+        ds_a, srv_a, self.addrs_a = _pool_stack(
+            "a", [make_model("model-a"), make_model("hog-a")])
+        ds_b, srv_b, self.addrs_b = _pool_stack(
+            "b", [make_model("model-b")])
+        self.mps = MultiPoolServer(
+            {"pool-a": srv_a, "pool-b": srv_b},
+            {"pool-a": ds_a, "pool-b": ds_b}, default="pool-a")
+        pools = {
+            "pool-a": SimpleNamespace(
+                datastore=ds_a, provider=srv_a.scheduler._provider,
+                scheduler=srv_a.scheduler, handler_server=srv_a),
+            "pool-b": SimpleNamespace(
+                datastore=ds_b, provider=srv_b.scheduler._provider,
+                scheduler=srv_b.scheduler, handler_server=srv_b),
+        }
+        provider = _ProviderView({n: p.provider for n, p in pools.items()})
+        datastore = _DatastoreView(
+            {n: p.datastore for n, p in pools.items()}, "pool-a")
+        proxy = GatewayProxy(self.mps, provider, datastore, pools=pools,
+                             fairness_cfg=fairness_cfg)
+        self.pools = pools
+        return proxy
+
+    def _pick(self, model: str):
+        ctx = RequestContext()
+        self.mps.process(ctx, RequestBody(generate_request(model)))
+        return ctx.target_pod
+
+    def test_no_inactive_warning_and_per_pool_seams(self, caplog):
+        with caplog.at_level("WARNING"):
+            proxy = self._proxy(fairness_cfg={"mode": "enforce"})
+        assert not any("INACTIVE" in r.message for r in caplog.records)
+        # Every pool got its own full stack, wired into ITS scheduler.
+        assert set(proxy.stacks) == {"pool-a", "pool-b"}
+        for name in ("pool-a", "pool-b"):
+            sched = self.pools[name].scheduler
+            stack = proxy.stacks[name]
+            assert sched.usage_advisor is stack.fairness
+            assert sched.health_advisor is stack.resilience
+            assert sched.placement_advisor is stack.placement
+            assert self.pools[name].handler_server.fairness is stack.fairness
+        assert proxy.stacks["pool-a"].fairness is not \
+            proxy.stacks["pool-b"].fairness
+
+    def test_hog_deprioritized_in_pool_a_pool_b_untouched(self):
+        proxy = self._proxy(fairness_cfg={"mode": "deprioritize"})
+        stack_a = proxy.stacks["pool-a"]
+        # hog-a is resident on pool A's pod 0 only.
+        pods_a = stack_a.provider.all_pod_metrics()
+        hog_pod = pods_a[0].pod.name
+        for pm in pods_a:
+            pm.metrics.active_adapters = (
+                {"hog-a": 0} if pm.pod.name == hog_pod else {})
+        stack_a.usage.seed_noisy("hog-a", "hog-a")
+        # Quiet pool-A picks never land on the hog's replica (isolation);
+        # the hog's own picks are contained ONTO it.
+        quiet_picks = {self._pick("model-a").name for _ in range(30)}
+        assert hog_pod not in quiet_picks and quiet_picks
+        hog_picks = {self._pick("hog-a").name for _ in range(10)}
+        assert hog_picks == {hog_pod}
+        # Pool B: unaffected — both replicas still serve, and pool B's
+        # fairness plane saw nothing.
+        b_picks = {self._pick("model-b").address for _ in range(40)}
+        assert b_picks == self.addrs_b
+        assert proxy.stacks["pool-b"].fairness.noisy() == frozenset()
+
+    def test_hog_throttled_in_pool_a_pool_b_untouched(self):
+        proxy = self._proxy(
+            fairness_cfg={"mode": "enforce", "quota_rps": 0.001,
+                          "quota_burst": 1.0})
+        stack_a, stack_b = proxy.stacks["pool-a"], proxy.stacks["pool-b"]
+        # Pool A's hog owns 90% of the pool's step-seconds.
+        stack_a.usage.shares_snapshot = lambda: {
+            ("hog-a", "hog-a"): 0.9, ("model-a", "base"): 0.1}
+        stack_a.fairness.tick()
+        assert stack_a.fairness.throttled() == frozenset({"hog-a"})
+        # The admit() gate on pool A's handler core demotes the hog once
+        # its burst token is spent; requests still serve (never a hard
+        # shed at the gate).
+        for _ in range(3):
+            assert self._pick("hog-a") is not None
+        assert sum(stack_a.fairness.quota_throttles.values()) >= 1
+        assert sum(stack_a.fairness.fairness_demotions.values()) >= 1
+        # Pool B's tenants pass untouched through THEIR gate.
+        for _ in range(5):
+            assert self._pick("model-b") is not None
+        assert stack_b.fairness.throttled() == frozenset()
+        assert stack_b.fairness.quota_throttles == {}
+        assert stack_b.fairness.fairness_demotions == {}
+
+
 TWO_POOL_DOCS = [
     {
         "apiVersion": "inference.tpu.x-k8s.io/v1alpha1",

@@ -738,7 +738,8 @@ def test_client_disconnect_mid_stream_is_accounted():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("scenario", ["blackhole", "brownout", "midstream",
+@pytest.mark.parametrize("scenario", ["replica_partition",
+                                      "blackhole", "brownout", "midstream",
                                       "scrape_flap", "handoff",
                                       "noisy_neighbor", "adapter_flood",
                                       "cold_start_storm"])

@@ -1,0 +1,565 @@
+"""Replicated control-plane state bus (ISSUE 11 tentpole).
+
+Covers the gossip/merge protocol (monotonic ``(replica, seq)`` LWW,
+push-pull transitivity, hostile-doc rejection), the staleness-bounded
+local-only fallback with journaled stale/rejoin transitions, the merged
+view's overlay onto every advisor plane (noisy flags, avoid sets,
+resident maps, quota partition), the proxy's HTTP endpoints, and the
+divergence report tool.
+"""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway.advisors import AdvisorStack
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.statebus import (
+    StateBus,
+    StateBusConfig,
+)
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+
+def make_stack(pool="pool-a", pods=("pod-0", "pod-1"), journal=None,
+               adapters=None):
+    provider = StaticProvider([
+        PodMetrics(pod=Pod(name, f"10.0.0.{i}:8000"),
+                   metrics=Metrics(active_adapters=dict(adapters or {})))
+        for i, name in enumerate(pods)])
+    return AdvisorStack(pool, provider,
+                        journal=journal or events_mod.EventJournal())
+
+
+def make_bus(rid, stack=None, pool="pool-a", clock=None, staleness=5.0):
+    stack = stack or make_stack(pool)
+    clock = clock or [100.0]
+    bus = StateBus({pool: stack},
+                   cfg=StateBusConfig(replica_id=rid,
+                                      staleness_s=staleness),
+                   journal=stack.journal, clock=lambda: clock[0])
+    return bus, stack, clock
+
+
+def peer_doc(replica="gw-x", seq=1, pool="pool-a", noisy=None, avoid=(),
+             resident=None, ts=100.0):
+    return {"replica": replica, "seq": seq, "ts": ts,
+            "pools": {pool: {"noisy": noisy or {},
+                             "avoid": list(avoid),
+                             "resident": resident or {},
+                             "buckets": [], "shares": []}}}
+
+
+# -- snapshot / merge protocol ----------------------------------------------
+
+class TestProtocol:
+    def test_snapshot_versions_are_monotonic(self):
+        bus, stack, _ = make_bus("gw-1")
+        d1, d2 = bus.snapshot(), bus.snapshot()
+        assert d1["replica"] == d2["replica"] == "gw-1"
+        assert d2["seq"] == d1["seq"] + 1
+        assert "pool-a" in d1["pools"]
+        for family in ("noisy", "avoid", "resident", "buckets", "shares"):
+            assert family in d1["pools"]["pool-a"]
+
+    def test_merge_is_last_writer_wins_per_replica(self):
+        bus, _, _ = make_bus("gw-1")
+        assert bus.merge([peer_doc("gw-2", seq=5)]) == 1
+        assert bus.merge([peer_doc("gw-2", seq=3)]) == 0  # stale seq
+        assert bus.merge([peer_doc("gw-2", seq=5)]) == 0  # same seq
+        assert bus.merge([peer_doc("gw-2", seq=6)]) == 1
+        docs = {d["replica"]: d for d in bus.all_docs()}
+        assert docs["gw-2"]["seq"] == 6
+
+    def test_restarted_replica_beats_its_own_ghost(self):
+        """Live-drill regression: a restarted replica reuses its id but
+        restarts seq at 1 — the boot epoch must outrank the pre-restart
+        ghost doc, or the rejoin stalls one tick per unit of previous
+        uptime."""
+        clock = [100.0]
+        bus_a, stack_a, _ = make_bus("gw-a", clock=clock)
+        old = make_bus("gw-b", clock=clock)[0]
+        for _ in range(26):
+            old_doc = old.snapshot()
+        bus_a.merge([old_doc])
+        assert {d["replica"]: d["seq"] for d in bus_a.all_docs()} == {
+            "gw-b": 26}
+        clock[0] = 200.0  # gw-b restarts: new boot epoch, seq resets
+        reborn, stack_b, _ = make_bus("gw-b", clock=clock)
+        stack_b.usage.seed_noisy("m", "hog")
+        assert bus_a.merge([reborn.snapshot()]) == 1
+        docs = {d["replica"]: d for d in bus_a.all_docs()}
+        assert docs["gw-b"]["seq"] == 1  # the fresh boot won
+        bus_a.apply()
+        assert "hog" in stack_a.usage.noisy()
+
+    def test_merge_skips_own_and_malformed_docs(self):
+        bus, _, _ = make_bus("gw-1")
+        bus.snapshot()
+        own_seq = bus.all_docs()[0]["seq"]
+        accepted = bus.merge([
+            peer_doc("gw-1", seq=999),            # spoofed self
+            "not-a-doc", None, 42,                # junk
+            {"replica": "", "seq": 1, "pools": {}},   # empty id
+            {"replica": "gw-3", "seq": "x", "pools": {}},  # bad seq
+            {"replica": "gw-3", "seq": 1, "pools": []},    # bad pools
+            peer_doc("gw-4", seq=1),              # the one good doc
+        ])
+        assert accepted == 1
+        docs = {d["replica"]: d["seq"] for d in bus.all_docs()}
+        assert docs == {"gw-1": own_seq, "gw-4": 1}
+
+    def test_hostile_inner_families_cannot_poison_the_bus(self):
+        """Review hardening (verified repro): a doc whose top-level shape
+        is valid but whose inner families are garbage must neither be
+        accepted with non-dict pools nor make apply()/tick() raise — a
+        raising overlay would freeze merged enforcement fleet-wide every
+        tick until the doc evicts."""
+        bus, stack, _ = make_bus("gw-1")
+        # Non-dict pool value: rejected at merge.
+        assert bus.merge([{"replica": "evil", "seq": 1, "boot": 1.0,
+                           "pools": {"pool-a": ["junk"]}}]) == 0
+        # Dict pool with garbage inner families: accepted (the shape
+        # merge vets) but every overlay survives it.
+        assert bus.merge([{"replica": "evil2", "seq": 1, "boot": 1.0,
+                           "pools": {"pool-a": {
+                               "noisy": ["a"],
+                               "avoid": {"x": 1},
+                               "resident": {"ad": "slot",
+                                            "ok": [["pod-0"], 3],
+                                            5: [[], []]},
+                               "buckets": 7}}}]) == 1
+        bus.apply()   # must not raise
+        bus.tick()    # must not raise
+        bus.debug_payload()  # must not raise
+        assert stack.usage.noisy() == frozenset()
+        assert stack.resilience.avoid_set() == frozenset()
+        assert stack.placement.resident_map() is None
+
+    def test_push_pull_is_transitive(self):
+        """A line topology A<->B, B<->C converges: A learns C's doc from
+        B without ever talking to C."""
+        bus_a, _, _ = make_bus("gw-a")
+        bus_b, _, _ = make_bus("gw-b")
+        bus_c, stack_c, _ = make_bus("gw-c")
+        stack_c.usage.seed_noisy("m", "hog")
+        for bus in (bus_a, bus_b, bus_c):
+            bus.snapshot()
+        bus_b.exchange_with(bus_c)
+        bus_a.exchange_with(bus_b)
+        replicas = {d["replica"] for d in bus_a.all_docs()}
+        assert replicas == {"gw-a", "gw-b", "gw-c"}
+        bus_a.apply()
+        assert bus_a.live_replicas() == 3
+
+
+# -- merged view -> advisor overlays ----------------------------------------
+
+class TestOverlays:
+    def test_remote_noisy_reaches_usage_and_fairness(self):
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([peer_doc("gw-2", noisy={"hog": ["m", "hog"]})])
+        bus.apply()
+        assert "hog" in stack.usage.noisy()
+        assert "hog" in stack.fairness.noisy()
+        # note_pick attributes the remote flag to its (model, adapter).
+        stack.usage.note_pick("pod-0", "hog")
+        assert stack.usage.would_deprioritize == {("m", "hog"): 1}
+
+    def test_remote_avoid_reaches_resilience(self):
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([peer_doc("gw-2", avoid=["pod-1"])])
+        bus.apply()
+        assert stack.resilience.should_avoid("pod-1")
+        assert not stack.resilience.should_avoid("pod-0")
+        assert "pod-1" in stack.resilience.avoid_set()
+        # Local publishing never includes the peer overlay.
+        assert "pod-1" not in stack.resilience.local_avoid_set()
+
+    def test_remote_resident_reaches_placement(self):
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([peer_doc(
+            "gw-2", resident={"ad-1": [["pod-0"], ["pod-1"]]})])
+        bus.apply()
+        slot, host = stack.placement.resident_tiers("ad-1")
+        assert slot == frozenset({"pod-0"})
+        assert host == frozenset({"pod-1"})
+        assert stack.placement.resident_map() is not None
+        assert stack.placement.local_resident_map() is None
+
+    def test_resident_union_slot_beats_host(self):
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([
+            peer_doc("gw-2", seq=1,
+                     resident={"ad": [["pod-0"], ["pod-1"]]}),
+            peer_doc("gw-3", seq=1,
+                     resident={"ad": [["pod-1"], []]}),
+        ])
+        bus.apply()
+        slot, host = stack.placement.resident_tiers("ad")
+        assert slot == frozenset({"pod-0", "pod-1"})
+        assert host == frozenset()
+
+    def test_quota_partitions_by_live_replica_count(self):
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([peer_doc("gw-2"), peer_doc("gw-3"),
+                   peer_doc("gw-4")])
+        bus.apply()
+        assert bus.live_replicas() == 4
+        assert abs(stack.fairness.quota_scale - 0.25) < 1e-9
+
+    def test_partitioned_quota_still_admits_at_full_priority(self):
+        """Review hardening: the scaled burst ceiling floors at one
+        request's cost — at 9+ replicas ``quota_burst/N < cost`` would
+        otherwise clamp every refill under the cost and starve the
+        throttled tenant at full priority FOREVER (the partition scales
+        the rate, not to zero)."""
+        from llm_instance_gateway_tpu.gateway.fairness import (
+            FairnessConfig,
+            FairnessPolicy,
+        )
+        from llm_instance_gateway_tpu.gateway.scheduling.types import (
+            LLMRequest,
+        )
+
+        class FakeRollup:
+            def shares_snapshot(self):
+                return {("hog", "hog"): 0.9, ("m", "base"): 0.1}
+
+            def noisy(self):
+                return frozenset()
+
+        clock = [100.0]
+        policy = FairnessPolicy(
+            FakeRollup(),
+            cfg=FairnessConfig(mode="enforce", quota_rps=2.0,
+                               quota_burst=8.0),
+            clock=lambda: clock[0])
+        policy.tick(now=100.0)
+        policy.set_quota_scale(1.0 / 9.0)  # burst*scale = 8/9 < cost 1.0
+        req = LLMRequest(model="hog", critical=True,
+                         criticality="Critical")
+        assert policy.admit(req) is None          # full bucket admits
+        assert policy.admit(req) == "Default"     # burst spent: demoted
+        clock[0] += 5.0  # refill at the PARTITIONED rate (2/9 tok/s)
+        req2 = LLMRequest(model="hog", critical=True,
+                          criticality="Critical")
+        assert policy.admit(req2) is None         # ...but admits again
+
+    def test_dead_replica_docs_evicted(self):
+        """Review hardening: identities unseen past evict_factor x
+        staleness are forgotten — no unbounded doc set / gossip payload
+        / metric cardinality under pod churn — while the replica stays
+        STALE (its fleet died; it did not become a born-single)."""
+        bus, stack, clock = make_bus("gw-1")
+        bus.merge([peer_doc("gw-2", noisy={"hog": ["m", "hog"]})])
+        bus.apply()
+        clock[0] = 100.0 + 5.0 * 10.0 + 1.0  # past evict bound
+        bus.apply()
+        assert bus.stale
+        assert [d["replica"] for d in bus.all_docs()] == []
+        assert "gw-2" not in "".join(bus.render())
+        # A brand-new doc from the same identity is accepted afresh.
+        assert bus.merge([peer_doc("gw-2", seq=1)]) == 1
+        bus.apply()
+        assert not bus.stale
+
+    def test_remote_overlay_never_republished(self):
+        """A flag learned from a peer must not appear in this replica's
+        own snapshot — each key family has one owning replica, so flags
+        can't ping-pong after the origin clears them."""
+        bus, stack, _ = make_bus("gw-1")
+        bus.merge([peer_doc("gw-2", noisy={"hog": ["m", "hog"]},
+                            avoid=["pod-1"])])
+        bus.apply()
+        assert "hog" in stack.usage.noisy()
+        doc = bus.snapshot()
+        assert doc["pools"]["pool-a"]["noisy"] == {}
+        assert doc["pools"]["pool-a"]["avoid"] == []
+
+    def test_origin_clearing_clears_the_fleet(self):
+        """When the owning replica's next snapshot drops the flag, one
+        gossip round clears it everywhere."""
+        bus_a, stack_a, _ = make_bus("gw-a")
+        bus_b, stack_b, _ = make_bus("gw-b")
+        stack_b.usage.seed_noisy("m", "hog")
+        bus_b.tick()
+        bus_a.tick()
+        bus_a.exchange_with(bus_b)
+        bus_a.apply()
+        assert "hog" in stack_a.usage.noisy()
+        # The origin clears (detection hysteresis exited): its next
+        # snapshot carries no flag; a newer doc replaces the old one.
+        stack_b.usage.set_remote_noisy({})
+        with stack_b.usage._lock:
+            stack_b.usage._states.clear()
+            stack_b.usage._noisy_key_of.clear()
+            stack_b.usage._noisy_models = frozenset()
+        bus_b.tick()
+        bus_a.exchange_with(bus_b)
+        bus_a.apply()
+        assert "hog" not in stack_a.usage.noisy()
+
+
+# -- staleness fallback ------------------------------------------------------
+
+class TestStaleness:
+    def test_stale_fallback_and_rejoin_journal_once_each(self):
+        journal = events_mod.EventJournal()
+        stack = make_stack(journal=journal)
+        clock = [100.0]
+        bus = StateBus({"pool-a": stack},
+                       cfg=StateBusConfig(replica_id="gw-1",
+                                          staleness_s=5.0),
+                       journal=journal, clock=lambda: clock[0])
+        bus.merge([peer_doc("gw-2", noisy={"hog": ["m", "hog"]})])
+        bus.apply()
+        assert "hog" in stack.usage.noisy() and not bus.stale
+        clock[0] = 110.0  # peer ages past the bound
+        bus.apply()
+        bus.apply()  # second pass must NOT double-journal
+        assert bus.stale
+        assert bus.stale_fallbacks_total == 1
+        assert "hog" not in stack.usage.noisy()  # local-only fallback
+        assert stack.fairness.quota_scale == 1.0
+        stale = journal.events(kind=events_mod.STATEBUS_STALE, limit=16)
+        assert len(stale) == 1
+        # Rejoin: a fresh peer doc restores the merged view.
+        bus.merge([peer_doc("gw-2", seq=2,
+                            noisy={"hog": ["m", "hog"]})])
+        bus.apply()
+        assert not bus.stale
+        assert "hog" in stack.usage.noisy()
+        rejoin = journal.events(kind=events_mod.STATEBUS_REJOIN, limit=16)
+        assert len(rejoin) == 1
+
+    def test_never_saw_peer_never_goes_stale(self):
+        """A single-replica gateway (no peers ever) is not 'degraded' —
+        no stale events, full quota, overlays empty."""
+        bus, stack, clock = make_bus("gw-1")
+        bus.tick()
+        clock[0] = 1000.0
+        bus.tick()
+        assert not bus.stale
+        assert bus.stale_fallbacks_total == 0
+        assert stack.fairness.quota_scale == 1.0
+
+
+# -- the merged state reaches the PICK seam ---------------------------------
+
+def test_remote_flag_steers_the_scheduler():
+    """End to end inside one replica: a noisy flag learned from a PEER
+    narrows this replica's pick survivors exactly like a local flag —
+    the merged state flows through the same filter_by_fairness seam the
+    lint plane guards."""
+    import random
+
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+        Scheduler,
+    )
+    from llm_instance_gateway_tpu.gateway.scheduling.types import (
+        LLMRequest,
+    )
+
+    provider = StaticProvider([
+        PodMetrics(pod=Pod("pod-hog", "10.0.0.0:8000"),
+                   metrics=Metrics(active_adapters={"hog": 0},
+                                   max_active_adapters=4)),
+        PodMetrics(pod=Pod("pod-quiet", "10.0.0.1:8000"),
+                   metrics=Metrics(active_adapters={"quiet": 0},
+                                   max_active_adapters=4)),
+    ])
+    stack = AdvisorStack("pool-a", provider,
+                         fairness_cfg={"mode": "deprioritize"})
+    scheduler = Scheduler(provider, token_aware=False,
+                          prefill_aware=False, prefix_aware=False,
+                          rng=random.Random(0))
+    stack.wire(scheduler, None)
+    bus, _, _ = make_bus("gw-1", stack=stack)
+    bus.merge([peer_doc("gw-2", noisy={"hog": ["m", "hog"]})])
+    bus.apply()
+    picks = {scheduler.schedule(
+        LLMRequest(model="quiet", resolved_target_model="quiet",
+                   critical=True)).name for _ in range(20)}
+    assert picks == {"pod-quiet"}  # isolation: quiet never on the hog pod
+    hog_picks = {scheduler.schedule(
+        LLMRequest(model="hog", resolved_target_model="hog",
+                   critical=True)).name for _ in range(10)}
+    assert hog_picks == {"pod-hog"}  # containment
+
+
+# -- proxy HTTP integration --------------------------------------------------
+
+def _mini_proxy(pool="pool-a", replica_id="gw-http"):
+    import random
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    pod = Pod("pod-0", "127.0.0.1:1")
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name=pool))
+    provider = StaticProvider([PodMetrics(pod=pod, metrics=Metrics())])
+    proxy = GatewayProxy(
+        Server(Scheduler(provider, token_aware=False,
+                         prefill_aware=False,
+                         rng=random.Random(0)), ds),
+        provider, ds,
+        statebus_cfg=StateBusConfig(replica_id=replica_id,
+                                    peers=("http://peer:1",)))
+    return proxy
+
+
+def test_proxy_statebus_endpoints_round_trip():
+    """POST /statebus/exchange merges peer docs and answers with the
+    full doc set; GET /debug/statebus serves the divergence payload;
+    control_tick publishes snapshots."""
+
+    async def run():
+        proxy = _mini_proxy()
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            proxy.control_tick()  # publish our own snapshot
+            doc = peer_doc("gw-peer", noisy={"hog": ["m", "hog"]},
+                           pool="pool-a")
+            resp = await client.post("/statebus/exchange", json=[doc])
+            assert resp.status == 200
+            docs = {d["replica"]: d for d in await resp.json()}
+            assert set(docs) == {"gw-http", "gw-peer"}
+            # The exchange applied the merged view immediately.
+            assert "hog" in proxy.usage.noisy()
+            resp = await client.get("/debug/statebus")
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["replica"] == "gw-http"
+            assert payload["replicas"]["gw-peer"]["fresh"]
+            assert payload["merged"]["pool-a"]["noisy"] == {
+                "hog": ["m", "hog"]}
+            assert payload["local"]["pool-a"]["noisy"] == {}
+            # Malformed exchanges are rejected, never crash the bus.
+            resp = await client.post("/statebus/exchange",
+                                     data=b"{not json")
+            assert resp.status == 400
+            resp = await client.post("/statebus/exchange",
+                                     json={"replica": "gw-x"})
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_peerless_gateway_refuses_exchange():
+    """Review hardening: with NO peers configured the statebus is inert
+    — an open merge endpoint would let any client that can reach the
+    port flag tenants noisy or mark every pod avoided."""
+
+    async def run():
+        proxy = _mini_proxy()
+        proxy.statebus.cfg = StateBusConfig(replica_id="gw-solo")
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            doc = peer_doc("gw-evil", avoid=["pod-0"],
+                           noisy={"hog": ["m", "hog"]})
+            resp = await client.post("/statebus/exchange", json=[doc])
+            assert resp.status == 403
+            assert proxy.statebus.all_docs() == []
+            assert proxy.usage.noisy() == frozenset()
+            assert not proxy.resilience.should_avoid("pod-0")
+            # The statebus families render on the proxy's /metrics.
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            assert "# TYPE gateway_statebus_peers gauge" in text
+            assert "gateway_statebus_snapshot_age_seconds" in text
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_two_real_proxies_converge_over_http():
+    """Two full proxies gossiping over the REAL /statebus/exchange wire:
+    a hog flagged on A reaches B's advisors in one exchange round."""
+
+    async def run():
+        proxy_a = _mini_proxy(replica_id="gw-a")
+        proxy_b = _mini_proxy(replica_id="gw-b")
+        client_b = TestClient(TestServer(proxy_b.build_app()))
+        await client_b.start_server()
+        try:
+            peer_url = (f"http://{client_b.host}:{client_b.port}")
+            proxy_a.statebus.cfg = StateBusConfig(
+                replica_id="gw-a", peers=(peer_url,))
+            proxy_a.control_tick()
+            proxy_b.control_tick()
+            # Seed AFTER the tick (a seeded flag with no backing usage
+            # counters is GC'd by the rollup's next tick) and publish it.
+            proxy_a.usage.seed_noisy("m", "hog")
+            proxy_a.statebus.snapshot()
+            await proxy_a.statebus.exchange(client_b.session)
+            proxy_a.statebus.apply()
+            # B merged A's doc during the POST; its advisors wear it.
+            assert "hog" in proxy_b.usage.noisy()
+            assert proxy_b.fairness.quota_scale == 0.5
+            assert proxy_a.statebus.exchanges.get("ok") == 1
+            # A learned B's doc from the push-pull response.
+            replicas = {d["replica"]
+                        for d in proxy_a.statebus.all_docs()}
+            assert replicas == {"gw-a", "gw-b"}
+        finally:
+            await client_b.close()
+
+    asyncio.run(run())
+
+
+# -- report tool --------------------------------------------------------------
+
+def test_statebus_report_renders_divergence(tmp_path, capsys):
+    from tools.statebus_report import main, render_report
+
+    bus, stack, clock = make_bus("gw-1")
+    stack.usage.seed_noisy("m", "local-hog")
+    bus.tick()
+    doc = peer_doc("gw-2", noisy={"peer-hog": ["m", "peer-hog"]},
+                   avoid=["pod-9"],
+                   resident={"ad": [["pod-0"], []]})
+    doc["pools"]["pool-a"]["buckets"] = [["m", "peer-hog", 1.5]]
+    bus.merge([doc])
+    bus.apply()
+    payload = json.loads(json.dumps(bus.debug_payload()))
+    report = render_report(payload)
+    assert "gw-1" in report and "gw-2" in report
+    # Divergence: the local flag is only-local, the peer's only-merged.
+    assert "local-hog" in report and "peer-hog" in report
+    assert "pod-9" in report
+    assert "('ad', 'slot', 'pod-0')" in report
+    # The fleet quota view renders each replica's bucket partition.
+    assert "fleet quota buckets" in report
+    assert "m/peer-hog: gw-2=1.5" in report
+    # --once --from-file renders the same report from disk (CI path).
+    path = tmp_path / "statebus.json"
+    path.write_text(json.dumps(payload))
+    assert main(["--from-file", str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "peer-hog" in out
+
+
+def test_statebus_report_flags_stale(tmp_path):
+    from tools.statebus_report import render_report
+
+    bus, stack, clock = make_bus("gw-1")
+    bus.merge([peer_doc("gw-2")])
+    clock[0] = 110.0  # past staleness (5s), inside the evict bound (50s)
+    bus.apply()
+    report = render_report(json.loads(json.dumps(bus.debug_payload())))
+    assert "LOCAL-ONLY" in report
+    assert "NO (stale)" in report

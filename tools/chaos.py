@@ -23,6 +23,10 @@ fault schedule, drives load, and asserts recovery invariants per scenario:
                       resident universe; hot-set p99 TTFT within 2x the
                       all-resident baseline, zero wrong-tier picks in
                       prefer_resident mode
+``replica_partition`` statebus plane: a replica partitioned off the bus
+                      degrades to local-only enforcement with zero 5xx
+                      (statebus_stale journaled) and rejoins within 2
+                      ticks of the partition healing
 ====================  ====================================================
 
 Usage: ``python tools/chaos.py --seed 0 --scenario all`` (``make chaos``).
@@ -721,6 +725,140 @@ async def scenario_cold_start_storm(seed: int) -> dict:
         return report
 
 
+async def scenario_replica_partition(seed: int) -> dict:
+    """Statebus acceptance: a gateway replica partitioned off the bus
+    degrades to LOCAL-ONLY enforcement with ZERO 5xx and rejoins within
+    2 ticks of the partition healing.
+
+    Topology: replica A is a fully REAL proxy serving traffic; replica B
+    is a peer gateway's control plane (advisor stack + statebus) that
+    detected a noisy hog A has never seen locally.  One gossip round
+    makes A enforce B's flag (quiet picks steer off the hog's replica,
+    the tenant quota partitions 2 ways); cutting the bus past the
+    staleness bound drops A to local-only (flag gone, full quota,
+    ``statebus_stale`` journaled) while every request keeps succeeding;
+    a fresh exchange restores merged enforcement (``statebus_rejoin``).
+    """
+    from llm_instance_gateway_tpu.gateway.advisors import AdvisorStack
+    from llm_instance_gateway_tpu.gateway.fairness import FairnessConfig
+    from llm_instance_gateway_tpu.gateway.statebus import (
+        StateBus,
+        StateBusConfig,
+    )
+
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="log_only", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    fcfg = FairnessConfig(mode="deprioritize")
+    hog, quiet = "hog", "m"
+    async with ChaosStack(schedule, seed, rcfg, models=(quiet, hog),
+                          fairness_cfg=fcfg) as stack:
+        proxy = stack.proxy
+        # The hog adapter is resident on pod-bad only: merged enforcement
+        # must steer quiet picks off that replica.
+        for pm in proxy.provider.all_pod_metrics():
+            pm.metrics.active_adapters = (
+                {hog: 0} if pm.pod.name == BAD else {})
+        clock = [1000.0]
+        pool = next(iter(proxy.stacks))
+        bus_a = StateBus(proxy.stacks,
+                         cfg=StateBusConfig(replica_id="gw-a",
+                                            staleness_s=5.0),
+                         journal=proxy.journal, clock=lambda: clock[0])
+        proxy.statebus = bus_a
+        # Replica B: a peer gateway's control plane over the same pool
+        # membership (no data path needed — it contributes STATE).
+        provider_b = StaticProvider([
+            PodMetrics(pod=Pod(pm.pod.name, pm.pod.address),
+                       metrics=Metrics(active_adapters=dict(
+                           pm.metrics.active_adapters)))
+            for pm in proxy.provider.all_pod_metrics()])
+        stack_b = AdvisorStack(pool, provider_b)
+        bus_b = StateBus({pool: stack_b},
+                         cfg=StateBusConfig(replica_id="gw-b",
+                                            staleness_s=5.0),
+                         clock=lambda: clock[0])
+        statuses: dict[str, list[int]] = {
+            "joined": [], "partitioned": [], "rejoined": []}
+
+        async def serve(phase: str, n: int) -> list[str]:
+            seq0 = proxy.journal.seq
+            for _ in range(n):
+                statuses[phase].append(await stack.request(model=quiet))
+            return [e["attrs"]["pod"] for e in proxy.journal.events(
+                since=seq0, limit=2048, kind=events_mod.PICK)]
+
+        # Phase 1: joined.  B detected the hog; one gossip round brings
+        # the flag (and the 2-way quota partition) to A.
+        stack_b.usage.seed_noisy(hog, hog)
+        bus_b.tick()
+        bus_a.tick()
+        bus_a.exchange_with(bus_b)
+        bus_a.apply()
+        joined_flagged = hog in proxy.fairness.noisy()
+        joined_scale = proxy.fairness.quota_scale
+        joined_picks = await serve("joined", 20)
+
+        # Phase 2: partition.  A's peer snapshots age past the staleness
+        # bound; the next tick falls back to local-only enforcement.
+        clock[0] += 10.0
+        bus_a.tick()
+        part_flagged = hog in proxy.fairness.noisy()
+        part_scale = proxy.fairness.quota_scale
+        part_picks = await serve("partitioned", 20)
+        stale_events = proxy.journal.events(
+            kind=events_mod.STATEBUS_STALE, limit=16)
+
+        # Phase 3: rejoin.  B publishes a fresh snapshot; count the A
+        # ticks until merged enforcement is back.
+        bus_b.tick()
+        bus_a.exchange_with(bus_b)
+        rejoin_ticks = 0
+        for _ in range(2):
+            rejoin_ticks += 1
+            bus_a.tick()
+            if hog in proxy.fairness.noisy():
+                break
+        rejoin_events = proxy.journal.events(
+            kind=events_mod.STATEBUS_REJOIN, limit=16)
+        rejoin_picks = await serve("rejoined", 20)
+
+        all_statuses = [s for phase in statuses.values() for s in phase]
+        report = {
+            "scenario": "replica_partition",
+            "requests": len(all_statuses),
+            "non_200": sum(1 for s in all_statuses if s != 200),
+            "joined": {"flagged": joined_flagged,
+                       "quota_scale": joined_scale,
+                       "quiet_picks_on_hog_pod":
+                           joined_picks.count(BAD)},
+            "partitioned": {"flagged": part_flagged,
+                            "quota_scale": part_scale,
+                            "stale_events": len(stale_events),
+                            "requests": len(statuses["partitioned"])},
+            "rejoined": {"ticks_to_rejoin": rejoin_ticks,
+                         "rejoin_events": len(rejoin_events),
+                         "quiet_picks_on_hog_pod":
+                             rejoin_picks.count(BAD)},
+        }
+        # Joined: the peer's flag enforces here — quiet traffic off the
+        # hog replica, quota partitioned 2 ways.
+        assert joined_flagged and joined_scale == 0.5, report
+        assert report["joined"]["quiet_picks_on_hog_pod"] == 0, report
+        # Partitioned: local-only (flag gone, full quota), journaled,
+        # and ZERO 5xx — the replica keeps serving.
+        assert not part_flagged and part_scale == 1.0, report
+        assert len(stale_events) == 1, report
+        assert report["non_200"] == 0, report
+        # Rejoined within 2 ticks, journaled, enforcement restored.
+        assert rejoin_ticks <= 2, report
+        assert hog in proxy.fairness.noisy(), report
+        assert len(rejoin_events) == 1, report
+        assert report["rejoined"]["quiet_picks_on_hog_pod"] == 0, report
+        return report
+
+
 SCENARIOS = {
     "blackhole": scenario_blackhole,
     "brownout": scenario_brownout,
@@ -730,6 +868,7 @@ SCENARIOS = {
     "noisy_neighbor": scenario_noisy_neighbor,
     "adapter_flood": scenario_adapter_flood,
     "cold_start_storm": scenario_cold_start_storm,
+    "replica_partition": scenario_replica_partition,
 }
 
 

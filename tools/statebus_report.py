@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Statebus divergence report: merged-vs-local state per gateway replica.
+
+Reads a gateway's ``/debug/statebus`` payload (live URL or a saved JSON
+file) and renders, per pool, how the replica's LOCAL tick-derived state
+differs from the MERGED fleet view its advisors currently wear — the
+first question when debugging a multi-gateway front ("why does gw-2
+still route to the hog's replica?" -> its merged view is stale/diverged).
+
+Sections:
+
+- **replicas**: every replica the gateway knows, with snapshot seq, age,
+  and freshness (stale replicas are excluded from the merged view).
+- **per-pool divergence**: for each key family (noisy flags, avoid set,
+  resident map) the entries only-local vs only-merged.  An empty table
+  means the fleet agrees; ``statebus stale — local-only enforcement``
+  is called out loudly.
+
+Usage::
+
+    python tools/statebus_report.py --url http://localhost:8081 --once
+    python tools/statebus_report.py --from-file /tmp/statebus.json --once
+
+``--once`` renders a single report and exits (CI-friendly); ``--watch``
+re-renders every N seconds.  ``--json`` dumps the raw payload instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch_payload(url: str) -> dict:
+    with urllib.request.urlopen(f"{url.rstrip('/')}/debug/statebus",
+                                timeout=5.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt_set(items) -> str:
+    items = sorted(items)
+    if not items:
+        return "-"
+    body = ", ".join(str(i) for i in items[:6])
+    return body + (f" (+{len(items) - 6} more)" if len(items) > 6 else "")
+
+
+def _resident_sets(resident: dict) -> set:
+    """Flatten a resident map into comparable (adapter, tier, pod)
+    triples."""
+    out = set()
+    for adapter, tiers in (resident or {}).items():
+        slot, host = (tiers + [[], []])[:2] if isinstance(tiers, list) \
+            else tiers
+        out |= {(adapter, "slot", p) for p in slot}
+        out |= {(adapter, "host", p) for p in host}
+    return out
+
+
+def render_report(payload: dict) -> str:
+    """The human-readable report (pure function of the /debug/statebus
+    payload — tested offline)."""
+    lines: list[str] = []
+    replica = payload.get("replica", "?")
+    lines.append(f"statebus @ {replica}  seq={payload.get('seq')}  "
+                 f"live_replicas={payload.get('live_replicas')}  "
+                 f"quota_scale={payload.get('quota_scale')}")
+    if payload.get("stale"):
+        lines.append("  !! STALE: every peer aged out — LOCAL-ONLY "
+                     "enforcement (statebus_stale journaled)")
+    lines.append("")
+    lines.append("  %-28s %8s %10s %s" % ("replica", "seq", "age_s",
+                                          "fresh"))
+    for rid, row in sorted(payload.get("replicas", {}).items()):
+        lines.append("  %-28s %8s %10.3f %s"
+                     % (rid, row.get("seq"), row.get("age_s", 0.0),
+                        "yes" if row.get("fresh") else "NO (stale)"))
+    local = payload.get("local", {})
+    merged = payload.get("merged", {})
+    for pool in sorted(set(local) | set(merged)):
+        lp = local.get(pool, {})
+        mp = merged.get(pool, {})
+        lines.append("")
+        lines.append(f"  pool {pool}:")
+        l_noisy = set(lp.get("noisy", {}))
+        m_noisy = set(mp.get("noisy", {}))
+        l_avoid = set(lp.get("avoid", []))
+        m_avoid = set(mp.get("avoid", []))
+        l_res = _resident_sets(lp.get("resident", {}))
+        m_res = _resident_sets(mp.get("resident", {}))
+        rows = [
+            ("noisy", l_noisy, m_noisy),
+            ("avoid", l_avoid, m_avoid),
+            ("resident", l_res, m_res),
+        ]
+        lines.append("    %-10s %-34s %s" % ("family", "only-local",
+                                             "only-merged(peers)"))
+        diverged = False
+        for family, lset, mset in rows:
+            only_l, only_m = lset - mset, mset - lset
+            if only_l or only_m:
+                diverged = True
+            lines.append("    %-10s %-34s %s"
+                         % (family, _fmt_set(only_l), _fmt_set(only_m)))
+        lines.append("    (fleet agrees)" if not diverged
+                     else "    => diverged: merged view adds/lacks the "
+                          "entries above vs this replica's own state")
+        shares = [s for s in lp.get("shares", [])
+                  if isinstance(s, (list, tuple)) and len(s) == 3]
+        if shares:
+            top = sorted(shares, key=lambda s: -s[2])[:5]
+            lines.append("    top local shares: " + ", ".join(
+                f"{m}/{a}={v}" for m, a, v in top))
+    fleet = payload.get("fleet_buckets", {})
+    for pool in sorted(fleet):
+        rows: dict[tuple, dict] = {}
+        for rid, buckets in fleet[pool].items():
+            for entry in buckets:
+                if isinstance(entry, (list, tuple)) and len(entry) == 3:
+                    model, adapter, tokens = entry
+                    rows.setdefault((model, adapter), {})[rid] = tokens
+        if not rows:
+            continue
+        lines.append("")
+        lines.append(f"  pool {pool} fleet quota buckets "
+                     "(tokens remaining per replica partition):")
+        for (model, adapter), per_rep in sorted(rows.items()):
+            spread = "  ".join(f"{rid}={tok}" for rid, tok
+                               in sorted(per_rep.items()))
+            lines.append(f"    {model}/{adapter}: {spread}  "
+                         f"(fleet total {round(sum(per_rep.values()), 3)})")
+    counters = payload.get("counters", {})
+    lines.append("")
+    lines.append(f"  stale_fallbacks_total="
+                 f"{counters.get('stale_fallbacks_total', 0)}  "
+                 f"exchanges={counters.get('exchanges', {})}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--url", default="http://localhost:8081",
+                        help="gateway base URL serving /debug/statebus")
+    parser.add_argument("--from-file", default=None, metavar="PATH",
+                        help="render a saved /debug/statebus payload "
+                             "instead of fetching (offline debugging)")
+    parser.add_argument("--once", action="store_true",
+                        help="render one report and exit (CI-friendly)")
+    parser.add_argument("--watch", type=float, default=0.0, metavar="S",
+                        help="re-render every S seconds (0 = once)")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw payload instead of the report")
+    args = parser.parse_args(argv)
+    while True:
+        if args.from_file:
+            with open(args.from_file) as f:
+                payload = json.load(f)
+        else:
+            payload = fetch_payload(args.url)
+        print(json.dumps(payload, indent=2) if args.json
+              else render_report(payload))
+        if args.once or args.watch <= 0:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
