@@ -679,6 +679,79 @@ def run_placement_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_profiler_microbench(emit_profile: bool = False) -> dict:
+    """Step-timeline-profiler overhead A/B (fleet-observability PR
+    acceptance bar: ``step_profile_ratio`` <= 1.05 — profiling every
+    dispatch costs < 5% of step-loop wall).
+
+    Two tiny CPU engines run the same decode-heavy workload, profiler ON
+    (the default) vs ``step_profile=False``; interleaved rounds, MIN per
+    side (the usage-attribution A/B precedent — contended cores swing
+    single runs 2x).  ``emit_profile=True`` additionally returns the ON
+    engine's profiler snapshot — the deterministic run committed as
+    ``PROFILE_BASELINE.json`` (the dispatch/host-sync/idle attribution
+    baseline every ROADMAP item-2 lever is measured against).
+    """
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request, SamplingParams,
+    )
+
+    cfg = dataclasses.replace(
+        LLAMA3_8B, name="profiler-cpu", vocab_size=512, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, head_dim=32,
+        max_seq_len=256,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    ecfg = dict(decode_slots=4, max_seq_len=256,
+                prefill_buckets=(32, 64, 128))
+    rng = np.random.RandomState(0)
+
+    def engine(**kw):
+        e = Engine(cfg, params, EngineConfig(**ecfg, **kw), eos_id=None,
+                   dtype=jnp.float32)
+        e.start()
+        return e
+
+    def req(prompt_len, max_new):
+        return Request(
+            prompt_tokens=list(rng.randint(1, 500, size=prompt_len)),
+            max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=0.0))
+
+    def decode_wall(e) -> float:
+        rs = [req(16, 24) for _ in range(4)]
+        t0 = time.perf_counter()
+        for r in rs:
+            e.submit(r)
+        for r in rs:
+            if not r.done.wait(300):
+                raise RuntimeError("profiler A/B request timed out")
+        return time.perf_counter() - t0
+
+    on_engine = engine()
+    off_engine = engine(step_profile=False)
+    try:
+        decode_wall(on_engine), decode_wall(off_engine)  # warmup pair
+        on_best = off_best = float("inf")
+        for _ in range(3):
+            off_best = min(off_best, decode_wall(off_engine))
+            on_best = min(on_best, decode_wall(on_engine))
+        out = {
+            "step_profile_on_s": round(on_best, 4),
+            "step_profile_off_s": round(off_best, 4),
+            "step_profile_ratio": round(on_best / off_best, 4),
+        }
+        if emit_profile:
+            out["profile"] = on_engine.profiler.snapshot()
+    finally:
+        on_engine.stop()
+        off_engine.stop()
+    return out
+
+
 def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
                                n_models: int = 1000,
                                batch: int = 64) -> dict:
@@ -1256,6 +1329,12 @@ if __name__ == "__main__":
             results.update(run_relay_microbench())
         except Exception as e:
             results["relay_error"] = str(e)[:200]
+        try:
+            # Step-profiler overhead A/B (fleet-observability PR): the
+            # <5% step_profile_ratio bound rides every emission.
+            results.update(run_profiler_microbench())
+        except Exception as e:
+            results["profiler_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

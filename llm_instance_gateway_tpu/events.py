@@ -57,6 +57,7 @@ PLACEMENT_DECISION = "placement_decision"  # planner emitted a tier action
 PLACEMENT_ESCAPE = "placement_escape"   # no resident candidate: full set served
 STATEBUS_STALE = "statebus_stale"       # peers quiet: local-only enforcement
 STATEBUS_REJOIN = "statebus_rejoin"     # fresh peer state after a stale spell
+FLEET_PEER_ERROR = "fleet_peer_error"   # fleet collector pull failed (fleetobs)
 
 
 class EventJournal:

@@ -14,11 +14,12 @@ Endpoints:
 - ``GET  /metrics``  — gateway self-telemetry (scheduler decisions, shed rate,
   pick latency, TTFT/TPOT/e2e histograms; resolves reference TODO
   provider.go:140).
-- ``GET  /debug/traces`` — recent request traces (``?trace_id=`` filters);
-  each trace merges the proxy's own spans with the model servers' spans
-  returned in their ``x-lig-spans`` response headers, so one JSON document
-  answers "where did this request spend its time?" across up to three
-  processes.
+- ``GET  /debug/traces`` — recent request traces (``?trace_id=`` filters,
+  ``?since=<seq>`` serves incremental deltas — the same cursor contract
+  as ``/debug/events``, what the fleet collector polls); each trace
+  merges the proxy's own spans with the model servers' spans returned in
+  their ``x-lig-spans`` response headers, so one JSON document answers
+  "where did this request spend its time?" across up to three processes.
 - ``GET  /debug/slo`` — per-model SLO compliance + multi-window burn rates
   + burn state (gateway/slo.py), evaluated on demand.
 - ``GET  /debug/health`` — per-replica 0-1 health scores with components
@@ -32,6 +33,12 @@ Endpoints:
   rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
   transitions, noisy-neighbor flags; ``?since=<seq>`` for incremental
   polling.
+- ``GET  /debug/fleet`` — the fleet observability view (gateway/fleetobs.py):
+  every peer gateway's and pool pod's traces/events/slo/health pulled
+  through the incremental cursors, cross-replica traces stitched into
+  causally-ordered timelines with clock-skew normalization, event journals
+  merged by (replica, seq), fleet-wide SLO rollup; rendered by
+  ``tools/fleet_report.py``.
 - ``GET  /healthz``  — 200 once the InferencePool is synced (main.go:43-52).
 - ``GET  /v1/models`` — logical models from the datastore.
 
@@ -67,6 +74,7 @@ import aiohttp
 from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import fleetobs
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
 from llm_instance_gateway_tpu.gateway import statebus as statebus_mod
 from llm_instance_gateway_tpu.gateway.advisors import (
@@ -223,6 +231,18 @@ class GatewayProxy:
         # (the default) it is inert beyond serving /debug/statebus.
         self.statebus = statebus_mod.StateBus(
             self.stacks, cfg=statebus_cfg, journal=self.journal)
+        # Fleet observability collector (gateway/fleetobs.py): pulls the
+        # peer gateways' (the statebus peer list — the fleet topology is
+        # already wired) and every pool pod's debug surfaces through the
+        # incremental cursors, stitches cross-replica traces, and serves
+        # /debug/fleet.  Peer-less single-pool gateways still get the
+        # local+pods view (streaming decode spans live only on pods).
+        self.fleet = fleetobs.FleetCollector(
+            self.statebus.replica_id,
+            peer_urls=self.statebus.cfg.peers,
+            pods_fn=self._fleet_pods,
+            local_fn=self._fleet_local_payloads,
+            journal=self.journal)
         # Black-box dump directory + dump-storm cooldown; both env-tunable.
         self.blackbox_dir = (
             blackbox_dir or os.environ.get("LIG_BLACKBOX_DIR")
@@ -264,6 +284,7 @@ class GatewayProxy:
         app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/placement", self.handle_debug_placement)
         app.router.add_get("/debug/statebus", self.handle_debug_statebus)
+        app.router.add_get("/debug/fleet", self.handle_debug_fleet)
         app.router.add_post("/statebus/exchange",
                             self.handle_statebus_exchange)
         app.router.add_get("/debug/events", self.handle_debug_events)
@@ -381,12 +402,18 @@ class GatewayProxy:
 
         def write() -> None:
             try:
+                # Pod profiler snapshots: best-effort bounded fetches off
+                # the event loop (this runs in the executor) — a wedged
+                # pod costs one timeout, never the dump.
+                profiles = fleetobs.collect_pod_profiles(self._fleet_pods())
                 path = slo_mod.write_blackbox(
                     self.blackbox_dir, reason, journal=self.journal,
                     tracer=self.tracer, metrics_text=self._render_metrics(),
                     slo_payload=self.slo.debug_payload(),
                     health_payload=self.health.debug_payload(),
-                    usage_payload=self.usage.debug_payload())
+                    usage_payload=self.usage.debug_payload(),
+                    statebus_payload=self.statebus.debug_payload(),
+                    profile_payload=profiles)
                 self._last_dump_t = time.time()
                 self.journal.emit(events_mod.BREACH_DUMP, model=model,
                                   objective=objective, path=path)
@@ -402,6 +429,32 @@ class GatewayProxy:
             asyncio.get_running_loop().run_in_executor(None, write)
         except RuntimeError:
             write()  # synchronous contexts (tests, CLI tools)
+
+    # -- fleet observability seams -----------------------------------------
+    def _fleet_pods(self) -> list:
+        """Live ``(pod_name, address)`` membership across every pool this
+        gateway fronts — the fleet collector's pod source list."""
+        out = []
+        for stack in self.stacks.values():
+            for pm in stack.provider.all_pod_metrics():
+                out.append((pm.pod.name, pm.pod.address))
+        return out
+
+    def _fleet_local_payloads(self) -> dict:
+        """This replica's own debug payloads, handed to the fleet
+        collector without an HTTP round trip to ourselves."""
+        # The journal pages OLDEST-first from a cursor: anchor the cursor
+        # 512 rows behind the head so the fleet view carries the NEWEST
+        # local events (the pre-breach window), not the ring's stale tail.
+        events_since = max(0, self.journal.seq - 512)
+        return {
+            "traces": tracing.debug_traces_payload(
+                self.tracer, {"limit": "256"}),
+            "events": events_mod.debug_events_payload(
+                self.journal, {"since": str(events_since), "limit": "512"}),
+            "slo": self.slo.debug_payload(),
+            "health": self.health.debug_payload(),
+        }
 
     # -- per-pool routing of data-path signals -----------------------------
     def _stack_for_pod(self, pod_name: str) -> AdvisorStack:
@@ -1269,6 +1322,7 @@ class GatewayProxy:
                 [stack.render() for stack in self.stacks.values()])
         extra = (self.slo.render() + stack_lines
                  + self.statebus.render()
+                 + self.fleet.render()
                  + self.journal.render_prom("gateway_events_total"))
         if extra:
             text += "\n".join(extra) + "\n"
@@ -1374,6 +1428,28 @@ class GatewayProxy:
         per-pool overlay the advisors currently apply —
         ``tools/statebus_report.py`` renders the divergence table."""
         return web.json_response(self.statebus.debug_payload())
+
+    async def handle_debug_fleet(self, request: web.Request) -> web.Response:
+        """The fleet observability view (gateway/fleetobs.py): one pull of
+        every peer gateway's and pool pod's debug surfaces (incremental
+        cursors — deltas only), stitched cross-replica traces, the merged
+        fleet journal, fleet-wide SLO rollup, and per-gateway health.
+        ``?limit=`` caps stitched traces (1..256, default 64).  Rendered
+        by ``tools/fleet_report.py``; dead sources degrade to their
+        cached view with an error marker, never a failed page."""
+        try:
+            limit = max(1, min(int(request.query.get("limit", "64")), 256))
+        except ValueError:
+            limit = 64
+        session = self._session
+        if session is None:
+            # Called before startup (tests, one-shot tools): a throwaway
+            # session is fine at debug-endpoint cadence.
+            async with aiohttp.ClientSession() as tmp:
+                payload = await self.fleet.collect(tmp, limit=limit)
+        else:
+            payload = await self.fleet.collect(session, limit=limit)
+        return web.json_response(payload)
 
     async def handle_statebus_exchange(
             self, request: web.Request) -> web.Response:

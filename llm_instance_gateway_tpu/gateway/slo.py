@@ -383,13 +383,19 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
                    metrics_text: str = "", slo_payload: dict | None = None,
                    health_payload: dict | None = None,
                    usage_payload: dict | None = None,
+                   statebus_payload: dict | None = None,
+                   profile_payload: dict | None = None,
                    clock=time.time) -> str:
     """Write the black-box dump for one breach; returns the file path.
 
     The dump is everything a post-mortem needs in ONE file: the flight
-    recorder's journal, the trace ring, the SLO/health debug payloads, and
-    the raw /metrics text at the moment of the breach.
-    ``tools/blackbox_report.py`` renders it into a timeline.
+    recorder's journal, the trace ring, the SLO/health debug payloads,
+    the replicated-state-bus view (merged vs local snapshots, peer ages,
+    quota scale — was this replica enforcing alone when it burned?), the
+    pool pods' step-profiler snapshots (was the engine dispatch-bound or
+    host-bound at the breach?), and the raw /metrics text at the moment
+    of the breach.  ``tools/blackbox_report.py`` renders it into a
+    timeline with statebus + profiler sections.
     """
     os.makedirs(dir_path, exist_ok=True)
     ts = clock()
@@ -409,6 +415,10 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
         # Who was consuming the pool at the moment of the breach — the
         # first question a fast-burn post-mortem asks (gateway/usage.py).
         "usage": usage_payload,
+        # Fleet context: the statebus divergence view and the pods' step
+        # profiler snapshots (gateway/statebus.py, server/profiler.py).
+        "statebus": statebus_payload,
+        "profile": profile_payload,
         "metrics_text": metrics_text,
     }
     tmp = path + ".tmp"

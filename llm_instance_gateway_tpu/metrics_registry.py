@@ -180,6 +180,20 @@ GATEWAY_FAMILIES = (
     Family("gateway_statebus_exchanges_total", "counter", ("outcome",),
            "Peer push-pull exchange attempts by outcome (ok | error).",
            GATEWAY_SURFACE),
+    Family("gateway_fleet_sources", "gauge", ("kind",),
+           "Sources the fleet collector reached on its last /debug/fleet "
+           "pull, by kind (gateway = statebus peers + self, pod = pool "
+           "replicas; gateway/fleetobs.py).", GATEWAY_SURFACE),
+    Family("gateway_fleet_stitched_traces", "gauge", (),
+           "Cross-replica traces stitched on the last fleet pull.",
+           GATEWAY_SURFACE),
+    Family("gateway_fleet_collect_errors_total", "counter", ("source",),
+           "Fleet-collector pull failures by source (also journaled as "
+           "fleet_peer_error); the source's cached view keeps serving.",
+           GATEWAY_SURFACE),
+    Family("gateway_fleet_collect_seconds", "histogram", (),
+           "Wall time of one full fleet pull (all sources concurrent).",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),
@@ -276,6 +290,16 @@ SERVER_FAMILIES = (
            "away (pool waste).", SERVER_SURFACE),
     Family("tpu:decode_batch_occupancy", "histogram", (),
            "Active-slots / total-slots fraction per decode dispatch.",
+           SERVER_SURFACE),
+    Family("tpu:dispatch_wall_seconds", "histogram", ("phase",),
+           "Per-dispatch device program + host-sync wall by phase "
+           "(prefill | decode | spec); the step-timeline profiler's "
+           "dispatch bucket (server/profiler.py, /debug/profile).",
+           SERVER_SURFACE),
+    Family("tpu:dispatch_gap_seconds", "histogram", ("kind",),
+           "Engine-thread gap between consecutive dispatches (kind=host "
+           "= step-loop overhead the ROADMAP item-2 levers amortize; "
+           "kind=idle = the gap contained a no-work wait).",
            SERVER_SURFACE),
     Family("tpu:events_total", "counter", ("kind",),
            "Replica-side flight-recorder events by kind (served by the "

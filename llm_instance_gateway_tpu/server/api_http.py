@@ -17,7 +17,9 @@ the union of what vLLM exposed to the reference:
                                   tpu:handoff_seconds /
                                   tpu:decode_step_seconds histograms)
 - ``GET  /debug/traces``          recent request traces (span JSON,
-                                  ``?trace_id=`` filter)
+                                  ``?trace_id=`` filter, ``?since=<seq>``
+                                  incremental cursor — the fleet
+                                  collector's delta poll)
 - ``GET  /debug/events``          replica-side flight recorder (admission
                                   rejections, handoff refusals, drain
                                   transitions; ``?since=`` cursor)
@@ -25,6 +27,11 @@ the union of what vLLM exposed to the reference:
                                   (step-seconds / tokens / KV block-seconds
                                   per {adapter, phase} + pool waste;
                                   server/usage.py)
+- ``GET  /debug/profile``         step-timeline profiler snapshot (per-
+                                  dispatch wall / host-sync gap / idle
+                                  attribution + recent dispatch records;
+                                  server/profiler.py, rendered by
+                                  tools/profile_report.py)
 - ``GET  /health``                200 once the engine loop is up
 
 Tracing: every inference request adopts the ``x-lig-trace-id`` header (or
@@ -141,6 +148,7 @@ class ModelServer:
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
+        app.router.add_get("/debug/profile", self.handle_debug_profile)
         app.router.add_get("/health", self.handle_health)
         return app
 
@@ -1339,6 +1347,20 @@ class ModelServer:
             "residency": snap.get("residency", {}),
             "usage": flat,
         })
+
+    async def handle_debug_profile(self, request: web.Request) -> web.Response:
+        """The step-timeline profiler's full payload (server/profiler.py):
+        dispatch/host-sync/idle attribution summary, wall+gap histogram
+        states, and the newest per-dispatch records — what
+        ``tools/profile_report.py`` renders and the fleet collector's
+        black-box dumps embed.  404 when ``step_profile`` is off."""
+        profiler = getattr(self.engine, "profiler", None)
+        if profiler is None:
+            return _err(404, "step profiler is disabled "
+                             "(EngineConfig.step_profile=False)")
+        return web.json_response({"model": self.model_name,
+                                  "role": self.engine.cfg.role,
+                                  **profiler.snapshot()})
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.engine.draining:

@@ -43,6 +43,7 @@ from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
 from llm_instance_gateway_tpu.server.sampling import sample
+from llm_instance_gateway_tpu.server.profiler import StepProfiler
 from llm_instance_gateway_tpu.server.usage import UsageTracker, owner_key
 from llm_instance_gateway_tpu.tracing import LATENCY_BUCKETS, Histogram
 
@@ -180,6 +181,14 @@ class EngineConfig:
     # the off switch exists for the bench.py overhead A/B
     # (usage_attribution_ratio), not for production use.
     usage_attribution: bool = True
+    # Step-timeline profiler (server/profiler.py): per-dispatch wall /
+    # host-sync gap / idle attribution in a bounded ring, exported as
+    # tpu:dispatch_wall_seconds / tpu:dispatch_gap_seconds and served by
+    # /debug/profile — the evidence layer for the dispatch-bound decode
+    # levers (ROADMAP item 2).  Like usage_attribution, the off switch
+    # exists for the bench A/B (step_profile_ratio <= 1.05), not for
+    # production use.
+    step_profile: bool = True
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -635,6 +644,12 @@ class Engine:
         self.usage: UsageTracker | None = (
             UsageTracker(b, kv_block=self._block if self.paged else 1)
             if self.cfg.usage_attribution else None)
+        # Step-timeline profiler (server/profiler.py): charged at the
+        # same dispatch call sites as the usage tracker, plus idle marks
+        # from the engine loop, so the dispatch/host-sync/idle attribution
+        # tiles the engine thread's wall.
+        self.profiler: StepProfiler | None = (
+            StepProfiler() if self.cfg.step_profile else None)
 
         if self.paged:
             step_fn = paged_lib.decode_step_paged
@@ -1261,6 +1276,11 @@ class Engine:
             # tpu:adapter_*_total / pool-waste families.
             **({"usage": self.usage.snapshot()}
                if self.usage is not None else {}),
+            # Step-timeline profiler histogram states (server/profiler.py)
+            # — the tpu:dispatch_wall_seconds / tpu:dispatch_gap_seconds
+            # families; the full per-dispatch ring rides /debug/profile.
+            **({"profile": self.profiler.hist_state()}
+               if self.profiler is not None else {}),
             **({"prefix_reused_tokens": self.prefix_reused_tokens}
                if self._prefix_enabled else {}),
             **({
@@ -1550,6 +1570,8 @@ class Engine:
                     self._fail_all_slots(e)
                 did_work = True
             if not did_work:
+                if self.profiler is not None:
+                    self.profiler.note_idle()
                 with self._work:
                     self._work.wait(timeout=0.05)
 
@@ -2236,6 +2258,10 @@ class Engine:
         if self.usage is not None:
             self.usage.charge_decode(step_s, owners, tok_by_owner)
             self._usage_sync_kv()
+        if self.profiler is not None:
+            self.profiler.note_dispatch(
+                "spec", t0, step_s, active=len(owners),
+                total_slots=self.cfg.decode_slots, n_steps=t_steps)
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0
@@ -2301,8 +2327,7 @@ class Engine:
             self._sync_tables()
             c = n - reused
             bucket = self._bucket(c)
-            if self.usage is not None:
-                self.usage.charge_padding(bucket - c)
+            self._note_padding(bucket - c)
             tokens = np.zeros((bucket,), np.int32)
             tokens[:c] = req.prompt_tokens[reused:]
             positions = reused + np.arange(bucket, dtype=np.int32)
@@ -2352,8 +2377,7 @@ class Engine:
 
         sp = req.sampling
         padded = -(-n // self._ring_pad) * self._ring_pad
-        if self.usage is not None:
-            self.usage.charge_padding(padded - n)
+        self._note_padding(padded - n)
         tokens = np.zeros((1, padded), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.broadcast_to(
@@ -2379,8 +2403,7 @@ class Engine:
         Returns (first_token device scalar, k, v, lp_info)."""
         sp = req.sampling
         bucket = self._bucket(n)
-        if self.usage is not None:
-            self.usage.charge_padding(bucket - n)
+        self._note_padding(bucket - n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
         positions = np.zeros((1, bucket), np.int32)
@@ -2400,8 +2423,7 @@ class Engine:
         Returns (first_tokens [P] device, k [L,P,S,...], v, lp_infos)."""
         bucket = self._bucket(max(ns))
         p = len(reqs)
-        if self.usage is not None:
-            self.usage.charge_padding(sum(bucket - n for n in ns))
+        self._note_padding(sum(bucket - n for n in ns))
         tokens = np.zeros((p, bucket), np.int32)
         positions = np.zeros((p, bucket), np.int32)
         for i, (req, n) in enumerate(zip(reqs, ns)):
@@ -2838,6 +2860,25 @@ class Engine:
                 max(0.0, req.t_first_token - req.t_prefill_start),
                 [req.adapter],
                 tokens={owner_key(req.adapter): len(req.prompt_tokens)})
+        if (self.profiler is not None
+                and req.t_prefill_start and req.t_first_token):
+            # t0=None: the prefill wall is time.time-stamped, so it can't
+            # anchor the perf_counter gap chain — the profiler records
+            # the wall and subtracts it from the next gap instead.
+            self.profiler.note_dispatch(
+                "prefill", None,
+                max(0.0, req.t_first_token - req.t_prefill_start),
+                active=1, total_slots=self.cfg.decode_slots,
+                n_steps=len(req.prompt_tokens))
+
+    def _note_padding(self, pad_tokens: int) -> None:
+        """Bucket/ring padding tokens prefilled and thrown away: counted
+        by the usage tracker's pool-waste counter AND the step profiler's
+        snapshot (both optional)."""
+        if self.usage is not None:
+            self.usage.charge_padding(pad_tokens)
+        if self.profiler is not None:
+            self.profiler.note_padding(pad_tokens)
 
     def _usage_sync_kv(self) -> None:
         """Refresh the attribution tracker's KV-holdings integral (engine
@@ -3041,6 +3082,10 @@ class Engine:
         if self.usage is not None:
             self.usage.charge_decode(step_s, owners, tok_by_owner)
             self._usage_sync_kv()
+        if self.profiler is not None:
+            self.profiler.note_dispatch(
+                "decode", t0, step_s, active=len(owners),
+                total_slots=self.cfg.decode_slots, n_steps=n_steps)
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0
@@ -3107,6 +3152,8 @@ class Engine:
                 did_work = True
             inflight = block
             if not did_work:
+                if self.profiler is not None:
+                    self.profiler.note_idle()
                 with self._work:
                     self._work.wait(timeout=0.05)
         if inflight is not None:
@@ -3341,6 +3388,14 @@ class Engine:
         if self.usage is not None:
             self.usage.charge_decode(step_s, owners, tok_by_owner)
             self._usage_sync_kv()
+        if self.profiler is not None:
+            # Pipelined blocks overlap: block N+1's dispatch stamp
+            # predates block N's process end, so the profiler's gap math
+            # clamps to ~0 host-sync — exactly what the pipeline buys.
+            self.profiler.note_dispatch(
+                "spec" if blk.get("spec") else "decode", blk["t0"], step_s,
+                active=len(owners), total_slots=self.cfg.decode_slots,
+                n_steps=blk["n_steps"])
         with self._lock:
             self.total_generated += n_tokens
             inst = n_tokens / step_s if step_s > 0 else 0.0

@@ -145,6 +145,15 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         from llm_instance_gateway_tpu.server.usage import render_usage
 
         lines += render_usage(usage, snapshot.get("model_name", ""))
+    profile = snapshot.get("profile")
+    if profile:
+        # Step-timeline profiler (server/profiler.py): per-phase dispatch
+        # wall + host-sync/idle gap histograms — the dispatch-bound
+        # evidence families (tools/profile_report.py renders the
+        # attribution table from /debug/profile).
+        from llm_instance_gateway_tpu.server.profiler import render_profile
+
+        lines += render_profile(profile)
     for name, value in (extra or {}).items():
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")

@@ -257,6 +257,14 @@ class Tracer:
     records, so old traces age out naturally.  Sampling is decided per
     TRACE (deterministic hash of the id), so multi-process traces are
     complete or absent, never partial.
+
+    Every record carries a monotonic sequence number (the events.py
+    journal convention), so ``/debug/traces?since=<seq>`` serves
+    incremental reads — the fleet collector and ``--watch`` tooling poll
+    deltas instead of re-shipping the whole ring.  The bump is a plain
+    attribute increment, not a lock: recorders run under the GIL and a
+    rare duplicate seq under thread races costs a poller one re-shipped
+    span, never a lost one.
     """
 
     def __init__(self, capacity: int | None = None,
@@ -270,8 +278,14 @@ class Tracer:
         self.capacity = max(1, capacity)
         self.sample = sample
         self.enabled = enabled
+        self._seq = 0
         self._ring: collections.deque = collections.deque(
             maxlen=self.capacity * 16)
+
+    @property
+    def seq(self) -> int:
+        """Head sequence number (the newest record's seq)."""
+        return self._seq
 
     def sampled(self, trace_id: str) -> bool:
         if not self.enabled:
@@ -287,37 +301,47 @@ class Tracer:
                **attrs) -> None:
         if not trace_id or not self.sampled(trace_id):
             return
+        self._seq = seq = self._seq + 1
         self._ring.append(
-            (trace_id, name, float(start), float(end), attrs or None))
+            (seq, trace_id, name, float(start), float(end), attrs or None))
 
     def record_wire(self, trace_id: str, value: str | None) -> None:
         """Merge spans from a downstream ``x-lig-spans`` header."""
         if not value or not trace_id or not self.sampled(trace_id):
             return
         for n, s, e in parse_wire(value):
-            self._ring.append((trace_id, n, s, e, None))
+            self._seq = seq = self._seq + 1
+            self._ring.append((seq, trace_id, n, s, e, None))
 
     def annotate(self, trace_id: str, model: str | None = None,
                  path: str | None = None, status: str | None = None) -> None:
         if not trace_id or not self.sampled(trace_id):
             return
-        self._ring.append((trace_id, _META, model, path, status))
+        self._seq = seq = self._seq + 1
+        self._ring.append((seq, trace_id, _META, model, path, status))
 
     # -- export (the /debug/traces JSON shape) ------------------------------
 
-    def _collect(self) -> "collections.OrderedDict[str, dict]":
-        """Group the flat ring into trace dicts, ordered by last activity."""
+    def _collect(self, since: int = 0) -> "collections.OrderedDict[str, dict]":
+        """Group the flat ring into trace dicts, ordered by last activity.
+        ``since`` skips records with seq <= since (the incremental-cursor
+        read); each trace carries ``seq`` = its newest included record."""
         traces: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
         for rec in list(self._ring):  # snapshot: appends may race the walk
-            tid = rec[0]
+            seq, tid = rec[0], rec[1]
+            if seq <= since:
+                continue
             t = traces.get(tid)
             if t is None:
                 t = traces[tid] = {"trace_id": tid, "model": "", "path": "",
-                                   "status": "", "spans": []}
+                                   "status": "", "seq": seq, "_min_seq": seq,
+                                   "spans": []}
             else:
                 traces.move_to_end(tid)
-            if rec[1] is _META:
-                _, _, model, path, status = rec
+            t["seq"] = max(t["seq"], seq)
+            t["_min_seq"] = min(t["_min_seq"], seq)
+            if rec[2] is _META:
+                _, _, _, model, path, status = rec
                 if model is not None:
                     t["model"] = model
                 if path is not None:
@@ -325,7 +349,7 @@ class Tracer:
                 if status is not None:
                     t["status"] = str(status)
             else:
-                _, name, s, e, attrs = rec
+                _, _, name, s, e, attrs = rec
                 t["spans"].append(
                     {"name": name, "start": round(s, 6), "end": round(e, 6),
                      **({"attrs": attrs} if attrs else {})})
@@ -335,6 +359,7 @@ class Tracer:
     def _export(t: dict) -> dict:
         spans = sorted(t["spans"], key=lambda x: (x["start"], x["end"]))
         t_created = spans[0]["start"] if spans else 0.0
+        t = {k: v for k, v in t.items() if k != "_min_seq"}
         return {**t, "t_created": t_created, "spans": spans}
 
     def get(self, trace_id: str) -> dict | None:
@@ -349,17 +374,47 @@ class Tracer:
         out.reverse()
         return out
 
+    def since(self, since: int, limit: int = 1024) -> tuple[list[dict], int]:
+        """(partial trace dicts holding only records with seq > ``since``,
+        next_since cursor).  The page is the ``limit`` traces whose OLDEST
+        new record is earliest, and on truncation the cursor retreats to
+        just before the first excluded trace's oldest record — resuming
+        from it can re-ship a few records of an included trace (the
+        stitcher dedups spans) but can never skip one, the events.py
+        lossless-paging contract lifted to trace granularity."""
+        traces = sorted(self._collect(since).values(),
+                        key=lambda t: t["_min_seq"])
+        limit = max(0, limit)
+        page, excluded = traces[:limit], traces[limit:]
+        if excluded:
+            next_since = min(t["_min_seq"] for t in excluded) - 1
+        else:
+            next_since = max((t["seq"] for t in page), default=self._seq)
+        return [self._export(t) for t in page], next_since
+
 
 def debug_traces_payload(tracer: Tracer, query) -> dict:
     """The shared ``/debug/traces`` response body: ``?trace_id=`` exact
-    filter, ``?limit=`` count cap (1..1024, default 64).  One contract for
-    the proxy and api_http endpoints."""
+    filter, ``?limit=`` count cap (1..1024, default 64), and the
+    incremental cursor ``?since=<seq>`` (the /debug/events contract:
+    poll with ``since=next_since`` until ``next_since == seq``) returning
+    only records newer than the cursor, grouped per trace.  One contract
+    for the proxy and api_http endpoints."""
     trace_id = query.get("trace_id")
     if trace_id:
         t = tracer.get(trace_id)
-        return {"traces": [t] if t else []}
+        return {"traces": [t] if t else [], "seq": tracer.seq}
     try:
         limit = max(1, min(int(query.get("limit", "64")), 1024))
     except ValueError:
         limit = 64
-    return {"traces": tracer.recent(limit)}
+    raw_since = query.get("since")
+    if raw_since is not None:
+        try:
+            since = max(0, int(raw_since))
+        except ValueError:
+            since = 0
+        rows, next_since = tracer.since(since, limit)
+        return {"traces": rows, "seq": tracer.seq,
+                "next_since": next_since}
+    return {"traces": tracer.recent(limit), "seq": tracer.seq}

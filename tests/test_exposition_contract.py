@@ -104,6 +104,7 @@ def loaded_gateway_metrics() -> GatewayMetrics:
 
 
 def server_snapshot() -> dict:
+    from llm_instance_gateway_tpu.server import profiler as profiler_mod
     from llm_instance_gateway_tpu.server import usage as usage_mod
 
     hist = tracing.Histogram(tracing.LATENCY_BUCKETS)
@@ -112,7 +113,17 @@ def server_snapshot() -> dict:
     occupancy = tracing.Histogram(usage_mod.OCCUPANCY_BUCKETS)
     occupancy.observe(0.5)
     occupancy.observe(1.0)
+    # Step-timeline profiler (server/profiler.py): one dispatch per
+    # phase plus a host gap and an idle gap, so every label value of the
+    # tpu:dispatch_* families renders.
+    prof = profiler_mod.StepProfiler()
+    prof.note_dispatch("prefill", None, 0.3, active=1, total_slots=4)
+    prof.note_dispatch("decode", 0.0, 0.1, active=2, total_slots=4)
+    prof.note_dispatch("decode", 0.15, 0.1, active=2, total_slots=4)
+    prof.note_idle()
+    prof.note_dispatch("spec", 0.5, 0.1, active=2, total_slots=4)
     return {
+        "profile": prof.hist_state(),
         "model_name": HOSTILE,
         "pool_role": "prefill",
         "prefill_queue_size": 2,
@@ -217,6 +228,14 @@ def test_server_render_contract():
     info = families["tpu:lora_requests_info"][0].labels
     assert info["running_lora_adapters"] == f"a1,{HOSTILE}"
     assert info["waiting_lora_adapters"] == HOSTILE
+    # Step-timeline profiler families (server/profiler.py): per-phase
+    # dispatch walls, host vs idle gap kinds, true histogram series.
+    wall_phases = {s.labels["phase"]
+                   for s in families["tpu:dispatch_wall_seconds_bucket"]}
+    assert wall_phases == {"prefill", "decode", "spec"}
+    gap_kinds = {s.labels["kind"]: s.value
+                 for s in families["tpu:dispatch_gap_seconds_count"]}
+    assert gap_kinds == {"host": 1, "idle": 1}
 
 
 def test_proxy_metrics_endpoint_round_trips():
@@ -646,6 +665,43 @@ def test_statebus_exposition_contract():
     assert {s.labels["outcome"] for s in
             families["gateway_statebus_exchanges_total"]} == {"ok", "error"}
     assert "gateway_statebus_merge_seconds_bucket" in families
+
+
+def loaded_fleet_collector():
+    """A REAL FleetCollector with a hostile source name in its error
+    counter and one collect's worth of gauge state (shared with the
+    docs-coverage test)."""
+    from llm_instance_gateway_tpu.gateway.fleetobs import FleetCollector
+
+    collector = FleetCollector("gw-self", peer_urls=("http://peer:1",))
+    collector.errors_total[HOSTILE] = 2
+    collector.last_sources = {"gateway": 1, "pod": 3}
+    collector.last_stitched = 7
+    collector.collect_hist.observe(0.02)
+    return collector
+
+
+def test_fleet_collector_exposition_contract():
+    """Fleet satellite: gateway_fleet_sources / stitched-traces gauges,
+    the per-source error counter (hostile source name round-tripping),
+    and the collect-latency histogram lint clean."""
+    collector = loaded_fleet_collector()
+    text = "\n".join(collector.render()) + "\n"
+    families = lint_exposition(text)
+    types = {line.split(" ")[2]: line.split(" ")[3]
+             for line in text.splitlines() if line.startswith("# TYPE ")}
+    assert types["gateway_fleet_sources"] == "gauge"
+    assert types["gateway_fleet_stitched_traces"] == "gauge"
+    assert types["gateway_fleet_collect_errors_total"] == "counter"
+    assert types["gateway_fleet_collect_seconds"] == "histogram"
+    kinds = {s.labels["kind"]: s.value
+             for s in families["gateway_fleet_sources"]}
+    assert kinds == {"gateway": 1, "pod": 3}
+    assert families["gateway_fleet_stitched_traces"][0].value == 7
+    errs = {s.labels["source"]: s.value
+            for s in families["gateway_fleet_collect_errors_total"]}
+    assert errs == {HOSTILE: 2}
+    assert "gateway_fleet_collect_seconds_bucket" in families
 
 
 def test_multipool_merged_exposition_round_trips():

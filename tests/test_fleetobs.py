@@ -1,0 +1,682 @@
+"""Fleet observability plane tests (gateway/fleetobs.py).
+
+The stitcher's contract under hostile input: duplicate span names across
+replicas stay distinguishable, skewed clocks normalize against the
+serving gateway's hop spans, missing hops degrade to an unshifted
+partial timeline, and partial ``x-lig-spans`` rows are skipped per-span
+— never a failed stitch.  The collector's contract: incremental cursors
+(deltas, not the whole ring), dead sources degrade to their cached view
+with an error marker, and ``/debug/fleet`` serves the stitched result on
+every replica.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu import tracing
+from llm_instance_gateway_tpu.gateway import fleetobs
+
+
+def span(name, start, end, **attrs):
+    s = {"name": name, "start": start, "end": end}
+    if attrs:
+        s["attrs"] = attrs
+    return s
+
+
+def payload(trace_id, spans, **fields):
+    return {"traces": [{"trace_id": trace_id, "spans": spans, **fields}]}
+
+
+class TestStitcher:
+    def test_merges_sources_and_dedups_gateway_copy(self):
+        """The gateway already merged the server's spans off the
+        x-lig-spans header: the stitcher must fold the duplicate, keep
+        the union of sources, and merge summary fields."""
+        gw = payload("t1", [span("gateway.admission", 100.0, 100.01),
+                            span("gateway.upstream", 100.01, 100.5),
+                            span("engine.prefill", 100.05, 100.2)],
+                     model="m", path="collocated")
+        pod = payload("t1", [span("engine.prefill", 100.05, 100.2),
+                             span("engine.decode", 100.2, 100.45)],
+                      status="ok")
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-1", pod)])
+        assert len(out) == 1
+        t = out[0]
+        assert t["sources"] == ["gw-a", "pod-1"]
+        assert t["model"] == "m" and t["status"] == "ok"
+        names = [s["name"] for s in t["spans"]]
+        assert names.count("engine.prefill") == 1  # deduped
+        # The decode span only the pod recorded made the timeline.
+        decode = next(s for s in t["spans"] if s["name"] == "engine.decode")
+        assert decode["source"] == "pod-1"
+
+    def test_duplicate_span_names_across_replicas_stay_distinct(self):
+        """Two replicas legitimately record the same span NAME for one
+        trace (a retried upstream, both hops' engines): different
+        boundaries are different spans, attributed per source."""
+        a = payload("t1", [span("engine.decode", 10.0, 10.5)])
+        b = payload("t1", [span("engine.decode", 11.0, 11.5)])
+        out = fleetobs.stitch_traces([("pod-a", a), ("pod-b", b)])
+        decodes = [s for s in out[0]["spans"] if s["name"] == "engine.decode"]
+        assert len(decodes) == 2
+        assert {s["source"] for s in decodes} == {"pod-a", "pod-b"}
+
+    def test_skewed_clock_normalizes_against_hop_span(self):
+        """A pod whose clock is ~50s behind: its spans appear to start
+        before the gateway even sent the request.  The stitcher shifts
+        the WHOLE source so its anchor lands at the covering hop's start,
+        and records the applied skew."""
+        gw = payload("t1", [span("gateway.admission", 100.0, 100.01),
+                            span("gateway.prefill_hop", 100.01, 100.6)])
+        pod = payload("t1", [span("engine.prefill", 50.0, 50.3),
+                             span("handoff.serialize", 50.3, 50.35)])
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-pre", pod)])
+        t = out[0]
+        assert t["skew"] == {"gateway.prefill_hop": pytest.approx(50.01)}
+        prefill = next(s for s in t["spans"]
+                       if s["name"] == "engine.prefill")
+        hop = next(s for s in t["spans"]
+                   if s["name"] == "gateway.prefill_hop")
+        assert prefill["start"] == pytest.approx(hop["start"])
+        # Causal order restored: admission precedes the shifted prefill.
+        names = [s["name"] for s in t["spans"]]
+        assert names.index("gateway.admission") < names.index(
+            "engine.prefill")
+
+    def test_wire_copies_on_the_gateway_still_normalize(self):
+        """The production shape: the serving gateway's /debug/traces
+        ALREADY carries the pod's spans (merged off x-lig-spans) at the
+        POD'S skewed timestamps.  Dedup keeps the gateway's copy — skew
+        must still apply, because clock domains follow span NAMES, not
+        which replica shipped the span."""
+        gw = payload("t1", [
+            span("gateway.admission", 100.0, 100.01),
+            span("gateway.prefill_hop", 100.01, 100.6),
+            # The pod's spans as the gateway merged them: pod clock -50s.
+            span("engine.prefill", 50.0, 50.3),
+            span("handoff.serialize", 50.3, 50.35),
+        ])
+        pod = payload("t1", [span("engine.prefill", 50.0, 50.3),
+                             span("handoff.serialize", 50.3, 50.35)])
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-pre", pod)])
+        t = out[0]
+        assert t["skew"] == {"gateway.prefill_hop": pytest.approx(50.01)}
+        prefill = next(s for s in t["spans"]
+                       if s["name"] == "engine.prefill")
+        assert prefill["source"] == "gw-a"  # deduped to the first source
+        assert prefill["start"] == pytest.approx(100.01)  # ...but shifted
+        names = [s["name"] for s in t["spans"]]
+        assert names.index("gateway.admission") < names.index(
+            "engine.prefill")
+
+    def test_synced_clocks_stay_unshifted(self):
+        gw = payload("t1", [span("gateway.admission", 100.0, 100.01),
+                            span("gateway.upstream", 100.01, 100.6)])
+        pod = payload("t1", [span("engine.prefill", 100.05, 100.2)])
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-1", pod)])
+        assert out[0]["skew"] == {}
+        prefill = next(s for s in out[0]["spans"]
+                       if s["name"] == "engine.prefill")
+        assert prefill["start"] == pytest.approx(100.05)
+
+    def test_missing_hops_tolerated(self):
+        """A pod view with NO gateway source at all (or no matching hop
+        span): no reference to normalize against — the partial timeline
+        renders unshifted instead of being invented or dropped."""
+        pod = payload("t1", [span("engine.prefill", 50.0, 50.3)])
+        out = fleetobs.stitch_traces([("pod-1", pod)])
+        assert out[0]["skew"] == {}
+        assert out[0]["spans"][0]["start"] == pytest.approx(50.0)
+        # Gateway present but without a covering hop for this source.
+        gw = payload("t2", [span("gateway.admission", 100.0, 100.01)])
+        foreign = payload("t2", [span("custom.phase", 50.0, 50.2)])
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-1", foreign)])
+        assert out[0]["skew"] == {}
+
+    def test_partial_and_hostile_spans_degrade_per_item(self):
+        bad = {"traces": [
+            {"trace_id": "t1", "spans": [
+                span("ok.span", 1.0, 2.0),
+                {"name": "no.end", "start": 1.0},
+                {"name": "bad.types", "start": "x", "end": "y"},
+                "not-a-span",
+                {"name": "inverted", "start": 5.0, "end": 4.0},
+            ]},
+            {"spans": [span("no.trace.id", 0.0, 1.0)]},
+            "not-a-trace",
+        ]}
+        out = fleetobs.stitch_traces([("pod-1", bad), ("pod-2", None),
+                                      ("pod-3", {"traces": "nope"})])
+        assert len(out) == 1
+        names = {s["name"] for s in out[0]["spans"]}
+        assert names == {"ok.span", "inverted"}
+        inv = next(s for s in out[0]["spans"] if s["name"] == "inverted")
+        assert inv["start"] <= inv["end"]  # normalized, not dropped
+
+    def test_two_hop_causal_order_with_both_pods_skewed(self):
+        """The e2e shape in miniature: gateway + prefill pod (clock -50s)
+        + decode pod (clock +30s) stitch into one monotonic chain."""
+        gw = payload("t1", [
+            span("gateway.admission", 100.0, 100.02),
+            span("gateway.prefill_hop", 100.02, 100.4),
+            span("gateway.attach_hop", 100.4, 100.9),
+        ])
+        pre = payload("t1", [span("engine.queue_wait", 50.03, 50.05),
+                             span("engine.prefill", 50.05, 50.3),
+                             span("handoff.serialize", 50.3, 50.35)])
+        dec = payload("t1", [span("handoff.deserialize", 130.41, 130.45),
+                             span("handoff.attach", 130.45, 130.5),
+                             span("engine.decode", 130.5, 130.85)])
+        out = fleetobs.stitch_traces([("gw-a", gw), ("pod-pre", pre),
+                                      ("pod-dec", dec)])
+        t = out[0]
+        assert set(t["skew"]) == {"gateway.prefill_hop",
+                                  "gateway.attach_hop"}
+        chain = ["gateway.admission", "engine.queue_wait", "engine.prefill",
+                 "handoff.serialize", "handoff.deserialize",
+                 "handoff.attach", "engine.decode"]
+        starts = {s["name"]: s["start"] for s in t["spans"]}
+        for a, b in zip(chain, chain[1:]):
+            assert starts[a] <= starts[b] + 1e-6, (a, b, starts)
+
+    def test_limit_keeps_most_recent(self):
+        sources = [("gw", {"traces": [
+            {"trace_id": f"t{i}",
+             "spans": [span("s", float(i), float(i) + 0.5)]}
+            for i in range(10)]})]
+        out = fleetobs.stitch_traces(sources, limit=3)
+        assert [t["trace_id"] for t in out] == ["t9", "t8", "t7"]
+
+    def test_t_last_is_max_end_not_last_sorted_span(self):
+        """An enclosing span (gateway.upstream around its engine
+        children) ends last but sorts by START — recency must rank by
+        true last activity or the limit cut drops the freshest trace."""
+        enclosing = payload("t1", [span("gateway.upstream", 0.0, 10.0),
+                                   span("engine.prefill", 1.0, 2.0)])
+        later_start = payload("t2", [span("gateway.upstream", 4.0, 5.0)])
+        out = fleetobs.stitch_traces([("gw", enclosing),
+                                      ("gw2", later_start)])
+        assert [t["trace_id"] for t in out] == ["t1", "t2"]
+        assert out[0]["t_last"] == pytest.approx(10.0)
+
+
+class TestMergeEvents:
+    def test_merge_by_replica_seq_dedups_and_orders(self):
+        a = {"events": [{"seq": 1, "ts": 10.0, "kind": "pick"},
+                        {"seq": 2, "ts": 12.0, "kind": "shed"}]}
+        a_repoll = {"events": [{"seq": 2, "ts": 12.0, "kind": "shed"}]}
+        b = {"events": [{"seq": 1, "ts": 11.0, "kind": "retry"}]}
+        rows = fleetobs.merge_events([("gw-a", a), ("gw-a", a_repoll),
+                                      ("gw-b", b), ("gw-c", None)])
+        assert [(e["replica"], e["seq"]) for e in rows] == [
+            ("gw-a", 1), ("gw-b", 1), ("gw-a", 2)]
+
+    def test_limit_keeps_newest(self):
+        src = {"events": [{"seq": i, "ts": float(i), "kind": "pick"}
+                          for i in range(10)]}
+        rows = fleetobs.merge_events([("gw", src)], limit=3)
+        assert [e["seq"] for e in rows] == [7, 8, 9]
+
+    def test_hostile_rows_degrade_per_row(self):
+        """A foreign/older peer's journal shape (missing seq, string ts)
+        must never fail the merged page — the collector caches rows, so
+        one crash here would poison every later /debug/fleet pull."""
+        src = {"events": [
+            {"kind": "no-seq"},   # lenient: admitted as seq 0
+            {"seq": "NaN", "kind": "bad-seq"},  # un-int-able: skipped
+            {"seq": 1, "ts": "yesterday", "kind": "bad-ts"},  # ts -> 0
+            {"seq": 2, "ts": 5.0, "kind": "ok"},
+        ]}
+        rows = fleetobs.merge_events([("gw", src)])
+        assert [e["kind"] for e in rows] == ["no-seq", "bad-ts", "ok"]
+
+
+class TestFleetSlo:
+    def test_good_total_sum_and_worst_burn(self):
+        a = {"models": {"m": {"ttft": {
+            "good": 90, "total": 100, "state": "ok",
+            "burn_rates": {"short": 0.5, "long": 1.2}}}}}
+        b = {"models": {"m": {"ttft": {
+            "good": 40, "total": 100, "state": "fast_burn",
+            "burn_rates": {"short": 20.0, "long": None}}}}}
+        out = fleetobs.fleet_slo({"gw-a": a, "gw-b": b})
+        agg = out["models"]["m"]["ttft"]
+        assert agg["good"] == 130 and agg["total"] == 200
+        assert agg["compliance"] == pytest.approx(0.65)
+        assert agg["worst_burn"] == pytest.approx(20.0)
+        assert agg["worst_burn_replica"] == "gw-b"
+        assert agg["states"] == {"gw-a": "ok", "gw-b": "fast_burn"}
+        assert out["replicas"] == ["gw-a", "gw-b"]
+
+    def test_hostile_payloads_skipped(self):
+        out = fleetobs.fleet_slo({"gw-a": None, "gw-b": {"models": "x"},
+                                  "gw-c": {"models": {"m": {"ttft": {
+                                      "good": "NaNsense", "total": 10,
+                                  }}}}})
+        assert out["models"]["m"]["ttft"]["good"] == 0
+
+
+def make_peer(name):
+    """A fake gateway peer: REAL Tracer + EventJournal behind the real
+    payload contracts, served over aiohttp — what the collector's
+    incremental cursors actually poll."""
+    tracer = tracing.Tracer()
+    journal = events_mod.EventJournal()
+
+    async def traces(request):
+        from aiohttp import web
+
+        return web.json_response(
+            tracing.debug_traces_payload(tracer, request.query))
+
+    async def events(request):
+        from aiohttp import web
+
+        return web.json_response(
+            events_mod.debug_events_payload(journal, request.query))
+
+    async def slo(request):
+        from aiohttp import web
+
+        return web.json_response({"models": {"m": {"ttft": {
+            "good": 9, "total": 10, "state": "ok",
+            "burn_rates": {"short": 0.4}}}}})
+
+    async def health(request):
+        from aiohttp import web
+
+        return web.json_response({"pods": {f"{name}-pod": {"score": 1.0}}})
+
+    from aiohttp import web
+
+    app = web.Application()
+    app.router.add_get("/debug/traces", traces)
+    app.router.add_get("/debug/events", events)
+    app.router.add_get("/debug/slo", slo)
+    app.router.add_get("/debug/health", health)
+    return app, tracer, journal
+
+
+class TestCollector:
+    def test_incremental_cursors_and_dead_peer_degrades(self):
+        async def run():
+            import aiohttp
+
+            app, tracer, journal = make_peer("peer-a")
+            peer = TestServer(app)
+            await peer.start_server()
+            journal_local = events_mod.EventJournal()
+            try:
+                base = f"http://{peer.host}:{peer.port}"
+                dead = "http://127.0.0.1:1"
+                collector = fleetobs.FleetCollector(
+                    "gw-self", peer_urls=(base, dead),
+                    journal=journal_local)
+                now = time.time()
+                tracer.record("t1", "gateway.admission", now, now + 0.01)
+                journal.emit(events_mod.PICK, "t1", pod="p")
+                async with aiohttp.ClientSession() as session:
+                    out1 = await collector.collect(session)
+                    st = collector._state(f"gw:{base}")
+                    since1 = st.trace_since
+                    assert since1 > 0  # cursor advanced
+                    # New activity between polls arrives as a DELTA and
+                    # folds into the cached trace.
+                    tracer.record("t1", "gateway.upstream", now + 0.01,
+                                  now + 0.2)
+                    out2 = await collector.collect(session)
+                    assert st.trace_since > since1
+                assert len(out1["traces"]) == 1
+                t2 = next(t for t in out2["traces"]
+                          if t["trace_id"] == "t1")
+                assert {s["name"] for s in t2["spans"]} == {
+                    "gateway.admission", "gateway.upstream"}
+                # The dead peer is a marker, not a failure.
+                rows = {s["name"]: s for s in out2["sources"]}
+                assert rows[f"gw:{dead}"]["ok"] is False
+                assert rows[f"gw:{dead}"]["error"]
+                assert rows[f"gw:{base}"]["ok"] is True
+                assert any(e["kind"] == events_mod.FLEET_PEER_ERROR
+                           for e in journal_local.events(limit=100))
+                # Fleet SLO folded the live peer's payload.
+                assert out2["slo"]["models"]["m"]["ttft"]["total"] == 10
+                # Merged journal carries (replica, seq) attribution.
+                assert any(e["replica"] == f"gw:{base}" and e["seq"] == 1
+                           for e in out2["events"])
+                # Exposition families render.
+                text = "\n".join(collector.render())
+                assert "gateway_fleet_sources" in text
+                assert "gateway_fleet_collect_errors_total" in text
+            finally:
+                await peer.close()
+
+        asyncio.run(run())
+
+
+    def test_non_dict_json_peer_degrades_to_error_marker(self):
+        """Valid JSON of the wrong shape (a list from a misconfigured
+        peer URL) must mark THAT source failed, never 500 the page."""
+
+        async def run():
+            import aiohttp
+            from aiohttp import web
+
+            async def not_a_dict(request):
+                return web.json_response([])
+
+            app = web.Application()
+            for route in ("/debug/traces", "/debug/events"):
+                app.router.add_get(route, not_a_dict)
+            peer = TestServer(app)
+            await peer.start_server()
+            try:
+                base = f"http://{peer.host}:{peer.port}"
+                collector = fleetobs.FleetCollector(
+                    "gw-self", peer_urls=(base,))
+                async with aiohttp.ClientSession() as session:
+                    out = await collector.collect(session)
+                row = next(s for s in out["sources"]
+                           if s["name"] == f"gw:{base}")
+                assert row["ok"] is False and "non-dict" in row["error"]
+            finally:
+                await peer.close()
+
+        asyncio.run(run())
+
+    def test_departed_sources_are_pruned(self):
+        """Pod churn mints new names forever: a departed pod's cached
+        state and its errors_total series must not grow memory and
+        metric cardinality monotonically."""
+
+        async def run():
+            import aiohttp
+
+            pods = [("old-pod", "127.0.0.1:1")]
+            collector = fleetobs.FleetCollector(
+                "gw-self", pods_fn=lambda: list(pods))
+            async with aiohttp.ClientSession() as session:
+                await collector.collect(session)
+                assert "pod:old-pod" in collector._sources
+                assert "pod:old-pod" in collector.errors_total
+                pods[:] = [("new-pod", "127.0.0.1:1")]  # reschedule
+                await collector.collect(session)
+            assert "pod:old-pod" not in collector._sources
+            assert "pod:old-pod" not in collector.errors_total
+            assert "pod:new-pod" in collector._sources
+
+        asyncio.run(run())
+
+
+def build_proxy():
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+        Scheduler,
+    )
+    from llm_instance_gateway_tpu.gateway.types import (
+        Metrics,
+        Pod,
+        PodMetrics,
+    )
+
+    pod = Pod("pod-0", "127.0.0.1:1")
+    ds = Datastore(pods=[pod])
+    ds.set_pool(InferencePool(name="pool-a"))
+    provider = StaticProvider([PodMetrics(pod=pod, metrics=Metrics())])
+    return GatewayProxy(
+        Server(Scheduler(provider, token_aware=False, prefill_aware=False,
+                         rng=random.Random(0)), ds),
+        provider, ds)
+
+
+class TestProxyEndpoint:
+    def test_local_events_contribution_is_the_newest_window(self):
+        """The journal pages oldest-first from a cursor: the fleet
+        view's local slice must anchor near the head, or once the
+        journal exceeds the window the collecting replica's RECENT
+        events (the pre-breach record) vanish behind stale history."""
+        proxy = build_proxy()
+        for i in range(600):
+            proxy.journal.emit("pick", pod=f"p{i}")
+        payload = proxy._fleet_local_payloads()
+        seqs = [e["seq"] for e in payload["events"]["events"]]
+        assert seqs and seqs[-1] == proxy.journal.seq  # newest included
+        assert seqs[0] == proxy.journal.seq - 511      # 512-row window
+
+    def test_debug_fleet_serves_stitched_local_view(self):
+        async def run():
+            proxy = build_proxy()
+            now = time.time()
+            proxy.tracer.record("t1", "gateway.admission", now - 1.0,
+                                now - 0.99, pod="pod-0")
+            proxy.tracer.record("t1", "gateway.upstream", now - 0.99,
+                                now - 0.1, pod="pod-0")
+            proxy.tracer.annotate("t1", model="m", path="collocated",
+                                  status="ok")
+            client = TestClient(TestServer(proxy.build_app()))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/fleet")
+                assert resp.status == 200
+                p = await resp.json()
+                t = next(t for t in p["traces"] if t["trace_id"] == "t1")
+                assert t["model"] == "m"
+                assert [s["name"] for s in t["spans"]] == [
+                    "gateway.admission", "gateway.upstream"]
+                # The unreachable pod degraded to an error marker.
+                pod_rows = [s for s in p["sources"] if s["kind"] == "pod"]
+                assert pod_rows and not pod_rows[0]["ok"]
+                # Fleet families render on /metrics.
+                resp = await client.get("/metrics")
+                text = await resp.text()
+                assert "# TYPE gateway_fleet_sources gauge" in text
+                assert "# TYPE gateway_fleet_collect_seconds histogram" \
+                    in text
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+
+class TestFleetReport:
+    def fleet_payload(self):
+        gw = payload("t1", [span("gateway.admission", 100.0, 100.02),
+                            span("gateway.prefill_hop", 100.02, 100.4),
+                            span("gateway.attach_hop", 100.4, 100.9)],
+                     model="m", path="disaggregated")
+        pre = payload("t1", [span("engine.prefill", 50.05, 50.3)])
+        dec = payload("t1", [span("engine.decode", 130.5, 130.85)])
+        return {
+            "replica": "gw-a",
+            "sources": [
+                {"name": "gw-a", "kind": "gateway", "url": "", "ok": True,
+                 "error": ""},
+                {"name": "pod:dead", "kind": "pod", "url": "http://x",
+                 "ok": False, "error": "boom"}],
+            "traces": fleetobs.stitch_traces(
+                [("gw-a", gw), ("pod-pre", pre), ("pod-dec", dec)]),
+            "slo": fleetobs.fleet_slo({"gw-a": {"models": {"m": {"ttft": {
+                "good": 9, "total": 10, "state": "ok",
+                "burn_rates": {"short": 0.4}}}}}}),
+            "health": {},
+            "events": [],
+        }
+
+    def test_render_report_sections(self):
+        from tools import fleet_report
+
+        out = fleet_report.render_report(self.fleet_payload())
+        assert "FLEET OBSERVABILITY REPORT" in out
+        assert "gateway.prefill_hop" in out     # fleet phase table
+        assert "Slowest traces:" in out
+        assert "pod-pre" in out and "pod-dec" in out  # source attribution
+        assert "ERROR boom" in out
+        assert "Fleet SLO rollup:" in out
+        assert "Per-replica divergence" in out
+
+    def test_main_json_from_file(self, tmp_path, capsys):
+        from tools import fleet_report
+
+        path = tmp_path / "fleet.json"
+        path.write_text(json.dumps(self.fleet_payload()))
+        assert fleet_report.main([str(path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["phases"] and doc["slowest"]
+        assert any(r["source"] == "pod-pre" for r in doc["divergence"])
+
+
+# -- e2e: 2 gateway replicas x disagg two-hop -> one stitched timeline ------
+
+E2E_PREFILL_PORT = 18851
+E2E_DECODE_PORT = 18852
+E2E_GW_A_PORT = 18853
+E2E_GW_B_PORT = 18854
+
+
+@pytest.fixture(scope="class")
+def fleet_stack(tmp_path_factory):
+    from tests.test_e2e_local import (
+        _launch_module,
+        _teardown_procs,
+        _wait_http,
+    )
+
+    tmp = tmp_path_factory.mktemp("e2e_fleet")
+    config = tmp / "pool.yaml"
+    config.write_text(f"""\
+kind: InferencePool
+metadata: {{name: fleet-pool, resourceVersion: "1"}}
+spec: {{selector: {{app: fleet}}, targetPortNumber: {E2E_PREFILL_PORT}}}
+---
+kind: InferenceModel
+metadata: {{name: llama3-tiny}}
+spec: {{modelName: llama3-tiny, criticality: Critical, poolRef: {{name: fleet-pool}}}}
+""")
+    procs = []
+
+    def launch(args, log_name):
+        entry = _launch_module(args, tmp / log_name, cwd=str(tmp))
+        procs.append(entry)
+
+    common = ["llm_instance_gateway_tpu.server.api_http", "--model",
+              "llama3-tiny", "--platform", "cpu", "--decode-slots", "2",
+              "--max-seq-len", "128", "--dtype", "float32"]
+    gw_common = ["llm_instance_gateway_tpu.gateway.proxy", "--config",
+                 str(config),
+                 "--pod", f"pre1=127.0.0.1:{E2E_PREFILL_PORT},role=prefill",
+                 "--pod", f"dec1=127.0.0.1:{E2E_DECODE_PORT},role=decode"]
+    try:
+        launch(common + ["--port", str(E2E_PREFILL_PORT), "--role",
+                         "prefill"], "prefill.log")
+        launch(common + ["--port", str(E2E_DECODE_PORT), "--role", "decode",
+                         "--paged-kv-block", "16"], "decode.log")
+        for port in (E2E_PREFILL_PORT, E2E_DECODE_PORT):
+            _wait_http(f"http://127.0.0.1:{port}/health")
+        launch(gw_common + ["--port", str(E2E_GW_A_PORT),
+                            "--replica-id", "gw-a", "--statebus-peer",
+                            f"http://127.0.0.1:{E2E_GW_B_PORT}"],
+               "gw_a.log")
+        launch(gw_common + ["--port", str(E2E_GW_B_PORT),
+                            "--replica-id", "gw-b", "--statebus-peer",
+                            f"http://127.0.0.1:{E2E_GW_A_PORT}"],
+               "gw_b.log")
+        for port in (E2E_GW_A_PORT, E2E_GW_B_PORT):
+            _wait_http(f"http://127.0.0.1:{port}/healthz")
+        time.sleep(2.0)  # one provider pod-refresh cycle
+    except Exception:
+        _teardown_procs(procs)
+        raise
+    yield {"tmp": tmp}
+    _teardown_procs(procs)
+
+
+@pytest.mark.slow
+class TestE2EStitchedTrace:
+    """Acceptance: 2 gateway replicas + a prefill/decode two-hop produce
+    ONE causally-ordered timeline for a single x-lig-trace-id — served by
+    the OTHER replica's /debug/fleet (the one that never saw the
+    request), with every hop's spans present and monotonic after skew
+    normalization."""
+
+    def test_other_replica_serves_the_stitched_two_hop_timeline(
+            self, fleet_stack):
+        import urllib.request
+
+        body = {"model": "llama3-tiny",
+                "prompt": "stitch this across the fleet",
+                "max_tokens": 8, "temperature": 0}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{E2E_GW_A_PORT}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            headers = dict(resp.headers)
+            resp.read()
+        assert headers.get("x-served-by") == "pre1+dec1", headers
+        trace_id = headers.get("x-lig-trace-id")
+        assert trace_id
+
+        # Gateway B never served the request; its fleet view must stitch
+        # the timeline from gateway A (statebus peer) + both pods.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{E2E_GW_B_PORT}/debug/fleet?limit=256",
+                timeout=30) as resp:
+            fleet = json.loads(resp.read())
+        matches = [t for t in fleet["traces"]
+                   if t["trace_id"] == trace_id]
+        assert len(matches) == 1, [t["trace_id"] for t in fleet["traces"]]
+        trace = matches[0]
+        # Gateway A and at least one pod contributed spans.
+        assert any(s.startswith("gw:") for s in trace["sources"]), trace
+        spans = {}
+        for s in trace["spans"]:
+            spans.setdefault(s["name"], s)
+        chain = ["gateway.admission", "engine.prefill", "handoff.serialize",
+                 "handoff.deserialize", "handoff.attach", "engine.decode"]
+        for name in chain:
+            assert name in spans, (name, sorted(spans))
+        for a, b in zip(chain, chain[1:]):
+            assert spans[a]["start"] <= spans[b]["start"] + 1e-6, (
+                a, spans[a], b, spans[b])
+            assert spans[a]["start"] <= spans[a]["end"]
+        # The serving replica's own /debug/fleet agrees (every replica
+        # serves the fleet view, not just the one that saw the request).
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{E2E_GW_A_PORT}/debug/fleet?limit=256",
+                timeout=30) as resp:
+            fleet_a = json.loads(resp.read())
+        assert any(t["trace_id"] == trace_id for t in fleet_a["traces"])
+
+
+class TestTraceReportMultiReplica:
+    def test_multi_url_merges_through_stitcher(self, tmp_path, capsys):
+        """trace_report with several --url sources reports the STITCHED
+        fleet truth: the decode span that lives only on the pod makes the
+        table, and the gateway's duplicate prefill copy is not counted
+        twice."""
+        from tools import trace_report
+
+        gw = payload("t1", [span("gateway.admission", 100.0, 100.02),
+                            span("engine.prefill", 100.05, 100.2)])
+        pod = payload("t1", [span("engine.prefill", 100.05, 100.2),
+                             span("engine.decode", 100.2, 100.9)])
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(gw))
+        b.write_text(json.dumps(pod))
+        assert trace_report.main(
+            ["--url", str(a), "--url", str(b), "--json"]) == 0
+        rows = {r["phase"]: r for r in
+                json.loads(capsys.readouterr().out)}
+        assert rows["engine.decode"]["n"] == 1
+        assert rows["engine.prefill"]["n"] == 1  # deduped, not 2

@@ -330,6 +330,26 @@ def test_metric_currency_flags_unregistered_statebus_family(tmp_path):
                for f in found), messages(found)
 
 
+def test_metric_currency_flags_unregistered_fleet_family(tmp_path):
+    """ISSUE 12 satellite: a ``gateway_fleet_*`` family rendered by the
+    fleet collector without a registry entry fails ``make lint`` — the
+    fleet plane's families stay operator-visible like every other
+    surface's."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/metrics_registry.py": REGISTRY_FIXTURE.replace(
+            '    Family("gateway_dead_total", "counter", (), "help", '
+            '"s"),\n', ""),
+        f"{PKG}/gateway/fleetobs.py":
+            'def render(self):\n'
+            '    return ["# TYPE gateway_fleet_mystery_total counter",\n'
+            '            f"gateway_fleet_mystery_total '
+            '{self.mystery}"]\n'})
+    found = run_rule(root, "metric-currency")
+    assert any("gateway_fleet_mystery_total" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+
+
 # -- event-kinds ------------------------------------------------------------
 
 EVENTS_FIXTURE = 'PICK = "pick"\nSHED = "shed"\n'
@@ -374,6 +394,24 @@ def test_event_kinds_flags_undeclared_statebus_event(tmp_path):
     assert any("'statebus_desynced'" in f.message
                for f in found), messages(found)
     assert not any("'statebus_stale'" in f.message for f in found)
+
+
+def test_event_kinds_flags_undeclared_fleet_event(tmp_path):
+    """ISSUE 12 satellite: a fleet-collector event kind emitted without
+    an events.py constant fails — ``fleet_peer_error`` must stay
+    declared or the blackbox narration and the events_total contract
+    lose it."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/events.py": EVENTS_FIXTURE
+        + 'FLEET_PEER_ERROR = "fleet_peer_error"\n',
+        f"{PKG}/gateway/fleetobs.py":
+            "def collect(self, journal):\n"
+            "    journal.emit('fleet_peer_error', source='gw:x')\n"
+            "    journal.emit('fleet_peer_vanished', source='gw:x')\n"})
+    found = run_rule(root, "event-kinds")
+    assert any("'fleet_peer_vanished'" in f.message
+               for f in found), messages(found)
+    assert not any("'fleet_peer_error'" in f.message for f in found)
 
 
 # -- label-hygiene ----------------------------------------------------------

@@ -37,8 +37,10 @@ def _rendered_family_names(text: str) -> set:
 def test_registry_covers_gateway_surface():
     from test_exposition_contract import (
         loaded_fairness_policy,
+        loaded_fleet_collector,
         loaded_observability,
         loaded_placement_planner,
+        loaded_statebus,
         loaded_usage_rollup,
     )
 
@@ -46,9 +48,12 @@ def test_registry_covers_gateway_surface():
     _gm2, rollup, _journal2 = loaded_usage_rollup()
     fairness = loaded_fairness_policy()
     placement = loaded_placement_planner()
+    statebus = loaded_statebus()
+    fleet = loaded_fleet_collector()
     text = gm.render() + "\n".join(
         engine.render() + scorer.render() + rollup.render()
         + fairness.render() + placement.render()
+        + statebus.render() + fleet.render()
         + journal.render_prom("gateway_events_total")) + "\n"
     rendered = _rendered_family_names(text)
     registered = metrics_registry.registered_names()

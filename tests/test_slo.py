@@ -214,12 +214,21 @@ class TestBreachEndToEnd:
                    for e in dump["events"]["events"])
         assert any(t["trace_id"] == "t-bad" for t in dump["traces"])
         assert "gateway_slo_burn_rate" in dump["metrics_text"]
+        # Fleet-observability sections (ISSUE 12): the statebus view and
+        # the pods' profiler snapshots ride the dump — the fake pod is
+        # unreachable, so its profile is an error marker, not an omission.
+        assert dump["statebus"]["replica"] == proxy.statebus.replica_id
+        assert "quota_scale" in dump["statebus"]
+        assert "error" in dump["profile"]["pod-a"]
 
         report = blackbox_report.render_report(dump, window_s=3600.0)
         assert "fast_burn" in report
         assert "model=m objective=ttft" in report
         assert "slo_transition" in report
         assert "t-bad" in report  # the trace made the timeline
+        assert "State bus at dump time:" in report
+        assert "Engine step-timeline at dump time" in report
+        assert "UNAVAILABLE" in report  # the unreachable pod's marker
 
     def test_dump_cooldown(self, tmp_path):
         proxy = build_proxy(tmp_path)

@@ -82,6 +82,79 @@ class TestTracer:
             ("n", 1.0, 2.0), ("m", 3.0, 4.0)]
 
 
+class TestTraceCursor:
+    """``/debug/traces?since=`` incremental cursor (ISSUE 12 satellite):
+    the /debug/events paging contract lifted to trace granularity, so
+    the fleet collector and --watch tooling poll deltas instead of
+    re-shipping the whole ring."""
+
+    def test_seq_is_monotonic_across_record_kinds(self):
+        t = tracing.Tracer()
+        t.record("t1", "a", 1.0, 2.0)
+        t.record_wire("t1", tracing.wire_spans([("b", 2.0, 3.0)]))
+        t.annotate("t1", model="m")
+        assert t.seq == 3
+
+    def test_since_returns_only_new_records(self):
+        t = tracing.Tracer()
+        t.record("t1", "a", 1.0, 2.0)
+        payload = tracing.debug_traces_payload(t, {"since": "0"})
+        assert payload["next_since"] == 1
+        assert [s["name"] for s in payload["traces"][0]["spans"]] == ["a"]
+        t.record("t1", "b", 2.0, 3.0)
+        t.record("t2", "c", 3.0, 4.0)
+        payload = tracing.debug_traces_payload(
+            t, {"since": str(payload["next_since"])})
+        assert payload["seq"] == 3 and payload["next_since"] == 3
+        by_id = {tr["trace_id"]: tr for tr in payload["traces"]}
+        # Only the DELTA ships: t1's already-polled span "a" stays home.
+        assert [s["name"] for s in by_id["t1"]["spans"]] == ["b"]
+        assert [s["name"] for s in by_id["t2"]["spans"]] == ["c"]
+
+    def test_caught_up_poll_returns_nothing(self):
+        t = tracing.Tracer()
+        t.record("t1", "a", 1.0, 2.0)
+        payload = tracing.debug_traces_payload(t, {"since": "1"})
+        assert payload["traces"] == []
+        assert payload["next_since"] == payload["seq"] == 1
+
+    def test_truncated_page_never_skips_a_record(self):
+        """Lossless paging: when ``limit`` truncates, the cursor retreats
+        to just before the first excluded trace's oldest record — a
+        poller may re-receive a span (the stitcher dedups) but can never
+        lose one, even with interleaved traces."""
+        t = tracing.Tracer()
+        t.record("tA", "a1", 1.0, 2.0)   # seq 1
+        t.record("tB", "b1", 2.0, 3.0)   # seq 2
+        t.record("tA", "a2", 3.0, 4.0)   # seq 3
+        page1 = tracing.debug_traces_payload(
+            t, {"since": "0", "limit": "1"})
+        assert [tr["trace_id"] for tr in page1["traces"]] == ["tA"]
+        # tB (oldest record seq 2) was excluded: cursor retreats to 1.
+        assert page1["next_since"] == 1
+        page2 = tracing.debug_traces_payload(
+            t, {"since": str(page1["next_since"])})
+        by_id = {tr["trace_id"]: tr for tr in page2["traces"]}
+        assert [s["name"] for s in by_id["tB"]["spans"]] == ["b1"]
+        assert [s["name"] for s in by_id["tA"]["spans"]] == ["a2"]
+
+    def test_hostile_since_falls_back(self):
+        t = tracing.Tracer()
+        t.record("t1", "a", 1.0, 2.0)
+        payload = tracing.debug_traces_payload(t, {"since": "zzz"})
+        assert len(payload["traces"]) == 1
+
+    def test_plain_payload_shape_unchanged(self):
+        """Without ?since= the historical contract holds (most recent
+        first, no next_since key) — plus the new head seq."""
+        t = tracing.Tracer()
+        t.record("t1", "a", 1.0, 2.0)
+        payload = tracing.debug_traces_payload(t, {})
+        assert "next_since" not in payload
+        assert payload["seq"] == 1
+        assert payload["traces"][0]["trace_id"] == "t1"
+
+
 class TestHistogramRender:
     def test_custom_buckets_size_counts(self):
         h = tracing.Histogram(tracing.LATENCY_BUCKETS)

@@ -45,6 +45,7 @@ ABSOLUTE_MAX = {
     "pick_policy_ratio": 1.05,
     "pick_fairness_ratio": 1.05,
     "pick_placement_ratio": 1.05,
+    "step_profile_ratio": 1.05,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
 # 1.0 on a socket-bound rig, so a baseline-relative gate would only measure
@@ -62,6 +63,7 @@ _RATIO_SOURCES = {
     "pick_policy_ratio": "policy",
     "pick_fairness_ratio": "fairness",
     "pick_placement_ratio": "placement",
+    "step_profile_ratio": "profiler",
 }
 
 # family -> (primary metric, direction) used to choose the conservative
@@ -73,6 +75,7 @@ _FAMILY_PRIMARY = {
     "policy": ("pick_policy_ratio", "lower"),
     "fairness": ("pick_fairness_ratio", "lower"),
     "placement": ("pick_placement_ratio", "lower"),
+    "profiler": ("step_profile_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -89,6 +92,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "policy": bench.run_policy_microbench(),
         "fairness": bench.run_fairness_microbench(),
         "placement": bench.run_placement_microbench(),
+        "profiler": bench.run_profiler_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
     }
@@ -103,7 +107,8 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
     _RATIO_FNS = {"pick": bench.run_pick_microbench,
                   "policy": bench.run_policy_microbench,
                   "fairness": bench.run_fairness_microbench,
-                  "placement": bench.run_placement_microbench}
+                  "placement": bench.run_placement_microbench,
+                  "profiler": bench.run_profiler_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):
             if fams[fam].get(metric, 0.0) <= ABSOLUTE_MAX[metric]:

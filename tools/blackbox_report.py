@@ -9,6 +9,11 @@ This tool renders it into the narrative an on-caller actually reads —
 - the breach reason (model, objective, burn rates per window),
 - SLO compliance/state per model-objective at dump time,
 - per-replica health scores, states, and streaks,
+- the replicated state bus's view (PR 10): merged-vs-local divergence,
+  peer snapshot ages, quota scale — was this replica enforcing alone
+  when it burned?,
+- the pool pods' step-profiler attribution (server/profiler.py):
+  dispatch / host-sync / idle shares per pod at the breach,
 - a merged chronological timeline of journal events and trace spans
   leading up to the dump (``--window`` seconds, default 60).
 
@@ -110,6 +115,47 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
         wa = health.get("would_avoid_total")
         if wa is not None:
             lines.append(f"  would-avoid picks (log-only): {wa}")
+        lines.append("")
+    statebus = dump.get("statebus") or {}
+    if statebus:
+        lines.append("State bus at dump time:")
+        lines.append(
+            f"  replica={statebus.get('replica')} "
+            f"stale={statebus.get('stale')} "
+            f"live_replicas={statebus.get('live_replicas')} "
+            f"quota_scale={statebus.get('quota_scale')}")
+        for rid, r in sorted((statebus.get("replicas") or {}).items()):
+            lines.append(
+                f"  peer {rid:<20} seq={r.get('seq')} "
+                f"age={r.get('age_s')}s "
+                f"{'fresh' if r.get('fresh') else 'STALE'}")
+        merged = statebus.get("merged") or {}
+        local = statebus.get("local") or {}
+        for pool in sorted(merged):
+            m, loc = merged[pool], local.get(pool) or {}
+            lines.append(
+                f"  pool {pool}: merged noisy={sorted(m.get('noisy') or {})}"
+                f" avoid={m.get('avoid') or []} | local "
+                f"noisy={sorted(loc.get('noisy') or {})}"
+                f" avoid={loc.get('avoid') or []}")
+        lines.append("")
+    profiles = dump.get("profile") or {}
+    if profiles:
+        lines.append("Engine step-timeline at dump time "
+                     "(dispatch/host-sync/idle shares):")
+        for pod in sorted(profiles):
+            p = profiles[pod]
+            if "error" in p:
+                lines.append(f"  {pod:<20} UNAVAILABLE: {p['error']}")
+                continue
+            att = p.get("attribution") or {}
+            shares = att.get("shares") or {}
+            lines.append(
+                f"  {pod:<20} dispatch={shares.get('dispatch', 0):.1%}"
+                f" host_sync={shares.get('host_sync', 0):.1%}"
+                f" idle={shares.get('idle', 0):.1%}"
+                f" over {att.get('dispatches', 0)} dispatches"
+                f" ({att.get('tracked_seconds', 0)}s tracked)")
         lines.append("")
     counts = (dump.get("events") or {}).get("counts") or {}
     if counts:

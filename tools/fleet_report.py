@@ -1,0 +1,222 @@
+"""Fleet-wide observability report: one request across N replicas.
+
+Renders a gateway's ``/debug/fleet`` payload — or builds one locally from
+several replicas' ``/debug/traces`` documents — into the three tables an
+operator scaling past one gateway actually needs:
+
+- **fleet phase table**: per-phase p50/p95/p99 over the STITCHED
+  cross-replica timelines (a two-hop disagg request contributes its
+  prefill replica's spans, its decode replica's spans, and its gateway's
+  hop spans to the same rows);
+- **slowest-trace exemplars**: the worst end-to-end traces with their
+  per-span breakdown and source replicas — the "which replica ate the
+  time" answer;
+- **per-replica divergence**: each source's per-phase p50 against the
+  fleet p50 (ratio >1 = this replica is slower than the fleet on that
+  phase), plus the fleet SLO rollup and source health when the input is
+  a /debug/fleet payload.
+
+Usage:
+  python tools/fleet_report.py http://gw-1:8081/debug/fleet
+  python tools/fleet_report.py --replicas http://gw-1:8081,http://gw-2:8082
+  python tools/fleet_report.py fleet.json --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_instance_gateway_tpu.gateway import fleetobs  # noqa: E402
+from tools.trace_report import (  # noqa: E402 — one loader, no drift
+    format_table,
+    load,
+    percentile,
+    phase_table,
+)
+
+
+def collect_replicas(bases: list[str]) -> dict:
+    """Build a fleet-shaped payload client-side from several replicas'
+    debug surfaces (the same stitcher /debug/fleet runs server-side)."""
+    trace_sources = []
+    slo_payloads = {}
+    sources = []
+    for base in bases:
+        base = base.rstrip("/")
+        row = {"name": base, "kind": "gateway", "url": base, "ok": True,
+               "error": ""}
+        try:
+            trace_sources.append(
+                (base, load(f"{base}/debug/traces?limit=256")))
+            try:
+                slo_payloads[base] = load(f"{base}/debug/slo")
+            except Exception:  # pods have no /debug/slo
+                row["kind"] = "pod"
+        except Exception as e:  # noqa: BLE001 — a dead replica is a marker
+            row["ok"], row["error"] = False, str(e)[:200]
+        sources.append(row)
+    return {
+        "replica": "(client-side collect)",
+        "sources": sources,
+        "traces": fleetobs.stitch_traces(trace_sources),
+        "slo": fleetobs.fleet_slo(slo_payloads),
+        "health": {},
+        "events": [],
+    }
+
+
+def phase_samples_by_source(traces: list[dict]) -> tuple[dict, dict]:
+    """(fleet phase->samples, source->phase->samples) off stitched spans."""
+    fleet: dict[str, list[float]] = {}
+    per_source: dict[str, dict[str, list[float]]] = {}
+    for trace in traces or []:
+        for span in trace.get("spans") or []:
+            try:
+                d = max(0.0, float(span["end"]) - float(span["start"]))
+            except (KeyError, TypeError, ValueError):
+                continue
+            name = str(span.get("name", "?"))
+            fleet.setdefault(name, []).append(d)
+            src = str(span.get("source", "?"))
+            per_source.setdefault(src, {}).setdefault(name, []).append(d)
+    return fleet, per_source
+
+
+def slowest_traces(traces: list[dict], n: int = 3) -> list[dict]:
+    rows = []
+    for t in traces or []:
+        spans = t.get("spans") or []
+        if not spans:
+            continue
+        dur = max(float(s["end"]) for s in spans) - min(
+            float(s["start"]) for s in spans)
+        rows.append({
+            "trace_id": t.get("trace_id", "?"),
+            "model": t.get("model", ""),
+            "path": t.get("path", ""),
+            "status": t.get("status", ""),
+            "total_ms": round(dur * 1e3, 3),
+            "sources": t.get("sources", []),
+            "skew": t.get("skew", {}),
+            "spans": [
+                {"name": s["name"], "source": s.get("source", "?"),
+                 "ms": round((float(s["end"]) - float(s["start"])) * 1e3, 3)}
+                for s in spans],
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows[:n]
+
+
+def divergence_rows(fleet: dict, per_source: dict) -> list[dict]:
+    """Per (source, phase): source p50 / fleet p50 — who is slow where."""
+    rows = []
+    for src in sorted(per_source):
+        for phase, xs in sorted(per_source[src].items()):
+            if not xs or not fleet.get(phase):
+                continue
+            src_p50 = percentile(sorted(xs), 0.50)
+            fleet_p50 = percentile(sorted(fleet[phase]), 0.50)
+            rows.append({
+                "source": src,
+                "phase": phase,
+                "n": len(xs),
+                "p50_ms": round(src_p50 * 1e3, 3),
+                "vs_fleet": (round(src_p50 / fleet_p50, 3)
+                             if fleet_p50 > 0 else None),
+            })
+    return rows
+
+
+def render_report(payload: dict) -> str:
+    traces = payload.get("traces") or []
+    fleet, per_source = phase_samples_by_source(traces)
+    out = [
+        "=" * 72,
+        f"FLEET OBSERVABILITY REPORT (collected by "
+        f"{payload.get('replica', '?')}; {len(traces)} stitched traces)",
+        "=" * 72,
+        "",
+        "Sources:",
+    ]
+    for s in payload.get("sources") or []:
+        status = "ok" if s.get("ok") else f"ERROR {s.get('error', '')}"
+        out.append(f"  {s.get('kind', '?'):<8} {s.get('name', '?'):<40}"
+                   f" {status}")
+    out += ["", "Fleet per-phase latency (stitched spans):",
+            format_table(phase_table(fleet))]
+    slo = payload.get("slo") or {}
+    if slo.get("models"):
+        out += ["", "Fleet SLO rollup:"]
+        for model in sorted(slo["models"]):
+            for objective, agg in sorted(slo["models"][model].items()):
+                states = ",".join(
+                    f"{r}={s}" for r, s in sorted(
+                        (agg.get("states") or {}).items()))
+                out.append(
+                    f"  {model}/{objective:<11}"
+                    f" compliance={agg.get('compliance')}"
+                    f" good/total={agg.get('good')}/{agg.get('total')}"
+                    f" worst_burn={agg.get('worst_burn')}"
+                    f"@{agg.get('worst_burn_replica')} [{states}]")
+    exemplars = slowest_traces(traces)
+    if exemplars:
+        out += ["", "Slowest traces:"]
+        for r in exemplars:
+            skew = (f" skew={r['skew']}" if r["skew"] else "")
+            out.append(f"  {r['trace_id']} model={r['model']} "
+                       f"path={r['path']} total={r['total_ms']}ms "
+                       f"sources={','.join(r['sources'])}{skew}")
+            for s in r["spans"]:
+                out.append(f"    {s['name']:<22} {s['ms']:>10.3f}ms  "
+                           f"[{s['source']}]")
+    div = divergence_rows(fleet, per_source)
+    if div:
+        out += ["", "Per-replica divergence (p50 vs fleet p50):",
+                format_table([{k: r[k] for k in
+                               ("source", "phase", "n", "p50_ms",
+                                "vs_fleet")} for r in div])]
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fleet-wide stitched-trace report from /debug/fleet "
+                    "or several replicas' /debug/traces")
+    parser.add_argument("source", nargs="?",
+                        help="/debug/fleet URL, file path, or - for stdin")
+    parser.add_argument("--replicas",
+                        help="CSV of replica base URLs to collect and "
+                             "stitch client-side (instead of a "
+                             "/debug/fleet source)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the computed tables as one JSON doc")
+    args = parser.parse_args(argv)
+    if args.replicas:
+        payload = collect_replicas(
+            [u.strip() for u in args.replicas.split(",") if u.strip()])
+    elif args.source:
+        payload = load(args.source)
+    else:
+        parser.error("need a source or --replicas")
+    if args.json:
+        fleet, per_source = phase_samples_by_source(
+            payload.get("traces") or [])
+        print(json.dumps({
+            "phases": phase_table(fleet),
+            "slowest": slowest_traces(payload.get("traces") or []),
+            "divergence": divergence_rows(fleet, per_source),
+            "slo": payload.get("slo"),
+        }))
+    else:
+        print(render_report(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

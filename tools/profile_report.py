@@ -1,0 +1,187 @@
+"""Dispatch-gap attribution table from the engine step-timeline profiler.
+
+Reads a ``/debug/profile`` payload (URL, file path, or ``-`` for stdin —
+including the committed ``PROFILE_BASELINE.json`` baseline run and the
+``profile`` section of a black-box dump) and renders where the engine
+thread's wall went:
+
+- the attribution table — dispatch / host-sync / idle shares (they tile
+  the tracked engine-thread timeline, so they sum to 100%);
+- per-phase dispatch walls (prefill vs decode vs spec) with counts and
+  mean wall per dispatch;
+- a recent-dispatch summary from the record ring (mean batch occupancy,
+  mean steps per dispatch, slot churn).
+
+This is the evidence layer for the ROADMAP item-2 decode levers: every
+"amortize the step loop" change must move the host-sync share DOWN on
+this table versus the committed baseline, not just a throughput ratio.
+
+Usage:
+  python tools/profile_report.py http://localhost:8000/debug/profile
+  python tools/profile_report.py PROFILE_BASELINE.json
+  python tools/profile_report.py dump.json --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.trace_report import load  # noqa: E402 — one loader, no drift
+
+
+def extract_profile(doc: dict, pod: str | None = None) -> dict:
+    """Accept a raw /debug/profile payload, a bench emission carrying
+    ``profile``, or a black-box dump whose ``profile`` section maps pod
+    name -> snapshot (slo.write_blackbox's shape; unreachable pods carry
+    error markers).  ``pod`` selects one replica from a dump; without it
+    the first pod (sorted) with a valid snapshot is used, with a note on
+    stderr when several were available."""
+    if "attribution" in doc:
+        return doc
+    inner = doc.get("profile")
+    if isinstance(inner, dict):
+        if "attribution" in inner:
+            return inner
+        # Black-box dump shape: pod name -> snapshot-or-error-marker.
+        valid = {name: snap for name, snap in sorted(inner.items())
+                 if isinstance(snap, dict) and "attribution" in snap}
+        if pod is not None:
+            if pod in valid:
+                return valid[pod]
+            raise ValueError(
+                f"pod {pod!r} has no profiler snapshot in this dump "
+                f"(pods with one: {sorted(valid) or 'none'})")
+        if valid:
+            name, snap = next(iter(valid.items()))
+            if len(valid) > 1:
+                print(f"note: dump holds {len(valid)} pod snapshots; "
+                      f"showing {name!r} (pick one with --pod)",
+                      file=sys.stderr)
+            return snap
+    raise ValueError("no profiler payload found (expected an 'attribution' "
+                     "key or a 'profile' section)")
+
+
+def attribution_rows(profile: dict) -> list[dict]:
+    """One row per bucket: seconds + share of the tracked total."""
+    att = profile.get("attribution") or {}
+    shares = att.get("shares") or {}
+    rows = []
+    for bucket, key in (("dispatch", "dispatch_seconds"),
+                        ("host_sync", "host_sync_seconds"),
+                        ("idle", "idle_seconds")):
+        rows.append({
+            "bucket": bucket,
+            "seconds": round(float(att.get(key, 0.0)), 6),
+            "share_pct": round(100.0 * float(shares.get(bucket, 0.0)), 3),
+        })
+    return rows
+
+
+def phase_rows(profile: dict) -> list[dict]:
+    """Per-phase dispatch wall: total seconds, dispatch count, mean wall
+    per dispatch (from the wall histogram's _sum/_count)."""
+    rows = []
+    for phase, state in sorted((profile.get("hist") or {}).get(
+            "wall", {}).items()):
+        n = int(state.get("count", 0))
+        total = float(state.get("sum", 0.0))
+        rows.append({
+            "phase": phase,
+            "dispatches": n,
+            "wall_s": round(total, 6),
+            "mean_ms": round(total / n * 1e3, 3) if n else 0.0,
+        })
+    return rows
+
+
+def record_summary(profile: dict) -> dict:
+    """Aggregate view of the recent per-dispatch record ring."""
+    records = [r for r in profile.get("records") or []
+               if r.get("phase") != "prefill"]
+    if not records:
+        return {}
+    occ = [r["active"] / r["slots"] for r in records if r.get("slots")]
+    gaps = [r.get("gap_s", 0.0) for r in records]
+    return {
+        "recent_dispatches": len(records),
+        "mean_occupancy": round(sum(occ) / len(occ), 4) if occ else None,
+        "mean_steps_per_dispatch": round(
+            sum(r.get("n_steps", 1) for r in records) / len(records), 2),
+        "mean_gap_ms": round(sum(gaps) / len(gaps) * 1e3, 4),
+        "slot_churn_events": sum(1 for r in records if r.get("slot_churn")),
+    }
+
+
+def _table(rows: list[dict], headers: tuple) -> str:
+    if not rows:
+        return "(no samples)"
+    widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
+
+    def fmt(vals):
+        return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
+                         for i, (v, w) in enumerate(zip(vals, widths)))
+
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt([r[h] for h in headers]) for r in rows]
+    return "\n".join(lines)
+
+
+def render_report(profile: dict) -> str:
+    att = attribution_rows(profile)
+    out = [
+        "ENGINE STEP-TIMELINE ATTRIBUTION "
+        f"(tracked {profile.get('attribution', {}).get('tracked_seconds', 0)}s "
+        f"over {profile.get('attribution', {}).get('dispatches', 0)} dispatches)",
+        "",
+        _table(att, ("bucket", "seconds", "share_pct")),
+        "",
+        "Per-phase dispatch wall:",
+        _table(phase_rows(profile), ("phase", "dispatches", "wall_s",
+                                     "mean_ms")),
+    ]
+    summary = record_summary(profile)
+    if summary:
+        out += ["", "Recent decode dispatches: " + ", ".join(
+            f"{k}={v}" for k, v in summary.items())]
+    padding = profile.get("padding_tokens")
+    if padding:
+        out += ["", f"Prefill padding tokens (cumulative): {padding}"]
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="dispatch / host-sync / idle attribution table from a "
+                    "/debug/profile payload")
+    parser.add_argument("source",
+                        help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--pod",
+                        help="which pod's snapshot to render when the "
+                             "source is a black-box dump holding several")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the attribution + phase rows as JSON")
+    args = parser.parse_args(argv)
+    try:
+        profile = extract_profile(load(args.source), pod=args.pod)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({
+            "attribution": attribution_rows(profile),
+            "phases": phase_rows(profile),
+            "summary": record_summary(profile),
+        }))
+    else:
+        print(render_report(profile))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+# Runnable as `python tools/trace_report.py` from anywhere: the fleet
+# stitcher import (multi-replica mode) needs the repo root on the path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load(source: str) -> dict:
@@ -80,10 +85,10 @@ def phase_table(samples: dict[str, list[float]]) -> list[dict]:
     return rows
 
 
-def format_table(rows: list[dict]) -> str:
+def format_table(rows: list[dict], headers: tuple | None = None) -> str:
     if not rows:
         return "(no phase samples)"
-    headers = ("phase", "n", "p50_ms", "p95_ms", "p99_ms", "mean_ms")
+    headers = tuple(headers or rows[0].keys())
     widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
     def fmt(vals):
         return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
@@ -93,17 +98,50 @@ def format_table(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def multi_replica_samples(sources: list[tuple[str, dict]]) -> dict:
+    """Phase samples over SEVERAL replicas' /debug/traces payloads,
+    merged through the fleet stitcher (gateway/fleetobs.py) — duplicate
+    spans (the gateway's ``x-lig-spans`` copy of a server span) fold and
+    per-source clock skew normalizes, so the table is the fleet truth
+    instead of one replica's view reported as the whole story."""
+    from llm_instance_gateway_tpu.gateway import fleetobs
+
+    return phase_samples(
+        {"traces": fleetobs.stitch_traces(sources, limit=1024)})
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="per-phase latency table from /debug/traces JSON or "
                     "loadgen --trace-out output")
-    parser.add_argument("source",
+    parser.add_argument("source", nargs="?",
                         help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--url", action="append", default=[],
+                        help="a replica's /debug/traces URL (repeatable: "
+                             "multiple replicas merge through the fleet "
+                             "stitcher instead of one view posing as the "
+                             "whole story)")
+    parser.add_argument("--replicas",
+                        help="CSV of replica base URLs; each fetches "
+                             "<base>/debug/traces and merges like --url")
     parser.add_argument("--json", action="store_true",
                         help="emit the rows as one JSON line instead of a "
                              "table")
     args = parser.parse_args(argv)
-    rows = phase_table(phase_samples(load(args.source)))
+    urls = list(args.url)
+    if args.replicas:
+        urls += [u.strip().rstrip("/") + "/debug/traces"
+                 for u in args.replicas.split(",") if u.strip()]
+    if urls:
+        sources = [(u, load(u)) for u in urls]
+        if args.source:
+            sources.append((args.source, load(args.source)))
+        samples = multi_replica_samples(sources)
+    elif args.source:
+        samples = phase_samples(load(args.source))
+    else:
+        parser.error("need a source, --url, or --replicas")
+    rows = phase_table(samples)
     if args.json:
         print(json.dumps(rows))
     else:
