@@ -2,15 +2,15 @@
 
 IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
 
-.PHONY: test test-e2e chaos native native-asan bench bench-check loadgen sim metrics-docs top usage-check lint typecheck docker-build install deploy undeploy fmt
+.PHONY: test test-e2e chaos native native-asan native-tsan bench bench-check loadgen sim metrics-docs top usage-check lint typecheck docker-build install deploy undeploy fmt
 
 test:            ## unit + integration tests (CPU, virtual 8-device mesh)
 	python -m pytest tests/ -q -m "not e2e"
 
-lint:            ## mechanical layer (ruff, when installed) + the repo-invariant linter
+lint:            ## mechanical layer (ruff, when installed) + the repo-invariant linter (incl. the concurrency rules; --timings shows which rule is slow)
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
 	else echo "ruff not installed — mechanical layer served by the invariant linter's mech-* fallback rules"; fi
-	python -m llm_instance_gateway_tpu.lint
+	python -m llm_instance_gateway_tpu.lint --timings
 
 typecheck:       ## scoped mypy gate over the contract-bearing core (mypy.ini)
 	@if command -v mypy >/dev/null 2>&1; then mypy --config-file mypy.ini; \
@@ -18,6 +18,9 @@ typecheck:       ## scoped mypy gate over the contract-bearing core (mypy.ini)
 
 native-asan:     ## sanitized native build: ASan/UBSan libligsched + hostile-snapshot FFI fuzz + ctypes parity
 	python tools/native_asan_check.py
+
+native-tsan:     ## thread-sanitized native build: concurrent pick_many vs snapshot swaps under the _call_lock protocol + lock-free const picks
+	python tools/native_tsan_check.py
 
 test-e2e:        ## full local stack: server + gateway + sidecar as processes
 	python -m pytest tests/test_e2e_local.py -q -m e2e
@@ -46,11 +49,12 @@ metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
 top:             ## one-shot lig-top render of a running gateway's /debug/usage
 	python tools/lig_top.py --once --url $${LIG_URL:-http://localhost:8081}
 
-usage-check:     ## invariant lint + typecheck + sanitized native build + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + docs currency
+usage-check:     ## invariant lint + typecheck + sanitized native builds + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + concurrency harness + docs currency
 	$(MAKE) lint
 	$(MAKE) typecheck
 	$(MAKE) native-asan
-	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_metrics_docs.py tests/test_lint.py -q
+	$(MAKE) native-tsan
+	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_metrics_docs.py tests/test_lint.py tests/test_concurrency.py -q
 	python tools/chaos.py --seed 0 --scenario noisy_neighbor
 	python tools/chaos.py --seed 0 --scenario adapter_flood
 	python tools/chaos.py --seed 0 --scenario cold_start_storm

@@ -679,6 +679,82 @@ def run_placement_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_witness_microbench(n: int = 4000, n_pods: int = 64) -> dict:
+    """Lock-witness overhead A/B (concurrency-contract PR acceptance bar:
+    ``pick_witness_ratio`` <= 1.05 — running with LIG_LOCK_WITNESS armed
+    costs < 5% of a pick vs plain locks, so the whole test suite can stay
+    witnessed without taxing anything).
+
+    Same harness shape as ``run_policy_microbench``: a real Python
+    filter-tree scheduler + ResiliencePlane advisor + GatewayMetrics
+    recording, so each pick crosses the three hot-path locks the witness
+    wraps (health note_pick, breaker note_pick, pick-latency record).  The
+    witness arms at LOCK CONSTRUCTION time, so each side builds its whole
+    stack under its own env setting.  Interleaved runs, MIN per side.
+    """
+    import os as os_mod
+    import random as random_mod
+
+    from llm_instance_gateway_tpu import lockwitness
+    from llm_instance_gateway_tpu.gateway import health, resilience
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod,
+    )
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                     prompt_tokens=25, criticality="Critical")
+
+    def make_side(armed: bool):
+        prev = os_mod.environ.get(lockwitness.ENV)
+        os_mod.environ[lockwitness.ENV] = "1" if armed else "0"
+        try:
+            provider = StaticProvider([
+                PodMetrics(pod=fake_pod(i),
+                           metrics=fake_metrics(queue=i % 5,
+                                                kv=(i % 10) / 10.0))
+                for i in range(n_pods)
+            ])
+            plane = resilience.ResiliencePlane(
+                health.HealthScorer(provider=provider))
+            plane.health.update()
+            gm = GatewayMetrics()
+            sched = Scheduler(provider, prefix_aware=False,
+                              rng=random_mod.Random(0))
+            sched.health_advisor = plane
+        finally:
+            if prev is None:
+                os_mod.environ.pop(lockwitness.ENV, None)
+            else:
+                os_mod.environ[lockwitness.ENV] = prev
+        return sched, gm
+
+    plain, armed = make_side(False), make_side(True)
+
+    def loop(side) -> float:
+        sched, gm = side
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pod = sched.schedule(req)
+            gm.record_pick(pod.name, 0.0, False)
+        return time.perf_counter() - t0
+
+    loop(plain), loop(armed)  # warmup pair
+    off_best = on_best = float("inf")
+    for _ in range(12):
+        off_best = min(off_best, loop(plain))
+        on_best = min(on_best, loop(armed))
+    return {
+        "pick_witness_off_us": round(off_best / n * 1e6, 2),
+        "pick_witness_on_us": round(on_best / n * 1e6, 2),
+        "pick_witness_ratio": round(on_best / off_best, 4),
+    }
+
+
 def run_profiler_microbench(emit_profile: bool = False) -> dict:
     """Step-timeline-profiler overhead A/B (fleet-observability PR
     acceptance bar: ``step_profile_ratio`` <= 1.05 — profiling every
@@ -1335,6 +1411,13 @@ if __name__ == "__main__":
             results.update(run_profiler_microbench())
         except Exception as e:
             results["profiler_error"] = str(e)[:200]
+        try:
+            # Lock-witness overhead A/B (concurrency-contract PR): the
+            # <5% pick_witness_ratio bound rides every emission so the
+            # test suite can stay witness-armed.
+            results.update(run_witness_microbench())
+        except Exception as e:
+            results["witness_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

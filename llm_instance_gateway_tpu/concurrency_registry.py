@@ -1,0 +1,530 @@
+"""Registry of every cross-thread shared field the framework mutates.
+
+PRs 10-11 multiplied the cross-thread surface: the statebus gossip path
+calls ``set_remote_noisy`` / ``set_remote_avoid`` / ``set_remote_resident``
+into advisor state that concurrent data-path picks read lock-free, per-pool
+tick stacks run on the observability thread, the fleet collector and the
+step profiler each added their own locks — ~40 ``threading.Lock`` sites
+across the tree, each with a hand-maintained discipline that lived in
+comments.  This module is the single declarative list (the
+``metrics_registry.py`` shape): every class owning cross-thread state
+declares its **owning domain**, its **lock attributes**, and — for every
+field rebound after construction — the field's **publication discipline**
+and the methods allowed to write it.
+
+The concurrency lint (``lint/concurrency.py``; ``make lint``) cross-checks
+this against the AST:
+
+- ``ownership``: a class that constructs a lock but is not registered
+  fails; a registered class assigning an undeclared field outside
+  ``__init__`` fails; a write from a method not in the field's ``writers``
+  allowlist fails.  Overlay seams (``set_remote_*``) are the declared
+  gossip-domain exceptions, not folklore.
+- ``publish-by-swap``: a field declared SWAP_PUBLISHED is read lock-free
+  on the pick hot path, so writers must REPLACE the whole object —
+  any in-place mutation (``.append``/``.update``/``[k] =``/``+=``) of it
+  fails lint.
+- ``lock-order``: the interprocedural acquisition graph over the declared
+  lock attributes (plus call edges resolved through ``BINDINGS``) must be
+  acyclic; the runtime ``lockwitness`` cross-checks the graph's
+  completeness from real acquisitions in the interleave harness.
+
+Keep entries grouped by module; ``tests/test_lint.py`` and the clean-tree
+lint run are the currency tests — an undeclared shared field fails CI, a
+dead entry fails CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# -- owning domains (who mutates the state in steady operation) -------------
+DATA_PATH = "data-path"            # request/pick threads (HTTP + gRPC pool)
+OBS_TICK = "observability-tick"    # the proxy's control_tick thread
+GOSSIP = "gossip"                  # statebus exchange / merged-view apply
+ENGINE_STEP = "engine-step"        # the model server's engine loop thread
+COLLECTOR = "collector"            # fleet-collector pulls (event loop)
+CONTROL = "control"                # config reload / lifecycle (rare writes)
+
+DOMAINS = (DATA_PATH, OBS_TICK, GOSSIP, ENGINE_STEP, COLLECTOR, CONTROL)
+
+# -- publication disciplines ------------------------------------------------
+# Reads and writes both happen inside the owning class's lock; lock-free
+# readers are bugs (the lint can't see reads, but the witness harness and
+# the discipline docs make the contract explicit).
+LOCK_GUARDED = "lock-guarded"
+# Lock-free reads on the hot path; writers REPLACE the field with a whole
+# (effectively immutable) object — the ``_noisy_pods_cache`` tuple-swap
+# idiom.  In-place mutation anywhere fails the publish-by-swap rule.
+SWAP_PUBLISHED = "publish-by-swap"
+# Increment-only numeric state owned by one domain (or bumped under the
+# lock); readers tolerate a stale value, never a torn one.
+MONOTONIC = "monotonic-counter"
+# Touched only from the owning domain's single thread (the engine step
+# loop's scratch state); in-place mutation is legal because there are NO
+# cross-thread readers — crossing a thread boundary means re-declaring
+# under one of the disciplines above.
+OWNER_PRIVATE = "owner-private"
+
+DISCIPLINES = (LOCK_GUARDED, SWAP_PUBLISHED, MONOTONIC, OWNER_PRIVATE)
+
+
+@dataclass(frozen=True)
+class SharedField:
+    name: str
+    discipline: str
+    writers: tuple = ()    # methods allowed to rebind it (besides __init__)
+    domain: str = ""       # override of the class domain (overlay seams)
+    note: str = ""
+
+
+@dataclass(frozen=True)
+class SharedClass:
+    module: str            # repo-relative path
+    name: str
+    domain: str            # dominant owning domain
+    lock_attrs: tuple = ("_lock",)
+    rlock_attrs: tuple = ()   # reentrant members of lock_attrs
+    fields: tuple = ()
+    note: str = ""
+
+
+PKG = "llm_instance_gateway_tpu"
+
+CLASSES = (
+    # -- shared infrastructure ---------------------------------------------
+    SharedClass(
+        f"{PKG}/events.py", "EventJournal", DATA_PATH,
+        lock_attrs=("_lock",),
+        fields=(
+            SharedField("_seq", MONOTONIC, writers=("emit",)),
+        ),
+        note="ring appends are GIL-atomic; seq/counters bump under the "
+             "lock"),
+    SharedClass(
+        f"{PKG}/gateway/telemetry.py", "GatewayMetrics", DATA_PATH,
+        fields=(
+            SharedField("lora_affinity_hits", MONOTONIC,
+                        writers=("record_pick",)),
+        ),
+        note="all counter tables mutate in place under the lock"),
+    SharedClass(
+        f"{PKG}/gateway/provider.py", "Provider", OBS_TICK,
+        lock_attrs=("_lock",), rlock_attrs=("_lock",),
+        fields=(
+            SharedField("version", MONOTONIC,
+                        writers=("refresh_metrics_once",
+                                 "refresh_pods_once",
+                                 "update_pod_metrics")),
+        ),
+        note="snapshot() hands out (version, pods) pairs; pods lists are "
+             "swapped whole per refresh"),
+    SharedClass(
+        f"{PKG}/gateway/datastore.py", "Datastore", CONTROL,
+        lock_attrs=("_lock",), rlock_attrs=("_lock",),
+        fields=(
+            SharedField("_pool", SWAP_PUBLISHED, writers=("set_pool",)),
+        )),
+
+    # -- gateway advisor stack (per pool) ----------------------------------
+    SharedClass(
+        f"{PKG}/gateway/health.py", "HealthScorer", OBS_TICK,
+        fields=(
+            SharedField("_non_healthy", SWAP_PUBLISHED,
+                        writers=("update",),
+                        note="the pick seam's lock-free avoid mark set"),
+            SharedField("last_update", MONOTONIC, writers=("update",)),
+            SharedField("would_avoid_total", MONOTONIC,
+                        writers=("note_pick",), domain=DATA_PATH),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/resilience.py", "CircuitBreaker", DATA_PATH,
+        fields=(
+            SharedField("_blocked_cache", SWAP_PUBLISHED,
+                        writers=("blocked_set",),
+                        note="lock-free fast-path read; rebuilt under the "
+                             "lock when dirty"),
+            SharedField("_cache_expiry", SWAP_PUBLISHED,
+                        writers=("blocked_set",)),
+            SharedField("_cache_dirty", LOCK_GUARDED,
+                        writers=("blocked_set", "note_pick", "prune",
+                                 "_transition", "_maybe_half_open")),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/resilience.py", "RetryBudget", DATA_PATH,
+        fields=(
+            SharedField("_tokens", LOCK_GUARDED,
+                        writers=("note_request", "try_spend")),
+            SharedField("spent_total", MONOTONIC, writers=("try_spend",)),
+            SharedField("denied_total", MONOTONIC, writers=("try_spend",)),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/resilience.py", "ResiliencePlane", DATA_PATH,
+        fields=(
+            SharedField("escape_hatch_total", MONOTONIC,
+                        writers=("note_escape_hatch",)),
+            SharedField("_remote_avoid", SWAP_PUBLISHED,
+                        writers=("set_remote_avoid",), domain=GOSSIP,
+                        note="statebus overlay; avoid_set() unions it "
+                             "lock-free per pick"),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/usage.py", "UsageRollup", OBS_TICK,
+        fields=(
+            SharedField("_noisy_models", SWAP_PUBLISHED,
+                        writers=("tick", "seed_noisy",
+                                 "set_remote_noisy"),
+                        note="noisy() serves it lock-free per pick"),
+            SharedField("_noisy_key_of", SWAP_PUBLISHED,
+                        writers=("tick", "seed_noisy"),
+                        note="note_pick reads it lock-free; every writer "
+                             "(seed_noisy included) rebuilds and swaps "
+                             "the dict whole"),
+            SharedField("_remote_noisy", SWAP_PUBLISHED,
+                        writers=("set_remote_noisy",), domain=GOSSIP,
+                        note="statebus overlay; note_pick falls back to "
+                             "it lock-free"),
+            SharedField("_totals", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_pool_waste", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_prev_requests", LOCK_GUARDED, writers=("tick",)),
+            SharedField("last_tick", MONOTONIC,
+                        writers=("tick",),
+                        note="maybe_tick reads it lock-free (float "
+                             "rebind)"),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+            SharedField("would_deprioritize_total", MONOTONIC,
+                        writers=("note_pick",), domain=DATA_PATH),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/fairness.py", "FairnessPolicy", OBS_TICK,
+        fields=(
+            SharedField("_noisy_pods_cache", SWAP_PUBLISHED,
+                        writers=("noisy_pods",), domain=DATA_PATH,
+                        note="the checked tuple-swap idiom: (noisy-set "
+                             "identity, frozenset) swapped whole; a "
+                             "mid-pick swap can never tear "
+                             "(tests/test_concurrency.py)"),
+            SharedField("_fair_shares", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_shares", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_costs", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_throttled", LOCK_GUARDED, writers=("tick",)),
+            SharedField("cfg", SWAP_PUBLISHED,
+                        writers=("update_config",), domain=CONTROL,
+                        note="whole FairnessConfig dataclass swapped on "
+                             "hot reload"),
+            SharedField("quota_scale", SWAP_PUBLISHED,
+                        writers=("set_quota_scale",), domain=GOSSIP),
+            SharedField("escape_total", MONOTONIC,
+                        writers=("note_fairness_escape",),
+                        domain=DATA_PATH),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/placement.py", "PlacementPlanner", OBS_TICK,
+        fields=(
+            SharedField("_resident_pods", SWAP_PUBLISHED,
+                        writers=("_rebuild_merged_locked",),
+                        note="note_pick/resident_pods read it lock-free"),
+            SharedField("_tier_pods", SWAP_PUBLISHED,
+                        writers=("_rebuild_merged_locked",),
+                        note="identity doubles as the native marshal's "
+                             "staleness signal"),
+            SharedField("_have_residency", SWAP_PUBLISHED,
+                        writers=("_rebuild_merged_locked",)),
+            SharedField("_have_local_residency", SWAP_PUBLISHED,
+                        writers=("tick",)),
+            SharedField("_local_tier_pods", SWAP_PUBLISHED,
+                        writers=("tick",),
+                        note="statebus publishes it; swapped whole per "
+                             "tick"),
+            SharedField("_remote_tier_pods", SWAP_PUBLISHED,
+                        writers=("set_remote_resident",), domain=GOSSIP),
+            SharedField("_decisions", SWAP_PUBLISHED, writers=("tick",)),
+            SharedField("_residency", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_idle", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_model_of", LOCK_GUARDED, writers=("tick",)),
+            SharedField("cfg", SWAP_PUBLISHED, writers=("update_config",),
+                        domain=CONTROL),
+            SharedField("would_steer_total", MONOTONIC,
+                        writers=("note_pick",), domain=DATA_PATH),
+            SharedField("wrong_tier_total", MONOTONIC,
+                        writers=("note_pick",), domain=DATA_PATH),
+            SharedField("escape_total", MONOTONIC,
+                        writers=("note_placement_escape",),
+                        domain=DATA_PATH),
+            SharedField("last_tick", MONOTONIC, writers=("tick",)),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/slo.py", "SLOEngine", OBS_TICK,
+        fields=(
+            SharedField("last_tick", MONOTONIC, writers=("tick",)),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/statebus.py", "StateBus", GOSSIP,
+        fields=(
+            SharedField("_seq", MONOTONIC, writers=("snapshot",),
+                        domain=OBS_TICK),
+            SharedField("_ever_saw_peer", SWAP_PUBLISHED,
+                        writers=("merge",),
+                        note="latching bool; set-once rebind"),
+            SharedField("_stale", SWAP_PUBLISHED, writers=("apply",),
+                        domain=OBS_TICK),
+            SharedField("last_apply_scale", SWAP_PUBLISHED,
+                        writers=("apply",), domain=OBS_TICK),
+            SharedField("stale_fallbacks_total", MONOTONIC,
+                        writers=("apply",), domain=OBS_TICK),
+            SharedField("exchanges", MONOTONIC,
+                        note="per-outcome counters mutated in place by "
+                             "the exchange event loop only; render() "
+                             "copies under the lock"),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/fleetobs.py", "FleetCollector", COLLECTOR,
+        fields=(
+            SharedField("last_sources", SWAP_PUBLISHED,
+                        writers=("_collect_locked",)),
+            SharedField("last_stitched", SWAP_PUBLISHED,
+                        writers=("_collect_locked",)),
+        )),
+
+    # -- scheduling ----------------------------------------------------------
+    SharedClass(
+        f"{PKG}/gateway/scheduling/native.py", "NativeScheduler",
+        DATA_PATH, lock_attrs=("_call_lock",),
+        fields=(
+            SharedField("_role_cache", SWAP_PUBLISHED,
+                        writers=("_routable_pods",),
+                        note="(version, pods, eff-version) tuple swapped "
+                             "whole; racing writers compute identical "
+                             "values for one snapshot version"),
+            SharedField("cfg", SWAP_PUBLISHED, writers=("update_config",),
+                        domain=CONTROL),
+            SharedField("_decode_tree", SWAP_PUBLISHED,
+                        writers=("update_config",), domain=CONTROL),
+            SharedField("_cfg_gen", MONOTONIC,
+                        writers=("update_config",), domain=CONTROL),
+        ),
+        note="the native State handle + persistent buffers live entirely "
+             "under _call_lock; the finish seams (prefix hash, RNG, "
+             "note_*) run outside it by PR-6 contract (lock-discipline "
+             "rule)"),
+    SharedClass(
+        f"{PKG}/gateway/scheduling/admission.py", "AdmissionController",
+        DATA_PATH,
+        fields=(
+            SharedField("_cfg", SWAP_PUBLISHED, writers=("update_config",),
+                        domain=CONTROL),
+            SharedField("_queues", SWAP_PUBLISHED,
+                        writers=("update_config",), domain=CONTROL),
+            SharedField("_park_budget", SWAP_PUBLISHED,
+                        writers=("set_park_budget",), domain=CONTROL),
+            SharedField("_drain_scheduler", SWAP_PUBLISHED,
+                        writers=("_arm",), domain=CONTROL),
+            SharedField("_running", SWAP_PUBLISHED,
+                        writers=("_arm", "stop"), domain=CONTROL),
+            SharedField("_thread", SWAP_PUBLISHED, writers=("_arm",),
+                        domain=CONTROL),
+        )),
+    SharedClass(
+        f"{PKG}/gateway/scheduling/prefix_affinity.py", "PrefixIndex",
+        DATA_PATH,
+        note="holder map mutates in place under the lock; no post-init "
+             "rebinds"),
+
+    # -- controllers / transports -------------------------------------------
+    SharedClass(
+        f"{PKG}/gateway/controllers/filewatch.py", "MembershipAggregator",
+        CONTROL),
+    SharedClass(
+        f"{PKG}/gateway/controllers/k8swatch.py", "KubeSource", CONTROL,
+        lock_attrs=("_slices_lock",)),
+    SharedClass(
+        f"{PKG}/gateway/extproc/service.py", "HealthService", CONTROL,
+        lock_attrs=("_watchers_lock",),
+        fields=(
+            SharedField("_watchers", LOCK_GUARDED, writers=("watch",),
+                        note="admission counter inc/dec under the lock"),
+        )),
+
+    # -- model server --------------------------------------------------------
+    SharedClass(
+        f"{PKG}/server/usage.py", "UsageTracker", ENGINE_STEP,
+        fields=(
+            SharedField("_kv_holdings", LOCK_GUARDED,
+                        writers=("sync_kv",)),
+            SharedField("_kv_t", LOCK_GUARDED, writers=("sync_kv",)),
+            SharedField("idle_slot_seconds", MONOTONIC,
+                        writers=("charge_decode",)),
+            SharedField("padding_tokens", MONOTONIC,
+                        writers=("charge_padding",)),
+        )),
+    SharedClass(
+        f"{PKG}/server/profiler.py", "StepProfiler", ENGINE_STEP,
+        fields=(
+            SharedField("_seq", MONOTONIC, writers=("note_dispatch",)),
+            SharedField("_last_end", OWNER_PRIVATE,
+                        writers=("note_dispatch",)),
+            SharedField("_idle_pending", OWNER_PRIVATE,
+                        writers=("note_dispatch", "note_idle")),
+            SharedField("_foreign_wall", OWNER_PRIVATE,
+                        writers=("note_dispatch",)),
+            SharedField("_prev_active", OWNER_PRIVATE,
+                        writers=("note_dispatch",)),
+            SharedField("padding_tokens", MONOTONIC,
+                        writers=("note_padding",)),
+        )),
+    SharedClass(
+        f"{PKG}/server/lora_manager.py", "LoRAManager", ENGINE_STEP,
+        lock_attrs=("_lock", "_mutate_lock"),
+        fields=(
+            SharedField("buffers", SWAP_PUBLISHED,
+                        writers=("load", "demote", "unload"),
+                        note="device buffer pytree swapped whole per "
+                             "residency verb"),
+        )),
+    SharedClass(
+        f"{PKG}/server/engine.py", "Engine", ENGINE_STEP,
+        fields=(
+            SharedField("_running", SWAP_PUBLISHED,
+                        writers=("start", "stop"), domain=CONTROL),
+            SharedField("_thread", SWAP_PUBLISHED, writers=("start",),
+                        domain=CONTROL),
+            SharedField("_draining", SWAP_PUBLISHED, writers=("drain",),
+                        domain=CONTROL),
+            SharedField("_admitting", LOCK_GUARDED,
+                        writers=("_admit_and_insert",
+                                 "_drain_decode_wait")),
+            SharedField("_pending", LOCK_GUARDED,
+                        writers=("_admit_and_insert", "_collect_followers",
+                                 "_start_stream", "stop")),
+            SharedField("_stream", LOCK_GUARDED,
+                        writers=("_abort_stream", "_start_stream",
+                                 "_stream_step", "stop")),
+            SharedField("decode_wait", LOCK_GUARDED,
+                        writers=("_sweep_decode_wait",)),
+            SharedField("_parked_kv_tokens", LOCK_GUARDED,
+                        writers=("_do_attach", "_drain_decode_wait",
+                                 "_park_waiting", "_sweep_decode_wait",
+                                 "stop")),
+            SharedField("cache", SWAP_PUBLISHED,
+                        writers=("_insert_prompt_kv", "_sync_tables"),
+                        note="KV pytree swapped whole by the engine "
+                             "thread"),
+            SharedField("draft_cache", OWNER_PRIVATE,
+                        writers=("_draft_admit",)),
+            SharedField("_tables_dirty", OWNER_PRIVATE,
+                        writers=("_paged_ensure", "_paged_free_row",
+                                 "_prefix_match_and_map", "_sync_tables")),
+            SharedField("_dev_counts", OWNER_PRIVATE,
+                        writers=("_count_first_token", "_counts",
+                                 "_dispatch_block", "_do_decode_step",
+                                 "_register_slot")),
+            SharedField("_dev_tokens", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_dispatch_block", "_dispatch_spec_block",
+                                 "_loop_pipelined")),
+            SharedField("_dev_positions", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_dispatch_block", "_dispatch_spec_block",
+                                 "_loop_pipelined")),
+            SharedField("_dev_remaining", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_dispatch_block", "_dispatch_spec_block",
+                                 "_loop_pipelined")),
+            SharedField("_dev_has_extra", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_dispatch_spec_block", "_draft_admit",
+                                 "_loop_pipelined")),
+            SharedField("_dev_extra_pos", OWNER_PRIVATE,
+                        writers=("_dispatch_spec_block",
+                                 "_loop_pipelined")),
+            SharedField("_dev_extra_tok", OWNER_PRIVATE,
+                        writers=("_dispatch_spec_block",
+                                 "_loop_pipelined")),
+            SharedField("_pending_budget_zero", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_loop_pipelined")),
+            SharedField("_prev_dispatch_steps", OWNER_PRIVATE,
+                        writers=("_loop_pipelined",
+                                 "_paged_ensure_decode")),
+            SharedField("decode_tps_ema", SWAP_PUBLISHED,
+                        writers=("_do_decode_step", "_do_spec_step",
+                                 "_process_block"),
+                        note="float rebind; the scrape thread reads it "
+                             "lock-free"),
+            SharedField("prefix_reused_tokens", MONOTONIC,
+                        writers=("_prefix_bucket_prefill",
+                                 "_prefix_match_and_map")),
+            SharedField("spec_cycles", MONOTONIC,
+                        writers=("_dispatch_spec_block", "_do_spec_step")),
+            SharedField("spec_emitted", MONOTONIC,
+                        writers=("_do_spec_step", "_process_block")),
+            SharedField("total_generated", MONOTONIC,
+                        writers=("_do_decode_step", "_do_spec_step",
+                                 "_emit_first_token", "_process_block")),
+            SharedField("total_requests", MONOTONIC,
+                        writers=("attach_prefilled", "submit"),
+                        domain=DATA_PATH),
+        ),
+        note="device-array fields are engine-thread-owned and rebound "
+             "whole; queue/park accounting shares the lock with the HTTP "
+             "submit path"),
+)
+
+# Attribute-name -> registered class, for the lock-order rule's
+# interprocedural call resolution (``self.usage.note_pick()`` resolves to
+# ``UsageRollup.note_pick`` through this map).  One name, one class,
+# repo-wide — keep attribute naming unambiguous or the analyzer (and the
+# reader) loses the thread.
+BINDINGS = {
+    "journal": "EventJournal",
+    "metrics": "GatewayMetrics",
+    "provider": "Provider",
+    "datastore": "Datastore",
+    "health": "HealthScorer",
+    "breaker": "CircuitBreaker",
+    "retry_budget": "RetryBudget",
+    "resilience": "ResiliencePlane",
+    "health_advisor": "ResiliencePlane",
+    "usage": "UsageRollup",
+    "fairness": "FairnessPolicy",
+    "usage_advisor": "FairnessPolicy",
+    "placement": "PlacementPlanner",
+    "placement_advisor": "PlacementPlanner",
+    "slo": "SLOEngine",
+    "statebus": "StateBus",
+    "bus": "StateBus",
+    "fleet": "FleetCollector",
+    "prefix_index": "PrefixIndex",
+    "admission": "AdmissionController",
+    "tracker": "UsageTracker",
+    "profiler": "StepProfiler",
+    "lora": "LoRAManager",
+    "engine": "Engine",
+}
+
+
+def all_classes() -> tuple[SharedClass, ...]:
+    return CLASSES
+
+
+def by_name() -> dict[str, SharedClass]:
+    return {c.name: c for c in CLASSES}
+
+
+def render_markdown() -> str:
+    """Domain/discipline catalogue for ARCHITECTURE.md §3m (generated on
+    demand by docs tooling; the source of truth stays here)."""
+    out = ["| class | module | domain | lock(s) | shared fields "
+           "(discipline) |", "|---|---|---|---|---|"]
+    for c in CLASSES:
+        fields = ", ".join(
+            f"`{f.name}` ({f.discipline}"
+            + (f", {f.domain}" if f.domain and f.domain != c.domain else "")
+            + ")"
+            for f in c.fields) or "—"
+        locks = ", ".join(f"`{a}`" for a in c.lock_attrs) or "—"
+        out.append(f"| `{c.name}` | `{c.module.split('/', 1)[1]}` "
+                   f"| {c.domain} | {locks} | {fields} |")
+    return "\n".join(out)

@@ -26,7 +26,8 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
+
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 import time
 
 # Event kinds.  One flat namespace shared by the gateway and the model
@@ -69,7 +70,7 @@ class EventJournal:
         self.capacity = max(1, capacity)
         self._ring: collections.deque = collections.deque(maxlen=self.capacity)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("EventJournal._lock")
         self._seq = 0
         # kind -> cumulative count (survives ring rotation; exported as a
         # labeled counter family on the owning surface's /metrics).

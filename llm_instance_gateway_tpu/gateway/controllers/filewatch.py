@@ -25,6 +25,7 @@ from typing import Callable
 
 import yaml
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.api import v1alpha1
 from llm_instance_gateway_tpu.gateway.controllers.reconcilers import (
     Endpoint,
@@ -135,7 +136,7 @@ class MembershipAggregator:
 
     def __init__(self, reconciler: EndpointsReconciler):
         self._reconciler = reconciler
-        self._lock = threading.Lock()
+        self._lock = witness_lock("MembershipAggregator._lock")
         self._sources: dict[str, list[Endpoint]] = {}
 
     def publish(self, source: str, endpoints: list[Endpoint]) -> None:

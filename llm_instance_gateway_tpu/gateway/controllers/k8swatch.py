@@ -32,6 +32,7 @@ import urllib.request
 from collections.abc import Callable, Mapping
 from dataclasses import dataclass
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.api.v1alpha1 import (
     GROUP,
     inference_model_from_doc,
@@ -286,7 +287,7 @@ class KubeSource:
         self.client = client or KubeClient(config)
         ns = config.namespace
         self._slices: dict[str, list[Endpoint]] = {}
-        self._slices_lock = threading.Lock()
+        self._slices_lock = witness_lock("KubeSource._slices_lock")
         # Accepts an EndpointsReconciler-shaped object OR a bare publish
         # callable (e.g. a MembershipAggregator sink).
         self._publish_endpoints = (

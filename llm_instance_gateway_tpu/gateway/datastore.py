@@ -11,10 +11,10 @@ like the reference's nanosecond-seeded draw (datastore.go:81-84).
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Iterable
 
+from llm_instance_gateway_tpu.lockwitness import witness_rlock
 from llm_instance_gateway_tpu.api.v1alpha1 import (
     Criticality,
     InferenceModel,
@@ -27,7 +27,7 @@ class Datastore:
     """Thread-safe cache of pool/models/pods consumed by scheduler + handlers."""
 
     def __init__(self, pods: Iterable[Pod] = ()):  # WithPods test option (:37-44)
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("Datastore._lock")
         self._pool: InferencePool | None = None
         self._models: dict[str, InferenceModel] = {}
         self._pods: dict[str, Pod] = {p.name: p for p in pods}

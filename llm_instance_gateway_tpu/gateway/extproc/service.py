@@ -17,12 +17,12 @@ to generated ``_pb2_grpc`` code.
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from concurrent import futures as _futures
 
 import grpc
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.gateway.extproc import envoy_base_pb2 as corepb
 from llm_instance_gateway_tpu.gateway.extproc import envoy_http_status_pb2 as statuspb
 from llm_instance_gateway_tpu.gateway.extproc import ext_proc_v3_pb2 as pb
@@ -159,7 +159,7 @@ class HealthService:
     def __init__(self, datastore):
         self._datastore = datastore
         self._watchers = 0
-        self._watchers_lock = threading.Lock()
+        self._watchers_lock = witness_lock("HealthService._watchers_lock")
 
     def _status(self) -> int:
         if self._datastore.has_synced_pool():

@@ -45,10 +45,10 @@ Config: ``add_resilience_args``-style bootstrap flags
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import asdict, dataclass, replace
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import render_keyed_family
 
@@ -131,7 +131,7 @@ class FairnessPolicy:
         self.journal = journal
         self.provider = provider    # adapter-rank source (may be None)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("FairnessPolicy._lock")
         # Tick-computed state (all keyed by (model, adapter)):
         self._fair_shares: dict[tuple, float] = {}
         self._shares: dict[tuple, float] = {}

@@ -36,9 +36,9 @@ from __future__ import annotations
 
 import asyncio
 import collections
-import threading
 import time
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import (
     Histogram,
@@ -382,7 +382,7 @@ class FleetCollector:
         self.trace_capacity = max(1, trace_capacity)
         self._clock = clock
         self._sources: dict[str, _SourceState] = {}
-        self._lock = threading.Lock()
+        self._lock = witness_lock("FleetCollector._lock")
         # collect() is single-flight: two overlapping /debug/fleet pulls
         # would both read the same cursors and double-append events into
         # the bounded deques (evicting real history with duplicates).

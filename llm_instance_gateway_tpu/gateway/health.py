@@ -36,10 +36,10 @@ from __future__ import annotations
 
 import logging
 import statistics
-import threading
 import time
 from dataclasses import asdict, dataclass
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import escape_label, render_counter
 
@@ -100,7 +100,7 @@ class HealthScorer:
         self.cfg = cfg or HealthConfig()
         self.journal = journal
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("HealthScorer._lock")
         # Proxy-fed streaks + cumulative counters (per pod name).
         self._err_streak: dict[str, int] = {}
         self._handoff_streak: dict[str, int] = {}

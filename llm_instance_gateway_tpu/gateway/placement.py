@@ -42,10 +42,10 @@ gateway-side brain over that ladder — the ``PlacementPlanner``:
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import asdict, dataclass
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import escape_label, render_keyed_family
 
@@ -132,7 +132,7 @@ class PlacementPlanner:
         self.cfg = cfg or PlacementConfig()
         self.journal = journal
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("PlacementPlanner._lock")
         # Tick-computed state:
         self._idle: dict[tuple[str, str], int] = {}  # (pod, adapter) -> ticks
         self._decisions: list[dict] = []     # latest tick's plan

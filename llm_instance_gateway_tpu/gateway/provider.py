@@ -18,6 +18,7 @@ import logging
 import threading
 import time
 
+from llm_instance_gateway_tpu.lockwitness import witness_rlock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway.datastore import Datastore
 from llm_instance_gateway_tpu.gateway.metrics_client import fetch_all
@@ -36,7 +37,7 @@ class Provider:
         self._client = metrics_client
         self._datastore = datastore
         self._metrics: dict[str, PodMetrics] = {}
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("Provider._lock")
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._executor = futures.ThreadPoolExecutor(

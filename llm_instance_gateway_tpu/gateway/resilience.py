@@ -35,11 +35,11 @@ from __future__ import annotations
 
 import logging
 import random
-import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
 from llm_instance_gateway_tpu.tracing import escape_label
@@ -121,7 +121,7 @@ class CircuitBreaker:
         self.cfg = cfg or ResilienceConfig()
         self.journal = journal
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("CircuitBreaker._lock")
         self._pods: dict[str, _PodCircuit] = {}
         # blocked_set() cache for the pick seam: rebuilt only after a
         # state/probe change (dirty flag) or when an open pod's cooldown
@@ -312,7 +312,7 @@ class RetryBudget:
         self.ratio = ratio
         self.cap = max(cap, min_tokens)
         self._tokens = min_tokens
-        self._lock = threading.Lock()
+        self._lock = witness_lock("RetryBudget._lock")
         self.spent_total = 0
         self.denied_total = 0
 
@@ -362,6 +362,9 @@ class ResiliencePlane:
             min_tokens=self.cfg.retry_budget_min,
             cap=self.cfg.retry_budget_cap)
         self.rng = rng or random.Random()
+        # The pick seam's note_escape_hatch runs on threaded transports;
+        # an unlocked += there loses updates (concurrency lint, ISSUE 13).
+        self._lock = witness_lock("ResiliencePlane._lock")
         self.escape_hatch_total = 0
         # Peer-gateway avoid overlay (statebus merged view): pods some
         # OTHER replica's health scorer or breaker currently avoids.
@@ -422,8 +425,10 @@ class ResiliencePlane:
 
     def note_escape_hatch(self) -> None:
         """Every tree survivor was avoidable; the pick proceeded over the
-        full set (policy=avoid last resort)."""
-        self.escape_hatch_total += 1
+        full set (policy=avoid last resort).  Called from the threaded-
+        transport pick seam, so the increment takes the lock."""
+        with self._lock:
+            self.escape_hatch_total += 1
         if self.journal is not None:
             self.journal.emit(events_mod.POLICY_ESCAPE,
                               policy=self.cfg.health_policy)

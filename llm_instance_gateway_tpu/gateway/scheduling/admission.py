@@ -34,6 +34,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.gateway.scheduling.config import AdmissionConfig
 from llm_instance_gateway_tpu.gateway.scheduling.scheduler import SchedulingError
 
@@ -138,7 +139,7 @@ class AdmissionController:
         self._drain_factory = drain_scheduler_factory
         self._cfg = cfg or AdmissionConfig()
         self._rng = rng or random.Random(0)
-        self._lock = threading.Lock()
+        self._lock = witness_lock("AdmissionController._lock")
         self._queues = TierQueues(self._cfg, self._rng)
         self._work = threading.Event()
         self._running = False

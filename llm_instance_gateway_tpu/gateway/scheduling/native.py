@@ -37,6 +37,7 @@ import weakref
 
 import numpy as np
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.gateway.scheduling.config import (
     DEFAULT_CONFIG,
     SchedulerConfig,
@@ -275,7 +276,7 @@ class NativeScheduler:
         self._role_cache: tuple | None = None
         # The gRPC transport calls schedule() from a thread pool; the
         # native state handles and persistent buffers are shared state.
-        self._call_lock = threading.Lock()
+        self._call_lock = witness_lock("NativeScheduler._call_lock")
         # Health/resilience hook (gateway/resilience.py) — same seam as
         # the Python Scheduler: log_only counts would-be avoidance picks
         # and never alters the pick (candidate parity with C++ stays

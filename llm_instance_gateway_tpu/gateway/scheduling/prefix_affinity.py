@@ -46,10 +46,10 @@ until a request actually repeats a prefix.
 from __future__ import annotations
 
 import hashlib
-import threading
 from collections import OrderedDict
 from typing import Sequence
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
 from llm_instance_gateway_tpu.gateway.types import PodMetrics
 
@@ -109,7 +109,7 @@ class PrefixIndex:
         # Divergence counters: hash -> (candidate pod, consecutive picks).
         # Bounded by _map pruning (entries die with their hash).
         self._pending: dict[int, tuple[str, int]] = {}
-        self._lock = threading.Lock()
+        self._lock = witness_lock("PrefixIndex._lock")
 
     def record(self, hashes: Sequence[int], pod_name: str) -> None:
         """Learn ``pod_name`` as the holder of ``hashes``.

@@ -40,10 +40,10 @@ import json
 import logging
 import os
 import re
-import threading
 import time
 from dataclasses import dataclass, field
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import escape_label
 
@@ -139,7 +139,7 @@ class SLOEngine:
         self.journal = journal
         self.on_fast_burn = on_fast_burn  # (model, objective, burns) -> None
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("SLOEngine._lock")
         # (model, objective) -> deque[(ts, good, total)] pruned to the
         # longest window; one sample per tick, so memory is O(models *
         # objectives * horizon/tick).

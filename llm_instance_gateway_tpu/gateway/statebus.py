@@ -46,13 +46,13 @@ replica from ``/debug/statebus``.
 from __future__ import annotations
 
 import asyncio
-import threading
 import time
 import uuid
 from dataclasses import asdict, dataclass
 
 import aiohttp
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import (
     Histogram,
@@ -119,7 +119,7 @@ class StateBus:
         self.replica_id = self.cfg.replica_id or f"gw-{uuid.uuid4().hex[:8]}"
         self.journal = journal
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("StateBus._lock")
         self._seq = 0
         # Boot epoch: a restarted replica reuses its id but restarts its
         # seq counter at 1 — without an epoch, peers holding its OLD doc

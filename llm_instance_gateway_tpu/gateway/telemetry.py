@@ -16,9 +16,9 @@ shared ``tracing.render_histogram`` helper.
 
 from __future__ import annotations
 
-import threading
 import time
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.tracing import (
     LATENCY_BUCKETS,
     PICK_BUCKETS,
@@ -45,7 +45,7 @@ PHASE_FAMILIES = (
 
 class GatewayMetrics:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("GatewayMetrics._lock")
         self.requests_total: dict[str, int] = {}  # by model
         self.scheduled_total: dict[str, int] = {}  # by target pod
         # Shed/error counters keyed by model; the None key is the unlabeled

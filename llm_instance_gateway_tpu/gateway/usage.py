@@ -40,10 +40,10 @@ deprioritizing picks and gating admission when its mode asks for it.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import asdict, dataclass
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.tracing import escape_label, render_keyed_family
 
@@ -87,7 +87,7 @@ class UsageRollup:
         # inflate, via the unclaimed-leftover split) pool A's traffic
         # shares.  None = claim everything (single-pool, unchanged).
         self._request_filter = request_filter
-        self._lock = threading.Lock()
+        self._lock = witness_lock("UsageRollup._lock")
         self._prev_totals: dict[str, dict] = {r: {} for r in RESOURCES}
         self._prev_requests: dict[str, float] = {}
         self._shares: dict[str, dict] = {r: {} for r in RESOURCES}
@@ -324,7 +324,11 @@ class UsageRollup:
         name = model if adapter == BASE else adapter
         with self._lock:
             self._states[(model, adapter)] = NOISY
-            self._noisy_key_of[name] = (model, adapter)
+            # _noisy_key_of is read lock-free by note_pick: swap a rebuilt
+            # dict in whole (publish-by-swap) instead of mutating the one
+            # a concurrent pick may be reading.
+            self._noisy_key_of = {**self._noisy_key_of,
+                                  name: (model, adapter)}
             self._noisy_models = frozenset(
                 self._noisy_key_of) | frozenset(self._remote_noisy)
 

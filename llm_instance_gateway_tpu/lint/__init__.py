@@ -35,6 +35,18 @@ and what breaking it costs — see ARCHITECTURE.md "correctness tooling"):
 ``usage-conservation``    per-adapter step-second charges always charge the
                           engine-wall denominator at the same site, and only
                           server/usage.py writes the accumulator tables (PR 5)
+``ownership``             every lock-constructing class and every post-init
+                          shared-field rebind is declared in
+                          concurrency_registry.py with a discipline and a
+                          writer allowlist (ISSUE 13)
+``publish-by-swap``       fields read lock-free on the pick hot path are only
+                          ever REPLACED whole, never mutated in place (the
+                          _noisy_pods_cache tuple-swap idiom, checked)
+``lock-order``            the interprocedural lock-acquisition graph (with
+                          blocks + call edges via the registry BINDINGS) is
+                          acyclic and never re-enters a non-reentrant lock;
+                          completeness cross-checked at runtime by
+                          lockwitness.py
 ``mech-*``                mechanical layer (ruff-equivalent fallback): unused
                           imports, mutable default arguments
 ========================  ===================================================
@@ -165,7 +177,7 @@ def rule(name: str) -> Callable[[RuleFn], RuleFn]:
 def _load_rules() -> None:
     # Import for registration side effects; idempotent (modules cache).
     from llm_instance_gateway_tpu.lint import (  # noqa: F401
-        abi, contracts, exposition, mechanical, seams,
+        abi, concurrency, contracts, exposition, mechanical, seams,
     )
 
 
@@ -183,21 +195,38 @@ def load_baseline(tree: Tree) -> set[str]:
 def run(root: str, rules: Iterable[str] | None = None,
         apply_baseline: bool = True) -> list[Finding]:
     """All unsuppressed, unbaselined findings for the tree at ``root``."""
+    findings, _ = run_timed(root, rules=rules, apply_baseline=apply_baseline)
+    return findings
+
+
+def run_timed(root: str, rules: Iterable[str] | None = None,
+              apply_baseline: bool = True
+              ) -> tuple[list[Finding], dict[str, float]]:
+    """``run`` plus per-rule wall seconds — CI logs print the table so a
+    rule that turns quadratic shows up as a number, not as "lint feels
+    slow" (the interprocedural lock-order graph is the obvious suspect to
+    watch as the package grows)."""
+    import time
+
     _load_rules()
     tree = Tree(root)
     wanted = set(rules) if rules is not None else None
     baseline = load_baseline(tree) if apply_baseline else set()
     findings: list[Finding] = []
+    timings: dict[str, float] = {}
     for name, fn in RULES:
         if wanted is not None and name not in wanted:
             continue
-        for f in fn(tree):
+        t0 = time.perf_counter()
+        found = fn(tree)
+        timings[name] = timings.get(name, 0.0) + time.perf_counter() - t0
+        for f in found:
             if f.fingerprint() in baseline:
                 continue
             if tree.suppressed(f):
                 continue
             findings.append(f)
-    return findings
+    return findings, timings
 
 
 def repo_root() -> str:

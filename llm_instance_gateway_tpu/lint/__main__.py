@@ -1,11 +1,16 @@
 """CLI: ``python -m llm_instance_gateway_tpu.lint`` (see package docstring).
 
 Exit status: 0 clean, 1 findings, 2 usage error.
+
+``--json`` emits one machine-readable document (findings + per-rule wall
+milliseconds) for CI log scraping; ``--timings`` prints the per-rule table
+in text mode so a slow rule is a number in the build log, not a vibe.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from llm_instance_gateway_tpu import lint
@@ -29,6 +34,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="refingerprint the native ABI "
                              "(lint/abi_baseline.json) after a deliberate, "
                              "version-bumped signature change")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings + per-rule timings as one "
+                             "JSON document (CI log scraping)")
+    parser.add_argument("--timings", action="store_true",
+                        help="print the per-rule wall-time table after "
+                             "the findings")
     args = parser.parse_args(argv)
     root = args.root or lint.repo_root()
     if args.write_abi_baseline:
@@ -41,10 +52,26 @@ def main(argv: list[str] | None = None) -> int:
             print(name)
         return 0
     rules = args.rules.split(",") if args.rules else None
-    findings = lint.run(root, rules=rules,
-                        apply_baseline=not args.no_baseline)
+    findings, timings = lint.run_timed(root, rules=rules,
+                                       apply_baseline=not args.no_baseline)
+    if args.as_json:
+        print(json.dumps({
+            "clean": not findings,
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+            "timings_ms": {name: round(s * 1000, 2)
+                           for name, s in timings.items()},
+            "total_ms": round(sum(timings.values()) * 1000, 2),
+        }, indent=1))
+        return 1 if findings else 0
     for f in findings:
         print(f)
+    if args.timings:
+        width = max(len(n) for n in timings) if timings else 0
+        for name, s in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<{width}}  {s * 1000:8.1f} ms")
+        print(f"  {'TOTAL':<{width}}  "
+              f"{sum(timings.values()) * 1000:8.1f} ms")
     if findings:
         print(f"\n{len(findings)} finding(s). Invariant catalogue: "
               f"ARCHITECTURE.md 'correctness tooling'; suppress a line "

@@ -1,0 +1,767 @@
+"""Rules ``lock-order``, ``ownership``, ``publish-by-swap``.
+
+The concurrency contract plane (ISSUE 13).  PR 9's lock-discipline rule
+polices two narrow patterns (work under the native ``_call_lock``, blocking
+calls in proxy coroutines); meanwhile PRs 10-11 multiplied the cross-thread
+surface — the statebus gossip path writes advisor state that concurrent
+data-path picks read lock-free, and ~40 ``threading.Lock`` sites guard
+hand-maintained disciplines that lived in comments.  These rules pin them
+against ``concurrency_registry.py`` (the ``metrics_registry.py`` shape):
+
+**ownership** — every class that constructs a lock is registered; every
+field a registered class rebinds after ``__init__`` is declared with a
+publication discipline and a writer allowlist.  A write from an undeclared
+method (or an undeclared field appearing at all) fails lint, so the overlay
+seams (``set_remote_*``) are checked exceptions rather than folklore, and
+new shared state cannot land undocumented.
+
+**publish-by-swap** — fields declared SWAP_PUBLISHED are read lock-free on
+the pick hot path, so the only legal write is replacing the whole object
+(the ``_noisy_pods_cache`` tuple-swap idiom).  Any in-place mutation
+(``.append`` / ``.update`` / ``[k] =`` / ``del`` / ``+=``) of such a field
+is a torn-read factory and fails here.
+
+**lock-order** — an interprocedural lock-acquisition graph: ``with
+self._lock`` sites give direct nesting edges; call edges resolve through
+the registry's ``BINDINGS`` (attribute name -> owning class) plus
+same-class/same-module lookup, and a held lock gains an edge to every lock
+its callees may transitively acquire.  A cycle is a potential deadlock —
+two code paths that acquire the same pair of locks in opposite orders —
+and fails statically, before any thread schedule has to demonstrate it.
+Re-entrant acquisition of a lock not declared reentrant fails too
+(``threading.Lock`` self-deadlocks).  Unresolvable calls are skipped: the
+runtime ``lockwitness`` cross-checks the graph's completeness against the
+acquisitions the deterministic interleave harness actually performs
+(``tests/test_concurrency.py``), so an analyzer blind spot fails a test
+instead of silently narrowing coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from llm_instance_gateway_tpu.lint import PKG, Finding, Tree, rule
+
+REGISTRY = f"{PKG}/concurrency_registry.py"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "witness_lock", "witness_rlock"}
+_RLOCK_FACTORIES = {"RLock", "witness_rlock"}
+
+# In-place mutators that tear a swap-published read.
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "sort", "reverse",
+    "appendleft", "extendleft",
+}
+
+
+# ---------------------------------------------------------------------------
+# Registry parsing (AST, not import — fixture trees must work)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FieldDecl:
+    name: str
+    discipline: str
+    writers: tuple = ()
+    domain: str = ""
+
+
+@dataclass
+class ClassDecl:
+    module: str
+    name: str
+    domain: str
+    lock_attrs: tuple = ("_lock",)
+    rlock_attrs: tuple = ()
+    fields: dict = field(default_factory=dict)   # name -> FieldDecl
+
+
+@dataclass
+class Registry:
+    classes: list            # [ClassDecl]
+    bindings: dict           # attr name -> class name
+    disciplines: set
+    domains: set
+
+    def by_key(self) -> dict:
+        return {(c.module, c.name): c for c in self.classes}
+
+    def by_name(self) -> dict:
+        return {c.name: c for c in self.classes}
+
+
+def _const_str(node, symbols: dict | None = None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # f"{PKG}/gateway/usage.py" — the real registry prefixes module paths
+    # with the package constant; resolve Name parts via module constants.
+    if isinstance(node, ast.JoinedStr) and symbols is not None:
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str):
+                out.append(part.value)
+            elif (isinstance(part, ast.FormattedValue)
+                  and isinstance(part.value, ast.Name)
+                  and part.value.id in symbols):
+                out.append(symbols[part.value.id])
+            else:
+                return None
+        return "".join(out)
+    return None
+
+
+def _const_str_tuple(node) -> tuple:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            s = _const_str(e)
+            if s is not None:
+                out.append(s)
+        return tuple(out)
+    return ()
+
+
+def _name_values(mod: ast.Module, names: set[str]) -> dict[str, str]:
+    """Module-level NAME = "str" constants (the discipline/domain
+    vocabulary), so registry entries can use the symbolic names."""
+    out: dict[str, str] = {}
+    for node in mod.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve(node, symbols: dict[str, str]) -> str | None:
+    s = _const_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.Name):
+        return symbols.get(node.id)
+    return None
+
+
+def load_registry(tree: Tree) -> tuple[Registry | None, list[Finding]]:
+    mod = tree.parse(REGISTRY)
+    if mod is None:
+        return None, [Finding(
+            "ownership", REGISTRY, 0,
+            "concurrency_registry.py missing or unparseable — the shared-"
+            "state contract has nothing to anchor to")]
+    symbols = _name_values(mod, set())
+    disciplines = {symbols[n] for n in
+                   ("LOCK_GUARDED", "SWAP_PUBLISHED", "MONOTONIC",
+                    "OWNER_PRIVATE")
+                   if n in symbols}
+    domains = {v for k, v in symbols.items()
+               if k in ("DATA_PATH", "OBS_TICK", "GOSSIP", "ENGINE_STEP",
+                        "COLLECTOR", "CONTROL")}
+    classes: list[ClassDecl] = []
+    bindings: dict[str, str] = {}
+    for node in ast.walk(mod):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "BINDINGS"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                ks, vs = _const_str(k), _const_str(v)
+                if ks and vs:
+                    bindings[ks] = vs
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "SharedClass"):
+            continue
+        args = list(node.args)
+        if len(args) < 3:
+            continue
+        module = _const_str(args[0], symbols)
+        name = _const_str(args[1])
+        domain = _resolve(args[2], symbols) or ""
+        if not module or not name:
+            continue
+        decl = ClassDecl(module=module, name=name, domain=domain)
+        for kw in node.keywords:
+            if kw.arg == "lock_attrs":
+                decl.lock_attrs = _const_str_tuple(kw.value)
+            elif kw.arg == "rlock_attrs":
+                decl.rlock_attrs = _const_str_tuple(kw.value)
+            elif kw.arg == "fields" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                for fe in kw.value.elts:
+                    if not (isinstance(fe, ast.Call)
+                            and isinstance(fe.func, ast.Name)
+                            and fe.func.id == "SharedField"
+                            and fe.args):
+                        continue
+                    fname = _const_str(fe.args[0])
+                    fdisc = (_resolve(fe.args[1], symbols)
+                             if len(fe.args) > 1 else None) or ""
+                    if fname is None:
+                        continue
+                    fd = FieldDecl(name=fname, discipline=fdisc)
+                    for fkw in fe.keywords:
+                        if fkw.arg == "writers":
+                            fd.writers = _const_str_tuple(fkw.value)
+                        elif fkw.arg == "domain":
+                            fd.domain = _resolve(fkw.value, symbols) or ""
+                    decl.fields[fname] = fd
+        classes.append(decl)
+    if not classes:
+        return None, [Finding(
+            "ownership", REGISTRY, 0,
+            "no SharedClass(...) declarations found in "
+            "concurrency_registry.py — re-anchor the shared-state "
+            "contract")]
+    return Registry(classes=classes, bindings=bindings,
+                    disciplines=disciplines, domains=domains), []
+
+
+# ---------------------------------------------------------------------------
+# Package scanning helpers
+# ---------------------------------------------------------------------------
+
+
+# lockwitness.py IS the lock instrumentation — its internal mutex and
+# wrapper classes are the measurement apparatus, excluded the way
+# label-hygiene trusts tracing.py's renderer layer.
+_TRUSTED = (f"{PKG}/lockwitness.py",)
+
+
+def _pkg_files(tree: Tree) -> list[str]:
+    return [rel for rel in tree.py_files(PKG, exclude=(f"{PKG}/lint/",))
+            if rel not in _TRUSTED]
+
+
+def _class_methods(cls: ast.ClassDef) -> dict[str, ast.AST]:
+    return {m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _lock_constructions(cls: ast.ClassDef) -> dict[str, tuple]:
+    """{attr: (lineno, is_rlock, witness_name)} for every
+    ``self.<attr> = <lock>()``; ``witness_name`` is the string literal
+    passed to witness_lock/witness_rlock (None for plain threading
+    constructions)."""
+    out: dict[str, tuple] = {}
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(meth):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                    and isinstance(node.value, ast.Call)):
+                continue
+            fn = node.value.func
+            if isinstance(fn, ast.Attribute):
+                # Only threading locks guard cross-THREAD state; asyncio
+                # locks serialize coroutines on one loop.
+                if not (isinstance(fn.value, ast.Name)
+                        and fn.value.id == "threading"):
+                    continue
+                name = fn.attr
+            else:
+                name = getattr(fn, "id", "")
+            if name in _LOCK_FACTORIES:
+                witness_name = None
+                if name.startswith("witness") and node.value.args:
+                    witness_name = _const_str(node.value.args[0])
+                out[node.targets[0].attr] = (
+                    node.lineno, name in _RLOCK_FACTORIES, witness_name)
+    return out
+
+
+def _attr_rebinds(meth: ast.AST) -> list[tuple[str, int, str]]:
+    """(field, lineno, kind) for every ``self.<field> = / += ...`` in the
+    method (nested defs included: they close over self)."""
+    out = []
+    for node in ast.walk(meth):
+        tgt = None
+        kind = "assign"
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.append((t.attr, node.lineno, "assign"))
+            continue
+        if isinstance(node, ast.AugAssign):
+            tgt, kind = node.target, "augassign"
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt = node.target
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            out.append((tgt.attr, node.lineno, kind))
+    return out
+
+
+_INIT_METHODS = ("__init__", "__post_init__", "__new__")
+
+
+# ---------------------------------------------------------------------------
+# ownership
+# ---------------------------------------------------------------------------
+
+
+@rule("ownership")
+def check_ownership(tree: Tree) -> list[Finding]:
+    registry, findings = load_registry(tree)
+    if registry is None:
+        return findings
+    by_key = registry.by_key()
+    by_name = registry.by_name()
+
+    # Vocabulary sanity: disciplines/domains/binding targets must resolve.
+    for c in registry.classes:
+        if registry.domains and c.domain not in registry.domains:
+            findings.append(Finding(
+                "ownership", REGISTRY, 0,
+                f"{c.name}: domain {c.domain!r} is not a declared domain"))
+        for f in c.fields.values():
+            if registry.disciplines and \
+                    f.discipline not in registry.disciplines:
+                findings.append(Finding(
+                    "ownership", REGISTRY, 0,
+                    f"{c.name}.{f.name}: discipline {f.discipline!r} is "
+                    f"not a declared discipline"))
+            if f.domain and registry.domains and \
+                    f.domain not in registry.domains:
+                findings.append(Finding(
+                    "ownership", REGISTRY, 0,
+                    f"{c.name}.{f.name}: domain {f.domain!r} is not a "
+                    f"declared domain"))
+    for attr, cls_name in sorted(registry.bindings.items()):
+        if cls_name not in by_name:
+            findings.append(Finding(
+                "ownership", REGISTRY, 0,
+                f"BINDINGS[{attr!r}] names {cls_name!r}, which is not a "
+                f"registered SharedClass — the lock-order analyzer "
+                f"cannot resolve calls through it"))
+
+    seen_keys: set[tuple[str, str]] = set()
+    for rel in _pkg_files(tree):
+        if rel == REGISTRY:
+            continue
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        for cls in ast.walk(mod):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_constructions(cls)
+            decl = by_key.get((rel, cls.name))
+            if decl is None:
+                if locks:
+                    attr, (lineno, _, _) = sorted(locks.items())[0]
+                    findings.append(Finding(
+                        "ownership", rel, lineno,
+                        f"{cls.name} constructs a lock ({attr}) but is "
+                        f"not registered in concurrency_registry.py — "
+                        f"declare its owning domain, lock attrs, and "
+                        f"shared fields (undeclared shared state is how "
+                        f"the next race ships)"))
+                continue
+            seen_keys.add((rel, cls.name))
+            # Lock attrs: constructed vs declared, both directions.
+            for attr, (lineno, is_rlock, witness_name) in sorted(
+                    locks.items()):
+                # The witness name IS the lock's runtime identity; a
+                # copy-paste typo would merge two locks into one graph
+                # node and corrupt both the cross_check and acyclicity.
+                if (witness_name is not None
+                        and witness_name != f"{cls.name}.{attr}"):
+                    findings.append(Finding(
+                        "ownership", rel, lineno,
+                        f"witness lock name {witness_name!r} does not "
+                        f"match its owner {cls.name}.{attr} — the "
+                        f"runtime witness would merge two distinct "
+                        f"locks into one graph node (copy-paste?)"))
+                if attr not in decl.lock_attrs:
+                    findings.append(Finding(
+                        "ownership", rel, lineno,
+                        f"{cls.name}.{attr} is a constructed lock not in "
+                        f"the registry's lock_attrs — the lock-order "
+                        f"graph cannot see acquisitions of it"))
+                elif is_rlock and attr not in decl.rlock_attrs:
+                    findings.append(Finding(
+                        "ownership", rel, lineno,
+                        f"{cls.name}.{attr} is reentrant but not in "
+                        f"rlock_attrs — the lock-order rule would "
+                        f"misflag legal re-acquisition"))
+                elif not is_rlock and attr in decl.rlock_attrs:
+                    findings.append(Finding(
+                        "ownership", rel, lineno,
+                        f"{cls.name}.{attr} is declared reentrant but "
+                        f"constructed as a plain Lock — re-acquisition "
+                        f"self-deadlocks"))
+            # Field inventory.
+            assigned_anywhere: set[str] = set()
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                rebinds = _attr_rebinds(meth)
+                assigned_anywhere.update(f for f, _, _ in rebinds)
+                if meth.name in _INIT_METHODS:
+                    continue
+                for fname, lineno, _kind in rebinds:
+                    if fname in locks or fname in decl.lock_attrs:
+                        continue
+                    fd = decl.fields.get(fname)
+                    if fd is None:
+                        findings.append(Finding(
+                            "ownership", rel, lineno,
+                            f"{cls.name}.{meth.name} writes undeclared "
+                            f"shared field {fname!r} — declare it in "
+                            f"concurrency_registry.py with a discipline "
+                            f"and writer allowlist (cross-thread state "
+                            f"does not get to be folklore)"))
+                    elif meth.name not in fd.writers:
+                        findings.append(Finding(
+                            "ownership", rel, lineno,
+                            f"{cls.name}.{meth.name} writes {fname!r} "
+                            f"but is not in its declared writers "
+                            f"{list(fd.writers)} — either the method "
+                            f"joined the owning domain (declare it) or "
+                            f"this write races the owner"))
+            for fname, fd in sorted(decl.fields.items()):
+                if fname not in assigned_anywhere:
+                    findings.append(Finding(
+                        "ownership", rel, cls.lineno,
+                        f"{cls.name}.{fname} is declared in "
+                        f"concurrency_registry.py but never assigned in "
+                        f"the class — dead registry entry (or the field "
+                        f"was renamed)"))
+    for (module, name), decl in sorted(by_key.items()):
+        if (module, name) not in seen_keys:
+            findings.append(Finding(
+                "ownership", REGISTRY, 0,
+                f"registered class {name} not found in {module} — the "
+                f"class moved or was renamed; re-anchor the registry"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# publish-by-swap
+# ---------------------------------------------------------------------------
+
+
+def _is_self_field(node: ast.AST, fields: dict) -> str | None:
+    """field name when ``node`` is ``self.<field>`` for a declared swap
+    field (the check scopes to the owning class — attr-name collisions
+    across classes in one module must not cross-fire)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr in fields):
+        return node.attr
+    return None
+
+
+@rule("publish-by-swap")
+def check_publish_by_swap(tree: Tree) -> list[Finding]:
+    registry, findings = load_registry(tree)
+    if registry is None:
+        return []  # ownership already reports the missing registry
+    for decl in registry.classes:
+        fields = {f.name: f for f in decl.fields.values()
+                  if f.discipline == "publish-by-swap"}
+        if not fields:
+            continue
+        mod = tree.parse(decl.module)
+        if mod is None:
+            continue
+        cls = next((n for n in ast.walk(mod)
+                    if isinstance(n, ast.ClassDef)
+                    and n.name == decl.name), None)
+        if cls is None:
+            continue  # ownership reports the missing class
+        for node in ast.walk(cls):
+            # self.field.mutator(...)
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                if node.func.attr in _MUTATOR_METHODS:
+                    base = _is_self_field(node.func.value, fields)
+                    if base is not None:
+                        findings.append(Finding(
+                            "publish-by-swap", decl.module, node.lineno,
+                            f"in-place .{node.func.attr}() on swap-"
+                            f"published field {decl.name}.{base} — "
+                            f"lock-free readers can see a half-built "
+                            f"value; build a new object and swap it "
+                            f"whole (the _noisy_pods_cache idiom)"))
+                continue
+            # self.field[k] = v   /   del self.field[k]
+            if isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                base = _is_self_field(node.value, fields)
+                if base is not None:
+                    findings.append(Finding(
+                        "publish-by-swap", decl.module, node.lineno,
+                        f"in-place subscript write to swap-published "
+                        f"field {decl.name}.{base} — lock-free "
+                        f"readers can see a half-built value; build a "
+                        f"new object and swap it whole"))
+                continue
+            # self.field += ... (read-modify-write: not a swap)
+            if isinstance(node, ast.AugAssign):
+                base = _is_self_field(node.target, fields)
+                if base is not None:
+                    findings.append(Finding(
+                        "publish-by-swap", decl.module, node.lineno,
+                        f"augmented assignment to swap-published field "
+                        f"{decl.name}.{base} — a read-modify-write is "
+                        f"not an atomic swap; compute the new value, then "
+                        f"rebind"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FnInfo:
+    key: tuple                         # (class name or "", fn name)
+    rel: str
+    direct_edges: list = field(default_factory=list)  # (L, M, lineno)
+    held_calls: list = field(default_factory=list)    # (L, callee key, line)
+    acquired: set = field(default_factory=set)        # lock ids touched
+    calls: set = field(default_factory=set)           # callee keys
+    acquire_sites: dict = field(default_factory=dict)  # lock id -> lineno
+
+
+def _lock_id_of(ctx: ast.AST, own_class: str | None,
+                bindings: dict) -> str | None:
+    """Lock identity for a with-item / .acquire() receiver, or None."""
+    if not isinstance(ctx, ast.Attribute) or "lock" not in ctx.attr:
+        return None
+    base = ctx.value
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            return f"{own_class}.{ctx.attr}" if own_class else None
+        cls = bindings.get(base.id)
+        return f"{cls}.{ctx.attr}" if cls else None
+    if isinstance(base, ast.Attribute):
+        cls = bindings.get(base.attr)
+        return f"{cls}.{ctx.attr}" if cls else None
+    return None
+
+
+def _callee_key(node: ast.Call, own_class: str | None,
+                bindings: dict, module_fns: set) -> tuple | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return ("", fn.id) if fn.id in module_fns else None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    base = fn.value
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            return (own_class, fn.attr) if own_class else None
+        cls = bindings.get(base.id)
+        return (cls, fn.attr) if cls else None
+    if isinstance(base, ast.Attribute):
+        cls = bindings.get(base.attr)
+        return (cls, fn.attr) if cls else None
+    return None
+
+
+def _walk_fn(info: _FnInfo, fn: ast.AST, own_class: str | None,
+             bindings: dict, module_fns: set) -> None:
+    """Recursive walk tracking the syntactic held-lock stack."""
+
+    def visit(node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+            inner = held
+            for item in node.items:
+                lock = _lock_id_of(item.context_expr, own_class, bindings)
+                if lock is not None:
+                    info.acquired.add(lock)
+                    info.acquire_sites.setdefault(lock, node.lineno)
+                    if inner:
+                        info.direct_edges.append(
+                            (inner[-1], lock, node.lineno))
+                    inner = inner + (lock,)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            # Explicit .acquire() outside a with-statement.
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                lock = _lock_id_of(node.func.value, own_class, bindings)
+                if lock is not None:
+                    info.acquired.add(lock)
+                    info.acquire_sites.setdefault(lock, node.lineno)
+                    if held:
+                        info.direct_edges.append(
+                            (held[-1], lock, node.lineno))
+            callee = _callee_key(node, own_class, bindings, module_fns)
+            if callee is not None:
+                info.calls.add(callee)
+                if held:
+                    info.held_calls.append((held[-1], callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child, ())
+
+
+def _build_fn_table(tree: Tree,
+                    bindings: dict) -> dict[tuple, list[_FnInfo]]:
+    """(class-or-"" , fn name) -> [_FnInfo] over the whole package.
+    Class keys use the bare class name (BINDINGS resolve to names, not
+    modules); colliding class names merge conservatively."""
+    table: dict[tuple, list[_FnInfo]] = {}
+    for rel in _pkg_files(tree):
+        if rel == REGISTRY:
+            continue
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        module_fns = {n.name for n in mod.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for node in mod.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(key=("", node.name), rel=rel)
+                _walk_fn(info, node, None, bindings, module_fns)
+                table.setdefault(info.key, []).append(info)
+            elif isinstance(node, ast.ClassDef):
+                for meth in node.body:
+                    if not isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    info = _FnInfo(key=(node.name, meth.name), rel=rel)
+                    _walk_fn(info, meth, node.name, bindings, module_fns)
+                    table.setdefault(info.key, []).append(info)
+    return table
+
+
+def _transitive_acquired(table: dict) -> dict[tuple, set]:
+    """Fixpoint: every lock a function may acquire, directly or through
+    resolved callees."""
+    acq = {key: set().union(*(i.acquired for i in infos))
+           for key, infos in table.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, infos in table.items():
+            cur = acq[key]
+            before = len(cur)
+            for info in infos:
+                for callee in info.calls:
+                    if callee in acq:
+                        cur |= acq[callee]
+            if len(cur) != before:
+                changed = True
+    return acq
+
+
+def static_lock_graph(tree: Tree) -> tuple[dict[str, set], dict, list]:
+    """(edges graph, witness sites, findings) — the interprocedural
+    acquisition-order graph.  ``witness sites``: edge -> (rel, lineno,
+    description) for reporting; the edge set is also what
+    ``lockwitness.cross_check`` compares observed runtime edges against.
+    """
+    graph, sites, findings, _ = _lock_graph_full(tree)
+    return graph, sites, findings
+
+
+def _lock_graph_full(tree: Tree):
+    registry, findings = load_registry(tree)
+    bindings = dict(registry.bindings) if registry else {}
+    rlocks: set[str] = set()
+    if registry:
+        for c in registry.classes:
+            for attr in c.rlock_attrs:
+                rlocks.add(f"{c.name}.{attr}")
+    table = _build_fn_table(tree, bindings)
+    acq = _transitive_acquired(table)
+    n_acquire_sites = sum(len(i.acquire_sites)
+                          for infos in table.values() for i in infos)
+    graph: dict[str, set] = {}
+    sites: dict[tuple, tuple] = {}
+    for key, infos in table.items():
+        for info in infos:
+            for held, lock, lineno in info.direct_edges:
+                graph.setdefault(held, set()).add(lock)
+                sites.setdefault((held, lock), (
+                    info.rel, lineno,
+                    f"{'.'.join(k for k in key if k)} nests the "
+                    f"acquisition directly"))
+            for held, callee, lineno in info.held_calls:
+                for lock in acq.get(callee, ()):
+                    graph.setdefault(held, set()).add(lock)
+                    sites.setdefault((held, lock), (
+                        info.rel, lineno,
+                        f"{'.'.join(k for k in key if k)} calls "
+                        f"{'.'.join(c for c in callee if c)} while "
+                        f"holding {held}"))
+    # Reentrancy self-edges are legal for declared rlocks.
+    for lock in rlocks:
+        if lock in graph:
+            graph[lock].discard(lock)
+    return graph, sites, findings, n_acquire_sites
+
+
+@rule("lock-order")
+def check_lock_order(tree: Tree) -> list[Finding]:
+    from llm_instance_gateway_tpu.lockwitness import find_cycle
+
+    graph, sites, reg_findings, n_sites = _lock_graph_full(tree)
+    if reg_findings:
+        return []  # the registry problem is ownership's finding
+    findings: list[Finding] = []
+    if n_sites == 0:
+        return [Finding(
+            "lock-order", REGISTRY, 0,
+            "no lock acquisitions found anywhere in the package — the "
+            "locking moved; re-anchor this rule")]
+    # Self-edges on non-reentrant locks: guaranteed self-deadlock.
+    for lock in sorted(graph):
+        if lock in graph.get(lock, ()):
+            rel, lineno, why = sites.get((lock, lock),
+                                         (REGISTRY, 0, "unknown site"))
+            findings.append(Finding(
+                "lock-order", rel, lineno,
+                f"re-entrant acquisition of non-reentrant lock {lock} "
+                f"({why}) — threading.Lock self-deadlocks; hoist the "
+                f"inner acquisition out or declare the lock reentrant "
+                f"in the registry"))
+    # Cycles among distinct locks: a deadlock-capable ordering exists.
+    pruned = {a: {b for b in tgts if b != a} for a, tgts in graph.items()}
+    seen_cycles: set[tuple] = set()
+    while True:
+        cycle = find_cycle(pruned)
+        if cycle is None:
+            break
+        canon = tuple(sorted(set(cycle)))
+        if canon in seen_cycles:
+            break
+        seen_cycles.add(canon)
+        a, b = cycle[0], cycle[1]
+        rel, lineno, why = sites.get((a, b), (REGISTRY, 0, "unknown site"))
+        findings.append(Finding(
+            "lock-order", rel, lineno,
+            f"lock-order cycle: {' -> '.join(cycle)} — two code paths "
+            f"acquire these locks in opposite orders ({why}); a thread "
+            f"schedule exists that deadlocks.  Break the cycle by "
+            f"snapshotting state before crossing objects"))
+        # Remove one edge of the reported cycle and keep looking so
+        # independent cycles each get a finding.
+        pruned[a].discard(b)
+    return findings

@@ -1,5 +1,6 @@
-// Hostile-snapshot FFI fuzzer for libligsched (built and run by
-// `make native-asan` with -fsanitize=address,undefined).
+// Hostile-snapshot + threaded FFI fuzzer for libligsched (built and run
+// by `make native-asan` with -fsanitize=address,undefined, and by
+// `make native-tsan` with -fsanitize=thread).
 //
 // The ctypes marshal in scheduling/native.py is a trusted caller, but the
 // ABI is extern "C": any process that dlopens the .so can hand it garbage,
@@ -21,12 +22,32 @@
 // valid snapshots and pick/pick_many batches so the legitimate paths run
 // under ASan/UBSan too.  Exit 0 = clean; any sanitizer report aborts.
 //
-// Build: make -C llm_instance_gateway_tpu/native asan
+// Threaded stages (the `make native-tsan` tentpole; also run under ASan
+// for the extra coverage):
+//
+//   - fuzz_threaded_protocol: N picker threads calling lig_pick_many race
+//     an updater thread swapping snapshots on the SAME state handle, all
+//     serialized by a mutex mirroring NativeScheduler._call_lock — TSan
+//     proves the real locking protocol race-free against the library's
+//     actual memory accesses (the Python-side lock is only correct if the
+//     library hides no unsynchronized global state behind it).
+//   - fuzz_concurrent_const_picks: threads call lig_pick / lig_pick_many
+//     concurrently with NO lock and no writer.  The pick path's contract
+//     is const — candidate computation reads the snapshot and writes only
+//     caller buffers.  A hidden mutable cache inside State would race
+//     here and TSan would catch it; this is the property that lets the
+//     gateway copy candidates out and run the finish seams unlocked.
+//
+// Build: make -C llm_instance_gateway_tpu/native asan   (ASan/UBSan)
+//        make -C llm_instance_gateway_tpu/native tsan   (TSan)
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <limits>
+#include <mutex>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -60,7 +81,7 @@ namespace {
 
 constexpr int32_t kError = -2;
 
-int g_failures = 0;
+std::atomic<int> g_failures{0};  // threads bump it too
 
 #define CHECK(cond, what)                                          \
   do {                                                             \
@@ -72,14 +93,22 @@ int g_failures = 0;
   } while (0)
 
 // Deterministic PRNG (no libc rand: reproducible across platforms).
-uint64_t g_seed = 0x9e3779b97f4a7c15ull;
-uint64_t next_u64() {
-  g_seed = g_seed * 6364136223846793005ull + 1442695040888963407ull;
-  return g_seed >> 11;
-}
-int64_t rnd(int64_t lo, int64_t hi) {  // inclusive range
-  return lo + static_cast<int64_t>(next_u64() % (hi - lo + 1));
-}
+// Struct form so each fuzz thread owns an independent stream — a shared
+// global seed would itself be the data race TSan reports first.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed = 0x9e3779b97f4a7c15ull) : s(seed) {}
+  uint64_t next_u64() {
+    s = s * 6364136223846793005ull + 1442695040888963407ull;
+    return s >> 11;
+  }
+  int64_t rnd(int64_t lo, int64_t hi) {  // inclusive range
+    return lo + static_cast<int64_t>(next_u64() % (hi - lo + 1));
+  }
+};
+
+Rng g_rng;  // single-threaded stages only
+int64_t rnd(int64_t lo, int64_t hi) { return g_rng.rnd(lo, hi); }
 
 // A valid snapshot workspace the hostile cases mutate one field at a time.
 struct Snapshot {
@@ -348,6 +377,110 @@ void fuzz_hostile_shapes() {
   lig_state_free(nullptr);  // must be a no-op, not a crash
 }
 
+// ---- threaded stages (the make native-tsan tentpole) ----------------------
+
+constexpr int32_t kMaxThreadedPods = 16;
+
+// Picker threads racing an updater's snapshot swaps on ONE handle, every
+// call serialized by a mutex mirroring the gateway's _call_lock protocol.
+// TSan verifies the protocol suffices against the library's real memory
+// accesses; the contract CHECKs verify picks stay in range across swaps.
+void fuzz_threaded_protocol() {
+  void* h = lig_state_new();
+  CHECK(h != nullptr, "lig_state_new (threaded)");
+  std::mutex call_lock;
+  Snapshot snaps[2];
+  snaps[0].build(8, 4, false);
+  snaps[1].build(kMaxThreadedPods, 6, false);
+  {
+    std::lock_guard<std::mutex> g(call_lock);
+    CHECK(snaps[0].update(h) == 0, "threaded baseline snapshot rejected");
+  }
+  std::atomic<bool> stop{false};
+  std::thread updater([&] {
+    for (int i = 0; i < 400; ++i) {
+      const Snapshot& s = snaps[i & 1];
+      std::lock_guard<std::mutex> g(call_lock);
+      CHECK(s.update(h) == 0, "threaded snapshot swap rejected");
+    }
+    stop.store(true);
+  });
+  std::vector<std::thread> pickers;
+  for (int t = 0; t < 4; ++t) {
+    pickers.emplace_back([&, t] {
+      Rng r(0x1000 + static_cast<uint64_t>(t) * 7919);
+      constexpr int32_t kMaxReqs = 8;
+      int32_t aids[kMaxReqs], counts[kMaxReqs];
+      uint8_t crit[kMaxReqs], noisy_req[kMaxReqs], flags[kMaxReqs];
+      int64_t toks[kMaxReqs];
+      int32_t cands[kMaxReqs * kMaxThreadedPods];
+      while (!stop.load()) {
+        const int32_t n_reqs = static_cast<int32_t>(r.rnd(1, kMaxReqs));
+        for (int32_t i = 0; i < n_reqs; ++i) {
+          aids[i] = static_cast<int32_t>(r.rnd(-2, 8));
+          crit[i] = static_cast<uint8_t>(r.rnd(0, 1));
+          noisy_req[i] = static_cast<uint8_t>(r.rnd(0, 1));
+          toks[i] = r.rnd(0, 1 << 14);
+        }
+        std::lock_guard<std::mutex> g(call_lock);
+        CHECK(lig_pick_many(h, n_reqs, aids, crit, noisy_req, toks,
+                            counts, cands, flags) == 0,
+              "threaded pick_many rejected under the call lock");
+        for (int32_t i = 0; i < n_reqs; ++i)
+          CHECK(counts[i] >= -3 && counts[i] <= kMaxThreadedPods,
+                "threaded pick_many count out of contract range");
+      }
+    });
+  }
+  updater.join();
+  for (auto& th : pickers) th.join();
+  lig_state_free(h);
+}
+
+// Concurrent lock-free picks against an immutable snapshot: the pick path
+// is contractually const (reads the snapshot, writes caller buffers only).
+// A hidden mutable cache inside State would be a TSan report here — this
+// is the property that lets the gateway copy candidates out and run the
+// prefix/RNG/note_* seams outside the lock.
+void fuzz_concurrent_const_picks() {
+  void* h = lig_state_new();
+  CHECK(h != nullptr, "lig_state_new (const picks)");
+  Snapshot s;
+  s.build(12, 5, false);
+  CHECK(s.update(h) == 0, "const-pick snapshot rejected");
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 6; ++t) {
+    threads.emplace_back([&, t] {
+      Rng r(0x2000 + static_cast<uint64_t>(t) * 104729);
+      int32_t out[12];
+      int32_t aids[4], counts[4];
+      uint8_t crit[4], noisy_req[4], flags[4];
+      int64_t toks[4];
+      int32_t cands[4 * 12];
+      for (int iter = 0; iter < 2000; ++iter) {
+        uint8_t f = 0;
+        const int32_t rc = lig_pick(
+            h, static_cast<int32_t>(r.rnd(-1, 6)),
+            static_cast<uint8_t>(r.rnd(0, 1)),
+            static_cast<uint8_t>(r.rnd(0, 1)), r.rnd(0, 4096), out, &f);
+        CHECK(rc >= -3 && rc <= 12,
+              "concurrent lig_pick out of contract range");
+        for (int32_t i = 0; i < 4; ++i) {
+          aids[i] = static_cast<int32_t>(r.rnd(-1, 6));
+          crit[i] = static_cast<uint8_t>(r.rnd(0, 1));
+          noisy_req[i] = static_cast<uint8_t>(r.rnd(0, 1));
+          toks[i] = r.rnd(0, 4096);
+        }
+        CHECK(lig_pick_many(h, 4, aids, crit, noisy_req, toks, counts,
+                            cands, flags) == 0,
+              "concurrent lig_pick_many rejected");
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  lig_state_free(h);
+}
+
 }  // namespace
 
 int main() {
@@ -355,8 +488,12 @@ int main() {
               lig_abi_version());
   fuzz_valid_load();
   fuzz_hostile_shapes();
+  std::printf("threaded fuzz: pick_many vs snapshot swaps under the call "
+              "lock, then lock-free const picks\n");
+  fuzz_threaded_protocol();
+  fuzz_concurrent_const_picks();
   if (g_failures > 0) {
-    std::fprintf(stderr, "FUZZ: %d failure(s)\n", g_failures);
+    std::fprintf(stderr, "FUZZ: %d failure(s)\n", g_failures.load());
     return 1;
   }
   std::printf("FUZZ PASS\n");

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
@@ -625,7 +626,7 @@ class Engine:
         self._thread: threading.Thread | None = None
 
         # Telemetry (exported by server.metrics in the gateway contract).
-        self._lock = threading.Lock()
+        self._lock = witness_lock("Engine._lock")
         self.total_generated = 0
         self.total_requests = 0
         self.decode_tps_ema = 0.0

@@ -16,7 +16,6 @@ a pytree ``{"meta": {"alpha": f, "rank": r}, "weights": {target: {"a": ...,
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -24,6 +23,7 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.models import lora as lora_lib
 
 logger = logging.getLogger(__name__)
@@ -108,12 +108,12 @@ class LoRAManager:
     def __init__(self, cfg, dtype=jnp.bfloat16, mesh=None,
                  host_cache_slots: int = 8, clock=time.perf_counter):
         self.cfg = cfg
-        self._lock = threading.Lock()
+        self._lock = witness_lock("LoRAManager._lock")
         # Serializes whole load/unload operations: the buffer update is a
         # read-modify-write of self.buffers, and concurrent HTTP admin calls
         # run in separate executor threads — without this, the second writer
         # would silently drop the first one's weights.
-        self._mutate_lock = threading.Lock()
+        self._mutate_lock = witness_lock("LoRAManager._mutate_lock")
         self._adapters: dict[str, AdapterInfo] = {}
         self._active: dict[str, int] = {}  # name -> in-flight request count
         self._free_slots = list(range(cfg.max_lora_slots))

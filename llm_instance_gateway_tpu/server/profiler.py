@@ -40,9 +40,9 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
 import time
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.tracing import Histogram
 
 # Dispatch walls are ~100µs (tiny CPU models) to ~100ms (remote TPU
@@ -72,7 +72,7 @@ class StepProfiler:
             capacity = int(os.environ.get("LIG_PROFILE_CAPACITY", "2048"))
         self.capacity = max(1, capacity)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("StepProfiler._lock")
         self._ring: collections.deque = collections.deque(maxlen=self.capacity)
         self._seq = 0
         # End of the previous dispatch on the engine-thread clock; None

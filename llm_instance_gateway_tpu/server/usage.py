@@ -35,9 +35,9 @@ from the engine loop and snapshot from the scrape thread.
 
 from __future__ import annotations
 
-import threading
 import time
 
+from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.tracing import Histogram
 
 # Attribution key for requests with no LoRA adapter (base-model rows).
@@ -63,7 +63,7 @@ class UsageTracker:
         self.decode_slots = max(1, decode_slots)
         self.kv_block = max(1, kv_block)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("UsageTracker._lock")
         self.step_seconds: dict[tuple[str, str], float] = {}
         self.tokens: dict[tuple[str, str], int] = {}
         self.kv_block_seconds: dict[str, float] = {}

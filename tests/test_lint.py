@@ -525,6 +525,225 @@ def test_suppression_pragma(tmp_path):
     assert run_rule(root, "mech-unused-import") == []
 
 
+# -- concurrency contract plane (ISSUE 13) ----------------------------------
+
+CONC_REGISTRY_REL = f"{PKG}/concurrency_registry.py"
+ALPHA_REL = f"{PKG}/gateway/alpha.py"
+BETA_REL = f"{PKG}/gateway/beta.py"
+
+CONC_REGISTRY = '''\
+LOCK_GUARDED = "lock-guarded"
+SWAP_PUBLISHED = "publish-by-swap"
+MONOTONIC = "monotonic-counter"
+OWNER_PRIVATE = "owner-private"
+DATA_PATH = "data-path"
+OBS_TICK = "observability-tick"
+
+
+class SharedField:
+    def __init__(self, *a, **k):
+        pass
+
+
+class SharedClass:
+    def __init__(self, *a, **k):
+        pass
+
+
+BINDINGS = {"beta": "Beta", "alpha": "Alpha"}
+
+CLASSES = (
+    SharedClass("llm_instance_gateway_tpu/gateway/alpha.py", "Alpha",
+                DATA_PATH, lock_attrs=("_lock",),
+                fields=(SharedField("_marks", SWAP_PUBLISHED,
+                                    writers=("tick",)),)),
+    SharedClass("llm_instance_gateway_tpu/gateway/beta.py", "Beta",
+                OBS_TICK, lock_attrs=("_lock",)),
+)
+'''
+
+GOOD_ALPHA = '''\
+import threading
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock = threading.Lock()
+        self.beta = beta
+        self._marks = frozenset()
+
+    def tick(self):
+        with self._lock:
+            held = 1
+        self.beta.poke()
+        self._marks = frozenset({"x"})
+'''
+
+GOOD_BETA = '''\
+import threading
+
+
+class Beta:
+    def __init__(self, alpha):
+        self._lock = threading.Lock()
+        self.alpha = alpha
+
+    def poke(self):
+        with self._lock:
+            pass
+'''
+
+
+def conc_tree(tmp_path, alpha=GOOD_ALPHA, beta=GOOD_BETA,
+              registry=CONC_REGISTRY, extra=None):
+    files = {CONC_REGISTRY_REL: registry, ALPHA_REL: alpha, BETA_REL: beta}
+    files.update(extra or {})
+    return make_tree(tmp_path, files)
+
+
+def test_concurrency_clean_fixture(tmp_path):
+    root = conc_tree(tmp_path)
+    for r in ("ownership", "publish-by-swap", "lock-order"):
+        assert run_rule(root, r) == [], r
+
+
+def test_lock_order_flags_inversion_across_two_modules(tmp_path):
+    """Alpha holds its lock while poking Beta; Beta holds its lock while
+    ticking Alpha — the classic cross-module inversion, caught from the
+    AST alone."""
+    alpha = GOOD_ALPHA.replace(
+        "        with self._lock:\n"
+        "            held = 1\n"
+        "        self.beta.poke()\n",
+        "        with self._lock:\n"
+        "            self.beta.poke()\n")
+    beta = GOOD_BETA + (
+        "\n    def cross(self):\n"
+        "        with self._lock:\n"
+        "            self.alpha.tick()\n")
+    root = conc_tree(tmp_path, alpha=alpha, beta=beta)
+    found = run_rule(root, "lock-order")
+    assert any("lock-order cycle" in f.message and "Alpha._lock" in f.message
+               and "Beta._lock" in f.message for f in found), \
+        messages(found)
+
+
+def test_lock_order_flags_reentrant_self_acquisition(tmp_path):
+    beta = GOOD_BETA + (
+        "\n    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.poke()\n")
+    root = conc_tree(tmp_path, beta=beta)
+    found = run_rule(root, "lock-order")
+    assert any("re-entrant acquisition" in f.message
+               and "Beta._lock" in f.message for f in found), \
+        messages(found)
+
+
+def test_ownership_flags_unregistered_shared_field(tmp_path):
+    alpha = GOOD_ALPHA.replace(
+        "        self._marks = frozenset({\"x\"})\n",
+        "        self._marks = frozenset({\"x\"})\n"
+        "        self._rogue = 1\n")
+    root = conc_tree(tmp_path, alpha=alpha)
+    found = run_rule(root, "ownership")
+    assert any("_rogue" in f.message and "undeclared shared field"
+               in f.message for f in found), messages(found)
+
+
+def test_ownership_flags_undeclared_writer(tmp_path):
+    alpha = GOOD_ALPHA + (
+        "\n    def sneak(self):\n"
+        "        self._marks = frozenset()\n")
+    root = conc_tree(tmp_path, alpha=alpha)
+    found = run_rule(root, "ownership")
+    assert any("sneak" in f.message and "not in its declared writers"
+               in f.message for f in found), messages(found)
+
+
+def test_ownership_flags_unregistered_lock_class(tmp_path):
+    root = conc_tree(tmp_path, extra={
+        f"{PKG}/gateway/gamma.py":
+            "import threading\n\n\n"
+            "class Gamma:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"})
+    found = run_rule(root, "ownership")
+    assert any("Gamma" in f.message and "not registered" in f.message
+               for f in found), messages(found)
+
+
+def test_ownership_flags_mismatched_witness_name(tmp_path):
+    """The witness name literal IS the lock's runtime identity; a
+    copy-paste typo merges two locks into one graph node."""
+    alpha = GOOD_ALPHA.replace(
+        "import threading\n",
+        "from llm_instance_gateway_tpu.lockwitness import witness_lock\n"
+    ).replace(
+        "self._lock = threading.Lock()",
+        'self._lock = witness_lock("HealthScorer._lock")')
+    root = conc_tree(tmp_path, alpha=alpha)
+    found = run_rule(root, "ownership")
+    assert any("does not match its owner Alpha._lock" in f.message
+               for f in found), messages(found)
+    # The correct name is clean.
+    alpha_ok = alpha.replace('witness_lock("HealthScorer._lock")',
+                             'witness_lock("Alpha._lock")')
+    assert run_rule(conc_tree(tmp_path / "ok", alpha=alpha_ok),
+                    "ownership") == []
+
+
+def test_ownership_flags_dead_field_entry(tmp_path):
+    registry = CONC_REGISTRY.replace(
+        'SharedField("_marks", SWAP_PUBLISHED,\n'
+        '                                    writers=("tick",)),',
+        'SharedField("_marks", SWAP_PUBLISHED,\n'
+        '                                    writers=("tick",)),\n'
+        '                        SharedField("_ghost", LOCK_GUARDED),')
+    assert registry != CONC_REGISTRY
+    root = conc_tree(tmp_path, registry=registry)
+    found = run_rule(root, "ownership")
+    assert any("_ghost" in f.message and "dead registry entry"
+               in f.message for f in found), messages(found)
+
+
+def test_publish_by_swap_flags_in_place_mutation(tmp_path):
+    alpha = GOOD_ALPHA.replace(
+        '        self._marks = frozenset({"x"})\n',
+        '        self._marks = set()\n'
+        '        self._marks.add("x")\n')
+    root = conc_tree(tmp_path, alpha=alpha)
+    found = run_rule(root, "publish-by-swap")
+    assert any(".add()" in f.message and "_marks" in f.message
+               for f in found), messages(found)
+
+
+def test_publish_by_swap_flags_subscript_and_augassign(tmp_path):
+    alpha = GOOD_ALPHA.replace(
+        '        self._marks = frozenset({"x"})\n',
+        '        self._marks["k"] = 1\n')
+    found = run_rule(conc_tree(tmp_path, alpha=alpha), "publish-by-swap")
+    assert any("subscript write" in f.message for f in found), \
+        messages(found)
+
+
+def test_witness_static_graph_mismatch_detected(tmp_path):
+    """The witness/static cross-check: a runtime-observed edge the AST
+    graph did not derive is a loud mismatch (analyzer or BINDINGS blind
+    spot), not a silent coverage gap."""
+    from llm_instance_gateway_tpu.lint.concurrency import static_lock_graph
+    from llm_instance_gateway_tpu.lockwitness import cross_check
+
+    root = conc_tree(tmp_path)
+    graph, _sites, findings = static_lock_graph(lint.Tree(root))
+    assert findings == []
+    static_edges = {(a, b) for a, t in graph.items() for b in t}
+    observed = set(static_edges) | {("Zeta._lock", "Alpha._lock")}
+    assert cross_check(static_edges, observed) == [
+        ("Zeta._lock", "Alpha._lock")]
+    assert cross_check(static_edges, static_edges) == []
+
+
 # -- the real tree ----------------------------------------------------------
 
 def test_clean_tree_zero_findings():
@@ -539,6 +758,7 @@ def test_all_rules_registered():
     for expected in ("seam-order", "lock-discipline", "abi-drift",
                      "metric-currency", "event-kinds", "label-hygiene",
                      "flag-docs", "usage-conservation",
+                     "ownership", "publish-by-swap", "lock-order",
                      "mech-unused-import", "mech-mutable-default"):
         assert expected in names, names
 

@@ -1,0 +1,38 @@
+"""Thread-sanitized native build gate as a pytest entry (slow-marked:
+the TSan build + threaded fuzz take ~1 min; tier-1 stays fast without it).
+
+``tools/native_tsan_check.py`` owns the orchestration: thread-sanitized
+build, then the fuzz harness's threaded stages — concurrent
+``lig_pick_many`` racing ``lig_state_update`` snapshot swaps under the
+real ``_call_lock`` protocol, plus lock-free const picks.  A missing
+toolchain or TSan runtime must SKIP LOUDLY — the tool prints
+``NATIVE-TSAN SKIPPED: <why>`` and this wrapper turns that into a visible
+pytest skip, never a silent pass.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "native_tsan_check.py")
+
+pytestmark = pytest.mark.slow
+
+
+def test_native_tsan_gate():
+    proc = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True,
+        timeout=600,
+        env=dict(os.environ,
+                 PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                     "PYTHONPATH", "")))
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"native-tsan gate failed:\n{out}"
+    if "NATIVE-TSAN SKIPPED" in out:
+        pytest.skip("thread-sanitized native build unavailable on this "
+                    "host — " + out.strip().splitlines()[-1])
+    assert "NATIVE-TSAN PASS" in out, out
+    assert "FUZZ PASS" in out, out

@@ -46,6 +46,7 @@ ABSOLUTE_MAX = {
     "pick_fairness_ratio": 1.05,
     "pick_placement_ratio": 1.05,
     "step_profile_ratio": 1.05,
+    "pick_witness_ratio": 1.05,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
 # 1.0 on a socket-bound rig, so a baseline-relative gate would only measure
@@ -64,6 +65,7 @@ _RATIO_SOURCES = {
     "pick_fairness_ratio": "fairness",
     "pick_placement_ratio": "placement",
     "step_profile_ratio": "profiler",
+    "pick_witness_ratio": "witness",
 }
 
 # family -> (primary metric, direction) used to choose the conservative
@@ -76,6 +78,7 @@ _FAMILY_PRIMARY = {
     "fairness": ("pick_fairness_ratio", "lower"),
     "placement": ("pick_placement_ratio", "lower"),
     "profiler": ("step_profile_ratio", "lower"),
+    "witness": ("pick_witness_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -93,6 +96,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "fairness": bench.run_fairness_microbench(),
         "placement": bench.run_placement_microbench(),
         "profiler": bench.run_profiler_microbench(),
+        "witness": bench.run_witness_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
     }
@@ -108,7 +112,8 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
                   "policy": bench.run_policy_microbench,
                   "fairness": bench.run_fairness_microbench,
                   "placement": bench.run_placement_microbench,
-                  "profiler": bench.run_profiler_microbench}
+                  "profiler": bench.run_profiler_microbench,
+                  "witness": bench.run_witness_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):
             if fams[fam].get(metric, 0.0) <= ABSOLUTE_MAX[metric]:

@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Thread-sanitized native build gate (`make native-tsan`).
+
+The ASan gate (tools/native_asan_check.py) proves the library safe against
+hostile INPUTS; this gate proves it safe against hostile SCHEDULES.  The
+fuzz harness's threaded stages drive the two concurrency contracts the
+gateway's data-plane fast path rests on:
+
+1. **The _call_lock protocol suffices**: picker threads calling
+   ``lig_pick_many`` race an updater thread swapping whole snapshots via
+   ``lig_state_update`` on ONE state handle, every call serialized by a
+   mutex mirroring ``NativeScheduler._call_lock``.  The Python-side lock
+   is only correct if the library hides no unsynchronized global state
+   behind it — TSan checks the library's real memory accesses, not our
+   beliefs about them.
+2. **Picks are const**: threads call ``lig_pick``/``lig_pick_many``
+   concurrently with NO lock and no writer.  The candidate computation
+   must read the snapshot and write only caller buffers; a hidden mutable
+   cache inside ``State`` would race here.  This property is why the
+   gateway may copy candidates out and run the prefix/RNG/note_* finish
+   seams outside the lock (the PR-6 lock discipline).
+
+Exit 0 with ``NATIVE-TSAN PASS`` on success; exit 0 with a loud
+``NATIVE-TSAN SKIPPED: <why>`` when the toolchain or the TSan runtime is
+absent (the pytest wrapper converts that into a visible skip); exit 1 on
+any failure or sanitizer report.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "llm_instance_gateway_tpu", "native")
+FUZZ_BIN = os.path.join(NATIVE_DIR, "ligsched_tsan_fuzz")
+
+
+def skip(why: str) -> int:
+    print(f"NATIVE-TSAN SKIPPED: {why}", flush=True)
+    return 0
+
+
+def _tsan_runtime_available(cxx: str) -> bool:
+    """Probe-compile a trivial program with -fsanitize=thread: some hosts
+    ship g++ but not libtsan, and that must be a loud skip, not a
+    confusing build error."""
+    with tempfile.TemporaryDirectory() as tmp:
+        src = os.path.join(tmp, "probe.cc")
+        out = os.path.join(tmp, "probe")
+        with open(src, "w") as fh:
+            fh.write("int main() { return 0; }\n")
+        try:
+            rc = subprocess.run(
+                [cxx, "-fsanitize=thread", "-pthread", src, "-o", out],
+                capture_output=True, text=True, timeout=60)
+        except (OSError, subprocess.SubprocessError):
+            return False
+        return rc.returncode == 0
+
+
+def main() -> int:
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None or shutil.which("make") is None:
+        return skip(f"no C++ toolchain ({cxx}/make not found) — the "
+                    f"thread-sanitized scheduler build cannot run on "
+                    f"this host")
+    if not _tsan_runtime_available(cxx):
+        return skip("libtsan not available (probe compile with "
+                    "-fsanitize=thread failed)")
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "tsan"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        print(build.stdout + build.stderr)
+        print("NATIVE-TSAN FAIL: thread-sanitized build failed")
+        return 1
+    env = dict(os.environ,
+               TSAN_OPTIONS="halt_on_error=1:second_deadlock_stack=1")
+    print("[1/1] threaded pick/update fuzz under TSan", flush=True)
+    fuzz = subprocess.run([FUZZ_BIN], env=env, capture_output=True,
+                          text=True, timeout=600)
+    print(fuzz.stdout, end="")
+    if fuzz.returncode != 0:
+        print(fuzz.stderr)
+        print("NATIVE-TSAN FAIL: threaded fuzz reported errors")
+        return 1
+    print("NATIVE-TSAN PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
