@@ -755,7 +755,187 @@ def run_witness_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
-def run_profiler_microbench(emit_profile: bool = False) -> dict:
+def run_decode_lever_microbench(emit_lanes: bool = False) -> dict:
+    """Decode fast-path lever family (CPU-deterministic; ROADMAP item 2).
+
+    Three A/Bs over one micro model (so per-dispatch host overhead, the
+    thing multi-step fusion amortizes, is a visible share of the wall):
+
+    - **adaptive multi-step dispatch**: decode tok/s at the seed settings
+      (steps=1, host stops) vs the fast path (``adaptive_steps=8`` +
+      device-side stops).  ``decode_adaptive_speedup`` is the PR's pinned
+      >= 2x acceptance bar, gated absolutely by tools/bench_check.py.
+    - **device-side stop strings**: wall with stop sequences riding the
+      device automaton vs the host oracle (stops present, never matching)
+      — bounds the automaton's overhead (``device_stops_ratio``).
+    - **concurrent chunk-stream lanes**: a long prompt ahead of a shorter
+      long prompt plus short decode traffic, 1 lane vs 2: the second
+      prompt's TTFT no longer serializes behind the first
+      (``stream_second_ttft_ratio``), with the lane-occupancy histogram
+      (``emit_lanes=True``) as the committed evidence artifact.
+
+    MIN over interleaved rounds per side, the suite convention.
+    """
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request, SamplingParams,
+    )
+
+    # Micro model: small enough that the per-dispatch host tax dominates a
+    # single step — the regime every remote-TPU tunnel lives in.
+    cfg = dataclasses.replace(
+        LLAMA3_8B, name="lever-cpu", vocab_size=128, d_model=64,
+        n_layers=1, n_heads=2, n_kv_heads=1, d_ff=128, head_dim=32,
+        max_seq_len=512,
+        # XLA paths: the Pallas kernels run interpreted off-TPU and would
+        # time the interpreter, not the engine.
+        use_flash_attention=False, use_pallas_decode=False,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    base = dict(decode_slots=4, max_seq_len=512, prefill_buckets=(16,))
+    rng = np.random.RandomState(0)
+
+    def engine(**kw):
+        e = Engine(cfg, params, EngineConfig(**base, **kw), eos_id=None,
+                   dtype=jnp.float32)
+        e.start()
+        return e
+
+    def reqs(n, prompt_len, max_new, stops=()):
+        return [
+            Request(prompt_tokens=list(rng.randint(1, 120, size=prompt_len)),
+                    max_new_tokens=max_new,
+                    sampling=SamplingParams(temperature=0.0),
+                    stop_sequences=tuple(tuple(s) for s in stops))
+            for _ in range(n)
+        ]
+
+    def decode_wall(e, stops=()) -> tuple[float, int]:
+        rs = reqs(4, 16, 64, stops=stops)
+        t0 = time.perf_counter()
+        for r in rs:
+            e.submit(r)
+        for r in rs:
+            if not r.done.wait(300):
+                raise RuntimeError("decode-lever request timed out")
+        wall = time.perf_counter() - t0
+        return wall, sum(len(r.output_tokens) for r in rs)
+
+    out: dict = {}
+    seed_e = engine(decode_steps_per_sync=1, device_stops=False)
+    fast_e = engine(adaptive_steps=8, device_stops=True)
+    try:
+        decode_wall(seed_e), decode_wall(fast_e)  # warmup/compile pair
+        seed_best = fast_best = float("inf")
+        toks = 0
+        for _ in range(3):
+            w, toks = decode_wall(seed_e)
+            seed_best = min(seed_best, w)
+            w, _ = decode_wall(fast_e)
+            fast_best = min(fast_best, w)
+        out["decode_step1_tok_s"] = round(toks / seed_best, 1)
+        out["decode_adaptive_tok_s"] = round(toks / fast_best, 1)
+        out["decode_adaptive_speedup"] = round(seed_best / fast_best, 4)
+
+        # Device automaton overhead: stops present, never matching (token
+        # 127 is excluded from the random prompts and unlikely greedy; a
+        # match would only shorten both sides identically anyway).
+        stops = [(127, 126, 125), (124, 123)]
+        host_e = engine(adaptive_steps=8, device_stops=False)
+        try:
+            decode_wall(fast_e, stops), decode_wall(host_e, stops)
+            on_best = off_best = float("inf")
+            for _ in range(3):
+                off_best = min(off_best, decode_wall(host_e, stops)[0])
+                on_best = min(on_best, decode_wall(fast_e, stops)[0])
+            out["device_stops_on_s"] = round(on_best, 4)
+            out["device_stops_off_s"] = round(off_best, 4)
+            out["device_stops_ratio"] = round(on_best / off_best, 4)
+        finally:
+            host_e.stop()
+    finally:
+        seed_e.stop()
+        fast_e.stop()
+
+    # -- chunk-stream lanes: head-of-line A/B ------------------------------
+    long_a = list(rng.randint(1, 120, size=160))   # 10 chunks of 16
+    long_b = list(rng.randint(1, 120, size=48))    # 3 chunks: the victim
+    shorts = [list(rng.randint(1, 120, size=8)) for _ in range(2)]
+
+    def lane_run(e):
+        occupancy: dict[int, int] = {}
+        ra = Request(prompt_tokens=long_a, max_new_tokens=8,
+                     sampling=SamplingParams(temperature=0.0))
+        rb = Request(prompt_tokens=long_b, max_new_tokens=8,
+                     sampling=SamplingParams(temperature=0.0))
+        rs = [Request(prompt_tokens=p, max_new_tokens=8,
+                      sampling=SamplingParams(temperature=0.0))
+              for p in shorts]
+        t0 = time.perf_counter()
+        for r in (ra, rb, *rs):
+            e.submit(r)
+        while not all(r.done.is_set() for r in (ra, rb, *rs)):
+            n = len(e._streams)
+            occupancy[n] = occupancy.get(n, 0) + 1
+            time.sleep(0.0002)
+        wall = time.perf_counter() - t0
+        for r in (ra, rb, *rs):
+            if r.error:
+                raise RuntimeError(f"lane bench request failed: {r.error}")
+        return wall, rb.ttft_s, occupancy
+
+    # One engine per side, warmed with a throwaway pass so the chunk /
+    # decode programs compile OUTSIDE the measured window (each Engine
+    # owns fresh jit objects), then MIN TTFT over rounds.
+    one_e = engine(stream_lanes=1)
+    two_e = engine(stream_lanes=2)
+    # Occupancy accumulates across EVERY round (warmup included): the
+    # per-round samples come from a polling thread, so any single round
+    # can miss the overlap window — but the stream_lanes_max_active gate
+    # (== 2) only needs the overlap observed ONCE across the whole run.
+    occ_all_1: dict[int, int] = {}
+    occ_all_2: dict[int, int] = {}
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            dst[k] = dst.get(k, 0) + v
+    try:
+        merge(occ_all_1, lane_run(one_e)[2])  # warmup/compile pair
+        merge(occ_all_2, lane_run(two_e)[2])
+        wall_1 = ttft_b_1 = wall_2 = ttft_b_2 = float("inf")
+        for _ in range(3):
+            w, t, o = lane_run(one_e)
+            merge(occ_all_1, o)
+            if t < ttft_b_1:
+                wall_1, ttft_b_1 = w, t
+            w, t, o = lane_run(two_e)
+            merge(occ_all_2, o)
+            if t < ttft_b_2:
+                wall_2, ttft_b_2 = w, t
+    finally:
+        one_e.stop()
+        two_e.stop()
+    out["stream_serialized_wall_s"] = round(wall_1, 4)
+    out["stream_dual_wall_s"] = round(wall_2, 4)
+    out["stream_second_ttft_1lane_ms"] = round(ttft_b_1 * 1e3, 2)
+    out["stream_second_ttft_2lane_ms"] = round(ttft_b_2 * 1e3, 2)
+    out["stream_second_ttft_ratio"] = round(
+        ttft_b_1 / ttft_b_2, 4) if ttft_b_2 > 0 else 0.0
+    out["stream_lanes_max_active"] = max(occ_all_2) if occ_all_2 else 0
+    if emit_lanes:
+        out["lane_occupancy"] = {
+            "one_lane_samples": {str(k): v
+                                 for k, v in sorted(occ_all_1.items())},
+            "two_lane_samples": {str(k): v
+                                 for k, v in sorted(occ_all_2.items())},
+        }
+    return out
+
+
+def run_profiler_microbench(emit_profile: bool = False,
+                            fast_path: bool = False) -> dict:
     """Step-timeline-profiler overhead A/B (fleet-observability PR
     acceptance bar: ``step_profile_ratio`` <= 1.05 — profiling every
     dispatch costs < 5% of step-loop wall).
@@ -767,6 +947,10 @@ def run_profiler_microbench(emit_profile: bool = False) -> dict:
     engine's profiler snapshot — the deterministic run committed as
     ``PROFILE_BASELINE.json`` (the dispatch/host-sync/idle attribution
     baseline every ROADMAP item-2 lever is measured against).
+    ``fast_path=True`` runs both engines with the decode levers on
+    (adaptive fused dispatch + device-side stops) — the post-lever
+    attribution the refreshed baseline commits, whose host-sync share
+    must sit strictly below the pre-lever baseline's.
     """
     from llm_instance_gateway_tpu.models import transformer
     from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
@@ -783,6 +967,8 @@ def run_profiler_microbench(emit_profile: bool = False) -> dict:
                                      dtype=jnp.float32)
     ecfg = dict(decode_slots=4, max_seq_len=256,
                 prefill_buckets=(32, 64, 128))
+    if fast_path:
+        ecfg["adaptive_steps"] = 8
     rng = np.random.RandomState(0)
 
     def engine(**kw):

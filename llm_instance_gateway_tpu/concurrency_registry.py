@@ -398,9 +398,18 @@ CLASSES = (
             SharedField("_pending", LOCK_GUARDED,
                         writers=("_admit_and_insert", "_collect_followers",
                                  "_start_stream", "stop")),
-            SharedField("_stream", LOCK_GUARDED,
-                        writers=("_abort_stream", "_start_stream",
-                                 "_stream_step", "stop")),
+            SharedField("_streams", LOCK_GUARDED,
+                        note="chunk-stream lane list (engine-thread "
+                             "append/remove in place; the scrape thread "
+                             "iterates a list() copy like decode_wait)"),
+            SharedField("_stream_rr", OWNER_PRIVATE,
+                        writers=("_stream_step",),
+                        note="fair-interleave round-robin cursor"),
+            SharedField("_stops_active", OWNER_PRIVATE,
+                        writers=("_clear_slot", "_program_stop_lanes"),
+                        note="rows with programmed device stop lanes; "
+                             "gates the history rebuild and excludes "
+                             "speculative dispatch"),
             SharedField("decode_wait", LOCK_GUARDED,
                         writers=("_sweep_decode_wait",)),
             SharedField("_parked_kv_tokens", LOCK_GUARDED,
@@ -432,6 +441,11 @@ CLASSES = (
                         writers=("_activate_slot_pipelined",
                                  "_dispatch_block", "_dispatch_spec_block",
                                  "_loop_pipelined")),
+            SharedField("_dev_stop_hist", OWNER_PRIVATE,
+                        writers=("_activate_slot_pipelined",
+                                 "_dispatch_block", "_loop_pipelined"),
+                        note="stop-automaton history carry (device-"
+                             "resident twin of _slot_stop_hist)"),
             SharedField("_dev_has_extra", OWNER_PRIVATE,
                         writers=("_activate_slot_pipelined",
                                  "_dispatch_spec_block", "_draft_admit",

@@ -256,6 +256,18 @@ SERVER_FAMILIES = (
     Family("tpu:spec_tokens_per_cycle", "gauge", (),
            "Accepted tokens per speculative cycle (draft-quality signal).",
            SERVER_SURFACE),
+    Family("tpu:stream_lanes", "gauge", (),
+           "Configured concurrent chunk-stream lanes (long prompts "
+           "streaming into reserved cache lanes at once; "
+           "EngineConfig.stream_lanes).", SERVER_SURFACE),
+    Family("tpu:stream_lanes_active", "gauge", (),
+           "Chunk-stream lanes currently mid-prompt; at the configured "
+           "lane count a further long prompt head-of-line waits.",
+           SERVER_SURFACE),
+    Family("tpu:dispatch_steps", "histogram", (),
+           "Fused decode steps per dispatch — the adaptive multi-step "
+           "planner's decision record (buckets land on its power-of-two "
+           "choices; EngineConfig.adaptive_steps).", SERVER_SURFACE),
     Family("tpu:prefill_seconds", "histogram", ("model", "role"),
            "Prefill compute latency.", SERVER_SURFACE),
     Family("tpu:handoff_seconds", "histogram", ("model", "role"),

@@ -132,12 +132,15 @@ def decode_step_paged(
     positions: jax.Array,    # [B] int32
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,
+    active: jax.Array | None = None,  # [B] bool — rows allowed to WRITE
 ):
     """One decode step over the paged pool.
 
     Semantics identical to ``transformer.decode_step`` (parity-tested); the
     only differences are the scatter address (table-mapped block/offset) and
-    the gather-then-attend read.
+    the gather-then-attend read.  With ``active`` given, inactive rows
+    write the trash block instead of their table-mapped cell — a reserved
+    row's table may already hold a mid-stream chunk prompt's real blocks.
     """
     b = tokens.shape[0]
     if slot_ids is None:
@@ -156,8 +159,11 @@ def decode_step_paged(
     lengths = positions + 1
     batch_idx = jnp.arange(b)
     # Physical write address of each row's current position.  Rows whose
-    # table entry is unallocated write the trash block.
+    # table entry is unallocated — and rows the engine marked inactive —
+    # write the trash block.
     phys_block = tables[batch_idx, positions // block]  # [B]
+    if active is not None:
+        phys_block = jnp.where(active, phys_block, TRASH_BLOCK)
     offset = positions % block
     quant = "k_scale" in cache
 
@@ -215,13 +221,15 @@ def extend_step_paged(
     positions: jax.Array,    # [B, C] int32 — absolute positions of each
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,
+    active: jax.Array | None = None,  # [B] bool — rows allowed to WRITE
 ):
     """Multi-token cached decode over the paged pool — the speculative
     verify/catch-up primitive (parity contract: ``transformer.extend_step``,
     tested token-for-token).  Each row's C tokens scatter through its block
     table and attend to the row's gathered view, causal within the new
-    tokens and over the lane's history.  Positions past the table span
-    route to the trash block (same rule as ``prefill_with_cache_paged``).
+    tokens and over the lane's history.  Positions past the table span —
+    and every position of a row the engine marked inactive — route to the
+    trash block (same rule as ``prefill_with_cache_paged``).
     Returns (logits [B, C, V] f32, new cache).
     """
     b, c = tokens.shape
@@ -234,9 +242,11 @@ def extend_step_paged(
     s_max = max_blocks * block
     batch_idx = jnp.arange(b)[:, None]  # [B, 1] broadcast over C
 
-    in_bounds = positions < s_max
+    writable = positions < s_max
+    if active is not None:
+        writable = writable & active[:, None]
     phys_block = jnp.where(
-        in_bounds,
+        writable,
         tables[batch_idx, jnp.clip(positions // block, 0, max_blocks - 1)],
         TRASH_BLOCK,
     )  # [B, C]

@@ -429,11 +429,17 @@ def decode_step(
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,
     attention_fn=None,       # override: (q, k_cache, v_cache, lengths) -> attn
+    active: jax.Array | None = None,  # [B] bool — rows allowed to WRITE
 ):
     """One decode step for every slot.  Returns (logits [B,V] f32, new cache).
 
-    Inactive slots simply decode garbage into their own lane (masked out by
-    the engine); lockstep batching keeps the step shape-static.
+    Inactive slots decode garbage LOGITS (masked out by the engine) but —
+    with ``active`` given — write NOTHING: their scatter index is pushed
+    out of bounds, where XLA drops the update.  Without the mask a frozen
+    or empty row keeps stomping its lane at a stale position, which is
+    fatal once a lane can be mid-chunk-stream for a DIFFERENT request
+    while decode dispatches run (the concurrent-lane engine); lockstep
+    batching keeps the step shape-static either way.
 
     ``attention_fn`` swaps the cached-attention implementation — used by
     ``ops.sharded_attention`` to run the Pallas decode kernel shard-local
@@ -452,6 +458,11 @@ def decode_step(
 
     lengths = positions + 1
     batch_idx = jnp.arange(b)
+    s_max = cache["k"].shape[2]
+    # Scatter address only — rope/masks keep the true positions.  s_max is
+    # out of bounds, so inactive rows' updates are dropped whole.
+    write_pos = (positions if active is None
+                 else jnp.where(active, positions, s_max))
     quant = "k_scale" in cache
 
     def layer_fn(h, xs):
@@ -471,10 +482,10 @@ def decode_step(
         if quant:
             kq, ks = _kv_quantize(k)
             vq, vs = _kv_quantize(v)
-            k_cache = k_cache.at[batch_idx, positions].set(kq)
-            v_cache = v_cache.at[batch_idx, positions].set(vq)
-            k_scale = k_scale.at[batch_idx, positions].set(ks)
-            v_scale = v_scale.at[batch_idx, positions].set(vs)
+            k_cache = k_cache.at[batch_idx, write_pos].set(kq)
+            v_cache = v_cache.at[batch_idx, write_pos].set(vq)
+            k_scale = k_scale.at[batch_idx, write_pos].set(ks)
+            v_scale = v_scale.at[batch_idx, write_pos].set(vs)
             if getattr(attention_fn, "quant_aware", False):
                 # Quant-aware override (sharded_attention.make_cached_
                 # decode_quant): raw int8 + scales go in; each shard's
@@ -507,8 +518,8 @@ def decode_step(
                     _kv_dequantize(v_cache, v_scale, h.dtype), lengths)
             carry_out = (k_cache, v_cache, k_scale, v_scale)
         else:
-            k_cache = k_cache.at[batch_idx, positions].set(k)
-            v_cache = v_cache.at[batch_idx, positions].set(v)
+            k_cache = k_cache.at[batch_idx, write_pos].set(k)
+            v_cache = v_cache.at[batch_idx, write_pos].set(v)
             if attention_fn is not None:
                 attn = attention_fn(q, k_cache, v_cache, lengths)
             elif cfg.use_pallas_decode:
@@ -546,6 +557,7 @@ def extend_step(
     positions: jax.Array,    # [B, C] int32 — absolute positions of each
     lora_bufs: Params | None = None,
     slot_ids: jax.Array | None = None,
+    active: jax.Array | None = None,  # [B] bool — rows allowed to WRITE
 ):
     """Multi-token cached decode: process C new tokens per slot in ONE
     forward (the speculative-decoding verify/catch-up primitive — decode is
@@ -554,9 +566,11 @@ def extend_step(
     Each row's tokens scatter into its own cache lane at ``positions`` and
     attend to every cached position <= their own — causal within the new
     tokens and over the lane's history.  Rows are independent; garbage rows
-    (frozen slots) decode garbage into their own lane exactly like
-    ``decode_step``.  Returns (logits [B, C, V] f32, new cache) — logits[i]
-    is the next-token distribution AFTER tokens[:, i].
+    (frozen slots) decode garbage logits exactly like ``decode_step`` —
+    and, with ``active`` given, write nothing (out-of-bounds scatter
+    address, update dropped): a frozen row's lane may already belong to a
+    mid-stream chunk prompt.  Returns (logits [B, C, V] f32, new cache) —
+    logits[i] is the next-token distribution AFTER tokens[:, i].
     """
     b, c = tokens.shape
     hd = cfg.resolved_head_dim
@@ -572,6 +586,8 @@ def extend_step(
         per_layer_lora, _ = lora_lib.stack_for_scan(lora_bufs)
 
     batch_idx = jnp.arange(b)[:, None]  # [B, 1] broadcast over C
+    write_pos = (positions if active is None
+                 else jnp.where(active[:, None], positions, s_max))
     quant = "k_scale" in cache
 
     def layer_fn(h, xs):
@@ -593,16 +609,16 @@ def extend_step(
         if quant:
             kq, ks = _kv_quantize(k)
             vq, vs = _kv_quantize(v)
-            k_cache = k_cache.at[batch_idx, positions].set(kq)
-            v_cache = v_cache.at[batch_idx, positions].set(vq)
-            k_scale = k_scale.at[batch_idx, positions].set(ks)
-            v_scale = v_scale.at[batch_idx, positions].set(vs)
+            k_cache = k_cache.at[batch_idx, write_pos].set(kq)
+            v_cache = v_cache.at[batch_idx, write_pos].set(vq)
+            k_scale = k_scale.at[batch_idx, write_pos].set(ks)
+            v_scale = v_scale.at[batch_idx, write_pos].set(vs)
             k_read = _kv_dequantize(k_cache, k_scale, h.dtype)
             v_read = _kv_dequantize(v_cache, v_scale, h.dtype)
             carry_out = (k_cache, v_cache, k_scale, v_scale)
         else:
-            k_cache = k_cache.at[batch_idx, positions].set(k)
-            v_cache = v_cache.at[batch_idx, positions].set(v)
+            k_cache = k_cache.at[batch_idx, write_pos].set(k)
+            v_cache = v_cache.at[batch_idx, write_pos].set(v)
             k_read, v_read = k_cache, v_cache
             carry_out = (k_cache, v_cache)
         # [B,C,K,G,hd] x [B,S,K,hd] -> [B,K,G,C,S]; mask j <= position_i.

@@ -249,8 +249,35 @@ class ModelServer:
                 logit_bias=logit_bias,
             ),
             adapter=adapter,
+            stop_sequences=self._encode_stops(body),
             logprobs=logprobs,
         )
+
+    def _encode_stops(self, body: dict) -> tuple[tuple[int, ...], ...]:
+        """Tokenized OpenAI ``stop`` strings for the engine's device-side
+        suffix automata — an EARLY-FREEZE accelerator, not the oracle.
+        The text-level scan (_wait_with_stops / _truncate_at_stop) stays
+        authoritative: an automaton hit only ends generation at a token
+        tail whose decode CONTAINS the stop string (encode/decode
+        round-trip), which the text truncation then cuts identically,
+        while a stop spelled by a different token split simply misses the
+        automaton and is caught by the text scan as before.  So this can
+        only stop generation earlier, never change the response."""
+        stop = body.get("stop")
+        stops = ([stop] if isinstance(stop, str)
+                 else [s for s in stop if isinstance(s, str)]
+                 if isinstance(stop, list) else [])
+        out = []
+        for s in stops:
+            if not s:
+                continue
+            try:
+                ids = self.tokenizer.encode(s)
+                if ids and self.tokenizer.decode(list(ids)) == s:
+                    out.append(tuple(int(t) for t in ids))
+            except Exception:  # pragma: no cover - defensive: odd tokenizer
+                continue
+        return tuple(out)
 
     @staticmethod
     def _parse_choice_params(body: dict) -> tuple[int, int, int | None, list[str]]:
@@ -569,6 +596,11 @@ class ModelServer:
         429 (the gateway's backpressure contract), and the done flag is read
         BEFORE the token count so the final re-diff can't drop a tail.
         """
+        # Mark the request as SSE-consumed BEFORE submission: the engine's
+        # adaptive dispatch planner caps fused steps for streaming rows
+        # (EngineConfig.adaptive_stream_cap) so a live stream keeps
+        # per-token cadence instead of n_steps-sized bursts.
+        req.streaming = True
         if submit:
             try:
                 self.engine.submit(req)
@@ -642,17 +674,41 @@ class ModelServer:
             done = req.done.is_set()  # read BEFORE the token count
             n = len(req.output_tokens)
             if n > consumed:
-                text = self.tokenizer.decode(req.output_tokens[consumed:])
-                if text.endswith("�") and not done:
-                    pass  # incomplete sequence: re-decode this suffix next wake
-                elif text:
-                    consumed = n
-                    await emit({
-                        "id": f"cmpl-{req.request_id}",
-                        "object": object_name,
-                        "model": model,
-                        "choices": [make_delta(text, None)],
-                    })
+                # PER-TOKEN chunking: the engine publishes each fused-block
+                # token individually (per-step emission from the trim
+                # walk), and each token's text DELTA becomes its own SSE
+                # chunk — a K-step fused dispatch no longer arrives as one
+                # concatenated burst.  Deltas come from prefix-diffing
+                # growing decodes of the unconsumed window (the
+                # concatenation-safe pattern the non-stream logprob walk
+                # uses): per-token decode() is NOT concatenative for
+                # SentencePiece-style tokenizers (leading-space stripping),
+                # so decoding each token span independently would eat
+                # inter-word spaces.  A prefix still ending in U+FFFD is
+                # held back (likely a multi-byte sequence the next token
+                # completes); the window is tiny (per-step wakes), so the
+                # quadratic prefix decode stays O(burst) per dispatch.
+                window = req.output_tokens[consumed:n]
+                prev = ""
+                clean = 0  # tokens of the window emitted cleanly
+                for i in range(1, len(window) + 1):
+                    text = self.tokenizer.decode(window[:i])
+                    if text.endswith("�"):
+                        if i < len(window):
+                            continue  # next token may complete the bytes
+                        if not done:
+                            break     # hold the incomplete tail back
+                    delta = text[len(prev):]
+                    prev = text
+                    clean = i
+                    if delta:
+                        await emit({
+                            "id": f"cmpl-{req.request_id}",
+                            "object": object_name,
+                            "model": model,
+                            "choices": [make_delta(delta, None)],
+                        })
+                consumed += clean
             if done:
                 # Final re-diff: anything appended since the last emit (or a
                 # held-back tail) rides the final chunk.
@@ -1425,7 +1481,24 @@ def main(argv=None) -> None:
              "active slot's TPOT) or run ONE ring-attention program when "
              "--mesh has a sequence axis")
     parser.add_argument("--decode-steps", type=int, default=8,
-                        help="fused decode steps per host sync (K)")
+                        help="fused decode steps per host sync (K); "
+                             "superseded when --adaptive-steps is set")
+    parser.add_argument("--adaptive-steps", type=int, default=8,
+                        metavar="CEILING",
+                        help="adaptive multi-step dispatch: a per-dispatch "
+                             "planner picks the fused step count (power of "
+                             "two <= CEILING) from remaining budgets, "
+                             "pending admissions/chunk streams, and SSE "
+                             "cadence; 0 = static --decode-steps")
+    parser.add_argument("--no-device-stops", action="store_true",
+                        help="disable the device-side stop-string automata "
+                             "(rows then stop via the host oracle only — "
+                             "the A/B for the decode-lever bench)")
+    parser.add_argument("--stream-lanes", type=int, default=1,
+                        help="concurrent chunk-stream lanes: how many "
+                             "long prompts may stream into reserved cache "
+                             "lanes at once (fair round-robin); 1 = a "
+                             "second long prompt head-of-line waits")
     parser.add_argument("--prefill-batch", type=int, default=1,
                         help="group up to P same-bucket queued prompts into "
                              "one prefill program (contiguous-lane cache)")
@@ -1608,6 +1681,9 @@ def main(argv=None) -> None:
                       if b <= args.max_seq_len)
                 or (min(args.max_seq_len, 1024),)),
             decode_steps_per_sync=args.decode_steps,
+            adaptive_steps=args.adaptive_steps,
+            device_stops=not args.no_device_stops,
+            stream_lanes=args.stream_lanes,
             pipeline_decode=args.pipeline_decode,
             prefill_batch=args.prefill_batch,
             paged_kv_block=args.paged_kv_block,

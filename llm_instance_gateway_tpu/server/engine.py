@@ -43,7 +43,14 @@ from llm_instance_gateway_tpu.lockwitness import witness_lock
 from llm_instance_gateway_tpu.models import paged as paged_lib
 from llm_instance_gateway_tpu.models import transformer
 from llm_instance_gateway_tpu.models.configs import ModelConfig
-from llm_instance_gateway_tpu.server.sampling import sample
+from llm_instance_gateway_tpu.server.sampling import (
+    STOP_LEN,
+    STOP_SEQS,
+    encode_stop_rows,
+    sample,
+    stop_hist_update,
+    stop_suffix_hit,
+)
 from llm_instance_gateway_tpu.server.profiler import StepProfiler
 from llm_instance_gateway_tpu.server.usage import UsageTracker, owner_key
 from llm_instance_gateway_tpu.tracing import LATENCY_BUCKETS, Histogram
@@ -56,6 +63,10 @@ logger = logging.getLogger(__name__)
 # values only for requests that asked.
 LOGPROB_TOPK = 5
 MAX_LOGIT_BIAS = 32  # per-request logit_bias entries (static lanes)
+
+# Dispatch-size histogram edges for tpu:dispatch_steps: the planner only
+# emits powers of two, so the buckets land exactly on its choices.
+STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
 class EngineDraining(RuntimeError):
@@ -95,6 +106,36 @@ class EngineConfig:
     # at EOS/budget and emit invalid steps), so large K costs only K-step
     # admission latency, not wasted tokens.
     decode_steps_per_sync: int = 1
+    # Adaptive multi-step dispatch (ROADMAP item 2): > 0 makes this the
+    # CEILING of a per-dispatch planner that picks n_steps from the live
+    # batch instead of the static decode_steps_per_sync — the minimum
+    # remaining token budget across active rows (never fuse past the point
+    # every row is frozen), pending admissions / parked inserts / chunk
+    # streams (a waiting prefill or stream chunk must not stall behind a
+    # fused block: those dispatches drop to 1 step), and SSE cadence
+    # (dispatches serving a streaming consumer cap at
+    # ``adaptive_stream_cap`` so fusion can't regress perceived TPOT).
+    # Choices quantize to powers of two, so the compiled-variant set stays
+    # log2(ceiling) deep.  0 = off (static decode_steps_per_sync).
+    adaptive_steps: int = 0
+    # Fused-step cap while any active row streams to an SSE consumer (the
+    # planner input that keeps adaptive fusion from batching a stream's
+    # tokens into bursts).  1 = per-token cadence (default).
+    adaptive_stream_cap: int = 1
+    # Device-side stop sequences: compile each row's stop strings (token
+    # suffixes) into per-row automata evaluated INSIDE the fused decode
+    # program, so a row whose stop hits mid-block freezes there with zero
+    # host round-trips (the host oracle still trims once per dispatch —
+    # token parity is structural, not probabilistic).  False = host-only
+    # stop checks (the A/B oracle for the bench and the parity tests).
+    device_stops: bool = True
+    # Concurrent chunk-stream lanes: how many long prompts may stream
+    # chunk-by-chunk into reserved cache lanes AT ONCE (fair round-robin,
+    # one chunk per engine cycle across lanes).  1 = the old single-lane
+    # behavior where a second long prompt head-of-line blocks behind the
+    # first.  Lanes beyond the first admit only with KV headroom left for
+    # active decode growth (paged pools).
+    stream_lanes: int = 1
     # Pipelined decode: dispatch block N+1 from the device-resident token/
     # position/budget carry BEFORE reading block N's tokens, overlapping the
     # host readback with compute.  Slot FREEING still lags one block (the
@@ -250,6 +291,18 @@ class Request:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     adapter: str | None = None
     stop_token_ids: tuple[int, ...] = ()
+    # Multi-token stop sequences (tokenized stop strings): generation ends
+    # the moment the output's token tail equals one of these; the matching
+    # tokens are emitted and finish_reason is "stop" (same inclusion
+    # semantics as stop_token_ids).  Evaluated device-side inside the
+    # fused decode block when they fit the static automaton lanes
+    # (sampling.STOP_SEQS x STOP_LEN) and ``EngineConfig.device_stops``;
+    # the host oracle in the result walk is authoritative either way.
+    stop_sequences: tuple[tuple[int, ...], ...] = ()
+    # Set by the transport for SSE responses: the adaptive dispatch
+    # planner caps fusion at ``adaptive_stream_cap`` while this request is
+    # active, so a live stream keeps per-token cadence.
+    streaming: bool = False
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
     # Record per-token logprobs: None = off; 0 = sampled token only (e.g.
     # best_of ranking); 1..LOGPROB_TOPK = also that many top alternatives.
@@ -592,6 +645,19 @@ class Engine:
         # Per-row token budget for device-side stop (0 = frozen row).
         self._slot_remaining = np.zeros((b,), np.int32)
         self._eos_for_device = jnp.int32(-1 if eos_id is None else eos_id)
+        # Device stop-string automata (server/sampling.py): per-row stop
+        # suffix lanes (right-aligned, -1 padded) programmed at slot
+        # registration, plus the host history scratch the sync loop
+        # rebuilds per dispatch (the pipelined loop keeps its history
+        # device-resident in the dispatch carry instead).
+        self._slot_stop_ids = np.full((b, STOP_SEQS, STOP_LEN), -1, np.int32)
+        self._slot_stop_lens = np.zeros((b, STOP_SEQS), np.int32)
+        self._slot_stop_hist = np.full((b, STOP_LEN), -1, np.int32)
+        # Count of rows with programmed device stop lanes: gates the
+        # per-dispatch history rebuild AND excludes speculative dispatch
+        # (the spec block does not evaluate the automaton, so its history
+        # carry would go stale mid-generation).
+        self._stops_active = 0
 
         self.prefill_queue: queue_mod.Queue[Request] = queue_mod.Queue(
             maxsize=self.cfg.max_queue
@@ -604,9 +670,13 @@ class Engine:
         # mutated; read by the scrape thread — int updates are atomic).
         self._parked_kv_tokens = 0
         self._pending: Request | None = None
-        # One long prompt at a time streams chunk-by-chunk into a reserved
-        # lane, interleaved with decode blocks (_stream_step).
-        self._stream: _ChunkStream | None = None
+        # Long prompts stream chunk-by-chunk into RESERVED cache lanes,
+        # interleaved with decode blocks (_stream_step): up to
+        # ``stream_lanes`` at once, advanced fair round-robin (one chunk
+        # per engine cycle across lanes) so a second 32k prompt no longer
+        # head-of-line blocks behind the first.
+        self._streams: list[_ChunkStream] = []
+        self._stream_rr = 0  # round-robin cursor over self._streams
         self._reserved_slots: set[int] = set()
         self._work = threading.Condition()
         self._running = False
@@ -639,6 +709,11 @@ class Engine:
             "handoff": Histogram(LATENCY_BUCKETS),
             "decode_step": Histogram(LATENCY_BUCKETS),
         }
+        # Fused steps per PLAIN decode dispatch — the adaptive planner's
+        # decision record, rendered as tpu:dispatch_steps (spec blocks'
+        # token-row counts are not planner choices and stay out).
+        # Mutated under self._lock like phase_hist.
+        self.dispatch_steps_hist = Histogram(STEP_BUCKETS)
         # Capacity attribution (server/usage.py): who is consuming this
         # replica.  Own lock; charged from the engine thread, snapshotted
         # by the scrape thread.
@@ -804,6 +879,7 @@ class Engine:
         model_cfg, step_fn, params, lora_bufs, cache, tokens, positions,
         slot_ids, temp, topk, topp, key, remaining, eos_id, seeds,
         presence, frequency, counts, bias_ids, bias_vals,
+        stop_ids, stop_lens, stop_hist,
         n_steps: int, penalized: bool = False,
     ):
         """``n_steps`` fused decode+sample steps with DEVICE-SIDE stop.
@@ -815,9 +891,19 @@ class Engine:
         ``valid=False`` steps, so a row that stops mid-block wastes no host
         tokens and large K blocks stay cheap at sequence tails.
 
-        Returns (toks [K,B], valid [K,B], next_tokens, next_positions,
-        next_remaining, cache).  Positions are clamped below max_seq_len so
-        capped slots never write out of bounds.
+        Stop-STRING automata extend the same freeze mechanism: each row
+        carries its last ``STOP_LEN`` emitted tokens (``stop_hist``; the
+        prefill's first token included, -1 = not yet generated) and a table
+        of right-aligned stop suffixes (``stop_ids``/``stop_lens``,
+        server/sampling.py).  A step whose sampled token completes a suffix
+        emits it as a valid token and zeroes the budget — the row freezes
+        mid-block with zero host round-trips, and the host result walk
+        merely confirms the match once per dispatch.
+
+        Returns (toks [K,B], valid [K,B], logprob triplet, next_tokens,
+        next_positions, next_remaining, next_hist, counts, cache).
+        Positions are clamped below max_seq_len so capped slots never write
+        out of bounds.
         """
         if "tables" in cache:  # paged: logical length = table span * block
             max_len = cache["tables"].shape[1] * cache["k"].shape[2]
@@ -827,12 +913,15 @@ class Engine:
         c0 = tokens.shape[0]
 
         def one_step(carry, step_key):
-            cache, tokens, positions, remaining, counts = carry
+            cache, tokens, positions, remaining, hist, counts = carry
             active = remaining > 0
             safe_pos = jnp.minimum(positions, max_len - 1)
+            # active gates the KV WRITE too: frozen/empty rows scatter
+            # nothing (trash block / OOB-dropped) — their lane may already
+            # belong to a mid-stream chunk prompt on a reserved slot.
             logits, cache = step_fn(
                 model_cfg, params, cache, tokens, safe_pos,
-                lora_bufs=lora_bufs, slot_ids=slot_ids,
+                lora_bufs=lora_bufs, slot_ids=slot_ids, active=active,
             )
             if penalized:
                 # OpenAI penalties over generated tokens: subtract BEFORE
@@ -850,26 +939,35 @@ class Engine:
             valid = active
             # EOS emitted now is a valid token but deactivates the row.
             hit_eos = valid & (sampled == eos_id)
+            # Stop-string automaton: the emitted token enters the history
+            # ring; a completed suffix deactivates the row exactly like
+            # EOS (the stop's tail tokens are emitted, later steps are
+            # invalid).  Frozen rows keep their history untouched.
+            hist = stop_hist_update(hist, sampled, valid)
+            hit_stop = valid & stop_suffix_hit(hist, stop_ids, stop_lens)
             remaining = jnp.where(valid, remaining - 1, remaining)
-            remaining = jnp.where(hit_eos, 0, remaining)
+            remaining = jnp.where(hit_eos | hit_stop, 0, remaining)
             next_tokens = jnp.where(active, sampled, tokens)
             next_positions = positions + active.astype(positions.dtype)
             if penalized:
                 counts = counts.at[jnp.arange(c0), sampled].add(
                     valid.astype(jnp.int32))
-            return (cache, next_tokens, next_positions, remaining, counts), (
-                sampled, valid, lp, top_v, top_i)
+            return (cache, next_tokens, next_positions, remaining, hist,
+                    counts), (sampled, valid, lp, top_v, top_i)
 
         keys = jax.random.split(key, n_steps)
         carry, (toks, valid, lps, top_v, top_i) = (
             jax.lax.scan(one_step,
-                         (cache, tokens, positions, remaining, counts), keys)
+                         (cache, tokens, positions, remaining, stop_hist,
+                          counts), keys)
         )
-        cache, next_tokens, next_positions, next_remaining, counts = carry
-        # The token/position/budget carries live on device for pipelined
-        # dispatch of the following block (no host round-trip needed).
+        (cache, next_tokens, next_positions, next_remaining, next_hist,
+         counts) = carry
+        # The token/position/budget/history carries live on device for
+        # pipelined dispatch of the following block (no host round-trip).
         return (toks, valid, lps, top_v, top_i,
-                next_tokens, next_positions, next_remaining, counts, cache)
+                next_tokens, next_positions, next_remaining, next_hist,
+                counts, cache)
 
     # ------------------------------------------------------------------
     # public API
@@ -914,14 +1012,80 @@ class Engine:
             w = self.decode_wait.popleft()
             self._parked_kv_tokens -= w.k.shape[2]
             stragglers.append(w.request)
-        if self._stream is not None:
-            stragglers.append(self._stream.request)
-            self._stream = None
+        while self._streams:
+            stragglers.append(self._streams.pop().request)
         stragglers += [s.request for s in self.slots if s is not None]
         for req in stragglers:
             if not req.done.is_set():
                 req.error = req.error or "engine stopped"
                 self._finish(req, "error")
+
+    def _plan_steps(self) -> int:
+        """Fused decode steps for the next dispatch (the adaptive planner).
+
+        Static mode (``adaptive_steps`` <= 0): the decode_steps_per_sync
+        CLI value, unchanged.  Adaptive mode picks from the live batch:
+
+        - **admission pressure** — queued prompts, a parked insert, or an
+          in-flight chunk stream all run BETWEEN dispatches, so a fused
+          block would stall their TTFT/chunk cadence: those dispatches
+          drop to 1 step;
+        - **remaining budget** — never fuse past the minimum remaining
+          token budget across active rows (the block would spend its tail
+          decoding frozen rows); pipelined mode subtracts the in-flight
+          block's steps since the host record lags it;
+        - **SSE cadence** — any active streaming consumer caps fusion at
+          ``adaptive_stream_cap`` so perceived TPOT cannot regress.
+
+        The choice quantizes DOWN to a power of two: ``n_steps`` is a
+        static jit argument, so this bounds the compiled-variant set to
+        log2(ceiling) programs per (penalized,) combination.
+        """
+        ceiling = self.cfg.adaptive_steps
+        if ceiling <= 0:
+            return max(1, self.cfg.decode_steps_per_sync)
+        # Parked decode_wait entries only stall on a FREE slot (their
+        # prefill already ran); a saturated pool with parked work keeps
+        # fusing — the min-remaining bound below still lands the block
+        # edge on the next budget-driven slot free.
+        if (self._streams or self._pending is not None
+                or not self.prefill_queue.empty()
+                or (self.decode_wait
+                    and self._free_slot_index() is not None)):
+            return 1
+        n = max(1, ceiling)
+        inflight = (self._prev_dispatch_steps
+                    if self.cfg.pipeline_decode else 0)
+        for s in self.slots:
+            if s is None:
+                continue
+            req = s.request
+            if req.streaming:
+                n = min(n, max(1, self.cfg.adaptive_stream_cap))
+            rem = req.max_new_tokens - len(req.output_tokens) - inflight
+            n = min(n, max(1, rem))
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        return p
+
+    def _sync_stop_hist(self) -> np.ndarray:
+        """The sync loop's per-dispatch stop-history input: each
+        stop-lane row's last STOP_LEN emitted tokens (right-aligned, -1
+        padded), rebuilt from the request's own output record — the host
+        record IS the history, so a fused block never sees a stale ring.
+        Stop-free batches skip the rebuild and pass the all--1 scratch."""
+        hist = self._slot_stop_hist
+        if not self._stops_active:
+            return hist  # all -1 by construction: nothing can match
+        hist[:] = -1
+        for i, s in enumerate(self.slots):
+            if s is None or not self._slot_stop_lens[i].any():
+                continue
+            tail = s.request.output_tokens[-STOP_LEN:]
+            if tail:
+                hist[i, STOP_LEN - len(tail):] = tail
+        return hist
 
     def _penalty_dispatch_args(self):
         """(counts, penalized) for a decode dispatch: the real buffer only
@@ -1008,6 +1172,16 @@ class Engine:
                     # device scatter and mis-bias a real token.
                     raise ValueError(
                         f"logit_bias token id {tid} is outside the "
+                        f"vocabulary [0, {self.model_cfg.vocab_size})")
+        for seq in request.stop_sequences:
+            if not seq:
+                raise ValueError("stop_sequences entries must be non-empty")
+            for tid in seq:
+                if not 0 <= int(tid) < self.model_cfg.vocab_size:
+                    # A negative id would alias the device automaton's -1
+                    # padding lane and make a short history false-match.
+                    raise ValueError(
+                        f"stop sequence token id {tid} is outside the "
                         f"vocabulary [0, {self.model_cfg.vocab_size})")
 
     def submit(self, request: Request) -> Request:
@@ -1197,9 +1371,9 @@ class Engine:
         for s in self.slots:
             if s is not None and s.request.adapter:
                 running.add(s.request.adapter)
-        stream = self._stream
-        if stream is not None and stream.request.adapter:
-            running.add(stream.request.adapter)
+        for stream in list(self._streams):
+            if stream.request.adapter:
+                running.add(stream.request.adapter)
         try:
             for w in list(self.decode_wait):
                 if w.request.adapter:
@@ -1223,7 +1397,7 @@ class Engine:
         else:
             used_tokens = sum(
                 (s.position if s is not None else 0) for s in self.slots
-            ) + (self._stream.next_start if self._stream is not None else 0)
+            ) + sum(st.next_start for st in list(self._streams))
             capacity = self.cfg.decode_slots * self.cfg.max_seq_len
         # decode_wait KV is real allocated HBM held OUTSIDE the cache/pool;
         # vLLM's counter (the semantics the 0.8 threshold was tuned against,
@@ -1237,13 +1411,14 @@ class Engine:
         with self._lock:
             tps = self.decode_tps_ema
             phase_hist = {k: h.state() for k, h in self.phase_hist.items()}
+            steps_hist = self.dispatch_steps_hist.state()
         running_adapters, waiting_adapters = self._adapter_activity()
         max_lora = self.lora.max_slots if self.lora else 0
-        # The in-flight chunk stream counts as prefilling: invisible, the
+        # In-flight chunk streams count as prefilling: invisible, the
         # gateway would route MORE traffic to the replica busiest streaming.
         prefill_depth = self.prefill_queue.qsize() + (
             1 if self._pending is not None else 0) + (
-            1 if self._stream is not None else 0) + self._admitting
+            len(self._streams)) + self._admitting
         decode_depth = len(self.decode_wait)
         return {
             "pool_role": self.cfg.role,
@@ -1270,6 +1445,12 @@ class Engine:
             # tpu:adapter_tier_transitions_total / tpu:adapter_load_*
             # plus the resident_tiers label on tpu:lora_requests_info.
             **(self._residency_keys() if self.lora else {}),
+            # Fused steps per dispatch (adaptive planner decision record)
+            # + chunk-stream lane occupancy — the decode fast-path
+            # observables (tpu:dispatch_steps / tpu:stream_lanes*).
+            "dispatch_steps_hist": steps_hist,
+            "stream_lanes": max(1, self.cfg.stream_lanes),
+            "stream_lanes_active": len(self._streams),
             # Phase-latency histogram states (server/metrics.py renders
             # these as the tpu:*_seconds histogram families).
             "phase_hist": phase_hist,
@@ -1320,6 +1501,12 @@ class Engine:
             self._spec_has_extra[i] = False
         self._slot_lora[i] = -1
         self._slot_remaining[i] = 0
+        if self._slot_stop_lens[i].any():
+            self._slot_stop_ids[i] = -1
+            self._slot_stop_lens[i] = 0
+            self._slot_stop_hist[i] = -1
+            self._stops_active = int(
+                (self._slot_stop_lens.sum(axis=1) > 0).sum())
         self._slot_seed[i] = -1
         self._slot_presence[i] = 0.0
         self._slot_frequency[i] = 0.0
@@ -1516,9 +1703,18 @@ class Engine:
             self._block_refs[blk] = 1
 
     def _sync_tables(self) -> None:
-        """Push host-side table changes to the device copy in the cache."""
+        """Push host-side table changes to the device copy in the cache.
+
+        COPY, never alias: the cache is DONATED to every decode/insert/
+        chunk program, and on the CPU backend ``jnp.asarray`` of a numpy
+        array can share its buffer — donation would then let XLA write a
+        program OUTPUT over ``_tables_host`` behind the allocator's back
+        (observed: the [B, STOP_LEN] stop-history output landing in the
+        same-shaped donated tables buffer, trashing every row's mapping).
+        """
         if self.paged and self._tables_dirty:
-            self.cache = dict(self.cache, tables=jnp.asarray(self._tables_host))
+            self.cache = dict(self.cache,
+                              tables=jnp.array(self._tables_host, copy=True))
             self._tables_dirty = False
 
     def _bucket(self, n: int) -> int:
@@ -1546,16 +1742,22 @@ class Engine:
             # slot left empty idles for a whole K-step block), then prefill
             # AHEAD into decode_wait while slots are busy.
             did_work = self._admit_and_insert(pipelined=False)
-            # 1b) One chunk of an in-flight long-prompt stream: decode
-            # blocks run between chunks, so streaming a 32k prompt no
-            # longer freezes every active slot's TPOT.
-            if self._stream is not None:
+            # 1b) One chunk of ONE in-flight long-prompt stream (fair
+            # round-robin across lanes): decode blocks run between chunks,
+            # so streaming a 32k prompt no longer freezes every active
+            # slot's TPOT — and N lanes advance interleaved instead of a
+            # second long prompt head-of-line blocking behind the first.
+            if self._streams:
                 self._stream_step(pipelined=False)
                 did_work = True
             # 2) One fused decode block for all active slots.
             if any(s is not None for s in self.slots):
                 try:
-                    if self._spec and any(
+                    # Stop-automaton rows exclude speculative dispatch:
+                    # the spec block does not evaluate the suffix automata,
+                    # so its history carry would go stale — plain fused
+                    # blocks serve the batch until those rows finish.
+                    if self._spec and not self._stops_active and any(
                         s is not None and self._spec_ok[i]
                         and self._slot_temp[i] <= 0.0
                         for i, s in enumerate(self.slots)
@@ -1653,8 +1855,8 @@ class Engine:
                     break  # pool backpressure: wait for block frees
                 if (len(req.prompt_tokens) > self._max_bucket()
                         and not self._ring_usable(len(req.prompt_tokens))):
-                    if self._stream is not None:
-                        break  # one stream at a time; FIFO head waits
+                    if not self._lane_available(n_req):
+                        break  # no lane (count or KV pressure); FIFO head waits
                     self._pending = None
                     self._admitting += 1
                     try:
@@ -1897,6 +2099,19 @@ class Engine:
         slot = _Slot(request=req, lora_slot=lora_slot, position=n)
         slot.pending_first = (first_token, lp_info)
         self._register_slot(slot_idx, slot)
+        if self._slot_stop_lens[slot_idx].any():
+            # Re-seed the row's device stop history: emitted tokens from
+            # the host record (attach / sync-parked admissions), else the
+            # device-resident first token — no host sync either way.
+            row = np.full((STOP_LEN,), -1, np.int32)
+            tail = req.output_tokens[-STOP_LEN:]
+            if tail:
+                row[STOP_LEN - len(tail):] = tail
+                self._dev_stop_hist = self._dev_stop_hist.at[slot_idx].set(
+                    jnp.asarray(row))
+            else:
+                self._dev_stop_hist = self._dev_stop_hist.at[slot_idx].set(
+                    jnp.asarray(row)).at[slot_idx, STOP_LEN - 1].set(tok_dev)
         self._count_first_token(slot_idx, tok_dev)
         if self._spec:
             # _register_slot set the row's sampling params _draft_admit
@@ -2048,8 +2263,12 @@ class Engine:
             # check; the clamped scatter writes garbage the mask hides.
             vpos = jnp.minimum(
                 positions[:, None] + jnp.arange(kp1)[None], s_max - 1)
+            # active gates the verify WRITES: frozen/empty rows must not
+            # stomp a reserved lane mid-chunk-stream (same contract as
+            # _decode_impl's step_fn call).
             logits, cache = target_extend(
-                cache, vtokens, vpos, lora_bufs=lora_bufs, slot_ids=slot_ids)
+                cache, vtokens, vpos, lora_bufs=lora_bufs, slot_ids=slot_ids,
+                active=active)
             greedy = greedy_pick(logits, model_cfg.vocab_size)  # [B, K+1]
             first_sampled = sample(
                 logits[:, 0], cycle_key, temp, topk, topp,
@@ -2115,9 +2334,13 @@ class Engine:
         if not self._spec:
             return
         n = len(prompt_tokens)
-        if n > self._max_bucket() or self._slot_temp[slot_idx] > 0.0:
+        req = self.slots[slot_idx].request if self.slots[slot_idx] else None
+        if (n > self._max_bucket() or self._slot_temp[slot_idx] > 0.0
+                or (req is not None and req.stop_sequences)):
             # Sampled rows never accept proposals — mirroring their prompt
             # into the draft would be a wasted prefill per admission.
+            # Stop-sequence rows are excluded too: their automata only run
+            # in plain blocks, so they never speculate.
             self._spec_ok[slot_idx] = False
             return
         try:
@@ -2150,8 +2373,11 @@ class Engine:
         throttle them (K+1)x per dispatch — run a full ``steps`` cycles
         instead, which restores sampled-row cadence and lets greedy rows
         run ahead (budget masks cap them).  Two schedules = two compiled
-        block variants, both cached after first use."""
-        steps = max(1, self.cfg.decode_steps_per_sync)
+        block variants, both cached after first use.  Under the adaptive
+        planner, ``steps`` is the planned per-dispatch budget (admission
+        pressure and SSE cadence throttle speculative fusion exactly like
+        plain fusion)."""
+        steps = self._plan_steps()
         mixed = any(
             s is not None
             and not (self._spec_ok[i] and self._slot_temp[i] <= 0.0)
@@ -2230,6 +2456,7 @@ class Engine:
                 req.output_tokens.append(tok)
                 self._store_logprobs(req, lps_np[j, i], top_v_np[j, i],
                                      top_i_np[j, i])
+                req.stream_event.set()  # per-step emission (see decode walk)
                 n_tokens += 1
                 slot.position += 1
                 self._slot_tokens[i] = tok
@@ -2269,6 +2496,9 @@ class Engine:
             a = self.cfg.tps_ema_alpha
             self.decode_tps_ema = (1 - a) * self.decode_tps_ema + a * inst
             # Per-cycle cadence (each verify cycle emits >= 1 token/row).
+            # No dispatch_steps observation: that histogram records the
+            # PLANNER's power-of-two choices, and a spec block's token-row
+            # count (cycles x (K+1)) is not one of them.
             self.phase_hist["decode_step"].observe(step_s / max(1, n_cycles))
 
     def _prefill_common(self, req: Request):
@@ -2687,13 +2917,30 @@ class Engine:
     # interleaved long-prompt streaming (one chunk per engine cycle)
     # ------------------------------------------------------------------
 
+    def _lane_available(self, n_prompt: int) -> bool:
+        """KV-pressure-aware lane admission: may a new chunk stream take a
+        reserved lane now?  Bounded by ``stream_lanes``; lanes beyond the
+        first additionally require the paged pool to keep a growth block
+        per active decode row AFTER the stream's atomic whole-prompt
+        allocation — a second 32k stream must unblock head-of-line waits,
+        not starve running decode of its next block."""
+        if len(self._streams) >= max(1, self.cfg.stream_lanes):
+            return False
+        if not self._streams or not self.paged:
+            return True
+        active = sum(1 for s in self.slots if s is not None)
+        avail = len(self._free_blocks) + (
+            len(self._evictable) if self._prefix_enabled else 0)
+        return avail - self._paged_needed(n_prompt + 1) >= active
+
     def _start_stream(self, req: Request) -> bool:
         """Reserve a free lane and begin streaming a long prompt into it.
 
         The lane is held out of ``_free_slot_index`` (not a live slot, so
-        decode steps skip it) and receives one chunk per ``_stream_step``.
-        Returns False only when the request was reparked for backpressure
-        (caller must stop admitting this cycle).
+        decode steps skip it) and receives one chunk per ``_stream_step``
+        pick (fair round-robin across up to ``stream_lanes`` concurrent
+        streams).  Returns False only when the request was reparked for
+        backpressure (caller must stop admitting this cycle).
         """
         if req.cancelled.is_set():
             self._finish(req, "cancelled")
@@ -2730,25 +2977,34 @@ class Engine:
                 self._reserved_slots.discard(slot_idx)
                 self._pending = req
                 return False
-        self._stream = _ChunkStream(request=req, slot_idx=slot_idx,
-                                    lora_slot=lora_slot, next_start=reused)
+        self._streams.append(_ChunkStream(request=req, slot_idx=slot_idx,
+                                          lora_slot=lora_slot,
+                                          next_start=reused))
         return True
 
-    def _abort_stream(self, reason: str) -> None:
-        st = self._stream
-        self._stream = None
+    def _abort_stream(self, st: _ChunkStream, reason: str) -> None:
+        if st in self._streams:
+            self._streams.remove(st)
         self._reserved_slots.discard(st.slot_idx)
         if self.paged:
             self._paged_free_row(st.slot_idx)
         self._finish(st.request, reason)
 
     def _stream_step(self, pipelined: bool) -> None:
-        """Dispatch ONE chunk of the in-flight stream; on the final chunk,
-        sample the first token and activate the lane as a live decode slot."""
-        st = self._stream
+        """Dispatch ONE chunk of ONE in-flight stream — the round-robin
+        cursor rotates across lanes, so N concurrent long prompts advance
+        fairly interleaved (one chunk per engine cycle total keeps the
+        decode cadence unchanged versus a single lane).  On a stream's
+        final chunk, sample the first token and activate its lane as a
+        live decode slot."""
+        if not self._streams:
+            return
+        self._stream_rr %= len(self._streams)
+        st = self._streams[self._stream_rr]
+        self._stream_rr += 1
         req = st.request
         if req.cancelled.is_set():
-            self._abort_stream("cancelled")
+            self._abort_stream(st, "cancelled")
             return
         chunk = self._max_bucket()
         prompt = req.prompt_tokens
@@ -2773,7 +3029,7 @@ class Engine:
         except Exception as e:  # engine must survive a poison request
             logger.exception("chunk stream failed for %s", req.request_id)
             req.error = str(e)
-            self._abort_stream("error")
+            self._abort_stream(st, "error")
             return
         st.next_start = start + c
         if st.next_start < n:
@@ -2782,7 +3038,7 @@ class Engine:
         # sample the first token, then activate the lane as a live slot.
         if self.paged:
             self._prefix_register_row(st.slot_idx, prompt, req.adapter)
-        self._stream = None
+        self._streams.remove(st)
         self._reserved_slots.discard(st.slot_idx)
         slot_idx = st.slot_idx
         sp = req.sampling
@@ -2837,7 +3093,35 @@ class Engine:
             self._dev_counts = self._counts().at[slot_idx].set(0)
         # Budget for device-side stop: the prefill already produced token 1.
         self._slot_remaining[slot_idx] = max(0, slot.request.max_new_tokens - 1)
+        self._program_stop_lanes(slot_idx, slot.request)
         self._usage_sync_kv()
+
+    def _program_stop_lanes(self, slot_idx: int, req: Request) -> None:
+        """Compile the request's stop suffixes into the row's device
+        automaton lanes.  Custom single-token stop ids fold in as length-1
+        sequences; anything that does not fit the static lanes — or
+        ``device_stops`` off — leaves the lanes empty, and the host oracle
+        in the result walk stays authoritative either way."""
+        self._slot_stop_ids[slot_idx] = -1
+        self._slot_stop_lens[slot_idx] = 0
+        self._slot_stop_hist[slot_idx] = -1
+        # Speculative engines: custom single-token stop ids keep the old
+        # host-side seam (programming them would pause spec dispatch via
+        # _stops_active for no freeze win — spec blocks host-trim anyway);
+        # multi-token sequences DO program and exclude speculation.
+        fold_ids = not self._spec
+        if self.cfg.device_stops and (req.stop_sequences
+                                      or (fold_ids and req.stop_token_ids)):
+            enc = encode_stop_rows(
+                [tuple(s) for s in req.stop_sequences]
+                + ([(int(t),) for t in req.stop_token_ids]
+                   if fold_ids else []))
+            if enc is not None:
+                ids, lens = enc
+                self._slot_stop_ids[slot_idx] = ids
+                self._slot_stop_lens[slot_idx] = lens
+        self._stops_active = int(
+            (self._slot_stop_lens.sum(axis=1) > 0).sum())
 
     def _record_ttft(self, req: Request) -> None:
         with self._lock:
@@ -2894,9 +3178,8 @@ class Engine:
             for s in self.slots if s is not None]
         holdings += [(w.request.adapter, w.k.shape[2])
                      for w in self.decode_wait]
-        if self._stream is not None and self._stream.next_start > 0:
-            holdings.append((self._stream.request.adapter,
-                             self._stream.next_start))
+        holdings += [(st.request.adapter, st.next_start)
+                     for st in self._streams if st.next_start > 0]
         self.usage.sync_kv(holdings)
 
     def observe_handoff(self, seconds: float) -> None:
@@ -2990,11 +3273,14 @@ class Engine:
         exhausted pool cannot grow fails with "kv pool exhausted" (the
         documented oversubscription tradeoff) without touching the batch.
         """
-        if not self.paged:
-            return
         prev = self._prev_dispatch_steps if pipelined else 0
         if pipelined:
+            # Recorded for BOTH cache layouts: the paged reservation below
+            # needs it, and _plan_steps subtracts it from the host-lagged
+            # remaining budgets on non-paged pipelined engines too.
             self._prev_dispatch_steps = n_steps
+        if not self.paged:
+            return
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -3015,12 +3301,12 @@ class Engine:
         self._sync_tables()
 
     def _do_decode_step(self) -> None:
-        n_steps = max(1, self.cfg.decode_steps_per_sync)
+        n_steps = self._plan_steps()
         self._paged_ensure_decode(n_steps, pipelined=False)
         t0 = time.perf_counter()
         counts_arg, penalized = self._penalty_dispatch_args()
         (step_tokens, step_valid, step_lps, step_top_v, step_top_i,
-         _, _, _, counts_out, self.cache) = self._jit_decode(
+         _, _, _, _, counts_out, self.cache) = self._jit_decode(
             self.params, self._lora_buffers(), self.cache,
             jnp.asarray(self._slot_tokens), jnp.asarray(self._slot_positions),
             jnp.asarray(self._slot_lora),
@@ -3032,6 +3318,9 @@ class Engine:
             jnp.asarray(self._slot_frequency), counts_arg,
             jnp.asarray(self._slot_bias_ids),
             jnp.asarray(self._slot_bias_vals),
+            jnp.asarray(self._slot_stop_ids),
+            jnp.asarray(self._slot_stop_lens),
+            jnp.asarray(self._sync_stop_hist()),
             n_steps=n_steps, penalized=penalized,
         )
         if penalized:
@@ -3064,6 +3353,11 @@ class Engine:
                 req.output_tokens.append(tok)
                 self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
                                      top_i_np[k, i])
+                # Per-step emission: each token of the fused block is
+                # published to the stream consumer as it lands in the
+                # trim walk, not once per dispatch — an SSE reader wakes
+                # per token instead of per burst.
+                req.stream_event.set()
                 n_tokens += 1
                 slot_tokens += 1
                 slot.position += 1
@@ -3095,6 +3389,7 @@ class Engine:
             # Steady-state cadence: wall per decode step (one token per
             # active slot per step) — tpu:decode_step_seconds.
             self.phase_hist["decode_step"].observe(step_s / n_steps)
+            self.dispatch_steps_hist.observe(n_steps)
 
     # ------------------------------------------------------------------
     # pipelined decode: overlap host readback with the next device block
@@ -3117,6 +3412,9 @@ class Engine:
         self._dev_tokens = jnp.zeros((b,), jnp.int32)
         self._dev_positions = jnp.zeros((b,), jnp.int32)
         self._dev_remaining = jnp.zeros((b,), jnp.int32)
+        # Stop-automaton history rides the device carry (the pipelined
+        # loop's no-host-round-trip contract); rows re-seed at activation.
+        self._dev_stop_hist = jnp.full((b, STOP_LEN), -1, jnp.int32)
         if self._spec:
             # Draft catch-up triple lives on device: spec blocks update it
             # in their carry, no host round-trip.
@@ -3129,7 +3427,7 @@ class Engine:
         inflight: dict | None = None
         while self._running:
             did_work = self._admit_and_insert(pipelined=True)
-            if self._stream is not None:
+            if self._streams:
                 self._stream_step(pipelined=True)
                 did_work = True
             block = None
@@ -3202,12 +3500,14 @@ class Engine:
                 self._paged_free_row(slot_idx)  # don't strand a slot-less row
 
     def _dispatch_block(self) -> dict:
-        if self._spec and any(
+        # _stops_active: same speculative exclusion as the sync loop —
+        # only plain blocks evaluate the stop automata.
+        if self._spec and not self._stops_active and any(
             s is not None and self._spec_ok[i] and self._slot_temp[i] <= 0.0
             for i, s in enumerate(self.slots)
         ):
             return self._dispatch_spec_block()
-        n_steps = max(1, self.cfg.decode_steps_per_sync)
+        n_steps = self._plan_steps()
         self._paged_ensure_decode(n_steps, pipelined=True)
         if self._pending_budget_zero:
             idxs = jnp.asarray(self._pending_budget_zero, jnp.int32)
@@ -3215,7 +3515,7 @@ class Engine:
             self._pending_budget_zero.clear()
         counts_arg, penalized = self._penalty_dispatch_args()
         (toks, valid, lps, top_v, top_i, next_tokens, next_positions,
-         next_remaining, counts_out, self.cache) = (
+         next_remaining, next_hist, counts_out, self.cache) = (
             self._jit_decode(
                 self.params, self._lora_buffers(), self.cache,
                 self._dev_tokens, self._dev_positions,
@@ -3228,6 +3528,9 @@ class Engine:
                 jnp.asarray(self._slot_frequency), counts_arg,
                 jnp.asarray(self._slot_bias_ids),
                 jnp.asarray(self._slot_bias_vals),
+                jnp.asarray(self._slot_stop_ids),
+                jnp.asarray(self._slot_stop_lens),
+                self._dev_stop_hist,
                 n_steps=n_steps, penalized=penalized,
             )
         )
@@ -3236,6 +3539,7 @@ class Engine:
         self._dev_tokens = next_tokens
         self._dev_positions = next_positions
         self._dev_remaining = next_remaining
+        self._dev_stop_hist = next_hist
         for arr in (toks, valid, lps, top_v, top_i):
             try:
                 arr.copy_to_host_async()
@@ -3359,6 +3663,7 @@ class Engine:
                     req.output_tokens.append(tok)
                     self._store_logprobs(req, lps_np[k, i], top_v_np[k, i],
                                          top_i_np[k, i])
+                    req.stream_event.set()  # per-step emission (see decode walk)
                     n_tokens += 1
                     row_tokens += 1
                     slot.position += 1
@@ -3407,9 +3712,26 @@ class Engine:
             # per-step cadence the gateway compares across replicas.
             self.phase_hist["decode_step"].observe(
                 step_s / max(1, blk["n_steps"]))
+            if not blk.get("spec"):
+                # Planner decision record only — spec blocks' token-row
+                # counts are not power-of-two planner choices.
+                self.dispatch_steps_hist.observe(blk["n_steps"])
 
     def _is_stop(self, req: Request, tok: int) -> bool:
-        return tok == self.eos_id or tok in req.stop_token_ids
+        """Host stop oracle, evaluated once per emitted token in the
+        post-dispatch walk: EOS / custom stop ids / multi-token stop
+        sequences against the output tail.  The device automaton freezes
+        rows by the SAME rule mid-block; this check is what actually
+        finishes the request, so device/host agreement is structural."""
+        if tok == self.eos_id or tok in req.stop_token_ids:
+            return True
+        if req.stop_sequences:
+            out = req.output_tokens
+            for seq in req.stop_sequences:
+                n = len(seq)
+                if n and len(out) >= n and tuple(out[-n:]) == tuple(seq):
+                    return True
+        return False
 
     def _is_finished(self, req: Request, tok: int) -> bool:
         return self._is_stop(req, tok) or len(req.output_tokens) >= req.max_new_tokens

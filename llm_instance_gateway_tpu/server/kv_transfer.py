@@ -82,6 +82,10 @@ class PrefillHandoff:
     max_new_tokens: int
     sampling: dict
     stop_token_ids: list[int]
+    # Tokenized multi-token stop strings (engine Request.stop_sequences):
+    # the decode hop's device-side stop automata need the same suffixes
+    # the prefill hop validated.
+    stop_sequences: list[list[int]]
     logprobs: int | None
     # Sampling carry: the prefill's sampled first token and its logprob info.
     first_token: int
@@ -147,6 +151,8 @@ class PrefillHandoff:
             "max_new_tokens": self.max_new_tokens,
             "sampling": self.sampling,
             "stop_token_ids": list(map(int, self.stop_token_ids)),
+            "stop_sequences": [list(map(int, s))
+                               for s in self.stop_sequences],
             "logprobs": self.logprobs,
             "first_token": self.first_token,
             "first_lp": self.first_lp,
@@ -208,6 +214,8 @@ class PrefillHandoff:
             max_new_tokens=int(meta["max_new_tokens"]),
             sampling=samp,
             stop_token_ids=[int(t) for t in meta["stop_token_ids"]],
+            stop_sequences=[[int(t) for t in s]
+                            for s in meta.get("stop_sequences") or []],
             logprobs=meta["logprobs"],
             first_token=int(meta["first_token"]),
             first_lp=meta["first_lp"],
@@ -263,6 +271,7 @@ def export_handoff(request, k, v, n: int, first_token: int, lp_info=None,
         adapter=request.adapter,
         max_new_tokens=request.max_new_tokens,
         sampling=samp, stop_token_ids=list(request.stop_token_ids),
+        stop_sequences=[list(s) for s in request.stop_sequences],
         logprobs=request.logprobs, first_token=int(first_token),
         first_lp=first_lp, first_top_vals=first_top_vals,
         first_top_ids=first_top_ids, t_submit=request.t_submit,
@@ -301,6 +310,7 @@ def make_request(handoff: PrefillHandoff):
         ),
         adapter=handoff.adapter,
         stop_token_ids=tuple(handoff.stop_token_ids),
+        stop_sequences=tuple(tuple(s) for s in handoff.stop_sequences),
         request_id=handoff.request_id,
         logprobs=handoff.logprobs,
     )

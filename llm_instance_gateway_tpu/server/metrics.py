@@ -130,6 +130,22 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
             "# TYPE tpu:spec_tokens_per_cycle gauge",
             f"tpu:spec_tokens_per_cycle {snapshot['spec_tokens_per_cycle']}",
         ]
+    if "stream_lanes" in snapshot:
+        # Concurrent chunk-stream lanes (engine decode fast path): the
+        # configured lane count and how many long prompts are streaming
+        # into reserved lanes right now.
+        lines += [
+            "# TYPE tpu:stream_lanes gauge",
+            f"tpu:stream_lanes {snapshot['stream_lanes']}",
+            "# TYPE tpu:stream_lanes_active gauge",
+            f"tpu:stream_lanes_active {snapshot.get('stream_lanes_active', 0)}",
+        ]
+    if snapshot.get("dispatch_steps_hist"):
+        # Fused steps per decode/spec dispatch — the adaptive planner's
+        # decision record (tpu:dispatch_steps buckets land exactly on the
+        # planner's power-of-two choices).
+        lines += render_histogram("tpu:dispatch_steps",
+                                  snapshot["dispatch_steps_hist"], {})
     phase_hist = snapshot.get("phase_hist") or {}
     if phase_hist:
         labels = {"model": snapshot.get("model_name", ""),

@@ -4,6 +4,14 @@ All paths are shape-static (top-k uses a fixed k; top-p masks a sorted copy)
 so the decode step compiles once regardless of per-request sampling params.
 Per-row parameters arrive as arrays, letting one batch mix sampling configs —
 required for multiplexed serving where every slot is a different request.
+
+Also home to the DEVICE-SIDE stop-sequence automaton the fused decode block
+evaluates per step (``stop_hist_update``/``stop_suffix_hit``): each row
+carries a ring of its last ``STOP_LEN`` emitted tokens, and a per-row table
+of right-aligned stop suffixes (-1 padded) matches against it with one
+masked compare — no host round-trip per emitted token, which is what lets
+``decode_steps_per_sync``/adaptive fusion stay safe for requests carrying
+stop strings (the host only trims the overshoot once per dispatch).
 """
 
 from __future__ import annotations
@@ -12,6 +20,57 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# Device stop-automaton lanes: at most STOP_SEQS token-suffix sequences per
+# row (the OpenAI surface caps `stop` at 4 strings), each at most STOP_LEN
+# tokens.  Rows whose stops exceed either bound fall back to the host
+# oracle (the engine leaves their lanes empty) — correctness never depends
+# on fitting the lanes, only the fused-dispatch freeze does.
+STOP_SEQS = 4
+STOP_LEN = 8
+
+
+def encode_stop_rows(
+    sequences,                 # iterable of token-id tuples for ONE row
+) -> "tuple[list[list[int]], list[int]] | None":
+    """(ids [STOP_SEQS][STOP_LEN] right-aligned -1-padded, lens [STOP_SEQS])
+    device lanes for one row's stop sequences, or ``None`` when they do not
+    fit the static lanes (too many, too long, or empty entries)."""
+    seqs = [tuple(int(t) for t in s) for s in sequences]
+    if len(seqs) > STOP_SEQS or any(
+            not s or len(s) > STOP_LEN for s in seqs):
+        return None
+    ids = [[-1] * STOP_LEN for _ in range(STOP_SEQS)]
+    lens = [0] * STOP_SEQS
+    for j, s in enumerate(seqs):
+        ids[j][STOP_LEN - len(s):] = list(s)
+        lens[j] = len(s)
+    return ids, lens
+
+
+def stop_hist_update(hist: jax.Array, sampled: jax.Array,
+                     advance: jax.Array) -> jax.Array:
+    """Shift each advancing row's token history left and append the newly
+    sampled token (``hist`` [B, STOP_LEN] int32, -1 = not yet generated).
+    Frozen rows (``advance`` False) keep their history unchanged."""
+    shifted = jnp.concatenate(
+        [hist[:, 1:], sampled[:, None].astype(hist.dtype)], axis=1)
+    return jnp.where(advance[:, None], shifted, hist)
+
+
+def stop_suffix_hit(hist: jax.Array, stop_ids: jax.Array,
+                    stop_lens: jax.Array) -> jax.Array:
+    """[B] bool: some stop sequence matches the row's history suffix.
+
+    ``stop_ids`` [B, STOP_SEQS, STOP_LEN] is right-aligned with -1 padding,
+    so an element-wise masked compare against the history tail IS the
+    suffix match; -1 history entries (fewer tokens generated than the stop
+    is long) can never equal a validated stop id, so short histories never
+    false-match.  All-pad lanes are excluded via ``stop_lens`` > 0."""
+    pad = stop_ids < 0
+    eq = stop_ids == hist[:, None, :]
+    matched = jnp.all(pad | eq, axis=-1)          # [B, STOP_SEQS]
+    return jnp.any(matched & (stop_lens > 0), axis=-1)
 
 
 def sample(

@@ -39,6 +39,17 @@ class LatencyModel:
     # device put of a few-MB delta — tens of milliseconds, versus the
     # DISK tier's full Orbax restore (adapter_load_s).
     host_promote_s: float = 0.02
+    # Decode fast-path knobs (engine PR 15 — the cost model item-3's
+    # autoscaler loop reuses):
+    # Fused decode steps per dispatch (EngineConfig.adaptive_steps /
+    # decode_steps_per_sync): the dispatch base cost ``decode_base_s`` is
+    # paid ONCE per dispatch while the per-kv/per-seq terms scale with the
+    # fused step count — exactly the amortization the adaptive planner
+    # buys on the real engine.
+    steps_per_dispatch: int = 1
+    # Concurrent chunk-stream lanes (EngineConfig.stream_lanes): how many
+    # long prompts a SimServer advances chunk-by-chunk at once.
+    stream_lanes: int = 1
 
     def prefill_s(self, prompt_tokens: int) -> float:
         return max(
@@ -51,6 +62,17 @@ class LatencyModel:
             self.decode_base_s
             + self.decode_per_kv_token_s * total_kv_tokens
             + self.decode_per_seq_s * batch
+        )
+
+    def decode_block_s(self, total_kv_tokens: int, batch: int) -> float:
+        """One fused dispatch advancing every sequence
+        ``steps_per_dispatch`` tokens: base paid once, marginal terms per
+        step (kv integral approximated at the block's starting size)."""
+        k = max(1, self.steps_per_dispatch)
+        return (
+            self.decode_base_s
+            + k * (self.decode_per_kv_token_s * total_kv_tokens
+                   + self.decode_per_seq_s * batch)
         )
 
 
@@ -134,6 +156,7 @@ class SimServer:
         prefix_cache_size: int = 32,
         host_cache_slots: int = 0,
         preload: "list[str] | None" = None,
+        chunk_tokens: int = 0,
     ):
         self.name = name
         self.pod = Pod(name=name, address=f"{name}:8000")
@@ -177,6 +200,13 @@ class SimServer:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_reused_tokens = 0
+        # Chunk-stream lanes (engine PR 15): prompts beyond chunk_tokens
+        # stream one chunk per iteration into up to latency.stream_lanes
+        # concurrent lanes (fair round-robin), interleaved with decode —
+        # 0 disables (monolithic prefill, the pre-lever model).
+        self.chunk_tokens = chunk_tokens
+        self.streaming: list[dict] = []   # {"req": SimRequest, "done": int}
+        self._lane_rr = 0
 
     # -- metrics the production scheduler consumes -------------------------
     def metrics(self) -> PodMetrics:
@@ -301,7 +331,9 @@ class SimServer:
         # blocking: the engine's executor-thread restore lets other
         # traffic keep flowing while the waiting request pays the latency.
         req = None
-        if self.prefill_queue and len(self.active) < self.decode_slots:
+        lanes = max(1, self.latency.stream_lanes)
+        active_streams = len(self.streaming) + len(self.active)
+        if self.prefill_queue and active_streams < self.decode_slots:
             for i, queued in enumerate(self.prefill_queue):
                 if (queued.adapter is not None
                         and queued.adapter not in self.resident_adapters):
@@ -309,6 +341,16 @@ class SimServer:
                     continue  # waiting on its load; later traffic flows
                 if not self._admit_would_fit(queued):
                     break  # KV capacity head-block at the first admissible
+                if (self.chunk_tokens
+                        and queued.prompt_tokens > self.chunk_tokens):
+                    # Long prompt: takes a chunk-stream lane (no compute
+                    # this iteration; chunks advance below).  No lane free
+                    # = head-of-line wait, the engine's FIFO contract.
+                    if len(self.streaming) >= lanes:
+                        break
+                    self.streaming.append(
+                        {"req": self.prefill_queue.pop(i), "done": 0})
+                    break
                 req = self.prefill_queue.pop(i)
                 break
         if req is not None:
@@ -345,14 +387,49 @@ class SimServer:
                 self.active.append(_ActiveSeq(req, req.prompt_tokens + 1))
             return duration
 
+        duration = 0.0
+        if self.streaming:
+            # One chunk of ONE lane per iteration (fair round-robin),
+            # interleaved with the decode block below — the engine loop's
+            # cycle shape, so N long prompts advance concurrently instead
+            # of head-of-line serializing.
+            self._lane_rr %= len(self.streaming)
+            lane = self.streaming[self._lane_rr]
+            self._lane_rr += 1
+            r = lane["req"]
+            chunk = min(self.chunk_tokens, r.prompt_tokens - lane["done"])
+            duration += self.latency.prefill_s(chunk)
+            lane["done"] += chunk
+            if lane["done"] >= r.prompt_tokens:
+                # Final chunk: the lane activates as a live decode slot
+                # and the first token is emitted (engine _stream_step).
+                self.streaming.remove(lane)
+                r.t_first_token = now + duration
+                r.generated = 1
+                self.tokens_generated += 1
+                if r.adapter:
+                    self.resident_adapters[r.adapter] = (
+                        self.resident_adapters.get(r.adapter, 0) + 1)
+                    self.last_used[r.adapter] = now
+                if r.generated >= r.output_tokens:
+                    r.t_done = now + duration
+                    if r.adapter:
+                        refs = self.resident_adapters.get(r.adapter, 1)
+                        self.resident_adapters[r.adapter] = max(0, refs - 1)
+                else:
+                    self.active.append(_ActiveSeq(r, r.prompt_tokens + 1))
         if self.active:
             total_kv = sum(a.kv_tokens for a in self.active)
-            duration = self.latency.decode_s(total_kv, len(self.active))
+            steps = max(1, self.latency.steps_per_dispatch)
+            duration += self.latency.decode_block_s(total_kv,
+                                                    len(self.active))
             finished = []
             for seq in self.active:
-                seq.request.generated += 1
-                seq.kv_tokens += 1
-                self.tokens_generated += 1
+                adv = min(steps,
+                          seq.request.output_tokens - seq.request.generated)
+                seq.request.generated += adv
+                seq.kv_tokens += adv
+                self.tokens_generated += adv
                 if seq.request.generated >= seq.request.output_tokens:
                     seq.request.t_done = now + duration
                     finished.append(seq)
@@ -361,6 +438,8 @@ class SimServer:
                 if seq.request.adapter:
                     refs = self.resident_adapters.get(seq.request.adapter, 1)
                     self.resident_adapters[seq.request.adapter] = max(0, refs - 1)
+            return duration
+        if duration > 0:
             return duration
         if self.loading:
             # Idle except for in-flight adapter loads: stay scheduled

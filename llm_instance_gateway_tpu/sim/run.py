@@ -606,6 +606,85 @@ def run_placement_scenario(
     return report
 
 
+def run_decode_lever_scenario(seed: int = 0) -> dict:
+    """CPU-deterministic simulator twin of the engine decode levers
+    (PR 15): the LatencyModel's ``steps_per_dispatch`` and
+    ``stream_lanes`` knobs driven over a fixed workload, committed as
+    ``SIM_DECODE_LEVERS.json`` so the elastic-topology autoscaler work
+    (ROADMAP item 3) can reuse this PR's cost model without re-deriving
+    it.
+
+    Two cells per lever, single server, direct-driven (no router):
+
+    - **fused dispatch**: 8 decode-heavy requests at steps-per-dispatch
+      1 vs 8 — tokens/s ratio shows the dispatch-base amortization the
+      adaptive planner buys (bounded by decode_base_s's share of a step).
+    - **stream lanes**: a 2048-token prompt ahead of a 512-token prompt
+      plus short traffic, chunk 256, lanes 1 vs 2 — the second prompt's
+      TTFT stops serializing behind the first.
+    """
+    import dataclasses as _dc
+
+    def drive(server: SimServer, reqs: "list[SimRequest]") -> float:
+        now = 0.0
+        for r in reqs:
+            server.prefill_queue.append(r)
+        while (server.prefill_queue or server.active or server.streaming):
+            d = server.step(now)
+            now += d if d > 0 else 0.01
+        return now
+
+    def fused_cell(steps: int) -> dict:
+        lat = _dc.replace(V5E_DEFAULT, steps_per_dispatch=steps)
+        server = SimServer("sim-0", lat, decode_slots=8)
+        reqs = [SimRequest(rid=i, arrival_s=0.0, prompt_tokens=128,
+                           output_tokens=256, model="m")
+                for i in range(8)]
+        wall = drive(server, reqs)
+        toks = server.tokens_generated
+        return {"steps_per_dispatch": steps, "wall_s": round(wall, 4),
+                "tokens": toks, "tok_per_s": round(toks / wall, 1)}
+
+    def lane_cell(lanes: int) -> dict:
+        lat = _dc.replace(V5E_DEFAULT, stream_lanes=lanes)
+        server = SimServer("sim-0", lat, decode_slots=8,
+                           kv_capacity_tokens=16384, chunk_tokens=256)
+        long_a = SimRequest(rid=0, arrival_s=0.0, prompt_tokens=2048,
+                            output_tokens=32, model="m")
+        long_b = SimRequest(rid=1, arrival_s=0.0, prompt_tokens=512,
+                            output_tokens=32, model="m")
+        shorts = [SimRequest(rid=2 + i, arrival_s=0.0, prompt_tokens=64,
+                             output_tokens=64, model="m") for i in range(2)]
+        wall = drive(server, [long_a, long_b, *shorts])
+        return {"stream_lanes": lanes, "wall_s": round(wall, 4),
+                "second_long_ttft_s": round(long_b.ttft_s, 4),
+                "first_long_ttft_s": round(long_a.ttft_s, 4)}
+
+    one, eight = fused_cell(1), fused_cell(8)
+    lane1, lane2 = lane_cell(1), lane_cell(2)
+    return {
+        "scenario": "decode_levers",
+        "seed": seed,
+        "latency_model": "v5e_default",
+        "fused_dispatch": {
+            "cells": [one, eight],
+            "tok_per_s_ratio": round(
+                eight["tok_per_s"] / one["tok_per_s"], 4),
+        },
+        "stream_lanes": {
+            "cells": [lane1, lane2],
+            "second_ttft_ratio": round(
+                lane1["second_long_ttft_s"] / lane2["second_long_ttft_s"],
+                4) if lane2["second_long_ttft_s"] > 0 else None,
+        },
+        # The reuse contract for item-3: both levers visible in the cost
+        # model, deterministically.
+        "ok": (eight["tok_per_s"] > one["tok_per_s"]
+               and lane2["second_long_ttft_s"]
+               < lane1["second_long_ttft_s"]),
+    }
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="routing-policy simulator")
     parser.add_argument("--qps", type=float, nargs="+", default=[20.0, 30.0])
@@ -633,11 +712,23 @@ def main(argv=None) -> None:
                              "acceptance scenario (1000-adapter Zipf by "
                              "default) and print its report instead of the "
                              "policy sweep")
+    parser.add_argument("--decode-lever-scenario", action="store_true",
+                        help="run the deterministic decode-lever scenario "
+                             "(steps-per-dispatch and stream-lane knobs; "
+                             "the committed SIM_DECODE_LEVERS.json) and "
+                             "print its report instead of the policy sweep")
     parser.add_argument("--out", default=None, metavar="PATH",
                         help="also write the placement-scenario report JSON "
                              "to this path (the committed artifact)")
     args = parser.parse_args(argv)
     latency = V5E_DEFAULT if args.latency_model == "v5e" else A100_VLLM
+    if args.decode_lever_scenario:
+        report = run_decode_lever_scenario()
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        raise SystemExit(0 if report["ok"] else 1)
     if args.placement_scenario:
         universe = args.adapter_universe or 1000
         report = run_placement_scenario(

@@ -103,6 +103,15 @@ def loaded_gateway_metrics() -> GatewayMetrics:
     return gm
 
 
+def _steps_hist() -> dict:
+    from llm_instance_gateway_tpu.server.engine import STEP_BUCKETS
+
+    h = tracing.Histogram(STEP_BUCKETS)
+    h.observe(1)
+    h.observe(8)
+    return h.state()
+
+
 def server_snapshot() -> dict:
     from llm_instance_gateway_tpu.server import profiler as profiler_mod
     from llm_instance_gateway_tpu.server import usage as usage_mod
@@ -146,6 +155,10 @@ def server_snapshot() -> dict:
         "tier_transitions": {("disk", "slot"): 2, ("slot", "host"): 1},
         "adapter_load_seconds": {"host": [0.05, 1], "disk": [1.2, 2]},
         "prefix_reused_tokens": 77,
+        # Decode fast-path observables (adaptive dispatch + stream lanes).
+        "stream_lanes": 2,
+        "stream_lanes_active": 1,
+        "dispatch_steps_hist": _steps_hist(),
         "phase_hist": {
             "prefill": hist.state(),
             "handoff": tracing.Histogram(tracing.LATENCY_BUCKETS).state(),
@@ -236,6 +249,11 @@ def test_server_render_contract():
     gap_kinds = {s.labels["kind"]: s.value
                  for s in families["tpu:dispatch_gap_seconds_count"]}
     assert gap_kinds == {"host": 1, "idle": 1}
+    # Decode fast-path families (adaptive dispatch + stream lanes).
+    assert families["tpu:stream_lanes"][0].value == 2
+    assert families["tpu:stream_lanes_active"][0].value == 1
+    assert families["tpu:dispatch_steps_count"][0].value == 2
+    assert families["tpu:dispatch_steps_sum"][0].value == 9
 
 
 def test_proxy_metrics_endpoint_round_trips():

@@ -312,3 +312,16 @@ class TestCommittedBaseline:
         assert att["dispatches"] > 0 and att["dispatch_seconds"] > 0
         out = profile_report.render_report(profile)
         assert "ENGINE STEP-TIMELINE ATTRIBUTION" in out
+
+    def test_host_sync_share_strictly_below_previous_baseline(self):
+        """The decode-lever acceptance bar: the refreshed baseline's
+        host-sync share sits strictly below the pre-lever baseline's
+        (embedded under 'previous'), and the report prints the delta."""
+        doc = json.loads((REPO / "PROFILE_BASELINE.json").read_text())
+        profile = profile_report.extract_profile(doc)
+        delta = profile_report.host_sync_delta(profile, doc["previous"])
+        assert delta is not None and delta["improved"], delta
+        assert delta["current_pct"] < delta["previous_pct"]
+        out = profile_report.render_report(profile, previous=doc["previous"])
+        assert "Host-sync share vs previous baseline" in out
+        assert "improved" in out

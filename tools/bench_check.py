@@ -37,9 +37,11 @@ GATED = {
     "pick_many_us": ("lower", 0.20),
     "handoff_blocks_per_s": ("higher", 0.20),
     "relay_fast_chunks_per_s": ("higher", 0.20),
+    "decode_adaptive_tok_s": ("higher", 0.20),
 }
 # Absolute bounds that hold regardless of the baseline (the PR acceptance
-# bars: tracing/policy enforcement each cost < 5% of a pick).
+# bars: tracing/policy enforcement each cost < 5% of a pick; the stop
+# automaton < 15% of a fused decode wall on the micro model).
 ABSOLUTE_MAX = {
     "pick_traced_ratio": 1.05,
     "pick_policy_ratio": 1.05,
@@ -47,13 +49,25 @@ ABSOLUTE_MAX = {
     "pick_placement_ratio": 1.05,
     "step_profile_ratio": 1.05,
     "pick_witness_ratio": 1.05,
+    "device_stops_ratio": 1.15,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
 # 1.0 on a socket-bound rig, so a baseline-relative gate would only measure
 # noise; the invariant worth pinning is that the zero-copy path never gets
 # MEANINGFULLY slower than the line-scanning oracle.
+# decode_adaptive_speedup >= 2.0 is the decode-lever PR's pinned
+# acceptance bar (adaptive fused dispatch + device-side stops vs the
+# steps=1 host-stop seed settings); stream_lanes_max_active == 2 pins the
+# head-of-line fix (a second long prompt streams CONCURRENTLY — sampled
+# across every round, so one missed polling window can't flake the gate);
+# the TTFT ratio floor only pins "a second lane never makes the second
+# prompt SLOWER" (the improvement itself swings 1.1-1.4x with host
+# timing, so a tighter floor would gate noise).
 ABSOLUTE_MIN = {
     "relay_fast_ratio": 0.80,
+    "decode_adaptive_speedup": 2.0,
+    "stream_lanes_max_active": 2,
+    "stream_second_ttft_ratio": 1.0,
 }
 
 
@@ -66,6 +80,7 @@ _RATIO_SOURCES = {
     "pick_placement_ratio": "placement",
     "step_profile_ratio": "profiler",
     "pick_witness_ratio": "witness",
+    "device_stops_ratio": "decode",
 }
 
 # family -> (primary metric, direction) used to choose the conservative
@@ -82,6 +97,7 @@ _FAMILY_PRIMARY = {
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
+    "decode": ("decode_adaptive_speedup", "higher"),
 }
 
 
@@ -99,6 +115,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "witness": bench.run_witness_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
+        "decode": bench.run_decode_lever_microbench(),
     }
     # The <5% overhead bounds are MIN-ratio estimates (12 interleaved
     # rounds per side inside each microbench), but one collect() pass on a
@@ -113,7 +130,8 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
                   "fairness": bench.run_fairness_microbench,
                   "placement": bench.run_placement_microbench,
                   "profiler": bench.run_profiler_microbench,
-                  "witness": bench.run_witness_microbench}
+                  "witness": bench.run_witness_microbench,
+                  "decode": bench.run_decode_lever_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):
             if fams[fam].get(metric, 0.0) <= ABSOLUTE_MAX[metric]:

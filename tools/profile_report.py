@@ -132,7 +132,31 @@ def _table(rows: list[dict], headers: tuple) -> str:
     return "\n".join(lines)
 
 
-def render_report(profile: dict) -> str:
+def host_sync_delta(profile: dict, previous: dict | None) -> dict | None:
+    """Host-sync-share movement vs a previous baseline's shares — the
+    number every ROADMAP item-2 lever is judged by.  ``previous`` is
+    either a ``{"shares": {...}}`` block (the refreshed
+    PROFILE_BASELINE.json embeds the pre-lever shares under "previous")
+    or a full profiler payload (--baseline FILE)."""
+    if not previous:
+        return None
+    prev_shares = previous.get("shares")
+    if prev_shares is None and "attribution" in previous:
+        prev_shares = (previous.get("attribution") or {}).get("shares")
+    if not prev_shares:
+        return None
+    cur = float(((profile.get("attribution") or {}).get("shares")
+                 or {}).get("host_sync", 0.0))
+    prev = float(prev_shares.get("host_sync", 0.0))
+    return {
+        "previous_pct": round(100.0 * prev, 4),
+        "current_pct": round(100.0 * cur, 4),
+        "delta_pp": round(100.0 * (cur - prev), 4),
+        "improved": cur < prev,
+    }
+
+
+def render_report(profile: dict, previous: dict | None = None) -> str:
     att = attribution_rows(profile)
     out = [
         "ENGINE STEP-TIMELINE ATTRIBUTION "
@@ -145,6 +169,12 @@ def render_report(profile: dict) -> str:
         _table(phase_rows(profile), ("phase", "dispatches", "wall_s",
                                      "mean_ms")),
     ]
+    delta = host_sync_delta(profile, previous)
+    if delta:
+        out += ["", "Host-sync share vs previous baseline: "
+                f"{delta['previous_pct']}% -> {delta['current_pct']}% "
+                f"(delta {delta['delta_pp']:+}pp"
+                f"{', improved' if delta['improved'] else ''})"]
     summary = record_summary(profile)
     if summary:
         out += ["", "Recent decode dispatches: " + ", ".join(
@@ -164,11 +194,21 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--pod",
                         help="which pod's snapshot to render when the "
                              "source is a black-box dump holding several")
+    parser.add_argument("--baseline",
+                        help="a previous profiler payload to diff the "
+                             "host-sync share against (the committed "
+                             "PROFILE_BASELINE.json embeds its "
+                             "predecessor's shares, so the delta also "
+                             "prints with no flag)")
     parser.add_argument("--json", action="store_true",
                         help="emit the attribution + phase rows as JSON")
     args = parser.parse_args(argv)
     try:
-        profile = extract_profile(load(args.source), pod=args.pod)
+        doc = load(args.source)
+        profile = extract_profile(doc, pod=args.pod)
+        previous = doc.get("previous") if isinstance(doc, dict) else None
+        if args.baseline:
+            previous = extract_profile(load(args.baseline))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -177,9 +217,11 @@ def main(argv: list[str] | None = None) -> int:
             "attribution": attribution_rows(profile),
             "phases": phase_rows(profile),
             "summary": record_summary(profile),
+            **({"host_sync_delta": host_sync_delta(profile, previous)}
+               if previous else {}),
         }))
     else:
-        print(render_report(profile))
+        print(render_report(profile, previous=previous))
     return 0
 
 
