@@ -49,12 +49,12 @@ metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
 top:             ## one-shot lig-top render of a running gateway's /debug/usage
 	python tools/lig_top.py --once --url $${LIG_URL:-http://localhost:8081}
 
-usage-check:     ## invariant lint + typecheck + sanitized native builds + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + decode levers + concurrency harness + docs currency
+usage-check:     ## invariant lint + typecheck + sanitized native builds + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + decode levers + concurrency harness + KV economy + docs currency
 	$(MAKE) lint
 	$(MAKE) typecheck
 	$(MAKE) native-asan
 	$(MAKE) native-tsan
-	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_decode_levers.py tests/test_sim.py tests/test_metrics_docs.py tests/test_lint.py tests/test_concurrency.py -q
+	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_decode_levers.py tests/test_kv_ledger.py tests/test_kvobs.py tests/test_sim.py tests/test_metrics_docs.py tests/test_lint.py tests/test_concurrency.py -q
 	python tools/chaos.py --seed 0 --scenario noisy_neighbor
 	python tools/chaos.py --seed 0 --scenario adapter_flood
 	python tools/chaos.py --seed 0 --scenario cold_start_storm

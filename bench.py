@@ -1014,6 +1014,75 @@ def run_profiler_microbench(emit_profile: bool = False,
     return out
 
 
+def run_kv_ledger_microbench() -> dict:
+    """KV block-lifecycle ledger overhead A/B (KV-economy PR acceptance
+    bar: ``kv_ledger_ratio`` < 1.05 — charging every alloc/reuse/release
+    plus the per-scrape state recount costs < 5% of paged-engine wall).
+
+    Two tiny paged-KV CPU engines run the same shared-prefix workload
+    (the reuse path is the hottest ledger charge site), ledger ON (the
+    default) vs ``kv_ledger=False``; interleaved rounds, MIN per side
+    (the step-profiler A/B precedent).  Each round also scrapes
+    ``metrics_snapshot()`` once per request batch, so the ledger's
+    snapshot/render cost is inside the measured wall, as in production.
+    """
+    from llm_instance_gateway_tpu.models import transformer
+    from llm_instance_gateway_tpu.models.configs import LLAMA3_8B
+    from llm_instance_gateway_tpu.server.engine import (
+        Engine, EngineConfig, Request, SamplingParams,
+    )
+
+    cfg = dataclasses.replace(
+        LLAMA3_8B, name="kvledger-cpu", vocab_size=512, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, head_dim=32,
+        max_seq_len=256,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+    ecfg = dict(decode_slots=4, max_seq_len=256,
+                prefill_buckets=(32, 64), paged_kv_block=8,
+                prefix_cache=True)
+    rng = np.random.RandomState(0)
+    shared = list(rng.randint(1, 500, size=16))  # two full shared blocks
+
+    def engine(**kw):
+        e = Engine(cfg, params, EngineConfig(**ecfg, **kw), eos_id=None,
+                   dtype=jnp.float32)
+        e.start()
+        return e
+
+    def wall(e) -> float:
+        rs = [Request(
+            prompt_tokens=shared + list(rng.randint(1, 500, size=8)),
+            max_new_tokens=16,
+            sampling=SamplingParams(temperature=0.0)) for _ in range(4)]
+        t0 = time.perf_counter()
+        for r in rs:
+            e.submit(r)
+        for r in rs:
+            if not r.done.wait(300):
+                raise RuntimeError("kv ledger A/B request timed out")
+        e.metrics_snapshot()  # the scrape rides the measured wall
+        return time.perf_counter() - t0
+
+    on_engine = engine()
+    off_engine = engine(kv_ledger=False)
+    try:
+        wall(on_engine), wall(off_engine)  # warmup pair
+        on_best = off_best = float("inf")
+        for _ in range(3):
+            off_best = min(off_best, wall(off_engine))
+            on_best = min(on_best, wall(on_engine))
+        return {
+            "kv_ledger_on_s": round(on_best, 4),
+            "kv_ledger_off_s": round(off_best, 4),
+            "kv_ledger_ratio": round(on_best / off_best, 4),
+        }
+    finally:
+        on_engine.stop()
+        off_engine.stop()
+
+
 def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
                                n_models: int = 1000,
                                batch: int = 64) -> dict:
@@ -1604,6 +1673,13 @@ if __name__ == "__main__":
             results.update(run_witness_microbench())
         except Exception as e:
             results["witness_error"] = str(e)[:200]
+        try:
+            # KV ledger overhead A/B (KV-economy PR): the <5%
+            # kv_ledger_ratio bound rides every emission so the ledger
+            # can stay on by default.
+            results.update(run_kv_ledger_microbench())
+        except Exception as e:
+            results["kv_ledger_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

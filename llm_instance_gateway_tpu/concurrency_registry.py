@@ -196,6 +196,26 @@ CLASSES = (
                         writers=("note_pick",), domain=DATA_PATH),
         )),
     SharedClass(
+        f"{PKG}/gateway/kvobs.py", "KvObsRollup", OBS_TICK,
+        fields=(
+            SharedField("_remote_tables", SWAP_PUBLISHED,
+                        writers=("set_remote_tables",), domain=GOSSIP,
+                        note="peer-gateway residency overlay; tick() reads "
+                             "it lock-free before joining, so writers swap "
+                             "the whole dict"),
+            SharedField("_pods", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_dup_rows", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_dup_totals", LOCK_GUARDED, writers=("tick",)),
+            SharedField("_dup_prefixes", LOCK_GUARDED, writers=("tick",)),
+            SharedField("last_tick", MONOTONIC, writers=("tick",),
+                        note="maybe_tick reads it lock-free (float "
+                             "rebind)"),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+        ),
+        note="EMA/delta tables (_prev_*, _*_rate) mutate in place under "
+             "the lock; the kv_duplication journal emit runs after "
+             "release (no nested acquisition)"),
+    SharedClass(
         f"{PKG}/gateway/fairness.py", "FairnessPolicy", OBS_TICK,
         fields=(
             SharedField("_noisy_pods_cache", SWAP_PUBLISHED,
@@ -375,6 +395,27 @@ CLASSES = (
                         writers=("note_padding",)),
         )),
     SharedClass(
+        f"{PKG}/server/kv_ledger.py", "KvLedger", ENGINE_STEP,
+        fields=(
+            SharedField("_states", LOCK_GUARDED, writers=("sync_states",),
+                        note="recounted whole from allocator ground truth "
+                             "per sync; snapshot() copies under the lock"),
+            SharedField("_parked_tokens", LOCK_GUARDED,
+                        writers=("sync_states",)),
+            SharedField("_free_view", SWAP_PUBLISHED,
+                        writers=("sync_states",),
+                        note="immutable tuple of the free list, swapped "
+                             "whole; the scrape-rate fragmentation "
+                             "histogram reads it without re-walking the "
+                             "allocator"),
+            SharedField("_syncs", MONOTONIC, writers=("sync_states",)),
+            SharedField("prefix_table_evictions", MONOTONIC,
+                        writers=("_touch",)),
+        ),
+        note="event counters / prefix LRU / ring mutate in place under "
+             "the lock (the GatewayMetrics shape); every note_* is "
+             "engine-thread, snapshot() is the scrape thread"),
+    SharedClass(
         f"{PKG}/server/lora_manager.py", "LoRAManager", ENGINE_STEP,
         lock_attrs=("_lock", "_mutate_lock"),
         fields=(
@@ -425,6 +466,10 @@ CLASSES = (
             SharedField("_tables_dirty", OWNER_PRIVATE,
                         writers=("_paged_ensure", "_paged_free_row",
                                  "_prefix_match_and_map", "_sync_tables")),
+            SharedField("_kv_evicts_pending", OWNER_PRIVATE,
+                        writers=("_paged_alloc_block", "_kv_ledger_sync"),
+                        note="eviction tally drained into ONE aggregated "
+                             "kv_evict journal event per ledger sync"),
             SharedField("_dev_counts", OWNER_PRIVATE,
                         writers=("_count_first_token", "_counts",
                                  "_dispatch_block", "_do_decode_step",
@@ -502,6 +547,7 @@ BINDINGS = {
     "resilience": "ResiliencePlane",
     "health_advisor": "ResiliencePlane",
     "usage": "UsageRollup",
+    "kvobs": "KvObsRollup",
     "fairness": "FairnessPolicy",
     "usage_advisor": "FairnessPolicy",
     "placement": "PlacementPlanner",
@@ -513,6 +559,7 @@ BINDINGS = {
     "prefix_index": "PrefixIndex",
     "admission": "AdmissionController",
     "tracker": "UsageTracker",
+    "kv_ledger": "KvLedger",
     "profiler": "StepProfiler",
     "lora": "LoRAManager",
     "engine": "Engine",

@@ -49,6 +49,8 @@ HEDGE = "hedge"                         # TTFT hedge fired (and its outcome)
 POLICY_ESCAPE = "policy_escape"         # avoid-policy last-resort pick
 CLIENT_DISCONNECT = "client_disconnect"  # client dropped a live stream
 KV_RELEASE = "kv_release"               # abandoned handoff KV released
+KV_EVICT = "kv_evict"                   # cached prefix blocks evicted LRU
+KV_DUPLICATION = "kv_duplication"       # prefix became fleet-duplicated (kvobs)
 FAULT_INJECT = "fault_inject"           # chaos harness applied a fault
 NOISY_NEIGHBOR = "noisy_neighbor"       # adapter usage flag changed (usage.py)
 QUOTA_THROTTLE = "quota_throttle"       # tenant over quota (fairness.py)

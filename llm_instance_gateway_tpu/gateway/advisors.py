@@ -24,6 +24,7 @@ from __future__ import annotations
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
+from llm_instance_gateway_tpu.gateway import kvobs as kvobs_mod
 from llm_instance_gateway_tpu.gateway import placement as placement_mod
 from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import usage as usage_mod
@@ -57,6 +58,10 @@ class AdvisorStack:
         self.usage = usage_mod.UsageRollup(
             provider, metrics=metrics, cfg=usage_cfg, journal=self.journal,
             request_filter=request_filter)
+        # KV economy rollup (gateway/kvobs.py): per-pod reuse efficiency /
+        # parked share + the fleet prefix duplication index over the same
+        # provider scrape.  Purely observational — no scheduler seam.
+        self.kvobs = kvobs_mod.KvObsRollup(provider, journal=self.journal)
         # Fairness config precedence, per FIELD: explicit CLI flags (a
         # dict of overrides from bootstrap.fairness_from_args — pinned,
         # re-applied on every hot reload) > THIS pool document's
@@ -107,6 +112,7 @@ class AdvisorStack:
         then the planes that read them (fairness quotas, placement)."""
         self.resilience.tick()
         self.usage.tick()
+        self.kvobs.tick()
         self.fairness.tick()
         self.placement.tick()
 
@@ -119,8 +125,8 @@ class AdvisorStack:
         fairness + placement).  Multi-pool fronts merge the per-stack
         blocks through ``merge_exposition_blocks``."""
         return (self.health.render() + self.resilience.render()
-                + self.usage.render() + self.fairness.render()
-                + self.placement.render())
+                + self.usage.render() + self.kvobs.render()
+                + self.fairness.render() + self.placement.render())
 
 
 def merge_exposition_blocks(blocks: list[list[str]]) -> list[str]:

@@ -286,20 +286,22 @@ def fleet_slo(payloads: dict[str, dict]) -> dict:
     return {"models": models, "replicas": sorted(payloads)}
 
 
-def collect_pod_profiles(pods: list[tuple[str, str]],
-                         timeout_s: float = 2.0) -> dict:
-    """Best-effort ``/debug/profile`` fetch from pool pods — the
-    black-box dump's profiler section (runs in the dump's executor
-    thread, never on the event loop).  Fetches run CONCURRENTLY so a
-    breach dump on a pool full of black-holed pods (exactly when dumps
-    fire) is delayed by ~one timeout, not one per wedged pod; failures
-    become error markers."""
+def collect_pod_payloads(pods: list[tuple[str, str]],
+                         path: str = "/debug/profile",
+                         timeout_s: float = 2.0,
+                         thread_name: str = "blackbox-fetch") -> dict:
+    """Best-effort JSON fetch of one debug ``path`` from every pool pod —
+    the black-box dump's profiler and KV-economy sections (runs in the
+    dump's executor thread, never on the event loop).  Fetches run
+    CONCURRENTLY so a breach dump on a pool full of black-holed pods
+    (exactly when dumps fire) is delayed by ~one timeout, not one per
+    wedged pod; failures become error markers."""
     import concurrent.futures as futures
     import json as json_mod
     import urllib.request
 
     def fetch(address: str) -> dict:
-        with urllib.request.urlopen(f"http://{address}/debug/profile",
+        with urllib.request.urlopen(f"http://{address}{path}",
                                     timeout=timeout_s) as resp:
             return json_mod.loads(resp.read().decode())
 
@@ -311,7 +313,7 @@ def collect_pod_profiles(pods: list[tuple[str, str]],
     # meanwhile — the dump must pay at most the deadline, never a
     # per-wedged-pod wait.
     ex = futures.ThreadPoolExecutor(max_workers=min(16, len(pods)),
-                                    thread_name_prefix="blackbox-profile")
+                                    thread_name_prefix=thread_name)
     futs = {ex.submit(fetch, address): name for name, address in pods}
     try:
         for fut in futures.as_completed(futs, timeout=timeout_s * 4):
@@ -332,6 +334,14 @@ def collect_pod_profiles(pods: list[tuple[str, str]],
     for name, _address in pods:
         out.setdefault(name, {"error": "fetch did not complete"})
     return out
+
+
+def collect_pod_profiles(pods: list[tuple[str, str]],
+                         timeout_s: float = 2.0) -> dict:
+    """Back-compat alias: the profiler-section fetch predates the
+    path-parameterized ``collect_pod_payloads``."""
+    return collect_pod_payloads(pods, "/debug/profile", timeout_s,
+                                thread_name="blackbox-profile")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,329 @@
+"""Fleet KV economy rollup: per-pod reuse efficiency and the cross-replica
+prefix duplication index over the replicas' ``tpu:kv_*`` ledger families
+(server/kv_ledger.py).
+
+The engine side keeps a block-lifecycle ledger — per-state block counts
+tiling the pool budget, a per-prefix reuse heatmap keyed by the
+content-addressed prefix id, fragmentation/headroom histograms.  This
+module answers the FLEET questions those per-pod tables can't:
+
+- **Reuse efficiency per pod**: cumulative prompt tokens served from the
+  prefix cache over total prompt tokens the pod saw
+  (``reused / (reused + prefilled)``) — how much prefill compute the
+  cache is actually buying on each replica, plus an EMA tokens/s rate of
+  the savings.
+- **Parked-KV share per pod**: the fraction of the pod's KV budget held
+  by prefilled-but-unslotted handoff imports (``parked`` state /
+  ``kv_blocks_total``) — capacity that serves nobody until a decode slot
+  frees.
+- **Fleet duplication index**: the per-pod ``kv_prefix_resident_blocks``
+  tables JOIN on the prefix id (the hash chain is content-addressed and
+  adapter-seeded, so one shared system prompt hashes identically on every
+  replica): a prefix resident on ``k >= 2`` replicas carries
+  ``sum(blocks) - max(blocks)`` duplicated blocks — HBM spent caching the
+  same tokens twice — and its fleet-wide reuse traffic times
+  ``(k - 1) / k`` is the tokens/s a single shared copy could serve
+  (the dedup headroom a KV-affinity router or shared KV store would
+  recover).  A prefix entering the duplicated set journals a
+  ``kv_duplication`` event.
+
+Mechanics mirror ``gateway/usage.py``: one ``tick()`` per observability
+cadence (lazily from ``/debug/kv``), cumulative-counter deltas EMA-smoothed
+into rates, state dropped for pods/prefixes that leave the exposition.
+``set_remote_tables`` is the statebus/fleet seam: peer gateways' pod
+residency tables overlay the join (publish-by-swap) so N fronts sharing a
+fleet compute one duplication index.  ``tools/kv_report.py`` renders the
+heatmap + duplication table from ``/debug/kv``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import asdict, dataclass
+
+from llm_instance_gateway_tpu.lockwitness import witness_lock
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.tracing import escape_label
+
+
+@dataclass(frozen=True)
+class KvObsConfig:
+    # Weight of the newest tick's delta rate in the EMA (1.0 = raw).
+    ema_alpha: float = 0.6
+    # Residency on this many replicas makes a prefix "duplicated".
+    min_replicas: int = 2
+    # Exposition/debug cap on per-prefix rows (hottest first) — the
+    # prefix id is a label, so an unbounded table is unbounded
+    # cardinality.
+    top_prefixes: int = 32
+
+
+class KvObsRollup:
+    """Thread-safe fleet KV rollup; ``tick()`` runs on the proxy's
+    observability cadence (and lazily from ``/debug/kv``)."""
+
+    def __init__(self, provider, cfg: KvObsConfig | None = None,
+                 journal: "events_mod.EventJournal | None" = None,
+                 clock=time.time):
+        self.provider = provider
+        self.cfg = cfg or KvObsConfig()
+        self.journal = journal
+        self._clock = clock
+        self._lock = witness_lock("KvObsRollup._lock")
+        # Cumulative-counter memory for delta rates.
+        self._prev_pod_saved: dict[str, float] = {}     # pod -> reused toks
+        self._prev_prefix_saved: dict[str, float] = {}  # prefix -> saved
+        self._pod_rate: dict[str, float] = {}           # pod -> tok/s EMA
+        self._prefix_rate: dict[str, float] = {}        # prefix -> tok/s EMA
+        # Last tick's derived view (read by render/debug under the lock).
+        self._pods: dict[str, dict] = {}
+        self._dup_rows: list[dict] = []
+        self._dup_totals: dict[str, float] = {
+            "duplicated_prefixes": 0, "duplicated_blocks": 0,
+            "duplicated_tokens": 0, "dedup_tokens_saved_per_s": 0.0}
+        self._dup_prefixes: set[str] = set()
+        # Peer-gateway residency overlay ({source: {"blocks": {prefix:
+        # blocks}, "block_tokens": n}}) — swapped whole, never mutated,
+        # so a concurrent tick joins either the old or the new view.
+        self._remote_tables: dict[str, dict] = {}
+        self.last_tick = 0.0
+        self.ticks = 0
+
+    # -- rollup --------------------------------------------------------------
+    def maybe_tick(self, min_interval_s: float = 1.0) -> None:
+        """On-demand rollup with a floor between passes — rate EMAs
+        difference cumulative counters per PASS, so an unthrottled debug
+        poller must not collapse every window to its own poll period."""
+        if self._clock() - self.last_tick >= min_interval_s:
+            self.tick()
+
+    @staticmethod
+    def _pod_view(m) -> dict | None:
+        """One pod's ledger-derived row, or None for servers without the
+        ``tpu:kv_*`` families (foreign engines, ledger disabled)."""
+        total = int(getattr(m, "kv_blocks_total", 0) or 0)
+        states = dict(getattr(m, "kv_blocks", None) or {})
+        if total <= 0 and not states:
+            return None
+        parked = float(states.get("parked", 0))
+        free = float(states.get("free", 0))
+        reused = float(getattr(m, "prefix_reused_tokens", 0) or 0)
+        prefilled = sum(
+            v for (_model, _adapter, phase), v in
+            (getattr(m, "adapter_tokens", None) or {}).items()
+            if phase == "prefill")
+        denom = reused + prefilled
+        return {
+            "blocks_total": total,
+            "block_tokens": int(getattr(m, "kv_block_tokens", 0) or 0),
+            "states": states,
+            "usage": round(1.0 - free / total, 4) if total else 0.0,
+            "parked_share": round(parked / total, 4) if total else 0.0,
+            "reused_tokens": int(reused),
+            "prefill_tokens": int(prefilled),
+            "reuse_efficiency": round(reused / denom, 4) if denom else 0.0,
+            "resident": {p: int(b) for p, b in
+                         (getattr(m, "kv_prefix_resident_blocks", None)
+                          or {}).items() if int(b) > 0},
+            "hits": dict(getattr(m, "kv_prefix_hits", None) or {}),
+            "saved": dict(getattr(m, "kv_prefix_tokens_saved", None) or {}),
+        }
+
+    def tick(self, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        pods: dict[str, dict] = {}
+        for pm in self.provider.all_pod_metrics():
+            view = self._pod_view(pm.metrics)
+            if view is not None:
+                pods[pm.pod.name] = view
+        remote = self._remote_tables  # swap-published; read once
+        cfg = self.cfg
+        entered: list[tuple[str, int, int]] = []
+        with self._lock:
+            dt = now - self.last_tick if self.ticks else 0.0
+            self.last_tick = now
+            self.ticks += 1
+            a = cfg.ema_alpha
+            # Per-pod savings rate (tokens/s of prefill the cache absorbed).
+            for name, view in pods.items():
+                cur = float(view["reused_tokens"])
+                prev = self._prev_pod_saved.get(name)
+                self._prev_pod_saved[name] = cur
+                if prev is not None and dt > 0:
+                    rate = max(0.0, cur - prev) / dt
+                    self._pod_rate[name] = (
+                        a * rate + (1 - a) * self._pod_rate.get(name, 0.0))
+                view["saved_tokens_per_s"] = round(
+                    self._pod_rate.get(name, 0.0), 2)
+            for table in (self._prev_pod_saved, self._pod_rate):
+                for gone in [n for n in table if n not in pods]:
+                    del table[gone]
+            # The duplication join: local pods + the peer overlay.  A peer
+            # front SHARING our pods ships the same pod names — local
+            # wins, the overlay only adds pods we don't scrape ourselves.
+            residency: dict[str, dict[str, int]] = {
+                name: view["resident"] for name, view in pods.items()}
+            block_tokens = max(
+                [v["block_tokens"] for v in pods.values()] or [0])
+            for source, tbl in remote.items():
+                if source in residency or not isinstance(tbl, dict):
+                    continue
+                blocks = {str(p): int(b)
+                          for p, b in (tbl.get("blocks") or {}).items()
+                          if int(b) > 0}
+                if blocks:
+                    residency[source] = blocks
+                    block_tokens = max(
+                        block_tokens, int(tbl.get("block_tokens") or 0))
+            by_prefix: dict[str, dict[str, int]] = {}
+            for pod_name, blocks in residency.items():
+                for prefix, n in blocks.items():
+                    by_prefix.setdefault(prefix, {})[pod_name] = n
+            # Fleet-wide per-prefix savings rate (cumulative across pods).
+            fleet_saved: dict[str, float] = {}
+            for view in pods.values():
+                for prefix, v in view["saved"].items():
+                    fleet_saved[prefix] = fleet_saved.get(prefix, 0.0) + v
+            for prefix, cur in fleet_saved.items():
+                prev = self._prev_prefix_saved.get(prefix)
+                self._prev_prefix_saved[prefix] = cur
+                if prev is not None and dt > 0:
+                    rate = max(0.0, cur - prev) / dt
+                    self._prefix_rate[prefix] = (
+                        a * rate + (1 - a) * self._prefix_rate.get(
+                            prefix, 0.0))
+            live = set(fleet_saved) | set(by_prefix)
+            for table in (self._prev_prefix_saved, self._prefix_rate):
+                for prefix in [p for p in table if p not in live]:
+                    del table[prefix]
+            # Duplicated rows: k replicas hold the prefix; every copy
+            # past the first is HBM spent re-caching the same tokens.
+            rows = []
+            dup_now: set[str] = set()
+            for prefix, holders in by_prefix.items():
+                k = len(holders)
+                if k < cfg.min_replicas:
+                    continue
+                dup_now.add(prefix)
+                dup_blocks = sum(holders.values()) - max(holders.values())
+                rate = self._prefix_rate.get(prefix, 0.0)
+                rows.append({
+                    "prefix": prefix,
+                    "replicas": k,
+                    "blocks": {p: holders[p] for p in sorted(holders)},
+                    "duplicated_blocks": dup_blocks,
+                    "duplicated_tokens": dup_blocks * block_tokens,
+                    "hits": int(sum(v["hits"].get(prefix, 0)
+                                    for v in pods.values())),
+                    "tokens_saved": int(fleet_saved.get(prefix, 0)),
+                    # The reuse traffic a single shared copy could serve:
+                    # (k-1)/k of the fleet hit rate lands on a duplicate.
+                    "dedup_tokens_saved_per_s": round(
+                        rate * (k - 1) / k, 2),
+                })
+            rows.sort(key=lambda r: (-r["duplicated_blocks"],
+                                     -r["tokens_saved"], r["prefix"]))
+            entered = [(r["prefix"], r["replicas"], r["duplicated_blocks"])
+                       for r in rows if r["prefix"] not in self._dup_prefixes]
+            self._dup_prefixes = dup_now
+            self._pods = pods
+            self._dup_rows = rows[:cfg.top_prefixes]
+            self._dup_totals = {
+                "duplicated_prefixes": len(rows),
+                "duplicated_blocks": sum(r["duplicated_blocks"]
+                                         for r in rows),
+                "duplicated_tokens": sum(r["duplicated_tokens"]
+                                         for r in rows),
+                "dedup_tokens_saved_per_s": round(
+                    sum(r["dedup_tokens_saved_per_s"] for r in rows), 2),
+            }
+        for prefix, replicas, blocks in entered:
+            if self.journal is not None:
+                self.journal.emit(events_mod.KV_DUPLICATION, prefix=prefix,
+                                  replicas=replicas, blocks=blocks)
+
+    # -- statebus / fleet seam ----------------------------------------------
+    def set_remote_tables(self, tables: dict[str, dict]) -> None:
+        """Replace the peer-derived residency overlay (``{source pod:
+        {"blocks": {prefix: blocks}, "block_tokens": n}}``; empty = join
+        local pods only).  Swapped whole so a concurrent tick reads a
+        consistent view."""
+        with self._lock:
+            self._remote_tables = dict(tables)
+
+    def local_tables(self) -> dict[str, dict]:
+        """This gateway's locally-scraped residency tables in the overlay
+        shape — what a peer feeds its ``set_remote_tables``."""
+        with self._lock:
+            return {name: {"blocks": dict(view["resident"]),
+                           "block_tokens": view["block_tokens"]}
+                    for name, view in self._pods.items()}
+
+    # -- export ---------------------------------------------------------------
+    def render(self) -> list[str]:
+        """The ``gateway_kv_*`` families."""
+        with self._lock:
+            pods = {name: dict(view) for name, view in self._pods.items()}
+            rows = list(self._dup_rows)
+            totals = dict(self._dup_totals)
+        lines = []
+        if pods:
+            lines.append("# TYPE gateway_kv_reuse_efficiency gauge")
+            for name in sorted(pods):
+                lines.append('gateway_kv_reuse_efficiency{pod="%s"} %.4f'
+                             % (escape_label(name),
+                                pods[name]["reuse_efficiency"]))
+            lines.append("# TYPE gateway_kv_parked_share gauge")
+            for name in sorted(pods):
+                lines.append('gateway_kv_parked_share{pod="%s"} %.4f'
+                             % (escape_label(name),
+                                pods[name]["parked_share"]))
+            lines.append("# TYPE gateway_kv_saved_tokens_per_s gauge")
+            for name in sorted(pods):
+                lines.append('gateway_kv_saved_tokens_per_s{pod="%s"} %.2f'
+                             % (escape_label(name),
+                                pods[name]["saved_tokens_per_s"]))
+        lines += [
+            "# TYPE gateway_kv_duplicated_prefixes gauge",
+            "gateway_kv_duplicated_prefixes %d"
+            % totals["duplicated_prefixes"],
+            "# TYPE gateway_kv_duplicated_blocks gauge",
+            "gateway_kv_duplicated_blocks %d" % totals["duplicated_blocks"],
+            "# TYPE gateway_kv_dedup_tokens_saved_per_s gauge",
+            "gateway_kv_dedup_tokens_saved_per_s %.2f"
+            % totals["dedup_tokens_saved_per_s"],
+        ]
+        if rows:
+            lines.append("# TYPE gateway_kv_prefix_replicas gauge")
+            for r in rows:
+                lines.append('gateway_kv_prefix_replicas{prefix="%s"} %d'
+                             % (escape_label(r["prefix"]), r["replicas"]))
+        return lines
+
+    def debug_payload(self) -> dict:
+        """The gateway's ``/debug/kv`` JSON body (also what
+        ``tools/kv_report.py`` and the black-box dump embed)."""
+        with self._lock:
+            pods = {}
+            for name, view in sorted(self._pods.items()):
+                row = dict(view)
+                resident = row.pop("resident")
+                hits = row.pop("hits")
+                saved = row.pop("saved")
+                # The raw per-prefix tables fold into one table per pod;
+                # kv_report joins them, lig-top only needs the scalars.
+                row["prefixes"] = {
+                    p: {"blocks": int(resident.get(p, 0)),
+                        "hits": int(hits.get(p, 0)),
+                        "tokens_saved": int(saved.get(p, 0))}
+                    for p in sorted(set(resident) | set(hits) | set(saved))}
+                pods[name] = row
+            return {
+                "pods": pods,
+                "duplication": {**{k: (int(v) if isinstance(v, int) else v)
+                                   for k, v in self._dup_totals.items()},
+                                "prefixes": list(self._dup_rows)},
+                "ticks": self.ticks,
+                "last_tick": self.last_tick,
+                "config": asdict(self.cfg),
+            }

@@ -610,6 +610,7 @@ def run_load(
     total_models = num_fake_pods * num_models_per_pod
     latencies: list[float] = []
     session_pods: dict[int, set[str]] = {}
+    session_requests: dict[int, int] = {}
     two_stage_hits = 0
     trace_hits = 0  # responses carrying the echoed x-lig-trace-id
     # Weighted adapter draw: seeded, so a mix scenario replays exactly.
@@ -667,6 +668,7 @@ def run_load(
                            and DEFAULT_DECODE_POD_HEADER in keys):
             two_stage_hits += 1
         if sid is not None:
+            session_requests[sid] = session_requests.get(sid, 0) + 1
             target = keys.get(DEFAULT_TARGET_POD_HEADER)
             if target:
                 session_pods.setdefault(sid, set()).add(target)
@@ -862,6 +864,22 @@ def run_load(
         out["session_prefix_chars"] = session_prefix_chars
         # 1.0 = perfect stickiness; N = the session sprayed over N pods.
         out["distinct_pods_per_session_avg"] = round(sum(per) / len(per), 2)
+        # Estimated prefix-cache reuse from stickiness alone: a request can
+        # hit a pod-local prefix cache iff its pod already served this
+        # session once, so each distinct pod a session touched charges one
+        # compulsory miss.  This is the upper bound the routing achieves —
+        # the ledger's measured reuse_efficiency (/debug/kv) reads at or
+        # below it when engines evict.
+        total = sum(session_requests.values())
+        hits = sum(max(0, session_requests[sid] - len(pods))
+                   for sid, pods in session_pods.items())
+        out["est_prefix_reuse_rate"] = round(hits / max(1, total), 4)
+        # Token-weighted: only the shared prefix chars of each hitting
+        # prompt are actually reusable.
+        prompt_chars = session_prefix_chars + len(" q0")
+        out["est_reuse_efficiency"] = round(
+            (hits / max(1, total))
+            * (session_prefix_chars / prompt_chars), 4)
     return out
 
 

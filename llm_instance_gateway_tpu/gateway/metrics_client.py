@@ -85,6 +85,14 @@ ADAPTER_TOKENS_METRIC = "tpu:adapter_tokens_total"
 ADAPTER_KV_SECONDS_METRIC = "tpu:adapter_kv_block_seconds_total"
 IDLE_SLOT_SECONDS_METRIC = "tpu:idle_slot_seconds_total"
 PREFILL_PADDING_METRIC = "tpu:prefill_padding_tokens_total"
+# KV economy ledger families (server/kv_ledger.py; all optional).
+KV_BLOCKS_METRIC = "tpu:kv_blocks"
+KV_BLOCKS_TOTAL_METRIC = "tpu:kv_blocks_total"
+KV_BLOCK_TOKENS_METRIC = "tpu:kv_block_tokens"
+KV_BLOCK_EVENTS_METRIC = "tpu:kv_block_events_total"
+KV_PREFIX_HITS_METRIC = "tpu:kv_prefix_hits_total"
+KV_PREFIX_TOKENS_SAVED_METRIC = "tpu:kv_prefix_tokens_saved_total"
+KV_PREFIX_RESIDENT_METRIC = "tpu:kv_prefix_resident_blocks"
 
 
 class FetchError(Exception):
@@ -199,6 +207,47 @@ def families_to_metrics(
         s = prom_parse.latest_sample(families.get(fam, []))
         if s is not None:
             setter(updated, s.value)
+
+    # KV economy ledger (optional): state-labeled block gauges and the
+    # prefix-keyed reuse tables, rebuilt whole each scrape (a prefix
+    # evicted from the replica's bounded table must drop here too — the
+    # duplication index would otherwise count ghosts).
+    kv_blocks = {}
+    for s in families.get(KV_BLOCKS_METRIC, []):
+        state = s.labels.get("state", "")
+        if state:
+            kv_blocks[state] = int(s.value)
+    if kv_blocks:
+        updated.kv_blocks = kv_blocks
+    for name, setter in (
+        (KV_BLOCKS_TOTAL_METRIC,
+         lambda m, x: setattr(m, "kv_blocks_total", int(x))),
+        (KV_BLOCK_TOKENS_METRIC,
+         lambda m, x: setattr(m, "kv_block_tokens", int(x))),
+    ):
+        s = prom_parse.latest_sample(families.get(name, []))
+        if s is not None:
+            setter(updated, s.value)
+    events = {}
+    for s in families.get(KV_BLOCK_EVENTS_METRIC, []):
+        kind = s.labels.get("kind", "")
+        if kind:
+            events[kind] = s.value
+    if events:
+        updated.kv_block_events = events
+    for fam, attr in (
+        (KV_PREFIX_HITS_METRIC, "kv_prefix_hits"),
+        (KV_PREFIX_TOKENS_SAVED_METRIC, "kv_prefix_tokens_saved"),
+        (KV_PREFIX_RESIDENT_METRIC, "kv_prefix_resident_blocks"),
+    ):
+        samples = families.get(fam, [])
+        if samples:
+            table = {}
+            for s in samples:
+                prefix = s.labels.get("prefix", "")
+                if prefix:
+                    table[prefix] = s.value
+            setattr(updated, attr, table)
 
     # LoRA info: latest series by gauge-value timestamp (metrics.go:135-150 —
     # the reference compares the *gauge value*, which vLLM sets to a unix ts).

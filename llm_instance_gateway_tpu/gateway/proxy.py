@@ -29,6 +29,11 @@ Endpoints:
 - ``GET  /debug/usage`` — pool-wide capacity attribution: per-{model,
   adapter} consumption shares, noisy-neighbor scores/flags, pool-waste
   aggregates (gateway/usage.py; live console: ``tools/lig_top.py``).
+- ``GET  /debug/kv`` — the fleet KV economy view (gateway/kvobs.py):
+  per-pod reuse efficiency / parked-KV share over the replicas'
+  ``tpu:kv_*`` ledger families and the cross-replica prefix duplication
+  index ("prefix P resident on k replicas, N blocks duplicated");
+  rendered by ``tools/kv_report.py``.
 - ``GET  /debug/events`` — the flight recorder (events.py): admission
   rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
   transitions, noisy-neighbor flags; ``?since=<seq>`` for incremental
@@ -215,6 +220,7 @@ class GatewayProxy:
         self.health = stack.health
         self.resilience = stack.resilience
         self.usage = stack.usage
+        self.kvobs = stack.kvobs
         self.fairness = stack.fairness
         self.placement = stack.placement
         self._pod_stack_cache: dict[str, AdvisorStack] = {}
@@ -282,6 +288,7 @@ class GatewayProxy:
         app.router.add_get("/debug/slo", self.handle_debug_slo)
         app.router.add_get("/debug/health", self.handle_debug_health)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
+        app.router.add_get("/debug/kv", self.handle_debug_kv)
         app.router.add_get("/debug/placement", self.handle_debug_placement)
         app.router.add_get("/debug/statebus", self.handle_debug_statebus)
         app.router.add_get("/debug/fleet", self.handle_debug_fleet)
@@ -405,7 +412,19 @@ class GatewayProxy:
                 # Pod profiler snapshots: best-effort bounded fetches off
                 # the event loop (this runs in the executor) — a wedged
                 # pod costs one timeout, never the dump.
-                profiles = fleetobs.collect_pod_profiles(self._fleet_pods())
+                pods = self._fleet_pods()
+                profiles = fleetobs.collect_pod_payloads(
+                    pods, "/debug/profile", thread_name="blackbox-profile")
+                # KV economy at dump time: the gateway rollup (refreshed —
+                # the breach may predate the last observability tick) plus
+                # each pod's raw ledger snapshot; unreachable pods degrade
+                # to error markers, never a lost dump.
+                self.kvobs.maybe_tick(max(1.0, self.obs_tick_s))
+                kv_payload = {
+                    "gateway": self.kvobs.debug_payload(),
+                    "pods": fleetobs.collect_pod_payloads(
+                        pods, "/debug/kv", thread_name="blackbox-kv"),
+                }
                 path = slo_mod.write_blackbox(
                     self.blackbox_dir, reason, journal=self.journal,
                     tracer=self.tracer, metrics_text=self._render_metrics(),
@@ -413,7 +432,8 @@ class GatewayProxy:
                     health_payload=self.health.debug_payload(),
                     usage_payload=self.usage.debug_payload(),
                     statebus_payload=self.statebus.debug_payload(),
-                    profile_payload=profiles)
+                    profile_payload=profiles,
+                    kv_payload=kv_payload)
                 self._last_dump_t = time.time()
                 self.journal.emit(events_mod.BREACH_DUMP, model=model,
                                   objective=objective, path=path)
@@ -1393,6 +1413,24 @@ class GatewayProxy:
                 for name, stack in self.stacks.items()}
         return web.json_response(payload)
 
+    async def handle_debug_kv(self, request: web.Request) -> web.Response:
+        """The fleet KV economy view (gateway/kvobs.py): per-pod reuse
+        efficiency, parked-KV share, and the cross-replica prefix
+        duplication index joined over the pods' ``tpu:kv_prefix_*``
+        tables.  Floored at the configured cadence — the savings-rate
+        EMAs difference cumulative counters per rollup pass.  Multi-pool
+        fronts add a ``pools`` section next to the default pool's
+        top-level fields.  Rendered by ``tools/kv_report.py``; the
+        fast-burn black-box dump embeds the same payload."""
+        for stack in self.stacks.values():
+            stack.kvobs.maybe_tick(max(1.0, self.obs_tick_s))
+        payload = self.kvobs.debug_payload()
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: stack.kvobs.debug_payload()
+                for name, stack in self.stacks.items()}
+        return web.json_response(payload)
+
     async def handle_debug_placement(self, request: web.Request) -> web.Response:
         """The placement plane's state + this tick's decisions — the wire
         ``tools/lora_sidecar.py --planner-url`` polls.  Floored at the
@@ -1449,6 +1487,11 @@ class GatewayProxy:
                 payload = await self.fleet.collect(tmp, limit=limit)
         else:
             payload = await self.fleet.collect(session, limit=limit)
+        # The fleet KV economy rollup rides along so a peer (or
+        # tools/fleet_report.py) reads duplication context without a
+        # second pull; per-pod joins live at /debug/kv.
+        self.kvobs.maybe_tick(max(1.0, self.obs_tick_s))
+        payload["kv"] = self.kvobs.debug_payload()
         return web.json_response(payload)
 
     async def handle_statebus_exchange(

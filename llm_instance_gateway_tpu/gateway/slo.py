@@ -385,6 +385,7 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
                    usage_payload: dict | None = None,
                    statebus_payload: dict | None = None,
                    profile_payload: dict | None = None,
+                   kv_payload: dict | None = None,
                    clock=time.time) -> str:
     """Write the black-box dump for one breach; returns the file path.
 
@@ -419,6 +420,10 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
         # profiler snapshots (gateway/statebus.py, server/profiler.py).
         "statebus": statebus_payload,
         "profile": profile_payload,
+        # KV economy at dump time (gateway/kvobs.py + per-pod /debug/kv):
+        # was the pool burning because its KV budget was parked or
+        # duplicated?  ``tools/blackbox_report.py`` renders the section.
+        "kv": kv_payload,
         "metrics_text": metrics_text,
     }
     tmp = path + ".tmp"

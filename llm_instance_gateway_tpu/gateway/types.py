@@ -125,6 +125,19 @@ class Metrics:
     # with empty rows, and prompt tokens prefilled as bucket/ring padding.
     idle_slot_seconds: float = 0.0
     prefill_padding_tokens: int = 0
+    # KV economy ledger families (server/kv_ledger.py; all optional —
+    # absent on foreign servers and with the ledger off).  kv_blocks maps
+    # state -> blocks ("free"/"active"/"prefix_resident"/"parked", tiling
+    # kv_blocks_total); kv_block_events maps lifecycle kind -> cumulative
+    # count; the kv_prefix_* tables key on the content-addressed prefix
+    # id, the join key of the fleet duplication index (gateway/kvobs.py).
+    kv_blocks: dict = field(default_factory=dict)
+    kv_blocks_total: int = 0
+    kv_block_tokens: int = 0
+    kv_block_events: dict = field(default_factory=dict)
+    kv_prefix_hits: dict = field(default_factory=dict)
+    kv_prefix_tokens_saved: dict = field(default_factory=dict)
+    kv_prefix_resident_blocks: dict = field(default_factory=dict)
 
     def clone(self) -> "Metrics":
         m = dataclasses.replace(self)
@@ -134,6 +147,11 @@ class Metrics:
         m.adapter_step_seconds = dict(self.adapter_step_seconds)
         m.adapter_tokens = dict(self.adapter_tokens)
         m.adapter_kv_block_seconds = dict(self.adapter_kv_block_seconds)
+        m.kv_blocks = dict(self.kv_blocks)
+        m.kv_block_events = dict(self.kv_block_events)
+        m.kv_prefix_hits = dict(self.kv_prefix_hits)
+        m.kv_prefix_tokens_saved = dict(self.kv_prefix_tokens_saved)
+        m.kv_prefix_resident_blocks = dict(self.kv_prefix_resident_blocks)
         return m
 
     @property

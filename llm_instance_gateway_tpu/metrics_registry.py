@@ -194,6 +194,32 @@ GATEWAY_FAMILIES = (
     Family("gateway_fleet_collect_seconds", "histogram", (),
            "Wall time of one full fleet pull (all sources concurrent).",
            GATEWAY_SURFACE),
+    Family("gateway_kv_reuse_efficiency", "gauge", ("pod",),
+           "Per-pod prefix-cache reuse efficiency: reused prompt tokens / "
+           "(reused + prefilled) cumulative (gateway/kvobs.py over the "
+           "replicas' tpu:kv_* ledger families).", GATEWAY_SURFACE),
+    Family("gateway_kv_parked_share", "gauge", ("pod",),
+           "Fraction of the pod's KV block budget held by parked "
+           "(prefilled-but-unslotted) handoff KV.", GATEWAY_SURFACE),
+    Family("gateway_kv_saved_tokens_per_s", "gauge", ("pod",),
+           "EMA rate of prefill tokens the pod's prefix cache absorbed "
+           "(scrape-tick deltas of tpu:prefix_reused_tokens).",
+           GATEWAY_SURFACE),
+    Family("gateway_kv_duplicated_prefixes", "gauge", (),
+           "Prefixes resident on >= min_replicas pods at the last rollup "
+           "— the fleet duplication index's row count.", GATEWAY_SURFACE),
+    Family("gateway_kv_duplicated_blocks", "gauge", (),
+           "KV blocks caching a prefix some other replica also holds "
+           "(sum(holders) - max(holders) per duplicated prefix): HBM "
+           "spent caching the same tokens twice.", GATEWAY_SURFACE),
+    Family("gateway_kv_dedup_tokens_saved_per_s", "gauge", (),
+           "Reuse traffic (tokens/s) currently served by duplicate copies "
+           "— what a KV-affinity router or shared KV store could serve "
+           "from one copy.", GATEWAY_SURFACE),
+    Family("gateway_kv_prefix_replicas", "gauge", ("prefix",),
+           "Replica count holding each duplicated prefix (top rows by "
+           "duplicated blocks; prefix = content-addressed 16-hex id).",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),
@@ -312,6 +338,41 @@ SERVER_FAMILIES = (
            "Engine-thread gap between consecutive dispatches (kind=host "
            "= step-loop overhead the ROADMAP item-2 levers amortize; "
            "kind=idle = the gap contained a no-work wait).",
+           SERVER_SURFACE),
+    Family("tpu:kv_blocks_total", "gauge", (),
+           "KV block budget the ledger accounts: pool blocks + parked "
+           "block-equivalents (server/kv_ledger.py; paged mode with "
+           "EngineConfig.kv_ledger).", SERVER_SURFACE),
+    Family("tpu:kv_block_tokens", "gauge", (),
+           "Tokens per KV block (the ledger's block size).",
+           SERVER_SURFACE),
+    Family("tpu:kv_blocks", "gauge", ("state",),
+           "Block budget by state (free | active | prefix_resident | "
+           "parked); states tile the budget, so the sum equals "
+           "tpu:kv_blocks_total — the conservation invariant "
+           "tests/test_kv_ledger.py pins.", SERVER_SURFACE),
+    Family("tpu:kv_block_events_total", "counter", ("kind",),
+           "Block lifecycle events (alloc | evict | reuse_hit | "
+           "reuse_unwind | register | release | cache_park | park | "
+           "unpark | sweep).", SERVER_SURFACE),
+    Family("tpu:kv_prefix_hits_total", "counter", ("prefix",),
+           "Prefix-cache hits per content-addressed prefix id (16-hex of "
+           "the deepest chained block hash; identical across replicas for "
+           "the same prompt prefix — the fleet duplication join key).",
+           SERVER_SURFACE),
+    Family("tpu:kv_prefix_tokens_saved_total", "counter", ("prefix",),
+           "Prompt tokens served from cache per prefix (reuse unwinds "
+           "subtracted, so the sum tracks tpu:prefix_reused_tokens).",
+           SERVER_SURFACE),
+    Family("tpu:kv_prefix_resident_blocks", "gauge", ("prefix",),
+           "Cached chain depth (blocks) currently resident per prefix; "
+           "decays as LRU eviction consumes the chain.", SERVER_SURFACE),
+    Family("tpu:kv_free_run_blocks", "histogram", (),
+           "Lengths of maximal runs of consecutive free physical block "
+           "ids at the last sync — the fragmentation view (a pool can be "
+           "40% free and still lack contiguous headroom).", SERVER_SURFACE),
+    Family("tpu:kv_parked_share", "histogram", (),
+           "Parked share of the block budget sampled at each ledger sync.",
            SERVER_SURFACE),
     Family("tpu:events_total", "counter", ("kind",),
            "Replica-side flight-recorder events by kind (served by the "

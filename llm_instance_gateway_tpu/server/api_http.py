@@ -149,6 +149,7 @@ class ModelServer:
         app.router.add_get("/debug/events", self.handle_debug_events)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/profile", self.handle_debug_profile)
+        app.router.add_get("/debug/kv", self.handle_debug_kv)
         app.router.add_get("/health", self.handle_health)
         return app
 
@@ -1418,6 +1419,24 @@ class ModelServer:
                                   "role": self.engine.cfg.role,
                                   **profiler.snapshot()})
 
+    async def handle_debug_kv(self, request: web.Request) -> web.Response:
+        """The KV economy ledger's full payload (server/kv_ledger.py):
+        block-state accounting, per-prefix reuse heatmap, fragmentation
+        histograms, and the lifecycle event ring — what
+        ``tools/kv_report.py`` renders, the gateway's ``gateway/kvobs.py``
+        duplication index joins, and black-box dumps embed.  404 when the
+        ledger is off (or the engine runs the contiguous-lane cache,
+        which has no block economy)."""
+        ledger = getattr(self.engine, "kv_ledger", None)
+        if ledger is None:
+            return _err(404, "kv ledger is disabled "
+                             "(EngineConfig.kv_ledger=False or non-paged "
+                             "cache)")
+        self.engine._kv_ledger_sync()
+        return web.json_response({"model": self.model_name,
+                                  "role": self.engine.cfg.role,
+                                  **ledger.snapshot()})
+
     async def handle_health(self, request: web.Request) -> web.Response:
         if self.engine.draining:
             # Readiness flip: the EPP's health-probed membership (and a k8s
@@ -1494,6 +1513,10 @@ def main(argv=None) -> None:
                         help="disable the device-side stop-string automata "
                              "(rows then stop via the host oracle only — "
                              "the A/B for the decode-lever bench)")
+    parser.add_argument("--no-kv-ledger", action="store_true",
+                        help="disable the KV block-lifecycle ledger "
+                             "(tpu:kv_* families + /debug/kv; the A/B "
+                             "for the kv_ledger_ratio bench)")
     parser.add_argument("--stream-lanes", type=int, default=1,
                         help="concurrent chunk-stream lanes: how many "
                              "long prompts may stream into reserved cache "
@@ -1689,6 +1712,7 @@ def main(argv=None) -> None:
             paged_kv_block=args.paged_kv_block,
             paged_kv_blocks=args.paged_kv_blocks,
             prefix_cache=args.prefix_cache,
+            kv_ledger=not args.no_kv_ledger,
             role=args.role,
             handoff_ttl_s=args.handoff_ttl_s,
             speculative_k=args.speculative,

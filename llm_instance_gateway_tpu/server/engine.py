@@ -51,6 +51,7 @@ from llm_instance_gateway_tpu.server.sampling import (
     stop_hist_update,
     stop_suffix_hit,
 )
+from llm_instance_gateway_tpu.server.kv_ledger import KvLedger
 from llm_instance_gateway_tpu.server.profiler import StepProfiler
 from llm_instance_gateway_tpu.server.usage import UsageTracker, owner_key
 from llm_instance_gateway_tpu.tracing import LATENCY_BUCKETS, Histogram
@@ -231,6 +232,14 @@ class EngineConfig:
     # exists for the bench A/B (step_profile_ratio <= 1.05), not for
     # production use.
     step_profile: bool = True
+    # KV economy ledger (server/kv_ledger.py, paged mode only): per-state
+    # block accounting (free/active/parked/prefix-resident tiling the
+    # budget), a per-prefix reuse table, fragmentation/headroom
+    # histograms, and a bounded lifecycle event ring — exported as the
+    # tpu:kv_* families and served by /debug/kv.  Like the trackers
+    # above, the off switch exists for the bench A/B (kv_ledger_ratio
+    # < 1.05), not for production use.
+    kv_ledger: bool = True
     # Prefix caching (paged mode only): full prompt blocks are
     # content-addressed (chained hashes, vLLM-style) and retained with
     # refcounts after a request finishes; a later prompt sharing the prefix
@@ -726,6 +735,18 @@ class Engine:
         # tiles the engine thread's wall.
         self.profiler: StepProfiler | None = (
             StepProfiler() if self.cfg.step_profile else None)
+        # KV economy ledger (server/kv_ledger.py): block lifecycle,
+        # per-prefix reuse, fragmentation.  Own lock; charged at the
+        # allocator/prefix/park sites, state-recounted on the KV sync,
+        # snapshotted by the scrape thread.  Paged pool only — the
+        # contiguous-lane cache has no block economy to account.
+        self.kv_ledger: KvLedger | None = (
+            KvLedger(self._n_blocks, self._block)
+            if self.paged and self.cfg.kv_ledger else None)
+        # LRU evictions since the last KV sync (journaled as ONE
+        # aggregated kv_evict event per sync — eviction storms must not
+        # flood the flight recorder's bounded ring).
+        self._kv_evicts_pending = 0
 
         if self.paged:
             step_fn = paged_lib.decode_step_paged
@@ -1463,6 +1484,11 @@ class Engine:
             # families; the full per-dispatch ring rides /debug/profile.
             **({"profile": self.profiler.hist_state()}
                if self.profiler is not None else {}),
+            # KV economy ledger (server/kv_ledger.py) — the tpu:kv_*
+            # block-lifecycle families; the full payload (event ring,
+            # prefix heatmap) rides /debug/kv.  Re-synced here so a
+            # scrape between dispatches still sees current block states.
+            **(self._kv_ledger_snapshot_key()),
             **({"prefix_reused_tokens": self.prefix_reused_tokens}
                if self._prefix_enabled else {}),
             **({
@@ -1474,6 +1500,17 @@ class Engine:
                 if self.spec_cycles else 0.0,
             } if self._spec else {}),
         }
+
+    def _kv_ledger_snapshot_key(self) -> dict:
+        """``{"kv_ledger": snapshot}`` for ``metrics_snapshot`` (empty
+        when the ledger is off).  The recount reads the allocator's
+        host-side structures lock-free like the paged math above — the
+        same single-writer tolerance, and the ledger's own lock makes
+        the stored counts internally consistent."""
+        if self.kv_ledger is None:
+            return {}
+        self._kv_ledger_sync()
+        return {"kv_ledger": self.kv_ledger.snapshot()}
 
     def _residency_keys(self) -> dict:
         transitions, load_seconds = self.lora.residency_counters()
@@ -1558,12 +1595,19 @@ class Engine:
         """One free physical block, evicting the LRU zero-ref cached block
         if the free list is dry.  Raises ``PagedPoolExhausted``."""
         if self._free_blocks:
-            return self._free_blocks.pop()
+            blk = self._free_blocks.pop()
+            if self.kv_ledger is not None:
+                self.kv_ledger.note_alloc()
+            return blk
         if self._prefix_enabled and self._evictable:
             blk, h = self._evictable.popitem(last=False)  # LRU
             self._prefix_table.pop(h, None)
             self._block_hash.pop(blk, None)
             self._block_refs.pop(blk, None)
+            if self.kv_ledger is not None:
+                self.kv_ledger.note_evict(h.hex()[:16])
+                self.kv_ledger.note_alloc()
+                self._kv_evicts_pending += 1
             return blk
         raise PagedPoolExhausted(
             f"kv pool exhausted: {self._n_blocks} blocks of "
@@ -1587,16 +1631,21 @@ class Engine:
     def _paged_free_row(self, row: int) -> None:
         blocks = self._row_blocks[row]
         if blocks:
+            freed = cached = 0
             for blk in blocks:
                 h = self._block_hash.get(blk)
                 if h is None:
                     self._free_blocks.append(blk)
+                    freed += 1
                 else:
                     # Cached prefix block: drop this row's reference; at
                     # zero it parks in the evictable LRU (content kept).
                     self._block_refs[blk] -= 1
                     if self._block_refs[blk] == 0:
                         self._evictable[blk] = h  # fresh key -> MRU end
+                        cached += 1
+            if self.kv_ledger is not None:
+                self.kv_ledger.note_release(freed, cached)
             self._row_blocks[row] = []
             self._tables_host[row, :] = paged_lib.TRASH_BLOCK
             self._tables_dirty = True
@@ -1679,6 +1728,13 @@ class Engine:
             self._tables_dirty = True
         reused = len(blocks) * self._block
         self.prefix_reused_tokens += reused
+        if reused and self.kv_ledger is not None:
+            # Prefix identity = hex of the DEEPEST matched chain hash:
+            # content-addressed and adapter-seeded, so the same shared
+            # prompt yields the same id on every replica — the join key
+            # for the gateway's fleet duplication index.
+            self.kv_ledger.note_reuse_hit(
+                hashes[len(blocks) - 1].hex()[:16], len(blocks), reused)
         return reused
 
     def _prefix_register_row(self, row: int, prompt: list[int],
@@ -1701,6 +1757,19 @@ class Engine:
             self._block_hash[blk] = h
             self._prefix_table[h] = blk
             self._block_refs[blk] = 1
+        if hashes and self.kv_ledger is not None:
+            self.kv_ledger.note_register(hashes[-1].hex()[:16], len(hashes))
+
+    def _kv_note_reuse_unwind(self, req: Request, reused: int) -> None:
+        """Ledger mirror of a reuse unwind — called exactly where
+        ``prefix_reused_tokens`` is decremented, so the ledger's
+        tokens-saved attribution tracks the engine counter."""
+        if self.kv_ledger is None or not reused:
+            return
+        blocks = reused // self._block
+        hashes = self._prefix_hashes_for(req)
+        self.kv_ledger.note_reuse_unwind(
+            hashes[blocks - 1].hex()[:16], blocks, reused)
 
     def _sync_tables(self) -> None:
         """Push host-side table changes to the device copy in the cache.
@@ -1919,6 +1988,12 @@ class Engine:
                        and now - w.t_parked > ttl)
             if w.request.cancelled.is_set() or expired:
                 self._parked_kv_tokens -= w.k.shape[2]
+                if self.kv_ledger is not None:
+                    self.kv_ledger.note_sweep(
+                        int(w.k.shape[2]),
+                        "ttl" if expired
+                        and not w.request.cancelled.is_set()
+                        else "cancelled")
                 if expired and not w.request.cancelled.is_set():
                     logger.warning(
                         "handoff %s parked %.1fs > ttl %.1fs; releasing",
@@ -1943,6 +2018,8 @@ class Engine:
             if w.request.cancelled.is_set():
                 self.decode_wait.popleft()
                 self._parked_kv_tokens -= w.k.shape[2]
+                if self.kv_ledger is not None:
+                    self.kv_ledger.note_sweep(int(w.k.shape[2]), "cancelled")
                 self._usage_sync_kv()
                 self._finish(w.request, "cancelled")
                 did = True
@@ -1954,6 +2031,8 @@ class Engine:
                 break  # pool backpressure: KV stays parked off-cache
             self.decode_wait.popleft()
             self._parked_kv_tokens -= w.k.shape[2]
+            if self.kv_ledger is not None:
+                self.kv_ledger.note_unpark(int(w.k.shape[2]))
             # Mid-admission guard: between the pop (decode_queue -> 0) and
             # _register_slot (running -> 1) the insert runs a device op —
             # without this count a drain()/scrape polling that window sees
@@ -2071,6 +2150,8 @@ class Engine:
                 t_parked=time.time())
             self.decode_wait.append(w)
             self._parked_kv_tokens += w.k.shape[2]
+            if self.kv_ledger is not None:
+                self.kv_ledger.note_park(int(w.k.shape[2]), "handoff")
             self._usage_sync_kv()
         except Exception as e:  # engine must survive a poison handoff
             logger.exception("attach failed for %s", req.request_id)
@@ -2553,6 +2634,7 @@ class Engine:
             # to the full-prompt program, which can evict them.
             self._paged_free_row(slot_idx)
             self.prefix_reused_tokens -= reused  # nothing was reused
+            self._kv_note_reuse_unwind(req, reused)
             return None
         try:
             self._sync_tables()
@@ -2586,6 +2668,7 @@ class Engine:
             # (the caller's cleanup only fires once it knows slot_idx).
             self._paged_free_row(slot_idx)
             self.prefix_reused_tokens -= reused  # nothing was reused
+            self._kv_note_reuse_unwind(req, reused)
             raise
         return slot_idx, first_token, n, lora_slot, lp_info
 
@@ -2731,6 +2814,8 @@ class Engine:
         # outside the decode cache — count the padded rows so the routing
         # signal sees the pressure (metrics_snapshot).
         self._parked_kv_tokens += w.k.shape[2]
+        if self.kv_ledger is not None:
+            self.kv_ledger.note_park(int(w.k.shape[2]), "prefill_ahead")
         self._usage_sync_kv()
 
     def _do_prefill_ahead_group(self, reqs, pipelined: bool) -> None:
@@ -3165,12 +3250,35 @@ class Engine:
         if self.profiler is not None:
             self.profiler.note_padding(pad_tokens)
 
+    def _kv_ledger_sync(self) -> None:
+        """Recount the KV ledger's block states from allocator ground
+        truth (free list, DISTINCT blocks across row tables — a shared
+        prefix block mapped into several rows is one block — and the
+        evictable LRU).  Recounting rather than deriving is what makes
+        the ledger's conservation sum a leak detector.  Rides the same
+        sites as ``_usage_sync_kv``; ``metrics_snapshot`` also calls it
+        so a scrape is never staler than the last dispatch."""
+        led = self.kv_ledger
+        if led is None:
+            return
+        distinct: set[int] = set()
+        for blocks in self._row_blocks:
+            distinct.update(blocks)
+        led.sync_states(self._free_blocks, len(distinct),
+                        len(self._evictable), self._parked_kv_tokens)
+        if self._kv_evicts_pending:
+            n, self._kv_evicts_pending = self._kv_evicts_pending, 0
+            if self.event_sink is not None:
+                self.event_sink("kv_evict", n=n)
+
     def _usage_sync_kv(self) -> None:
         """Refresh the attribution tracker's KV-holdings integral (engine
         thread): active slot rows at their current position, parked
         ``decode_wait`` KV at its padded size (the same HBM the
         ``kv_parked_tokens`` gauge counts), and the in-flight chunk
-        stream's filled prefix."""
+        stream's filled prefix.  The KV ledger's state recount rides the
+        same call sites (it has its own off switch)."""
+        self._kv_ledger_sync()
         if self.usage is None:
             return
         holdings: list[tuple[str | None, int]] = [

@@ -161,6 +161,15 @@ def render(snapshot: dict, extra: dict | None = None) -> str:
         from llm_instance_gateway_tpu.server.usage import render_usage
 
         lines += render_usage(usage, snapshot.get("model_name", ""))
+    kv_ledger = snapshot.get("kv_ledger")
+    if kv_ledger:
+        # KV economy ledger (server/kv_ledger.py): block-state
+        # accounting, per-prefix reuse heatmap, fragmentation/headroom
+        # histograms — the tpu:kv_blocks* / tpu:kv_prefix_* families
+        # (tools/kv_report.py renders the operator view from /debug/kv).
+        from llm_instance_gateway_tpu.server.kv_ledger import render_kv
+
+        lines += render_kv(kv_ledger)
     profile = snapshot.get("profile")
     if profile:
         # Step-timeline profiler (server/profiler.py): per-phase dispatch

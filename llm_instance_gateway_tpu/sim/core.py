@@ -157,6 +157,7 @@ class SimServer:
         host_cache_slots: int = 0,
         preload: "list[str] | None" = None,
         chunk_tokens: int = 0,
+        kv_block_tokens: int = 16,
     ):
         self.name = name
         self.pod = Pod(name=name, address=f"{name}:8000")
@@ -200,6 +201,17 @@ class SimServer:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_reused_tokens = 0
+        # KV economy twin (server/kv_ledger.py): the sim's token-denominated
+        # budget quantized to block-equivalents so ``kv_snapshot()`` emits
+        # the SAME snapshot shape the engine ledger exports — per-prefix
+        # heatmap rows keyed by the workload's shared prefix_id rendered as
+        # a 16-hex id (identical across replicas, the duplication join key).
+        self.kv_block_tokens = max(1, kv_block_tokens)
+        self.prefix_stats: dict[int, dict] = {}   # pid -> hits/saved/touch
+        self.prefix_registers = 0
+        self.prefix_evictions = 0
+        self.prefilled_tokens = 0  # cumulative prompt tokens computed
+        self._kv_syncs = 0
         # Chunk-stream lanes (engine PR 15): prompts beyond chunk_tokens
         # stream one chunk per iteration into up to latency.stream_lanes
         # concurrent lanes (fair round-robin), interleaved with decode —
@@ -208,12 +220,79 @@ class SimServer:
         self.streaming: list[dict] = []   # {"req": SimRequest, "done": int}
         self._lane_rr = 0
 
+    # -- KV economy twin ---------------------------------------------------
+    @staticmethod
+    def _prefix_label(pid: int) -> str:
+        """The workload's integer prefix_id as a 16-hex id — the same
+        width as the engine's content-addressed ids, and identical across
+        every sim replica serving the prefix (the duplication join key)."""
+        return "%016x" % (pid % (1 << 64))
+
+    def kv_snapshot(self) -> dict:
+        """The engine ledger's ``snapshot()`` shape from sim state
+        (server/kv_ledger.py contract; tests/test_sim.py pins key parity).
+        Token-denominated sim KV quantizes to ``kv_block_tokens``-sized
+        block-equivalents; cached prefixes sit outside the charged budget
+        exactly like the engine's zero-ref evictable blocks."""
+        from llm_instance_gateway_tpu.server.kv_ledger import (
+            FREE_RUN_BUCKETS, PARKED_SHARE_BUCKETS)
+        from llm_instance_gateway_tpu.tracing import Histogram
+
+        self._kv_syncs += 1
+        block = self.kv_block_tokens
+        pool = max(1, self.kv_capacity_tokens // block)
+        active = sum(-(-a.kv_tokens // block) for a in self.active
+                     if a.kv_tokens > 0)
+        resident = {pid: -(-tok // block)
+                    for pid, tok in self.cached_prefixes.items() if tok > 0}
+        free = max(0, pool - active - sum(resident.values()))
+        prefixes = []
+        for pid, blocks in resident.items():
+            stats = self.prefix_stats.get(pid) or {
+                "hits": 0, "tokens_saved": 0, "last_touch": 0.0}
+            prefixes.append({
+                "prefix": self._prefix_label(pid),
+                "hits": stats["hits"],
+                "tokens_saved": stats["tokens_saved"],
+                "blocks": blocks,
+                "age_s": 0.0,
+            })
+        prefixes.sort(key=lambda e: (-e["hits"], -e["tokens_saved"],
+                                     e["prefix"]))
+        free_runs = Histogram(FREE_RUN_BUCKETS)
+        if free:
+            # The sim has no physical block ids: its free space is one
+            # contiguous run (an upper bound on contiguity, stated here).
+            free_runs.observe(float(free))
+        parked_share = Histogram(PARKED_SHARE_BUCKETS)
+        parked_share.observe(0.0)
+        return {
+            "blocks_total": pool,
+            "pool_blocks": pool,
+            "block_tokens": block,
+            "states": {"free": free, "active": active,
+                       "prefix_resident": sum(resident.values()),
+                       "parked": 0},
+            "parked_tokens": 0,
+            "events": {"reuse_hit": self.prefix_hits,
+                       "register": self.prefix_registers,
+                       "evict": self.prefix_evictions},
+            "prefixes": prefixes,
+            "prefix_table_size": len(resident),
+            "prefix_table_evictions": self.prefix_evictions,
+            "free_runs": free_runs.state(),
+            "parked_share": parked_share.state(),
+            "ring": [],
+            "syncs": self._kv_syncs,
+        }
+
     # -- metrics the production scheduler consumes -------------------------
     def metrics(self) -> PodMetrics:
         used = sum(a.kv_tokens for a in self.active)
         tiers = {name: "slot" for name in self.resident_adapters}
         for name in self.host_cache:
             tiers.setdefault(name, "host")
+        kv = self.kv_snapshot()
         return PodMetrics(
             pod=self.pod,
             metrics=Metrics(
@@ -232,6 +311,23 @@ class SimServer:
                 kv_cache_usage_percent=used / self.kv_capacity_tokens,
                 kv_tokens_capacity=self.kv_capacity_tokens,
                 kv_tokens_free=self.kv_capacity_tokens - used,
+                # KV economy twin: the same fields metrics_client parses
+                # from a real pod's tpu:kv_* families, so the gateway's
+                # kvobs rollup (and KV_BASELINE generation) runs over sim
+                # fleets unchanged.
+                prefix_reused_tokens=self.prefix_reused_tokens,
+                adapter_tokens={("sim", "base", "prefill"):
+                                float(self.prefilled_tokens)},
+                kv_blocks=dict(kv["states"]),
+                kv_blocks_total=kv["blocks_total"],
+                kv_block_tokens=kv["block_tokens"],
+                kv_block_events=dict(kv["events"]),
+                kv_prefix_hits={e["prefix"]: e["hits"]
+                                for e in kv["prefixes"]},
+                kv_prefix_tokens_saved={e["prefix"]: e["tokens_saved"]
+                                        for e in kv["prefixes"]},
+                kv_prefix_resident_blocks={e["prefix"]: e["blocks"]
+                                           for e in kv["prefixes"]},
             ),
         )
 
@@ -356,6 +452,10 @@ class SimServer:
         if req is not None:
             prefill_tokens = req.prompt_tokens
             if req.prefix_id is not None:
+                stats = self.prefix_stats.setdefault(
+                    req.prefix_id,
+                    {"hits": 0, "tokens_saved": 0, "last_touch": now})
+                stats["last_touch"] = now
                 if req.prefix_id in self.cached_prefixes:
                     # Cache hit: only the suffix prefills (the prefix's KV
                     # blocks map into the row's table, zero compute).
@@ -363,12 +463,18 @@ class SimServer:
                         0, req.prompt_tokens - req.prefix_tokens)
                     self.prefix_hits += 1
                     self.prefix_reused_tokens += req.prefix_tokens
+                    stats["hits"] += 1
+                    stats["tokens_saved"] += req.prefix_tokens
                 else:
                     self.prefix_misses += 1
+                    self.prefix_registers += 1
                 self.cached_prefixes[req.prefix_id] = req.prefix_tokens
                 self.cached_prefixes.move_to_end(req.prefix_id)
                 while len(self.cached_prefixes) > self.prefix_cache_size:
-                    self.cached_prefixes.popitem(last=False)
+                    evicted, _tok = self.cached_prefixes.popitem(last=False)
+                    self.prefix_stats.pop(evicted, None)
+                    self.prefix_evictions += 1
+            self.prefilled_tokens += prefill_tokens
             duration = self.latency.prefill_s(prefill_tokens)
             if req.adapter:
                 self.resident_adapters[req.adapter] = (
@@ -400,6 +506,7 @@ class SimServer:
             chunk = min(self.chunk_tokens, r.prompt_tokens - lane["done"])
             duration += self.latency.prefill_s(chunk)
             lane["done"] += chunk
+            self.prefilled_tokens += chunk
             if lane["done"] >= r.prompt_tokens:
                 # Final chunk: the lane activates as a live decode slot
                 # and the first token is emitted (engine _stream_step).

@@ -112,6 +112,22 @@ def _steps_hist() -> dict:
     return h.state()
 
 
+def _kv_ledger_state() -> dict:
+    """A charged KV ledger (server/kv_ledger.py) with a hostile prefix id
+    so every tpu:kv_* family renders and round-trips."""
+    from llm_instance_gateway_tpu.server.kv_ledger import KvLedger
+
+    led = KvLedger(n_blocks=16, block_tokens=8)
+    led.note_alloc(n=4)
+    led.note_register(HOSTILE, blocks=2)
+    led.note_reuse_hit(HOSTILE, blocks=2, tokens=16)
+    led.note_release(freed=1, cached=2)
+    led.note_park(24, source="handoff")
+    led.sync_states([0, 1, 2, 7], active_blocks=8, prefix_resident=4,
+                    parked_tokens=24)
+    return led.snapshot()
+
+
 def server_snapshot() -> dict:
     from llm_instance_gateway_tpu.server import profiler as profiler_mod
     from llm_instance_gateway_tpu.server import usage as usage_mod
@@ -155,6 +171,9 @@ def server_snapshot() -> dict:
         "tier_transitions": {("disk", "slot"): 2, ("slot", "host"): 1},
         "adapter_load_seconds": {"host": [0.05, 1], "disk": [1.2, 2]},
         "prefix_reused_tokens": 77,
+        # KV economy ledger (server/kv_ledger.py): the tpu:kv_* block-
+        # lifecycle families with a hostile prefix label.
+        "kv_ledger": _kv_ledger_state(),
         # Decode fast-path observables (adaptive dispatch + stream lanes).
         "stream_lanes": 2,
         "stream_lanes_active": 1,
@@ -254,6 +273,17 @@ def test_server_render_contract():
     assert families["tpu:stream_lanes_active"][0].value == 1
     assert families["tpu:dispatch_steps_count"][0].value == 2
     assert families["tpu:dispatch_steps_sum"][0].value == 9
+    # KV economy ledger (server/kv_ledger.py): per-state blocks tile the
+    # budget and the hostile prefix id survives the label round-trip.
+    states = {s.labels["state"]: s.value for s in families["tpu:kv_blocks"]}
+    assert set(states) == {"free", "active", "prefix_resident", "parked"}
+    assert sum(states.values()) == families["tpu:kv_blocks_total"][0].value
+    assert families["tpu:kv_block_tokens"][0].value == 8
+    hit_prefixes = {s.labels["prefix"]
+                    for s in families["tpu:kv_prefix_hits_total"]}
+    assert HOSTILE in hit_prefixes
+    assert "tpu:kv_free_run_blocks_bucket" in families
+    assert "tpu:kv_parked_share_bucket" in families
 
 
 def test_proxy_metrics_endpoint_round_trips():

@@ -1,0 +1,349 @@
+"""Fleet KV economy rollup (gateway/kvobs.py) + the evidence tools.
+
+Covers the gateway layer of the KV observatory: per-pod reuse efficiency
+and parked-share derivation from the scraped ``tpu:kv_*`` families, the
+savings-rate EMA over cumulative counters, the cross-replica duplication
+join (sum - max blocks per prefix, the (k-1)/k dedup-servable rate, the
+``kv_duplication`` journal edge), the peer-gateway overlay seam, the
+``gateway_kv_*`` exposition contract with hostile labels, the proxy's
+``/debug/kv`` endpoint, and ``tools/kv_report.py`` — including the
+committed ``KV_BASELINE.json`` artifact's determinism and its >= 3x
+duplication factor.
+"""
+
+import json
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import kvobs as kvobs_mod
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+
+HOSTILE = 'evil"pod\nname\\x'
+HOSTILE_PREFIX = 'ff"00\\11'
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def pod_metrics(name, *, total=20, free=5, active=10, resident=4, parked=1,
+                reused=300, prefilled=700, prefixes=None):
+    prefixes = prefixes or {}
+    return PodMetrics(
+        pod=Pod(name, "127.0.0.1:1"),
+        metrics=Metrics(
+            kv_blocks_total=total, kv_block_tokens=16,
+            kv_blocks={"free": free, "active": active,
+                       "prefix_resident": resident, "parked": parked},
+            prefix_reused_tokens=reused,
+            adapter_tokens={("m", "base", "prefill"): float(prefilled),
+                            ("m", "base", "decode"): 9999.0},
+            kv_prefix_resident_blocks={p: b for p, (b, _h, _s)
+                                       in prefixes.items()},
+            kv_prefix_hits={p: h for p, (_b, h, _s) in prefixes.items()},
+            kv_prefix_tokens_saved={p: s for p, (_b, _h, s)
+                                    in prefixes.items()}))
+
+
+def two_pod_rollup(clock=None, journal=None):
+    """pod-a and pod-b share prefix a11c; solo lives on pod-a only."""
+    pods = [
+        pod_metrics("pod-a", reused=300, prefilled=700,
+                    prefixes={"a11c": (4, 10, 200), "solo": (2, 3, 100)}),
+        pod_metrics("pod-b", reused=100, prefilled=900, parked=2,
+                    prefixes={"a11c": (6, 5, 150)}),
+    ]
+    provider = StaticProvider(pods)
+    rollup = kvobs_mod.KvObsRollup(provider, journal=journal,
+                                   clock=clock or FakeClock())
+    return rollup, pods
+
+
+class TestRollup:
+    def test_pod_view_derivation(self):
+        rollup, _ = two_pod_rollup()
+        rollup.tick(now=100.0)
+        payload = rollup.debug_payload()
+        a = payload["pods"]["pod-a"]
+        assert a["reuse_efficiency"] == 0.3      # 300 / (300 + 700)
+        assert a["usage"] == 0.75                # 1 - 5/20
+        assert a["parked_share"] == 0.05         # 1/20
+        assert a["prefixes"]["a11c"] == {
+            "blocks": 4, "hits": 10, "tokens_saved": 200}
+        b = payload["pods"]["pod-b"]
+        assert b["reuse_efficiency"] == 0.1
+        assert b["parked_share"] == 0.1
+        # Decode tokens never count toward the prefill denominator.
+
+    def test_pods_without_ledger_are_skipped(self):
+        provider = StaticProvider([
+            PodMetrics(pod=Pod("old", "127.0.0.1:1"), metrics=Metrics()),
+            pod_metrics("new"),
+        ])
+        rollup = kvobs_mod.KvObsRollup(provider, clock=FakeClock())
+        rollup.tick(now=100.0)
+        assert set(rollup.debug_payload()["pods"]) == {"new"}
+
+    def test_saved_rate_ema_over_cumulative_counter(self):
+        pods = [pod_metrics("pod-a", reused=1000)]
+        provider = StaticProvider(pods)
+        rollup = kvobs_mod.KvObsRollup(provider, clock=FakeClock())
+        rollup.tick(now=100.0)
+        assert rollup.debug_payload()["pods"]["pod-a"][
+            "saved_tokens_per_s"] == 0.0  # first tick: no delta yet
+        pods[0].metrics.prefix_reused_tokens = 1500
+        rollup.tick(now=110.0)
+        # delta 500 over 10s -> 50 tok/s raw; EMA alpha 0.6 from 0.
+        assert rollup.debug_payload()["pods"]["pod-a"][
+            "saved_tokens_per_s"] == 30.0
+
+    def test_duplication_join_and_journal_edge(self):
+        journal = events_mod.EventJournal(capacity=32)
+        rollup, _pods = two_pod_rollup(journal=journal)
+        rollup.tick(now=100.0)
+        payload = rollup.debug_payload()
+        dup = payload["duplication"]
+        assert dup["duplicated_prefixes"] == 1
+        (row,) = dup["prefixes"]
+        assert row["prefix"] == "a11c"
+        assert row["replicas"] == 2
+        # sum(4, 6) - max = 4 duplicated blocks, x16 tokens each.
+        assert row["duplicated_blocks"] == 4
+        assert row["duplicated_tokens"] == 64
+        assert row["hits"] == 15 and row["tokens_saved"] == 350
+        assert dup["duplicated_blocks"] == 4
+        # The journal saw the ENTER edge exactly once; a second tick with
+        # the prefix still duplicated is not an edge.
+        evs = journal.events(kind=events_mod.KV_DUPLICATION, limit=8)
+        assert len(evs) == 1
+        assert evs[0]["attrs"] == {"prefix": "a11c", "replicas": 2,
+                                   "blocks": 4}
+        rollup.tick(now=110.0)
+        assert len(journal.events(kind=events_mod.KV_DUPLICATION,
+                                  limit=8)) == 1
+
+    def test_dedup_rate_is_fraction_of_fleet_hit_rate(self):
+        pods = [
+            pod_metrics("pod-a", prefixes={"a11c": (4, 10, 200)}),
+            pod_metrics("pod-b", prefixes={"a11c": (6, 5, 150)}),
+        ]
+        provider = StaticProvider(pods)
+        rollup = kvobs_mod.KvObsRollup(provider, clock=FakeClock())
+        rollup.tick(now=100.0)
+        pods[0].metrics.kv_prefix_tokens_saved = {"a11c": 400}  # +200
+        rollup.tick(now=110.0)
+        (row,) = rollup.debug_payload()["duplication"]["prefixes"]
+        # Fleet saved rate: 200/10s EMA-weighted 0.6 -> 12; (k-1)/k = 1/2.
+        assert row["dedup_tokens_saved_per_s"] == 6.0
+
+    def test_departed_pods_and_prefixes_drop_state(self):
+        pods = [pod_metrics("pod-a"), pod_metrics("pod-b")]
+        provider = StaticProvider(pods)
+        rollup = kvobs_mod.KvObsRollup(provider, clock=FakeClock())
+        rollup.tick(now=100.0)
+        assert set(rollup._prev_pod_saved) == {"pod-a", "pod-b"}
+        del provider._pm[1]
+        rollup.tick(now=110.0)
+        assert set(rollup._prev_pod_saved) == {"pod-a"}
+        assert set(rollup.debug_payload()["pods"]) == {"pod-a"}
+
+    def test_remote_overlay_joins_and_local_wins(self):
+        journal = events_mod.EventJournal(capacity=32)
+        rollup, _ = two_pod_rollup(journal=journal)
+        rollup.set_remote_tables({
+            # A peer's view of a pod WE scrape: ignored (local wins).
+            "pod-a": {"blocks": {"a11c": 99}, "block_tokens": 16},
+            # A pod only the peer scrapes: joins the index.
+            "peer-pod": {"blocks": {"a11c": 3}, "block_tokens": 16},
+        })
+        rollup.tick(now=100.0)
+        (row,) = rollup.debug_payload()["duplication"]["prefixes"]
+        assert row["replicas"] == 3
+        assert row["blocks"] == {"pod-a": 4, "pod-b": 6, "peer-pod": 3}
+        assert row["duplicated_blocks"] == (4 + 6 + 3) - 6
+        # local_tables round-trips the overlay shape a peer would feed us.
+        local = rollup.local_tables()
+        assert local["pod-a"]["blocks"]["a11c"] == 4
+        assert local["pod-a"]["block_tokens"] == 16
+
+
+class TestExpositionContract:
+    def test_families_round_trip_with_hostile_labels(self):
+        from test_exposition_contract import lint_exposition
+
+        pods = [
+            pod_metrics(HOSTILE,
+                        prefixes={HOSTILE_PREFIX: (4, 10, 200)}),
+            pod_metrics("pod-b", prefixes={HOSTILE_PREFIX: (6, 5, 150)}),
+        ]
+        rollup = kvobs_mod.KvObsRollup(StaticProvider(pods),
+                                       clock=FakeClock())
+        rollup.tick(now=100.0)
+        text = "\n".join(rollup.render()) + "\n"
+        families = lint_exposition(text)
+        effs = {s.labels["pod"]: s.value
+                for s in families["gateway_kv_reuse_efficiency"]}
+        assert effs[HOSTILE] == 0.3  # hostile pod name round-trips
+        assert {s.labels["pod"]
+                for s in families["gateway_kv_parked_share"]} == {
+            HOSTILE, "pod-b"}
+        assert families["gateway_kv_duplicated_prefixes"][0].value == 1
+        assert families["gateway_kv_duplicated_blocks"][0].value == 4
+        (rep,) = families["gateway_kv_prefix_replicas"]
+        assert rep.labels["prefix"] == HOSTILE_PREFIX
+        assert rep.value == 2
+
+    def test_empty_state_still_lints(self):
+        from test_exposition_contract import lint_exposition
+
+        rollup = kvobs_mod.KvObsRollup(StaticProvider([]),
+                                       clock=FakeClock())
+        rollup.tick(now=100.0)
+        families = lint_exposition("\n".join(rollup.render()) + "\n")
+        assert families["gateway_kv_duplicated_prefixes"][0].value == 0
+
+    def test_registry_covers_every_rendered_family(self):
+        from llm_instance_gateway_tpu import metrics_registry
+
+        rollup, _ = two_pod_rollup()
+        rollup.tick(now=100.0)
+        rendered = {line.split(" ")[2]
+                    for line in rollup.render()
+                    if line.startswith("# TYPE ")}
+        assert rendered
+        assert rendered <= metrics_registry.registered_names()
+
+
+def test_proxy_debug_kv_endpoint():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    async def run():
+        pod = Pod("pod-a", "127.0.0.1:1")
+        ds = Datastore(pods=[pod])
+        ds.set_pool(InferencePool(name="pool"))
+        provider = StaticProvider([pod_metrics("pod-a")])
+        proxy = GatewayProxy(
+            Server(Scheduler(provider, token_aware=False,
+                             prefill_aware=False), ds), provider, ds)
+        assert proxy.kvobs is proxy.stacks[proxy._default_pool].kvobs
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/kv")
+            assert resp.status == 200
+            payload = await resp.json()
+        finally:
+            await client.close()
+        assert payload["ticks"] >= 1
+        assert payload["pods"]["pod-a"]["reuse_efficiency"] == 0.3
+        assert "duplication" in payload
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# tools/kv_report.py + the committed baseline artifact
+# ---------------------------------------------------------------------------
+
+
+def gateway_payload():
+    rollup, _ = two_pod_rollup()
+    rollup.tick(now=100.0)
+    return rollup.debug_payload()
+
+
+class TestKvReport:
+    def test_pure_rows_and_render(self):
+        from tools import kv_report
+
+        payload = gateway_payload()
+        rows = kv_report.pod_rows(payload)
+        assert [r["pod"] for r in rows] == ["pod-a", "pod-b"]
+        assert rows[0]["reuse_eff_pct"] == 30.0
+        assert rows[0]["parked_pct"] == 5.0
+        heat = kv_report.heatmap_rows(payload)
+        a11c = next(r for r in heat if r["prefix"] == "a11c")
+        assert a11c["replicas"] == 2 and a11c["hits"] == 15
+        assert "pod-a:4" in a11c["holders"] and "pod-b:6" in a11c["holders"]
+        dup = kv_report.duplication_rows(payload)
+        assert dup[0]["prefix"] == "a11c"
+        assert dup[0]["dup_blocks"] == 4
+        text = kv_report.render_gateway(payload)
+        assert "a11c" in text and "pod-a" in text
+        assert "duplication" in text.lower()
+
+    def test_server_payload_render(self):
+        from llm_instance_gateway_tpu.server.kv_ledger import KvLedger
+        from tools import kv_report
+
+        led = KvLedger(n_blocks=8, block_tokens=8)
+        led.note_register("aa00", blocks=2)
+        led.note_reuse_hit("aa00", blocks=2, tokens=16)
+        led.sync_states([0, 1, 4], 3, 2, 0)
+        kind, payload = kv_report.extract_kv(led.snapshot())
+        assert kind == "server"
+        text = kv_report.render_server(payload)
+        assert "aa00" in text and "free" in text
+
+    def test_baseline_is_deterministic_and_duplicated(self):
+        from tools import kv_report
+
+        a = kv_report.run_baseline()
+        b = kv_report.run_baseline()
+        assert a == b, "baseline scenario must be deterministic"
+        assert a["format"] == kv_report.BASELINE_FORMAT
+        # The acceptance bar: the shared prefix is resident on enough
+        # replicas for a >= 3x duplication factor.
+        assert a["duplication_factor"] >= 3
+        dup = a["kv"]["duplication"]
+        assert dup["duplicated_prefixes"] >= 2
+        top = dup["prefixes"][0]
+        assert top["replicas"] == 4
+        assert top["duplicated_blocks"] == 3 * top["blocks"][
+            sorted(top["blocks"])[0]]
+
+    def test_committed_artifact_matches_scenario(self):
+        """KV_BASELINE.json (committed) == a fresh run — the CI currency
+        check ``kv_report --once`` reproduces."""
+        import pathlib
+
+        from tools import kv_report
+
+        artifact = pathlib.Path(__file__).resolve().parents[1] \
+            / "KV_BASELINE.json"
+        committed = json.loads(artifact.read_text())
+        assert committed == kv_report.run_baseline()
+        # And the renderer accepts the artifact (the --once path).
+        kind, payload = kv_report.extract_kv(committed)
+        assert kind == "gateway"
+        text = kv_report.render_gateway(payload)
+        assert "00000000000a11ce" in text
+
+
+def test_lig_top_kv_section():
+    from tools.lig_top import kv_lines, render_table
+
+    kv = gateway_payload()
+    lines = kv_lines(kv)
+    assert any("pod-a" in ln and "reuse_eff=30.0%" in ln for ln in lines)
+    assert any("duplication: 1 prefixes / 4 blocks" in ln for ln in lines)
+    assert any("top a11c x2" in ln for ln in lines)
+    # Absent /debug/kv (older gateway): the section degrades to nothing.
+    assert kv_lines(None) == []
+    table = render_table({"adapters": [], "pool_waste": {}, "noisy": []},
+                         kv=kv)
+    assert "kv duplication" in table

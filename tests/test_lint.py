@@ -350,6 +350,32 @@ def test_metric_currency_flags_unregistered_fleet_family(tmp_path):
                for f in found), messages(found)
 
 
+def test_metric_currency_flags_unregistered_kv_family(tmp_path):
+    """ISSUE 17 satellite: a KV-economy family rendered on either surface
+    (the ledger's ``tpu:kv_*``, the rollup's ``gateway_kv_*``) without a
+    registry entry fails ``make lint`` — both /debug/kv surfaces stay
+    operator-visible like every other plane's."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/metrics_registry.py": REGISTRY_FIXTURE.replace(
+            '    Family("gateway_dead_total", "counter", (), "help", '
+            '"s"),\n', ""),
+        f"{PKG}/server/kv_ledger.py":
+            'def render_kv(kv):\n'
+            '    return ["# TYPE tpu:kv_shadow_blocks gauge",\n'
+            '            f"tpu:kv_shadow_blocks {kv}"]\n',
+        f"{PKG}/gateway/kvobs.py":
+            'def render(self):\n'
+            '    return ["# TYPE gateway_kv_mystery_ratio gauge",\n'
+            '            f"gateway_kv_mystery_ratio {self.x}"]\n'})
+    found = run_rule(root, "metric-currency")
+    assert any("tpu:kv_shadow_blocks" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+    assert any("gateway_kv_mystery_ratio" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+
+
 # -- event-kinds ------------------------------------------------------------
 
 EVENTS_FIXTURE = 'PICK = "pick"\nSHED = "shed"\n'
@@ -412,6 +438,24 @@ def test_event_kinds_flags_undeclared_fleet_event(tmp_path):
     assert any("'fleet_peer_vanished'" in f.message
                for f in found), messages(found)
     assert not any("'fleet_peer_error'" in f.message for f in found)
+
+
+def test_event_kinds_flags_undeclared_kv_event(tmp_path):
+    """ISSUE 17 satellite: a KV-economy event kind emitted without an
+    events.py constant fails — ``kv_duplication``/``kv_evict`` must stay
+    declared or the blackbox narration and the events_total contract
+    lose them."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/events.py": EVENTS_FIXTURE
+        + 'KV_DUPLICATION = "kv_duplication"\n',
+        f"{PKG}/gateway/kvobs.py":
+            "def tick(self, journal):\n"
+            "    journal.emit('kv_duplication', prefix='ab12')\n"
+            "    journal.emit('kv_dedup_regret', prefix='ab12')\n"})
+    found = run_rule(root, "event-kinds")
+    assert any("'kv_dedup_regret'" in f.message
+               for f in found), messages(found)
+    assert not any("'kv_duplication'" in f.message for f in found)
 
 
 # -- label-hygiene ----------------------------------------------------------

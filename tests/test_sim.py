@@ -156,6 +156,48 @@ def test_prefix_cache_hit_shortens_prefill():
     assert s.prefix_reused_tokens == 4000
 
 
+def test_kv_snapshot_matches_engine_ledger_shape():
+    """The sim's kv_snapshot() is the engine ledger's snapshot() twin:
+    gateway/kvobs.py and tools/kv_report.py consume both without caring
+    which produced the payload, so the key set, the state tiling and the
+    16-hex prefix-label convention must stay in lockstep."""
+    from llm_instance_gateway_tpu.server.kv_ledger import KvLedger
+    from llm_instance_gateway_tpu.sim.core import (
+        SimRequest, SimServer, V5E_DEFAULT)
+
+    led = KvLedger(n_blocks=8, block_tokens=16)
+    led.note_alloc(n=2)
+    led.note_register("00000000000000aa", blocks=1)
+    led.sync_states([0, 1, 2, 3, 4], active_blocks=2, prefix_resident=1,
+                    parked_tokens=0)
+    engine_snap = led.snapshot()
+
+    s = SimServer("s", V5E_DEFAULT, kv_capacity_tokens=4096)
+
+    def req(rid):
+        return SimRequest(rid=rid, arrival_s=0.0, prompt_tokens=512,
+                          output_tokens=1, model="base", prefix_id=7,
+                          prefix_tokens=496)
+
+    s.prefill_queue.append(req(0))
+    s.step(0.0)                       # miss: registers prefix 7
+    s.prefill_queue.append(req(1))
+    s.step(1.0)                       # hit: charges reuse
+    sim_snap = s.kv_snapshot()
+
+    assert set(sim_snap) == set(engine_snap)
+    assert set(sim_snap["states"]) == set(engine_snap["states"])
+    assert sum(sim_snap["states"].values()) == sim_snap["blocks_total"]
+    for entry in sim_snap["prefixes"]:
+        assert set(entry) == set(engine_snap["prefixes"][0])
+    (top,) = [e for e in sim_snap["prefixes"]
+              if e["prefix"] == "%016x" % 7]
+    assert top["hits"] == 1 and top["tokens_saved"] == 496
+    assert top["blocks"] == -(-496 // s.kv_block_tokens)
+    for hist_key in ("free_runs", "parked_share"):
+        assert set(sim_snap[hist_key]) == set(engine_snap[hist_key])
+
+
 class TestDecodeLevers:
     """The PR-15 cost-model knobs: steps-per-dispatch amortization and
     concurrent chunk-stream lanes, pinned to the committed scenario."""

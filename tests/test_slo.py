@@ -220,6 +220,11 @@ class TestBreachEndToEnd:
         assert dump["statebus"]["replica"] == proxy.statebus.replica_id
         assert "quota_scale" in dump["statebus"]
         assert "error" in dump["profile"]["pod-a"]
+        # KV economy section (ISSUE 17): the dump carries the gateway
+        # rollup plus each pod's raw ledger fetch — the fake pod is
+        # unreachable, so its ledger is an error marker, not an omission.
+        assert "gateway" in dump["kv"] and "duplication" in dump["kv"]["gateway"]
+        assert "error" in dump["kv"]["pods"]["pod-a"]
 
         report = blackbox_report.render_report(dump, window_s=3600.0)
         assert "fast_burn" in report
@@ -229,6 +234,8 @@ class TestBreachEndToEnd:
         assert "State bus at dump time:" in report
         assert "Engine step-timeline at dump time" in report
         assert "UNAVAILABLE" in report  # the unreachable pod's marker
+        assert "KV economy at dump time:" in report
+        assert "duplication: 0 prefixes" in report
 
     def test_dump_cooldown(self, tmp_path):
         proxy = build_proxy(tmp_path)

@@ -49,6 +49,7 @@ ABSOLUTE_MAX = {
     "pick_placement_ratio": 1.05,
     "step_profile_ratio": 1.05,
     "pick_witness_ratio": 1.05,
+    "kv_ledger_ratio": 1.05,
     "device_stops_ratio": 1.15,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
@@ -80,6 +81,7 @@ _RATIO_SOURCES = {
     "pick_placement_ratio": "placement",
     "step_profile_ratio": "profiler",
     "pick_witness_ratio": "witness",
+    "kv_ledger_ratio": "kvledger",
     "device_stops_ratio": "decode",
 }
 
@@ -94,6 +96,7 @@ _FAMILY_PRIMARY = {
     "placement": ("pick_placement_ratio", "lower"),
     "profiler": ("step_profile_ratio", "lower"),
     "witness": ("pick_witness_ratio", "lower"),
+    "kvledger": ("kv_ledger_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -113,6 +116,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "placement": bench.run_placement_microbench(),
         "profiler": bench.run_profiler_microbench(),
         "witness": bench.run_witness_microbench(),
+        "kvledger": bench.run_kv_ledger_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
         "decode": bench.run_decode_lever_microbench(),
@@ -131,6 +135,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
                   "placement": bench.run_placement_microbench,
                   "profiler": bench.run_profiler_microbench,
                   "witness": bench.run_witness_microbench,
+                  "kvledger": bench.run_kv_ledger_microbench,
                   "decode": bench.run_decode_lever_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):

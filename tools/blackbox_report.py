@@ -14,6 +14,9 @@ This tool renders it into the narrative an on-caller actually reads —
   when it burned?,
 - the pool pods' step-profiler attribution (server/profiler.py):
   dispatch / host-sync / idle shares per pod at the breach,
+- the KV economy at dump time (gateway/kvobs.py + per-pod /debug/kv):
+  reuse efficiency, parked-KV share, the fleet duplication headline, and
+  each pod's raw block-state ledger (unreachable pods marked UNAVAILABLE),
 - a merged chronological timeline of journal events and trace spans
   leading up to the dump (``--window`` seconds, default 60).
 
@@ -156,6 +159,36 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
                 f" idle={shares.get('idle', 0):.1%}"
                 f" over {att.get('dispatches', 0)} dispatches"
                 f" ({att.get('tracked_seconds', 0)}s tracked)")
+        lines.append("")
+    kv = dump.get("kv") or {}
+    if kv:
+        lines.append("KV economy at dump time:")
+        gw = kv.get("gateway") or {}
+        for pod, view in sorted((gw.get("pods") or {}).items()):
+            lines.append(
+                f"  {pod:<20} usage={view.get('usage', 0):.1%}"
+                f" parked={view.get('parked_share', 0):.1%}"
+                f" reuse_eff={view.get('reuse_efficiency', 0):.1%}"
+                f" saved={view.get('saved_tokens_per_s', 0)}tok/s")
+        dup = gw.get("duplication") or {}
+        lines.append(
+            f"  duplication: {dup.get('duplicated_prefixes', 0)} prefixes"
+            f" / {dup.get('duplicated_blocks', 0)} blocks on >=2 replicas"
+            f" ({dup.get('dedup_tokens_saved_per_s', 0)}tok/s servable by"
+            " a shared copy)")
+        # Per-pod raw ledger fetches: unreachable pods (exactly when
+        # dumps fire) degrade to markers, mirroring the profiler section.
+        for pod, snap in sorted((kv.get("pods") or {}).items()):
+            if isinstance(snap, dict) and "error" in snap:
+                lines.append(f"  {pod:<20} UNAVAILABLE: {snap['error']}")
+            elif isinstance(snap, dict):
+                states = snap.get("states") or {}
+                lines.append(
+                    f"  {pod:<20} ledger: " + " ".join(
+                        f"{s}={states.get(s, 0)}"
+                        for s in ("free", "active", "prefix_resident",
+                                  "parked"))
+                    + f" (of {snap.get('blocks_total', 0)})")
         lines.append("")
     counts = (dump.get("events") or {}).get("counts") or {}
     if counts:

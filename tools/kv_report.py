@@ -1,0 +1,373 @@
+"""KV economy report: reuse heatmap, fleet duplication table, fragmentation.
+
+Reads a ``/debug/kv`` payload (URL, file path, or ``-`` for stdin) from
+EITHER surface — the gateway's fleet view (``gateway/kvobs.py``: per-pod
+rows + duplication index) or a single model server's ledger snapshot
+(``server/kv_ledger.py``: block states + prefix table + histograms) — or
+the ``kv`` section of a black-box dump, and renders the operator view:
+
+- the per-pod economy table (KV usage, parked share, reuse efficiency,
+  cache-savings rate);
+- the prefix reuse heatmap (hottest prefixes fleet-wide, which replicas
+  hold them);
+- the duplication table ("prefix P resident on k replicas, N blocks
+  duplicated, M tokens/s servable by one shared copy");
+- a fragmentation/headroom summary from a server ledger's free-run and
+  parked-share histograms.
+
+``--baseline`` regenerates the committed ``KV_BASELINE.json`` evidence
+artifact: a deterministic 4-replica SimServer fleet serving a shared
+system prompt (every replica caches the same prefix — >=3x duplication),
+rolled up through the REAL gateway join (``KvObsRollup``), no RNG and no
+wall clock, so CI re-derives the identical document byte-for-byte.
+
+Usage:
+  python tools/kv_report.py http://localhost:9002/debug/kv        # watch
+  python tools/kv_report.py http://localhost:9002/debug/kv --once
+  python tools/kv_report.py KV_BASELINE.json
+  python tools/kv_report.py --baseline --artifact KV_BASELINE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.trace_report import load  # noqa: E402 — one loader, no drift
+
+BASELINE_FORMAT = "lig-kv-baseline/1"
+
+
+# ---------------------------------------------------------------------------
+# Payload extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_kv(doc: dict) -> tuple[str, dict]:
+    """Classify a payload: ``("gateway", payload)`` for the fleet rollup
+    shape, ``("server", payload)`` for one ledger snapshot.  Accepts the
+    baseline artifact (``kv`` section) and a black-box dump (``kv`` ->
+    ``gateway``/``pods``)."""
+    if not isinstance(doc, dict):
+        raise ValueError("payload is not a JSON object")
+    if isinstance(doc.get("kv"), dict):
+        inner = doc["kv"]
+        # Black-box dump shape: {"gateway": rollup, "pods": {name: raw}}.
+        if isinstance(inner.get("gateway"), dict):
+            return "gateway", inner["gateway"]
+        return extract_kv(inner)
+    if "duplication" in doc and "pods" in doc:
+        return "gateway", doc
+    if "states" in doc and "blocks_total" in doc:
+        return "server", doc
+    raise ValueError("no KV payload found (expected a gateway /debug/kv "
+                     "body, a server ledger snapshot, or a dump's 'kv' "
+                     "section)")
+
+
+# ---------------------------------------------------------------------------
+# Rows (pure — the testable core)
+# ---------------------------------------------------------------------------
+
+
+def pod_rows(gw: dict) -> list[dict]:
+    rows = []
+    for name, view in sorted((gw.get("pods") or {}).items()):
+        rows.append({
+            "pod": name,
+            "blocks": view.get("blocks_total", 0),
+            "usage_pct": round(100.0 * view.get("usage", 0.0), 1),
+            "parked_pct": round(100.0 * view.get("parked_share", 0.0), 1),
+            "reuse_eff_pct": round(
+                100.0 * view.get("reuse_efficiency", 0.0), 1),
+            "saved_tok_s": view.get("saved_tokens_per_s", 0.0),
+        })
+    return rows
+
+
+def heatmap_rows(gw: dict, top: int = 16) -> list[dict]:
+    """Hottest prefixes fleet-wide: fleet hits/savings summed across the
+    pods' per-prefix tables, holders listed as ``pod:blocks``."""
+    agg: dict[str, dict] = {}
+    for pod, view in sorted((gw.get("pods") or {}).items()):
+        for prefix, e in (view.get("prefixes") or {}).items():
+            row = agg.setdefault(prefix, {"prefix": prefix, "hits": 0,
+                                          "tokens_saved": 0, "holders": []})
+            row["hits"] += int(e.get("hits", 0))
+            row["tokens_saved"] += int(e.get("tokens_saved", 0))
+            if e.get("blocks"):
+                row["holders"].append(f"{pod}:{e['blocks']}")
+    rows = sorted(agg.values(),
+                  key=lambda r: (-r["hits"], -r["tokens_saved"],
+                                 r["prefix"]))[:top]
+    for r in rows:
+        r["replicas"] = len(r["holders"])
+        r["holders"] = " ".join(r["holders"]) or "-"
+    return rows
+
+
+def duplication_rows(gw: dict) -> list[dict]:
+    rows = []
+    for r in ((gw.get("duplication") or {}).get("prefixes") or []):
+        rows.append({
+            "prefix": r.get("prefix", "?"),
+            "replicas": r.get("replicas", 0),
+            "dup_blocks": r.get("duplicated_blocks", 0),
+            "dup_tokens": r.get("duplicated_tokens", 0),
+            "dedup_tok_s": r.get("dedup_tokens_saved_per_s", 0.0),
+        })
+    return rows
+
+
+def fragmentation_summary(ledger: dict) -> dict:
+    """Headroom shape from one server ledger snapshot: states, the mean
+    and max free-run length (can a growth burst find room?), parked
+    share samples."""
+    runs = ledger.get("free_runs") or {}
+    counts = runs.get("counts") or []
+    buckets = runs.get("buckets") or []
+    n = int(runs.get("count", 0))
+    max_bucket = 0.0
+    for i, c in enumerate(counts):
+        if c:
+            max_bucket = (buckets[i] if i < len(buckets)
+                          else float("inf"))
+    return {
+        "states": dict(ledger.get("states") or {}),
+        "blocks_total": ledger.get("blocks_total", 0),
+        "parked_tokens": ledger.get("parked_tokens", 0),
+        "free_runs": n,
+        "mean_run_blocks": round(runs.get("sum", 0.0) / n, 2) if n else 0.0,
+        "max_run_bucket": max_bucket,
+        "prefix_table_size": ledger.get("prefix_table_size", 0),
+        "prefix_table_evictions": ledger.get("prefix_table_evictions", 0),
+    }
+
+
+def _table(rows: list[dict], headers: tuple) -> str:
+    if not rows:
+        return "(no samples)"
+    widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
+
+    def fmt(vals):
+        return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
+                         for i, (v, w) in enumerate(zip(vals, widths)))
+
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt([r[h] for h in headers]) for r in rows]
+    return "\n".join(lines)
+
+
+def render_gateway(gw: dict) -> str:
+    dup = gw.get("duplication") or {}
+    out = [
+        "FLEET KV ECONOMY "
+        f"(ticks={gw.get('ticks', 0)}, pods={len(gw.get('pods') or {})})",
+        "",
+        _table(pod_rows(gw), ("pod", "blocks", "usage_pct", "parked_pct",
+                              "reuse_eff_pct", "saved_tok_s")),
+        "",
+        "Prefix reuse heatmap (fleet-wide, hottest first):",
+        _table(heatmap_rows(gw), ("prefix", "replicas", "hits",
+                                  "tokens_saved", "holders")),
+        "",
+        f"Duplication index: {dup.get('duplicated_prefixes', 0)} prefixes "
+        f"on >=2 replicas, {dup.get('duplicated_blocks', 0)} blocks "
+        f"({dup.get('duplicated_tokens', 0)} tokens) duplicated, "
+        f"{dup.get('dedup_tokens_saved_per_s', 0.0)} tok/s servable by a "
+        "shared copy:",
+        _table(duplication_rows(gw), ("prefix", "replicas", "dup_blocks",
+                                      "dup_tokens", "dedup_tok_s")),
+    ]
+    return "\n".join(out)
+
+
+def render_server(ledger: dict) -> str:
+    frag = fragmentation_summary(ledger)
+    states = frag["states"]
+    state_rows = [{"state": s, "blocks": states.get(s, 0)}
+                  for s in ("free", "active", "prefix_resident", "parked")]
+    prefix_rows = [
+        {"prefix": e.get("prefix", "?"), "hits": e.get("hits", 0),
+         "tokens_saved": e.get("tokens_saved", 0),
+         "blocks": e.get("blocks", 0), "age_s": e.get("age_s", 0.0)}
+        for e in (ledger.get("prefixes") or [])[:16]]
+    out = [
+        "SERVER KV LEDGER "
+        f"(blocks_total={frag['blocks_total']}, "
+        f"block_tokens={ledger.get('block_tokens', 0)}, "
+        f"syncs={ledger.get('syncs', 0)})",
+        "",
+        _table(state_rows, ("state", "blocks")),
+        "",
+        "Prefix reuse heatmap (hottest first):",
+        _table(prefix_rows, ("prefix", "hits", "tokens_saved", "blocks",
+                             "age_s")),
+        "",
+        "Fragmentation/headroom: "
+        f"{frag['free_runs']} free runs, mean {frag['mean_run_blocks']} "
+        f"blocks, longest-run bucket <= {frag['max_run_bucket']}; "
+        f"parked {frag['parked_tokens']} tokens; prefix table "
+        f"{frag['prefix_table_size']} entries "
+        f"({frag['prefix_table_evictions']} evicted)",
+    ]
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic baseline scenario (the committed KV_BASELINE.json)
+# ---------------------------------------------------------------------------
+
+
+def run_baseline() -> dict:
+    """Four sim replicas behind one gateway rollup, all serving the same
+    shared-prefix template (plus a 2-replica template and per-pod unique
+    prefixes) — deterministic: fixed request plan, stepped sim clock, no
+    RNG, no wall time."""
+    from llm_instance_gateway_tpu.gateway import kvobs
+    from llm_instance_gateway_tpu.sim.core import (
+        SimRequest, SimServer, V5E_DEFAULT)
+
+    shared_prefix, pair_prefix = 0xA11CE, 0xB0B
+    servers = [SimServer(f"sim-{i}", V5E_DEFAULT, decode_slots=8,
+                         kv_capacity_tokens=8192, kv_block_tokens=16)
+               for i in range(4)]
+    rid = 0
+    for i, srv in enumerate(servers):
+        plan = [(shared_prefix, 256)] * 3 + [(0x100 + i, 64)]
+        if i < 2:
+            plan += [(pair_prefix, 128)] * 2
+        t = 0.0
+        for prefix_id, prefix_tokens in plan:
+            rid += 1
+            srv.prefill_queue.append(SimRequest(
+                rid=rid, arrival_s=t, prompt_tokens=prefix_tokens + 32,
+                output_tokens=4, model="sim", prefix_id=prefix_id,
+                prefix_tokens=prefix_tokens))
+            # Drain the admission: step until the queue empties (each
+            # iteration admits at most one request, engine-loop shape).
+            for _ in range(8):
+                t += srv.step(t) or 0.05
+                if not srv.prefill_queue:
+                    break
+
+    class _Provider:
+        def __init__(self, fleet):
+            self.fleet = fleet
+
+        def all_pod_metrics(self):
+            return [s.metrics() for s in self.fleet]
+
+    t = [0.0]
+    rollup = kvobs.KvObsRollup(_Provider(servers), clock=lambda: t[0])
+    rollup.tick()
+    t[0] = 10.0
+    rollup.tick()
+    payload = rollup.debug_payload()
+    dup = payload["duplication"]
+    top = dup["prefixes"][0] if dup["prefixes"] else {}
+    return {
+        "format": BASELINE_FORMAT,
+        "scenario": {
+            "replicas": len(servers),
+            "shared_prefix": "%016x" % shared_prefix,
+            "plan": "3x shared(256tok) on all pods, 2x pair(128tok) on "
+                    "pods 0-1, 1 unique(64tok) per pod",
+        },
+        # Max copies of one prefix beyond the first — the headline ">=3x
+        # duplicated" number the acceptance gate pins.
+        "duplication_factor": max(
+            [r["replicas"] - 1 for r in dup["prefixes"]] or [0]),
+        "kv": payload,
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def render(doc: dict) -> str:
+    kind, payload = extract_kv(doc)
+    text = (render_gateway(payload) if kind == "gateway"
+            else render_server(payload))
+    if doc.get("format") == BASELINE_FORMAT:
+        text = (f"(baseline artifact, duplication_factor="
+                f"{doc.get('duplication_factor')})\n\n") + text
+    return text
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="KV economy report: reuse heatmap, duplication index, "
+                    "fragmentation (from /debug/kv)")
+    parser.add_argument("source", nargs="?",
+                        help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--once", action="store_true",
+                        help="render one report and exit (CI mode; URL "
+                             "sources otherwise refresh every --interval)")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="watch-mode refresh seconds (URL sources)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the extracted rows as JSON")
+    parser.add_argument("--baseline", action="store_true",
+                        help="regenerate the deterministic 4-replica "
+                             "shared-prefix scenario (KV_BASELINE.json)")
+    parser.add_argument("--artifact",
+                        help="write the payload (baseline mode) or rows "
+                             "(--json) to this path instead of stdout")
+    args = parser.parse_args(argv)
+
+    if args.baseline:
+        payload = run_baseline()
+        text = json.dumps(payload, indent=1, sort_keys=True)
+        if args.artifact:
+            with open(args.artifact, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.artifact} (duplication_factor="
+                  f"{payload['duplication_factor']})")
+        else:
+            print(text)
+        return 0
+    if not args.source:
+        parser.error("a source is required unless --baseline is given")
+
+    watch = (not args.once and not args.json
+             and args.source.startswith(("http://", "https://")))
+    while True:
+        try:
+            doc = load(args.source)
+            kind, payload = extract_kv(doc)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            rows = ({"kind": kind, "pods": pod_rows(payload),
+                     "heatmap": heatmap_rows(payload),
+                     "duplication": duplication_rows(payload)}
+                    if kind == "gateway"
+                    else {"kind": kind,
+                          "fragmentation": fragmentation_summary(payload),
+                          "prefixes": payload.get("prefixes") or []})
+            text = json.dumps(rows, indent=1)
+            if args.artifact:
+                with open(args.artifact, "w", encoding="utf-8") as f:
+                    f.write(text + "\n")
+            else:
+                print(text)
+            return 0
+        if watch:
+            print("\x1b[2J\x1b[H", end="")
+        print(render(doc))
+        if not watch:
+            return 0
+        time.sleep(max(0.5, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,17 @@ def fetch_usage(url: str, timeout_s: float = 5.0) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
+def fetch_kv(url: str, timeout_s: float = 5.0) -> dict | None:
+    """Best-effort /debug/kv fetch (gateway/kvobs.py) — the KV economy
+    section degrades to absent against gateways predating the ledger."""
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/debug/kv", timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
 def _row(values, color: str = "") -> str:
     cells = []
     for v, w in zip(values, WIDTHS):
@@ -51,7 +62,35 @@ def _row(values, color: str = "") -> str:
     return f"{color}{line}{RESET}" if color else line
 
 
-def render_table(payload: dict, color: bool = False) -> str:
+def kv_lines(kv: dict | None) -> list[str]:
+    """The KV economy section (pure; from the gateway's /debug/kv): one
+    line per pod (usage, parked share, reuse efficiency) plus the fleet
+    duplication headline with the top duplicated prefix."""
+    if not kv:
+        return []
+    lines = []
+    for name, view in sorted((kv.get("pods") or {}).items()):
+        lines.append(
+            "kv %-12s usage=%.1f%% parked=%.1f%% reuse_eff=%.1f%% "
+            "saved=%.1ftok/s"
+            % (name, 100 * view.get("usage", 0.0),
+               100 * view.get("parked_share", 0.0),
+               100 * view.get("reuse_efficiency", 0.0),
+               view.get("saved_tokens_per_s", 0.0)))
+    dup = kv.get("duplication") or {}
+    top = (dup.get("prefixes") or [{}])[0]
+    lines.append(
+        "kv duplication: %d prefixes / %d blocks on >=2 replicas%s"
+        % (dup.get("duplicated_prefixes", 0),
+           dup.get("duplicated_blocks", 0),
+           ("; top %s x%d" % (top.get("prefix", "?"),
+                              top.get("replicas", 0))
+            if top.get("prefix") else "")))
+    return lines
+
+
+def render_table(payload: dict, color: bool = False,
+                 kv: dict | None = None) -> str:
     """One frame of the console (pure function — unit-tested and shared by
     --once).  Rows arrive pre-sorted by step-seconds share, descending."""
     lines = []
@@ -78,6 +117,7 @@ def render_table(payload: dict, color: bool = False) -> str:
         host_total = sum(per.get("host", 0) for per in tier_counts.values())
         lines.append("residency: %d slot / %d host copies across %d pods"
                      % (slot_total, host_total, len(residency)))
+    lines += kv_lines(kv)
     fairness = payload.get("fairness") or {}
     if fairness:
         lines.append(
@@ -131,10 +171,12 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     try:
         if args.once:
-            print(render_table(fetch_usage(args.url)))
+            print(render_table(fetch_usage(args.url),
+                               kv=fetch_kv(args.url)))
             return 0
         while True:
-            frame = render_table(fetch_usage(args.url), color=True)
+            frame = render_table(fetch_usage(args.url), color=True,
+                                 kv=fetch_kv(args.url))
             sys.stdout.write(CLEAR + frame + "\n"
                              + f"{DIM}{args.url}  ^C to quit{RESET}\n")
             sys.stdout.flush()
