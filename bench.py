@@ -548,6 +548,88 @@ def run_policy_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     }
 
 
+def run_pick_ledger_microbench(n: int = 4000, n_pods: int = 64) -> dict:
+    """Decision-ledger overhead A/B (explainability PR acceptance bar:
+    ``pick_ledger_ratio`` <= 1.05 — sampled decision records + the
+    counterfactual replays, amortized at the default sample_every=8,
+    cost < 5% of a pick vs no ledger).
+
+    Same harness shape as ``run_policy_microbench``: a real Python
+    filter-tree scheduler over a static fleet with ALL THREE advisor
+    planes attached on both sides (the ledger's counterfactual replays
+    exercise every seam); the ON side additionally wires a real
+    ``PickLedger``.  Interleaved runs, MIN per side.
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
+    from llm_instance_gateway_tpu.gateway import health, resilience
+    from llm_instance_gateway_tpu.gateway import pickledger
+    from llm_instance_gateway_tpu.gateway import placement as placement_mod
+    from llm_instance_gateway_tpu.gateway import usage as usage_mod
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+    from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+    from llm_instance_gateway_tpu.gateway.testing import (
+        fake_metrics, fake_pod,
+    )
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    provider = StaticProvider([
+        PodMetrics(pod=fake_pod(i),
+                   metrics=fake_metrics(queue=i % 5, kv=(i % 10) / 10.0))
+        for i in range(n_pods)
+    ])
+    req = LLMRequest(model="m", resolved_target_model="m", critical=True,
+                     prompt_tokens=25, criticality="Critical")
+
+    def make_side(with_ledger: bool):
+        plane = resilience.ResiliencePlane(
+            health.HealthScorer(provider=provider))
+        plane.health.update()
+        rollup = usage_mod.UsageRollup(provider)
+        fair = fairness_mod.FairnessPolicy(rollup, provider=provider)
+        planner = placement_mod.PlacementPlanner(provider, usage=rollup)
+        sched = Scheduler(provider, prefix_aware=False,
+                          rng=random_mod.Random(0))
+        sched.health_advisor = plane
+        sched.usage_advisor = fair
+        sched.placement_advisor = planner
+        if with_ledger:
+            sched.pick_ledger = pickledger.PickLedger(
+                cfg=pickledger.PickLedgerConfig(sample_every=8))
+        return sched
+
+    off, on = make_side(False), make_side(True)
+
+    def loop(sched) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sched.schedule(req)
+        return time.perf_counter() - t0
+
+    loop(off), loop(on)  # warmup pair
+    # Median of PAIRED per-round ratios, not MIN per side: each round
+    # times off then on back-to-back so CPU-frequency drift cancels
+    # within the pair — a MIN-per-side comparison can attribute a
+    # machine-wide slow phase entirely to whichever side it landed on.
+    offs, ons, ratios = [], [], []
+    for _ in range(12):
+        o, w = loop(off), loop(on)
+        offs.append(o)
+        ons.append(w)
+        ratios.append(w / o)
+    ratios.sort()
+    mid = len(ratios) // 2
+    ratio = (ratios[mid] if len(ratios) % 2
+             else (ratios[mid - 1] + ratios[mid]) / 2)
+    return {
+        "pick_ledger_off_us": round(min(offs) / n * 1e6, 2),
+        "pick_ledger_on_us": round(min(ons) / n * 1e6, 2),
+        "pick_ledger_ratio": round(ratio, 4),
+    }
+
+
 def run_fairness_microbench(n: int = 4000, n_pods: int = 64) -> dict:
     """Fairness pick-deprioritization cost A/B (fairness PR acceptance
     bar: ``pick_fairness_ratio`` <= 1.05 — ``mode=enforce`` costs < 5% of
@@ -1680,6 +1762,13 @@ if __name__ == "__main__":
             results.update(run_kv_ledger_microbench())
         except Exception as e:
             results["kv_ledger_error"] = str(e)[:200]
+        try:
+            # Decision-ledger overhead A/B (explainability PR): the <5%
+            # pick_ledger_ratio bound rides every emission so the ledger
+            # can stay on by default.
+            results.update(run_pick_ledger_microbench())
+        except Exception as e:
+            results["pick_ledger_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

@@ -216,6 +216,40 @@ CLASSES = (
              "the lock; the kv_duplication journal emit runs after "
              "release (no nested acquisition)"),
     SharedClass(
+        f"{PKG}/gateway/pickledger.py", "PickLedger", OBS_TICK,
+        fields=(
+            SharedField("_rollup", SWAP_PUBLISHED, writers=("tick",),
+                        note="seam_rollup() serves statebus/fleet/loadgen "
+                             "readers lock-free; tick() rebuilds and "
+                             "swaps the dict whole"),
+            SharedField("_ring", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_seq", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_samples", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_stage_survivors", LOCK_GUARDED,
+                        writers=("charge",)),
+            SharedField("_stage_removed", LOCK_GUARDED,
+                        writers=("charge",)),
+            SharedField("_steered", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_decisive", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_escapes", LOCK_GUARDED, writers=("charge",)),
+            SharedField("_steered_away", LOCK_GUARDED,
+                        writers=("charge",)),
+            SharedField("_shadow_mismatch", LOCK_GUARDED,
+                        writers=("charge",)),
+            SharedField("_picks_seen", MONOTONIC, writers=("sampled",),
+                        domain=DATA_PATH,
+                        note="per-pick int rebind next to the GIL-atomic "
+                             "itertools.count bump; readers tolerate "
+                             "one-pick staleness"),
+            SharedField("last_tick", MONOTONIC, writers=("tick",),
+                        note="maybe_tick reads it lock-free (float "
+                             "rebind)"),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+        ),
+        note="charge() runs the counterfactual replays and builds the "
+             "record BEFORE taking the lock; journal emits run after "
+             "release (kvobs discipline — no nested acquisition)"),
+    SharedClass(
         f"{PKG}/gateway/fairness.py", "FairnessPolicy", OBS_TICK,
         fields=(
             SharedField("_noisy_pods_cache", SWAP_PUBLISHED,
@@ -322,6 +356,11 @@ CLASSES = (
                         domain=CONTROL),
             SharedField("_decode_tree", SWAP_PUBLISHED,
                         writers=("update_config",), domain=CONTROL),
+            SharedField("_oracle_tree", SWAP_PUBLISHED,
+                        writers=("update_config",), domain=CONTROL,
+                        note="the pick ledger's shadow-replay filter tree; "
+                             "rebuilt and swapped whole on hot reload like "
+                             "_decode_tree"),
             SharedField("_cfg_gen", MONOTONIC,
                         writers=("update_config",), domain=CONTROL),
         ),
@@ -548,6 +587,8 @@ BINDINGS = {
     "health_advisor": "ResiliencePlane",
     "usage": "UsageRollup",
     "kvobs": "KvObsRollup",
+    "pickledger": "PickLedger",
+    "pick_ledger": "PickLedger",
     "fairness": "FairnessPolicy",
     "usage_advisor": "FairnessPolicy",
     "placement": "PlacementPlanner",

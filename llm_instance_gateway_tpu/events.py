@@ -61,6 +61,8 @@ PLACEMENT_ESCAPE = "placement_escape"   # no resident candidate: full set served
 STATEBUS_STALE = "statebus_stale"       # peers quiet: local-only enforcement
 STATEBUS_REJOIN = "statebus_rejoin"     # fresh peer state after a stale spell
 FLEET_PEER_ERROR = "fleet_peer_error"   # fleet collector pull failed (fleetobs)
+PICK_SAMPLE = "pick_sample"             # routing decision record captured
+PICK_ESCAPE_EXPLAINED = "pick_escape_explained"  # sampled pick hit escape hatch
 
 
 class EventJournal:

@@ -25,6 +25,7 @@ from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
 from llm_instance_gateway_tpu.gateway import kvobs as kvobs_mod
+from llm_instance_gateway_tpu.gateway import pickledger as pickledger_mod
 from llm_instance_gateway_tpu.gateway import placement as placement_mod
 from llm_instance_gateway_tpu.gateway import resilience as resilience_mod
 from llm_instance_gateway_tpu.gateway import usage as usage_mod
@@ -46,7 +47,7 @@ class AdvisorStack:
                  journal: "events_mod.EventJournal | None" = None,
                  resilience_cfg=None, health_cfg=None, usage_cfg=None,
                  fairness_cfg=None, placement_cfg=None,
-                 request_filter=None):
+                 pickledger_cfg=None, request_filter=None):
         self.pool_name = pool_name
         self.provider = provider
         self.journal = journal if journal is not None \
@@ -62,6 +63,12 @@ class AdvisorStack:
         # parked share + the fleet prefix duplication index over the same
         # provider scrape.  Purely observational — no scheduler seam.
         self.kvobs = kvobs_mod.KvObsRollup(provider, journal=self.journal)
+        # Routing decision ledger (gateway/pickledger.py): sampled
+        # per-pick explanation records + counterfactual seam attribution.
+        # Log-only by construction — the scheduler seam it wires never
+        # alters routing (counter-modulus sampling, no RNG).
+        self.pickledger = pickledger_mod.PickLedger(
+            cfg=pickledger_cfg, journal=self.journal)
         # Fairness config precedence, per FIELD: explicit CLI flags (a
         # dict of overrides from bootstrap.fairness_from_args — pinned,
         # re-applied on every hot reload) > THIS pool document's
@@ -97,6 +104,8 @@ class AdvisorStack:
             sched.usage_advisor = self.fairness
         if sched is not None and hasattr(sched, "placement_advisor"):
             sched.placement_advisor = self.placement
+        if sched is not None and hasattr(sched, "pick_ledger"):
+            sched.pick_ledger = self.pickledger
         # The AdmissionController feeds fairnessPolicy hot-reloads from
         # the pool document through this reference.
         if outer_scheduler is not None and hasattr(outer_scheduler,
@@ -115,6 +124,7 @@ class AdvisorStack:
         self.kvobs.tick()
         self.fairness.tick()
         self.placement.tick()
+        self.pickledger.tick()
 
     def pod_names(self) -> set[str]:
         return {pm.pod.name for pm in self.provider.all_pod_metrics()}
@@ -126,7 +136,8 @@ class AdvisorStack:
         blocks through ``merge_exposition_blocks``."""
         return (self.health.render() + self.resilience.render()
                 + self.usage.render() + self.kvobs.render()
-                + self.fairness.render() + self.placement.render())
+                + self.fairness.render() + self.placement.render()
+                + self.pickledger.render())
 
 
 def merge_exposition_blocks(blocks: list[list[str]]) -> list[str]:

@@ -286,6 +286,52 @@ def fleet_slo(payloads: dict[str, dict]) -> dict:
     return {"models": models, "replicas": sorted(payloads)}
 
 
+def pick_steering_rollup(docs: list[dict]) -> dict:
+    """Fold statebus docs' per-pool pick-ledger rollups
+    (``gateway/pickledger.py`` via ``StateBus.snapshot``) into the fleet
+    steering view — "which seam is steering traffic on which replica":
+    per replica/pool the seam steering counts and decisive-seam
+    histogram, plus fleet-wide seam totals.  Pure over ``all_docs()``;
+    docs from pre-ledger peers (no ``picks`` key) are skipped."""
+    replicas: dict[str, dict] = {}
+    totals_steered: dict[str, int] = {}
+    totals_decisive: dict[str, int] = {}
+    for doc in docs or ():
+        if not isinstance(doc, dict):
+            continue
+        replica = doc.get("replica")
+        pools = doc.get("pools")
+        if not isinstance(replica, str) or not isinstance(pools, dict):
+            continue
+        for pool, pool_doc in sorted(pools.items()):
+            if not isinstance(pool_doc, dict):
+                continue
+            picks = pool_doc.get("picks")
+            if not isinstance(picks, dict) or not picks.get("samples"):
+                continue
+            steered = {str(k): int(v) for k, v in
+                       (picks.get("steered") or {}).items()
+                       if isinstance(v, (int, float))}
+            decisive = {str(k): int(v) for k, v in
+                        (picks.get("decisive") or {}).items()
+                        if isinstance(v, (int, float))}
+            replicas.setdefault(replica, {})[pool] = {
+                "samples": int(picks.get("samples") or 0),
+                "picks": int(picks.get("picks") or 0),
+                "steered": steered,
+                "decisive": decisive,
+                "escapes": dict(picks.get("escapes") or {}),
+                "steered_away": dict(picks.get("steered_away") or {}),
+            }
+            for seam, n in steered.items():
+                totals_steered[seam] = totals_steered.get(seam, 0) + n
+            for tag, n in decisive.items():
+                totals_decisive[tag] = totals_decisive.get(tag, 0) + n
+    return {"replicas": replicas,
+            "steered_total": totals_steered,
+            "decisive_total": totals_decisive}
+
+
 def collect_pod_payloads(pods: list[tuple[str, str]],
                          path: str = "/debug/profile",
                          timeout_s: float = 2.0,

@@ -127,6 +127,8 @@ def handle_request_body(server, req_ctx, msg: RequestBody) -> ProcessingResult:
         # not alias (their KV blocks can't be shared).
         prefix_hashes=LazyPrefixHashes(
             lambda: prefix_hashes(text, model=model_name)),
+        # Joins the pick ledger's decision record to this request's trace.
+        trace_id=req_ctx.trace_id,
     )
 
     request_body = msg.body

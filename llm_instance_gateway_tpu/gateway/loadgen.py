@@ -55,6 +55,37 @@ def model_name(i: int) -> str:  # benchmark.go:71-73
     return f"adapter-{i}"
 
 
+def attach_pick_ledger(outer_scheduler, sample_every: int = 8):
+    """Wire a standalone decision ledger into a rig scheduler's
+    ``pick_ledger`` seam (the AdvisorStack does this in production; bare
+    loadgen rigs have no stack).  Returns the ledger, or None when the
+    scheduler predates the seam."""
+    from llm_instance_gateway_tpu.gateway import pickledger
+
+    sched = getattr(outer_scheduler, "_scheduler", outer_scheduler)
+    if not hasattr(sched, "pick_ledger"):
+        return None
+    ledger = pickledger.PickLedger(
+        cfg=pickledger.PickLedgerConfig(sample_every=sample_every))
+    sched.pick_ledger = ledger
+    return ledger
+
+
+def pick_funnel_block(ledger) -> dict | None:
+    """The artifact's ``pick_funnel`` section: per-stage mean narrowing
+    + per-seam steering counts from one ledger's rollup."""
+    if ledger is None:
+        return None
+    ledger.tick()
+    roll = ledger.seam_rollup()
+    return {
+        "samples": roll["samples"],
+        "mean_survivors": roll["mean_survivors"],
+        "steered": roll["steered"],
+        "decisive": roll["decisive"],
+    }
+
+
 CRITICALITY_TIERS = {"critical": Criticality.CRITICAL,
                      "default": Criticality.DEFAULT,
                      "sheddable": Criticality.SHEDDABLE}
@@ -401,8 +432,9 @@ def run_multi_gateway(requests: int = 20000, gateways: int = 4,
     # must not masquerade as (or hide) a scaling regression on either
     # side of the ratio.
     base_front, _, _ = _build_gateway_replica(fixtures, seed, replica=999)
-    fronts = [_build_gateway_replica(fixtures, seed, replica=r)[0]
-              for r in range(gateways)]
+    replicas = [_build_gateway_replica(fixtures, seed, replica=r)
+                for r in range(gateways)]
+    fronts = [front for front, _, _ in replicas]
     base_wall = float("inf")
     best: dict[int, tuple[float, list[float]]] = {}
     for _ in range(3):
@@ -477,6 +509,31 @@ def run_multi_gateway(requests: int = 20000, gateways: int = 4,
                     != res_o.set_headers.get(DEFAULT_TARGET_POD_HEADER)):
                 mismatches += 1
     bus0 = merged[0][2]
+    # Fleet pick funnel: weighted per-stage mean narrowing + per-seam
+    # steering summed across every throughput replica's per-pool ledger
+    # (the AdvisorStack wires one into each scheduler).
+    funnel_samples = 0
+    funnel_means: dict[str, float] = {}
+    funnel_steered: dict[str, int] = {}
+    for _, stacks, _ in replicas:
+        for stack in stacks.values():
+            block = pick_funnel_block(stack.pickledger)
+            if not block or not block["samples"]:
+                continue
+            n = block["samples"]
+            funnel_samples += n
+            for stage, mean in block["mean_survivors"].items():
+                funnel_means[stage] = funnel_means.get(stage, 0.0) + mean * n
+            for seam, count in block["steered"].items():
+                funnel_steered[seam] = funnel_steered.get(seam, 0) + count
+    pick_funnel = {
+        "samples": funnel_samples,
+        "mean_survivors": {
+            stage: round(total / funnel_samples, 2)
+            for stage, total in funnel_means.items()
+        } if funnel_samples else {},
+        "steered": funnel_steered,
+    }
     return {
         "mode": "multi_gateway",
         "gateways": gateways,
@@ -508,6 +565,7 @@ def run_multi_gateway(requests: int = 20000, gateways: int = 4,
             "live_replicas": bus0.live_replicas(),
             "quota_scale": bus0.last_apply_scale,
         },
+        "pick_funnel": pick_funnel,
         "relay_mode": "fast",
         "scheduler": "python",
     }
@@ -677,6 +735,7 @@ def run_load(
         # In-process dispatch: the handler core alone — request parse,
         # admission, pick, header mutation — with ZERO transport framing.
         server = build_handler_server(pods, models, scheduler_factory=factory)
+        ledger = attach_pick_ledger(server.scheduler)
         t_start = time.perf_counter()
         for i in range(requests):
             body, sid, adapter, target = body_for(i)
@@ -720,6 +779,7 @@ def run_load(
 
         server = start_ext_proc(pods, models, port=port,
                                 scheduler_factory=factory)
+        ledger = attach_pick_ledger(server.handler_server.scheduler)
         try:
             channel = grpc.insecure_channel(f"localhost:{port}")
             stub = make_process_stub(channel)
@@ -793,6 +853,12 @@ def run_load(
         # the fast/slow axis alongside the scheduler one.
         "relay_mode": "fast" if fast_path else "slow",
     }
+    funnel = pick_funnel_block(ledger)
+    if funnel is not None:
+        # Per-stage mean narrowing + per-seam steering over the sampled
+        # picks of THIS run (gateway/pickledger.py; no advisors attached
+        # on the bare rig, so steering is the filter tree's alone).
+        out["pick_funnel"] = funnel
     if trace_out:
         # Raw per-request samples in the shape tools/trace_report.py reads
         # ({"phases": {name: [seconds...]}}): the ext-proc Process round

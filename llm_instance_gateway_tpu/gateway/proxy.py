@@ -80,6 +80,7 @@ from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
 from llm_instance_gateway_tpu.gateway import fleetobs
+from llm_instance_gateway_tpu.gateway import pickledger as pickledger_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
 from llm_instance_gateway_tpu.gateway import statebus as statebus_mod
 from llm_instance_gateway_tpu.gateway.advisors import (
@@ -146,6 +147,7 @@ class GatewayProxy:
         fast_relay: bool = True,
         pools: dict | None = None,
         statebus_cfg: "statebus_mod.StateBusConfig | None" = None,
+        pickledger_cfg: "pickledger_mod.PickLedgerConfig | None" = None,
     ):
         self.server = handler_server
         self.provider = provider
@@ -180,6 +182,7 @@ class GatewayProxy:
                     resilience_cfg=resilience_cfg, health_cfg=health_cfg,
                     usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
                     placement_cfg=placement_cfg,
+                    pickledger_cfg=pickledger_cfg,
                     # Scope this pool's admitted-traffic shares to its own
                     # models (the shared GatewayMetrics counts everything).
                     request_filter=(
@@ -205,7 +208,8 @@ class GatewayProxy:
                 metrics=self.metrics, journal=self.journal,
                 resilience_cfg=resilience_cfg, health_cfg=health_cfg,
                 usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
-                placement_cfg=placement_cfg)
+                placement_cfg=placement_cfg,
+                pickledger_cfg=pickledger_cfg)
             self._default_pool = pool_name
             # Scrape failures land in the flight recorder (Provider
             # emits, throttled); StaticProvider lacks the attribute.
@@ -223,6 +227,7 @@ class GatewayProxy:
         self.kvobs = stack.kvobs
         self.fairness = stack.fairness
         self.placement = stack.placement
+        self.pickledger = stack.pickledger
         self._pod_stack_cache: dict[str, AdvisorStack] = {}
         # SLO engine stays gateway-wide: it reads the shared
         # GatewayMetrics histograms, which span every pool this process
@@ -289,6 +294,7 @@ class GatewayProxy:
         app.router.add_get("/debug/health", self.handle_debug_health)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/kv", self.handle_debug_kv)
+        app.router.add_get("/debug/picks", self.handle_debug_picks)
         app.router.add_get("/debug/placement", self.handle_debug_placement)
         app.router.add_get("/debug/statebus", self.handle_debug_statebus)
         app.router.add_get("/debug/fleet", self.handle_debug_fleet)
@@ -425,6 +431,14 @@ class GatewayProxy:
                     "pods": fleetobs.collect_pod_payloads(
                         pods, "/debug/kv", thread_name="blackbox-kv"),
                 }
+                # Decision records at dump time: the last sampled picks
+                # per pool — "why were requests landing where they were in
+                # the 30s before the breach" (tools/blackbox_report.py
+                # renders the funnel + decisive seams).
+                picks_payload = {
+                    name: pickledger_mod.debug_picks_payload(
+                        stack.pickledger, {"limit": "64"})
+                    for name, stack in self.stacks.items()}
                 path = slo_mod.write_blackbox(
                     self.blackbox_dir, reason, journal=self.journal,
                     tracer=self.tracer, metrics_text=self._render_metrics(),
@@ -433,7 +447,8 @@ class GatewayProxy:
                     usage_payload=self.usage.debug_payload(),
                     statebus_payload=self.statebus.debug_payload(),
                     profile_payload=profiles,
-                    kv_payload=kv_payload)
+                    kv_payload=kv_payload,
+                    picks_payload=picks_payload)
                 self._last_dump_t = time.time()
                 self.journal.emit(events_mod.BREACH_DUMP, model=model,
                                   objective=objective, path=path)
@@ -1431,6 +1446,25 @@ class GatewayProxy:
                 for name, stack in self.stacks.items()}
         return web.json_response(payload)
 
+    async def handle_debug_picks(self, request: web.Request) -> web.Response:
+        """The routing decision ledger (gateway/pickledger.py): sampled
+        per-pick explanation records — stage-by-stage candidate
+        narrowing, removed-pod attribution, escape-hatch fires, and the
+        counterfactual "decisive seam" tag.  ``?since=<seq>`` incremental
+        cursor + ``?limit=`` cap, mirroring /debug/events; records join
+        traces via their ``trace_id`` (the ``x-lig-trace-id`` the proxy
+        mints).  Multi-pool fronts add a ``pools`` section.  Rendered by
+        ``tools/pick_report.py``; the fast-burn black-box dump embeds the
+        same payload."""
+        payload = pickledger_mod.debug_picks_payload(
+            self.pickledger, request.query)
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: pickledger_mod.debug_picks_payload(
+                    stack.pickledger, request.query)
+                for name, stack in self.stacks.items()}
+        return web.json_response(payload)
+
     async def handle_debug_placement(self, request: web.Request) -> web.Response:
         """The placement plane's state + this tick's decisions — the wire
         ``tools/lora_sidecar.py --planner-url`` polls.  Floored at the
@@ -1492,6 +1526,11 @@ class GatewayProxy:
         # second pull; per-pod joins live at /debug/kv.
         self.kvobs.maybe_tick(max(1.0, self.obs_tick_s))
         payload["kv"] = self.kvobs.debug_payload()
+        # Fleet pick-steering rollup: which replicas/pools are steering
+        # picks and why, joined from the statebus docs already gossiped
+        # (no extra pull) — per-pick joins live at /debug/picks.
+        payload["picks"] = fleetobs.pick_steering_rollup(
+            self.statebus.all_docs())
         return web.json_response(payload)
 
     async def handle_statebus_exchange(
@@ -1554,6 +1593,13 @@ def main(argv: list[str] | None = None) -> None:
                         help="disable the zero-copy SSE relay fast path "
                              "(falls back to the line-scanning relay; the "
                              "A/B axis for byte-parity and perf checks)")
+    parser.add_argument("--no-pick-ledger", action="store_true",
+                        help="disable the routing decision ledger "
+                             "(/debug/picks goes empty; routing itself is "
+                             "unchanged either way — the ledger is log-only)")
+    parser.add_argument("--pick-sample-every", type=int, default=8,
+                        help="sample every Nth pick into the decision "
+                             "ledger (1 = every pick; default 8)")
     bootstrap.add_common_args(parser)
     bootstrap.add_resilience_args(parser)
     bootstrap.add_statebus_args(parser)
@@ -1565,6 +1611,9 @@ def main(argv: list[str] | None = None) -> None:
                          fairness_cfg=bootstrap.fairness_from_args(args),
                          placement_cfg=bootstrap.placement_from_args(args),
                          fast_relay=not args.no_fast_relay,
+                         pickledger_cfg=pickledger_mod.PickLedgerConfig(
+                             enabled=not args.no_pick_ledger,
+                             sample_every=max(1, args.pick_sample_every)),
                          pools=getattr(comps, "pools", None),
                          statebus_cfg=bootstrap.statebus_from_args(
                              args, port=args.port))

@@ -48,6 +48,7 @@ from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
     Scheduler,
     SchedulingError,
     build_decode_tree,
+    build_default_tree,
     filter_by_fairness,
     filter_by_placement,
     filter_by_policy,
@@ -263,6 +264,13 @@ class NativeScheduler:
         # seam, and it keeps the fuzz-pinned C++ candidate parity for the
         # main tree untouched.
         self._decode_tree = build_decode_tree(cfg, token_aware=token_aware)
+        # Python-oracle tree for the pick ledger's shadow replay: sampled
+        # native picks are EXPLAINED by re-running this tree + the silent
+        # advisor chain in Python (gateway/pickledger.py) — the FFI hot
+        # path never grows a crossing for observability.  Inert until a
+        # ledger is attached.
+        self._oracle_tree = build_default_tree(
+            cfg, token_aware=token_aware, prefill_aware=prefill_aware)
         # Snapshot-resident native state: ``_state`` is keyed on the
         # provider's monotonic snapshot version (plus policy/config
         # generations) and re-marshalled only when one of them moves;
@@ -300,6 +308,11 @@ class NativeScheduler:
         # nothing and keeps byte-exact parity, note_pick counting in
         # Python over the planner's own map.
         self.placement_advisor = None
+        # Decision-ledger seam (gateway/pickledger.py) — same contract as
+        # the Python Scheduler's pick_ledger: counter-modulus sampling
+        # (no RNG, no filtering, routing byte-identical), with sampled
+        # picks explained via the Python-oracle shadow replay above.
+        self.pick_ledger = None
 
     # -- marshalling --------------------------------------------------------
     def _policy_and_avoid(self) -> tuple[str, frozenset]:
@@ -560,6 +573,9 @@ class NativeScheduler:
         self._cfg_gen += 1
         self._decode_tree = build_decode_tree(
             cfg, token_aware=self.token_aware)
+        self._oracle_tree = build_default_tree(
+            cfg, token_aware=self.token_aware,
+            prefill_aware=self.prefill_aware)
 
     def _snapshot_pods(self):
         snapshot = getattr(self._provider, "snapshot", None)
@@ -568,13 +584,16 @@ class NativeScheduler:
         return None, self._provider.all_pod_metrics()
 
     def _routable_pods(self):
-        """(pods, version) after the single-hop role policy, with the
-        O(pods) role partition cached per snapshot version — the per-pick
-        path must not re-walk 200 pods to rediscover an unchanged split."""
+        """(pods, version, pool_total) after the single-hop role policy,
+        with the O(pods) role partition cached per snapshot version — the
+        per-pick path must not re-walk 200 pods to rediscover an
+        unchanged split.  ``pool_total`` is the pre-partition pool size
+        (the pick ledger's funnel head)."""
         version, pods = self._snapshot_pods()
         cache = self._role_cache
         if version is not None and cache is not None and cache[0] == version:
-            return cache[1], cache[2]
+            return cache[1], cache[2], cache[3]
+        total = len(pods)
         collocated = [pm for pm in pods
                       if pod_role(pm.pod) == ROLE_COLLOCATED]
         if collocated and len(collocated) != len(pods):
@@ -582,12 +601,13 @@ class NativeScheduler:
         else:
             use, use_version = pods, version
         if version is not None:
-            self._role_cache = (version, use, use_version)
-        return use, use_version
+            self._role_cache = (version, use, use_version, total)
+        return use, use_version, total
 
     # -- pick ---------------------------------------------------------------
     def _finish_pick(self, req: LLMRequest, pods: list[PodMetrics],
-                     cand: list[int], flags: int) -> Pod:
+                     cand: list[int], flags: int, hop: str = "single",
+                     pool_n: int = 0) -> Pod:
         """Post-candidate seams, identical to Scheduler._pick ordering:
         escape-hatch note, prefix tie-break, RNG draw, note_pick hooks.
 
@@ -616,10 +636,12 @@ class NativeScheduler:
             if note is not None:
                 note()
         pick = None
+        tie_break = False
         if self.prefix_index is not None and req.prefix_hashes:
             held = self.prefix_index.prefer(req, [pods[i] for i in cand])
             if held is not None:
                 pick = held.pod
+                tie_break = True
         if pick is None:
             pick = pods[cand[self._rng.randrange(len(cand))]].pod
         if self.prefix_index is not None and req.prefix_hashes:
@@ -631,14 +653,52 @@ class NativeScheduler:
         if self.placement_advisor is not None:
             self.placement_advisor.note_pick(
                 pick.name, req.resolved_target_model)
+        ledger = self.pick_ledger
+        if ledger is not None and ledger.sampled():
+            self._charge_shadow(ledger, req, pods, cand, flags, hop,
+                                pool_n, tie_break, pick)
         return pick
+
+    def _charge_shadow(self, ledger, req: LLMRequest,
+                       pods: list[PodMetrics], cand: list[int], flags: int,
+                       hop: str, pool_n: int, tie_break: bool,
+                       pick: Pod) -> None:
+        """Explain a sampled native pick via Python-oracle shadow replay:
+        the oracle tree + silent advisor chain over the SAME pods list
+        the native pick saw.  ``shadow_match`` records whether the replay
+        reproduced the native candidate set — a truthfulness observable
+        (the same-RNG diff tests pin the paths byte-identical), never an
+        assert.  Off the FFI path entirely; sampled picks only."""
+        advisors = (self.health_advisor, self.usage_advisor,
+                    self.placement_advisor)
+        try:
+            base = self._oracle_tree.filter(req, list(pods))
+        except FilterError:
+            # The oracle sheds where the native path served (snapshot
+            # skew): fall back to the native candidates as the funnel
+            # head — still a truthful record of what survived.
+            base = [pods[i] for i in cand]
+        post_health, post_fairness, final = ledger.replay(
+            req, base, advisors)
+        actual = {pods[i].pod.name for i in cand}
+        shadow_match = {pm.pod.name for pm in final} == actual
+        escapes = [seam for bit, seam in
+                   ((1, "health/circuit"), (4, "fairness"),
+                    (8, "placement")) if flags & bit]
+        ledger.charge(
+            req, winner=pick.name, base=base, post_health=post_health,
+            post_fairness=post_fairness, post_placement=final, hop=hop,
+            path="native-shadow", pool_n=pool_n or len(pods),
+            role_n=len(pods), tie_break=tie_break, advisors=advisors,
+            escapes=escapes, trace_id=req.trace_id,
+            shadow_match=shadow_match)
 
     def schedule(self, req: LLMRequest) -> Pod:
         # Same role policy as the Python Scheduler: single-hop traffic
         # prefers collocated replicas; a role-filtered SUBSET bypasses the
         # snapshot-version resident state (it keys on (version, n) and a
         # subset would poison it).
-        pods, version = self._routable_pods()
+        pods, version, pool_total = self._routable_pods()
         if not pods:
             raise SchedulingError(
                 "failed to apply filter, resulted 0 pods: no pods", shed=True)
@@ -646,7 +706,7 @@ class NativeScheduler:
             state = self._ensure_state(version, pods)
             count, flags = self._pick_candidates_locked(state, req)
             cand = state.out[:count].tolist()
-        return self._finish_pick(req, pods, cand, flags)
+        return self._finish_pick(req, pods, cand, flags, pool_n=pool_total)
 
     def pick_many(self, reqs: list[LLMRequest]) -> list[Pod]:
         """Batched scheduling: ONE FFI crossing for the whole batch (the
@@ -656,7 +716,7 @@ class NativeScheduler:
         shed ``SchedulingError`` at the first request that sheds."""
         if not reqs:
             return []
-        pods, version = self._routable_pods()
+        pods, version, pool_total = self._routable_pods()
         if not pods:
             raise SchedulingError(
                 "failed to apply filter, resulted 0 pods: no pods", shed=True)
@@ -701,7 +761,8 @@ class NativeScheduler:
                 raise SchedulingError(f"native scheduler error {count}")
             cand = cands[r_idx * n:r_idx * n + count].tolist()
             picks.append(self._finish_pick(
-                reqs[r_idx], pods, cand, int(flags[r_idx])))
+                reqs[r_idx], pods, cand, int(flags[r_idx]),
+                pool_n=pool_total))
         return picks
 
     def schedule_disaggregated(
@@ -719,19 +780,25 @@ class NativeScheduler:
             state = self._ensure_state(None, prefills)
             count, flags = self._pick_candidates_locked(state, req)
             cand = state.out[:count].tolist()
-        prefill_pod = self._finish_pick(req, prefills, cand, flags)
+        prefill_pod = self._finish_pick(req, prefills, cand, flags,
+                                        hop="prefill", pool_n=len(pods))
+        ledger = self.pick_ledger
+        sampled = ledger is not None and ledger.sampled()
+        if sampled:
+            escape_base = ledger.escape_counters(
+                self.health_advisor, self.usage_advisor,
+                self.placement_advisor)
         try:
-            decode_survivors = self._decode_tree.filter(req, decodes)
+            decode_base = self._decode_tree.filter(req, decodes)
         except FilterError as e:
             raise SchedulingError(
                 f"no decode replica for disaggregated request: {e}",
                 shed=e.shed) from e
-        decode_survivors = filter_by_policy(
-            self.health_advisor, decode_survivors)
-        decode_survivors = filter_by_fairness(
-            self.usage_advisor, req, decode_survivors)
+        decode_health = filter_by_policy(self.health_advisor, decode_base)
+        decode_fairness = filter_by_fairness(
+            self.usage_advisor, req, decode_health)
         decode_survivors = filter_by_placement(
-            self.placement_advisor, req, decode_survivors)
+            self.placement_advisor, req, decode_fairness)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
@@ -741,6 +808,17 @@ class NativeScheduler:
         if self.placement_advisor is not None:
             self.placement_advisor.note_pick(
                 decode_pod.name, req.resolved_target_model)
+        if sampled:
+            # The decode hop IS the Python path here (tree + filters run
+            # in Python above) — charged directly, no shadow needed.
+            ledger.charge(
+                req, winner=decode_pod.name, base=decode_base,
+                post_health=decode_health, post_fairness=decode_fairness,
+                post_placement=decode_survivors, hop="decode",
+                path="python", pool_n=len(pods), role_n=len(decodes),
+                advisors=(self.health_advisor, self.usage_advisor,
+                          self.placement_advisor),
+                escape_base=escape_base, trace_id=req.trace_id)
         return prefill_pod, decode_pod
 
 

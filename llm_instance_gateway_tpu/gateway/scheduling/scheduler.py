@@ -460,6 +460,12 @@ class Scheduler:
         # ``prefer_resident`` the survivor set additionally passes through
         # ``filter_by_placement`` after the fairness filter.
         self.placement_advisor: Any = None
+        # Decision-ledger seam (gateway/pickledger.py, set by the proxy).
+        # Sampling is a counter modulus — no RNG draws, no filtering —
+        # so routing stays byte-identical with the ledger attached
+        # (pinned by same-RNG diff tests); all record/counterfactual
+        # work rides sampled picks only.
+        self.pick_ledger: Any = None
 
     def update_config(self, cfg: SchedulerConfig) -> None:
         """Swap thresholds at runtime (pool hot-reload); rebuilds the tree.
@@ -495,22 +501,34 @@ class Scheduler:
             raise SchedulingError("failed to apply filter, resulted 0 pods")
         return survivors
 
-    def _pick(self, req: LLMRequest, survivors: Sequence[PodMetrics]) -> Pod:
+    def _pick(self, req: LLMRequest, survivors: Sequence[PodMetrics],
+              hop: str = "single", pool_n: int = 0, role_n: int = 0) -> Pod:
         # Enforcing health policy narrows the candidate set FIRST, so the
         # prefix-affinity tie-break can't pin a request to an avoided
         # holder (log_only returns the set unchanged); fairness
         # deprioritization runs over whatever survives it.
-        survivors = filter_by_policy(self.health_advisor, list(survivors))
-        survivors = filter_by_fairness(self.usage_advisor, req, survivors)
-        survivors = filter_by_placement(self.placement_advisor, req,
-                                        survivors)
+        ledger = self.pick_ledger
+        sampled = ledger is not None and ledger.sampled()
+        base = survivors
+        if sampled:
+            escape_base = ledger.escape_counters(
+                self.health_advisor, self.usage_advisor,
+                self.placement_advisor)
+            base = list(survivors)  # pin the funnel head for the record
+        post_health = filter_by_policy(self.health_advisor, base)
+        post_fairness = filter_by_fairness(self.usage_advisor, req,
+                                           post_health)
+        final = filter_by_placement(self.placement_advisor, req,
+                                    post_fairness)
         pick = None
+        tie_break = False
         if self.prefix_index is not None and req.prefix_hashes:
-            held = self.prefix_index.prefer(req, survivors)
+            held = self.prefix_index.prefer(req, final)
             if held is not None:
                 pick = held.pod
+                tie_break = True
         if pick is None:
-            pick = survivors[self._rng.randrange(len(survivors))].pod
+            pick = final[self._rng.randrange(len(final))].pod
         if self.prefix_index is not None and req.prefix_hashes:
             # The pick is about to prefill (and, with the engine's prefix
             # cache on, retain) this prefix: future lookups route here.
@@ -522,6 +540,15 @@ class Scheduler:
         if self.placement_advisor is not None:
             self.placement_advisor.note_pick(
                 pick.name, req.resolved_target_model)
+        if sampled:
+            ledger.charge(
+                req, winner=pick.name, base=base, post_health=post_health,
+                post_fairness=post_fairness, post_placement=final,
+                hop=hop, path="python", pool_n=pool_n, role_n=role_n,
+                tie_break=tie_break,
+                advisors=(self.health_advisor, self.usage_advisor,
+                          self.placement_advisor),
+                escape_base=escape_base, trace_id=req.trace_id)
         return pick
 
     def schedule(self, req: LLMRequest) -> Pod:
@@ -533,7 +560,9 @@ class Scheduler:
         # replica can take it (roles are advisory, engines are complete).
         collocated = [pm for pm in pods
                       if pod_role(pm.pod) == ROLE_COLLOCATED]
-        return self._pick(req, self._survivors(req, collocated or list(pods)))
+        role_set = collocated or list(pods)
+        return self._pick(req, self._survivors(req, role_set),
+                          pool_n=len(pods), role_n=len(role_set))
 
     def schedule_disaggregated(
         self, req: LLMRequest
@@ -553,20 +582,27 @@ class Scheduler:
         if not prefills or not decodes:
             return self.schedule(req), None
         t0 = time.perf_counter()
-        prefill_pod = self._pick(req, self._survivors(req, prefills))
+        prefill_pod = self._pick(req, self._survivors(req, prefills),
+                                 hop="prefill", pool_n=len(pods),
+                                 role_n=len(prefills))
         t1 = time.perf_counter()
+        ledger = self.pick_ledger
+        sampled = ledger is not None and ledger.sampled()
+        if sampled:
+            escape_base = ledger.escape_counters(
+                self.health_advisor, self.usage_advisor,
+                self.placement_advisor)
         try:
-            decode_survivors = self._decode_tree.filter(req, decodes)
+            decode_base = self._decode_tree.filter(req, decodes)
         except FilterError as e:
             raise SchedulingError(
                 f"no decode replica for disaggregated request: {e}",
                 shed=e.shed) from e
-        decode_survivors = filter_by_policy(
-            self.health_advisor, decode_survivors)
-        decode_survivors = filter_by_fairness(
-            self.usage_advisor, req, decode_survivors)
+        decode_health = filter_by_policy(self.health_advisor, decode_base)
+        decode_fairness = filter_by_fairness(
+            self.usage_advisor, req, decode_health)
         decode_survivors = filter_by_placement(
-            self.placement_advisor, req, decode_survivors)
+            self.placement_advisor, req, decode_fairness)
         decode_pod = decode_survivors[
             self._rng.randrange(len(decode_survivors))].pod
         if self.health_advisor is not None:
@@ -576,6 +612,15 @@ class Scheduler:
         if self.placement_advisor is not None:
             self.placement_advisor.note_pick(
                 decode_pod.name, req.resolved_target_model)
+        if sampled:
+            ledger.charge(
+                req, winner=decode_pod.name, base=decode_base,
+                post_health=decode_health, post_fairness=decode_fairness,
+                post_placement=decode_survivors, hop="decode",
+                path="python", pool_n=len(pods), role_n=len(decodes),
+                advisors=(self.health_advisor, self.usage_advisor,
+                          self.placement_advisor),
+                escape_base=escape_base, trace_id=req.trace_id)
         # Per-hop pick split for the tracing layer (the admission span's
         # attribution of "pick" into prefill-hop vs decode-hop cost).
         req.pick_hops_s = (t1 - t0, time.perf_counter() - t1)

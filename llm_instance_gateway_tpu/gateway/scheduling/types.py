@@ -91,3 +91,8 @@ class LLMRequest:
     # pick-time split of a disaggregated two-stage pick.
     admission_wait_s: float = 0.0
     pick_hops_s: tuple | None = None
+    # The request's x-lig-trace-id (minted by the transport before
+    # scheduling): lets the pick ledger's decision records join the
+    # request's trace/span timeline.  Empty for callers without tracing
+    # (sim, bench) — the ledger records it verbatim.
+    trace_id: str = ""

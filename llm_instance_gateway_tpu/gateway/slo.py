@@ -386,6 +386,7 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
                    statebus_payload: dict | None = None,
                    profile_payload: dict | None = None,
                    kv_payload: dict | None = None,
+                   picks_payload: dict | None = None,
                    clock=time.time) -> str:
     """Write the black-box dump for one breach; returns the file path.
 
@@ -424,6 +425,10 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
         # was the pool burning because its KV budget was parked or
         # duplicated?  ``tools/blackbox_report.py`` renders the section.
         "kv": kv_payload,
+        # Routing decisions near the breach (gateway/pickledger.py):
+        # where WERE requests landing, and which advisor seam steered
+        # them there?  Per-pool cursor payloads with sampled records.
+        "picks": picks_payload,
         "metrics_text": metrics_text,
     }
     tmp = path + ".tmp"

@@ -160,6 +160,14 @@ class StateBus:
                 "buckets": stack.fairness.bucket_levels(),
                 "shares": [[m, a, round(v, 4)] for (m, a), v in
                            sorted(stack.usage.shares_snapshot().items())],
+                # Pick-ledger steering rollup (gateway/pickledger.py):
+                # swap-published read, never blocks a pick.  Peers fold
+                # these into the /debug/fleet steering view
+                # (fleetobs.pick_steering_rollup); merged_overlays
+                # ignores unknown keys, so pre-ledger peers interop.
+                "picks": (stack.pickledger.seam_rollup()
+                          if getattr(stack, "pickledger", None)
+                          is not None else {}),
             }
         now = self._clock()
         with self._lock:

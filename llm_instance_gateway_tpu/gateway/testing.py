@@ -126,6 +126,9 @@ def start_ext_proc(
     grpc_server = build_grpc_server(
         handler_server, handler_server.datastore, port=port)
     grpc_server.start()
+    # Rigs that instrument the scheduler seams (loadgen's pick-funnel
+    # block) reach the wrapped core through this attribute.
+    grpc_server.handler_server = handler_server
     return grpc_server
 
 

@@ -220,6 +220,22 @@ GATEWAY_FAMILIES = (
            "Replica count holding each duplicated prefix (top rows by "
            "duplicated blocks; prefix = content-addressed 16-hex id).",
            GATEWAY_SURFACE),
+    Family("gateway_pick_sample_total", "counter", (),
+           "Picks recorded by the routing decision ledger "
+           "(gateway/pickledger.py; deterministic every-Nth sampling — "
+           "multiply by the configured sample_every to estimate pick "
+           "volume).", GATEWAY_SURFACE),
+    Family("gateway_pick_narrowing", "gauge", ("stage",),
+           "Mean surviving candidates after each pick stage across "
+           "sampled picks (pool -> role_partition -> filter_tree -> "
+           "health/circuit -> fairness -> placement -> prefix_affinity "
+           "-> rng): the funnel /debug/picks itemizes per record.",
+           GATEWAY_SURFACE),
+    Family("gateway_pick_steered_total", "counter", ("seam",),
+           "Sampled picks whose final survivor set the counterfactual "
+           "replay shows this advisor seam changed (disabling the seam "
+           "yields a different set) — the 'why pod X' attribution.",
+           GATEWAY_SURFACE),
     Family("gateway_events_total", "counter", ("kind",),
            "Flight-recorder events by kind (events.py; the journal itself "
            "is served by /debug/events).", GATEWAY_SURFACE),

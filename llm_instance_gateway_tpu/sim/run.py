@@ -143,7 +143,7 @@ class _SimProvider:
 
 def make_router(policy: str, servers: list[SimServer], seed: int = 0,
                 scheduler_cfg=None, prefix_index=None,
-                placement_advisor=None):
+                placement_advisor=None, pick_ledger=None):
     rng = pyrandom.Random(seed)
     by_name = {s.pod.name: s for s in servers}
     if policy == "random":
@@ -188,6 +188,10 @@ def make_router(policy: str, servers: list[SimServer], seed: int = 0,
                               **kwargs)
         if policy == "production_placement" and placement_advisor is not None:
             scheduler.placement_advisor = placement_advisor
+        if pick_ledger is not None:
+            # Decision-ledger seam for sim decision-parity studies: the
+            # log-only invariant means attaching it never moves a pick.
+            scheduler.pick_ledger = pick_ledger
 
         def route(req: SimRequest):
             llm_req = LLMRequest(

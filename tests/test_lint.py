@@ -376,6 +376,25 @@ def test_metric_currency_flags_unregistered_kv_family(tmp_path):
                for f in found), messages(found)
 
 
+def test_metric_currency_flags_unregistered_pick_family(tmp_path):
+    """ISSUE 18 satellite: a ``gateway_pick_*`` family rendered by the
+    decision ledger without a registry entry fails ``make lint`` — the
+    explainability surface stays operator-visible like every other
+    plane's."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/metrics_registry.py": REGISTRY_FIXTURE.replace(
+            '    Family("gateway_dead_total", "counter", (), "help", '
+            '"s"),\n', ""),
+        f"{PKG}/gateway/pickledger.py":
+            'def render(self):\n'
+            '    return ["# TYPE gateway_pick_phantom_total counter",\n'
+            '            f"gateway_pick_phantom_total {self.n}"]\n'})
+    found = run_rule(root, "metric-currency")
+    assert any("gateway_pick_phantom_total" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+
+
 # -- event-kinds ------------------------------------------------------------
 
 EVENTS_FIXTURE = 'PICK = "pick"\nSHED = "shed"\n'
@@ -456,6 +475,24 @@ def test_event_kinds_flags_undeclared_kv_event(tmp_path):
     assert any("'kv_dedup_regret'" in f.message
                for f in found), messages(found)
     assert not any("'kv_duplication'" in f.message for f in found)
+
+
+def test_event_kinds_flags_undeclared_pick_event(tmp_path):
+    """ISSUE 18 satellite: a decision-ledger event kind emitted without
+    an events.py constant fails — ``pick_sample``/``pick_escape_explained``
+    must stay declared or the blackbox narration and the events_total
+    contract lose them."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/events.py": EVENTS_FIXTURE
+        + 'PICK_SAMPLE = "pick_sample"\n',
+        f"{PKG}/gateway/pickledger.py":
+            "def charge(self, journal):\n"
+            "    journal.emit('pick_sample', winner='pod-0')\n"
+            "    journal.emit('pick_explained_wrong', winner='pod-0')\n"})
+    found = run_rule(root, "event-kinds")
+    assert any("'pick_explained_wrong'" in f.message
+               for f in found), messages(found)
+    assert not any("'pick_sample'" in f.message for f in found)
 
 
 # -- label-hygiene ----------------------------------------------------------

@@ -1,0 +1,498 @@
+"""Routing decision ledger: per-pick explainability + counterfactual
+seam attribution (gateway/pickledger.py).
+
+The contract under test, in order of importance:
+
+1. **Log-only invariant** — attaching the ledger NEVER moves a pick.
+   Same-RNG diff tests pin the routing sequence byte-identical with the
+   ledger on vs off, on the Python scheduler AND the native scheduler,
+   with every advisor plane composed in enforcement mode.
+2. **Truthful records** — the stage funnel, removed-pod attribution,
+   escape hatches, counterfactual steering, and the decisive-seam tag
+   reflect what the filter chain actually did.
+3. **Surfaces** — the /debug/picks cursor pages without skips, the
+   gateway_pick_* families survive hostile labels, blackbox dumps from
+   before a payload section render an UNAVAILABLE marker (not a stack
+   trace), and lig_top/pick_report render the records.
+"""
+
+import json
+import random
+
+import pytest
+
+from llm_instance_gateway_tpu.gateway import pickledger
+from llm_instance_gateway_tpu.gateway.pickledger import (
+    PickLedger,
+    PickLedgerConfig,
+    debug_picks_payload,
+)
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.scheduling import native
+from llm_instance_gateway_tpu.gateway.scheduling.scheduler import Scheduler
+from llm_instance_gateway_tpu.gateway.scheduling.types import LLMRequest
+from llm_instance_gateway_tpu.gateway.testing import fake_metrics, fake_pod
+from llm_instance_gateway_tpu.gateway.types import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    PodMetrics,
+)
+
+from tests.test_exposition_contract import lint_exposition
+
+
+# -- advisor fakes (enforcement-mode, minimal seam surface) -----------------
+
+class FakeHealth:
+    """filter_by_policy seam: avoid-policy advisor without avoid_set
+    batching (exercises the should_avoid fallback)."""
+
+    policy = "avoid"
+
+    def __init__(self, avoid=()):
+        self.avoid = set(avoid)
+        self.escape_hatch_total = 0
+        self.picks = []
+
+    def should_avoid(self, name):
+        return name in self.avoid
+
+    def note_escape_hatch(self):
+        self.escape_hatch_total += 1
+
+    def note_pick(self, name):
+        self.picks.append(name)
+
+
+class FakeFairness:
+    """filter_by_fairness seam: deprioritize-mode advisor; marked pods
+    are derived from active_adapters (no noisy_pods cache)."""
+
+    mode = "deprioritize"
+
+    def __init__(self, flagged=()):
+        self._flagged = frozenset(flagged)
+        self.escape_total = 0
+
+    def noisy(self):
+        return self._flagged
+
+    def note_fairness_escape(self):
+        self.escape_total += 1
+
+    def note_pick(self, name, model):
+        pass
+
+
+class FakePlacement:
+    """filter_by_placement seam: flat (single-tier) resident map."""
+
+    mode = "prefer_resident"
+
+    def __init__(self, resident=None):
+        self._resident = resident or {}
+        self.escape_total = 0
+
+    def resident_pods(self, adapter):
+        return self._resident.get(adapter)
+
+    def note_placement_escape(self):
+        self.escape_total += 1
+
+    def note_pick(self, name, adapter):
+        pass
+
+
+def uniform_pods(n, adapters=None, role="collocated"):
+    """Identical metrics so the filter tree passes every pod through and
+    the advisor seams are the only narrowing stages."""
+    return [
+        PodMetrics(pod=fake_pod(i, role=role),
+                   metrics=fake_metrics(adapters=dict(adapters or {})))
+        for i in range(n)
+    ]
+
+
+def make_sched(pods, seed=0, ledger=None, health=None, fairness=None,
+               placement=None, prefix_aware=False):
+    sched = Scheduler(StaticProvider(pods), prefix_aware=prefix_aware,
+                      rng=random.Random(seed))
+    sched.health_advisor = health
+    sched.usage_advisor = fairness
+    sched.placement_advisor = placement
+    if ledger is not None:
+        sched.pick_ledger = ledger
+    return sched
+
+
+def req_for(model="m", adapter=None, trace_id="", prefix=()):
+    return LLMRequest(model=model, resolved_target_model=adapter or model,
+                      critical=True, prompt_tokens=25,
+                      criticality="Critical", trace_id=trace_id,
+                      prefix_hashes=tuple(prefix))
+
+
+# -- sampling ---------------------------------------------------------------
+
+class TestSampling:
+    def test_deterministic_modulus_first_pick_sampled(self):
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=4))
+        pattern = [led.sampled() for _ in range(9)]
+        assert pattern == [True, False, False, False,
+                           True, False, False, False, True]
+
+    def test_disabled_never_samples(self):
+        led = PickLedger(cfg=PickLedgerConfig(enabled=False))
+        assert not any(led.sampled() for _ in range(10))
+
+    def test_sampling_never_consumes_scheduler_rng(self):
+        pods = uniform_pods(6)
+        a = make_sched(pods, seed=3)
+        b = make_sched(pods, seed=3,
+                       ledger=PickLedger(cfg=PickLedgerConfig(
+                           sample_every=1)))
+        picks_a = [a.schedule(req_for()).name for _ in range(50)]
+        picks_b = [b.schedule(req_for()).name for _ in range(50)]
+        assert picks_a == picks_b
+
+
+# -- record truthfulness ----------------------------------------------------
+
+class TestRecords:
+    def test_funnel_removed_attribution_and_decisive(self):
+        pods = uniform_pods(5)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        sched = make_sched(pods, ledger=led,
+                           health=FakeHealth(avoid={"pod-1"}))
+        sched.schedule(req_for(trace_id="t-42"))
+        rec = led.records()[0]
+        stages = {row["stage"]: row for row in rec["stages"]}
+        assert [row["stage"] for row in rec["stages"]] == list(
+            pickledger.STAGES)
+        assert stages["pool"]["survivors"] == 5
+        assert stages["filter_tree"]["survivors"] == 5
+        assert stages["health/circuit"]["survivors"] == 4
+        assert stages["health/circuit"]["removed"] == ["pod-1"]
+        assert stages["placement"]["survivors"] == 4
+        assert rec["trace_id"] == "t-42"
+        assert rec["path"] == "python" and rec["hop"] == "single"
+        # Counterfactual: without the health seam pod-1 is back in the
+        # final set -> steered, decisive.
+        assert rec["steered"] == ["health/circuit"]
+        assert rec["decisive"] == "health/circuit"
+        cf = rec["counterfactual"]["health/circuit"]
+        assert cf["changed"] and cf["delta"] == 1
+        assert cf["would_add"] == ["pod-1"]
+        # Untouched seams carry the compact no-op row.
+        assert rec["counterfactual"]["fairness"] == {
+            "changed": False, "delta": 0}
+        led.tick()
+        assert led.seam_rollup()["steered_away"] == {"pod-1": 1}
+
+    def test_decisive_seam_is_largest_delta(self):
+        # Health removes one pod; fairness removes two (they host the
+        # flagged adapter) -> fairness has the larger counterfactual
+        # delta and wins the decisive tag.
+        pods = [
+            PodMetrics(pod=fake_pod(0),
+                       metrics=fake_metrics(adapters={"noisy": 1})),
+            PodMetrics(pod=fake_pod(1),
+                       metrics=fake_metrics(adapters={"noisy": 1})),
+            PodMetrics(pod=fake_pod(2), metrics=fake_metrics()),
+            PodMetrics(pod=fake_pod(3), metrics=fake_metrics()),
+            PodMetrics(pod=fake_pod(4), metrics=fake_metrics()),
+        ]
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        sched = make_sched(pods, ledger=led,
+                           health=FakeHealth(avoid={"pod-4"}),
+                           fairness=FakeFairness(flagged={"noisy"}))
+        sched.schedule(req_for(model="quiet"))
+        rec = led.records()[0]
+        assert set(rec["steered"]) == {"health/circuit", "fairness"}
+        assert rec["counterfactual"]["fairness"]["delta"] == 2
+        assert rec["counterfactual"]["health/circuit"]["delta"] == 1
+        assert rec["decisive"] == "fairness"
+
+    def test_escape_hatch_recorded_not_steered(self):
+        # Every pod avoidable: filter_by_policy returns the full set
+        # (escape hatch) -> the record carries the escape, and the
+        # replay-skip logic keeps the seam out of `steered` (disabling a
+        # filter that removed nothing changes nothing).
+        pods = uniform_pods(3)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        health = FakeHealth(avoid={"pod-0", "pod-1", "pod-2"})
+        sched = make_sched(pods, ledger=led, health=health)
+        sched.schedule(req_for())
+        rec = led.records()[0]
+        assert rec["escapes"] == ["health/circuit"]
+        assert rec["steered"] == []
+        assert rec["decisive"] == "rng"
+        led.tick()
+        assert led.seam_rollup()["escapes"] == {"health/circuit": 1}
+
+    def test_prefix_tie_break_decisive(self):
+        pods = uniform_pods(4)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        sched = make_sched(pods, ledger=led, prefix_aware=True)
+        sched.schedule(req_for(prefix=(11,)))     # records the holder
+        sched.schedule(req_for(prefix=(11,)))     # tie-breaks to it
+        rec = led.records()[1]
+        assert rec["tie_break"] is True
+        assert rec["decisive"] == "prefix_affinity"
+        assert rec["stages"][-2]["stage"] == "prefix_affinity"
+        assert rec["stages"][-2]["survivors"] == 1
+
+    def test_disagg_hops_share_trace(self):
+        pods = (uniform_pods(3, role=ROLE_PREFILL)
+                + [PodMetrics(pod=fake_pod(i + 3, role=ROLE_DECODE),
+                              metrics=fake_metrics()) for i in range(3)])
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        sched = make_sched(pods, ledger=led)
+        prefill, decode = sched.schedule_disaggregated(
+            req_for(trace_id="t-disagg"))
+        assert decode is not None
+        recs = led.records()
+        assert [r["hop"] for r in recs] == ["prefill", "decode"]
+        assert {r["trace_id"] for r in recs} == {"t-disagg"}
+        assert recs[0]["winner"] == prefill.name
+        assert recs[1]["winner"] == decode.name
+
+
+# -- log-only invariant (same-RNG diff, all planes composed) ----------------
+
+class TestLogOnlyInvariant:
+    def _run(self, ledger):
+        pods = [
+            PodMetrics(pod=fake_pod(i),
+                       metrics=fake_metrics(
+                           adapters={"noisy": 1} if i < 2 else {"a2": 1}))
+            for i in range(6)
+        ]
+        sched = make_sched(
+            pods, seed=11, ledger=ledger,
+            health=FakeHealth(avoid={"pod-3"}),
+            fairness=FakeFairness(flagged={"noisy"}),
+            placement=FakePlacement(
+                resident={"a2": frozenset({"pod-4", "pod-5"})}),
+            prefix_aware=True)
+        picks = []
+        for i in range(120):
+            req = req_for(model=("noisy" if i % 3 == 0 else "quiet"),
+                          adapter=("a2" if i % 2 == 0 else None),
+                          prefix=((i % 5,) if i % 4 == 0 else ()))
+            picks.append(sched.schedule(req).name)
+        return picks
+
+    def test_python_routing_identical_ledger_on_off(self):
+        off = self._run(None)
+        on = self._run(PickLedger(cfg=PickLedgerConfig(sample_every=1)))
+        assert off == on
+
+    def test_ledger_disabled_is_identical_too(self):
+        off = self._run(None)
+        dis = self._run(PickLedger(cfg=PickLedgerConfig(enabled=False)))
+        assert off == dis
+
+
+@pytest.mark.skipif(
+    not native.available(),
+    reason="native/libligsched.so not buildable on this host")
+class TestNativeShadow:
+    def _native(self, ledger, pods, seed=5):
+        sched = native.NativeScheduler(StaticProvider(pods))
+        sched._rng = random.Random(seed)
+        if ledger is not None:
+            sched.pick_ledger = ledger
+        return sched
+
+    def test_native_routing_identical_ledger_on_off(self):
+        pods = uniform_pods(6)
+        off = self._native(None, pods)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        on = self._native(led, pods)
+        picks_off = [off.schedule(req_for()).name for _ in range(60)]
+        picks_on = [on.schedule(req_for()).name for _ in range(60)]
+        assert picks_off == picks_on
+
+    def test_shadow_records_match_native_candidates(self):
+        pods = uniform_pods(6)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+        sched = self._native(led, pods)
+        for _ in range(10):
+            sched.schedule(req_for(trace_id="t-native"))
+        recs = led.records()
+        assert recs, "native path never charged the ledger"
+        assert all(r["path"] == "native-shadow" for r in recs)
+        assert all(r["shadow_match"] is True for r in recs)
+        led.tick()
+        assert led.seam_rollup()["shadow_mismatch"] == 0
+
+
+# -- sim parity -------------------------------------------------------------
+
+def test_sim_make_router_decision_parity():
+    from llm_instance_gateway_tpu.sim.run import make_router
+    from llm_instance_gateway_tpu.sim.core import (
+        V5E_DEFAULT,
+        SimRequest,
+        SimServer,
+    )
+
+    servers = [SimServer(f"s{i}", V5E_DEFAULT) for i in range(4)]
+    reqs = [SimRequest(rid=i, arrival_s=0.0, prompt_tokens=100,
+                       output_tokens=10, model="m") for i in range(20)]
+    plain = make_router("production", servers, seed=9)
+    led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+    observed = make_router("production", servers, seed=9, pick_ledger=led)
+    assert ([plain(r).pod.name for r in reqs]
+            == [observed(r).pod.name for r in reqs])
+    assert len(led.records()) == 20
+
+
+# -- cursor + capacity ------------------------------------------------------
+
+class TestCursor:
+    def _charged(self, n, capacity=512):
+        pods = uniform_pods(3)
+        led = PickLedger(cfg=PickLedgerConfig(sample_every=1,
+                                              capacity=capacity))
+        sched = make_sched(pods, ledger=led)
+        for _ in range(n):
+            sched.schedule(req_for())
+        return led
+
+    def test_paging_drains_without_skips(self):
+        led = self._charged(10)
+        seen, since = [], 0
+        while True:
+            page = debug_picks_payload(led, {"since": str(since),
+                                             "limit": "3"})
+            seen.extend(r["seq"] for r in page["records"])
+            if page["next_since"] == page["seq"]:
+                break
+            since = page["next_since"]
+        assert seen == list(range(1, 11))
+
+    def test_capacity_bounds_ring(self):
+        led = self._charged(12, capacity=4)
+        recs = led.records()
+        assert [r["seq"] for r in recs] == [9, 10, 11, 12]
+        assert led.seq == 12
+
+    def test_hostile_query_params_degrade(self):
+        led = self._charged(2)
+        page = debug_picks_payload(led, {"since": "zzz", "limit": "-5"})
+        assert len(page["records"]) >= 1  # sane defaults, no raise
+
+
+# -- exposition contract ----------------------------------------------------
+
+HOSTILE = 'pod\n"evil\\'
+
+
+def test_render_round_trips_hostile_labels():
+    led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+    pods = uniform_pods(3)
+    sched = make_sched(pods, ledger=led,
+                       health=FakeHealth(avoid={"pod-0"}))
+    sched.schedule(req_for())
+    # Hostile keys reaching the aggregates (e.g. a hostile pod name
+    # narrating a seam) must render escaped, next to the canonical set.
+    with led._lock:
+        led._steered[HOSTILE] = 3
+        led._stage_survivors[HOSTILE] = 2
+    text = "\n".join(led.render()) + "\n"
+    families = lint_exposition(text)
+    assert families["gateway_pick_sample_total"][0].value == 1.0
+    stages = {s.labels["stage"]
+              for s in families["gateway_pick_narrowing"]}
+    assert set(pickledger.STAGES) <= stages and HOSTILE in stages
+    seams = {s.labels["seam"]
+             for s in families["gateway_pick_steered_total"]}
+    assert set(pickledger.SEAMS) <= seams and HOSTILE in seams
+
+
+# -- tools: lig_top + blackbox compat guard ---------------------------------
+
+def _picks_payload():
+    led = PickLedger(cfg=PickLedgerConfig(sample_every=1))
+    pods = uniform_pods(4)
+    sched = make_sched(pods, ledger=led,
+                       health=FakeHealth(avoid={"pod-2"}))
+    for _ in range(6):
+        sched.schedule(req_for(model="m", adapter="a2",
+                               trace_id="t-top"))
+    return debug_picks_payload(led, {"limit": "64"})
+
+
+def test_lig_top_steer_column_and_summary():
+    from tools.lig_top import COLUMNS, pick_lines, render_table
+
+    assert "STEER" in COLUMNS
+    picks = _picks_payload()
+    lines = pick_lines(picks)
+    assert any("sampled=6/6" in ln for ln in lines)
+    assert any("health/circuit" in ln for ln in lines)
+    # Absent /debug/picks (older gateway): section degrades to nothing,
+    # STEER renders the "-" placeholder.
+    assert pick_lines(None) == []
+    row = {"adapter": "a2", "model": "m", "share": {}, "score": 0.0,
+           "traffic_share": 0.0, "state": "quiet"}
+    table = render_table({"adapters": [row], "pool_waste": {},
+                          "noisy": []}, picks=None)
+    assert "STEER" in table and "-" in table
+    steered_table = render_table({"adapters": [row], "pool_waste": {},
+                                  "noisy": []}, picks=picks)
+    assert "picks: sampled=6/6" in steered_table
+
+
+def test_pick_report_renders_funnel_and_steering():
+    from tools import pick_report
+
+    picks = _picks_payload()
+    assert set(pick_report.extract_picks(picks)) == {"default"}
+    text = pick_report.render(picks)
+    assert "health/circuit" in text
+    assert "pod-2" in text       # steered-away attribution
+    assert "t-top" in text       # exemplar trace join
+
+
+def test_blackbox_report_marks_predating_dumps_unavailable():
+    """Compat guard: a dump written before a payload section existed
+    renders an explicit UNAVAILABLE marker — never a stack trace, and
+    never a silent omission.  Present-but-empty stays silent."""
+    import tools.blackbox_report as blackbox_report
+
+    old_dump = {
+        "format": "lig-blackbox/1",
+        "written_at": 1000.0,
+        "reason": {"model": "m", "objective": "ttft", "window": "5m",
+                   "state": "fast_burn", "burn_rate": 20.0},
+        "events": {"events": []},
+        "traces": [],
+        "metrics_text": "",
+        # No statebus / profile / kv / picks keys at all: the dump
+        # predates those PRs.
+    }
+    report = blackbox_report.render_report(old_dump, window_s=3600.0)
+    for section in ("State bus", "Engine step-timeline", "KV economy",
+                    "Routing decisions"):
+        assert f"{section}: UNAVAILABLE (dump predates this payload " \
+               f"section)" in report, section
+    # Present-but-empty is NOT "predates": no marker, no section noise.
+    empty_dump = dict(old_dump, picks={})
+    report2 = blackbox_report.render_report(empty_dump, window_s=3600.0)
+    assert "Routing decisions: UNAVAILABLE" not in report2
+
+    # And a dump WITH records renders them.
+    rich_dump = dict(old_dump, picks={"default": _picks_payload()})
+    report3 = blackbox_report.render_report(rich_dump, window_s=3600.0)
+    assert "Routing decisions" in report3
+    assert "t-top" in report3
+
+
+def test_records_json_serializable():
+    """The /debug/picks body and the blackbox embedding both json-dump
+    records; the flat-ring materialization must produce plain types."""
+    picks = _picks_payload()
+    json.dumps(picks)

@@ -288,3 +288,56 @@ def test_models_listing():
         assert ids == {"m1", "m2"}
 
     asyncio.run(run())
+
+
+def test_debug_picks_route_serves_cursor_and_trace_join():
+    """Routing decision ledger at the proxy: the REAL /v1/completions
+    path charges the ledger, /debug/picks serves the record with the
+    since/next_since cursor, and the record joins /debug/traces via the
+    x-lig-trace-id the proxy echoes (pickledger.py wiring in proxy.py)."""
+    from llm_instance_gateway_tpu.gateway import pickledger as pickledger_mod
+
+    async def run():
+        upstream = await start_fake_model_server("upstream-a")
+        addr = f"127.0.0.1:{upstream.port}"
+        pods = {Pod("good", addr): fake_metrics(queue=0, kv=0.1)}
+        ds = Datastore(pods=list(pods))
+        ds.set_pool(InferencePool(name="pool"))
+        ds.store_model(make_model("m"))
+        provider = StaticProvider(
+            [PodMetrics(pod=p, metrics=m) for p, m in pods.items()])
+        scheduler = Scheduler(provider, token_aware=False, prefill_aware=False)
+        proxy = GatewayProxy(
+            Server(scheduler, ds), provider, ds,
+            pickledger_cfg=pickledger_mod.PickLedgerConfig(sample_every=1))
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/completions", json={"model": "m", "prompt": "hello"},
+                headers={"x-lig-trace-id": "beef0123beef0123"})
+            assert resp.status == 200
+            doc = await (await client.get("/debug/picks")).json()
+            assert doc["records"], doc
+            rec = doc["records"][-1]
+            assert rec["trace_id"] == "beef0123beef0123"
+            assert rec["winner"] == "good"
+            assert rec["path"] == "python"
+            assert [s["stage"] for s in rec["stages"]] \
+                == list(pickledger_mod.STAGES)
+            # Same trace id is retrievable from /debug/traces — the join.
+            traces = await (await client.get(
+                "/debug/traces",
+                params={"trace_id": rec["trace_id"]})).json()
+            assert len(traces["traces"]) == 1
+            # Cursor contract: paging from next_since yields nothing new.
+            drained = await (await client.get(
+                "/debug/picks",
+                params={"since": str(doc["next_since"])})).json()
+            assert drained["records"] == []
+            assert drained["next_since"] == doc["next_since"]
+        finally:
+            await client.close()
+            await upstream.close()
+
+    asyncio.run(run())

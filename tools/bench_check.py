@@ -50,6 +50,7 @@ ABSOLUTE_MAX = {
     "step_profile_ratio": 1.05,
     "pick_witness_ratio": 1.05,
     "kv_ledger_ratio": 1.05,
+    "pick_ledger_ratio": 1.05,
     "device_stops_ratio": 1.15,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
@@ -82,6 +83,7 @@ _RATIO_SOURCES = {
     "step_profile_ratio": "profiler",
     "pick_witness_ratio": "witness",
     "kv_ledger_ratio": "kvledger",
+    "pick_ledger_ratio": "pickledger",
     "device_stops_ratio": "decode",
 }
 
@@ -97,6 +99,7 @@ _FAMILY_PRIMARY = {
     "profiler": ("step_profile_ratio", "lower"),
     "witness": ("pick_witness_ratio", "lower"),
     "kvledger": ("kv_ledger_ratio", "lower"),
+    "pickledger": ("pick_ledger_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -117,6 +120,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "profiler": bench.run_profiler_microbench(),
         "witness": bench.run_witness_microbench(),
         "kvledger": bench.run_kv_ledger_microbench(),
+        "pickledger": bench.run_pick_ledger_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
         "decode": bench.run_decode_lever_microbench(),
@@ -136,6 +140,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
                   "profiler": bench.run_profiler_microbench,
                   "witness": bench.run_witness_microbench,
                   "kvledger": bench.run_kv_ledger_microbench,
+                  "pickledger": bench.run_pick_ledger_microbench,
                   "decode": bench.run_decode_lever_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):

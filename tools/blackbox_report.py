@@ -34,6 +34,28 @@ import sys
 import time
 
 
+# Payload sections added after the dump format shipped.  A dump written
+# by an older gateway simply lacks the key — render an explicit marker
+# (not a silent skip, and never a stack trace) so the on-caller knows the
+# data was never captured rather than captured-empty.
+_VERSIONED_SECTIONS = (
+    ("statebus", "State bus"),
+    ("profile", "Engine step-timeline"),
+    ("kv", "KV economy"),
+    ("picks", "Routing decisions"),
+)
+
+
+def _predates(dump: dict, key: str) -> bool:
+    """True when the dump was written before this payload section
+    existed (key absent entirely — distinct from present-but-empty)."""
+    return key not in dump
+
+
+def _funnel(stages: list) -> str:
+    return "->".join(str(s.get("survivors", "?")) for s in stages or [])
+
+
 def _fmt_ts(ts: float, t0: float) -> str:
     """Absolute clock + offset relative to the dump instant (negative =
     before the breach)."""
@@ -120,7 +142,11 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
             lines.append(f"  would-avoid picks (log-only): {wa}")
         lines.append("")
     statebus = dump.get("statebus") or {}
-    if statebus:
+    if _predates(dump, "statebus"):
+        lines.append("State bus: UNAVAILABLE "
+                     "(dump predates this payload section)")
+        lines.append("")
+    elif statebus:
         lines.append("State bus at dump time:")
         lines.append(
             f"  replica={statebus.get('replica')} "
@@ -143,7 +169,11 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
                 f" avoid={loc.get('avoid') or []}")
         lines.append("")
     profiles = dump.get("profile") or {}
-    if profiles:
+    if _predates(dump, "profile"):
+        lines.append("Engine step-timeline: UNAVAILABLE "
+                     "(dump predates this payload section)")
+        lines.append("")
+    elif profiles:
         lines.append("Engine step-timeline at dump time "
                      "(dispatch/host-sync/idle shares):")
         for pod in sorted(profiles):
@@ -161,7 +191,11 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
                 f" ({att.get('tracked_seconds', 0)}s tracked)")
         lines.append("")
     kv = dump.get("kv") or {}
-    if kv:
+    if _predates(dump, "kv"):
+        lines.append("KV economy: UNAVAILABLE "
+                     "(dump predates this payload section)")
+        lines.append("")
+    elif kv:
         lines.append("KV economy at dump time:")
         gw = kv.get("gateway") or {}
         for pod, view in sorted((gw.get("pods") or {}).items()):
@@ -189,6 +223,31 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
                         for s in ("free", "active", "prefix_resident",
                                   "parked"))
                     + f" (of {snap.get('blocks_total', 0)})")
+        lines.append("")
+    picks = dump.get("picks") or {}
+    if _predates(dump, "picks"):
+        lines.append("Routing decisions: UNAVAILABLE "
+                     "(dump predates this payload section)")
+        lines.append("")
+    elif picks:
+        lines.append("Routing decisions at dump time "
+                     "(sampled; gateway/pickledger.py):")
+        for pool, p in sorted(picks.items()):
+            if not isinstance(p, dict):
+                continue
+            decisive = p.get("decisive") or {}
+            escapes = p.get("escapes") or {}
+            lines.append(
+                f"  pool {pool}: picks={p.get('picks', 0)}"
+                f" samples={p.get('samples', 0)}"
+                f" decisive={json.dumps(decisive, sort_keys=True)}"
+                f" escapes={json.dumps(escapes, sort_keys=True)}")
+            for r in (p.get("records") or [])[-3:]:
+                lines.append(
+                    f"    {r.get('hop', '?'):<7} winner={r.get('winner')}"
+                    f" decisive={r.get('decisive')}"
+                    f" funnel={_funnel(r.get('stages'))}"
+                    f" trace={r.get('trace_id', '')}")
         lines.append("")
     counts = (dump.get("events") or {}).get("counts") or {}
     if counts:

@@ -58,6 +58,9 @@ from llm_instance_gateway_tpu.gateway import faultinject  # noqa: E402
 from llm_instance_gateway_tpu.gateway.datastore import Datastore  # noqa: E402
 from llm_instance_gateway_tpu.gateway.handlers.server import Server  # noqa: E402
 from llm_instance_gateway_tpu.gateway.health import HealthConfig  # noqa: E402
+from llm_instance_gateway_tpu.gateway.pickledger import (  # noqa: E402
+    PickLedgerConfig,
+)
 from llm_instance_gateway_tpu.gateway.provider import StaticProvider  # noqa: E402
 from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy  # noqa: E402
 from llm_instance_gateway_tpu.gateway.resilience import (  # noqa: E402
@@ -127,6 +130,9 @@ class ChaosStack:
             resilience_cfg=self.rcfg,
             fairness_cfg=self.fairness_cfg,
             placement_cfg=self.placement_cfg,
+            # Every pick recorded: the scenarios assert on the decision
+            # ledger's counterfactual attribution, not a sample of it.
+            pickledger_cfg=PickLedgerConfig(sample_every=1),
             # Fast hysteresis for harness time: 2-tick dwell is the
             # quantity the acceptance criterion counts.
             health_cfg=HealthConfig(dwell_ticks=2, error_streak_floor=3))
@@ -186,6 +192,7 @@ async def scenario_blackhole(seed: int) -> dict:
         assert BAD in warm_picks and GOOD in warm_picks, warm_picks
 
         schedule.inject_now(faultinject.BLACKHOLE, pod=BAD)
+        pick_seq0 = stack.proxy.pickledger.seq
         round_picks: list[list[str]] = []
         for _ in range(6):  # 6 rounds == 6 health ticks under fault
             seq0 = stack.proxy.journal.seq
@@ -200,6 +207,17 @@ async def scenario_blackhole(seed: int) -> dict:
         success_rate = ok / len(statuses)
         bad_after_2_ticks = sum(p.count(BAD) for p in round_picks[2:])
         circuit = stack.proxy.resilience.breaker.state(BAD)
+        # Explainability acceptance: the decision ledger's counterfactual
+        # must ATTRIBUTE the reroute — during the outage, steered picks
+        # are decisively steered by the health/circuit seam (disabling it
+        # would have put the blackholed pod back in the survivor set).
+        outage_recs = stack.proxy.pickledger.records(since=pick_seq0,
+                                                     limit=2048)
+        steered_recs = [r for r in outage_recs if r["steered"]]
+        health_decisive = sum(1 for r in steered_recs
+                              if r["decisive"] == "health/circuit")
+        decisive_share = (health_decisive / len(steered_recs)
+                          if steered_recs else 0.0)
         report = {
             "scenario": "blackhole", "requests": len(statuses),
             "success_rate": round(success_rate, 4),
@@ -207,11 +225,15 @@ async def scenario_blackhole(seed: int) -> dict:
             "bad_picks_after_2_ticks": bad_after_2_ticks,
             "circuit_state_bad": circuit,
             "retries": dict(stack.proxy.metrics.retries_total),
+            "steered_picks": len(steered_recs),
+            "decisive_health_share": round(decisive_share, 4),
         }
         assert success_rate > 0.99, report
         assert bad_after_2_ticks == 0, report
         assert circuit == "open", report
         assert sum(stack.proxy.metrics.retries_total.values()) >= 1, report
+        assert steered_recs, report
+        assert decisive_share >= 0.95, report
         return report
 
 

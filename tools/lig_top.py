@@ -30,8 +30,8 @@ CLEAR = "\x1b[2J\x1b[H"
 BOLD, RED, DIM, RESET = "\x1b[1m", "\x1b[31m", "\x1b[2m", "\x1b[0m"
 
 COLUMNS = ("MODEL", "ADAPTER", "STEP%", "TOK%", "KV%", "TRAF%", "SCORE",
-           "STATE", "TIERS")
-WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7, 14)
+           "STATE", "TIERS", "STEER")
+WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7, 14, 6)
 
 
 def fetch_usage(url: str, timeout_s: float = 5.0) -> dict:
@@ -49,6 +49,46 @@ def fetch_kv(url: str, timeout_s: float = 5.0) -> dict | None:
             return json.loads(resp.read().decode("utf-8"))
     except (OSError, ValueError):
         return None
+
+
+def fetch_picks(url: str, timeout_s: float = 5.0) -> dict | None:
+    """Best-effort /debug/picks fetch (gateway/pickledger.py) — the
+    steering column degrades to '-' against gateways predating the
+    decision ledger."""
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/debug/picks?limit=256",
+                timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def steer_counts(picks: dict | None) -> dict[tuple[str, str], int]:
+    """Per-{model, adapter} steered-pick counts over the recent sampled
+    decision records (pure; feeds the STEER column)."""
+    counts: dict[tuple[str, str], int] = {}
+    for r in (picks or {}).get("records") or []:
+        if r.get("steered"):
+            key = (r.get("model", ""), r.get("adapter", ""))
+            counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def pick_lines(picks: dict | None) -> list[str]:
+    """The routing-decision summary line (pure; from /debug/picks):
+    sample coverage, per-seam steering counts, and the decisive-seam
+    distribution across sampled picks."""
+    if not picks:
+        return []
+    steered = picks.get("rollup", {}).get("steered") or {}
+    decisive = picks.get("decisive") or {}
+    return [
+        "picks: sampled=%d/%d steered={%s} decisive={%s}"
+        % (picks.get("samples", 0), picks.get("picks", 0),
+           ", ".join(f"{k}:{steered[k]}" for k in sorted(steered)) or "none",
+           ", ".join(f"{k}:{decisive[k]}" for k in sorted(decisive))
+           or "none")]
 
 
 def _row(values, color: str = "") -> str:
@@ -90,7 +130,8 @@ def kv_lines(kv: dict | None) -> list[str]:
 
 
 def render_table(payload: dict, color: bool = False,
-                 kv: dict | None = None) -> str:
+                 kv: dict | None = None,
+                 picks: dict | None = None) -> str:
     """One frame of the console (pure function — unit-tested and shared by
     --once).  Rows arrive pre-sorted by step-seconds share, descending."""
     lines = []
@@ -118,6 +159,7 @@ def render_table(payload: dict, color: bool = False,
         lines.append("residency: %d slot / %d host copies across %d pods"
                      % (slot_total, host_total, len(residency)))
     lines += kv_lines(kv)
+    lines += pick_lines(picks)
     fairness = payload.get("fairness") or {}
     if fairness:
         lines.append(
@@ -141,12 +183,16 @@ def render_table(payload: dict, color: bool = False,
     if not rows:
         lines.append("(no attribution samples yet — is traffic flowing "
                      "and are replicas exposing tpu:adapter_*_total?)")
+    steers = steer_counts(picks)
     for r in rows:
         share = r.get("share") or {}
         flagged = r.get("state") == "noisy"
         per = tier_counts.get(r.get("adapter", ""), {})
         tiers_cell = ",".join(f"{t}x{per[t]}" for t in ("slot", "host")
                               if per.get(t)) or ("-" if residency else "")
+        steer_cell = ("-" if picks is None else
+                      str(steers.get((r.get("model", ""),
+                                      r.get("adapter", "")), 0)))
         lines.append(_row((
             r.get("model", ""), r.get("adapter", ""),
             "%.1f" % (100 * share.get("step_seconds", 0.0)),
@@ -156,6 +202,7 @@ def render_table(payload: dict, color: bool = False,
             "%.2f" % r.get("score", 0.0),
             r.get("state", "quiet"),
             tiers_cell,
+            steer_cell,
         ), RED if (flagged and color) else ""))
     return "\n".join(lines)
 
@@ -172,11 +219,13 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.once:
             print(render_table(fetch_usage(args.url),
-                               kv=fetch_kv(args.url)))
+                               kv=fetch_kv(args.url),
+                               picks=fetch_picks(args.url)))
             return 0
         while True:
             frame = render_table(fetch_usage(args.url), color=True,
-                                 kv=fetch_kv(args.url))
+                                 kv=fetch_kv(args.url),
+                                 picks=fetch_picks(args.url))
             sys.stdout.write(CLEAR + frame + "\n"
                              + f"{DIM}{args.url}  ^C to quit{RESET}\n")
             sys.stdout.flush()

@@ -1,0 +1,228 @@
+"""Routing decision report: pick funnel, seam steering, exemplar picks.
+
+Reads a ``/debug/picks`` payload (URL, file path, or ``-`` for stdin)
+from the gateway's routing decision ledger (``gateway/pickledger.py``),
+or the ``picks`` section of a black-box dump (one payload per pool), and
+renders the operator view of "why did my request land on pod X?":
+
+- the narrowing funnel (mean surviving candidates per pick stage across
+  sampled picks: pool -> role partition -> filter tree -> health/circuit
+  -> fairness -> placement -> prefix tie-break -> RNG);
+- per-seam steering shares (what fraction of sampled picks each advisor
+  seam changed, per the counterfactual replay) and the decisive-seam
+  distribution;
+- the top steered-away pods (who keeps getting removed, by which stage);
+- exemplar decision records, newest first, with their trace ids (join
+  against ``tools/trace_report.py`` / the fleet's stitched traces).
+
+Usage:
+  python tools/pick_report.py http://localhost:8081/debug/picks
+  python tools/pick_report.py http://localhost:8081/debug/picks --once
+  python tools/pick_report.py dump.json        # black-box picks section
+  python tools/pick_report.py - --json < picks.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.trace_report import load  # noqa: E402 — one loader, no drift
+
+# Canonical funnel order (pickledger.STAGES; re-declared so the report
+# renders old payloads without importing gateway code).
+STAGE_ORDER = ("pool", "role_partition", "filter_tree", "health/circuit",
+               "fairness", "placement", "prefix_affinity", "rng")
+
+
+# ---------------------------------------------------------------------------
+# Payload extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_picks(doc: dict) -> dict[str, dict]:
+    """Normalize any accepted source to ``{pool_name: ledger_payload}``.
+
+    A ``/debug/picks`` body is one payload (pool "default"; its optional
+    ``pools`` section overrides per-pool); a black-box dump carries
+    ``picks`` as a per-pool mapping already."""
+    if not isinstance(doc, dict):
+        raise ValueError("payload is not a JSON object")
+    if isinstance(doc.get("picks"), dict) and "samples" not in doc:
+        # Black-box dump (or a wrapper): {"picks": {pool: payload}}.
+        inner = doc["picks"]
+        if inner and all(isinstance(v, dict) for v in inner.values()):
+            return dict(inner)
+    if "samples" in doc and "rollup" in doc:
+        pools = doc.get("pools")
+        if isinstance(pools, dict) and pools:
+            return dict(pools)
+        return {"default": doc}
+    raise ValueError("no pick-ledger payload found (expected a gateway "
+                     "/debug/picks body or a dump's 'picks' section)")
+
+
+# ---------------------------------------------------------------------------
+# Rows (pure — the testable core)
+# ---------------------------------------------------------------------------
+
+
+def funnel_rows(payload: dict) -> list[dict]:
+    means = (payload.get("rollup") or {}).get("mean_survivors") or {}
+    extra = sorted(set(means) - set(STAGE_ORDER))
+    return [{"stage": stage, "mean_survivors": means.get(stage, 0.0)}
+            for stage in (*STAGE_ORDER, *extra) if stage in means]
+
+
+def steering_rows(payload: dict) -> list[dict]:
+    """Per-seam steering share over sampled picks, joined with the
+    decisive counts and escape-hatch fires."""
+    rollup = payload.get("rollup") or {}
+    steered = rollup.get("steered") or {}
+    decisive = payload.get("decisive") or rollup.get("decisive") or {}
+    escapes = payload.get("escapes") or rollup.get("escapes") or {}
+    samples = int(payload.get("samples") or rollup.get("samples") or 0)
+    seams = sorted(set(steered) | set(decisive) | set(escapes))
+    rows = []
+    for seam in seams:
+        n = int(steered.get(seam, 0))
+        rows.append({
+            "seam": seam,
+            "steered": n,
+            "steered_pct": round(100.0 * n / samples, 1) if samples else 0.0,
+            "decisive": int(decisive.get(seam, 0)),
+            "escapes": int(escapes.get(seam, 0)),
+        })
+    rows.sort(key=lambda r: (-r["steered"], -r["decisive"], r["seam"]))
+    return rows
+
+
+def steered_away_rows(payload: dict, top: int = 8) -> list[dict]:
+    away = (payload.get("rollup") or {}).get("steered_away") or {}
+    rows = [{"pod": pod, "removals": int(n)} for pod, n in away.items()]
+    rows.sort(key=lambda r: (-r["removals"], r["pod"]))
+    return rows[:top]
+
+
+def exemplar_rows(payload: dict, top: int = 5) -> list[dict]:
+    """Newest sampled decisions, compacted to one row each."""
+    rows = []
+    for r in (payload.get("records") or [])[-top:][::-1]:
+        funnel = "->".join(str(s.get("survivors", "?"))
+                           for s in r.get("stages") or [])
+        rows.append({
+            "seq": r.get("seq", 0),
+            "hop": r.get("hop", "?"),
+            "path": r.get("path", "?"),
+            "winner": r.get("winner", "?"),
+            "decisive": r.get("decisive", "?"),
+            "steered": ",".join(r.get("steered") or []) or "-",
+            "escapes": ",".join(r.get("escapes") or []) or "-",
+            "funnel": funnel,
+            "trace": r.get("trace_id") or "-",
+        })
+    return rows
+
+
+def _table(rows: list[dict], headers: tuple) -> str:
+    if not rows:
+        return "(no samples)"
+    widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
+
+    def fmt(vals):
+        return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
+                         for i, (v, w) in enumerate(zip(vals, widths)))
+
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt([r[h] for h in headers]) for r in rows]
+    return "\n".join(lines)
+
+
+def render_pool(name: str, payload: dict) -> str:
+    rollup = payload.get("rollup") or {}
+    mismatch = rollup.get("shadow_mismatch", 0)
+    out = [
+        f"ROUTING DECISIONS — pool {name} "
+        f"(picks={payload.get('picks', 0)}, "
+        f"samples={payload.get('samples', 0)}, "
+        f"sample_every={(payload.get('config') or {}).get('sample_every')})",
+        "",
+        "Narrowing funnel (mean survivors per stage):",
+        _table(funnel_rows(payload), ("stage", "mean_survivors")),
+        "",
+        "Seam steering (counterfactual: picks the seam changed):",
+        _table(steering_rows(payload),
+               ("seam", "steered", "steered_pct", "decisive", "escapes")),
+        "",
+        "Top steered-away pods:",
+        _table(steered_away_rows(payload), ("pod", "removals")),
+        "",
+        "Exemplar decisions (newest first):",
+        _table(exemplar_rows(payload),
+               ("seq", "hop", "path", "winner", "decisive", "steered",
+                "escapes", "funnel", "trace")),
+    ]
+    if mismatch:
+        out += ["", f"WARNING: {mismatch} native shadow-replay "
+                    "mismatch(es) — oracle drifted from the native path"]
+    return "\n".join(out)
+
+
+def render(doc: dict) -> str:
+    pools = extract_picks(doc)
+    return "\n\n".join(render_pool(name, payload)
+                       for name, payload in sorted(pools.items()))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Routing decision report: pick funnel, seam steering, "
+                    "exemplars (from /debug/picks)")
+    parser.add_argument("source",
+                        help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--once", action="store_true",
+                        help="render one report and exit (CI mode; URL "
+                             "sources otherwise refresh every --interval)")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="watch-mode refresh seconds (URL sources)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the extracted rows as JSON")
+    args = parser.parse_args(argv)
+
+    watch = (not args.once and not args.json
+             and args.source.startswith(("http://", "https://")))
+    while True:
+        try:
+            doc = load(args.source)
+            pools = extract_picks(doc)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({
+                name: {"funnel": funnel_rows(p),
+                       "steering": steering_rows(p),
+                       "steered_away": steered_away_rows(p),
+                       "exemplars": exemplar_rows(p)}
+                for name, p in sorted(pools.items())}, indent=1))
+            return 0
+        if watch:
+            print("\x1b[2J\x1b[H", end="")
+        print(render(doc))
+        if not watch:
+            return 0
+        time.sleep(max(0.5, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
