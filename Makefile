@@ -2,7 +2,7 @@
 
 IMG ?= gcr.io/PROJECT/tpu-inference-gateway:latest
 
-.PHONY: test test-e2e chaos native native-asan native-tsan bench bench-check loadgen sim metrics-docs top usage-check lint typecheck docker-build install deploy undeploy fmt
+.PHONY: test test-e2e chaos native native-asan native-tsan bench bench-check loadgen sim sim-check metrics-docs top usage-check lint typecheck docker-build install deploy undeploy fmt
 
 test:            ## unit + integration tests (CPU, virtual 8-device mesh)
 	python -m pytest tests/ -q -m "not e2e"
@@ -43,22 +43,27 @@ loadgen:         ## gateway load rig (200 fake pods x 5 adapters)
 sim:             ## routing-policy simulation sweep
 	python -m llm_instance_gateway_tpu.sim.run --qps 20 30 --policies random production
 
+sim-check:       ## deterministic twin-calibration scenario: observable recovery + committed TWIN_CALIBRATION.json reproduction + knee sanity
+	env JAX_PLATFORMS=cpu python -m llm_instance_gateway_tpu.sim.run --twin-scenario
+
 metrics-docs:    ## regenerate docs/METRICS.md from the metric registry
 	python tools/gen_metrics_docs.py docs/METRICS.md
 
 top:             ## one-shot lig-top render of a running gateway's /debug/usage
 	python tools/lig_top.py --once --url $${LIG_URL:-http://localhost:8081}
 
-usage-check:     ## invariant lint + typecheck + sanitized native builds + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + decode levers + concurrency harness + KV economy + docs currency
+usage-check:     ## invariant lint + typecheck + sanitized native builds + attribution conservation + noisy-neighbor + fairness + placement + multipool enforcement + statebus + fleet obs + profiler + decode levers + concurrency harness + KV economy + capacity twin + docs currency
 	$(MAKE) lint
 	$(MAKE) typecheck
 	$(MAKE) native-asan
 	$(MAKE) native-tsan
-	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_decode_levers.py tests/test_kv_ledger.py tests/test_kvobs.py tests/test_sim.py tests/test_metrics_docs.py tests/test_lint.py tests/test_concurrency.py -q
+	$(MAKE) sim-check
+	python -m pytest tests/test_usage.py tests/test_fairness.py tests/test_placement.py tests/test_multipool.py tests/test_statebus.py tests/test_fleetobs.py tests/test_profiler.py tests/test_decode_levers.py tests/test_kv_ledger.py tests/test_kvobs.py tests/test_capacity.py tests/test_sim.py tests/test_metrics_docs.py tests/test_lint.py tests/test_concurrency.py -q
 	python tools/chaos.py --seed 0 --scenario noisy_neighbor
 	python tools/chaos.py --seed 0 --scenario adapter_flood
 	python tools/chaos.py --seed 0 --scenario cold_start_storm
 	python tools/chaos.py --seed 0 --scenario replica_partition
+	python tools/chaos.py --seed 0 --scenario saturation_ramp
 
 docker-build:    ## build the framework image
 	docker build -t $(IMG) .

@@ -1165,6 +1165,157 @@ def run_kv_ledger_microbench() -> dict:
         off_engine.stop()
 
 
+def run_capacity_microbench(n_pods: int = 16, n_ticks: int = 192) -> dict:
+    """Capacity-plane tick overhead A/B (capacity-twin PR acceptance bar:
+    ``capacity_tick_ratio`` < 1.05 — enabling ``CapacityPlanner`` on the
+    observability cadence costs < 5% of the control-tick composite the
+    proxy already runs every period).
+
+    Both sides drive the REAL composite — the full advisor stack
+    (health/breaker, usage, kvobs, fairness, placement, pickledger), the
+    SLO engine, and the statebus snapshot/apply, exactly
+    ``GatewayProxy.control_tick``'s synchronous pass — over an identical
+    deterministic schedule of advancing pod accumulators; the ON side
+    flips ``CapacityConfig.enabled``.  The planner's clock is pinned to
+    a virtual 5s-per-tick clock (the default obs cadence) so the
+    ``min_window_s`` window floor folds on its production duty cycle —
+    one fold per 6 ticks, clock-compare early-returns between — instead
+    of collapsing to a single fold at bench speed.  192 ticks per round
+    = 32 folds = exactly one ``refit_every_ticks`` self-calibration, so
+    the refit spike lands once per round instead of jittering the
+    per-round ratios.  Self-calibration
+    refits ride the measured wall (they amortize at
+    ``refit_every_ticks``, as in production) but the DES knee probes are
+    excluded: their cadence is a config knob whose cost ``make
+    sim-check`` pins, not a per-tick tax.  The workload advance runs
+    OUTSIDE the timed region (it is load synthesis, not observability
+    work — leaving it in would pad both sides and flatter the ratio).
+    The two sides interleave per tick (off-tick then on-tick, same
+    virtual instant) and each tick index is timed separately; the
+    reported ratio compares per-side sums of PER-TICK-INDEX medians
+    across rounds.  An OS or GC hiccup lands in one tick of one round
+    and that tick's cross-round median rejects it, while structural
+    cost — including the refit tick — survives because it recurs at
+    the same tick index every round.
+    """
+    import random as random_mod
+
+    from llm_instance_gateway_tpu.gateway.advisors import AdvisorStack
+    from llm_instance_gateway_tpu.gateway.capacity import CapacityConfig
+    from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+    from llm_instance_gateway_tpu.gateway.slo import SLOEngine
+    from llm_instance_gateway_tpu.gateway.statebus import StateBus
+    from llm_instance_gateway_tpu.gateway.telemetry import GatewayMetrics
+    from llm_instance_gateway_tpu.gateway.testing import fake_metrics, fake_pod
+    from llm_instance_gateway_tpu.gateway.types import PodMetrics
+
+    rng = random_mod.Random(0)
+    # Per-pod per-tick accumulator increments, precomputed once so both
+    # sides (and every round) replay identical scrape content.
+    plan = [[(0.02 * (1 + rng.random()), 20.0,
+              1.5 * (1 + rng.random()), 3000.0,
+              5 * 0.25 * (1 + rng.random()), 5.0,
+              20.0 * rng.randint(120, 260), 20.0 * rng.randint(130, 170),
+              # KV free varies independently of batch so calibration
+              # windows stay full-rank: the twin actually FITS and the
+              # ON side pays the real steady-state path (drift
+              # predictions + amortized refits), not the degenerate
+              # fit-rejected one.
+              200000 - rng.randint(20000, 160000))
+             for _ in range(n_pods)]
+            for _ in range(n_ticks)]
+
+    def make_side(enabled: bool):
+        pods = [PodMetrics(pod=fake_pod(i),
+                           metrics=fake_metrics(
+                               queue=i % 5, kv=(i % 10) / 10.0,
+                               adapters={f"adapter-{i}-{j}": 0
+                                         for j in range(4)}))
+                for i in range(n_pods)]
+        for pm in pods:
+            pm.metrics.kv_tokens_capacity = 200000
+            pm.metrics.kv_tokens_free = 180000
+            pm.metrics.running_queue_size = 4
+        gw_metrics = GatewayMetrics()
+        stack = AdvisorStack(
+            "pool", StaticProvider(pods), metrics=gw_metrics,
+            capacity_cfg=CapacityConfig(enabled=enabled,
+                                        forecast_every_ticks=10 ** 9))
+        slo = SLOEngine(gw_metrics)
+        bus = StateBus({"pool": stack})
+        clock = [1000.0]
+        stack.capacity._clock = lambda: clock[0]
+
+        def advance(tick_i: int) -> None:
+            # Production-shaped load: 4 models on the SLO engine, one
+            # token-attribution entry per {adapter, phase} per pod — the
+            # multi-tenant tables the usage plane exists to roll up, not
+            # a single-model toy that would understate the base.
+            clock[0] += 5.0
+            for j in range(4):
+                gw_metrics.record_request("m%d" % j)
+                gw_metrics.record_phase("m%d" % j, "/v1/completions",
+                                        ttft_s=0.05, tpot_s=0.02,
+                                        e2e_s=3.0)
+            for i, (pm, inc) in enumerate(zip(pods,
+                                              plan[tick_i % n_ticks])):
+                m = pm.metrics
+                m.prefill_seconds_sum += inc[0]
+                m.prefill_seconds_count += inc[1]
+                m.decode_step_seconds_sum += inc[2]
+                m.decode_step_seconds_count += inc[3]
+                m.decode_batch_occupancy_sum += inc[4]
+                m.decode_batch_occupancy_count += inc[5]
+                m.kv_tokens_free = inc[8]
+                at = m.adapter_tokens
+                for j in range(4):
+                    for value, phase in ((inc[6] / 4.0, "prefill"),
+                                         (inc[7] / 4.0, "decode")):
+                        k = ("m%d" % j, "adapter-%d-%d" % (i, j), phase)
+                        at[k] = at.get(k, 0.0) + value
+        return stack, slo, bus, advance
+
+    off_side, on_side = make_side(False), make_side(True)
+    perf = time.perf_counter
+
+    def timed_tick(side, i: int) -> float:
+        stack, slo, bus, advance = side
+        advance(i)
+        t0 = perf()
+        stack.tick()
+        slo.tick()
+        bus.tick()
+        return perf() - t0
+
+    n_rounds = 16
+    # off_t[r][i] / on_t[r][i]: wall of tick i in round r.
+    off_t = [[0.0] * n_ticks for _ in range(n_rounds)]
+    on_t = [[0.0] * n_ticks for _ in range(n_rounds)]
+    for i in range(n_ticks):  # warmup round (untimed)
+        timed_tick(off_side, i), timed_tick(on_side, i)
+    for r in range(n_rounds):
+        for i in range(n_ticks):
+            off_t[r][i] = timed_tick(off_side, i)
+            on_t[r][i] = timed_tick(on_side, i)
+
+    def col(rows: list, i: int) -> list:
+        return sorted(rows[r][i] for r in range(n_rounds))
+
+    total_off = total_on = min_off = min_on = 0.0
+    mid = n_rounds // 2
+    for i in range(n_ticks):
+        o, w = col(off_t, i), col(on_t, i)
+        total_off += (o[mid - 1] + o[mid]) / 2
+        total_on += (w[mid - 1] + w[mid]) / 2
+        min_off += o[0]
+        min_on += w[0]
+    return {
+        "capacity_tick_off_us": round(min_off / n_ticks * 1e6, 2),
+        "capacity_tick_on_us": round(min_on / n_ticks * 1e6, 2),
+        "capacity_tick_ratio": round(total_on / total_off, 4),
+    }
+
+
 def run_native_pick_microbench(n: int = 4000, n_pods: int = 200,
                                n_models: int = 1000,
                                batch: int = 64) -> dict:
@@ -1769,6 +1920,13 @@ if __name__ == "__main__":
             results.update(run_pick_ledger_microbench())
         except Exception as e:
             results["pick_ledger_error"] = str(e)[:200]
+        try:
+            # Capacity-plane overhead A/B (capacity-twin PR): the <5%
+            # capacity_tick_ratio bound rides every emission so the
+            # headroom forecasts can stay on by default.
+            results.update(run_capacity_microbench())
+        except Exception as e:
+            results["capacity_error"] = str(e)[:200]
         print(json.dumps(results), flush=True)
     else:
         main()

@@ -216,6 +216,50 @@ CLASSES = (
              "the lock; the kv_duplication journal emit runs after "
              "release (no nested acquisition)"),
     SharedClass(
+        f"{PKG}/gateway/capacity.py", "CapacityPlanner", OBS_TICK,
+        fields=(
+            SharedField("_pods", LOCK_GUARDED,
+                        writers=("_derive_saturation",),
+                        note="derived lazily at render/debug time from "
+                             "the stashed rows, under the same lock"),
+            SharedField("_pool_saturation", LOCK_GUARDED,
+                        writers=("_derive_saturation",)),
+            SharedField("_prev", LOCK_GUARDED,
+                        writers=("_fold_windows",),
+                        note="rebuilt and swapped whole each fold (pod "
+                             "membership churn prunes via the swap)"),
+            SharedField("_rows_old", LOCK_GUARDED,
+                        writers=("_fold_windows",)),
+            SharedField("_sat_dt", LOCK_GUARDED,
+                        writers=("_fold_windows",)),
+            SharedField("_sat_ticks", LOCK_GUARDED,
+                        writers=("_fold_windows", "_derive_saturation"),
+                        note="fold invalidates, derive stamps — both "
+                             "under the tick lock"),
+            SharedField("_model", LOCK_GUARDED,
+                        writers=("_load_artifact", "_refit"),
+                        note="_load_artifact runs at construction; _refit "
+                             "under the tick lock"),
+            SharedField("_model_info", LOCK_GUARDED,
+                        writers=("_load_artifact", "_refit")),
+            SharedField("_forecast", LOCK_GUARDED,
+                        writers=("_update_forecast",)),
+            SharedField("_drift_state", LOCK_GUARDED,
+                        writers=("_update_drift",)),
+            SharedField("_drift_over", LOCK_GUARDED,
+                        writers=("_update_drift",)),
+            SharedField("_drift_under", LOCK_GUARDED,
+                        writers=("_update_drift",)),
+            SharedField("last_tick", MONOTONIC, writers=("tick",),
+                        note="maybe_tick reads it lock-free (float "
+                             "rebind)"),
+            SharedField("ticks", MONOTONIC, writers=("tick",)),
+        ),
+        note="EMA tables (_windows, _mix, _rate_hist, _drift) mutate in "
+             "place under the lock; the _fold/_refit/_update helpers all "
+             "run from tick() inside it; journal emits run after release "
+             "(kvobs discipline)"),
+    SharedClass(
         f"{PKG}/gateway/pickledger.py", "PickLedger", OBS_TICK,
         fields=(
             SharedField("_rollup", SWAP_PUBLISHED, writers=("tick",),
@@ -587,6 +631,7 @@ BINDINGS = {
     "health_advisor": "ResiliencePlane",
     "usage": "UsageRollup",
     "kvobs": "KvObsRollup",
+    "capacity": "CapacityPlanner",
     "pickledger": "PickLedger",
     "pick_ledger": "PickLedger",
     "fairness": "FairnessPolicy",

@@ -63,6 +63,8 @@ STATEBUS_REJOIN = "statebus_rejoin"     # fresh peer state after a stale spell
 FLEET_PEER_ERROR = "fleet_peer_error"   # fleet collector pull failed (fleetobs)
 PICK_SAMPLE = "pick_sample"             # routing decision record captured
 PICK_ESCAPE_EXPLAINED = "pick_escape_explained"  # sampled pick hit escape hatch
+TWIN_DRIFT = "twin_drift"               # capacity twin diverged from observed
+CAPACITY_FORECAST = "capacity_forecast"  # time-to-breach entered the horizon
 
 
 class EventJournal:

@@ -22,6 +22,7 @@ share one brain without any advisor growing a network dependency.
 from __future__ import annotations
 
 from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import capacity as capacity_mod
 from llm_instance_gateway_tpu.gateway import fairness as fairness_mod
 from llm_instance_gateway_tpu.gateway import health as health_mod
 from llm_instance_gateway_tpu.gateway import kvobs as kvobs_mod
@@ -47,7 +48,8 @@ class AdvisorStack:
                  journal: "events_mod.EventJournal | None" = None,
                  resilience_cfg=None, health_cfg=None, usage_cfg=None,
                  fairness_cfg=None, placement_cfg=None,
-                 pickledger_cfg=None, request_filter=None):
+                 pickledger_cfg=None, capacity_cfg=None,
+                 request_filter=None):
         self.pool_name = pool_name
         self.provider = provider
         self.journal = journal if journal is not None \
@@ -69,6 +71,12 @@ class AdvisorStack:
         # alters routing (counter-modulus sampling, no RNG).
         self.pickledger = pickledger_mod.PickLedger(
             cfg=pickledger_cfg, journal=self.journal)
+        # Capacity & saturation plane (gateway/capacity.py): saturation
+        # indices + the sim-calibrated digital twin's headroom/
+        # time-to-breach forecasts and drift alarms.  Purely
+        # observational — no scheduler seam.
+        self.capacity = capacity_mod.CapacityPlanner(
+            provider, cfg=capacity_cfg, journal=self.journal)
         # Fairness config precedence, per FIELD: explicit CLI flags (a
         # dict of overrides from bootstrap.fairness_from_args — pinned,
         # re-applied on every hot reload) > THIS pool document's
@@ -122,6 +130,8 @@ class AdvisorStack:
         self.resilience.tick()
         self.usage.tick()
         self.kvobs.tick()
+        if self.capacity.cfg.enabled:
+            self.capacity.tick()
         self.fairness.tick()
         self.placement.tick()
         self.pickledger.tick()
@@ -134,9 +144,11 @@ class AdvisorStack:
         """This pool's exposition lines (health + circuits + usage +
         fairness + placement).  Multi-pool fronts merge the per-stack
         blocks through ``merge_exposition_blocks``."""
-        return (self.health.render() + self.resilience.render()
-                + self.usage.render() + self.kvobs.render()
-                + self.fairness.render() + self.placement.render()
+        lines = (self.health.render() + self.resilience.render()
+                 + self.usage.render() + self.kvobs.render())
+        if self.capacity.cfg.enabled:
+            lines += self.capacity.render()
+        return (lines + self.fairness.render() + self.placement.render()
                 + self.pickledger.render())
 
 

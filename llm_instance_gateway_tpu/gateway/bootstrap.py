@@ -566,6 +566,46 @@ def add_resilience_args(parser) -> None:
                              "(0 = disabled)")
     add_fairness_args(parser)
     add_placement_args(parser)
+    add_capacity_args(parser)
+
+
+def add_capacity_args(parser: argparse.ArgumentParser) -> None:
+    """Capacity & saturation plane flags (gateway/capacity.py).
+    ``add_resilience_args`` includes these."""
+    from llm_instance_gateway_tpu.gateway.capacity import CapacityConfig
+
+    c = CapacityConfig()
+    parser.add_argument("--no-capacity", action="store_true",
+                        help="disable the capacity plane (no saturation "
+                             "indices, twin forecasts or drift alarms; "
+                             "/debug/capacity serves an empty view — "
+                             "routing itself is unchanged either way, the "
+                             "plane is purely observational)")
+    parser.add_argument("--twin-calibration", default=c.calibration_path,
+                        metavar="PATH",
+                        help="committed LatencyModel calibration artifact "
+                             "(lig-twin-calibration/1 JSON, e.g. "
+                             "TWIN_CALIBRATION.json) the twin loads; "
+                             "empty = self-calibrate from live scrape "
+                             "windows")
+    parser.add_argument("--twin-drift-threshold", type=float,
+                        default=c.drift_threshold,
+                        help="predicted-vs-observed relative divergence "
+                             "(EMA) above which the twin enters drift: "
+                             "forecasts are marked untrusted and a "
+                             "twin_drift event journals "
+                             f"(default {c.drift_threshold})")
+
+
+def capacity_from_args(args):
+    """Build a CapacityConfig from ``add_capacity_args`` flags."""
+    from llm_instance_gateway_tpu.gateway.capacity import CapacityConfig
+
+    return CapacityConfig(
+        enabled=not args.no_capacity,
+        calibration_path=args.twin_calibration,
+        drift_threshold=args.twin_drift_threshold,
+    )
 
 
 def add_placement_args(parser: argparse.ArgumentParser) -> None:

@@ -26,6 +26,7 @@ import argparse
 import bisect
 import hashlib
 import json
+import math
 import random
 import time
 
@@ -578,6 +579,86 @@ def session_prompt(sid: int, k: int, prefix_chars: int) -> str:
     return (f"{sid:04d}" * (prefix_chars // 4 + 1))[:prefix_chars] + f" q{k}"
 
 
+ARRIVAL_SHAPES = ("poisson", "burst", "diurnal")
+
+
+def build_arrival_timeline(shape: str, n: int, rate_rps: float = 100.0,
+                           seed: int = 0, burst_factor: float = 8.0,
+                           duty: float = 0.2,
+                           period_s: float = 10.0) -> list[float]:
+    """Seeded VIRTUAL arrival timestamps for ``n`` requests.
+
+    The rig's dispatch loop is a synchronous tight loop (it measures
+    gateway processing cost, not wall-clock pacing), so arrival shapes
+    are virtual: a seeded timeline stamped onto the run and recorded in
+    the emission (``arrival_summary``) — the reproducible offered-load
+    shape the sim's calibration scenarios and the capacity plane's
+    forecast tests consume.
+
+    - ``poisson``: memoryless exponential inter-arrivals at ``rate_rps``.
+    - ``burst``: on/off square wave — ``duty`` of each ``period_s`` runs
+      at ``burst_factor`` x the off rate, normalized so the MEAN rate
+      stays ``rate_rps``.
+    - ``diurnal``: sinusoidal modulation with period ``period_s`` (a
+      compressed day): the instantaneous rate swings 0.25x..1.75x the
+      mean.
+    """
+    if shape not in ARRIVAL_SHAPES:
+        raise ValueError(f"unknown arrival shape {shape!r} "
+                         f"(choices: {ARRIVAL_SHAPES})")
+    rng = random.Random(seed)
+    out: list[float] = []
+    t = 0.0
+    for _ in range(n):
+        if shape == "poisson":
+            rate = rate_rps
+        elif shape == "burst":
+            base = rate_rps / (duty * burst_factor + (1.0 - duty))
+            in_burst = (t % period_s) < duty * period_s
+            rate = base * (burst_factor if in_burst else 1.0)
+        else:  # diurnal
+            rate = rate_rps * (1.0
+                               + 0.75 * math.sin(2.0 * math.pi * t / period_s))
+        t += rng.expovariate(max(rate, 1e-6))
+        out.append(t)
+    return out
+
+
+def arrival_summary(shape: str, timeline: list[float], rate_rps: float,
+                    seed: int) -> dict:
+    """The emission block describing a virtual arrival timeline: the
+    shape + seed (enough to regenerate it exactly), the offered-rate
+    series in 1s windows (capped), and the burstiness observables a
+    reader compares across shapes (peak-to-mean, inter-arrival CV —
+    ~1 for poisson, >1 for bursty)."""
+    n = len(timeline)
+    duration = timeline[-1] if timeline else 0.0
+    counts: dict[int, int] = {}
+    for ts in timeline:
+        counts[int(ts)] = counts.get(int(ts), 0) + 1
+    series = [counts.get(s, 0) for s in range(int(duration) + 1)]
+    mean = n / max(duration, 1e-9)
+    inter = [b - a for a, b in zip(timeline, timeline[1:])]
+    cv = 0.0
+    if inter:
+        mi = sum(inter) / len(inter)
+        var = sum((x - mi) ** 2 for x in inter) / len(inter)
+        cv = (var ** 0.5) / max(mi, 1e-12)
+    return {
+        "shape": shape, "seed": seed, "rate_rps": rate_rps,
+        "requests": n,
+        "virtual_duration_s": round(duration, 1),
+        "mean_rps": round(mean, 1),
+        "peak_1s_rps": max(series) if series else 0,
+        "peak_to_mean": round((max(series) if series else 0)
+                              / max(mean, 1e-9), 2),
+        "interarrival_cv": round(cv, 3),
+        # The head of the 1s offered-rate series (bounded: a long run's
+        # full series belongs in --trace-out territory, not the summary).
+        "offered_rps_windows": series[:64],
+    }
+
+
 def run_load(
     requests: int = 10000,
     num_fake_pods: int = 200,
@@ -595,6 +676,9 @@ def run_load(
     adapter_universe: int = 0,
     adapter_zipf: float = 1.1,
     fast_path: bool = True,
+    arrival: str | None = None,
+    arrival_rate_rps: float = 100.0,
+    arrival_seed: int = 0,
 ) -> dict:
     """Fire ``requests`` Process calls; return a ghz-style summary dict.
 
@@ -946,6 +1030,16 @@ def run_load(
         out["est_reuse_efficiency"] = round(
             (hits / max(1, total))
             * (session_prefix_chars / prompt_chars), 4)
+    if arrival:
+        # Virtual offered-load shape (--arrival): seeded, reproducible,
+        # recorded so the artifact carries the load SHAPE alongside the
+        # latency numbers — the input the capacity twin's trend
+        # forecasts and sim calibration replay.
+        timeline = build_arrival_timeline(arrival, requests,
+                                          rate_rps=arrival_rate_rps,
+                                          seed=arrival_seed)
+        out["arrival"] = arrival_summary(arrival, timeline,
+                                         arrival_rate_rps, arrival_seed)
     return out
 
 
@@ -994,6 +1088,19 @@ def main(argv=None):
                              "the fixture's models get seeded tier "
                              "assignments and the report gains a per-tier "
                              "latency/shed breakdown")
+    parser.add_argument("--arrival", default=None, choices=ARRIVAL_SHAPES,
+                        help="stamp a seeded VIRTUAL arrival timeline on "
+                             "the run (poisson | burst | diurnal) and "
+                             "record its offered-rate shape in the "
+                             "emission — the reproducible load shape sim "
+                             "calibration and capacity-forecast tests "
+                             "replay; the dispatch loop itself stays a "
+                             "tight loop")
+    parser.add_argument("--arrival-rate", type=float, default=100.0,
+                        metavar="RPS",
+                        help="mean rate of the virtual arrival timeline")
+    parser.add_argument("--arrival-seed", type=int, default=0,
+                        help="seed for the virtual arrival timeline")
     parser.add_argument("--no-fast-path", action="store_true",
                         help="drive the gRPC ext-proc stream (proto "
                              "marshalling per request) instead of the "
@@ -1045,7 +1152,10 @@ def main(argv=None):
                            if args.criticality_mix else None),
                        adapter_universe=args.adapter_universe,
                        adapter_zipf=args.adapter_zipf,
-                       fast_path=not args.no_fast_path)
+                       fast_path=not args.no_fast_path,
+                       arrival=args.arrival,
+                       arrival_rate_rps=args.arrival_rate,
+                       arrival_seed=args.arrival_seed)
     summary["scheduler"] = "native" if args.native else "python"
     print(json.dumps(summary))
 

@@ -76,6 +76,7 @@ DECODE_TPS_METRIC = "tpu:decode_tokens_per_sec"
 PREFIX_REUSED_METRIC = "tpu:prefix_reused_tokens"
 PREFILL_SECONDS_METRIC = "tpu:prefill_seconds"
 DECODE_STEP_SECONDS_METRIC = "tpu:decode_step_seconds"
+DECODE_BATCH_OCCUPANCY_METRIC = "tpu:decode_batch_occupancy"
 # Step-timeline profiler families (server/profiler.py; optional).
 DISPATCH_WALL_SECONDS_METRIC = "tpu:dispatch_wall_seconds"
 DISPATCH_GAP_SECONDS_METRIC = "tpu:dispatch_gap_seconds"
@@ -154,6 +155,25 @@ def families_to_metrics(
         s_count = prom_parse.latest_sample(families.get(fam + "_count", []))
         if s_sum is not None and s_count is not None and s_count.value > 0:
             setattr(updated, attr, s_sum.value / s_count.value)
+
+    # CUMULATIVE histogram sums/counts (optional), summed ACROSS label
+    # series: the capacity plane (gateway/capacity.py) differences these
+    # between scrape ticks into per-window means — the observation windows
+    # the twin's self-calibration fits.  Means alone can't give windows
+    # (they average over all time); the raw accumulators can.
+    for fam, sum_attr, count_attr in (
+        (PREFILL_SECONDS_METRIC,
+         "prefill_seconds_sum", "prefill_seconds_count"),
+        (DECODE_STEP_SECONDS_METRIC,
+         "decode_step_seconds_sum", "decode_step_seconds_count"),
+        (DECODE_BATCH_OCCUPANCY_METRIC,
+         "decode_batch_occupancy_sum", "decode_batch_occupancy_count"),
+    ):
+        sums = families.get(fam + "_sum", [])
+        counts = families.get(fam + "_count", [])
+        if sums and counts:
+            setattr(updated, sum_attr, sum(s.value for s in sums))
+            setattr(updated, count_attr, sum(s.value for s in counts))
 
     # Step-timeline profiler means (optional): the wall family sums
     # ACROSS its phase series (one engine, several phases); the gap mean

@@ -34,6 +34,10 @@ Endpoints:
   ``tpu:kv_*`` ledger families and the cross-replica prefix duplication
   index ("prefix P resident on k replicas, N blocks duplicated");
   rendered by ``tools/kv_report.py``.
+- ``GET  /debug/capacity`` — the capacity & saturation plane
+  (gateway/capacity.py): per-pod per-resource saturation indices, the
+  sim-calibrated twin's headroom-at-SLO and time-to-breach forecasts, and
+  the twin-drift trust state; rendered by ``tools/capacity_report.py``.
 - ``GET  /debug/events`` — the flight recorder (events.py): admission
   rejections, pick outcomes, disagg fallbacks, scrape failures, SLO/health
   transitions, noisy-neighbor flags; ``?since=<seq>`` for incremental
@@ -79,6 +83,7 @@ import aiohttp
 from aiohttp import web
 
 from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway import capacity as capacity_mod
 from llm_instance_gateway_tpu.gateway import fleetobs
 from llm_instance_gateway_tpu.gateway import pickledger as pickledger_mod
 from llm_instance_gateway_tpu.gateway import slo as slo_mod
@@ -148,6 +153,7 @@ class GatewayProxy:
         pools: dict | None = None,
         statebus_cfg: "statebus_mod.StateBusConfig | None" = None,
         pickledger_cfg: "pickledger_mod.PickLedgerConfig | None" = None,
+        capacity_cfg: "capacity_mod.CapacityConfig | None" = None,
     ):
         self.server = handler_server
         self.provider = provider
@@ -183,6 +189,7 @@ class GatewayProxy:
                     usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
                     placement_cfg=placement_cfg,
                     pickledger_cfg=pickledger_cfg,
+                    capacity_cfg=capacity_cfg,
                     # Scope this pool's admitted-traffic shares to its own
                     # models (the shared GatewayMetrics counts everything).
                     request_filter=(
@@ -209,7 +216,8 @@ class GatewayProxy:
                 resilience_cfg=resilience_cfg, health_cfg=health_cfg,
                 usage_cfg=usage_cfg, fairness_cfg=fairness_cfg,
                 placement_cfg=placement_cfg,
-                pickledger_cfg=pickledger_cfg)
+                pickledger_cfg=pickledger_cfg,
+                capacity_cfg=capacity_cfg)
             self._default_pool = pool_name
             # Scrape failures land in the flight recorder (Provider
             # emits, throttled); StaticProvider lacks the attribute.
@@ -225,6 +233,7 @@ class GatewayProxy:
         self.resilience = stack.resilience
         self.usage = stack.usage
         self.kvobs = stack.kvobs
+        self.capacity = stack.capacity
         self.fairness = stack.fairness
         self.placement = stack.placement
         self.pickledger = stack.pickledger
@@ -294,6 +303,7 @@ class GatewayProxy:
         app.router.add_get("/debug/health", self.handle_debug_health)
         app.router.add_get("/debug/usage", self.handle_debug_usage)
         app.router.add_get("/debug/kv", self.handle_debug_kv)
+        app.router.add_get("/debug/capacity", self.handle_debug_capacity)
         app.router.add_get("/debug/picks", self.handle_debug_picks)
         app.router.add_get("/debug/placement", self.handle_debug_placement)
         app.router.add_get("/debug/statebus", self.handle_debug_statebus)
@@ -431,6 +441,15 @@ class GatewayProxy:
                     "pods": fleetobs.collect_pod_payloads(
                         pods, "/debug/kv", thread_name="blackbox-kv"),
                 }
+                # Twin state at dump time: saturation, forecasts and the
+                # drift trust flag — was capacity exhaustion forecast, and
+                # was the forecast trusted, when the burn hit?
+                capacity_payload = None
+                if self.capacity.cfg.enabled:
+                    self.capacity.maybe_tick(max(1.0, self.obs_tick_s))
+                    capacity_payload = {
+                        name: stack.capacity.debug_payload()
+                        for name, stack in self.stacks.items()}
                 # Decision records at dump time: the last sampled picks
                 # per pool — "why were requests landing where they were in
                 # the 30s before the breach" (tools/blackbox_report.py
@@ -448,7 +467,8 @@ class GatewayProxy:
                     statebus_payload=self.statebus.debug_payload(),
                     profile_payload=profiles,
                     kv_payload=kv_payload,
-                    picks_payload=picks_payload)
+                    picks_payload=picks_payload,
+                    capacity_payload=capacity_payload)
                 self._last_dump_t = time.time()
                 self.journal.emit(events_mod.BREACH_DUMP, model=model,
                                   objective=objective, path=path)
@@ -1446,6 +1466,26 @@ class GatewayProxy:
                 for name, stack in self.stacks.items()}
         return web.json_response(payload)
 
+    async def handle_debug_capacity(self,
+                                    request: web.Request) -> web.Response:
+        """The capacity & saturation plane (gateway/capacity.py):
+        per-pod per-resource saturation indices, the calibrated twin's
+        knee/headroom/time-to-breach forecasts, drift divergences and the
+        trust state.  Floored at the configured cadence — the calibration
+        windows difference cumulative counters per rollup pass.
+        Multi-pool fronts add a ``pools`` section.  Rendered by
+        ``tools/capacity_report.py``; the fast-burn black-box dump embeds
+        the same payload."""
+        for stack in self.stacks.values():
+            if stack.capacity.cfg.enabled:
+                stack.capacity.maybe_tick(max(1.0, self.obs_tick_s))
+        payload = self.capacity.debug_payload()
+        if len(self.stacks) > 1:
+            payload["pools"] = {
+                name: stack.capacity.debug_payload()
+                for name, stack in self.stacks.items()}
+        return web.json_response(payload)
+
     async def handle_debug_picks(self, request: web.Request) -> web.Response:
         """The routing decision ledger (gateway/pickledger.py): sampled
         per-pick explanation records — stage-by-stage candidate
@@ -1526,6 +1566,16 @@ class GatewayProxy:
         # second pull; per-pod joins live at /debug/kv.
         self.kvobs.maybe_tick(max(1.0, self.obs_tick_s))
         payload["kv"] = self.kvobs.debug_payload()
+        # Capacity rollup rides along too: headroom/forecast/trust per
+        # pool, so a fleet console answers "which pool runs out first"
+        # without a second pull; full detail lives at /debug/capacity.
+        if self.capacity.cfg.enabled:
+            self.capacity.maybe_tick(max(1.0, self.obs_tick_s))
+            payload["capacity"] = {
+                name: {"saturation": cap["saturation"],
+                       "forecast": cap["forecast"]}
+                for name, stack in self.stacks.items()
+                for cap in [stack.capacity.debug_payload()]}
         # Fleet pick-steering rollup: which replicas/pools are steering
         # picks and why, joined from the statebus docs already gossiped
         # (no extra pull) — per-pick joins live at /debug/picks.
@@ -1610,6 +1660,7 @@ def main(argv: list[str] | None = None) -> None:
                          resilience_cfg=bootstrap.resilience_from_args(args),
                          fairness_cfg=bootstrap.fairness_from_args(args),
                          placement_cfg=bootstrap.placement_from_args(args),
+                         capacity_cfg=bootstrap.capacity_from_args(args),
                          fast_relay=not args.no_fast_relay,
                          pickledger_cfg=pickledger_mod.PickLedgerConfig(
                              enabled=not args.no_pick_ledger,

@@ -387,6 +387,7 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
                    profile_payload: dict | None = None,
                    kv_payload: dict | None = None,
                    picks_payload: dict | None = None,
+                   capacity_payload: dict | None = None,
                    clock=time.time) -> str:
     """Write the black-box dump for one breach; returns the file path.
 
@@ -429,6 +430,10 @@ def write_blackbox(dir_path: str, reason: dict, journal=None, tracer=None,
         # where WERE requests landing, and which advisor seam steered
         # them there?  Per-pool cursor payloads with sampled records.
         "picks": picks_payload,
+        # Twin state at dump time (gateway/capacity.py): saturation,
+        # headroom/time-to-breach forecasts and the drift trust flag —
+        # was the breach forecast, and was the forecast trusted?
+        "capacity": capacity_payload,
         "metrics_text": metrics_text,
     }
     tmp = path + ".tmp"

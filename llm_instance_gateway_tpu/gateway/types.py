@@ -106,6 +106,17 @@ class Metrics:
     # yet (or a foreign server without the families).
     prefill_seconds_mean: float = 0.0
     decode_step_seconds_mean: float = 0.0
+    # CUMULATIVE phase-histogram sums/counts behind the means above, plus
+    # the decode-batch occupancy histogram: the capacity plane
+    # (gateway/capacity.py) differences these between scrape ticks to get
+    # per-WINDOW means — the observation windows
+    # sim/calibrate.calibrate_from_observables fits the twin from.
+    prefill_seconds_sum: float = 0.0
+    prefill_seconds_count: float = 0.0
+    decode_step_seconds_sum: float = 0.0
+    decode_step_seconds_count: float = 0.0
+    decode_batch_occupancy_sum: float = 0.0
+    decode_batch_occupancy_count: float = 0.0
     # Step-timeline profiler means (tpu:dispatch_wall_seconds /
     # tpu:dispatch_gap_seconds{kind="host"} _sum/_count): per-dispatch
     # device wall and the host-sync tax between dispatches — the

@@ -220,6 +220,42 @@ GATEWAY_FAMILIES = (
            "Replica count holding each duplicated prefix (top rows by "
            "duplicated blocks; prefix = content-addressed 16-hex id).",
            GATEWAY_SURFACE),
+    Family("gateway_capacity_saturation", "gauge", ("resource",),
+           "Pool saturation index per resource, 0..1 (gateway/capacity.py; "
+           "max over pods — saturation is a weakest-link property): kv "
+           "(1 - free/capacity), decode_slots (batch occupancy window "
+           "mean), queue (waiting over waiting+running), prefill_compute "
+           "(prefill wall seconds per wall second).", GATEWAY_SURFACE),
+    Family("gateway_capacity_pod_saturation", "gauge", ("pod", "resource"),
+           "Per-pod per-resource saturation index, 0..1 (the rows behind "
+           "gateway_capacity_saturation).", GATEWAY_SURFACE),
+    Family("gateway_capacity_offered_rps", "gauge", (),
+           "EMA'd offered arrival rate (prefill completions/s summed over "
+           "the pool's pods, scrape-tick deltas).", GATEWAY_SURFACE),
+    Family("gateway_capacity_knee_rps", "gauge", (),
+           "The calibrated twin's knee: offered load where simulated TTFT "
+           "p95 crosses the SLO (bisected DES probes at the observed "
+           "prompt/output mix, times the pod count).", GATEWAY_SURFACE),
+    Family("gateway_capacity_headroom_ratio", "gauge", (),
+           "Headroom-at-SLO: (knee - offered) / knee, clamped to 0 "
+           "(0 = at or past the knee; 1 = idle).", GATEWAY_SURFACE),
+    Family("gateway_capacity_time_to_breach_seconds", "gauge", (),
+           "Forecast seconds until the offered-rate trend (least-squares "
+           "slope over recent windows) crosses the knee; -1 = no breach "
+           "on the current trend; 0 = already past the knee.  Entering "
+           "the breach horizon journals a capacity_forecast event.",
+           GATEWAY_SURFACE),
+    Family("gateway_twin_drift", "gauge", ("observable",),
+           "EMA'd relative divergence |predicted - observed| / observed "
+           "between the calibrated twin and the live pool, per observable "
+           "(prefill_s, decode_step_s, occupancy via Little's law).  "
+           "Breaching --twin-drift-threshold journals twin_drift and "
+           "untrusts forecasts.", GATEWAY_SURFACE),
+    Family("gateway_twin_trusted", "gauge", (),
+           "1 while the twin's forecasts are trusted (a model is loaded "
+           "or fitted AND drift is below threshold); 0 = capacity "
+           "surfaces still export but must not be believed.",
+           GATEWAY_SURFACE),
     Family("gateway_pick_sample_total", "counter", (),
            "Picks recorded by the routing decision ledger "
            "(gateway/pickledger.py; deterministic every-Nth sampling — "

@@ -689,6 +689,157 @@ def run_decode_lever_scenario(seed: int = 0) -> dict:
     }
 
 
+def twin_ttft_p95(
+    model: LatencyModel,
+    rate_rps: float,
+    prompt_mean: float = 202.0,
+    output_mean: float = 179.0,
+    decode_slots: int = 16,
+    duration_s: float = 4.0,
+    seed: int = 0,
+) -> float:
+    """TTFT p95 of ONE simulated server at ``rate_rps`` — the capacity
+    twin's probe primitive.  Right-censored requests (still queued at the
+    drain cutoff, i.e. the server is past its knee) count as +inf samples
+    so saturation reads as a breach instead of vanishing from the
+    percentile; a run completing <70% of its arrivals is saturated outright.
+    """
+    if rate_rps <= 0:
+        return 0.0
+    wl = WorkloadConfig(
+        qps=rate_rps, duration_s=duration_s,
+        prompt_mean=prompt_mean, prompt_std=max(1.0, 0.1 * prompt_mean),
+        output_mean=output_mean, output_std=max(1.0, 0.1 * output_mean),
+        critical_fraction=0.0, sheddable_fraction=0.0,
+        adapter_fraction=0.0, seed=seed)
+    res = simulate("least_queue", wl, n_servers=1, latency=model,
+                   decode_slots=decode_slots)
+    total = sum(res.tier_totals.values())
+    if total == 0:
+        return 0.0
+    censored = total - res.completed  # still queued at cutoff, or shed
+    if not res.ttfts or res.completed < 0.7 * total:
+        return float("inf")
+    vals = sorted(res.ttfts) + [float("inf")] * censored
+    return vals[min(len(vals) - 1, int(0.95 * len(vals)))]
+
+
+def twin_knee_rate(
+    model: LatencyModel,
+    prompt_mean: float = 202.0,
+    output_mean: float = 179.0,
+    slo_ttft_s: float = 0.5,
+    decode_slots: int = 16,
+    duration_s: float = 4.0,
+    seed: int = 0,
+    probes: int = 5,
+) -> float:
+    """Per-server knee: the highest arrival rate whose simulated TTFT p95
+    still meets ``slo_ttft_s``, found by bisection between an analytic
+    bracket's edges.  The analytic bound (slots over slot-residency time,
+    min'd with the prefill service rate) seeds the bracket so the DES
+    probes stay cheap (tens of requests each); a pool's knee is this times
+    its replica count (capacity scales linearly in replicas under
+    least-queue routing, which the gateway's production tree approximates
+    at saturation)."""
+    kv_est = decode_slots * (prompt_mean + output_mean / 2.0)
+    step_s = model.decode_s(kv_est, decode_slots)
+    decode_bound = decode_slots / max(1e-9, output_mean * step_s)
+    prefill_bound = 1.0 / max(1e-9, model.prefill_s(prompt_mean))
+    lo, hi = 0.0, 2.0 * min(decode_bound, prefill_bound)
+
+    def probe(rate: float) -> float:
+        return twin_ttft_p95(model, rate, prompt_mean=prompt_mean,
+                             output_mean=output_mean,
+                             decode_slots=decode_slots,
+                             duration_s=duration_s, seed=seed)
+
+    for _ in range(4):  # grow until the top of the bracket breaches
+        if probe(hi) > slo_ttft_s:
+            break
+        lo, hi = hi, hi * 2.0
+    for _ in range(probes):
+        mid = (lo + hi) / 2.0
+        if probe(mid) <= slo_ttft_s:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def run_twin_scenario(seed: int = 0,
+                      calibration_path: str | None = None) -> dict:
+    """The ``make sim-check`` pre-merge gate (ROADMAP item 5's throughput
+    proxy): CPU-deterministic, seeded, three assertions in one report:
+
+    1. **Calibration recovery** — observables generated from the known
+       ``V5E_DEFAULT`` model round-trip through
+       ``calibrate_from_observables`` with every fitted constant within
+       10% (the twin's self-calibration is trustworthy).
+    2. **Committed artifact** — the fit reproduces
+       ``TWIN_CALIBRATION.json`` byte-for-byte (the repo's committed twin
+       constants are exactly what the code produces).
+    3. **Knee sanity** — the fitted model's knee rate (bisected TTFT-p95
+       DES probes) separates load: 60% of knee meets the TTFT SLO, 160%
+       breaches it (the headroom forecast's foundation discriminates).
+    """
+    import os
+
+    from llm_instance_gateway_tpu.sim import calibrate as cal
+
+    slo_ttft_s = 0.5
+    obs = cal.sim_observables(V5E_DEFAULT, seed=seed, windows=24)
+    fitted, residuals = cal.calibrate_from_observables(obs)
+    truth = {
+        "prefill_base_s": V5E_DEFAULT.prefill_base_s,
+        "prefill_per_token_s": V5E_DEFAULT.prefill_per_token_s,
+        "decode_base_s": V5E_DEFAULT.decode_base_s,
+        "decode_per_kv_token_s": V5E_DEFAULT.decode_per_kv_token_s,
+        "decode_per_seq_s": V5E_DEFAULT.decode_per_seq_s,
+    }
+    errors = {k: round(abs(getattr(fitted, k) - v) / v, 4)
+              for k, v in truth.items()}
+    recovered = max(errors.values()) <= 0.10
+
+    if calibration_path is None:
+        calibration_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "TWIN_CALIBRATION.json")
+    artifact_ok = False
+    artifact_err = ""
+    try:
+        committed, _art = cal.load_calibration(calibration_path)
+        artifact_ok = cal.model_to_dict(committed) == cal.model_to_dict(fitted)
+        if not artifact_ok:
+            artifact_err = "committed constants differ from the seeded fit"
+    except (OSError, ValueError, KeyError) as e:
+        artifact_err = str(e)
+
+    knee = twin_knee_rate(fitted, slo_ttft_s=slo_ttft_s, seed=seed)
+    under = twin_ttft_p95(fitted, 0.6 * knee, seed=seed)
+    over = twin_ttft_p95(fitted, 1.6 * knee, seed=seed)
+    knee_ok = knee > 0 and under <= slo_ttft_s < over
+
+    return {
+        "scenario": "twin_calibration",
+        "seed": seed,
+        "latency_model": "v5e_default",
+        "slo_ttft_s": slo_ttft_s,
+        "fit": {"constants": cal.model_to_dict(fitted),
+                "relative_errors": errors,
+                "residuals": residuals,
+                "recovered_within_10pct": recovered},
+        "artifact": {"path": calibration_path, "ok": artifact_ok,
+                     **({"error": artifact_err} if artifact_err else {})},
+        "knee": {"knee_rps_per_server": round(knee, 3),
+                 "ttft_p95_at_60pct_s": round(under, 4),
+                 "ttft_p95_at_160pct_s": (round(over, 4)
+                                          if over != float("inf") else "inf"),
+                 "ok": knee_ok},
+        "ok": recovered and artifact_ok and knee_ok,
+    }
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="routing-policy simulator")
     parser.add_argument("--qps", type=float, nargs="+", default=[20.0, 30.0])
@@ -721,11 +872,25 @@ def main(argv=None) -> None:
                              "(steps-per-dispatch and stream-lane knobs; "
                              "the committed SIM_DECODE_LEVERS.json) and "
                              "print its report instead of the policy sweep")
+    parser.add_argument("--twin-scenario", action="store_true",
+                        help="run the deterministic capacity-twin "
+                             "calibration scenario (the `make sim-check` "
+                             "pre-merge gate: calibration recovery, "
+                             "committed TWIN_CALIBRATION.json "
+                             "reproduction, knee sanity) and print its "
+                             "report instead of the policy sweep")
     parser.add_argument("--out", default=None, metavar="PATH",
                         help="also write the placement-scenario report JSON "
                              "to this path (the committed artifact)")
     args = parser.parse_args(argv)
     latency = V5E_DEFAULT if args.latency_model == "v5e" else A100_VLLM
+    if args.twin_scenario:
+        report = run_twin_scenario()
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        raise SystemExit(0 if report["ok"] else 1)
     if args.decode_lever_scenario:
         report = run_decode_lever_scenario()
         print(json.dumps(report, indent=1))

@@ -1,0 +1,716 @@
+"""Capacity & saturation plane (gateway/capacity.py) + its seams.
+
+Covers the sim-calibrated digital twin end to end at the unit level: the
+least-squares calibration from scraped observation windows (recovery
+under noise, the degenerate-window guards, equivalence with a reference
+SVD solve), the planner's fused scrape+fold (window means, counter-reset
+clamps, the ``min_window_s`` floor, the lazy per-pod saturation derive),
+self-calibration cadence (bootstrap fast / maintain slow), the committed
+``TWIN_CALIBRATION.json`` artifact loading, drift detection with
+enter/clear hysteresis and forecast untrusting, the headroom/breach
+forecast and its ``capacity_forecast`` journal event, the
+``gateway_capacity_*``/``gateway_twin_*`` exposition contract with
+hostile labels, the proxy's ``/debug/capacity`` endpoint, the loadgen
+``--arrival`` offered-load shapes, and the operator tools
+(``tools/capacity_report.py``, lig_top's HEADROOM column, the fast-burn
+black-box dump's capacity section).
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from llm_instance_gateway_tpu import events as events_mod
+from llm_instance_gateway_tpu.gateway.capacity import (
+    NO_BREACH,
+    RESOURCES,
+    CapacityConfig,
+    CapacityPlanner,
+)
+from llm_instance_gateway_tpu.gateway.provider import StaticProvider
+from llm_instance_gateway_tpu.gateway.types import Metrics, Pod, PodMetrics
+from llm_instance_gateway_tpu.sim import calibrate as cal
+from llm_instance_gateway_tpu.sim.run import V5E_DEFAULT
+
+REPO_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+ARTIFACT = os.path.join(REPO_ROOT, "TWIN_CALIBRATION.json")
+HOSTILE = 'evil"pod\nname\\x'
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def pod_metrics(name="pod-a", *, prefill_s=0.0, prefills=0.0,
+                decode_s=0.0, decode_steps=0.0, occ_sum=0.0, occ_count=0.0,
+                prefill_tokens=0.0, decode_tokens=0.0,
+                kv_capacity=100_000, kv_free=80_000,
+                running=4, waiting=1) -> PodMetrics:
+    return PodMetrics(
+        pod=Pod(name, "127.0.0.1:1"),
+        metrics=Metrics(
+            prefill_seconds_sum=prefill_s,
+            prefill_seconds_count=prefills,
+            decode_step_seconds_sum=decode_s,
+            decode_step_seconds_count=decode_steps,
+            decode_batch_occupancy_sum=occ_sum,
+            decode_batch_occupancy_count=occ_count,
+            adapter_tokens={("m", "base", "prefill"): prefill_tokens,
+                            ("m", "base", "decode"): decode_tokens},
+            kv_tokens_capacity=kv_capacity,
+            kv_tokens_free=kv_free,
+            running_queue_size=running,
+            waiting_queue_size=waiting))
+
+
+def advance(pm: PodMetrics, *, prefill_s=0.0, prefills=0.0, decode_s=0.0,
+            decode_steps=0.0, occ_sum=0.0, occ_count=0.0,
+            prefill_tokens=0.0, decode_tokens=0.0, kv_free=None) -> None:
+    m = pm.metrics
+    m.prefill_seconds_sum += prefill_s
+    m.prefill_seconds_count += prefills
+    m.decode_step_seconds_sum += decode_s
+    m.decode_step_seconds_count += decode_steps
+    m.decode_batch_occupancy_sum += occ_sum
+    m.decode_batch_occupancy_count += occ_count
+    m.adapter_tokens[("m", "base", "prefill")] += prefill_tokens
+    m.adapter_tokens[("m", "base", "decode")] += decode_tokens
+    if kv_free is not None:
+        m.kv_tokens_free = kv_free
+
+
+def make_planner(pods=None, journal=None, **cfg_over):
+    """A planner on a virtual clock; min_window_s=0 folds every tick()
+    like the chaos rig (the 30s production floor has its own test)."""
+    cfg_over.setdefault("min_window_s", 0.0)
+    cfg_over.setdefault("forecast_every_ticks", 10 ** 9)
+    pods = pods if pods is not None else [pod_metrics()]
+    planner = CapacityPlanner(StaticProvider(pods),
+                              cfg=CapacityConfig(**cfg_over),
+                              journal=journal)
+    planner._clock = FakeClock()
+    return planner, pods
+
+
+def model_consistent_advance(pm, model, *, prompt_tokens=200.0,
+                             prefills=40.0, decode_steps=400.0,
+                             occ=0.5, out_tokens_per_req=10.0,
+                             kv_free=80_000, slots=16,
+                             decode_scale=1.0):
+    """One 5s window whose observables MATCH ``model`` (scale the decode
+    half with ``decode_scale`` to manufacture drift)."""
+    kv_mean = pm.metrics.kv_tokens_capacity - kv_free
+    # Keep the occupancy observable consistent too (Little's law:
+    # concurrency = arrival rate x service time), so only decode_scale
+    # manufactures drift.
+    pm.metrics.running_queue_size = (prefills / 5.0) * (
+        model.prefill_s(prompt_tokens)
+        + out_tokens_per_req * model.decode_s(kv_mean, occ * slots))
+    advance(pm,
+            prefill_s=prefills * model.prefill_s(prompt_tokens),
+            prefills=prefills,
+            decode_s=(decode_steps * decode_scale
+                      * model.decode_s(kv_mean, occ * slots)),
+            decode_steps=decode_steps,
+            occ_sum=occ * 5.0, occ_count=5.0,
+            prefill_tokens=prefills * prompt_tokens,
+            decode_tokens=prefills * out_tokens_per_req,
+            kv_free=kv_free)
+
+
+# ---------------------------------------------------------------------------
+# sim/calibrate.py: the least-squares fit
+# ---------------------------------------------------------------------------
+
+
+class TestCalibration:
+    def test_recovers_reference_constants_under_noise(self):
+        # Seeded: prefill_per_token_s is the hard constant (its slope
+        # term is ~1.5% of the intercept at fixture prompt lengths, so
+        # identifiability is genuinely noise-limited window-count work).
+        obs = cal.sim_observables(V5E_DEFAULT, seed=3, windows=64,
+                                  noise=0.05)
+        fitted, residuals = cal.calibrate_from_observables(obs)
+        for key in ("prefill_base_s", "prefill_per_token_s",
+                    "decode_base_s", "decode_per_kv_token_s",
+                    "decode_per_seq_s"):
+            truth = getattr(V5E_DEFAULT, key)
+            assert abs(getattr(fitted, key) - truth) / truth <= 0.10, key
+        assert residuals["windows"] == 64
+        assert 0 < residuals["decode_rms_rel"] < 0.10
+
+    def test_closed_form_matches_reference_lstsq(self):
+        """The Gram/Cramer decode solve is the SAME least squares an SVD
+        lstsq computes — the speedup must not move the constants."""
+        obs = cal.sim_observables(V5E_DEFAULT, seed=3, windows=32,
+                                  noise=0.08)
+        fitted, _ = cal.calibrate_from_observables(obs)
+        kv = np.array([o["kv_tokens_mean"] for o in obs])
+        batch = np.array([o["batch_mean"] for o in obs])
+        zs = np.array([o["decode_step_s_mean"] for o in obs])
+        design = np.stack([np.ones_like(kv), kv, batch], axis=1)
+        ref, *_ = np.linalg.lstsq(design, zs, rcond=None)
+        assert math.isclose(fitted.decode_base_s, max(ref[0], 1e-6),
+                            rel_tol=1e-6)
+        assert math.isclose(fitted.decode_per_kv_token_s, max(ref[1], 0.0),
+                            rel_tol=1e-6)
+        assert math.isclose(fitted.decode_per_seq_s, max(ref[2], 0.0),
+                            rel_tol=1e-6)
+
+    def test_insufficient_windows_raise(self):
+        obs = cal.sim_observables(V5E_DEFAULT, windows=8)
+        with pytest.raises(ValueError, match="insufficient"):
+            cal.calibrate_from_observables(obs[:3], min_windows=4)
+
+    def test_no_prompt_spread_raises(self):
+        obs = [dict(o, prefill_tokens_mean=128.0)
+               for o in cal.sim_observables(V5E_DEFAULT, windows=12)]
+        with pytest.raises(ValueError, match="prompt-length spread"):
+            cal.calibrate_from_observables(obs)
+
+    def test_collinear_decode_regressors_raise(self):
+        # kv and batch in lockstep: the decode plane is unidentifiable.
+        obs = [{"prefill_tokens_mean": 100.0 + 10 * i,
+                "prefill_s_mean": 0.03 + 0.001 * i,
+                "kv_tokens_mean": 1000.0 * (i + 1),
+                "batch_mean": 10.0 * (i + 1),
+                "decode_step_s_mean": 0.01 + 0.0001 * i}
+               for i in range(12)]
+        with pytest.raises(ValueError, match="collinear"):
+            cal.calibrate_from_observables(obs)
+
+
+# ---------------------------------------------------------------------------
+# CapacityPlanner: fold, floor, lazy derive
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerFold:
+    def test_window_means_from_accumulator_deltas(self):
+        planner, pods = make_planner()
+        planner.tick(now=1000.0)  # baseline scrape, no window yet
+        assert planner._windows == []
+        advance(pods[0], prefill_s=2.0, prefills=40.0, decode_s=4.0,
+                decode_steps=400.0, occ_sum=2.5, occ_count=5.0,
+                prefill_tokens=8000.0, decode_tokens=400.0, kv_free=60_000)
+        planner.tick(now=1010.0)
+        (w,) = planner._windows
+        assert w["dt_s"] == 10.0
+        assert w["offered_rps"] == 4.0           # 40 prefills / 10s
+        assert w["prefill_tokens_mean"] == 200.0  # 8000 / 40
+        assert w["prefill_s_mean"] == 0.05        # 2.0 / 40
+        assert w["decode_step_s_mean"] == 0.01    # 4.0 / 400
+        assert w["batch_mean"] == 8.0             # (2.5/5) * 16 slots
+        assert w["kv_tokens_mean"] == 40_000.0    # capacity - free
+        assert w["output_tokens_mean"] == 10.0    # 400 / 40
+
+    def test_counter_reset_clamps_instead_of_going_negative(self):
+        planner, pods = make_planner()
+        planner.tick(now=1000.0)
+        advance(pods[0], prefill_s=2.0, prefills=40.0, decode_s=4.0,
+                decode_steps=400.0, prefill_tokens=8000.0)
+        planner.tick(now=1010.0)
+        # Replica restart: every accumulator drops back toward zero, but
+        # this window still saw decode progress on the other counters.
+        m = pods[0].metrics
+        m.prefill_seconds_sum = 0.01
+        m.prefill_seconds_count = 1.0
+        m.adapter_tokens[("m", "base", "prefill")] = 10.0
+        m.decode_step_seconds_sum += 1.0
+        m.decode_step_seconds_count += 100.0
+        planner.tick(now=1020.0)
+        # The reset pod's negative deltas are clamped to zero: no window
+        # is produced (no positive prefill delta), nothing goes negative.
+        assert len(planner._windows) == 1
+        planner.tick(now=1020.0)  # dt=0 guard: same now, no new window
+        assert planner.ticks == 4
+        assert len(planner._windows) == 1
+
+    def test_min_window_floor_skips_folds_between_windows(self):
+        planner, pods = make_planner(min_window_s=30.0)
+        planner.tick(now=1000.0)
+        assert planner.ticks == 1
+        for dt in (5.0, 10.0, 29.9):  # inside the floor: clock-compare only
+            planner.tick(now=1000.0 + dt)
+        assert planner.ticks == 1
+        advance(pods[0], prefill_s=1.0, prefills=20.0, decode_s=1.0,
+                decode_steps=100.0, prefill_tokens=4000.0)
+        planner.tick(now=1030.0)
+        assert planner.ticks == 2
+        assert len(planner._windows) == 1
+
+    def test_maybe_tick_floors_debug_pollers(self):
+        planner, _ = make_planner()
+        planner._clock.t = 1000.0
+        planner.maybe_tick()
+        assert planner.ticks == 1
+        planner.maybe_tick()             # same instant: floored
+        assert planner.ticks == 1
+        planner._clock.t = 1001.5
+        planner.maybe_tick()
+        assert planner.ticks == 2
+
+    def test_saturation_view_is_lazy_and_correct(self):
+        pods = [pod_metrics("pod-a", kv_capacity=100_000, kv_free=25_000,
+                            running=4, waiting=6),
+                pod_metrics("pod-b", kv_capacity=100_000, kv_free=90_000,
+                            running=2, waiting=0)]
+        planner, _ = make_planner(pods)
+        planner.tick(now=1000.0)
+        advance(pods[0], prefill_s=5.0, prefills=10.0, decode_s=1.0,
+                decode_steps=100.0, occ_sum=4.5, occ_count=5.0,
+                prefill_tokens=2000.0)
+        advance(pods[1], prefill_s=0.5, prefills=5.0, decode_s=0.5,
+                decode_steps=50.0, occ_sum=0.5, occ_count=5.0,
+                prefill_tokens=1000.0)
+        planner.tick(now=1010.0)
+        # The tick itself must not have materialized the view.
+        assert planner._sat_ticks != planner.ticks
+        payload = planner.debug_payload()
+        assert planner._sat_ticks == planner.ticks  # derived lazily once
+        a = payload["pods"]["pod-a"]["saturation"]
+        assert a["kv"] == 0.75                 # 1 - 25k/100k
+        assert a["decode_slots"] == 0.9        # 4.5 / 5
+        assert a["queue"] == 0.6               # 6 / (6 + 4)
+        assert a["prefill_compute"] == 0.5     # 5s prefill / 10s wall
+        assert payload["pods"]["pod-a"]["saturation_index"] == 0.9
+        b = payload["pods"]["pod-b"]["saturation"]
+        assert b["kv"] == pytest.approx(0.1)
+        # Pool index is the weakest link (max over pods) per resource.
+        assert payload["saturation"]["kv"] == 0.75
+        assert payload["saturation"]["decode_slots"] == 0.9
+        assert set(payload["saturation"]) == set(RESOURCES)
+
+
+# ---------------------------------------------------------------------------
+# Self-calibration cadence + committed artifact
+# ---------------------------------------------------------------------------
+
+
+class TestSelfCalibration:
+    def drive(self, planner, pods, n, *, decode_scale=1.0, start=0):
+        rng_occ = (0.3, 0.5, 0.7)
+        for i in range(start, start + n):
+            # Vary prompt length, occupancy, and kv so every regressor
+            # has spread (full-rank design).
+            model_consistent_advance(
+                pods[0], V5E_DEFAULT,
+                prompt_tokens=150.0 + 40.0 * (i % 5),
+                occ=rng_occ[i % 3],
+                kv_free=80_000 - 7000 * (i % 7),
+                decode_scale=decode_scale)
+            planner.tick(now=1000.0 + 5.0 * (i + 1))
+
+    def test_bootstrap_fast_then_refit_slow(self):
+        planner, pods = make_planner(min_fit_windows=4,
+                                     refit_every_ticks=16)
+        planner.tick(now=1000.0)
+        self.drive(planner, pods, 8)
+        info = planner.debug_payload()["twin"]["model"]
+        assert info["source"] == "self"
+        first_fit = info["fit_tick"]
+        # Bootstrap cadence: the fit landed on the fast min_fit_windows
+        # retry grid, not the slow refit_every_ticks maintenance one.
+        assert first_fit <= 2 * 4, first_fit
+        self.drive(planner, pods, 20, start=8)
+        refit = planner.debug_payload()["twin"]["model"]["fit_tick"]
+        assert refit > first_fit
+        assert refit % 16 == 0  # maintenance refits on the slow cadence
+
+    def test_degenerate_traffic_keeps_previous_fit_and_records_why(self):
+        planner, pods = make_planner(min_fit_windows=4,
+                                     refit_every_ticks=1)
+        planner.tick(now=1000.0)
+        self.drive(planner, pods, 6)
+        fitted = planner.debug_payload()["twin"]["model"]
+        assert fitted["source"] == "self"
+        # Constant traffic: no spread, the refit can't identify the
+        # constants — the previous fit must survive, with the reason.
+        for i in range(70):
+            model_consistent_advance(pods[0], V5E_DEFAULT)
+            planner.tick(now=2000.0 + 5.0 * i)
+        info = planner.debug_payload()["twin"]["model"]
+        assert info["source"] == "self"
+        assert info["constants"] == fitted["constants"]
+        assert "spread" in info["last_fit_error"]
+
+    def test_committed_artifact_loads_and_pins_the_twin(self):
+        planner, _ = make_planner(calibration_path=ARTIFACT)
+        info = planner.debug_payload()["twin"]["model"]
+        assert info["source"] == "artifact"
+        committed = json.load(open(ARTIFACT))["model"]
+        assert info["constants"] == committed
+
+    def test_bad_artifact_degrades_to_self_calibration_loudly(self):
+        planner, pods = make_planner(
+            calibration_path="/nonexistent/twin.json",
+            min_fit_windows=4, refit_every_ticks=1)
+        info = planner.debug_payload()["twin"]["model"]
+        assert info["source"] == "error" and "twin.json" in info["path"]
+        planner.tick(now=1000.0)
+        TestSelfCalibration().drive(planner, pods, 6)
+        assert planner.debug_payload()["twin"]["model"]["source"] == "self"
+
+
+# ---------------------------------------------------------------------------
+# Drift detection + forecast
+# ---------------------------------------------------------------------------
+
+
+def artifact_planner(journal=None, **cfg_over):
+    cfg_over.setdefault("calibration_path", ARTIFACT)
+    return make_planner(journal=journal, **cfg_over)
+
+
+class TestDriftAndForecast:
+    def agree(self, planner, pods, n, start=0, **kw):
+        for i in range(start, start + n):
+            model_consistent_advance(pods[0], planner._model, **kw)
+            planner.tick(now=1000.0 + 5.0 * (i + 1))
+
+    def test_consistent_traffic_keeps_twin_trusted(self):
+        planner, pods = artifact_planner()
+        planner.tick(now=1000.0)
+        self.agree(planner, pods, 6)
+        payload = planner.debug_payload()
+        assert payload["twin"]["state"] == "ok"
+        assert max(payload["twin"]["drift"].values()) < 0.2
+        assert payload["forecast"]["trusted"] is True
+
+    def test_drift_hysteresis_enters_untrusts_and_clears(self):
+        journal = events_mod.EventJournal(capacity=64)
+        planner, pods = artifact_planner(journal=journal)
+        planner.tick(now=1000.0)
+        self.agree(planner, pods, 3)
+        # The pool stops behaving like the twin: decode steps take 4x
+        # the predicted wall.  One bad window is NOT drift...
+        self.agree(planner, pods, 1, start=3, decode_scale=4.0)
+        assert planner.debug_payload()["twin"]["state"] == "ok"
+        assert not [e for e in journal.snapshot()["events"]
+                    if e["kind"] == events_mod.TWIN_DRIFT]
+        # ...but a sustained mismatch is: one more window charges the
+        # divergence EMA past the threshold, then drift_enter_ticks
+        # consecutive over-threshold ticks flip the state.
+        self.agree(planner, pods, 3, start=4, decode_scale=4.0)
+        payload = planner.debug_payload()
+        assert payload["twin"]["state"] == "drift"
+        assert payload["forecast"]["trusted"] is False
+        (ev,) = [e for e in journal.snapshot()["events"]
+                 if e["kind"] == events_mod.TWIN_DRIFT]
+        assert ev["attrs"]["worst"] > 0.5
+        assert "decode_step_s" in ev["attrs"]["drift"]
+        # Behaving again: the EMA decays, and after drift_clear_ticks
+        # consecutive under-threshold windows trust returns.
+        self.agree(planner, pods, 10, start=5)
+        payload = planner.debug_payload()
+        assert payload["twin"]["state"] == "ok"
+        assert payload["forecast"]["trusted"] is True
+
+    def test_breach_forecast_event_on_rising_trend(self, monkeypatch):
+        from llm_instance_gateway_tpu.sim import run as sim_run
+
+        monkeypatch.setattr(sim_run, "twin_knee_rate",
+                            lambda *a, **k: 20.0)
+        journal = events_mod.EventJournal(capacity=64)
+        planner, pods = artifact_planner(journal=journal,
+                                         forecast_every_ticks=1,
+                                         ema_alpha=1.0)
+        planner.tick(now=1000.0)
+        # Offered load ramps toward the knee: prefills/window rises.
+        for i in range(8):
+            model_consistent_advance(pods[0], planner._model,
+                                     prefills=40.0 + 8.0 * i)
+            planner.tick(now=1000.0 + 5.0 * (i + 1))
+        fc = planner.debug_payload()["forecast"]
+        assert fc["knee_rps"] == 20.0
+        assert 0.0 < fc["headroom_ratio"] < 1.0
+        assert 0.0 < fc["time_to_breach_s"] <= 600.0
+        assert fc["breach_alarm"] is True
+        events = [e for e in journal.snapshot()["events"]
+                  if e["kind"] == events_mod.CAPACITY_FORECAST]
+        assert len(events) == 1  # alarm edge journals once, not per tick
+        # The edge fired ticks ago, so its time-to-breach reads larger
+        # than the latest forecast's.
+        assert events[0]["attrs"]["time_to_breach_s"] >= fc["time_to_breach_s"]
+        assert events[0]["attrs"]["knee_rps"] == 20.0
+
+    def test_flat_trend_has_no_breach(self, monkeypatch):
+        from llm_instance_gateway_tpu.sim import run as sim_run
+
+        monkeypatch.setattr(sim_run, "twin_knee_rate",
+                            lambda *a, **k: 20.0)
+        planner, pods = artifact_planner(forecast_every_ticks=1)
+        planner.tick(now=1000.0)
+        self.agree(planner, pods, 6)
+        fc = planner.debug_payload()["forecast"]
+        assert fc["time_to_breach_s"] == NO_BREACH
+        assert fc["breach_alarm"] is False
+
+    def test_untrusted_twin_suppresses_breach_alarm(self, monkeypatch):
+        from llm_instance_gateway_tpu.sim import run as sim_run
+
+        monkeypatch.setattr(sim_run, "twin_knee_rate",
+                            lambda *a, **k: 20.0)
+        journal = events_mod.EventJournal(capacity=64)
+        planner, pods = artifact_planner(journal=journal,
+                                         forecast_every_ticks=1,
+                                         ema_alpha=1.0)
+        planner.tick(now=1000.0)
+        # Same rising trend as the breach test, but the twin is drifted:
+        # the forecast keeps exporting yet must NOT alarm.
+        for i in range(8):
+            model_consistent_advance(pods[0], planner._model,
+                                     prefills=40.0 + 8.0 * i,
+                                     decode_scale=4.0)
+            planner.tick(now=1000.0 + 5.0 * (i + 1))
+        fc = planner.debug_payload()["forecast"]
+        assert fc["trusted"] is False
+        assert 0.0 < fc["time_to_breach_s"] <= 600.0  # still exported
+        assert fc["breach_alarm"] is False
+        assert not [e for e in journal.snapshot()["events"]
+                    if e["kind"] == events_mod.CAPACITY_FORECAST]
+
+
+# ---------------------------------------------------------------------------
+# Exposition contract
+# ---------------------------------------------------------------------------
+
+
+class TestExpositionContract:
+    def loaded(self):
+        pods = [pod_metrics(HOSTILE, kv_free=25_000, waiting=6),
+                pod_metrics("pod-b")]
+        planner, _ = make_planner(pods)
+        planner.tick(now=1000.0)
+        for pm in pods:
+            advance(pm, prefill_s=1.0, prefills=20.0, decode_s=1.0,
+                    decode_steps=100.0, occ_sum=2.5, occ_count=5.0,
+                    prefill_tokens=4000.0, decode_tokens=200.0)
+        planner.tick(now=1010.0)
+        return planner
+
+    def test_families_round_trip_with_hostile_labels(self):
+        from test_exposition_contract import lint_exposition
+
+        planner = self.loaded()
+        planner._drift = {"prefill_s": 0.01, "decode_step_s": 0.02,
+                          "occupancy": 0.03}
+        families = lint_exposition("\n".join(planner.render()) + "\n")
+        sat = {(s.labels["pod"], s.labels["resource"]): s.value
+               for s in families["gateway_capacity_pod_saturation"]}
+        assert sat[(HOSTILE, "kv")] == 0.75  # hostile pod name round-trips
+        assert {s.labels["resource"]
+                for s in families["gateway_capacity_saturation"]} == set(
+            RESOURCES)
+        assert families["gateway_capacity_offered_rps"][0].value == 4.0
+        assert families["gateway_capacity_knee_rps"][0].value == 0.0
+        assert families["gateway_capacity_headroom_ratio"][0].value == 0.0
+        assert (families["gateway_capacity_time_to_breach_seconds"][0].value
+                == NO_BREACH)
+        drift = {s.labels["observable"]: s.value
+                 for s in families["gateway_twin_drift"]}
+        assert drift == {"prefill_s": 0.01, "decode_step_s": 0.02,
+                         "occupancy": 0.03}
+        assert families["gateway_twin_trusted"][0].value == 0
+
+    def test_empty_state_still_lints(self):
+        from test_exposition_contract import lint_exposition
+
+        planner, _ = make_planner([])
+        planner.tick(now=1000.0)
+        families = lint_exposition("\n".join(planner.render()) + "\n")
+        assert families["gateway_twin_trusted"][0].value == 0
+
+    def test_registry_covers_every_rendered_family(self):
+        from llm_instance_gateway_tpu import metrics_registry
+
+        planner = self.loaded()
+        planner._drift = {"prefill_s": 0.01}
+        rendered = {line.split(" ")[2]
+                    for line in planner.render()
+                    if line.startswith("# TYPE ")}
+        assert rendered
+        assert rendered <= metrics_registry.registered_names()
+
+
+def test_proxy_debug_capacity_endpoint():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_instance_gateway_tpu.api.v1alpha1 import InferencePool
+    from llm_instance_gateway_tpu.gateway.datastore import Datastore
+    from llm_instance_gateway_tpu.gateway.handlers.server import Server
+    from llm_instance_gateway_tpu.gateway.proxy import GatewayProxy
+    from llm_instance_gateway_tpu.gateway.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    async def run():
+        pod = Pod("pod-a", "127.0.0.1:1")
+        ds = Datastore(pods=[pod])
+        ds.set_pool(InferencePool(name="pool"))
+        provider = StaticProvider([pod_metrics("pod-a")])
+        proxy = GatewayProxy(
+            Server(Scheduler(provider, token_aware=False,
+                             prefill_aware=False), ds), provider, ds)
+        client = TestClient(TestServer(proxy.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/capacity")
+            assert resp.status == 200
+            payload = await resp.json()
+        finally:
+            await client.close()
+        assert payload["ticks"] >= 1
+        assert "forecast" in payload and "saturation" in payload
+        assert payload["twin"]["model"]["source"] in ("none", "artifact",
+                                                      "self")
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# loadgen --arrival: seeded offered-load shapes
+# ---------------------------------------------------------------------------
+
+
+class TestArrivalShapes:
+    def test_timelines_are_seeded_and_deterministic(self):
+        from llm_instance_gateway_tpu.gateway import loadgen
+
+        for shape in loadgen.ARRIVAL_SHAPES:
+            a = loadgen.build_arrival_timeline(shape, 500, seed=11)
+            b = loadgen.build_arrival_timeline(shape, 500, seed=11)
+            assert a == b
+            assert a != loadgen.build_arrival_timeline(shape, 500, seed=12)
+            assert all(t2 > t1 for t1, t2 in zip(a, a[1:]))
+
+    def test_shape_statistics_discriminate(self):
+        from llm_instance_gateway_tpu.gateway import loadgen
+
+        stats = {
+            shape: loadgen.arrival_summary(
+                shape, loadgen.build_arrival_timeline(
+                    shape, 4000, rate_rps=100.0, seed=0),
+                100.0, 0)
+            for shape in loadgen.ARRIVAL_SHAPES}
+        # Poisson: memoryless, CV ~ 1, mean rate ~ the requested rate.
+        assert 0.9 < stats["poisson"]["interarrival_cv"] < 1.1
+        assert stats["poisson"]["mean_rps"] == pytest.approx(100.0,
+                                                             rel=0.1)
+        # Burst: overdispersed — CV and peak-to-mean clearly above
+        # poisson — while the MEAN rate stays normalized.
+        assert stats["burst"]["interarrival_cv"] > 1.3
+        assert (stats["burst"]["peak_to_mean"]
+                > stats["poisson"]["peak_to_mean"])
+        assert stats["burst"]["mean_rps"] == pytest.approx(100.0, rel=0.1)
+        # Diurnal: modulated but smoother than the square wave.
+        assert (stats["poisson"]["peak_to_mean"]
+                < stats["diurnal"]["peak_to_mean"]
+                < stats["burst"]["peak_to_mean"])
+        for s in stats.values():
+            assert len(s["offered_rps_windows"]) <= 64
+
+    def test_unknown_shape_raises(self):
+        from llm_instance_gateway_tpu.gateway import loadgen
+
+        with pytest.raises(ValueError, match="unknown arrival shape"):
+            loadgen.build_arrival_timeline("thundering_herd", 10)
+
+
+# ---------------------------------------------------------------------------
+# Operator tools: capacity_report, lig_top HEADROOM, blackbox section
+# ---------------------------------------------------------------------------
+
+
+def forecast_payload(trusted=True):
+    planner, pods = artifact_planner()
+    planner.tick(now=1000.0)
+    for i in range(4):
+        model_consistent_advance(pods[0], planner._model)
+        planner.tick(now=1000.0 + 5.0 * (i + 1))
+    payload = planner.debug_payload()
+    if not trusted:
+        payload["forecast"]["trusted"] = False
+        payload["twin"]["state"] = "drift"
+    return payload
+
+
+class TestCapacityReport:
+    def test_extracts_raw_payload_and_blackbox_dump(self):
+        from tools import capacity_report
+
+        payload = forecast_payload()
+        assert capacity_report.extract_capacity(payload) is payload
+        dump = {"reason": "fast_burn", "capacity": payload}
+        assert capacity_report.extract_capacity(dump) is payload
+        with pytest.raises(ValueError, match="no capacity payload"):
+            capacity_report.extract_capacity({"slo": {}})
+
+    def test_rows_and_render(self):
+        from tools import capacity_report
+
+        payload = forecast_payload()
+        rows = capacity_report.saturation_rows(payload)
+        assert [r["pod"] for r in rows] == ["pod-a", "POOL(max)"]
+        assert set(capacity_report.RESOURCES) <= set(rows[0])
+        text = capacity_report.render(payload)
+        assert "pod-a" in text and "headroom" in text.lower()
+        assert "UNTRUSTED" not in text
+        assert "UNTRUSTED" in capacity_report.render(
+            forecast_payload(trusted=False))
+
+    def test_main_once_from_file(self, tmp_path, capsys):
+        from tools import capacity_report
+
+        path = tmp_path / "capacity.json"
+        path.write_text(json.dumps(forecast_payload()))
+        assert capacity_report.main([str(path), "--once"]) == 0
+        assert "pod-a" in capsys.readouterr().out
+        assert capacity_report.main([str(path), "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows["saturation"] and rows["twin_state"] == "ok"
+
+
+class TestLigTopHeadroom:
+    def test_headroom_cell_states(self):
+        from tools import lig_top
+
+        assert lig_top.headroom_cell(None) == "-"
+        payload = {"forecast": {"headroom_ratio": 0.75, "trusted": True}}
+        assert lig_top.headroom_cell(payload) == "75%"
+        payload["forecast"]["trusted"] = False
+        assert lig_top.headroom_cell(payload) == "75%?"
+
+    def test_capacity_summary_line(self):
+        from tools import lig_top
+
+        payload = forecast_payload()
+        payload["forecast"].update(knee_rps=20.0, headroom_ratio=0.6,
+                                   time_to_breach_s=120.0, trusted=True)
+        (line,) = lig_top.capacity_lines(payload)
+        assert "knee=20.0rps" in line and "sat={" in line
+        assert "twin=ok" in line and "ttb=120s" in line
+        assert "BREACH-ALARM" not in line
+        payload["forecast"]["breach_alarm"] = True
+        assert "BREACH-ALARM" in lig_top.capacity_lines(payload)[0]
+        assert lig_top.capacity_lines(None) == []
+
+
+def test_blackbox_report_renders_capacity_section():
+    from tools import blackbox_report
+
+    dump = {"reason": {"trigger": "fast_burn", "model": "m",
+                       "objective": "ttft", "burns": {}},
+            "capacity": forecast_payload()}
+    text = blackbox_report.render_report(dump)
+    assert "Capacity twin" in text
+    assert "knee" in text.lower()

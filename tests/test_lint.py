@@ -866,3 +866,43 @@ def test_abi_baseline_matches_tree():
     # The handshake constant rides the same contract.
     py_version, _, _ = abi.py_marshals(lint.Tree(REPO))
     assert py_version == version
+
+
+def test_metric_currency_flags_unregistered_capacity_family(tmp_path):
+    """Capacity-twin satellite: a ``gateway_capacity_*``/``gateway_twin_*``
+    family rendered by the capacity planner without a registry entry
+    fails ``make lint`` — the headroom/saturation surface stays
+    operator-visible like every other plane's."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/metrics_registry.py": REGISTRY_FIXTURE,
+        f"{PKG}/gateway/capacity.py":
+            'def render(self):\n'
+            '    return ["# TYPE gateway_capacity_phantom_rps gauge",\n'
+            '            f"gateway_capacity_phantom_rps {self.x}",\n'
+            '            "# TYPE gateway_twin_mystery gauge",\n'
+            '            f"gateway_twin_mystery {self.y}"]\n'})
+    found = run_rule(root, "metric-currency")
+    assert any("gateway_capacity_phantom_rps" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+    assert any("gateway_twin_mystery" in f.message
+               and "not declared" in f.message
+               for f in found), messages(found)
+
+
+def test_event_kinds_flags_undeclared_twin_event(tmp_path):
+    """Capacity-twin satellite: a twin event kind emitted without an
+    events.py constant fails — ``twin_drift``/``capacity_forecast`` must
+    stay declared or the blackbox narration and the events_total
+    contract lose them."""
+    root = make_tree(tmp_path, {
+        f"{PKG}/events.py": EVENTS_FIXTURE
+        + 'TWIN_DRIFT = "twin_drift"\n',
+        f"{PKG}/gateway/capacity.py":
+            "def tick(self, journal):\n"
+            "    journal.emit('twin_drift', worst=0.8)\n"
+            "    journal.emit('twin_recalibrated', tick=4)\n"})
+    found = run_rule(root, "event-kinds")
+    assert any("'twin_recalibrated'" in f.message
+               for f in found), messages(found)
+    assert not any("'twin_drift'" in f.message for f in found)

@@ -742,7 +742,8 @@ def test_client_disconnect_mid_stream_is_accounted():
                                       "blackhole", "brownout", "midstream",
                                       "scrape_flap", "handoff",
                                       "noisy_neighbor", "adapter_flood",
-                                      "cold_start_storm"])
+                                      "cold_start_storm",
+                                      "saturation_ramp"])
 def test_chaos_scenario(scenario):
     from tools import chaos
 

@@ -233,3 +233,26 @@ class TestDecodeLevers:
         committed.pop("note", None)
         fresh = run_decode_lever_scenario()
         assert committed == fresh  # deterministic: byte-for-byte reproducible
+
+
+class TestTwinScenario:
+    """The ``make sim-check`` gate (capacity-twin PR): calibration
+    recovery, committed-artifact reproduction, and knee discrimination
+    in one seeded CPU-deterministic report."""
+
+    def test_twin_scenario_green(self):
+        from llm_instance_gateway_tpu.sim.run import run_twin_scenario
+
+        rep = run_twin_scenario()
+        assert rep["ok"]
+        # Noiseless seeded windows recover every constant exactly.
+        fit = rep["fit"]
+        assert fit["recovered_within_10pct"]
+        assert max(fit["relative_errors"].values()) == 0.0
+        # The committed TWIN_CALIBRATION.json is what the fitter emits.
+        assert rep["artifact"]["ok"], rep["artifact"]
+        # The knee separates load: meets SLO below, breaches above.
+        knee = rep["knee"]
+        assert knee["ok"]
+        assert knee["ttft_p95_at_60pct_s"] <= rep["slo_ttft_s"]
+        assert knee["ttft_p95_at_160pct_s"] > rep["slo_ttft_s"]

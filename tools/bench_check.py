@@ -51,6 +51,7 @@ ABSOLUTE_MAX = {
     "pick_witness_ratio": 1.05,
     "kv_ledger_ratio": 1.05,
     "pick_ledger_ratio": 1.05,
+    "capacity_tick_ratio": 1.05,
     "device_stops_ratio": 1.15,
 }
 # Absolute floors.  relay_fast_ratio (slow wall / fast wall) hovers around
@@ -84,6 +85,7 @@ _RATIO_SOURCES = {
     "pick_witness_ratio": "witness",
     "kv_ledger_ratio": "kvledger",
     "pick_ledger_ratio": "pickledger",
+    "capacity_tick_ratio": "capacity",
     "device_stops_ratio": "decode",
 }
 
@@ -100,6 +102,7 @@ _FAMILY_PRIMARY = {
     "witness": ("pick_witness_ratio", "lower"),
     "kvledger": ("kv_ledger_ratio", "lower"),
     "pickledger": ("pick_ledger_ratio", "lower"),
+    "capacity": ("capacity_tick_ratio", "lower"),
     "native": ("pick_native_us", "lower"),
     "relay": ("relay_fast_chunks_per_s", "higher"),
     "handoff": ("handoff_blocks_per_s", "higher"),
@@ -121,6 +124,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
         "witness": bench.run_witness_microbench(),
         "kvledger": bench.run_kv_ledger_microbench(),
         "pickledger": bench.run_pick_ledger_microbench(),
+        "capacity": bench.run_capacity_microbench(),
         "native": bench.run_native_pick_microbench(),
         "relay": bench.run_relay_microbench(n_chunks=512, chunk_bytes=2048),
         "decode": bench.run_decode_lever_microbench(),
@@ -141,6 +145,7 @@ def collect_families(skip_handoff: bool = False) -> dict[str, dict]:
                   "witness": bench.run_witness_microbench,
                   "kvledger": bench.run_kv_ledger_microbench,
                   "pickledger": bench.run_pick_ledger_microbench,
+                  "capacity": bench.run_capacity_microbench,
                   "decode": bench.run_decode_lever_microbench}
     for metric, fam in _RATIO_SOURCES.items():
         for _ in range(2):

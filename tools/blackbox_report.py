@@ -17,6 +17,9 @@ This tool renders it into the narrative an on-caller actually reads —
 - the KV economy at dump time (gateway/kvobs.py + per-pod /debug/kv):
   reuse efficiency, parked-KV share, the fleet duplication headline, and
   each pod's raw block-state ledger (unreachable pods marked UNAVAILABLE),
+- the capacity twin at dump time (gateway/capacity.py): saturation
+  indices, the headroom/time-to-breach forecast and whether it was
+  trusted — was this breach forecast, and did anyone get to see it?,
 - a merged chronological timeline of journal events and trace spans
   leading up to the dump (``--window`` seconds, default 60).
 
@@ -43,6 +46,7 @@ _VERSIONED_SECTIONS = (
     ("profile", "Engine step-timeline"),
     ("kv", "KV economy"),
     ("picks", "Routing decisions"),
+    ("capacity", "Capacity twin"),
 )
 
 
@@ -248,6 +252,41 @@ def render_report(dump: dict, window_s: float = 60.0) -> str:
                     f" decisive={r.get('decisive')}"
                     f" funnel={_funnel(r.get('stages'))}"
                     f" trace={r.get('trace_id', '')}")
+        lines.append("")
+    capacity = dump.get("capacity") or {}
+    if _predates(dump, "capacity"):
+        lines.append("Capacity twin: UNAVAILABLE "
+                     "(dump predates this payload section)")
+        lines.append("")
+    elif capacity:
+        # Was the breach forecast, and was the forecast trusted when it
+        # mattered?  (gateway/capacity.py; tools/capacity_report.py
+        # renders the full table from the same section.)
+        fc = capacity.get("forecast") or {}
+        twin = capacity.get("twin") or {}
+        sat = capacity.get("saturation") or {}
+        ttb = fc.get("time_to_breach_s", -1.0)
+        lines.append("Capacity twin at dump time:")
+        lines.append(
+            f"  forecast: offered={fc.get('offered_rps', 0.0)}rps"
+            f" knee={fc.get('knee_rps', 0.0)}rps"
+            f" headroom={fc.get('headroom_ratio', 0.0):.1%}"
+            f" time_to_breach="
+            + ("none" if ttb is None or ttb < 0 else f"{ttb:.0f}s")
+            + f" breach_alarm={bool(fc.get('breach_alarm'))}"
+            f" trusted={bool(fc.get('trusted'))}")
+        lines.append(
+            "  saturation: " + (" ".join(
+                f"{k}={sat[k]:.2f}" for k in sorted(sat)) or "(none)"))
+        drift = twin.get("drift") or {}
+        lines.append(
+            f"  twin: source={(twin.get('model') or {}).get('source', '?')}"
+            f" state={twin.get('state', '?')}"
+            + ("  drift: " + " ".join(
+                f"{k}={drift[k]}" for k in sorted(drift)) if drift else ""))
+        if not fc.get("trusted"):
+            lines.append("  NOTE: forecasts were UNTRUSTED at the breach "
+                         "— the twin had drifted or never calibrated")
         lines.append("")
     counts = (dump.get("events") or {}).get("counts") or {}
     if counts:

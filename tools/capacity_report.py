@@ -1,0 +1,217 @@
+"""Capacity report: saturation, headroom forecast, twin drift.
+
+Reads a ``/debug/capacity`` payload (URL, file path, or ``-`` for stdin)
+from the gateway's capacity plane (``gateway/capacity.py``) — or the
+``capacity`` section of a fast-burn black-box dump — and renders the
+operator view:
+
+- the per-pod per-resource saturation table (KV, decode slots, queue,
+  prefill compute) with the pool's weakest-link indices;
+- the headroom forecast: offered load vs the calibrated twin's knee
+  rate, headroom-at-SLO, time-to-breach on the current trend, and
+  whether a breach alarm is standing;
+- the twin itself: calibration source (committed artifact vs live
+  self-fit), fit residuals, per-observable drift EMAs against the
+  ``--twin-drift-threshold``, and the trust state — an UNTRUSTED
+  banner when drift has disarmed the forecasts.
+
+Usage:
+  python tools/capacity_report.py http://localhost:8081/debug/capacity
+  python tools/capacity_report.py http://localhost:8081/debug/capacity --once
+  python tools/capacity_report.py dump.json          # black-box dump
+  python tools/capacity_report.py - --json < payload.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.trace_report import load  # noqa: E402 — one loader, no drift
+
+# Render order mirrors gateway/capacity.py (import-free on purpose: the
+# report must open dumps from gateways whose package isn't importable).
+RESOURCES = ("kv", "decode_slots", "queue", "prefill_compute")
+DRIFT_OBSERVABLES = ("prefill_s", "decode_step_s", "occupancy")
+
+
+# ---------------------------------------------------------------------------
+# Payload extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_capacity(doc: dict) -> dict:
+    """Accept a raw ``/debug/capacity`` body, a black-box dump (its
+    ``capacity`` section), or a fleet payload row holding one."""
+    if not isinstance(doc, dict):
+        raise ValueError("payload is not a JSON object")
+    if "forecast" in doc and "saturation" in doc:
+        return doc
+    inner = doc.get("capacity")
+    if isinstance(inner, dict):
+        return extract_capacity(inner)
+    raise ValueError("no capacity payload found (expected a gateway "
+                     "/debug/capacity body or a dump's 'capacity' section)")
+
+
+# ---------------------------------------------------------------------------
+# Rows (pure — the testable core)
+# ---------------------------------------------------------------------------
+
+
+def saturation_rows(payload: dict) -> list[dict]:
+    rows = []
+    for name, view in sorted((payload.get("pods") or {}).items()):
+        sat = view.get("saturation") or {}
+        rows.append({
+            "pod": name,
+            **{r: f"{100.0 * sat.get(r, 0.0):.1f}%" for r in RESOURCES},
+            "index": f"{100.0 * view.get('saturation_index', 0.0):.1f}%",
+        })
+    pool = payload.get("saturation") or {}
+    if pool:
+        rows.append({
+            "pod": "POOL(max)",
+            **{r: f"{100.0 * pool.get(r, 0.0):.1f}%" for r in RESOURCES},
+            "index": f"{100.0 * max(pool.values(), default=0.0):.1f}%",
+        })
+    return rows
+
+
+def drift_rows(payload: dict) -> list[dict]:
+    twin = payload.get("twin") or {}
+    drift = twin.get("drift") or {}
+    threshold = (payload.get("config") or {}).get("drift_threshold", 0.5)
+    rows = []
+    for obs in DRIFT_OBSERVABLES:
+        if obs not in drift:
+            continue
+        rows.append({"observable": obs, "ema": round(drift[obs], 4),
+                     "threshold": threshold,
+                     "over": "YES" if drift[obs] > threshold else "no"})
+    return rows
+
+
+def forecast_summary(payload: dict) -> dict:
+    fc = payload.get("forecast") or {}
+    ttb = fc.get("time_to_breach_s", -1.0)
+    return {
+        "offered_rps": fc.get("offered_rps", 0.0),
+        "knee_rps": fc.get("knee_rps", 0.0),
+        "headroom_pct": round(100.0 * fc.get("headroom_ratio", 0.0), 1),
+        "time_to_breach": ("none" if ttb is None or ttb < 0
+                           else "NOW" if ttb == 0 else f"{ttb:.0f}s"),
+        "trusted": bool(fc.get("trusted")),
+        "breach_alarm": bool(fc.get("breach_alarm")),
+    }
+
+
+def _table(rows: list[dict], headers: tuple) -> str:
+    if not rows:
+        return "(no samples)"
+    widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in headers]
+
+    def fmt(vals):
+        return "  ".join(str(v).rjust(w) if i else str(v).ljust(w)
+                         for i, (v, w) in enumerate(zip(vals, widths)))
+
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines += [fmt([r[h] for h in headers]) for r in rows]
+    return "\n".join(lines)
+
+
+def render(payload: dict) -> str:
+    fc = forecast_summary(payload)
+    twin = payload.get("twin") or {}
+    model = twin.get("model") or {}
+    residuals = model.get("residuals") or {}
+    out = [
+        "CAPACITY & SATURATION "
+        f"(ticks={payload.get('ticks', 0)}, "
+        f"pods={len(payload.get('pods') or {})})",
+        "",
+        _table(saturation_rows(payload), ("pod",) + RESOURCES + ("index",)),
+        "",
+        f"Headroom forecast: offered={fc['offered_rps']}rps "
+        f"knee={fc['knee_rps']}rps headroom={fc['headroom_pct']}% "
+        f"time_to_breach={fc['time_to_breach']}"
+        + (" [BREACH ALARM]" if fc["breach_alarm"] else ""),
+    ]
+    if not fc["trusted"]:
+        out.append("*** FORECAST UNTRUSTED — twin state "
+                   f"'{twin.get('state', '?')}' (drift or no calibration); "
+                   "numbers exported but not alarmed on ***")
+    src = model.get("source", "none")
+    res_txt = " ".join(f"{k}={residuals[k]}" for k in sorted(residuals))
+    out += [
+        "",
+        f"Twin: source={src}"
+        + (f" path={model.get('path')}" if model.get("path") else "")
+        + (f" fit_tick={model.get('fit_tick')}"
+           if model.get("fit_tick") else "")
+        + f" fit_windows={twin.get('fit_windows', 0)}"
+        + (f"  residuals: {res_txt}" if res_txt else ""),
+    ]
+    if model.get("source") == "error":
+        out.append(f"  calibration artifact REJECTED: {model.get('error')}")
+    if model.get("last_fit_error"):
+        out.append("  last self-fit rejected: "
+                   f"{model.get('last_fit_error')}")
+    rows = drift_rows(payload)
+    if rows:
+        out += ["", "Twin drift (EMA of |predicted-observed|/observed):",
+                _table(rows, ("observable", "ema", "threshold", "over"))]
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Capacity report: saturation, headroom forecast, twin "
+                    "drift (from /debug/capacity)")
+    parser.add_argument("source",
+                        help="file path, http(s) URL, or - for stdin")
+    parser.add_argument("--once", action="store_true",
+                        help="render one report and exit (CI mode; URL "
+                             "sources otherwise refresh every --interval)")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="watch-mode refresh seconds (URL sources)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the extracted rows as JSON")
+    args = parser.parse_args(argv)
+
+    watch = (not args.once and not args.json
+             and args.source.startswith(("http://", "https://")))
+    while True:
+        try:
+            payload = extract_capacity(load(args.source))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({
+                "saturation": saturation_rows(payload),
+                "forecast": forecast_summary(payload),
+                "drift": drift_rows(payload),
+                "twin_state": (payload.get("twin") or {}).get("state"),
+            }, indent=1))
+            return 0
+        if watch:
+            print("\x1b[2J\x1b[H", end="")
+        print(render(payload))
+        if not watch:
+            return 0
+        time.sleep(max(0.5, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

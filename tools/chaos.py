@@ -27,6 +27,11 @@ fault schedule, drives load, and asserts recovery invariants per scenario:
                       degrades to local-only enforcement with zero 5xx
                       (statebus_stale journaled) and rejoins within 2
                       ticks of the partition healing
+``saturation_ramp``   capacity plane: a load ramp toward the pool knee —
+                      the twin's capacity_forecast event leads the SLO
+                      fast burn by >= 2 ticks, drift stays quiet on
+                      honest counters, an injected model/pool mismatch
+                      fires twin_drift and un-trusts forecasts
 ====================  ====================================================
 
 Usage: ``python tools/chaos.py --seed 0 --scenario all`` (``make chaos``).
@@ -87,7 +92,8 @@ class ChaosStack:
                  provider_cls=StaticProvider,
                  models: tuple[str, ...] = ("m",),
                  model_tiers: dict[str, object] | None = None,
-                 fairness_cfg=None, placement_cfg=None):
+                 fairness_cfg=None, placement_cfg=None,
+                 capacity_cfg=None, blackbox_dir: str | None = None):
         self.schedule = schedule
         self.seed = seed
         self.rcfg = rcfg
@@ -99,6 +105,8 @@ class ChaosStack:
         self.model_tiers = model_tiers or {}
         self.fairness_cfg = fairness_cfg
         self.placement_cfg = placement_cfg
+        self.capacity_cfg = capacity_cfg
+        self.blackbox_dir = blackbox_dir
         self.upstreams: dict[str, TestServer] = {}
         self.state: dict[str, dict] = {}
         self.client: TestClient | None = None
@@ -130,6 +138,8 @@ class ChaosStack:
             resilience_cfg=self.rcfg,
             fairness_cfg=self.fairness_cfg,
             placement_cfg=self.placement_cfg,
+            capacity_cfg=self.capacity_cfg,
+            blackbox_dir=self.blackbox_dir,
             # Every pick recorded: the scenarios assert on the decision
             # ledger's counterfactual attribution, not a sample of it.
             pickledger_cfg=PickLedgerConfig(sample_every=1),
@@ -881,6 +891,221 @@ async def scenario_replica_partition(seed: int) -> dict:
         return report
 
 
+async def scenario_saturation_ramp(seed: int) -> dict:
+    """Capacity-plane acceptance: a slow offered-load ramp toward the
+    pool's knee.  Three bars, one stack:
+
+    - **Forecast leads the page.**  The capacity plane's
+      ``capacity_forecast`` event (time-to-breach entered the horizon)
+      must fire at least 2 observability ticks BEFORE the SLO engine's
+      fast-burn transition — the whole point of a digital twin is the
+      alarm that arrives while there is still time to act.
+    - **Drift stays quiet on honest traffic.**  The synthesized scrape
+      counters are generated FROM a known ``LatencyModel`` (V5E), so the
+      self-calibrated twin must track them: ZERO ``twin_drift`` events
+      through warmup and ramp.
+    - **A lying pool un-trusts the twin.**  After the burn, the replica
+      counters flip to a 4x-slower reality (the injected mismatch): the
+      drift detector must journal ``twin_drift`` within a few ticks,
+      flip ``trusted`` off, and suppress the breach-forecast alarm.
+
+    The gateway side is fully real (the REAL CapacityPlanner self-
+    calibrates from the scraped windows, the REAL SLOEngine judges the
+    recorded TTFTs, the fast-burn hook writes the REAL black-box dump —
+    asserted to embed the twin state).  The replica side synthesizes the
+    cumulative counters a scrape would return, Little's-law-consistent
+    with the generating model below the knee.  Time is virtual: both
+    planes tick with explicit ``now`` so every "within N ticks" bar is
+    deterministic."""
+    import tempfile
+
+    from llm_instance_gateway_tpu.gateway.capacity import CapacityConfig
+    from llm_instance_gateway_tpu.sim.core import V5E_DEFAULT
+
+    schedule = faultinject.FaultSchedule([], seed=seed)
+    rcfg = ResilienceConfig(health_policy="log_only", max_retries=1,
+                            ttft_timeout_s=2.0, connect_timeout_s=2.0,
+                            stream_idle_timeout_s=2.0)
+    # Harness-speed cadences: fit from 4 windows, refit + forecast every
+    # tick.  slo_ttft_s matches the SLO engine's default ttft threshold
+    # (1.0s) so the knee the twin probes is the knee the page watches.
+    ccfg = CapacityConfig(min_fit_windows=4, refit_every_ticks=1,
+                          forecast_every_ticks=1, slo_ttft_s=1.0,
+                          trend_window=8, breach_horizon_s=600.0,
+                          min_window_s=0.0)
+    dump_dir = tempfile.mkdtemp(prefix="lig-chaos-blackbox-")
+    gen = V5E_DEFAULT
+    rng = random.Random(seed)
+    slots = float(ccfg.decode_slots)
+    kv_capacity = 200_000.0
+    dt, clock = 5.0, [1000.0]
+    async with ChaosStack(schedule, seed, rcfg, capacity_cfg=ccfg,
+                          blackbox_dir=dump_dir) as stack:
+        proxy = stack.proxy
+        cap = proxy.capacity
+        # The planner timestamps its own on-demand passes (the fast-burn
+        # hook's maybe_tick): pin it to the scenario's virtual clock so a
+        # wall-clock read cannot fold a garbage mega-window into the fit.
+        cap._clock = lambda: clock[0]
+        cum = {pm.pod.name: {"prefill_s": 0.0, "prefills": 0.0,
+                             "decode_s": 0.0, "steps": 0.0, "occ": 0.0,
+                             "occs": 0.0, "ptoks": 0.0, "dtoks": 0.0}
+               for pm in proxy.provider.all_pod_metrics()}
+        n_pods = len(cum)
+
+        def scrape(rate_rps: float, mismatch: float = 1.0) -> None:
+            """One synthetic scrape round at pool rate ``rate_rps``:
+            cumulative counters grown exactly as the generating model
+            would (``mismatch`` scales the observed seconds — the
+            injected model/pool divergence)."""
+            prompt = rng.uniform(120.0, 260.0)
+            output = rng.uniform(130.0, 170.0)
+            kv_per_seq = rng.uniform(2000.0, 4500.0)
+            per_pod = rate_rps / n_pods
+            # Little's law twice: batch = per-pod concurrency at this
+            # rate (one refinement pass resolves decode_s(batch)).
+            batch = per_pod * (gen.prefill_s(prompt)
+                               + output * gen.decode_s(kv_per_seq * 8, 8))
+            kv = max(1.0, batch) * kv_per_seq
+            service = (gen.prefill_s(prompt)
+                       + output * gen.decode_s(kv, batch))
+            batch = min(slots, max(0.5, per_pod * service))
+            kv = batch * kv_per_seq
+            overflow = max(0.0, per_pod * service - slots)
+            for pm in proxy.provider.all_pod_metrics():
+                c = cum[pm.pod.name]
+                prefills = per_pod * dt
+                steps = max(1.0, prefills * output / max(1.0, batch))
+                c["prefills"] += prefills
+                c["prefill_s"] += prefills * gen.prefill_s(prompt) * mismatch
+                c["steps"] += steps
+                c["decode_s"] += steps * gen.decode_s(kv, batch) * mismatch
+                c["occ"] += steps * (batch / slots)
+                c["occs"] += steps
+                c["ptoks"] += prefills * prompt
+                c["dtoks"] += prefills * output
+                m = pm.metrics
+                m.prefill_seconds_sum = c["prefill_s"]
+                m.prefill_seconds_count = c["prefills"]
+                m.decode_step_seconds_sum = c["decode_s"]
+                m.decode_step_seconds_count = c["steps"]
+                m.decode_batch_occupancy_sum = c["occ"]
+                m.decode_batch_occupancy_count = c["occs"]
+                m.adapter_tokens = {("m", "m", "prefill"): c["ptoks"],
+                                    ("m", "m", "decode"): c["dtoks"]}
+                m.kv_tokens_capacity = kv_capacity
+                m.kv_tokens_free = kv_capacity - kv
+                m.running_queue_size = int(round(batch))
+                m.waiting_queue_size = int(round(overflow))
+
+        def serve_slo(n: int, ttft_s: float) -> None:
+            for _ in range(n):
+                proxy.metrics.record_request("m")
+                proxy.metrics.record_phase("m", "completions", ttft_s=ttft_s)
+
+        def step(rate: float, ttft_s: float, n_req: int,
+                 mismatch: float = 1.0) -> None:
+            clock[0] += dt
+            scrape(rate, mismatch=mismatch)
+            cap.tick(now=clock[0])
+            serve_slo(n_req, ttft_s)
+            proxy.slo.tick(now=clock[0])
+
+        def first_tick(kind: str) -> int | None:
+            ev = proxy.journal.events(kind=kind, limit=4)
+            return ev[0]["attrs"]["tick"] if ev else None
+
+        # Phase A — steady warmup well below the knee: the twin self-
+        # calibrates; constant rate = flat trend = no breach forecast.
+        for _ in range(6):
+            step(rate=4.0, ttft_s=0.05, n_req=10)
+        payload = cap.debug_payload()
+        assert payload["twin"]["model"]["source"] == "self", payload["twin"]
+        assert first_tick(events_mod.TWIN_DRIFT) is None, payload["twin"]
+        assert first_tick(events_mod.CAPACITY_FORECAST) is None, payload
+
+        # Phase B — the ramp: +1.5 rps per tick toward the knee.  TTFT
+        # stays good until offered crosses the twin's knee (that IS what
+        # a knee means), then collapses; the rate holds just above the
+        # knee while the SLO windows fill with bad requests.
+        rate, fast_burn_i, forecast_i = 4.0, None, None
+        for i in range(1, 41):
+            knee = cap.debug_payload()["forecast"]["knee_rps"]
+            over = knee > 0 and rate >= knee
+            if not over:
+                rate += 1.5
+            step(rate=rate, ttft_s=1.8 if over else 0.05,
+                 n_req=60 if over else 10)
+            if forecast_i is None and first_tick(
+                    events_mod.CAPACITY_FORECAST) is not None:
+                forecast_i = i
+            slo_evs = proxy.journal.events(
+                kind=events_mod.SLO_TRANSITION, limit=64)
+            if any(e["attrs"]["to"] == "fast_burn" for e in slo_evs):
+                fast_burn_i = i
+                break
+        pre_burn = cap.debug_payload()
+        forecast_ev = proxy.journal.events(
+            kind=events_mod.CAPACITY_FORECAST, limit=4)[0]["attrs"]
+
+        # The black-box dump the burn triggered must embed the twin
+        # state (the write is dispatched to the executor; wait for it).
+        dump = None
+        for _ in range(100):
+            dumps = proxy.journal.events(kind=events_mod.BREACH_DUMP,
+                                         limit=4)
+            if dumps:
+                with open(dumps[0]["attrs"]["path"]) as f:
+                    dump = json.load(f)
+                break
+            await asyncio.sleep(0.05)
+
+        # Phase C — the injected mismatch: the pool turns 4x slower than
+        # the twin's constants.  Drift must fire and un-trust forecasts.
+        drift_after = None
+        for i in range(1, 9):
+            step(rate=6.0, ttft_s=0.05, n_req=10, mismatch=4.0)
+            if drift_after is None and first_tick(
+                    events_mod.TWIN_DRIFT) is not None:
+                drift_after = i
+        post = cap.debug_payload()
+
+        report = {
+            "scenario": "saturation_ramp",
+            "knee_rps": pre_burn["forecast"]["knee_rps"],
+            "forecast_tick": forecast_i,
+            "fast_burn_tick": fast_burn_i,
+            "lead_ticks": (fast_burn_i - forecast_i
+                           if forecast_i and fast_burn_i else None),
+            "forecast_event": forecast_ev,
+            "drift_events_before_mismatch": 0 if drift_after else None,
+            "dump_has_capacity": bool(dump and dump.get("capacity")),
+            "drift_fired_after_ticks": drift_after,
+            "post_mismatch_state": post["twin"]["state"],
+            "post_mismatch_trusted": post["forecast"]["trusted"],
+            "post_mismatch_breach_alarm": post["forecast"]["breach_alarm"],
+        }
+        # The forecast led the page by >= 2 ticks.
+        assert forecast_i is not None and fast_burn_i is not None, report
+        assert fast_burn_i - forecast_i >= 2, report
+        # Honest traffic never drifted: the first twin_drift event (if
+        # any) came from the mismatch phase, not the ramp.
+        pre_mismatch_drift = [
+            e for e in proxy.journal.events(kind=events_mod.TWIN_DRIFT,
+                                            limit=16)
+            if e["attrs"]["tick"] <= pre_burn["ticks"]]
+        assert not pre_mismatch_drift, report
+        assert pre_burn["forecast"]["trusted"], report
+        # The dump landed and carries the twin state.
+        assert report["dump_has_capacity"], report
+        # The mismatch fired drift, flipped trust, muzzled the alarm.
+        assert drift_after is not None, report
+        assert post["twin"]["state"] == "drift", report
+        assert not post["forecast"]["trusted"], report
+        assert not post["forecast"]["breach_alarm"], report
+        return report
+
+
 SCENARIOS = {
     "blackhole": scenario_blackhole,
     "brownout": scenario_brownout,
@@ -891,6 +1116,7 @@ SCENARIOS = {
     "adapter_flood": scenario_adapter_flood,
     "cold_start_storm": scenario_cold_start_storm,
     "replica_partition": scenario_replica_partition,
+    "saturation_ramp": scenario_saturation_ramp,
 }
 
 

@@ -30,8 +30,8 @@ CLEAR = "\x1b[2J\x1b[H"
 BOLD, RED, DIM, RESET = "\x1b[1m", "\x1b[31m", "\x1b[2m", "\x1b[0m"
 
 COLUMNS = ("MODEL", "ADAPTER", "STEP%", "TOK%", "KV%", "TRAF%", "SCORE",
-           "STATE", "TIERS", "STEER")
-WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7, 14, 6)
+           "STATE", "TIERS", "STEER", "HEADROOM")
+WIDTHS = (18, 18, 7, 7, 7, 7, 7, 7, 14, 6, 8)
 
 
 def fetch_usage(url: str, timeout_s: float = 5.0) -> dict:
@@ -62,6 +62,51 @@ def fetch_picks(url: str, timeout_s: float = 5.0) -> dict | None:
             return json.loads(resp.read().decode("utf-8"))
     except (OSError, ValueError):
         return None
+
+
+def fetch_capacity(url: str, timeout_s: float = 5.0) -> dict | None:
+    """Best-effort /debug/capacity fetch (gateway/capacity.py) — the
+    HEADROOM column degrades to '-' against gateways predating the
+    capacity plane."""
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/debug/capacity",
+                timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def headroom_cell(capacity: dict | None) -> str:
+    """The HEADROOM column value — pool headroom-at-SLO from the capacity
+    plane (one pool per gateway, so every tenant row shares it); '?'
+    suffix when the twin is drifted/uncalibrated and the number is
+    exported-but-untrusted."""
+    if not capacity:
+        return "-"
+    fc = capacity.get("forecast") or {}
+    cell = "%.0f%%" % (100.0 * fc.get("headroom_ratio", 0.0))
+    return cell if fc.get("trusted") else cell + "?"
+
+
+def capacity_lines(capacity: dict | None) -> list[str]:
+    """The capacity/forecast summary line (pure; from /debug/capacity):
+    pool saturation indices, offered vs knee, time-to-breach, twin
+    trust."""
+    if not capacity:
+        return []
+    fc = capacity.get("forecast") or {}
+    sat = capacity.get("saturation") or {}
+    ttb = fc.get("time_to_breach_s", -1.0)
+    return [
+        "capacity: offered=%.1frps knee=%.1frps headroom=%s ttb=%s "
+        "sat={%s} twin=%s%s"
+        % (fc.get("offered_rps", 0.0), fc.get("knee_rps", 0.0),
+           headroom_cell(capacity),
+           "none" if ttb is None or ttb < 0 else "%.0fs" % ttb,
+           ", ".join(f"{k}:{sat[k]:.2f}" for k in sorted(sat)),
+           (capacity.get("twin") or {}).get("state", "?"),
+           " BREACH-ALARM" if fc.get("breach_alarm") else "")]
 
 
 def steer_counts(picks: dict | None) -> dict[tuple[str, str], int]:
@@ -131,7 +176,8 @@ def kv_lines(kv: dict | None) -> list[str]:
 
 def render_table(payload: dict, color: bool = False,
                  kv: dict | None = None,
-                 picks: dict | None = None) -> str:
+                 picks: dict | None = None,
+                 capacity: dict | None = None) -> str:
     """One frame of the console (pure function — unit-tested and shared by
     --once).  Rows arrive pre-sorted by step-seconds share, descending."""
     lines = []
@@ -159,6 +205,7 @@ def render_table(payload: dict, color: bool = False,
         lines.append("residency: %d slot / %d host copies across %d pods"
                      % (slot_total, host_total, len(residency)))
     lines += kv_lines(kv)
+    lines += capacity_lines(capacity)
     lines += pick_lines(picks)
     fairness = payload.get("fairness") or {}
     if fairness:
@@ -184,6 +231,7 @@ def render_table(payload: dict, color: bool = False,
         lines.append("(no attribution samples yet — is traffic flowing "
                      "and are replicas exposing tpu:adapter_*_total?)")
     steers = steer_counts(picks)
+    hr_cell = headroom_cell(capacity)
     for r in rows:
         share = r.get("share") or {}
         flagged = r.get("state") == "noisy"
@@ -203,6 +251,7 @@ def render_table(payload: dict, color: bool = False,
             r.get("state", "quiet"),
             tiers_cell,
             steer_cell,
+            hr_cell,
         ), RED if (flagged and color) else ""))
     return "\n".join(lines)
 
@@ -220,12 +269,14 @@ def main(argv: list[str] | None = None) -> int:
         if args.once:
             print(render_table(fetch_usage(args.url),
                                kv=fetch_kv(args.url),
-                               picks=fetch_picks(args.url)))
+                               picks=fetch_picks(args.url),
+                               capacity=fetch_capacity(args.url)))
             return 0
         while True:
             frame = render_table(fetch_usage(args.url), color=True,
                                  kv=fetch_kv(args.url),
-                                 picks=fetch_picks(args.url))
+                                 picks=fetch_picks(args.url),
+                                 capacity=fetch_capacity(args.url))
             sys.stdout.write(CLEAR + frame + "\n"
                              + f"{DIM}{args.url}  ^C to quit{RESET}\n")
             sys.stdout.flush()
